@@ -1,0 +1,48 @@
+"""Demo: pending ops are cancelled when the endpoint closes.
+
+Analogue of the reference's cb.py (reference: cb.py:12-40): posts a receive
+that nothing will ever match, closes the client, and shows the fail callback
+firing with a cancellation reason.
+
+Run:  python examples/cancel_on_close.py
+"""
+
+import asyncio
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from starway_tpu import Client, Server  # noqa: E402
+
+PORT = 23755
+
+
+async def main() -> None:
+    server = Server()
+    server.listen("127.0.0.1", PORT)
+    client = Client()
+    await client.aconnect("127.0.0.1", PORT)
+
+    sink = np.empty(1024, dtype=np.uint8)
+
+    async def doomed_recv():
+        try:
+            await client.arecv(sink, tag=999, tag_mask=(1 << 64) - 1)
+            print("recv completed (unexpected!)")
+        except Exception as e:
+            print(f"recv failed as expected: {e}")
+
+    task = asyncio.create_task(doomed_recv())
+    await asyncio.sleep(0.05)
+    print("closing client with recv in flight...")
+    await client.aclose()
+    await task
+    await server.aclose()
+    print("done")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,56 @@
+"""Manual perf: concurrent large sends (reference: test.py:18-56).
+
+Fires 5 x 1 GiB sends concurrently from client to server and reports
+aggregate throughput.
+
+Run:  python examples/throughput.py [--tls tcp] [--count 5] [--size 1g]
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from starway_tpu import Client, Server  # noqa: E402
+
+PORT = 23753
+
+
+async def main(count: int, size: int) -> None:
+    server = Server()
+    server.listen("127.0.0.1", PORT)
+    client = Client()
+    await client.aconnect("127.0.0.1", PORT)
+
+    payloads = [np.full(size, i, dtype=np.uint8) for i in range(count)]
+    sinks = [np.empty(size, dtype=np.uint8) for _ in range(count)]
+
+    t0 = time.perf_counter()
+    recvs = [server.arecv(s, 0, 0) for s in sinks]
+    sends = [client.asend(p, i) for i, p in enumerate(payloads)]
+    await asyncio.gather(*sends, *recvs)
+    dt = time.perf_counter() - t0
+
+    total = count * size
+    print(f"{count} x {size} bytes in {dt:.3f}s -> {total / dt / 1e9:.2f} GB/s aggregate")
+
+    await client.aclose()
+    await server.aclose()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tls")
+    ap.add_argument("--count", type=int, default=5)
+    ap.add_argument("--size", default="1g")
+    args = ap.parse_args()
+    if args.tls:
+        os.environ["STARWAY_TLS"] = args.tls
+    from starway_tpu.bench import parse_size
+
+    asyncio.run(main(args.count, parse_size(args.size)))

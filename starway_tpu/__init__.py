@@ -1,0 +1,59 @@
+"""starway-tpu: TPU-native asynchronous point-to-point communication.
+
+A brand-new implementation of the capabilities of the reference library
+``Clouder0/starway`` (an asyncio tag-matched P2P layer over OpenUCX), built
+for the TPU stack instead: host tag matching + event-driven engines replace
+UCX workers, ``jax.Array`` HBM buffers ride an in-process/ICI device plane,
+and TCP carries the cross-process (DCN-adjacent) bootstrap path.
+
+Public surface mirrors the reference (src/starway/__init__.py:351-358):
+
+>>> import starway_tpu as sw
+>>> server = sw.Server(); server.listen("127.0.0.1", 13337)
+>>> client = sw.Client(); await client.aconnect("127.0.0.1", 13337)
+>>> await client.asend(np.arange(16, dtype=np.uint8), tag=7)
+"""
+
+from __future__ import annotations
+
+from .api import Client, Server
+from .core.endpoint import ServerEndpoint
+from .device import DeviceBuffer
+
+__version__ = "0.1.0"
+
+
+def check_sys_libs() -> str:
+    """Report which engine implementation is active.
+
+    The reference's analogue reports system-vs-wheel libucx
+    (src/starway/__init__.py:63-65).  There is no UCX here; instead this
+    returns ``"native"`` when the C++ engine extension is loaded and
+    ``"python"`` for the pure-Python engine.
+    """
+    from . import config
+
+    if config.use_native():
+        try:
+            from . import _native  # type: ignore  # noqa: F401
+
+            return "native"
+        except ImportError:
+            pass
+    return "python"
+
+
+def list_benchmark_scenarios() -> list[str]:
+    from .benchmarks import list_scenarios
+
+    return list_scenarios()
+
+
+__all__ = [
+    "Server",
+    "Client",
+    "ServerEndpoint",
+    "DeviceBuffer",
+    "check_sys_libs",
+    "list_benchmark_scenarios",
+]

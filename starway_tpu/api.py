@@ -1,0 +1,319 @@
+"""Public asyncio API: ``Server`` and ``Client``.
+
+The exact contract of the reference's Python layer
+(src/starway/__init__.py:71-348 and src/starway/_bindings.pyi): callback-style
+``send``/``recv``/``flush`` plus future-style ``asend``/``arecv``/``aflush``
+variants, dual bootstrap (socket listener / worker-address bytes), endpoint
+introspection, and ``evaluate_perf``.  Completion callbacks run on the engine
+thread and trampoline into asyncio with ``loop.call_soon_threadsafe``
+(reference: src/starway/__init__.py:124-128).
+
+Buffers: 1-D ``uint8`` NumPy arrays are the host path (zero-copy, the buffer
+must outlive the operation -- reference: src/bindings/main.hpp:55-59).
+Non-uint8 arrays are value-cast to uint8 via a copy, matching nanobind's
+implicit ndarray conversion in the reference bindings.  ``jax.Array`` and
+:class:`~starway_tpu.device.DeviceBuffer` payloads take the device plane (see
+device.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, Optional
+
+import numpy as np
+
+from .core.endpoint import ServerEndpoint
+from .core.engine import ClientWorker, ServerWorker
+
+logger = logging.getLogger("starway_tpu")
+
+_U64_MASK = (1 << 64) - 1
+
+
+def _is_device_payload(buffer) -> bool:
+    from . import device
+
+    return device.is_device_payload(buffer)
+
+
+def _send_view(buffer):
+    """Coerce a send payload to (keepalive, flat uint8 memoryview)."""
+    if isinstance(buffer, np.ndarray):
+        arr = buffer
+        if arr.dtype != np.uint8:
+            # nanobind-style implicit conversion: value-cast copy.
+            arr = np.ascontiguousarray(arr).astype(np.uint8)
+        elif not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
+        return arr, memoryview(arr).cast("B")
+    if isinstance(buffer, (bytes, bytearray, memoryview)):
+        return buffer, memoryview(buffer).cast("B")
+    raise TypeError(
+        f"unsupported send buffer type {type(buffer)!r}; expected numpy uint8 "
+        "array, bytes-like, jax.Array, or DeviceBuffer"
+    )
+
+
+def _recv_view(buffer):
+    """Coerce a receive target to (keepalive, writable flat uint8 memoryview)."""
+    if isinstance(buffer, np.ndarray):
+        if buffer.dtype != np.uint8:
+            raise TypeError("receive buffer must be a uint8 ndarray")
+        if not buffer.flags["C_CONTIGUOUS"]:
+            raise TypeError("receive buffer must be C-contiguous")
+        if not buffer.flags["WRITEABLE"]:
+            raise TypeError("receive buffer must be writable")
+        return buffer, memoryview(buffer).cast("B")
+    if isinstance(buffer, (bytearray, memoryview)):
+        mv = memoryview(buffer).cast("B")
+        if mv.readonly:
+            raise TypeError("receive buffer must be writable")
+        return buffer, mv
+    raise TypeError(
+        f"unsupported receive buffer type {type(buffer)!r}; expected numpy "
+        "uint8 array, bytearray, or DeviceBuffer"
+    )
+
+
+def _tag(tag: int) -> int:
+    return int(tag) & _U64_MASK
+
+
+def _future_pair(loop: Optional[asyncio.AbstractEventLoop], result_factory=None):
+    """Build (future, done_cb, fail_cb) bridging engine-thread completions to
+    asyncio, tolerant of the loop having shut down underneath us."""
+    if loop is None:
+        loop = asyncio.get_running_loop()
+    fut: asyncio.Future = asyncio.Future(loop=loop)
+
+    def _safe(call, *args):
+        def apply():
+            if not fut.done():
+                call(*args)
+
+        try:
+            loop.call_soon_threadsafe(apply)
+        except RuntimeError:
+            pass  # loop already closed; completion is dropped
+
+    def done(*args):
+        _safe(fut.set_result, result_factory(*args) if result_factory else None)
+
+    def fail(reason: str):
+        _safe(fut.set_exception, Exception(reason))
+
+    return fut, done, fail
+
+
+class Server:
+    """Accepting side.  Reference: class Server, src/starway/__init__.py:71-209."""
+
+    def __init__(self):
+        self._server = ServerWorker()
+
+    # --------------------------------------------------------------- listen
+    def listen(self, addr: str, port: int) -> None:
+        self._server.listen(addr, port)
+
+    def listen_address(self) -> bytes:
+        return self._server.listen_address()
+
+    def set_accept_cb(self, on_accept: Callable[[ServerEndpoint], None]) -> None:
+        self._server.set_accept_cb(on_accept)
+
+    def get_worker_address(self) -> bytes:
+        return self._server.get_worker_address()
+
+    def list_clients(self) -> set[ServerEndpoint]:
+        return self._server.list_clients()
+
+    # ---------------------------------------------------------------- close
+    def aclose(self, loop: Optional[asyncio.AbstractEventLoop] = None):
+        fut, done, _ = _future_pair(loop)
+
+        def close_cb():
+            logger.debug("starway server closed")
+            done()
+
+        self._server.close(close_cb)
+        return fut
+
+    # ----------------------------------------------------------------- send
+    def send(self, client_ep: ServerEndpoint, buffer, tag: int,
+             done_callback: Callable[[], None], fail_callback: Callable[[str], None]) -> None:
+        if _is_device_payload(buffer):
+            from . import device
+
+            device.send_device(self._server, client_ep._conn, buffer, _tag(tag),
+                               done_callback, fail_callback)
+            return
+        owner, view = _send_view(buffer)
+        self._server.submit_send(client_ep._conn, view, _tag(tag),
+                                 done_callback, fail_callback, owner)
+
+    def asend(self, client_ep: ServerEndpoint, buffer, tag: int,
+              loop: Optional[asyncio.AbstractEventLoop] = None):
+        fut, done, fail = _future_pair(loop)
+        self.send(client_ep, buffer, tag, done, fail)
+        return fut
+
+    # ----------------------------------------------------------------- recv
+    def recv(self, buffer, tag: int, tag_mask: int,
+             done_callback: Callable[[int, int], None],
+             fail_callback: Callable[[str], None]) -> None:
+        if _is_device_payload(buffer):
+            from . import device
+
+            device.post_device_recv(self._server, buffer, _tag(tag), _tag(tag_mask),
+                                    done_callback, fail_callback)
+            return
+        owner, view = _recv_view(buffer)
+        self._server.post_recv(view, _tag(tag), _tag(tag_mask),
+                               done_callback, fail_callback, owner)
+
+    def arecv(self, buffer, tag: int, tag_mask: int,
+              loop: Optional[asyncio.AbstractEventLoop] = None):
+        fut, done, fail = _future_pair(loop, result_factory=lambda st, ln: (st, ln))
+        self.recv(buffer, tag, tag_mask, done, fail)
+        return fut
+
+    # ---------------------------------------------------------------- flush
+    def flush(self, done_callback: Callable[[], None],
+              fail_callback: Callable[[str], None]) -> None:
+        self._server.submit_flush(done_callback, fail_callback)
+
+    def aflush(self, loop: Optional[asyncio.AbstractEventLoop] = None):
+        fut, done, fail = _future_pair(loop)
+        self.flush(done, fail)
+        return fut
+
+    def flush_ep(self, client_ep: ServerEndpoint, done_callback: Callable[[], None],
+                 fail_callback: Callable[[str], None]) -> None:
+        self._server.submit_flush(done_callback, fail_callback, [client_ep._conn])
+
+    def aflush_ep(self, client_ep: ServerEndpoint,
+                  loop: Optional[asyncio.AbstractEventLoop] = None):
+        fut, done, fail = _future_pair(loop)
+        self.flush_ep(client_ep, done, fail)
+        return fut
+
+    # ------------------------------------------------------------ telemetry
+    def evaluate_perf(self, client_ep: ServerEndpoint, msg_size: int) -> float:
+        return self._server.evaluate_perf(client_ep._conn, msg_size)
+
+    def __del__(self):
+        try:
+            self._server.force_close()
+        except Exception:
+            pass
+
+
+class Client:
+    """Connecting side.  Reference: class Client, src/starway/__init__.py:212-348."""
+
+    def __init__(self):
+        self._client = ClientWorker()
+
+    # -------------------------------------------------------------- connect
+    def aconnect(self, addr: str, port: int,
+                 loop: Optional[asyncio.AbstractEventLoop] = None):
+        fut, done, fail = _future_pair(loop)
+
+        def connection_cb(status: str):
+            if status == "":
+                logger.debug("starway client connected to %s:%s", addr, port)
+                done()
+            else:
+                fail(status)
+
+        self._client.connect(addr, port, connection_cb)
+        return fut
+
+    def aconnect_address(self, remote_address: bytes,
+                         loop: Optional[asyncio.AbstractEventLoop] = None):
+        fut, done, fail = _future_pair(loop)
+
+        def connection_cb(status: str):
+            if status == "":
+                logger.debug("starway client connected via worker address")
+                done()
+            else:
+                fail(status)
+
+        self._client.connect_address(remote_address, connection_cb)
+        return fut
+
+    def get_worker_address(self) -> bytes:
+        return self._client.get_worker_address()
+
+    # ---------------------------------------------------------------- close
+    def aclose(self, loop: Optional[asyncio.AbstractEventLoop] = None):
+        fut, done, _ = _future_pair(loop)
+
+        def close_cb():
+            logger.debug("starway client closed")
+            done()
+
+        self._client.close(close_cb)
+        return fut
+
+    # ----------------------------------------------------------------- send
+    def send(self, buffer, tag: int, done_callback: Callable[[], None],
+             fail_callback: Callable[[str], None]) -> None:
+        if _is_device_payload(buffer):
+            from . import device
+
+            device.send_device(self._client, self._client.primary_conn, buffer,
+                               _tag(tag), done_callback, fail_callback)
+            return
+        owner, view = _send_view(buffer)
+        self._client.submit_send(self._client.primary_conn, view, _tag(tag),
+                                 done_callback, fail_callback, owner)
+
+    def asend(self, buffer, tag: int,
+              loop: Optional[asyncio.AbstractEventLoop] = None):
+        fut, done, fail = _future_pair(loop)
+        self.send(buffer, tag, done, fail)
+        return fut
+
+    # ----------------------------------------------------------------- recv
+    def recv(self, buffer, tag: int, tag_mask: int,
+             done_callback: Callable[[int, int], None],
+             fail_callback: Callable[[str], None]) -> None:
+        if _is_device_payload(buffer):
+            from . import device
+
+            device.post_device_recv(self._client, buffer, _tag(tag), _tag(tag_mask),
+                                    done_callback, fail_callback)
+            return
+        owner, view = _recv_view(buffer)
+        self._client.post_recv(view, _tag(tag), _tag(tag_mask),
+                               done_callback, fail_callback, owner)
+
+    def arecv(self, buffer, tag: int, tag_mask: int,
+              loop: Optional[asyncio.AbstractEventLoop] = None):
+        fut, done, fail = _future_pair(loop, result_factory=lambda st, ln: (st, ln))
+        self.recv(buffer, tag, tag_mask, done, fail)
+        return fut
+
+    # ---------------------------------------------------------------- flush
+    def flush(self, done_callback: Callable[[], None],
+              fail_callback: Callable[[str], None]) -> None:
+        self._client.submit_flush(done_callback, fail_callback)
+
+    def aflush(self, loop: Optional[asyncio.AbstractEventLoop] = None):
+        fut, done, fail = _future_pair(loop)
+        self.flush(done, fail)
+        return fut
+
+    # ------------------------------------------------------------ telemetry
+    def evaluate_perf(self, msg_size: int) -> float:
+        return self._client.evaluate_perf(self._client.primary_conn, msg_size)
+
+    def __del__(self):
+        try:
+            self._client.force_close()
+        except Exception:
+            pass

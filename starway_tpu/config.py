@@ -1,0 +1,71 @@
+"""Environment-driven configuration.
+
+The reference is configured purely through environment variables and CLI flags
+(reference: SURVEY.md section 5 "Config / flag system"; src/starway/__init__.py:14,
+benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
+
+``STARWAY_TLS``
+    Comma-separated transport preference list, analogous to ``UCX_TLS``.
+    Known transports: ``inproc`` (same-process fast path, what ICI device
+    transfers ride on), ``tcp`` (cross-process / DCN bootstrap path),
+    ``ici`` / ``dcn`` (device-plane selectors used by the device layer).
+    Default: all enabled.
+
+``STARWAY_HOST``
+    Routable host address advertised in worker-address blobs (default
+    ``127.0.0.1``).
+
+``STARWAY_RNDV_THRESHOLD``
+    Payload size in bytes above which sends switch from eager (local
+    completion = fully handed to the transport) to rendezvous-style streaming
+    (local completion = transmission begun; delivery requires ``aflush``).
+    Mirrors UCX eager/RNDV split (reference: src/bindings/main.cpp:954-980).
+
+``STARWAY_NATIVE``
+    "1" (default) = use the C++ engine extension when built, "0" = force the
+    pure-Python engine.
+
+``STARWAY_BACKEND``
+    Device-plane backend: ``auto`` (default), ``tpu``, or ``cpu``.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "transports_enabled",
+    "advertised_host",
+    "rndv_threshold",
+    "use_native",
+    "device_backend",
+]
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def transports_enabled() -> list[str]:
+    raw = _env("STARWAY_TLS", "inproc,tcp,ici,dcn")
+    return [t.strip() for t in raw.split(",") if t.strip()]
+
+
+def inproc_enabled() -> bool:
+    return "inproc" in transports_enabled()
+
+
+def advertised_host() -> str:
+    return _env("STARWAY_HOST", "127.0.0.1")
+
+
+def rndv_threshold() -> int:
+    return int(_env("STARWAY_RNDV_THRESHOLD", str(8 * 1024 * 1024)))
+
+
+def use_native() -> bool:
+    return _env("STARWAY_NATIVE", "1") == "1"
+
+
+def device_backend() -> str:
+    return _env("STARWAY_BACKEND", "auto")

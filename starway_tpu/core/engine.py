@@ -1,0 +1,625 @@
+"""Worker engines: the progress/completion runtime behind Client and Server.
+
+The reference dedicates one 100%-CPU busy-poll thread per Client/Server
+(``start_working``, reference: src/bindings/main.cpp:361-468, 1126-1268) and
+hands ops over capacity-1 spin channels (src/bindings/chan.hpp:84-119).  On a
+TPU host the CPU belongs to XLA dispatch, so this build replaces that design
+with one *event-driven* engine thread per worker: a ``selectors`` loop woken
+by a socketpair when the application submits an op -- zero CPU when idle, same
+ownership model (the engine thread is the only thread that touches sockets).
+
+Submission is an unbounded FIFO deque rather than a capacity-1 rendezvous
+slot; ordering guarantees are identical (ops of one worker execute in
+submission order) and the application never blocks on submission.
+
+Completion flows the same way as the reference: transport event -> engine
+thread -> user callback (which typically trampolines into asyncio via
+``loop.call_soon_threadsafe``; reference: src/starway/__init__.py:124-128).
+All user callbacks are invoked outside the worker lock.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import selectors
+import socket
+import threading
+import uuid
+import weakref
+from collections import deque
+from typing import Callable, Optional
+
+from .. import config
+from ..errors import REASON_CANCELLED, REASON_NOT_CONNECTED, StarwayStateError
+from . import fabric, frames, state
+from .conn import InprocConn, TcpConn
+from .endpoint import ServerEndpoint
+from .matching import TagMatcher
+
+logger = logging.getLogger("starway_tpu")
+
+CONNECT_TIMEOUT_S = 3.0
+
+
+def _run_fires(fires) -> None:
+    for f in fires:
+        if f is None:
+            continue
+        try:
+            f()
+        except Exception:
+            logger.exception("starway: user callback raised")
+
+
+class FlushRec:
+    """One outstanding flush barrier (worker- or endpoint-scoped).
+
+    Completes when every targeted connection has acknowledged the flush
+    sequence issued to it -- the analogue of ``ucp_worker_flush_nbx`` /
+    ``ucp_ep_flush_nbx`` completion (reference: src/bindings/main.cpp:432,1202).
+    """
+
+    __slots__ = ("done", "fail", "waits", "completed")
+
+    def __init__(self, done, fail):
+        self.done = done
+        self.fail = fail
+        self.waits: dict = {}  # conn -> seq
+        self.completed = False
+
+
+class Worker:
+    kind = "worker"
+
+    def __init__(self, name: str = ""):
+        self.lock = threading.RLock()
+        self.status = state.VOID
+        self.worker_id = uuid.uuid4().hex
+        self.name = name or self.worker_id[:8]
+        self.matcher = TagMatcher()
+        self.ops: deque = deque()
+        self.conns: dict = {}  # conn_id -> conn
+        self.flush_records: list[FlushRec] = []
+        self.close_cb: Optional[Callable[[], None]] = None
+        self.selector: Optional[selectors.BaseSelector] = None
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self.thread: Optional[threading.Thread] = None
+        self._listener: Optional[socket.socket] = None
+        self.mode = "socket"
+        self._address_blob: Optional[bytes] = None
+
+    # ------------------------------------------------------------ app side
+    def _require_running(self) -> None:
+        if self.status != state.RUNNING:
+            raise StarwayStateError(
+                f"starway {self.kind} is not in a running state "
+                f"(status={state.NAMES[self.status]})"
+            )
+
+    def post_recv(self, buf, tag: int, mask: int, done, fail, owner=None) -> None:
+        with self.lock:
+            self._require_running()
+            fires = self.matcher.post_recv(buf, tag, mask, done, fail, owner)
+        _run_fires(fires)
+
+    def submit_send(self, conn, view, tag: int, done, fail, owner=None) -> None:
+        with self.lock:
+            self._require_running()
+            self.ops.append(("send", conn, view, tag, done, fail, owner))
+        self._wake()
+
+    def submit_flush(self, done, fail, conns=None) -> None:
+        with self.lock:
+            self._require_running()
+            self.ops.append(("flush", done, fail, conns))
+        self._wake()
+
+    def close(self, cb) -> None:
+        with self.lock:
+            self._require_running()
+            self.status = state.CLOSING
+            self.close_cb = cb
+        self._wake()
+
+    def force_close(self) -> None:
+        """Destructor path: initiate close without a callback and without
+        joining (engine threads are daemons).  Must never hang or raise --
+        the reference pins this with del + gc.collect()
+        (tests/test_basic.py:666-686)."""
+        with self.lock:
+            if self.status not in (state.INIT, state.RUNNING):
+                return
+            self.status = state.CLOSING
+            self.close_cb = None
+        try:
+            self._wake()
+        except OSError:
+            pass
+
+    def get_worker_address(self) -> bytes:
+        if self._address_blob is None:
+            self._address_blob = json.dumps(
+                {
+                    "worker_id": self.worker_id,
+                    "host": config.advertised_host(),
+                    "port": 0,
+                    "fabric": "starway-tpu",
+                }
+            ).encode()
+        return self._address_blob
+
+    def evaluate_perf(self, conn, msg_size: int) -> float:
+        from .. import perf
+
+        with self.lock:
+            self._require_running()
+            transport = conn.kind if conn is not None else "tcp"
+        return perf.estimate(transport, msg_size)
+
+    # --------------------------------------------------------- engine side
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"\x00")
+        except (BlockingIOError, OSError):
+            pass  # wake buffer full => engine already has a pending wake
+
+    def _start_thread(self) -> None:
+        self.thread = threading.Thread(
+            target=self._run, name=f"starway-{self.kind}-{self.name}", daemon=True
+        )
+        self.thread.start()
+
+    def _run(self) -> None:
+        try:
+            self.selector = selectors.DefaultSelector()
+            self.selector.register(self._wake_r, selectors.EVENT_READ, self._on_wake)
+            if not self._setup():
+                self._teardown_sockets()
+                return
+            while True:
+                with self.lock:
+                    if self.status == state.CLOSING:
+                        break
+                try:
+                    events = self.selector.select()
+                except OSError:
+                    break
+                for key, mask in events:
+                    fires: list = []
+                    key.data(mask, fires)
+                    _run_fires(fires)
+                self._drain_ops()
+            self._do_close()
+        except Exception:
+            logger.exception("starway: engine thread crashed; emergency close")
+            try:
+                self._do_close()
+            except Exception:
+                pass
+
+    def _setup(self) -> bool:
+        raise NotImplementedError
+
+    def _on_wake(self, mask, fires) -> None:
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
+    def _drain_ops(self) -> None:
+        while True:
+            with self.lock:
+                if not self.ops or self.status != state.RUNNING:
+                    return
+                op = self.ops.popleft()
+            fires: list = []
+            self._process_op(op, fires)
+            _run_fires(fires)
+
+    def _process_op(self, op, fires) -> None:
+        if op[0] == "send":
+            _, conn, view, tag, done, fail, owner = op
+            if conn is None or not conn.alive:
+                if fail is not None:
+                    fires.append(lambda f=fail: f(REASON_NOT_CONNECTED))
+                return
+            conn.send_data(tag, view, done, fail, owner, fires)
+        elif op[0] == "flush":
+            _, done, fail, conns = op
+            self._start_flush(done, fail, conns, fires)
+
+    # -------------------------------------------------------------- flush
+    def _start_flush(self, done, fail, conns, fires) -> None:
+        with self.lock:
+            targets = [
+                c
+                for c in (conns if conns is not None else list(self.conns.values()))
+                if c.alive
+            ]
+        rec = FlushRec(done, fail)
+        for c in targets:
+            rec.waits[c] = c.alloc_flush_seq()
+        self.flush_records.append(rec)
+        for c in targets:
+            c.send_flush(rec.waits[c], fires)
+        self._try_complete_flush(rec, fires)
+
+    def _on_flush_ack(self, conn, seq: int, fires) -> None:
+        conn.flush_acked = max(conn.flush_acked, seq)
+        for rec in list(self.flush_records):
+            self._try_complete_flush(rec, fires)
+
+    def _try_complete_flush(self, rec: FlushRec, fires) -> None:
+        if rec.completed:
+            return
+        pending = [c for c, s in rec.waits.items() if c.flush_acked < s]
+        dead = [c for c in pending if not c.alive]
+        if dead:
+            rec.completed = True
+            if rec in self.flush_records:
+                self.flush_records.remove(rec)
+            if rec.fail is not None:
+                fires.append(
+                    lambda f=rec.fail: f(REASON_NOT_CONNECTED + " (peer reset during flush)")
+                )
+        elif not pending:
+            rec.completed = True
+            if rec in self.flush_records:
+                self.flush_records.remove(rec)
+            if rec.done is not None:
+                fires.append(rec.done)
+
+    # ----------------------------------------------------------- conn mgmt
+    def _register_conn_io(self, conn: TcpConn) -> None:
+        events = selectors.EVENT_READ | (selectors.EVENT_WRITE if conn._want_write else 0)
+        self.selector.register(
+            conn.sock, events, lambda mask, fires, c=conn: self._on_conn_io(c, mask, fires)
+        )
+        conn._registered = True
+
+    def _update_conn_interest(self, conn: TcpConn) -> None:
+        if not conn._registered or self.selector is None:
+            return
+        events = selectors.EVENT_READ | (selectors.EVENT_WRITE if conn._want_write else 0)
+        try:
+            self.selector.modify(
+                conn.sock, events, lambda mask, fires, c=conn: self._on_conn_io(c, mask, fires)
+            )
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def _unregister_conn_io(self, conn: TcpConn) -> None:
+        if getattr(conn, "_registered", False) and self.selector is not None:
+            try:
+                self.selector.unregister(conn.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            conn._registered = False
+
+    def _on_conn_io(self, conn: TcpConn, mask, fires) -> None:
+        if mask & selectors.EVENT_WRITE:
+            conn.kick_tx(fires)
+        if mask & selectors.EVENT_READ and conn.alive:
+            conn.on_readable(fires)
+
+    def _conn_broken(self, conn, fires) -> None:
+        """Peer died / stream reset.  Pending posted receives stay pending
+        (the reference's UCX workers never fail posted recvs on peer death;
+        pinned by tests/test_basic.py:250-277) -- only flush barriers
+        targeting the connection fail."""
+        conn.mark_dead(fires)
+        for rec in list(self.flush_records):
+            self._try_complete_flush(rec, fires)
+
+    # --------------------------------------------------------------- hooks
+    def _on_hello(self, conn, info, fires) -> None:  # pragma: no cover - server only
+        pass
+
+    def _on_hello_ack(self, conn, info, fires) -> None:  # pragma: no cover
+        pass
+
+    # --------------------------------------------------------------- close
+    def _do_close(self) -> None:
+        fires: list = []
+        with self.lock:
+            while self.ops:
+                op = self.ops.popleft()
+                fail = op[5] if op[0] == "send" else op[2]
+                if fail is not None:
+                    fires.append(lambda f=fail: f(REASON_CANCELLED))
+            fires.extend(self.matcher.cancel_all())
+            conns = list(self.conns.values())
+        for rec in self.flush_records:
+            if not rec.completed and rec.fail is not None:
+                fires.append(lambda f=rec.fail: f(REASON_CANCELLED))
+        self.flush_records.clear()
+        for c in conns:
+            c.close(fires)
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        fabric.unregister(self)
+        try:
+            if self.selector is not None:
+                self.selector.close()
+        except OSError:
+            pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+        with self.lock:
+            self.status = state.CLOSED
+            cb = self.close_cb
+            self.close_cb = None
+        _run_fires(fires)
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                logger.exception("starway: close callback raised")
+
+    def _teardown_sockets(self) -> None:
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+        try:
+            if self.selector is not None:
+                self.selector.close()
+        except OSError:
+            pass
+
+
+class ClientWorker(Worker):
+    """Engine behind ``starway_tpu.Client`` (reference: struct Client,
+    src/bindings/main.hpp:131-189; connect-once lifecycle main.cpp:552-585)."""
+
+    kind = "client"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.primary_conn = None
+        self._connect_cb = None
+        self._connect_target = None
+
+    def connect(self, addr: str, port: int, cb) -> None:
+        with self.lock:
+            if self.status != state.VOID:
+                raise StarwayStateError(
+                    "starway client supports a single connect "
+                    f"(status={state.NAMES[self.status]})"
+                )
+            self.status = state.INIT
+        self._connect_cb = cb
+        self._connect_target = ("socket", addr, port, None)
+        self._start_thread()
+
+    def connect_address(self, blob: bytes, cb) -> None:
+        info = json.loads(bytes(blob).decode())
+        with self.lock:
+            if self.status != state.VOID:
+                raise StarwayStateError(
+                    "starway client supports a single connect "
+                    f"(status={state.NAMES[self.status]})"
+                )
+            self.status = state.INIT
+        self._connect_cb = cb
+        self._connect_target = (
+            "address",
+            info.get("host", "127.0.0.1"),
+            int(info.get("port", 0)),
+            info.get("worker_id"),
+        )
+        self._start_thread()
+
+    def _fail_connect(self, cb, reason: str) -> None:
+        with self.lock:
+            self.status = state.CLOSED
+        self._teardown_sockets()
+        if cb is not None:
+            _run_fires([lambda: cb(reason)])
+
+    def _setup(self) -> bool:
+        mode, addr, port, wid = self._connect_target
+        cb = self._connect_cb
+        if config.inproc_enabled():
+            target = fabric.lookup_worker_id(wid) if wid else fabric.lookup_sockaddr(addr, port)
+            if target is not None and target is not self:
+                try:
+                    conn = target.attach_inproc(self, mode)
+                except Exception as e:
+                    self._fail_connect(cb, f"{REASON_NOT_CONNECTED}: {e}")
+                    return False
+                self.primary_conn = conn
+                with self.lock:
+                    self.conns[conn.conn_id] = conn
+                    if self.status == state.INIT:
+                        self.status = state.RUNNING
+                fabric.register_worker(self)
+                if cb is not None:
+                    _run_fires([lambda: cb("")])
+                return True
+        # Real TCP path (cross-process / DCN bootstrap).
+        try:
+            sock = socket.create_connection((addr, port), timeout=CONNECT_TIMEOUT_S)
+            sock.settimeout(CONNECT_TIMEOUT_S)
+            sock.sendall(frames.pack_hello(self.worker_id, mode, self.name))
+            hdr = _read_exact(sock, frames.HEADER_SIZE)
+            ftype, _, blen = frames.unpack_header(hdr)
+            if ftype != frames.T_HELLO_ACK:
+                raise ConnectionError("unexpected frame during handshake")
+            ack = frames.unpack_json_body(bytes(_read_exact(sock, blen)))
+        except Exception as e:
+            self._fail_connect(cb, f"{REASON_NOT_CONNECTED}: {e}")
+            return False
+        conn = TcpConn(self, sock, mode, handshaken=True)
+        conn.peer_name = ack.get("worker_id", "")
+        self.primary_conn = conn
+        with self.lock:
+            self.conns[conn.conn_id] = conn
+            if self.status == state.INIT:
+                self.status = state.RUNNING
+        self._register_conn_io(conn)
+        fabric.register_worker(self)
+        if cb is not None:
+            _run_fires([lambda: cb("")])
+        return True
+
+
+class ServerWorker(Worker):
+    """Engine behind ``starway_tpu.Server`` (reference: struct Server,
+    src/bindings/main.hpp:306-376; listen modes main.cpp:811-851,1063-1124)."""
+
+    kind = "server"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.accept_cb = None
+        self.eps: dict = {}  # conn_id -> ServerEndpoint
+
+    def set_accept_cb(self, cb) -> None:
+        self.accept_cb = cb
+
+    def listen(self, addr: str, port: int) -> None:
+        with self.lock:
+            if self.status != state.VOID:
+                raise StarwayStateError(
+                    f"starway server already listening or closed (status={state.NAMES[self.status]})"
+                )
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                listener.bind((addr, port))
+                listener.listen(512)
+            except OSError:
+                listener.close()
+                raise
+            listener.setblocking(False)
+            self._listener = listener
+            self.mode = "socket"
+            self.status = state.RUNNING
+            self._make_address_blob(addr, port)
+        fabric.register(self, addr, port)
+        self._start_thread()
+
+    def listen_address(self) -> bytes:
+        """Worker-address (listenerless in the reference) bootstrap mode.
+
+        The reference returns serialized UCX worker-address bytes and relies
+        on an out-of-band channel to move them (src/bindings/main.cpp:834-860).
+        Here the blob carries the worker id plus host:port contact info; an
+        in-process peer attaches directly through the fabric registry and a
+        cross-process peer bootstraps over TCP (the DCN analogue).
+        """
+        with self.lock:
+            if self.status != state.VOID:
+                raise StarwayStateError(
+                    f"starway server already listening or closed (status={state.NAMES[self.status]})"
+                )
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.bind(("0.0.0.0", 0))
+            listener.listen(512)
+            listener.setblocking(False)
+            self._listener = listener
+            self.mode = "address"
+            self.status = state.RUNNING
+            self._make_address_blob(config.advertised_host(), listener.getsockname()[1])
+        fabric.register_worker(self)
+        self._start_thread()
+        return self._address_blob
+
+    def _make_address_blob(self, host: str, port: int) -> None:
+        self._address_blob = json.dumps(
+            {
+                "worker_id": self.worker_id,
+                "host": host if host not in ("0.0.0.0", "") else config.advertised_host(),
+                "port": port,
+                "fabric": "starway-tpu",
+            }
+        ).encode()
+
+    def _setup(self) -> bool:
+        self.selector.register(self._listener, selectors.EVENT_READ, self._on_accept)
+        return True
+
+    def _on_accept(self, mask, fires) -> None:
+        while True:
+            try:
+                s, _ = self._listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            conn = TcpConn(self, s, "socket", handshaken=False)
+            self._register_conn_io(conn)
+            # The connection joins self.conns once its HELLO arrives.
+
+    def _on_hello(self, conn, info, fires) -> None:
+        conn.peer_name = info.get("worker_id", "")
+        mode = info.get("mode", "socket")
+        conn.mode = mode
+        if mode == "address":
+            # Mirrors the reference: in worker-address mode endpoint socket
+            # fields are empty (README.md:141-143).
+            conn.local_addr = conn.remote_addr = ""
+            conn.local_port = conn.remote_port = 0
+        conn.handshaken = True
+        ep = ServerEndpoint(conn)
+        with self.lock:
+            self.conns[conn.conn_id] = conn
+            self.eps[conn.conn_id] = ep
+        conn.send_ctl(frames.pack_hello_ack(self.worker_id), fires)
+        if self.accept_cb is not None:
+            fires.append(lambda ep=ep: self.accept_cb(ep))
+
+    def attach_inproc(self, client_worker, mode: str):
+        """Attach a same-process client (called from the client's engine
+        thread).  The analogue of the reference's reverse-endpoint creation in
+        the AM handshake path (src/bindings/main.cpp:912-938) -- except the
+        in-process conn pair is naturally full-duplex, so no reverse endpoint
+        is needed."""
+        server_side = InprocConn(self, weakref.ref(client_worker), mode)
+        client_side = InprocConn(client_worker, weakref.ref(self), mode)
+        server_side.peer_conn = client_side
+        client_side.peer_conn = server_side
+        server_side.peer_name = client_worker.worker_id
+        client_side.peer_name = self.worker_id
+        if mode == "socket" and self._listener is not None:
+            try:
+                la, lp = self._listener.getsockname()[:2]
+                server_side.local_addr, server_side.local_port = la, lp
+                server_side.remote_addr = "127.0.0.1"
+            except OSError:
+                pass
+        ep = ServerEndpoint(server_side)
+        with self.lock:
+            if self.status != state.RUNNING:
+                raise StarwayStateError("server is not in a running state")
+            self.conns[server_side.conn_id] = server_side
+            self.eps[server_side.conn_id] = ep
+        if self.accept_cb is not None:
+            _run_fires([lambda: self.accept_cb(ep)])
+        return client_side
+
+    def list_clients(self) -> set:
+        with self.lock:
+            return set(self.eps.values())
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytearray:
+    buf = bytearray(n)
+    got = 0
+    while got < n:
+        r = sock.recv_into(memoryview(buf)[got:])
+        if r == 0:
+            raise ConnectionError("peer closed during handshake")
+        got += r
+    return buf

@@ -1,0 +1,89 @@
+"""Wire protocol for the host (TCP / DCN-bootstrap) transport.
+
+The reference gets its wire protocol for free from UCX tag matching
+(``ucp_tag_send_nbx`` / ``ucp_tag_recv_nbx``, reference: src/bindings/main.cpp:370,404).
+The TPU build has no tag-matching NIC, so the host transport speaks a small
+framed protocol over a stream socket and the tag matcher lives in the worker
+runtime (see core/matching.py).
+
+Every frame starts with a fixed 17-byte little-endian header::
+
+    u8  type
+    u64 a
+    u64 b
+
+Frame types (fields a / b):
+
+======== ============================ =====================================
+type     a                            b
+======== ============================ =====================================
+HELLO    0                            length of JSON body that follows
+HELLO_ACK0                            length of JSON body that follows
+DATA     sender tag                   payload length (bytes that follow)
+FLUSH    flush sequence number        0
+FLUSH_ACK flush sequence number       0
+======== ============================ =====================================
+
+HELLO is sent by the connector and carries ``{"worker_id", "mode", "name"}``
+-- the analogue of the reference's worker-address Active-Message handshake
+(AM id 0x7A, reference: src/bindings/main.cpp:25,292-334).  ``mode`` is
+``"socket"`` or ``"address"``; in address mode the accepted endpoint reports
+empty socket fields, mirroring the reference (README.md:141-143).
+
+FLUSH / FLUSH_ACK implement the delivery barrier: because the byte stream is
+processed in order, a FLUSH_ACK for sequence *n* proves every DATA payload
+enqueued before flush *n* has been fully ingested by the peer's matching
+engine -- the semantics ``ucp_worker_flush_nbx`` provides in the reference
+(src/bindings/main.cpp:432,1202; behaviour pinned by tests/test_basic.py:190-415).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+HEADER = struct.Struct("<BQQ")
+HEADER_SIZE = HEADER.size  # 17
+
+T_HELLO = 1
+T_HELLO_ACK = 2
+T_DATA = 3
+T_FLUSH = 4
+T_FLUSH_ACK = 5
+
+
+def pack_header(ftype: int, a: int, b: int) -> bytes:
+    return HEADER.pack(ftype, a, b)
+
+
+def unpack_header(buf) -> tuple[int, int, int]:
+    return HEADER.unpack(buf)
+
+
+def pack_hello(worker_id: str, mode: str, name: str = "") -> bytes:
+    body = json.dumps(
+        {"worker_id": worker_id, "mode": mode, "name": name},
+        separators=(",", ":"),
+    ).encode()
+    return pack_header(T_HELLO, 0, len(body)) + body
+
+
+def pack_hello_ack(worker_id: str) -> bytes:
+    body = json.dumps({"worker_id": worker_id}, separators=(",", ":")).encode()
+    return pack_header(T_HELLO_ACK, 0, len(body)) + body
+
+
+def unpack_json_body(body: bytes) -> dict:
+    return json.loads(body.decode())
+
+
+def pack_data_header(tag: int, length: int) -> bytes:
+    return pack_header(T_DATA, tag, length)
+
+
+def pack_flush(seq: int) -> bytes:
+    return pack_header(T_FLUSH, seq, 0)
+
+
+def pack_flush_ack(seq: int) -> bytes:
+    return pack_header(T_FLUSH_ACK, seq, 0)

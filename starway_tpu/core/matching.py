@@ -1,0 +1,207 @@
+"""Host-side tag-matching engine.
+
+The reference delegates tag matching to UCX: receives are posted on the
+*worker* (any endpoint, fan-in) with a 64-bit tag + mask and matched against
+incoming messages by the transport (``ucp_tag_recv_nbx`` with wildcard masks,
+reference: src/bindings/main.cpp:404,1172; fan-in behaviour pinned by
+tests/test_basic.py:526-554).  TPU interconnects have no tag matching, so the
+matcher is a first-class component of the host runtime (SURVEY.md section 7,
+"Hard parts").
+
+Matching rule (UCX semantics): a posted receive ``(rtag, rmask)`` matches an
+incoming message with tag ``stag`` iff ``(stag & rmask) == (rtag & rmask)``.
+``rmask == 0`` is the wildcard used throughout the reference tests
+(tests/test_basic.py:547).  Both posted receives and unexpected messages are
+kept in FIFO order, matching UCX's ordering guarantees.
+
+Threading: the matcher is owned by a Worker and guarded by the worker's lock.
+All mutating methods return a list of zero-argument "fire" thunks (completed /
+failed user callbacks); the caller must invoke them *after* releasing the
+worker lock so user callbacks can re-enter the API without deadlocking.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Optional
+
+from ..errors import REASON_CANCELLED, REASON_TRUNCATED
+
+DoneCb = Callable[[int, int], None]  # (sender_tag, length)
+FailCb = Callable[[str], None]
+
+
+def tags_match(stag: int, rtag: int, rmask: int) -> bool:
+    return (stag & rmask) == (rtag & rmask)
+
+
+class PostedRecv:
+    """A receive posted by the application, waiting for a matching message."""
+
+    __slots__ = ("buf", "tag", "mask", "done", "fail", "claimed", "owner")
+
+    def __init__(self, buf: memoryview, tag: int, mask: int, done: DoneCb, fail: FailCb, owner=None):
+        self.buf = buf
+        self.tag = tag
+        self.mask = mask
+        self.done = done
+        self.fail = fail
+        self.claimed = False  # an in-flight inbound message is streaming to us
+        self.owner = owner  # keepalive for the python object owning buf
+
+
+class InboundMsg:
+    """An inbound message whose header has arrived.
+
+    ``sink`` is where payload bytes are streamed: directly into the posted
+    receive buffer when a match existed at header time (zero intermediate
+    copy), otherwise into a spill ``bytearray`` (the unexpected-message queue,
+    the analogue of UCX's unexpected queue).
+    """
+
+    __slots__ = ("tag", "length", "sink", "received", "posted", "complete", "discard", "spill")
+
+    def __init__(self, tag: int, length: int):
+        self.tag = tag
+        self.length = length
+        self.sink: Optional[memoryview] = None
+        self.received = 0
+        self.posted: Optional[PostedRecv] = None
+        self.complete = False
+        self.discard = False
+        self.spill: Optional[bytearray] = None
+
+
+class TagMatcher:
+    """Worker-level matcher: FIFO posted-receive list + FIFO unexpected queue."""
+
+    def __init__(self) -> None:
+        self.posted: deque[PostedRecv] = deque()
+        self.unexpected: deque[InboundMsg] = deque()
+        # Messages whose payload is still streaming in (for close-time cancel).
+        self.inflight: set[InboundMsg] = set()
+
+    # ------------------------------------------------------------------ post
+    def post_recv(self, buf: memoryview, tag: int, mask: int, done: DoneCb, fail: FailCb, owner=None) -> list:
+        """Post a receive.  Returns fire thunks (may complete immediately
+        against a fully-arrived unexpected message)."""
+        fires: list = []
+        for msg in self.unexpected:
+            if msg.posted is None and not msg.discard and tags_match(msg.tag, tag, mask):
+                if msg.length > len(buf):
+                    self.unexpected.remove(msg)
+                    fires.append(lambda fail=fail: fail(REASON_TRUNCATED))
+                    return fires
+                if msg.complete:
+                    self.unexpected.remove(msg)
+                    buf[: msg.length] = memoryview(msg.spill)[: msg.length] if msg.spill is not None else b""
+                    stag, length = msg.tag, msg.length
+                    fires.append(lambda done=done, stag=stag, length=length: done(stag, length))
+                    return fires
+                # In flight: claim it; payload keeps streaming into the spill
+                # buffer and is copied on completion.
+                pr = PostedRecv(buf, tag, mask, done, fail, owner)
+                pr.claimed = True
+                msg.posted = pr
+                return fires
+        self.posted.append(PostedRecv(buf, tag, mask, done, fail, owner))
+        return fires
+
+    # -------------------------------------------------------- inbound (tcp)
+    def on_message_start(self, tag: int, length: int) -> tuple[InboundMsg, list]:
+        """Header of an inbound message arrived.  Chooses the sink.
+
+        Returns the message record plus fire thunks (a truncation failure
+        fires immediately, like UCS_ERR_MESSAGE_TRUNCATED in the reference).
+        """
+        fires: list = []
+        msg = InboundMsg(tag, length)
+        self.inflight.add(msg)
+        for pr in self.posted:
+            if not pr.claimed and tags_match(tag, pr.tag, pr.mask):
+                if length > len(pr.buf):
+                    # UCS_ERR_MESSAGE_TRUNCATED analogue: fail the receive now;
+                    # the connection still consumes the payload (sink=None =>
+                    # conn streams the bytes into its scratch discard buffer).
+                    self.posted.remove(pr)
+                    fires.append(lambda pr=pr: pr.fail(REASON_TRUNCATED))
+                    msg.discard = True
+                    return msg, fires
+                pr.claimed = True
+                msg.posted = pr
+                self.posted.remove(pr)
+                msg.sink = pr.buf
+                return msg, fires
+        msg.spill = bytearray(length)
+        msg.sink = memoryview(msg.spill)
+        self.unexpected.append(msg)
+        return msg, fires
+
+    def on_message_complete(self, msg: InboundMsg) -> list:
+        """All payload bytes of ``msg`` have been ingested."""
+        fires: list = []
+        msg.complete = True
+        self.inflight.discard(msg)
+        if msg.discard:
+            return fires
+        pr = msg.posted
+        if pr is not None:
+            if msg.spill is not None:
+                # Claimed mid-flight while spilling: copy spill -> user buffer.
+                pr.buf[: msg.length] = memoryview(msg.spill)[: msg.length]
+                try:
+                    self.unexpected.remove(msg)
+                except ValueError:
+                    pass
+            fires.append(lambda pr=pr, m=msg: pr.done(m.tag, m.length))
+        # else: stays in the unexpected queue until a matching recv is posted.
+        return fires
+
+    # ------------------------------------------------------ inproc delivery
+    def deliver(self, tag: int, payload: memoryview) -> list:
+        """Deliver a complete message in one step (in-process fast path).
+
+        This is the path device-buffer transfers ride on: a single copy from
+        the sender's buffer into the posted receive buffer, no serialization.
+        """
+        fires: list = []
+        length = len(payload)
+        for pr in self.posted:
+            if not pr.claimed and tags_match(tag, pr.tag, pr.mask):
+                self.posted.remove(pr)
+                if length > len(pr.buf):
+                    fires.append(lambda pr=pr: pr.fail(REASON_TRUNCATED))
+                    return fires
+                pr.buf[:length] = payload
+                fires.append(lambda pr=pr, t=tag, n=length: pr.done(t, n))
+                return fires
+        msg = InboundMsg(tag, length)
+        msg.spill = bytearray(payload)
+        msg.complete = True
+        self.unexpected.append(msg)
+        return fires
+
+    # --------------------------------------------------------------- close
+    def cancel_all(self) -> list:
+        """Fail every pending posted receive with the cancel reason.
+
+        Mirrors the reference's close-time ``ucp_request_cancel`` sweep
+        (src/bindings/main.cpp:483-507); the reason string must contain
+        "cancel" (tests/test_basic.py:638-663).
+        """
+        fires: list = []
+        while self.posted:
+            pr = self.posted.popleft()
+            fires.append(lambda pr=pr: pr.fail(REASON_CANCELLED))
+        # In-flight claimed messages (streaming directly into a posted buffer
+        # or claimed while spilling): their PostedRecv is no longer in
+        # self.posted; fail them too.
+        for msg in list(self.inflight):
+            if msg.posted is not None and not msg.complete:
+                pr = msg.posted
+                msg.posted = None
+                msg.discard = True
+                fires.append(lambda pr=pr: pr.fail(REASON_CANCELLED))
+        self.inflight.clear()
+        self.unexpected.clear()
+        return fires

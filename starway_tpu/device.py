@@ -1,0 +1,51 @@
+"""Device data plane: jax.Array payloads over the fabric.
+
+Placeholder hooks for the device plane (SURVEY.md section 7, stage 3); the
+full implementation lands with the mesh/ICI layer.  The host byte path never
+imports jax, keeping cold-start light for pure host users.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def is_device_payload(buffer) -> bool:
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    if isinstance(buffer, DeviceBuffer):
+        return True
+    try:
+        return isinstance(buffer, jax.Array)
+    except Exception:
+        return False
+
+
+class DeviceBuffer:
+    """Mutable holder for a receive target living in device HBM.
+
+    jax.Arrays are immutable, so "receive into a preallocated device buffer"
+    means: the framework materialises the received payload as a jax.Array on
+    ``device`` and swaps it into ``.array`` (donating the previous one when
+    possible).  Created empty via shape/dtype or wrapping an existing array.
+    """
+
+    def __init__(self, shape, dtype, device=None, array=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.device = device
+        self.array = array
+
+    def __len__(self) -> int:
+        import numpy as np
+
+        return int(np.prod(self.shape)) * np.dtype(self.dtype).itemsize
+
+
+def send_device(worker, conn, buffer, tag, done, fail):
+    raise NotImplementedError("device plane lands in the mesh/ICI milestone")
+
+
+def post_device_recv(worker, buffer, tag, mask, done, fail):
+    raise NotImplementedError("device plane lands in the mesh/ICI milestone")

@@ -1,0 +1,38 @@
+"""Error types for starway-tpu.
+
+The reference surfaces failures as plain ``Exception(reason)`` built from UCS
+status strings (reference: src/starway/__init__.py:127-128) and raises
+``RuntimeError`` for lifecycle violations such as double close (reference:
+tests/test_basic.py:500-511).  We keep those observable contracts:
+
+* lifecycle violations raise :class:`StarwayStateError` (a ``RuntimeError``),
+* operation failures are delivered to ``fail_callback(reason: str)`` where
+  ``reason`` contains a stable keyword:
+
+  - ``"cancel"``     -- op cancelled by local close (tests/test_basic.py:638-663)
+  - ``"not connected"`` -- connect failure / op on dead endpoint
+    (tests/test_basic.py:514-518)
+  - ``"truncated"``  -- message larger than the posted receive buffer
+"""
+
+from __future__ import annotations
+
+
+class StarwayError(Exception):
+    """Base class for all starway-tpu errors."""
+
+
+class StarwayStateError(RuntimeError):
+    """Lifecycle violation: op issued while the worker is in the wrong state.
+
+    RuntimeError subclass so ``pytest.raises(RuntimeError)`` on double close
+    matches the reference behaviour (tests/test_basic.py:508-511).
+    """
+
+
+# Stable reason strings passed to fail callbacks.  Keyword contracts mirror the
+# reference's UCS status strings surfaced through Exception(reason).
+REASON_CANCELLED = "Operation cancelled (local endpoint closed before completion)"
+REASON_NOT_CONNECTED = "Endpoint is not connected"
+REASON_TRUNCATED = "Message truncated: payload larger than posted receive buffer"
+REASON_INTERNAL = "Internal transport error"

@@ -1,0 +1,55 @@
+"""Transfer-time estimation: the ``evaluate_perf`` analogue.
+
+The reference exposes UCX's transport model estimate
+(``ucp_ep_evaluate_perf``, reference: src/bindings/main.cpp:452-467,666-678)
+as seconds-to-transfer-msg_size.  The TPU build replaces it with an explicit
+alpha-beta link model per transport (SURVEY.md section 5 "Tracing /
+profiling": "keep an evaluate_perf analogue backed by an ICI/DCN link
+model")::
+
+    t(bytes) = alpha + bytes / beta
+
+Default betas reflect TPU v5e-class hardware (ICI ~45 GB/s per link
+direction, DCN ~12.5 GB/s per host NIC) and measured host-loopback numbers;
+calibrate with :func:`calibrate` from observed samples.
+"""
+
+from __future__ import annotations
+
+# transport -> (alpha seconds, beta bytes/second)
+LINK_MODELS: dict[str, tuple[float, float]] = {
+    "inproc": (2.0e-6, 30.0e9),  # same-process memcpy / HBM-to-HBM handoff
+    "tcp": (30.0e-6, 2.5e9),  # host loopback / DCN-adjacent bootstrap path
+    "ici": (1.0e-6, 45.0e9),  # v5e ICI per-link, one direction
+    "dcn": (50.0e-6, 12.5e9),  # cross-slice data-center network
+}
+
+
+def estimate(transport: str, msg_size: int) -> float:
+    """Estimated seconds to transfer ``msg_size`` bytes over ``transport``.
+
+    Always > 0, matching the reference contract (tests/test_basic.py:445-457).
+    """
+    alpha, beta = LINK_MODELS.get(transport, LINK_MODELS["tcp"])
+    return alpha + max(0, int(msg_size)) / beta
+
+
+def calibrate(transport: str, samples: list[tuple[int, float]]) -> tuple[float, float]:
+    """Least-squares fit of (alpha, beta) from (bytes, seconds) samples and
+    update the model in place.  Returns the fitted (alpha, beta)."""
+    if len(samples) < 2:
+        raise ValueError("need at least two (bytes, seconds) samples")
+    n = len(samples)
+    sx = sum(b for b, _ in samples)
+    sy = sum(t for _, t in samples)
+    sxx = sum(b * b for b, _ in samples)
+    sxy = sum(b * t for b, t in samples)
+    denom = n * sxx - sx * sx
+    if denom == 0:
+        raise ValueError("degenerate samples")
+    inv_beta = (n * sxy - sx * sy) / denom
+    alpha = (sy - inv_beta * sx) / n
+    alpha = max(alpha, 1e-9)
+    beta = 1.0 / max(inv_beta, 1e-15)
+    LINK_MODELS[transport] = (alpha, beta)
+    return alpha, beta

@@ -1,0 +1,33 @@
+"""Test configuration.
+
+Device-plane tests run on a virtual 8-device CPU mesh so the suite needs no
+TPU hardware (SURVEY.md section 4: "Add what the reference lacks: a CPU
+fake-mesh backend so tests run without TPUs").  The env vars must be set
+before jax is first imported anywhere in the process.
+"""
+
+import asyncio
+import inspect
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+# Minimal asyncio test support (pytest-asyncio is not available in the image):
+# coroutine test functions run under asyncio.run, mirroring the reference's
+# module-wide `pytestmark = pytest.mark.asyncio` setup.
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: run coroutine test in an asyncio event loop")
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {n: pyfuncitem.funcargs[n] for n in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(fn(**kwargs))
+        return True

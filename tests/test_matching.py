@@ -1,0 +1,128 @@
+"""Unit tests for the host tag-matching engine (starway_tpu/core/matching.py).
+
+The reference has no unit tier (UCX does its matching); this engine is ours,
+so it gets direct coverage: match rules, FIFO order, wildcard masks,
+unexpected-queue behaviour, truncation, claim-in-flight, cancellation.
+"""
+
+import numpy as np
+
+from starway_tpu.core.matching import TagMatcher, tags_match
+
+
+def run(fires):
+    for f in fires:
+        f()
+
+
+def test_tags_match_rules():
+    assert tags_match(0x1234, 0x0, 0x0)  # mask 0 = wildcard
+    assert tags_match(0x1234, 0x1234, (1 << 64) - 1)
+    assert not tags_match(0x1234, 0x1235, (1 << 64) - 1)
+    assert tags_match(0xAB12, 0xCD12, 0xFF)  # low-byte-only match
+
+
+def test_deliver_to_posted_recv():
+    m = TagMatcher()
+    buf = np.zeros(4, dtype=np.uint8)
+    got = []
+    run(m.post_recv(memoryview(buf), 7, (1 << 64) - 1, lambda t, n: got.append((t, n)), lambda e: got.append(e)))
+    run(m.deliver(7, memoryview(np.array([1, 2, 3, 4], dtype=np.uint8))))
+    assert got == [(7, 4)]
+    np.testing.assert_array_equal(buf, [1, 2, 3, 4])
+
+
+def test_unexpected_then_post():
+    m = TagMatcher()
+    run(m.deliver(9, memoryview(np.array([5, 6], dtype=np.uint8))))
+    buf = np.zeros(2, dtype=np.uint8)
+    got = []
+    run(m.post_recv(memoryview(buf), 0, 0, lambda t, n: got.append((t, n)), lambda e: got.append(e)))
+    assert got == [(9, 2)]
+    np.testing.assert_array_equal(buf, [5, 6])
+
+
+def test_fifo_order_of_unexpected():
+    m = TagMatcher()
+    run(m.deliver(1, memoryview(np.array([1], dtype=np.uint8))))
+    run(m.deliver(2, memoryview(np.array([2], dtype=np.uint8))))
+    buf = np.zeros(1, dtype=np.uint8)
+    got = []
+    run(m.post_recv(memoryview(buf), 0, 0, lambda t, n: got.append(t), lambda e: got.append(e)))
+    assert got == [1]
+    run(m.post_recv(memoryview(buf), 0, 0, lambda t, n: got.append(t), lambda e: got.append(e)))
+    assert got == [1, 2]
+
+
+def test_fifo_order_of_posted():
+    m = TagMatcher()
+    b1 = np.zeros(1, dtype=np.uint8)
+    b2 = np.zeros(1, dtype=np.uint8)
+    got = []
+    run(m.post_recv(memoryview(b1), 0, 0, lambda t, n: got.append("first"), lambda e: None))
+    run(m.post_recv(memoryview(b2), 0, 0, lambda t, n: got.append("second"), lambda e: None))
+    run(m.deliver(5, memoryview(np.array([9], dtype=np.uint8))))
+    assert got == ["first"]
+
+
+def test_mask_selects_specific_recv():
+    m = TagMatcher()
+    b1 = np.zeros(1, dtype=np.uint8)
+    b2 = np.zeros(1, dtype=np.uint8)
+    got = []
+    full = (1 << 64) - 1
+    run(m.post_recv(memoryview(b1), 100, full, lambda t, n: got.append(100), lambda e: None))
+    run(m.post_recv(memoryview(b2), 200, full, lambda t, n: got.append(200), lambda e: None))
+    run(m.deliver(200, memoryview(np.array([1], dtype=np.uint8))))
+    assert got == [200]
+
+
+def test_truncation_fails_recv():
+    m = TagMatcher()
+    buf = np.zeros(2, dtype=np.uint8)
+    got = []
+    run(m.post_recv(memoryview(buf), 0, 0, lambda t, n: got.append("done"), lambda e: got.append(e)))
+    run(m.deliver(1, memoryview(np.zeros(10, dtype=np.uint8))))
+    assert len(got) == 1 and "truncated" in got[0].lower()
+
+
+def test_streaming_message_start_complete():
+    m = TagMatcher()
+    buf = np.zeros(8, dtype=np.uint8)
+    got = []
+    run(m.post_recv(memoryview(buf), 3, (1 << 64) - 1, lambda t, n: got.append((t, n)), lambda e: got.append(e)))
+    msg, fires = m.on_message_start(3, 8)
+    run(fires)
+    assert msg.sink is not None and not got
+    msg.sink[:8] = bytes(range(8))
+    msg.received = 8
+    run(m.on_message_complete(msg))
+    assert got == [(3, 8)]
+    np.testing.assert_array_equal(buf, np.arange(8, dtype=np.uint8))
+
+
+def test_claim_inflight_spill():
+    m = TagMatcher()
+    msg, fires = m.on_message_start(4, 4)  # no posted recv: spills
+    run(fires)
+    buf = np.zeros(4, dtype=np.uint8)
+    got = []
+    run(m.post_recv(memoryview(buf), 4, (1 << 64) - 1, lambda t, n: got.append((t, n)), lambda e: got.append(e)))
+    assert not got  # claimed but still in flight
+    msg.sink[:4] = b"\x01\x02\x03\x04"
+    msg.received = 4
+    run(m.on_message_complete(msg))
+    assert got == [(4, 4)]
+    np.testing.assert_array_equal(buf, [1, 2, 3, 4])
+
+
+def test_cancel_all_fails_everything():
+    m = TagMatcher()
+    buf = np.zeros(1, dtype=np.uint8)
+    got = []
+    run(m.post_recv(memoryview(buf), 50, (1 << 64) - 1, lambda t, n: got.append("done"), lambda e: got.append(e)))
+    msg, fires = m.on_message_start(1, 100)  # in-flight spill, unclaimed
+    run(fires)
+    run(m.cancel_all())
+    assert len(got) == 1 and "cancel" in got[0].lower()
+    assert not m.posted and not m.unexpected and not m.inflight
