@@ -1,0 +1,143 @@
+"""Driver benchmark: one JSON line with the headline metric.
+
+Metric (BASELINE.json): pingpong bandwidth of a 1 MiB jax.Array moved through
+the framework's asend/arecv path, compared against the raw transfer the same
+hardware does without the framework.  ``vs_baseline`` is
+``framework_gbps / (0.9 * raw_gbps)``: >= 1.0 means the north-star target
+(">= 90% of raw link bandwidth on 1 MB pingpong") is met on this hardware.
+
+With >= 2 visible devices the pingpong crosses devices (ICI on TPU hardware);
+with one device it is a host<->device round trip (the only real data motion a
+single chip can do).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+MSG_BYTES = 1 << 20
+WARMUP = 10
+ITERS = 50
+MASK = (1 << 64) - 1
+PING, PONG = 0x51, 0x52
+
+
+async def _framework_pingpong(devices) -> list[float]:
+    import numpy as np
+
+    from starway_tpu import Client, DeviceBuffer, Server
+
+    import jax
+    import jax.numpy as jnp
+
+    server = Server()
+    server.listen("127.0.0.1", 0)
+    client = Client()
+    await client.aconnect_address(server.get_worker_address())
+    for _ in range(200):
+        if server.list_clients():
+            break
+        await asyncio.sleep(0.005)
+    ep = server.list_clients().pop()
+
+    two_dev = len(devices) >= 2
+    d_src = devices[0]
+    d_dst = devices[1] if two_dev else devices[0]
+
+    if two_dev:
+        payload = jax.device_put(jnp.zeros(MSG_BYTES, dtype=jnp.uint8), d_src)
+        payload.block_until_ready()
+        back = jax.device_put(jnp.zeros(MSG_BYTES, dtype=jnp.uint8), d_dst)
+        back.block_until_ready()
+    else:
+        payload = np.zeros(MSG_BYTES, dtype=np.uint8)
+
+    rtts: list[float] = []
+    for i in range(WARMUP + ITERS):
+        t0 = time.perf_counter()
+        if two_dev:
+            sink = DeviceBuffer((MSG_BYTES,), jnp.uint8, device=d_dst)
+            ret = DeviceBuffer((MSG_BYTES,), jnp.uint8, device=d_src)
+        else:
+            sink = DeviceBuffer((MSG_BYTES,), jnp.uint8, device=d_dst)
+            ret = np.empty(MSG_BYTES, dtype=np.uint8)
+        srv_fut = server.arecv(sink, PING, MASK)
+        cli_fut = client.arecv(ret, PONG, MASK)
+        await client.asend(payload, PING)
+        await srv_fut
+        await server.asend(ep, sink.array if two_dev else sink, PONG)
+        await cli_fut
+        if i >= WARMUP:
+            rtts.append(time.perf_counter() - t0)
+    await client.aclose()
+    await server.aclose()
+    return rtts
+
+
+def _raw_pingpong(devices) -> list[float]:
+    """The same data motion without the framework: the raw-link baseline."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    two_dev = len(devices) >= 2
+    if two_dev:
+        src = jax.device_put(jnp.zeros(MSG_BYTES, dtype=jnp.uint8), devices[0])
+        src.block_until_ready()
+    else:
+        host = np.zeros(MSG_BYTES, dtype=np.uint8)
+
+    rtts: list[float] = []
+    for i in range(WARMUP + ITERS):
+        t0 = time.perf_counter()
+        if two_dev:
+            there = jax.device_put(src, devices[1])
+            there.block_until_ready()
+            back = jax.device_put(there, devices[0])
+            back.block_until_ready()
+        else:
+            dev = jax.device_put(host, devices[0])
+            dev.block_until_ready()
+            np.asarray(dev)
+        if i >= WARMUP:
+            rtts.append(time.perf_counter() - t0)
+    return rtts
+
+
+def main() -> None:
+    import jax
+
+    devices = jax.devices()
+    fw = asyncio.run(_framework_pingpong(devices))
+    raw = _raw_pingpong(devices)
+
+    fw_p50 = statistics.median(fw)
+    raw_p50 = statistics.median(raw)
+    fw_gbps = 2 * MSG_BYTES / fw_p50 / 1e9
+    raw_gbps = 2 * MSG_BYTES / raw_p50 / 1e9
+    vs_baseline = fw_gbps / (0.9 * raw_gbps) if raw_gbps > 0 else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "1MiB jax.Array pingpong bandwidth via asend/arecv "
+                f"({'device-to-device' if len(devices) >= 2 else 'host-to-device'}, "
+                f"{len(devices)} dev, p50 of {ITERS} iters; "
+                f"raw={raw_gbps:.2f}GB/s p50_rtt={fw_p50 * 1e6:.0f}us)",
+                "value": round(fw_gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

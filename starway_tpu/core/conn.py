@@ -135,6 +135,12 @@ class BaseConn:
         self.remote_port = 0
         self.flush_seq = 0
         self.flush_acked = 0
+        # Delivery-barrier accounting: ``dirty`` = tagged data handed to this
+        # conn that no completed flush has covered yet.  A dead+dirty conn
+        # fails flush instead of passing it vacuously.
+        self.dirty = False
+        self._data_counter = 0
+        self._flush_marks: dict[int, int] = {}
 
     def alloc_flush_seq(self) -> int:
         self.flush_seq += 1
@@ -177,12 +183,20 @@ class TcpConn(BaseConn):
             if fail is not None:
                 fires.append(lambda: fail(REASON_NOT_CONNECTED + " (connection reset)"))
             return
+        self.dirty = True
+        self._data_counter += 1
         self.tx.append(TxData(tag, payload, done, fail, owner))
         self.kick_tx(fires)
 
     def send_flush(self, seq: int, fires: list) -> None:
+        self._flush_marks[seq] = self._data_counter
         self.tx.append(TxCtl(frames.pack_flush(seq)))
         self.kick_tx(fires)
+
+    def on_flush_acked(self, seq: int) -> None:
+        mark = self._flush_marks.pop(seq, None)
+        if mark is not None and mark == self._data_counter:
+            self.dirty = False
 
     def send_ctl(self, data: bytes, fires: list) -> None:
         self.tx.append(TxCtl(data))
@@ -333,6 +347,10 @@ class TcpConn(BaseConn):
             for item in self.tx:
                 item.cancel(fires)
             self.tx.clear()
+            if self._rx_msg is not None:
+                with self.worker.lock:
+                    self.worker.matcher.purge_inflight(self._rx_msg)
+                self._rx_msg = None
             try:
                 self.sock.close()
             except OSError:

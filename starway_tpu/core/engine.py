@@ -235,11 +235,17 @@ class Worker:
     # -------------------------------------------------------------- flush
     def _start_flush(self, done, fail, conns, fires) -> None:
         with self.lock:
-            targets = [
-                c
-                for c in (conns if conns is not None else list(self.conns.values()))
-                if c.alive
-            ]
+            candidates = conns if conns is not None else list(self.conns.values())
+        # A dead connection with unacknowledged tagged data means the barrier
+        # cannot truthfully complete: fail like a send on a dead endpoint
+        # would, instead of passing vacuously.
+        if any((not c.alive) and c.dirty for c in candidates):
+            if fail is not None:
+                fires.append(
+                    lambda f=fail: f(REASON_NOT_CONNECTED + " (peer reset before flush)")
+                )
+            return
+        targets = [c for c in candidates if c.alive]
         rec = FlushRec(done, fail)
         for c in targets:
             rec.waits[c] = c.alloc_flush_seq()
@@ -250,6 +256,8 @@ class Worker:
 
     def _on_flush_ack(self, conn, seq: int, fires) -> None:
         conn.flush_acked = max(conn.flush_acked, seq)
+        if hasattr(conn, "on_flush_acked"):
+            conn.on_flush_acked(seq)
         for rec in list(self.flush_records):
             self._try_complete_flush(rec, fires)
 
@@ -312,6 +320,7 @@ class Worker:
         pinned by tests/test_basic.py:250-277) -- only flush barriers
         targeting the connection fail."""
         conn.mark_dead(fires)
+        getattr(self, "_half_open", set()).discard(conn)
         for rec in list(self.flush_records):
             self._try_complete_flush(rec, fires)
 
@@ -339,6 +348,8 @@ class Worker:
         self.flush_records.clear()
         for c in conns:
             c.close(fires)
+        for c in list(getattr(self, "_half_open", ())):
+            c.mark_dead(fires)
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -485,6 +496,9 @@ class ServerWorker(Worker):
         super().__init__(name)
         self.accept_cb = None
         self.eps: dict = {}  # conn_id -> ServerEndpoint
+        # Accepted TCP conns whose HELLO has not arrived yet; they join
+        # self.conns at handshake and must still be torn down at close.
+        self._half_open: set = set()
 
     def set_accept_cb(self, cb) -> None:
         self.accept_cb = cb
@@ -507,8 +521,11 @@ class ServerWorker(Worker):
             self._listener = listener
             self.mode = "socket"
             self.status = state.RUNNING
-            self._make_address_blob(addr, port)
-        fabric.register(self, addr, port)
+            # Use the kernel-assigned port so listen(addr, 0) advertises a
+            # connectable address.
+            bound_port = listener.getsockname()[1]
+            self._make_address_blob(addr, bound_port)
+        fabric.register(self, addr, bound_port)
         self._start_thread()
 
     def listen_address(self) -> bytes:
@@ -559,6 +576,7 @@ class ServerWorker(Worker):
             except (BlockingIOError, OSError):
                 return
             conn = TcpConn(self, s, "socket", handshaken=False)
+            self._half_open.add(conn)
             self._register_conn_io(conn)
             # The connection joins self.conns once its HELLO arrives.
 
@@ -572,6 +590,7 @@ class ServerWorker(Worker):
             conn.local_addr = conn.remote_addr = ""
             conn.local_port = conn.remote_port = 0
         conn.handshaken = True
+        self._half_open.discard(conn)
         ep = ServerEndpoint(conn)
         with self.lock:
             self.conns[conn.conn_id] = conn

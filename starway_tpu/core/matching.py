@@ -14,6 +14,15 @@ incoming message with tag ``stag`` iff ``(stag & rmask) == (rtag & rmask)``.
 (tests/test_basic.py:547).  Both posted receives and unexpected messages are
 kept in FIFO order, matching UCX's ordering guarantees.
 
+Receive targets and payloads are duck-typed so device (jax.Array) transfers
+ride the same matcher with no jax dependency here:
+
+* host target: writable ``memoryview``; host payload: ``memoryview``;
+* device target: ``DeviceRecvSink`` (``nbytes`` / ``host_staging()`` /
+  ``finalize_from_host()`` / ``accept_device()``, see device.py);
+* device payload: ``DevicePayload`` (``nbytes`` / ``as_host_view()`` /
+  ``.array``).
+
 Threading: the matcher is owned by a Worker and guarded by the worker's lock.
 All mutating methods return a list of zero-argument "fire" thunks (completed /
 failed user callbacks); the caller must invoke them *after* releasing the
@@ -35,12 +44,25 @@ def tags_match(stag: int, rtag: int, rmask: int) -> bool:
     return (stag & rmask) == (rtag & rmask)
 
 
+def _size(target_or_payload) -> int:
+    if isinstance(target_or_payload, memoryview):
+        return len(target_or_payload)
+    return int(target_or_payload.nbytes)
+
+
+def _is_host(x) -> bool:
+    return isinstance(x, memoryview)
+
+
 class PostedRecv:
-    """A receive posted by the application, waiting for a matching message."""
+    """A receive posted by the application, waiting for a matching message.
+
+    ``buf`` is a writable host memoryview or a DeviceRecvSink.
+    """
 
     __slots__ = ("buf", "tag", "mask", "done", "fail", "claimed", "owner")
 
-    def __init__(self, buf: memoryview, tag: int, mask: int, done: DoneCb, fail: FailCb, owner=None):
+    def __init__(self, buf, tag: int, mask: int, done: DoneCb, fail: FailCb, owner=None):
         self.buf = buf
         self.tag = tag
         self.mask = mask
@@ -49,17 +71,24 @@ class PostedRecv:
         self.claimed = False  # an in-flight inbound message is streaming to us
         self.owner = owner  # keepalive for the python object owning buf
 
+    @property
+    def size(self) -> int:
+        return _size(self.buf)
+
 
 class InboundMsg:
     """An inbound message whose header has arrived.
 
-    ``sink`` is where payload bytes are streamed: directly into the posted
-    receive buffer when a match existed at header time (zero intermediate
-    copy), otherwise into a spill ``bytearray`` (the unexpected-message queue,
-    the analogue of UCX's unexpected queue).
+    ``sink`` is the memoryview payload bytes are streamed into: the posted
+    receive buffer (or its device staging buffer) when a match existed at
+    header time -- zero intermediate copy for host receives -- otherwise a
+    spill ``bytearray`` (the analogue of UCX's unexpected queue).  Complete
+    in-process device messages skip sinks entirely: the array reference is
+    held in ``device_payload``.
     """
 
-    __slots__ = ("tag", "length", "sink", "received", "posted", "complete", "discard", "spill")
+    __slots__ = ("tag", "length", "sink", "received", "posted", "complete",
+                 "discard", "spill", "device_payload")
 
     def __init__(self, tag: int, length: int):
         self.tag = tag
@@ -70,6 +99,23 @@ class InboundMsg:
         self.complete = False
         self.discard = False
         self.spill: Optional[bytearray] = None
+        self.device_payload = None
+
+
+def _copy_complete(pr: PostedRecv, payload, length: int) -> None:
+    """Move a fully-arrived payload into a posted receive target."""
+    if _is_host(pr.buf):
+        if _is_host(payload):
+            pr.buf[:length] = payload
+        else:  # device payload -> host buffer
+            pr.buf[:length] = payload.as_host_view()
+    else:
+        if _is_host(payload):
+            staging = pr.buf.host_staging()
+            staging[:length] = payload
+            pr.buf.finalize_from_host(length)
+        else:  # device -> device: direct HBM handoff / ICI copy
+            pr.buf.accept_device(payload.array)
 
 
 class TagMatcher:
@@ -82,25 +128,29 @@ class TagMatcher:
         self.inflight: set[InboundMsg] = set()
 
     # ------------------------------------------------------------------ post
-    def post_recv(self, buf: memoryview, tag: int, mask: int, done: DoneCb, fail: FailCb, owner=None) -> list:
+    def post_recv(self, buf, tag: int, mask: int, done: DoneCb, fail: FailCb, owner=None) -> list:
         """Post a receive.  Returns fire thunks (may complete immediately
         against a fully-arrived unexpected message)."""
         fires: list = []
+        size = _size(buf)
         for msg in self.unexpected:
             if msg.posted is None and not msg.discard and tags_match(msg.tag, tag, mask):
-                if msg.length > len(buf):
+                if msg.length > size:
                     self.unexpected.remove(msg)
                     fires.append(lambda fail=fail: fail(REASON_TRUNCATED))
                     return fires
+                pr = PostedRecv(buf, tag, mask, done, fail, owner)
                 if msg.complete:
                     self.unexpected.remove(msg)
-                    buf[: msg.length] = memoryview(msg.spill)[: msg.length] if msg.spill is not None else b""
+                    if msg.device_payload is not None:
+                        _copy_complete(pr, msg.device_payload, msg.length)
+                    else:
+                        _copy_complete(pr, memoryview(msg.spill)[: msg.length] if msg.spill is not None else memoryview(b""), msg.length)
                     stag, length = msg.tag, msg.length
                     fires.append(lambda done=done, stag=stag, length=length: done(stag, length))
                     return fires
                 # In flight: claim it; payload keeps streaming into the spill
                 # buffer and is copied on completion.
-                pr = PostedRecv(buf, tag, mask, done, fail, owner)
                 pr.claimed = True
                 msg.posted = pr
                 return fires
@@ -109,7 +159,7 @@ class TagMatcher:
 
     # -------------------------------------------------------- inbound (tcp)
     def on_message_start(self, tag: int, length: int) -> tuple[InboundMsg, list]:
-        """Header of an inbound message arrived.  Chooses the sink.
+        """Header of an inbound streamed message arrived.  Chooses the sink.
 
         Returns the message record plus fire thunks (a truncation failure
         fires immediately, like UCS_ERR_MESSAGE_TRUNCATED in the reference).
@@ -119,7 +169,7 @@ class TagMatcher:
         self.inflight.add(msg)
         for pr in self.posted:
             if not pr.claimed and tags_match(tag, pr.tag, pr.mask):
-                if length > len(pr.buf):
+                if length > pr.size:
                     # UCS_ERR_MESSAGE_TRUNCATED analogue: fail the receive now;
                     # the connection still consumes the payload (sink=None =>
                     # conn streams the bytes into its scratch discard buffer).
@@ -130,7 +180,7 @@ class TagMatcher:
                 pr.claimed = True
                 msg.posted = pr
                 self.posted.remove(pr)
-                msg.sink = pr.buf
+                msg.sink = pr.buf if _is_host(pr.buf) else pr.buf.host_staging()
                 return msg, fires
         msg.spill = bytearray(length)
         msg.sink = memoryview(msg.spill)
@@ -147,39 +197,69 @@ class TagMatcher:
         pr = msg.posted
         if pr is not None:
             if msg.spill is not None:
-                # Claimed mid-flight while spilling: copy spill -> user buffer.
-                pr.buf[: msg.length] = memoryview(msg.spill)[: msg.length]
+                # Claimed mid-flight while spilling: move spill -> target.
+                _copy_complete(pr, memoryview(msg.spill)[: msg.length], msg.length)
                 try:
                     self.unexpected.remove(msg)
                 except ValueError:
                     pass
+            elif not _is_host(pr.buf):
+                # Streamed straight into the device sink's staging buffer.
+                pr.buf.finalize_from_host(msg.length)
             fires.append(lambda pr=pr, m=msg: pr.done(m.tag, m.length))
         # else: stays in the unexpected queue until a matching recv is posted.
         return fires
 
     # ------------------------------------------------------ inproc delivery
-    def deliver(self, tag: int, payload: memoryview) -> list:
+    def deliver(self, tag: int, payload) -> list:
         """Deliver a complete message in one step (in-process fast path).
 
-        This is the path device-buffer transfers ride on: a single copy from
-        the sender's buffer into the posted receive buffer, no serialization.
+        ``payload`` is a host memoryview (single copy into the posted buffer)
+        or a DevicePayload (direct array handoff -- the path ICI device
+        transfers ride, no host serialization).
         """
         fires: list = []
-        length = len(payload)
+        length = _size(payload)
         for pr in self.posted:
             if not pr.claimed and tags_match(tag, pr.tag, pr.mask):
                 self.posted.remove(pr)
-                if length > len(pr.buf):
+                if length > pr.size:
                     fires.append(lambda pr=pr: pr.fail(REASON_TRUNCATED))
                     return fires
-                pr.buf[:length] = payload
+                _copy_complete(pr, payload, length)
                 fires.append(lambda pr=pr, t=tag, n=length: pr.done(t, n))
                 return fires
         msg = InboundMsg(tag, length)
-        msg.spill = bytearray(payload)
+        if _is_host(payload):
+            msg.spill = bytearray(payload)
+        else:
+            # Keep the array reference; no host copy unless a host receive
+            # eventually claims it.
+            msg.device_payload = payload
         msg.complete = True
         self.unexpected.append(msg)
         return fires
+
+    # -------------------------------------------------------- conn death
+    def purge_inflight(self, msg: InboundMsg) -> None:
+        """The connection streaming ``msg`` died mid-payload.
+
+        An unclaimed partial must not sit in the unexpected queue where a
+        future post_recv would claim it and hang, and must not shadow a
+        complete message with the same tag from a live peer.  A partial
+        already claimed by a posted receive stays claimed: that receive
+        never completes, matching the reference's peer-death semantics
+        (tests/test_basic.py:250-277).
+        """
+        if msg.complete:
+            return
+        msg.discard = True
+        self.inflight.discard(msg)
+        if msg.posted is None:
+            try:
+                self.unexpected.remove(msg)
+            except ValueError:
+                pass
 
     # --------------------------------------------------------------- close
     def cancel_all(self) -> list:

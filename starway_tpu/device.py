@@ -1,21 +1,57 @@
 """Device data plane: jax.Array payloads over the fabric.
 
-Placeholder hooks for the device plane (SURVEY.md section 7, stage 3); the
-full implementation lands with the mesh/ICI layer.  The host byte path never
-imports jax, keeping cold-start light for pure host users.
+This is the TPU-native replacement for the reference's zero-copy RDMA into
+preallocated NumPy buffers (reference: src/bindings/main.hpp:155-161 captures
+raw host pointers; BASELINE.json north star: "asend/arecv/aflush async
+primitives operate on jax.Array device buffers in HBM").
+
+Three transfer paths, chosen per connection:
+
+* **in-process, device payload -> device sink**: the sender hands the
+  ``jax.Array`` itself to the receiver's matcher; the receiver materialises
+  it on its target device with ``jax.device_put`` -- on TPU hardware with
+  both devices in the same process this is an HBM-to-HBM copy over ICI with
+  zero host staging.  (Same-device delivery is a reference handoff.)
+* **in-process, mixed host/device**: one host copy at the boundary
+  (``np.asarray`` of the payload, or ``device_put`` of the staged bytes).
+* **cross-process (TCP / DCN bootstrap path)**: payload bytes are staged to
+  host, streamed, and re-materialised on the receiver's device.  Real
+  cross-host device DMA (jax.transfer-style) can slot in behind the same
+  sink protocol when available.
+
+The tag matcher stays byte-oriented; device awareness enters through two
+small duck-typed protocols (no jax import in the core):
+
+* :class:`DevicePayload` -- wraps an array for sending (``nbytes``,
+  ``as_host_view()``, ``.array``).
+* :class:`DeviceRecvSink` -- wraps a :class:`DeviceBuffer` for receiving
+  (``nbytes``, ``host_staging()``, ``finalize_from_host()``,
+  ``accept_device()``).
 """
 
 from __future__ import annotations
 
 import sys
+from typing import Optional
+
+
+def _np_dtype(dtype):
+    """Normalise numpy / jax.numpy scalar types / strings to np.dtype
+    (ml_dtypes like bfloat16 included)."""
+    import numpy as np
+
+    d = getattr(dtype, "dtype", None)
+    if isinstance(d, np.dtype):
+        return d
+    return np.dtype(dtype)
 
 
 def is_device_payload(buffer) -> bool:
+    if isinstance(buffer, DeviceBuffer):
+        return True
     jax = sys.modules.get("jax")
     if jax is None:
         return False
-    if isinstance(buffer, DeviceBuffer):
-        return True
     try:
         return isinstance(buffer, jax.Array)
     except Exception:
@@ -23,29 +59,137 @@ def is_device_payload(buffer) -> bool:
 
 
 class DeviceBuffer:
-    """Mutable holder for a receive target living in device HBM.
+    """Mutable holder for a receive target living in device memory.
 
     jax.Arrays are immutable, so "receive into a preallocated device buffer"
     means: the framework materialises the received payload as a jax.Array on
-    ``device`` and swaps it into ``.array`` (donating the previous one when
-    possible).  Created empty via shape/dtype or wrapping an existing array.
+    ``device`` and swaps it into ``.array``.  The previous array (if any) is
+    dropped, letting XLA reuse its HBM.
+
+    >>> sink = DeviceBuffer((1024,), jnp.bfloat16, device=jax.devices()[1])
+    >>> tag, length = await server.arecv(sink, tag=7, tag_mask=MASK)
+    >>> sink.array  # received payload, resident on devices()[1]
     """
 
     def __init__(self, shape, dtype, device=None, array=None):
-        self.shape = tuple(shape)
-        self.dtype = dtype
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = _np_dtype(dtype)
         self.device = device
         self.array = array
 
-    def __len__(self) -> int:
-        import numpy as np
+    @classmethod
+    def like(cls, array, device=None) -> "DeviceBuffer":
+        """A sink shaped like ``array``, targeting ``device`` (default: the
+        device ``array`` lives on)."""
+        dev = device
+        if dev is None:
+            devs = getattr(array, "devices", None)
+            if callable(devs):
+                ds = devs()
+                dev = next(iter(ds)) if ds else None
+        return cls(array.shape, array.dtype, device=dev)
 
-        return int(np.prod(self.shape)) * np.dtype(self.dtype).itemsize
+    @property
+    def nbytes(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n * self.dtype.itemsize
+
+
+class DevicePayload:
+    """Send-side wrapper: a jax.Array plus a lazily-created host view."""
+
+    __slots__ = ("array", "nbytes", "_host_view")
+
+    def __init__(self, array):
+        self.array = array
+        self.nbytes = int(array.nbytes)
+        self._host_view: Optional[memoryview] = None
+
+    def as_host_view(self) -> memoryview:
+        if self._host_view is None:
+            import numpy as np
+
+            host = np.ascontiguousarray(np.asarray(self.array))
+            self._host_view = memoryview(host).cast("B")
+        return self._host_view
+
+
+class DeviceRecvSink:
+    """Receive-side adapter bridging the byte matcher to a DeviceBuffer."""
+
+    __slots__ = ("devbuf", "_staging", "_staging_view")
+
+    def __init__(self, devbuf: DeviceBuffer):
+        self.devbuf = devbuf
+        self._staging = None
+        self._staging_view: Optional[memoryview] = None
+
+    @property
+    def nbytes(self) -> int:
+        return self.devbuf.nbytes
+
+    def host_staging(self) -> memoryview:
+        """Host bounce buffer for streamed (TCP) payloads."""
+        if self._staging_view is None:
+            import numpy as np
+
+            self._staging = np.empty(self.nbytes, dtype=np.uint8)
+            self._staging_view = memoryview(self._staging).cast("B")
+        return self._staging_view
+
+    def finalize_from_host(self, length: int) -> None:
+        """Staged bytes fully arrived: view as dtype/shape, place on device."""
+        import jax
+
+        raw = self._staging[:length]
+        arr = raw.view(self.devbuf.dtype)
+        if length == self.nbytes:
+            arr = arr.reshape(self.devbuf.shape)
+        self.devbuf.array = (
+            jax.device_put(arr, self.devbuf.device) if self.devbuf.device is not None else jax.device_put(arr)
+        )
+        self._staging = None
+        self._staging_view = None
+
+    def accept_device(self, array) -> None:
+        """Direct device handoff (in-process path): HBM -> HBM over ICI when
+        source and target devices differ, reference handoff when they match."""
+        import jax
+
+        target = self.devbuf.device
+        if target is not None:
+            src_devs = array.devices() if hasattr(array, "devices") else set()
+            if src_devs == {target}:
+                self.devbuf.array = array
+                return
+            self.devbuf.array = jax.device_put(array, target)
+            # Make completion mean "data resident on target", matching the
+            # reference's recv-complete semantics.
+            self.devbuf.array.block_until_ready()
+        else:
+            self.devbuf.array = array
 
 
 def send_device(worker, conn, buffer, tag, done, fail):
-    raise NotImplementedError("device plane lands in the mesh/ICI milestone")
+    """Route a device payload: direct array handoff in-process, host staging
+    over TCP."""
+    if isinstance(buffer, DeviceBuffer):
+        if buffer.array is None:
+            raise ValueError("DeviceBuffer has no array to send")
+        payload = DevicePayload(buffer.array)
+    else:
+        payload = DevicePayload(buffer)
+    if conn is not None and conn.kind == "inproc":
+        worker.submit_send(conn, payload, tag, done, fail, payload)
+    else:
+        view = payload.as_host_view()
+        worker.submit_send(conn, view, tag, done, fail, payload)
 
 
 def post_device_recv(worker, buffer, tag, mask, done, fail):
-    raise NotImplementedError("device plane lands in the mesh/ICI milestone")
+    if not isinstance(buffer, DeviceBuffer):
+        raise TypeError("device receives require a DeviceBuffer sink")
+    sink = DeviceRecvSink(buffer)
+    worker.post_recv(sink, tag, mask, done, fail, owner=sink)

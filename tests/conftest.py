@@ -10,10 +10,18 @@ import asyncio
 import inspect
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the sandbox pre-imports jax (sitecustomize) with the
+# real-TPU tunnel backend selected; tests always run on the virtual CPU mesh
+# unless explicitly told to use hardware.  jax is already in sys.modules, so
+# the env var alone is too late -- use config.update before first backend use.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+if os.environ.get("STARWAY_TEST_REAL_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 # Minimal asyncio test support (pytest-asyncio is not available in the image):

@@ -1,0 +1,161 @@
+"""Device-plane tests on the virtual 8-device CPU mesh (no TPU needed).
+
+Covers the BASELINE.json north star shape: asend/arecv operating on
+jax.Array device buffers, including cross-device delivery (the ICI path on
+real hardware) and host-staged delivery over real sockets.
+"""
+
+import asyncio
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from starway_tpu import Client, DeviceBuffer, Server
+
+pytestmark = pytest.mark.asyncio
+
+SERVER_ADDR = "127.0.0.1"
+MASK = (1 << 64) - 1
+
+
+@pytest.fixture
+def port():
+    return random.randint(10000, 50000)
+
+
+@pytest.fixture(params=["inproc", "tcp"])
+def transport(request, monkeypatch):
+    if request.param == "tcp":
+        monkeypatch.setenv("STARWAY_TLS", "tcp")
+    return request.param
+
+
+async def _pair(port):
+    server = Server()
+    client = Client()
+    server.listen(SERVER_ADDR, port)
+    await client.aconnect(SERVER_ADDR, port)
+    return server, client
+
+
+async def test_device_to_device_transfer(port, transport):
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest should provide 8 virtual devices"
+    server, client = await _pair(port)
+    try:
+        src = jax.device_put(jnp.arange(2048, dtype=jnp.float32), devices[0])
+        sink = DeviceBuffer((2048,), jnp.float32, device=devices[3])
+
+        recv_fut = server.arecv(sink, 7, MASK)
+        await asyncio.sleep(0.01)
+        await client.asend(src, 7)
+        tag, length = await recv_fut
+
+        assert tag == 7
+        assert length == src.nbytes
+        assert sink.array is not None
+        assert sink.array.devices() == {devices[3]}
+        np.testing.assert_array_equal(np.asarray(sink.array), np.asarray(src))
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_device_to_host_transfer(port, transport):
+    server, client = await _pair(port)
+    try:
+        src = jnp.arange(512, dtype=jnp.uint8)
+        host_sink = np.zeros(512, dtype=np.uint8)
+
+        recv_fut = server.arecv(host_sink, 9, MASK)
+        await asyncio.sleep(0.01)
+        await client.asend(src, 9)
+        tag, length = await recv_fut
+
+        assert (tag, length) == (9, 512)
+        np.testing.assert_array_equal(host_sink, np.asarray(src))
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_host_to_device_transfer(port, transport):
+    server, client = await _pair(port)
+    try:
+        src = np.random.randint(0, 255, 1024, dtype=np.uint8)
+        sink = DeviceBuffer((256,), jnp.float32, device=jax.devices()[5])
+        assert sink.nbytes == 1024
+
+        recv_fut = server.arecv(sink, 11, MASK)
+        await asyncio.sleep(0.01)
+        await client.asend(src, 11)
+        tag, length = await recv_fut
+
+        assert (tag, length) == (11, 1024)
+        assert sink.array.devices() == {jax.devices()[5]}
+        np.testing.assert_array_equal(
+            np.asarray(sink.array), src.view(np.float32).reshape(256)
+        )
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_device_unexpected_then_post(port):
+    """Device message arriving before the recv is posted parks in the
+    unexpected queue holding the array reference (no host copy)."""
+    server, client = await _pair(port)
+    try:
+        src = jax.device_put(jnp.full((64,), 3.5, dtype=jnp.bfloat16), jax.devices()[2])
+        await client.asend(src, 21)
+        await asyncio.sleep(0.05)
+
+        sink = DeviceBuffer((64,), jnp.bfloat16, device=jax.devices()[6])
+        tag, length = await server.arecv(sink, 21, MASK)
+        assert (tag, length) == (21, src.nbytes)
+        assert sink.array.devices() == {jax.devices()[6]}
+        np.testing.assert_array_equal(np.asarray(sink.array), np.asarray(src))
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_server_to_client_device_send(port):
+    server, client = await _pair(port)
+    try:
+        ep = server.list_clients().pop()
+        src = jnp.linspace(0, 1, 128, dtype=jnp.float32)
+        sink = DeviceBuffer.like(src, device=jax.devices()[4])
+
+        recv_fut = client.arecv(sink, 13, MASK)
+        await asyncio.sleep(0.01)
+        await server.asend(ep, src, 13)
+        tag, length = await recv_fut
+        assert (tag, length) == (13, src.nbytes)
+        np.testing.assert_allclose(np.asarray(sink.array), np.asarray(src))
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_devicebuffer_send_side(port):
+    """A DeviceBuffer holding an array can itself be the send payload."""
+    server, client = await _pair(port)
+    try:
+        holder = DeviceBuffer((32,), jnp.int32, array=jnp.arange(32, dtype=jnp.int32))
+        host_sink = np.zeros(32 * 4, dtype=np.uint8)
+        recv_fut = server.arecv(host_sink, 15, MASK)
+        await asyncio.sleep(0.01)
+        await client.asend(holder, 15)
+        tag, length = await recv_fut
+        assert (tag, length) == (15, 128)
+        np.testing.assert_array_equal(
+            host_sink.view(np.int32), np.arange(32, dtype=np.int32)
+        )
+    finally:
+        await client.aclose()
+        await server.aclose()

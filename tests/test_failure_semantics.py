@@ -1,0 +1,142 @@
+"""Failure-path semantics: peer death, flush truthfulness, ephemeral ports.
+
+Regression coverage for review findings on the host runtime:
+1. a partial message from a dead connection must not claim later receives;
+2. flush targeting a dead connection with unacknowledged data must fail,
+   not pass vacuously;
+3. listen(addr, 0) must advertise the kernel-assigned port;
+4. close with half-open (pre-handshake) connections must not leak or hang.
+"""
+
+import asyncio
+import random
+import socket
+
+import numpy as np
+import pytest
+
+from starway_tpu import Client, Server
+from starway_tpu.core.matching import TagMatcher
+
+pytestmark = pytest.mark.asyncio
+
+SERVER_ADDR = "127.0.0.1"
+
+
+@pytest.fixture
+def port():
+    return random.randint(10000, 50000)
+
+
+def test_purge_inflight_partial_message():
+    m = TagMatcher()
+    # Header arrived, no posted recv: spills as unexpected, incomplete.
+    msg, fires = m.on_message_start(7, 100)
+    assert not fires and msg in m.inflight
+    # Connection dies mid-payload.
+    m.purge_inflight(msg)
+    assert msg not in m.inflight and msg not in m.unexpected
+    # A later recv with a matching tag must NOT claim the dead partial...
+    got = []
+    buf = np.zeros(100, dtype=np.uint8)
+    fires = m.post_recv(memoryview(buf), 7, (1 << 64) - 1, lambda t, n: got.append((t, n)), got.append)
+    for f in fires:
+        f()
+    assert not got  # still pending (nothing delivered), not hung on the corpse
+    # ...and a complete message from a live peer must reach it.
+    fires = m.deliver(7, memoryview(np.arange(100, dtype=np.uint8)))
+    for f in fires:
+        f()
+    assert got == [(7, 100)]
+
+
+def test_purge_inflight_claimed_stays_pending():
+    m = TagMatcher()
+    buf = np.zeros(64, dtype=np.uint8)
+    got = []
+    fires = m.post_recv(memoryview(buf), 5, (1 << 64) - 1, lambda t, n: got.append("done"), got.append)
+    msg, f2 = m.on_message_start(5, 64)  # streams straight into buf
+    m.purge_inflight(msg)
+    # Claimed receive stays pending forever (reference peer-death semantics).
+    assert not got
+
+
+async def test_flush_after_peer_reset_fails(port, monkeypatch):
+    """Client rendezvous-sends to a server that dies; flush must fail."""
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_RNDV_THRESHOLD", str(1 << 20))
+
+    server = Server()
+    server.listen(SERVER_ADDR, port)
+    client = Client()
+    await client.aconnect(SERVER_ADDR, port)
+
+    payload = np.zeros(64 << 20, dtype=np.uint8)  # 64 MiB >> threshold
+    send_fut = client.asend(payload, 1)  # local completion: header on wire
+    await send_fut
+    await server.aclose()  # peer dies with payload in flight
+    await asyncio.sleep(0.3)
+
+    with pytest.raises(Exception) as e:
+        await asyncio.wait_for(client.aflush(), timeout=5)
+    assert "not connected" in str(e.value).lower() or "cancel" in str(e.value).lower()
+    await client.aclose()
+
+
+async def test_flush_on_clean_dead_conn_succeeds(port, monkeypatch):
+    """No unacknowledged data -> flush over a closed peer passes truthfully."""
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    server = Server()
+    server.listen(SERVER_ADDR, port)
+    client = Client()
+    await client.aconnect(SERVER_ADDR, port)
+
+    sink = np.zeros(4, dtype=np.uint8)
+    recv_fut = server.arecv(sink, 0, 0)
+    await client.asend(np.arange(4, dtype=np.uint8), 9)
+    await recv_fut
+    await client.aflush()  # acked: conn is clean
+    await server.aclose()
+    await asyncio.sleep(0.3)
+    await asyncio.wait_for(client.aflush(), timeout=5)  # vacuous but truthful
+    await client.aclose()
+
+
+async def test_listen_ephemeral_port_advertises_real_port(monkeypatch):
+    monkeypatch.setenv("STARWAY_TLS", "tcp")  # force the advertised TCP path
+    import json
+
+    server = Server()
+    server.listen(SERVER_ADDR, 0)
+    blob = server.get_worker_address()
+    info = json.loads(blob.decode())
+    assert info["port"] != 0
+
+    client = Client()
+    await client.aconnect_address(blob)
+    sink = np.zeros(4, dtype=np.uint8)
+    recv_fut = server.arecv(sink, 0, 0)
+    await client.asend(np.arange(4, dtype=np.uint8), 3)
+    tag, length = await recv_fut
+    assert tag == 3 and length == 4
+    await client.aclose()
+    await server.aclose()
+
+
+async def test_close_with_half_open_connection(port):
+    """A raw TCP connect with no HELLO must not wedge server close, and the
+    socket must be torn down promptly."""
+    server = Server()
+    server.listen(SERVER_ADDR, port)
+    raw = socket.create_connection((SERVER_ADDR, port), timeout=5)
+    await asyncio.sleep(0.2)  # let the server accept it
+    await asyncio.wait_for(server.aclose(), timeout=5)
+    # Server side closed the half-open socket: reads finish quickly.
+    raw.settimeout(2)
+    try:
+        data = raw.recv(16)
+        assert data == b""  # EOF
+    except ConnectionError:
+        pass  # reset is equally acceptable
+    finally:
+        raw.close()
