@@ -1,0 +1,25 @@
+"""Model families built on the framework's device plane.
+
+The reference moves opaque buffers; the configs in BASELINE.json ground them
+in real workloads ("Llama-3 8B activation/grad transfer between TPU hosts").
+This package provides the flagship Llama family used by the benchmarks, the
+DP-exchange demos, and the graft entry's multichip training step.
+"""
+
+from .llama import (
+    LlamaConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+    param_specs,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "make_train_step",
+    "param_specs",
+]

@@ -1,0 +1,20 @@
+"""Jitted device-plane building blocks: collectives and attention kernels.
+
+This is the SPMD-native layer of the framework: where the host runtime
+(core/) moves opaque tagged buffers between workers, these ops move sharded
+``jax.Array`` data across a ``jax.sharding.Mesh`` with XLA collectives over
+ICI -- the idiomatic TPU equivalent of composing transfers from the
+reference's P2P primitives (SURVEY.md section 5 "Long-context / sequence
+parallelism": "ring attention = asend/arecv to ring neighbors + overlap,
+i.e. CollectivePermute; Ulysses = all-to-all composed from P2P").
+"""
+
+from .collectives import (
+    all_gather,
+    all_to_all,
+    psum,
+    reduce_scatter,
+    ring_shift,
+)
+
+__all__ = ["ring_shift", "all_to_all", "all_gather", "psum", "reduce_scatter"]

@@ -1,0 +1,154 @@
+"""Attention building blocks: online-softmax partials for blockwise and ring
+attention.
+
+All functions are pure jax/lax (compiler-friendly static shapes, scan-based
+control flow) so they run identically on the virtual CPU mesh and on TPU,
+where XLA fuses the softmax chain and tiles the matmuls onto the MXU.  A
+hand-tuned pallas kernel for the block partial lands behind the same
+interface (ops/pallas_attention.py).
+
+Layout convention: ``q, k, v: [batch, heads, seq, head_dim]``.
+
+The decomposition is the standard flash/ring-attention algebra: a block
+produces an *unnormalised* output ``o = exp(s - m) @ v`` with row statistics
+``(m = rowmax(s), l = rowsum(exp(s - m)))``; partials merge associatively
+with :func:`merge_partials`, which is what lets kv blocks arrive in any
+order around the ICI ring (parallel/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_BIG = -0.9e30  # mask fill; avoids -inf NaN traps in exp/max chains
+
+
+def repeat_kv(x, n_rep: int):
+    """Expand grouped KV heads to match query heads (GQA)."""
+    if n_rep == 1:
+        return x
+    b, h, t, d = x.shape
+    return jnp.broadcast_to(x[:, :, None, :, :], (b, h, n_rep, t, d)).reshape(b, h * n_rep, t, d)
+
+
+def partial_attention(
+    q,
+    k,
+    v,
+    *,
+    q_offset=0,
+    kv_offset=0,
+    causal: bool = False,
+    kv_limit: Optional[int] = None,
+    sm_scale: Optional[float] = None,
+):
+    """Attention of ``q`` against one kv block, in mergeable partial form.
+
+    Returns ``(o, m, l)``: unnormalised output ``[B,H,Tq,D]``, row max
+    ``[B,H,Tq]``, row sum ``[B,H,Tq]``.  ``q_offset``/``kv_offset`` are the
+    global positions of the first query/key token -- the causal mask is
+    computed in global coordinates so blocks can come from anywhere in the
+    sequence (ring steps pass traced offsets).  ``kv_limit`` masks key
+    positions at or beyond that global index (padding).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    # Scores and row stats in f32 (MXU takes bf16 inputs, accumulates f32).
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
+    kv_pos = kv_offset + jnp.arange(k.shape[2])
+    mask = jnp.ones((q.shape[2], k.shape[2]), dtype=bool)
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[2])
+        mask = mask & (q_pos[:, None] >= kv_pos[None, :])
+    if kv_limit is not None:
+        mask = mask & (kv_pos < kv_limit)[None, :]
+    s = jnp.where(mask[None, None, :, :], s, NEG_BIG)
+    m = jnp.max(s, axis=-1)
+    # Rows with no visible keys: exp(s - m) would be exp(0)=1; zero them.
+    p = jnp.where(s > NEG_BIG / 2, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+    return o, m, l
+
+
+def merge_partials(a, b):
+    """Associatively merge two attention partials over the same queries."""
+    o_a, m_a, l_a = a
+    o_b, m_b, l_b = b
+    m = jnp.maximum(m_a, m_b)
+    sa = jnp.exp(m_a - m)
+    sb = jnp.exp(m_b - m)
+    l = l_a * sa + l_b * sb
+    o = o_a * sa[..., None].astype(o_a.dtype) + o_b * sb[..., None].astype(o_b.dtype)
+    return o, m, l
+
+
+def zero_partial(q):
+    """Identity element for merge_partials over queries shaped like ``q``.
+    Accumulators are f32 regardless of compute dtype."""
+    b, h, tq, d = q.shape
+    return (
+        jnp.zeros((b, h, tq, d), dtype=jnp.float32),
+        jnp.full((b, h, tq), NEG_BIG, dtype=jnp.float32),
+        jnp.zeros((b, h, tq), dtype=jnp.float32),
+    )
+
+
+def finalize_partial(o, m, l, out_dtype=None):
+    """Normalise a merged partial into the attention output."""
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(out_dtype) if out_dtype is not None else out
+
+
+def blockwise_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    block_k: int = 512,
+    sm_scale: Optional[float] = None,
+):
+    """Single-device flash-style attention: scan over kv blocks with the
+    online-softmax merge, never materialising the full [Tq, Tkv] matrix."""
+    b, h, tq, d = q.shape
+    tkv = k.shape[2]
+    block_k = min(block_k, tkv)
+    nblocks = (tkv + block_k - 1) // block_k
+    pad = nblocks * block_k - tkv
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(b, h, nblocks, block_k, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nblocks, block_k, d).transpose(2, 0, 1, 3, 4)
+    offs = jnp.arange(nblocks) * block_k
+
+    def step(carry, blk):
+        k_i, v_i, off = blk
+        part = partial_attention(
+            q, k_i, v_i,
+            q_offset=0, kv_offset=off,
+            causal=causal, kv_limit=tkv if pad else None, sm_scale=sm_scale,
+        )
+        return merge_partials(carry, part), None
+
+    (o, m, l), _ = jax.lax.scan(step, zero_partial(q), (kb, vb, offs))
+    return finalize_partial(o, m, l, out_dtype=q.dtype)
+
+
+def attention_reference(q, k, v, *, causal: bool = False, sm_scale: Optional[float] = None):
+    """Plain materialised-softmax attention (test oracle)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
+    if causal:
+        tq, tkv = q.shape[2], k.shape[2]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tkv)[None, :]
+        s = jnp.where(mask[None, None, :, :], s, NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)

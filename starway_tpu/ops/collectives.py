@@ -1,0 +1,79 @@
+"""Mesh collectives: thin, explicit wrappers over lax collectives.
+
+Used inside ``shard_map``-decorated functions (the per-device SPMD view).
+On TPU hardware every one of these lowers to XLA collectives scheduled on
+ICI links; ``ring_shift`` is the CollectivePermute underlying ring attention
+and pipeline-style neighbor exchange -- the device-plane analogue of the
+reference's tagged neighbor sends (BASELINE config 4/5 patterns).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def axis_size(axis_name: str) -> int:
+    return lax.axis_size(axis_name)
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def ring_shift(x, axis_name: str, shift: int = 1):
+    """Rotate shards around the mesh axis ring: device i -> device (i+shift).
+
+    CollectivePermute over ICI; with ``shift=+1``/``-1`` both neighbor
+    directions of a ring attention pass.
+    """
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int, *, tiled: bool = True):
+    """Transpose shard ownership: split local data along ``split_axis`` into
+    one block per device on the mesh axis, exchange, concatenate received
+    blocks along ``concat_axis``.  The Ulysses-style sequence<->head
+    re-sharding primitive and the KV-cache shuffle (BASELINE config 4)."""
+    return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=tiled)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, *, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def psum(x, axis_name: str):
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str):
+    return lax.pmean(x, axis_name)
+
+
+def reduce_scatter(x, axis_name: str, scatter_axis: int = 0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ring_reduce(x, axis_name: str, op=None):
+    """Explicit ring all-reduce built from CollectivePermute steps.
+
+    XLA's psum is normally what you want (it already schedules a ring over
+    ICI); this exists as the transparent composition example -- the
+    device-plane mirror of building collectives from P2P sends, and a
+    teaching/verification tool for the link model in perf.py.
+    """
+    import jax.numpy as jnp
+
+    n = lax.axis_size(axis_name)
+    if op is None:
+        op = jnp.add
+
+    def body(i, acc_and_buf):
+        acc, buf = acc_and_buf
+        buf = ring_shift(buf, axis_name, 1)
+        return op(acc, buf), buf
+
+    acc, _ = jax.lax.fori_loop(0, n - 1, body, (x, x))
+    return acc

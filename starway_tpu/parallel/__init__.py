@@ -1,0 +1,31 @@
+"""Composition layer: parallelism patterns built on the device plane.
+
+The reference ships only P2P primitives and documents the patterns users
+build from them (SURVEY.md section 2 "Parallelism strategies": all-to-all
+composed from P2P, DP-boundary transfers, ring neighbor exchange).  Here
+those patterns are first-class, TPU-native:
+
+* :mod:`sharding` -- mesh construction and NamedSharding helpers.
+* :mod:`ring_attention` -- sequence-parallel attention over an ICI ring
+  (CollectivePermute + online-softmax merge), the long-context substrate.
+* :mod:`all_to_all` -- sharded KV-cache-style shuffles (BASELINE config 4).
+* :mod:`dp_exchange` -- pytree activation/grad transfer between hosts over
+  the async P2P API (BASELINE config 5).
+"""
+
+from .sharding import make_mesh, mesh_sharding
+from .ring_attention import ring_attention, make_ring_attention
+from .all_to_all import make_shuffle
+from .dp_exchange import ClientPort, ServerPort, recv_pytree, send_pytree
+
+__all__ = [
+    "make_mesh",
+    "mesh_sharding",
+    "ring_attention",
+    "make_ring_attention",
+    "make_shuffle",
+    "ClientPort",
+    "ServerPort",
+    "send_pytree",
+    "recv_pytree",
+]

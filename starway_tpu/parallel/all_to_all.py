@@ -1,0 +1,37 @@
+"""Sharded all-to-all shuffles: the KV-cache disaggregation pattern.
+
+BASELINE config 4 ("1GB jax.Array all-to-all shuffle") built the TPU way: a
+single jitted ``lax.all_to_all`` over the mesh axis, which XLA schedules as
+an all-to-all over ICI -- versus the reference's composition of N^2 tagged
+P2P sends (SURVEY.md section 2 checklist: "1GB all-to-all shuffle must be
+composed from P2P").  A host-API composition equivalent lives in
+examples/all_to_all_p2p.py for parity with that pattern.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.collectives import all_to_all
+from .sharding import shard_map_fn
+
+
+def make_shuffle(mesh, axis_name: str, *, split_axis: int = 1, concat_axis: int = 0):
+    """Jitted resharding shuffle over ``axis_name``.
+
+    The global view: input sharded on dim 0 over the axis; output is the
+    transposed ownership -- dim ``split_axis`` becomes the sharded dim.  For
+    a [S, B, ...] KV cache sharded on S, ``make_shuffle(mesh, "x")`` yields
+    the cache sharded on B: every device sends 1/n of its shard to each
+    peer, the disaggregated-serving handoff pattern.
+    """
+
+    def local(x):
+        return all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis)
+
+    in_spec = P(axis_name)
+    out_spec_list = [None] * (max(split_axis, concat_axis) + 1)
+    out_spec_list[split_axis] = axis_name
+    out_spec = P(*out_spec_list)
+    return jax.jit(shard_map_fn(mesh, local, in_specs=(in_spec,), out_specs=out_spec))

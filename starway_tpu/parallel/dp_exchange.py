@@ -1,0 +1,93 @@
+"""DP-boundary pytree transfer over the async P2P API.
+
+BASELINE config 5: "Llama-3 8B activation/grad transfer between TPU hosts
+(DP boundary)".  The unit of exchange is a pytree of jax.Arrays (a gradient
+tree, an activation dict); each leaf becomes one tagged message, tags are
+``base_tag + leaf_index``, and a flush closes the batch -- the same shape a
+user of the reference would build by hand from asend/arecv
+(SURVEY.md section 2, BASELINE configs).
+
+Ports unify the two directions of the Client/Server API so the same transfer
+code runs on either side:
+
+>>> await send_pytree(ClientPort(client), grads, base_tag=0x9000)
+>>> grads2 = await recv_pytree(ServerPort(server), like=grads, base_tag=0x9000)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ..device import DeviceBuffer
+
+FULL_MASK = (1 << 64) - 1
+
+
+class ClientPort:
+    """Client side of a duplex link."""
+
+    def __init__(self, client):
+        self._c = client
+
+    def asend(self, buf, tag):
+        return self._c.asend(buf, tag)
+
+    def arecv(self, buf, tag, mask=FULL_MASK):
+        return self._c.arecv(buf, tag, mask)
+
+    def aflush(self):
+        return self._c.aflush()
+
+
+class ServerPort:
+    """Server side of a duplex link, bound to one endpoint."""
+
+    def __init__(self, server, endpoint=None):
+        self._s = server
+        if endpoint is None:
+            clients = server.list_clients()
+            if not clients:
+                raise ValueError("server has no connected endpoints")
+            endpoint = next(iter(clients))
+        self._ep = endpoint
+
+    def asend(self, buf, tag):
+        return self._s.asend(self._ep, buf, tag)
+
+    def arecv(self, buf, tag, mask=FULL_MASK):
+        return self._s.arecv(buf, tag, mask)
+
+    def aflush(self):
+        return self._s.aflush_ep(self._ep)
+
+
+async def send_pytree(port, tree: Any, base_tag: int, *, flush: bool = True) -> int:
+    """Send every leaf of ``tree`` as a tagged message; returns leaf count.
+
+    Leaves go out concurrently (the engine pipelines them); ``flush=True``
+    appends the delivery barrier so the batch survives a subsequent close.
+    """
+    import asyncio
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    await asyncio.gather(*(port.asend(leaf, base_tag + i) for i, leaf in enumerate(leaves)))
+    if flush:
+        await port.aflush()
+    return len(leaves)
+
+
+async def recv_pytree(port, like: Any, base_tag: int, *, device=None) -> Any:
+    """Receive a pytree shaped like ``like``; returns the reconstructed tree
+    of received jax.Arrays (placed on ``device`` or each leaf's own device)."""
+    import asyncio
+
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    sinks = [
+        DeviceBuffer.like(leaf, device=device) for leaf in leaves
+    ]
+    await asyncio.gather(
+        *(port.arecv(sink, base_tag + i) for i, sink in enumerate(sinks))
+    )
+    return jax.tree_util.tree_unflatten(treedef, [s.array for s in sinks])

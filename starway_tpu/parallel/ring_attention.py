@@ -1,0 +1,76 @@
+"""Ring attention: sequence-parallel exact attention over an ICI ring.
+
+Long-context substrate (the capability SURVEY.md section 5 calls out as the
+point of the tagged-P2P primitives: "ring attention = asend/arecv to ring
+neighbors + overlap, i.e. CollectivePermute").  Implemented TPU-native: each
+device owns a sequence shard of q/k/v; kv shards rotate around the mesh axis
+with ``lax.ppermute`` while every device accumulates online-softmax partials
+against its resident queries.  XLA overlaps the ppermute DMA with the next
+block's matmuls, so the ring rides ICI concurrently with MXU compute.
+
+Exactness comes from the associative merge in ops/attention.py -- blocks may
+arrive in any rotation order, which is also what makes the accumulation
+robust to mesh axis ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import (
+    finalize_partial,
+    merge_partials,
+    partial_attention,
+    zero_partial,
+)
+from ..ops.collectives import ring_shift
+from .sharding import shard_map_fn
+
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
+                   sm_scale: Optional[float] = None):
+    """Per-device body (call inside shard_map): q/k/v are local sequence
+    shards ``[B, H, T_local, D]``; returns the local output shard.
+
+    Rotation schedule: after step ``i`` the device holds kv shard
+    ``(my_index - i - 1) mod n``; global offsets feed the causal mask so no
+    cross-shard attention is ever wrongly masked or admitted.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    q_off = my * t_local
+
+    def body(i, carry):
+        acc, k_cur, v_cur = carry
+        src = (my - i) % n  # owner of the kv shard currently resident here
+        part = partial_attention(
+            q, k_cur, v_cur,
+            q_offset=q_off, kv_offset=src * t_local,
+            causal=causal, sm_scale=sm_scale,
+        )
+        acc = merge_partials(acc, part)
+        # Rotate kv to the next device; XLA overlaps this ppermute with the
+        # next iteration's compute.
+        k_cur = ring_shift(k_cur, axis_name, 1)
+        v_cur = ring_shift(v_cur, axis_name, 1)
+        return acc, k_cur, v_cur
+
+    acc, _, _ = lax.fori_loop(0, n, body, (zero_partial(q), k, v))
+    return finalize_partial(*acc, out_dtype=q.dtype)
+
+
+def make_ring_attention(mesh, axis_name: str = "sp", *, causal: bool = True,
+                        sm_scale: Optional[float] = None):
+    """Jitted global-view ring attention: q/k/v are global arrays sharded on
+    the sequence dimension over ``axis_name`` ([B, H, S, D], S sharded)."""
+    spec = P(None, None, axis_name, None)
+
+    def local(q, k, v):
+        return ring_attention(q, k, v, axis_name, causal=causal, sm_scale=sm_scale)
+
+    return jax.jit(shard_map_fn(mesh, local, in_specs=(spec, spec, spec), out_specs=spec))
