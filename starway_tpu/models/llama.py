@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import blockwise_attention, repeat_kv
+from ..ops.attention import blockwise_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,7 +80,7 @@ def init_params(key, cfg: LlamaConfig) -> dict:
     """Stacked-layer parameter pytree.  Weights init: scaled normal."""
     dt = cfg.compute_dtype
     hd = cfg.head_dim
-    keys = jax.random.split(key, 8)
+    keys = jax.random.split(key, 9)
 
     def norm(k, shape, scale):
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
@@ -101,7 +101,7 @@ def init_params(key, cfg: LlamaConfig) -> dict:
             "mlp_norm": jnp.ones((L, D), dt),
         },
         "final_norm": jnp.ones((D,), dt),
-        "lm_head": norm(keys[0], (D, cfg.vocab_size), D**-0.5),
+        "lm_head": norm(keys[8], (D, cfg.vocab_size), D**-0.5),
     }
 
 
@@ -148,7 +148,10 @@ def rope_tables(seq_len: int, head_dim: int, theta: float):
 
 
 def apply_rope(x, cos, sin):
-    """x: [B, H, S, Dh]; rotate pairs (even, odd)."""
+    """x: [B, H, S, Dh]; split-half (NeoX) rotation convention: the two
+    rotated components are x[..., :Dh/2] and x[..., Dh/2:].  NOTE: Meta's
+    released Llama checkpoints use the interleaved-pair convention; loading
+    them requires permuting wq/wk columns accordingly."""
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     c = cos[None, None, :, :]
     s = sin[None, None, :, :]
@@ -166,15 +169,15 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
             attn_fn: Optional[Callable] = None):
     """Next-token logits ``[B, S, V]`` for token ids ``[B, S]``.
 
-    ``attn_fn(q, k, v) -> out`` operates on ``[B, H, S, Dh]`` with KV heads
-    already expanded; defaults to single-device blockwise attention.  Pass
-    :func:`make_sharded_attn`'s result for sequence-parallel ring attention.
+    ``attn_fn(q, k, v) -> out`` takes q ``[B, Hq, S, Dh]`` and *grouped*
+    kv ``[B, Hkv, S, Dh]`` (impls expand GQA heads internally); defaults to
+    single-device blockwise attention.  Pass :func:`make_sharded_attn`'s
+    result for sequence-parallel ring attention.
     """
     if attn_fn is None:
         attn_fn = default_attn
     B, S = tokens.shape
     hd = cfg.head_dim
-    n_rep = cfg.n_heads // cfg.n_kv_heads
     cos, sin = rope_tables(S, hd, cfg.rope_theta)
 
     h = params["embed"][tokens]  # [B, S, D]
@@ -186,8 +189,8 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
         v = (x @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k = repeat_kv(k, n_rep)
-        v = repeat_kv(v, n_rep)
+        # kv stays in grouped (narrow) form; attention impls expand it, so
+        # the ring rotates 1/n_rep of the bytes over ICI.
         o = attn_fn(q, k, v)  # [B, H, S, Dh]
         o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_heads * hd)
         h = h + o @ lp["wo"]
@@ -233,7 +236,8 @@ def make_sharded_attn(mesh, *, seq_axis: str = "sp", dp_axis: str = "dp",
                       tp_axis: str = "tp"):
     """Sequence-parallel ring attention for use as ``attn_fn`` inside the
     GSPMD-jitted forward: q/k/v arrive [B, H, S, Dh] with batch sharded over
-    dp, heads over tp, sequence over sp; the kv shards ride the ICI ring."""
+    dp, heads over tp, sequence over sp; the (grouped, narrow) kv shards
+    ride the ICI ring.  Requires n_kv_heads % tp == 0."""
     from ..parallel.ring_attention import ring_attention
     from ..parallel.sharding import shard_map_fn
 

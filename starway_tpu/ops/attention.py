@@ -115,7 +115,12 @@ def blockwise_attention(
     sm_scale: Optional[float] = None,
 ):
     """Single-device flash-style attention: scan over kv blocks with the
-    online-softmax merge, never materialising the full [Tq, Tkv] matrix."""
+    online-softmax merge, never materialising the full [Tq, Tkv] matrix.
+    Grouped-query kv (fewer kv heads than q heads) is expanded here."""
+    if k.shape[1] != q.shape[1]:
+        n_rep = q.shape[1] // k.shape[1]
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
     b, h, tq, d = q.shape
     tkv = k.shape[2]
     block_k = min(block_k, tkv)

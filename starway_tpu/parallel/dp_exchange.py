@@ -42,7 +42,14 @@ class ClientPort:
 
 
 class ServerPort:
-    """Server side of a duplex link, bound to one endpoint."""
+    """Server side of a duplex link.
+
+    Sends are bound to one endpoint; receives are worker-wide tag matches
+    (the core contract -- reference recvs post on the worker, not the
+    endpoint, src/bindings/main.cpp:1172).  With multiple peers exchanging
+    concurrently, give each peer a disjoint ``base_tag`` range; tags are the
+    routing key, exactly as in the reference's multi-client fan-in pattern
+    (tests/test_basic.py:526-554)."""
 
     def __init__(self, server, endpoint=None):
         self._s = server

@@ -25,6 +25,7 @@ from ..ops.attention import (
     finalize_partial,
     merge_partials,
     partial_attention,
+    repeat_kv,
     zero_partial,
 )
 from ..ops.collectives import ring_shift
@@ -36,31 +37,39 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
     """Per-device body (call inside shard_map): q/k/v are local sequence
     shards ``[B, H, T_local, D]``; returns the local output shard.
 
-    Rotation schedule: after step ``i`` the device holds kv shard
-    ``(my_index - i - 1) mod n``; global offsets feed the causal mask so no
-    cross-shard attention is ever wrongly masked or admitted.
+    Grouped-query kv is accepted unexpanded (``k/v`` with fewer heads): the
+    ring rotates the *narrow* kv shards and expands per step, so ICI moves
+    1/n_rep of the naive traffic.  Rotation schedule: after step ``i`` the
+    device holds kv shard ``(my_index - i) mod n``; global offsets feed the
+    causal mask so no cross-shard attention is wrongly masked or admitted.
+    The last compute step skips the rotation (n-1 ppermutes for n shards).
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     t_local = q.shape[2]
     q_off = my * t_local
+    n_rep = q.shape[1] // k.shape[1]
 
-    def body(i, carry):
-        acc, k_cur, v_cur = carry
+    def compute(i, acc, k_cur, v_cur):
         src = (my - i) % n  # owner of the kv shard currently resident here
         part = partial_attention(
-            q, k_cur, v_cur,
+            q, repeat_kv(k_cur, n_rep), repeat_kv(v_cur, n_rep),
             q_offset=q_off, kv_offset=src * t_local,
             causal=causal, sm_scale=sm_scale,
         )
-        acc = merge_partials(acc, part)
+        return merge_partials(acc, part)
+
+    def body(i, carry):
+        acc, k_cur, v_cur = carry
+        acc = compute(i, acc, k_cur, v_cur)
         # Rotate kv to the next device; XLA overlaps this ppermute with the
         # next iteration's compute.
         k_cur = ring_shift(k_cur, axis_name, 1)
         v_cur = ring_shift(v_cur, axis_name, 1)
         return acc, k_cur, v_cur
 
-    acc, _, _ = lax.fori_loop(0, n, body, (zero_partial(q), k, v))
+    acc, k_last, v_last = lax.fori_loop(0, n - 1, body, (zero_partial(q), k, v))
+    acc = compute(n - 1, acc, k_last, v_last)
     return finalize_partial(*acc, out_dtype=q.dtype)
 
 
