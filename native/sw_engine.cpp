@@ -1,0 +1,1230 @@
+// starway-tpu native host engine (C++20).
+//
+// The TPU-native counterpart of the reference's C++ binding core
+// (reference: src/bindings/main.cpp -- UCX workers driven by busy-poll
+// progress threads).  This engine keeps the reference's ownership model
+// (one engine thread owns all socket I/O per worker; the application thread
+// only enqueues ops) but is event-driven: epoll + eventfd wakeup, zero CPU
+// when idle, instead of a 100% busy-poll loop.
+//
+// Wire protocol: identical to the Python engine (starway_tpu/core/frames.py)
+// -- 17-byte little-endian header {u8 type, u64 a, u64 b}; HELLO/HELLO_ACK
+// carry a tiny JSON body; DATA streams `b` payload bytes; FLUSH/FLUSH_ACK
+// carry a sequence number.  Native and Python workers interoperate across
+// processes.
+//
+// Exposed as a plain extern "C" surface consumed through ctypes
+// (starway_tpu/core/native.py).  Callbacks are invoked from the engine
+// thread with no locks held; the ctypes trampoline re-acquires the GIL.
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+// ---------------------------------------------------------------- C ABI
+
+extern "C" {
+typedef void (*sw_done_cb)(void* ctx);
+typedef void (*sw_fail_cb)(void* ctx, const char* reason);
+typedef void (*sw_recv_cb)(void* ctx, uint64_t sender_tag, uint64_t length);
+typedef void (*sw_accept_cb)(void* ctx, uint64_t conn_id);
+typedef void (*sw_status_cb)(void* ctx, const char* status);  // "" = ok
+}
+
+namespace {
+
+constexpr uint8_t T_HELLO = 1;
+constexpr uint8_t T_HELLO_ACK = 2;
+constexpr uint8_t T_DATA = 3;
+constexpr uint8_t T_FLUSH = 4;
+constexpr uint8_t T_FLUSH_ACK = 5;
+constexpr size_t HEADER_SIZE = 17;
+
+constexpr int ST_VOID = 0, ST_INIT = 1, ST_RUNNING = 2, ST_CLOSING = 3, ST_CLOSED = 4;
+
+const char* kCancelled = "Operation cancelled (local endpoint closed before completion)";
+const char* kNotConnected = "Endpoint is not connected";
+const char* kTruncated = "Message truncated: payload larger than posted receive buffer";
+
+uint64_t rndv_threshold() {
+  static uint64_t v = [] {
+    const char* e = getenv("STARWAY_RNDV_THRESHOLD");
+    return e ? strtoull(e, nullptr, 10) : (uint64_t)(8u << 20);
+  }();
+  return v;
+}
+
+void pack_header(uint8_t* out, uint8_t type, uint64_t a, uint64_t b) {
+  out[0] = type;
+  memcpy(out + 1, &a, 8);  // x86/ARM LE; matches struct.pack("<BQQ")
+  memcpy(out + 9, &b, 8);
+}
+
+void unpack_header(const uint8_t* in, uint8_t* type, uint64_t* a, uint64_t* b) {
+  *type = in[0];
+  memcpy(a, in + 1, 8);
+  memcpy(b, in + 9, 8);
+}
+
+// Minimal JSON string-field extractor for our fixed handshake bodies.
+std::string json_field(const std::string& body, const std::string& key) {
+  std::string pat = "\"" + key + "\"";
+  size_t p = body.find(pat);
+  if (p == std::string::npos) return "";
+  p = body.find(':', p + pat.size());
+  if (p == std::string::npos) return "";
+  p = body.find('"', p);
+  if (p == std::string::npos) return "";
+  size_t q = body.find('"', p + 1);
+  if (q == std::string::npos) return "";
+  return body.substr(p + 1, q - p - 1);
+}
+
+using Fire = std::function<void()>;
+using FireList = std::vector<Fire>;
+
+bool tags_match(uint64_t stag, uint64_t rtag, uint64_t rmask) {
+  return (stag & rmask) == (rtag & rmask);
+}
+
+// ------------------------------------------------------------- matcher
+
+struct PostedRecv {
+  uint8_t* buf = nullptr;
+  uint64_t cap = 0;
+  uint64_t tag = 0, mask = 0;
+  sw_recv_cb done = nullptr;
+  sw_fail_cb fail = nullptr;
+  void* ctx = nullptr;
+  bool claimed = false;
+};
+
+struct InboundMsg {
+  uint64_t tag = 0, length = 0, received = 0;
+  std::vector<uint8_t> spill;  // unexpected-path buffer
+  bool use_spill = false;
+  PostedRecv pr{};  // valid iff has_pr
+  bool has_pr = false;
+  bool complete = false;
+  bool discard = false;
+};
+
+struct Matcher {
+  std::deque<PostedRecv> posted;
+  std::deque<InboundMsg*> unexpected;
+  std::unordered_set<InboundMsg*> inflight;
+
+  ~Matcher() {
+    for (auto* m : unexpected) delete m;
+  }
+
+  void post_recv(const PostedRecv& pr_in, FireList& fires) {
+    for (auto it = unexpected.begin(); it != unexpected.end(); ++it) {
+      InboundMsg* m = *it;
+      if (!m->has_pr && !m->discard && tags_match(m->tag, pr_in.tag, pr_in.mask)) {
+        if (m->length > pr_in.cap) {
+          unexpected.erase(it);
+          if (!m->complete) { m->discard = true; } else { delete m; }
+          auto fail = pr_in.fail; auto ctx = pr_in.ctx;
+          fires.push_back([fail, ctx] { fail(ctx, kTruncated); });
+          return;
+        }
+        if (m->complete) {
+          memcpy(pr_in.buf, m->spill.data(), m->length);
+          uint64_t t = m->tag, n = m->length;
+          unexpected.erase(it);
+          delete m;
+          auto done = pr_in.done; auto ctx = pr_in.ctx;
+          fires.push_back([done, ctx, t, n] { done(ctx, t, n); });
+          return;
+        }
+        m->pr = pr_in;
+        m->pr.claimed = true;
+        m->has_pr = true;  // copied from spill at completion
+        return;
+      }
+    }
+    posted.push_back(pr_in);
+  }
+
+  // Header of a streamed message arrived; returns the record.
+  InboundMsg* on_start(uint64_t tag, uint64_t length, FireList& fires) {
+    auto* m = new InboundMsg();
+    m->tag = tag;
+    m->length = length;
+    inflight.insert(m);
+    for (auto it = posted.begin(); it != posted.end(); ++it) {
+      if (!it->claimed && tags_match(tag, it->tag, it->mask)) {
+        if (length > it->cap) {
+          auto fail = it->fail; auto ctx = it->ctx;
+          posted.erase(it);
+          fires.push_back([fail, ctx] { fail(ctx, kTruncated); });
+          m->discard = true;
+          return m;
+        }
+        m->pr = *it;
+        m->pr.claimed = true;
+        m->has_pr = true;
+        posted.erase(it);
+        return m;  // streams straight into pr.buf
+      }
+    }
+    m->use_spill = true;
+    m->spill.resize(length);
+    unexpected.push_back(m);
+    return m;
+  }
+
+  void on_complete(InboundMsg* m, FireList& fires) {
+    m->complete = true;
+    inflight.erase(m);
+    if (m->discard) {
+      delete m;
+      return;
+    }
+    if (m->has_pr) {
+      if (m->use_spill) {
+        memcpy(m->pr.buf, m->spill.data(), m->length);
+        for (auto it = unexpected.begin(); it != unexpected.end(); ++it)
+          if (*it == m) { unexpected.erase(it); break; }
+      }
+      auto done = m->pr.done; auto ctx = m->pr.ctx;
+      uint64_t t = m->tag, n = m->length;
+      fires.push_back([done, ctx, t, n] { done(ctx, t, n); });
+      delete m;
+      return;
+    }
+    // stays in unexpected until claimed (spill holds the payload)
+  }
+
+  void purge_inflight(InboundMsg* m) {
+    if (m->complete) return;
+    m->discard = true;
+    inflight.erase(m);
+    if (!m->has_pr) {
+      for (auto it = unexpected.begin(); it != unexpected.end(); ++it)
+        if (*it == m) { unexpected.erase(it); break; }
+      delete m;
+    }
+    // claimed partial: pr stays pending forever (peer-death semantics);
+    // record deleted at close.
+  }
+
+  void cancel_all(FireList& fires) {
+    for (auto& pr : posted) {
+      auto fail = pr.fail; auto ctx = pr.ctx;
+      fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
+    }
+    posted.clear();
+    for (auto* m : inflight) {
+      if (m->has_pr && !m->complete) {
+        auto fail = m->pr.fail; auto ctx = m->pr.ctx;
+        fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
+      }
+      if (!m->use_spill) delete m;  // spill-owned records freed below
+      else m->discard = true;
+    }
+    inflight.clear();
+    for (auto* m : unexpected) delete m;
+    unexpected.clear();
+  }
+};
+
+// ----------------------------------------------------------------- conn
+
+struct TxItem {
+  std::vector<uint8_t> header;
+  const uint8_t* payload = nullptr;
+  uint64_t paylen = 0;
+  uint64_t off = 0;
+  bool is_data = false;
+  bool rndv = false;
+  bool local_done = false;
+  sw_done_cb done = nullptr;
+  sw_fail_cb fail = nullptr;
+  void* ctx = nullptr;
+
+  uint64_t total() const { return header.size() + paylen; }
+};
+
+struct Conn {
+  uint64_t id = 0;
+  int fd = -1;
+  bool alive = true;
+  bool handshaken = false;
+  bool want_write = false;
+  std::string peer_name, mode = "socket";
+  std::string local_addr, remote_addr;
+  int local_port = 0, remote_port = 0;
+  std::deque<TxItem> tx;
+  // rx parser
+  uint8_t hdr[HEADER_SIZE];
+  size_t hdr_got = 0;
+  int ctl_type = 0;
+  std::string ctl_body;
+  size_t ctl_need = 0;
+  InboundMsg* rx_msg = nullptr;
+  std::vector<uint8_t> scratch;
+  // flush accounting
+  uint64_t flush_seq = 0, flush_acked = 0, data_counter = 0;
+  std::unordered_map<uint64_t, uint64_t> flush_marks;
+  bool dirty = false;
+
+  bool has_unfinished_data() const {
+    for (auto& t : tx)
+      if (t.is_data && t.off < t.total()) return true;
+    return false;
+  }
+};
+
+struct FlushRec {
+  sw_done_cb done = nullptr;
+  sw_fail_cb fail = nullptr;
+  void* ctx = nullptr;
+  std::unordered_map<uint64_t, uint64_t> waits;  // conn_id -> seq
+  bool completed = false;
+};
+
+// ------------------------------------------------------------------ ops
+
+struct Op {
+  enum Kind { SEND, FLUSH } kind;
+  uint64_t conn_id = 0;       // SEND target; FLUSH: 0 = all conns
+  bool conn_scoped = false;   // FLUSH limited to conn_id
+  const uint8_t* buf = nullptr;
+  uint64_t len = 0, tag = 0;
+  sw_done_cb done = nullptr;
+  sw_recv_cb rdone = nullptr;
+  sw_fail_cb fail = nullptr;
+  void* ctx = nullptr;
+};
+
+// --------------------------------------------------------------- worker
+
+struct Worker {
+  std::mutex mu;
+  std::atomic<int> status{ST_VOID};
+  std::atomic<int> refs{1};  // python handle; engine thread takes one more
+  int epfd = -1, evfd = -1;
+  std::thread::id engine_tid{};
+  std::string worker_id;
+  std::deque<Op> ops;
+  std::unordered_map<uint64_t, Conn*> conns;
+  std::vector<FlushRec*> flushes;
+  Matcher matcher;
+  uint64_t next_conn_id = 1;
+  sw_done_cb close_done = nullptr;
+  void* close_ctx = nullptr;
+  bool is_server = false;
+  // server bits
+  int listen_fd = -1;
+  sw_accept_cb accept_cb = nullptr;
+  void* accept_ctx = nullptr;
+  std::unordered_set<Conn*> half_open;
+  // client bits
+  std::string c_host, c_mode;
+  int c_port = 0;
+  sw_status_cb c_status_cb = nullptr;
+  void* c_status_ctx = nullptr;
+  uint64_t primary_conn = 0;
+
+  virtual ~Worker() {
+    for (auto& [id, c] : conns) delete c;
+    for (auto* f : flushes) delete f;
+  }
+
+  void unref() {
+    if (refs.fetch_sub(1) == 1) delete this;
+  }
+
+  void wake() {
+    if (evfd >= 0) {
+      uint64_t one = 1;
+      ssize_t r = write(evfd, &one, 8);
+      (void)r;
+    }
+  }
+
+  // ---------------------------------------------------------- epoll mgmt
+  void ep_add(int fd, uint32_t events, void* ptr) {
+    epoll_event ev{};
+    ev.events = events;
+    ev.data.ptr = ptr;
+    epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
+  }
+
+  void ep_mod_conn(Conn* c) {
+    epoll_event ev{};
+    ev.events = EPOLLIN | (c->want_write ? EPOLLOUT : 0);
+    ev.data.ptr = c;
+    epoll_ctl(epfd, EPOLL_CTL_MOD, c->fd, &ev);
+  }
+
+  void ep_del(int fd) { epoll_ctl(epfd, EPOLL_CTL_DEL, fd, nullptr); }
+
+  // -------------------------------------------------------------- sends
+  void conn_send_data(Conn* c, const Op& op, FireList& fires) {
+    if (!c->alive) {
+      auto fail = op.fail; auto ctx = op.ctx;
+      if (fail) fires.push_back([fail, ctx] { fail(ctx, "Endpoint is not connected (connection reset)"); });
+      return;
+    }
+    c->dirty = true;
+    c->data_counter++;
+    TxItem item;
+    item.header.resize(HEADER_SIZE);
+    pack_header(item.header.data(), T_DATA, op.tag, op.len);
+    item.payload = op.buf;
+    item.paylen = op.len;
+    item.is_data = true;
+    item.rndv = op.len > rndv_threshold();
+    item.done = op.done;
+    item.fail = op.fail;
+    item.ctx = op.ctx;
+    c->tx.push_back(std::move(item));
+    kick_tx(c, fires);
+  }
+
+  void conn_send_ctl(Conn* c, uint8_t type, uint64_t a, uint64_t b,
+                     const std::string& body, FireList& fires) {
+    if (!c->alive) return;
+    TxItem item;
+    item.header.resize(HEADER_SIZE + body.size());
+    pack_header(item.header.data(), type, a, b);
+    if (!body.empty()) memcpy(item.header.data() + HEADER_SIZE, body.data(), body.size());
+    c->tx.push_back(std::move(item));
+    kick_tx(c, fires);
+  }
+
+  void kick_tx(Conn* c, FireList& fires) {
+    if (!c->alive) return;
+    while (!c->tx.empty()) {
+      TxItem& item = c->tx.front();
+      uint64_t hlen = item.header.size();
+      bool blocked = false;
+      while (item.off < item.total()) {
+        const uint8_t* p;
+        size_t n;
+        if (item.off < hlen) {
+          p = item.header.data() + item.off;
+          n = hlen - item.off;
+        } else {
+          uint64_t po = item.off - hlen;
+          p = item.payload + po;
+          uint64_t left = item.paylen - po;
+          n = left > (4u << 20) ? (4u << 20) : (size_t)left;
+        }
+        ssize_t w = ::send(c->fd, p, n, MSG_NOSIGNAL);
+        if (w < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            blocked = true;
+            break;
+          }
+          conn_broken(c, fires);
+          return;
+        }
+        item.off += (uint64_t)w;
+        // Rendezvous local completion: transmission begun (header written).
+        if (item.is_data && item.rndv && !item.local_done && item.off >= hlen) {
+          item.local_done = true;
+          if (item.done) {
+            auto done = item.done; auto ctx = item.ctx;
+            fires.push_back([done, ctx] { done(ctx); });
+          }
+        }
+      }
+      if (blocked) {
+        if (!c->want_write) {
+          c->want_write = true;
+          ep_mod_conn(c);
+        }
+        return;
+      }
+      if (item.is_data && !item.local_done) {
+        item.local_done = true;
+        if (item.done) {
+          auto done = item.done; auto ctx = item.ctx;
+          fires.push_back([done, ctx] { done(ctx); });
+        }
+      }
+      c->tx.pop_front();
+    }
+    if (c->want_write) {
+      c->want_write = false;
+      ep_mod_conn(c);
+    }
+  }
+
+  // ----------------------------------------------------------------- rx
+  void conn_readable(Conn* c, FireList& fires) {
+    while (c->alive) {
+      if (c->rx_msg) {
+        InboundMsg* m = c->rx_msg;
+        uint64_t remaining = m->length - m->received;
+        uint8_t* target;
+        size_t want;
+        if (m->discard) {
+          if (c->scratch.size() < (1u << 20)) c->scratch.resize(1u << 20);
+          target = c->scratch.data();
+          want = remaining > c->scratch.size() ? c->scratch.size() : (size_t)remaining;
+        } else if (m->has_pr && !m->use_spill) {
+          target = m->pr.buf + m->received;
+          want = remaining > (4u << 20) ? (4u << 20) : (size_t)remaining;
+        } else {
+          target = m->spill.data() + m->received;
+          want = remaining > (4u << 20) ? (4u << 20) : (size_t)remaining;
+        }
+        ssize_t r = ::recv(c->fd, target, want, 0);
+        if (r < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+          conn_broken(c, fires);
+          return;
+        }
+        if (r == 0) {
+          conn_broken(c, fires);
+          return;
+        }
+        m->received += (uint64_t)r;
+        if (m->received >= m->length) {
+          {
+            std::lock_guard<std::mutex> g(mu);
+            matcher.on_complete(m, fires);
+          }
+          c->rx_msg = nullptr;
+        }
+        continue;
+      }
+      if (c->ctl_need) {
+        size_t have = c->ctl_body.size();
+        size_t want = c->ctl_need - have;
+        char tmp[4096];
+        ssize_t r = ::recv(c->fd, tmp, want > sizeof(tmp) ? sizeof(tmp) : want, 0);
+        if (r < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+          conn_broken(c, fires);
+          return;
+        }
+        if (r == 0) {
+          conn_broken(c, fires);
+          return;
+        }
+        c->ctl_body.append(tmp, (size_t)r);
+        if (c->ctl_body.size() < c->ctl_need) continue;
+        int t = c->ctl_type;
+        std::string body = std::move(c->ctl_body);
+        c->ctl_body.clear();
+        c->ctl_need = 0;
+        c->ctl_type = 0;
+        if (t == T_HELLO) on_hello(c, body, fires);
+        // T_HELLO_ACK handled synchronously during client connect
+        continue;
+      }
+      ssize_t r = ::recv(c->fd, c->hdr + c->hdr_got, HEADER_SIZE - c->hdr_got, 0);
+      if (r < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        conn_broken(c, fires);
+        return;
+      }
+      if (r == 0) {
+        conn_broken(c, fires);
+        return;
+      }
+      c->hdr_got += (size_t)r;
+      if (c->hdr_got < HEADER_SIZE) continue;
+      c->hdr_got = 0;
+      uint8_t type;
+      uint64_t a, b;
+      unpack_header(c->hdr, &type, &a, &b);
+      switch (type) {
+        case T_DATA: {
+          std::lock_guard<std::mutex> g(mu);
+          InboundMsg* m = matcher.on_start(a, b, fires);
+          if (b == 0) {
+            matcher.on_complete(m, fires);
+          } else {
+            c->rx_msg = m;
+          }
+          break;
+        }
+        case T_FLUSH:
+          conn_send_ctl(c, T_FLUSH_ACK, a, 0, "", fires);
+          break;
+        case T_FLUSH_ACK:
+          on_flush_ack(c, a, fires);
+          break;
+        case T_HELLO:
+        case T_HELLO_ACK:
+          c->ctl_type = type;
+          c->ctl_need = (size_t)b;
+          break;
+        default:
+          conn_broken(c, fires);
+          return;
+      }
+    }
+  }
+
+  // -------------------------------------------------------------- flush
+  void start_flush(const Op& op, FireList& fires) {
+    std::vector<Conn*> candidates;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      if (op.conn_scoped) {
+        auto it = conns.find(op.conn_id);
+        if (it != conns.end()) candidates.push_back(it->second);
+      } else {
+        for (auto& [id, c] : conns) candidates.push_back(c);
+      }
+    }
+    for (Conn* c : candidates) {
+      if (!c->alive && c->dirty) {
+        auto fail = op.fail; auto ctx = op.ctx;
+        if (fail) fires.push_back([fail, ctx] { fail(ctx, "Endpoint is not connected (peer reset before flush)"); });
+        return;
+      }
+    }
+    auto* rec = new FlushRec();
+    rec->done = op.done;
+    rec->fail = op.fail;
+    rec->ctx = op.ctx;
+    for (Conn* c : candidates) {
+      if (!c->alive) continue;
+      uint64_t seq = ++c->flush_seq;
+      rec->waits[c->id] = seq;
+      c->flush_marks[seq] = c->data_counter;
+      conn_send_ctl(c, T_FLUSH, seq, 0, "", fires);
+    }
+    flushes.push_back(rec);
+    try_complete_flush(rec, fires);
+  }
+
+  void on_flush_ack(Conn* c, uint64_t seq, FireList& fires) {
+    if (seq > c->flush_acked) c->flush_acked = seq;
+    auto it = c->flush_marks.find(seq);
+    if (it != c->flush_marks.end()) {
+      if (it->second == c->data_counter) c->dirty = false;
+      c->flush_marks.erase(it);
+    }
+    auto snapshot = flushes;
+    for (auto* rec : snapshot) try_complete_flush(rec, fires);
+  }
+
+  void try_complete_flush(FlushRec* rec, FireList& fires) {
+    if (rec->completed) return;
+    bool pending = false, dead = false;
+    for (auto& [cid, seq] : rec->waits) {
+      auto it = conns.find(cid);
+      if (it == conns.end()) continue;
+      Conn* c = it->second;
+      if (c->flush_acked < seq) {
+        if (!c->alive) dead = true;
+        else pending = true;
+      }
+    }
+    if (dead) {
+      rec->completed = true;
+      remove_flush(rec);
+      auto fail = rec->fail; auto ctx = rec->ctx;
+      if (fail) fires.push_back([fail, ctx] { fail(ctx, "Endpoint is not connected (peer reset during flush)"); });
+      delete rec;
+    } else if (!pending) {
+      rec->completed = true;
+      remove_flush(rec);
+      auto done = rec->done; auto ctx = rec->ctx;
+      if (done) fires.push_back([done, ctx] { done(ctx); });
+      delete rec;
+    }
+  }
+
+  void remove_flush(FlushRec* rec) {
+    for (auto it = flushes.begin(); it != flushes.end(); ++it)
+      if (*it == rec) {
+        flushes.erase(it);
+        return;
+      }
+  }
+
+  // --------------------------------------------------------- conn death
+  void conn_broken(Conn* c, FireList& fires) {
+    if (!c->alive) return;
+    c->alive = false;
+    ep_del(c->fd);
+    for (auto& item : c->tx) {
+      if (item.is_data && !item.local_done && item.fail) {
+        auto fail = item.fail; auto ctx = item.ctx;
+        fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
+      }
+    }
+    c->tx.clear();
+    if (c->rx_msg) {
+      std::lock_guard<std::mutex> g(mu);
+      matcher.purge_inflight(c->rx_msg);
+      c->rx_msg = nullptr;
+    }
+    close(c->fd);
+    c->fd = -1;
+    bool was_half_open = half_open.erase(c) > 0;
+    auto snapshot = flushes;
+    for (auto* rec : snapshot) try_complete_flush(rec, fires);
+    if (was_half_open) delete c;  // never reached conns registry
+  }
+
+  void conn_close_local(Conn* c, FireList& fires) {
+    if (!c->alive) return;
+    bool abort = c->has_unfinished_data();
+    for (auto& item : c->tx) {
+      if (item.is_data && !item.local_done && item.fail) {
+        auto fail = item.fail; auto ctx = item.ctx;
+        fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
+      }
+    }
+    c->tx.clear();
+    c->alive = false;
+    ep_del(c->fd);
+    if (abort) {
+      // RST: a partially-written message must not look deliverable.
+      struct linger lg { 1, 0 };
+      setsockopt(c->fd, SOL_SOCKET, SO_LINGER, &lg, sizeof(lg));
+    }
+    close(c->fd);
+    c->fd = -1;
+  }
+
+  // -------------------------------------------------------------- hello
+  void on_hello(Conn* c, const std::string& body, FireList& fires) {
+    c->peer_name = json_field(body, "worker_id");
+    std::string mode = json_field(body, "mode");
+    if (!mode.empty()) c->mode = mode;
+    if (c->mode == "address") {
+      c->local_addr.clear();
+      c->remote_addr.clear();
+      c->local_port = c->remote_port = 0;
+    }
+    c->handshaken = true;
+    half_open.erase(c);
+    {
+      std::lock_guard<std::mutex> g(mu);
+      conns[c->id] = c;
+    }
+    std::string ack = std::string("{\"worker_id\": \"") + worker_id + "\"}";
+    conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires);
+    if (accept_cb) {
+      auto cb = accept_cb; auto ctx = accept_ctx; uint64_t id = c->id;
+      fires.push_back([cb, ctx, id] { cb(ctx, id); });
+    }
+  }
+
+  // --------------------------------------------------------------- main
+  void drain_ops(FireList& fires) {
+    for (;;) {
+      Op op;
+      {
+        std::lock_guard<std::mutex> g(mu);
+        if (ops.empty() || status.load() != ST_RUNNING) return;
+        op = ops.front();
+        ops.pop_front();
+      }
+      if (op.kind == Op::SEND) {
+        Conn* c = nullptr;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          auto it = conns.find(op.conn_id);
+          if (it != conns.end()) c = it->second;
+        }
+        if (!c || !c->alive) {
+          auto fail = op.fail; auto ctx = op.ctx;
+          if (fail) fires.push_back([fail, ctx] { fail(ctx, kNotConnected); });
+        } else {
+          conn_send_data(c, op, fires);
+        }
+      } else {
+        start_flush(op, fires);
+      }
+    }
+  }
+
+  void do_close(FireList& fires) {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      while (!ops.empty()) {
+        Op& op = ops.front();
+        auto fail = op.fail; auto ctx = op.ctx;
+        if (fail) fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
+        ops.pop_front();
+      }
+      matcher.cancel_all(fires);
+    }
+    for (auto* rec : flushes) {
+      if (!rec->completed && rec->fail) {
+        auto fail = rec->fail; auto ctx = rec->ctx;
+        fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
+      }
+      delete rec;
+    }
+    flushes.clear();
+    for (auto& [id, c] : conns) conn_close_local(c, fires);
+    for (auto* c : half_open) {
+      c->alive = false;
+      ep_del(c->fd);
+      close(c->fd);
+      c->fd = -1;
+      delete c;
+    }
+    half_open.clear();
+    if (listen_fd >= 0) {
+      close(listen_fd);
+      listen_fd = -1;
+    }
+    status.store(ST_CLOSED);
+    if (close_done) {
+      auto done = close_done; auto ctx = close_ctx;
+      fires.push_back([done, ctx] { done(ctx); });
+      close_done = nullptr;
+    }
+  }
+
+  virtual bool setup(FireList& fires) = 0;
+
+  void run() {
+    engine_tid = std::this_thread::get_id();
+    {
+      FireList fires;
+      bool ok = setup(fires);
+      for (auto& f : fires) f();
+      if (!ok) {
+        cleanup_fds();
+        unref();
+        return;
+      }
+    }
+    epoll_event events[64];
+    for (;;) {
+      if (status.load() == ST_CLOSING) break;
+      int n = epoll_wait(epfd, events, 64, -1);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        break;
+      }
+      FireList fires;
+      for (int i = 0; i < n; i++) {
+        void* ptr = events[i].data.ptr;
+        if (ptr == &evfd) {
+          uint64_t buf;
+          while (read(evfd, &buf, 8) == 8) {
+          }
+        } else if (ptr == &listen_fd) {
+          accept_loop(fires);
+        } else {
+          Conn* c = (Conn*)ptr;
+          if (events[i].events & EPOLLOUT) kick_tx(c, fires);
+          if ((events[i].events & (EPOLLIN | EPOLLHUP | EPOLLERR)) && c->alive)
+            conn_readable(c, fires);
+        }
+      }
+      drain_ops(fires);
+      for (auto& f : fires) f();
+    }
+    FireList fires;
+    do_close(fires);
+    for (auto& f : fires) f();
+    cleanup_fds();
+    unref();
+  }
+
+  void accept_loop(FireList& fires) {
+    for (;;) {
+      sockaddr_in addr{};
+      socklen_t alen = sizeof(addr);
+      int fd = accept4(listen_fd, (sockaddr*)&addr, &alen, SOCK_NONBLOCK);
+      if (fd < 0) return;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new Conn();
+      c->fd = fd;
+      {
+        std::lock_guard<std::mutex> g(mu);
+        c->id = next_conn_id++;
+      }
+      char buf[64];
+      inet_ntop(AF_INET, &addr.sin_addr, buf, sizeof(buf));
+      c->remote_addr = buf;
+      c->remote_port = ntohs(addr.sin_port);
+      sockaddr_in local{};
+      socklen_t llen = sizeof(local);
+      if (getsockname(fd, (sockaddr*)&local, &llen) == 0) {
+        inet_ntop(AF_INET, &local.sin_addr, buf, sizeof(buf));
+        c->local_addr = buf;
+        c->local_port = ntohs(local.sin_port);
+      }
+      half_open.insert(c);
+      ep_add(fd, EPOLLIN, c);
+    }
+  }
+
+  void cleanup_fds() {
+    if (epfd >= 0) {
+      close(epfd);
+      epfd = -1;
+    }
+    if (evfd >= 0) {
+      close(evfd);
+      evfd = -1;
+    }
+  }
+};
+
+struct ServerWorker : Worker {
+  ServerWorker() { is_server = true; }
+  bool setup(FireList&) override {
+    ep_add(evfd, EPOLLIN, &evfd);
+    ep_add(listen_fd, EPOLLIN, &listen_fd);
+    return true;
+  }
+};
+
+struct ClientWorker : Worker {
+  bool setup(FireList& fires) override {
+    ep_add(evfd, EPOLLIN, &evfd);
+    // Nonblocking connect with 3s timeout.
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    auto fail_connect = [&](const std::string& why) {
+      if (fd >= 0) close(fd);
+      status.store(ST_CLOSED);
+      if (c_status_cb) {
+        auto cb = c_status_cb; auto ctx = c_status_ctx;
+        std::string msg = std::string(kNotConnected) + ": " + why;
+        fires.push_back([cb, ctx, msg] { cb(ctx, msg.c_str()); });
+      }
+      return false;
+    };
+    if (fd < 0) return fail_connect("socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)c_port);
+    if (inet_pton(AF_INET, c_host.c_str(), &addr.sin_addr) != 1)
+      return fail_connect("bad address " + c_host);
+    int rc = ::connect(fd, (sockaddr*)&addr, sizeof(addr));
+    if (rc < 0 && errno != EINPROGRESS) return fail_connect(strerror(errno));
+    pollfd pfd{fd, POLLOUT, 0};
+    if (poll(&pfd, 1, 3000) <= 0) return fail_connect("connect timeout");
+    int err = 0;
+    socklen_t elen = sizeof(err);
+    getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+    if (err != 0) return fail_connect(strerror(err));
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // HELLO / HELLO_ACK handshake (blocking with poll deadlines).
+    std::string hello = std::string("{\"worker_id\": \"") + worker_id +
+                        "\", \"mode\": \"" + c_mode + "\", \"name\": \"\"}";
+    std::vector<uint8_t> frame(HEADER_SIZE + hello.size());
+    pack_header(frame.data(), T_HELLO, 0, hello.size());
+    memcpy(frame.data() + HEADER_SIZE, hello.data(), hello.size());
+    size_t off = 0;
+    while (off < frame.size()) {
+      ssize_t w = ::send(fd, frame.data() + off, frame.size() - off, MSG_NOSIGNAL);
+      if (w < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          pollfd p2{fd, POLLOUT, 0};
+          if (poll(&p2, 1, 3000) <= 0) return fail_connect("handshake send timeout");
+          continue;
+        }
+        return fail_connect("handshake send failed");
+      }
+      off += (size_t)w;
+    }
+    auto read_exact = [&](uint8_t* out, size_t n) -> bool {
+      size_t got = 0;
+      while (got < n) {
+        ssize_t r = ::recv(fd, out + got, n - got, 0);
+        if (r > 0) {
+          got += (size_t)r;
+          continue;
+        }
+        if (r == 0) return false;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          pollfd p2{fd, POLLIN, 0};
+          if (poll(&p2, 1, 3000) <= 0) return false;
+          continue;
+        }
+        return false;
+      }
+      return true;
+    };
+    uint8_t hdr[HEADER_SIZE];
+    if (!read_exact(hdr, HEADER_SIZE)) return fail_connect("handshake read failed");
+    uint8_t type;
+    uint64_t a, b;
+    unpack_header(hdr, &type, &a, &b);
+    if (type != T_HELLO_ACK || b > 4096) return fail_connect("bad handshake frame");
+    std::vector<uint8_t> body(b);
+    if (b && !read_exact(body.data(), b)) return fail_connect("handshake body read failed");
+    auto* c = new Conn();
+    c->fd = fd;
+    c->handshaken = true;
+    c->mode = c_mode;
+    c->peer_name = json_field(std::string((char*)body.data(), body.size()), "worker_id");
+    sockaddr_in local{};
+    socklen_t llen = sizeof(local);
+    char buf[64];
+    if (getsockname(fd, (sockaddr*)&local, &llen) == 0) {
+      inet_ntop(AF_INET, &local.sin_addr, buf, sizeof(buf));
+      c->local_addr = buf;
+      c->local_port = ntohs(local.sin_port);
+    }
+    c->remote_addr = c_host;
+    c->remote_port = c_port;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      c->id = next_conn_id++;
+      conns[c->id] = c;
+      primary_conn = c->id;
+    }
+    ep_add(fd, EPOLLIN, c);
+    int expect = ST_INIT;
+    status.compare_exchange_strong(expect, ST_RUNNING);
+    if (c_status_cb) {
+      auto cb = c_status_cb; auto ctx = c_status_ctx;
+      fires.push_back([cb, ctx] { cb(ctx, ""); });
+    }
+    return true;
+  }
+};
+
+int worker_start(Worker* w) {
+  w->epfd = epoll_create1(EPOLL_CLOEXEC);
+  w->evfd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+  if (w->epfd < 0 || w->evfd < 0) return -1;
+  w->refs.fetch_add(1);  // engine thread reference
+  std::thread([w] { w->run(); }).detach();
+  return 0;
+}
+
+}  // namespace
+
+// ------------------------------------------------------------- C surface
+
+extern "C" {
+
+const char* sw_version() { return "starway-native-1"; }
+
+// ----- client
+
+void* sw_client_new(const char* worker_id) {
+  auto* w = new ClientWorker();
+  w->worker_id = worker_id ? worker_id : "";
+  return w;
+}
+
+int sw_client_connect(void* h, const char* host, int port, const char* mode,
+                      sw_status_cb cb, void* ctx) {
+  auto* w = (ClientWorker*)h;
+  int expect = ST_VOID;
+  if (!w->status.compare_exchange_strong(expect, ST_INIT)) return -1;
+  w->c_host = host;
+  w->c_port = port;
+  w->c_mode = mode ? mode : "socket";
+  w->c_status_cb = cb;
+  w->c_status_ctx = ctx;
+  return worker_start(w);
+}
+
+// ----- server
+
+void* sw_server_new(const char* worker_id) {
+  auto* w = new ServerWorker();
+  w->worker_id = worker_id ? worker_id : "";
+  return w;
+}
+
+int sw_server_set_accept_cb(void* h, sw_accept_cb cb, void* ctx) {
+  auto* w = (ServerWorker*)h;
+  w->accept_cb = cb;
+  w->accept_ctx = ctx;
+  return 0;
+}
+
+// Returns the bound port (>0) or -errno.
+int sw_server_listen(void* h, const char* addr, int port) {
+  auto* w = (ServerWorker*)h;
+  int expect = ST_VOID;
+  if (!w->status.compare_exchange_strong(expect, ST_INIT)) return -EALREADY;
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, addr, &sa.sin_addr) != 1) {
+    close(fd);
+    return -EINVAL;
+  }
+  if (bind(fd, (sockaddr*)&sa, sizeof(sa)) < 0 || listen(fd, 512) < 0) {
+    int e = errno;
+    close(fd);
+    w->status.store(ST_VOID);
+    return -e;
+  }
+  socklen_t slen = sizeof(sa);
+  getsockname(fd, (sockaddr*)&sa, &slen);
+  w->listen_fd = fd;
+  w->status.store(ST_RUNNING);
+  if (worker_start(w) != 0) return -EIO;
+  return ntohs(sa.sin_port);
+}
+
+// ----- shared worker ops (h = client or server)
+
+static Worker* W(void* h) { return (Worker*)h; }
+
+int sw_send(void* h, uint64_t conn_id, const void* buf, uint64_t len, uint64_t tag,
+            sw_done_cb done, sw_fail_cb fail, void* ctx) {
+  Worker* w = W(h);
+  {
+    std::lock_guard<std::mutex> g(w->mu);
+    if (w->status.load() != ST_RUNNING) return -1;
+    Op op;
+    op.kind = Op::SEND;
+    op.conn_id = conn_id ? conn_id : w->primary_conn;
+    op.buf = (const uint8_t*)buf;
+    op.len = len;
+    op.tag = tag;
+    op.done = done;
+    op.fail = fail;
+    op.ctx = ctx;
+    w->ops.push_back(op);
+  }
+  w->wake();
+  return 0;
+}
+
+int sw_recv(void* h, void* buf, uint64_t cap, uint64_t tag, uint64_t mask,
+            sw_recv_cb done, sw_fail_cb fail, void* ctx) {
+  Worker* w = W(h);
+  FireList fires;
+  {
+    std::lock_guard<std::mutex> g(w->mu);
+    if (w->status.load() != ST_RUNNING) return -1;
+    PostedRecv pr;
+    pr.buf = (uint8_t*)buf;
+    pr.cap = cap;
+    pr.tag = tag;
+    pr.mask = mask;
+    pr.done = done;
+    pr.fail = fail;
+    pr.ctx = ctx;
+    w->matcher.post_recv(pr, fires);
+  }
+  for (auto& f : fires) f();
+  return 0;
+}
+
+int sw_flush(void* h, uint64_t conn_id, int conn_scoped,
+             sw_done_cb done, sw_fail_cb fail, void* ctx) {
+  Worker* w = W(h);
+  {
+    std::lock_guard<std::mutex> g(w->mu);
+    if (w->status.load() != ST_RUNNING) return -1;
+    Op op;
+    op.kind = Op::FLUSH;
+    op.conn_id = conn_id;
+    op.conn_scoped = conn_scoped != 0;
+    op.done = done;
+    op.fail = fail;
+    op.ctx = ctx;
+    w->ops.push_back(op);
+  }
+  w->wake();
+  return 0;
+}
+
+int sw_close(void* h, sw_done_cb done, void* ctx) {
+  Worker* w = W(h);
+  {
+    std::lock_guard<std::mutex> g(w->mu);
+    int st = w->status.load();
+    if (st != ST_RUNNING) return -1;
+    w->close_done = done;
+    w->close_ctx = ctx;
+    w->status.store(ST_CLOSING);
+  }
+  w->wake();
+  return 0;
+}
+
+int sw_status(void* h) { return W(h)->status.load(); }
+
+uint64_t sw_primary_conn(void* h) { return W(h)->primary_conn; }
+
+// List live+dead handshaken conn ids; returns count (may exceed cap).
+int sw_list_conns(void* h, uint64_t* out, int cap) {
+  Worker* w = W(h);
+  std::lock_guard<std::mutex> g(w->mu);
+  int n = 0;
+  for (auto& [id, c] : w->conns) {
+    if (n < cap) out[n] = id;
+    n++;
+  }
+  return n;
+}
+
+// JSON conn info into out (returns body length or -1).
+int sw_conn_info(void* h, uint64_t conn_id, char* out, int cap) {
+  Worker* w = W(h);
+  std::lock_guard<std::mutex> g(w->mu);
+  auto it = w->conns.find(conn_id);
+  if (it == w->conns.end()) return -1;
+  Conn* c = it->second;
+  char buf[512];
+  int n = snprintf(buf, sizeof(buf),
+                   "{\"name\": \"%s\", \"mode\": \"%s\", \"alive\": %d, "
+                   "\"local_addr\": \"%s\", \"local_port\": %d, "
+                   "\"remote_addr\": \"%s\", \"remote_port\": %d}",
+                   c->peer_name.c_str(), c->mode.c_str(), c->alive ? 1 : 0,
+                   c->local_addr.c_str(), c->local_port,
+                   c->remote_addr.c_str(), c->remote_port);
+  if (n < 0 || n >= cap) return -1;
+  memcpy(out, buf, (size_t)n + 1);
+  return n;
+}
+
+// Destructor path: never blocks, never fails.  Signals close if running and
+// drops the Python reference; the engine thread frees the worker when done.
+void sw_free(void* h) {
+  Worker* w = W(h);
+  int st = w->status.load();
+  if (st == ST_RUNNING) {
+    std::lock_guard<std::mutex> g(w->mu);
+    w->close_done = nullptr;
+    w->status.store(ST_CLOSING);
+    w->wake();
+  } else if (st == ST_INIT) {
+    w->status.store(ST_CLOSING);
+    w->wake();
+  }
+  w->unref();
+}
+
+}  // extern "C"

@@ -31,16 +31,9 @@ def check_sys_libs() -> str:
     returns ``"native"`` when the C++ engine extension is loaded and
     ``"python"`` for the pure-Python engine.
     """
-    from . import config
+    from .api import _use_native_engine
 
-    if config.use_native():
-        try:
-            from . import _native  # type: ignore  # noqa: F401
-
-            return "native"
-        except ImportError:
-            pass
-    return "python"
+    return "native" if _use_native_engine() else "python"
 
 
 def list_benchmark_scenarios() -> list[str]:

@@ -24,10 +24,37 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from . import config
 from .core.endpoint import ServerEndpoint
 from .core.engine import ClientWorker, ServerWorker
 
 logger = logging.getLogger("starway_tpu")
+
+
+def _use_native_engine() -> bool:
+    """The C++ engine serves the pure-TCP mode (STARWAY_TLS=tcp); the
+    in-process fast path and device handoff need the Python engine."""
+    if not config.use_native() or config.inproc_enabled():
+        return False
+    from .core import native
+
+    return native.available()
+
+
+def _new_client_worker():
+    if _use_native_engine():
+        from .core.native import NativeClientWorker
+
+        return NativeClientWorker()
+    return ClientWorker()
+
+
+def _new_server_worker():
+    if _use_native_engine():
+        from .core.native import NativeServerWorker
+
+        return NativeServerWorker()
+    return ServerWorker()
 
 _U64_MASK = (1 << 64) - 1
 
@@ -111,7 +138,7 @@ class Server:
     """Accepting side.  Reference: class Server, src/starway/__init__.py:71-209."""
 
     def __init__(self):
-        self._server = ServerWorker()
+        self._server = _new_server_worker()
 
     # --------------------------------------------------------------- listen
     def listen(self, addr: str, port: int) -> None:
@@ -214,7 +241,7 @@ class Client:
     """Connecting side.  Reference: class Client, src/starway/__init__.py:212-348."""
 
     def __init__(self):
-        self._client = ClientWorker()
+        self._client = _new_client_worker()
 
     # -------------------------------------------------------------- connect
     def aconnect(self, addr: str, port: int,

@@ -1,0 +1,444 @@
+"""ctypes bridge to the C++ native engine (native/sw_engine.cpp).
+
+Presents the same worker protocol as the pure-Python engine
+(core/engine.py): ``NativeClientWorker`` / ``NativeServerWorker`` with
+``submit_send`` / ``post_recv`` / ``submit_flush`` / ``close`` / endpoint
+introspection, so the api layer swaps engines transparently.  The native
+engine covers the TCP path (it speaks the same wire protocol as the Python
+engine, so mixed-engine processes interoperate); the in-process fast path
+and device plane stay in Python, which is why native selection requires
+pure-TCP mode (``STARWAY_TLS=tcp`` + ``STARWAY_NATIVE=1``).
+
+Lifetime/GIL notes: callbacks cross from the engine thread through ctypes
+trampolines, which acquire the GIL.  Each pending op holds its Python buffer
+and callbacks in a registry keyed by an integer handle passed through the
+C ``ctx`` pointer, so nothing is garbage-collected mid-flight.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import itertools
+import json
+import threading
+import uuid
+from typing import Optional
+
+from .. import config
+from ..errors import StarwayStateError
+from . import state
+from .engine import logger
+
+_lib = None
+_lib_err: Optional[str] = None
+
+_DONE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+_FAIL_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_char_p)
+_RECV_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64)
+_ACCEPT_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64)
+_STATUS_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_char_p)
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building on first use) the native engine; None if unavailable."""
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    try:
+        from .. import native_build
+
+        path = native_build.ensure_built()
+        lib = ctypes.CDLL(str(path))
+        lib.sw_version.restype = ctypes.c_char_p
+        lib.sw_client_new.restype = ctypes.c_void_p
+        lib.sw_client_new.argtypes = [ctypes.c_char_p]
+        lib.sw_server_new.restype = ctypes.c_void_p
+        lib.sw_server_new.argtypes = [ctypes.c_char_p]
+        lib.sw_client_connect.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            _STATUS_CB, ctypes.c_void_p,
+        ]
+        lib.sw_server_listen.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.sw_server_set_accept_cb.argtypes = [ctypes.c_void_p, _ACCEPT_CB, ctypes.c_void_p]
+        lib.sw_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_uint64, _DONE_CB, _FAIL_CB, ctypes.c_void_p,
+        ]
+        lib.sw_recv.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, _RECV_CB, _FAIL_CB, ctypes.c_void_p,
+        ]
+        lib.sw_flush.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, _DONE_CB, _FAIL_CB,
+            ctypes.c_void_p,
+        ]
+        lib.sw_close.argtypes = [ctypes.c_void_p, _DONE_CB, ctypes.c_void_p]
+        lib.sw_status.argtypes = [ctypes.c_void_p]
+        lib.sw_primary_conn.argtypes = [ctypes.c_void_p]
+        lib.sw_primary_conn.restype = ctypes.c_uint64
+        lib.sw_list_conns.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int
+        ]
+        lib.sw_conn_info.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int
+        ]
+        lib.sw_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    except Exception as e:  # toolchain/build failure => Python engine
+        _lib_err = str(e)
+        logger.debug("starway native engine unavailable: %s", e)
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# ----------------------------------------------------------- op registry
+
+_op_ids = itertools.count(1)
+_ops: dict[int, tuple] = {}
+_ops_lock = threading.Lock()
+
+
+def _register(*payload) -> int:
+    key = next(_op_ids)
+    with _ops_lock:
+        _ops[key] = payload
+    return key
+
+
+def _take(key: int):
+    with _ops_lock:
+        return _ops.pop(key, None)
+
+
+def _peek(key: int):
+    with _ops_lock:
+        return _ops.get(key)
+
+
+@_DONE_CB
+def _on_done(ctx):
+    rec = _take(ctx)
+    if rec and rec[0] is not None:
+        try:
+            rec[0]()
+        except Exception:
+            logger.exception("starway native done callback raised")
+
+
+@_FAIL_CB
+def _on_fail(ctx, reason):
+    rec = _take(ctx)
+    if rec and rec[1] is not None:
+        try:
+            rec[1]((reason or b"").decode())
+        except Exception:
+            logger.exception("starway native fail callback raised")
+
+
+@_RECV_CB
+def _on_recv(ctx, sender_tag, length):
+    rec = _take(ctx)
+    if rec and rec[0] is not None:
+        try:
+            rec[0](int(sender_tag), int(length))
+        except Exception:
+            logger.exception("starway native recv callback raised")
+
+
+@_STATUS_CB
+def _on_status(ctx, status):
+    rec = _take(ctx)
+    if rec and rec[0] is not None:
+        try:
+            rec[0]((status or b"").decode())
+        except Exception:
+            logger.exception("starway native status callback raised")
+
+
+@_ACCEPT_CB
+def _on_accept(ctx, conn_id):
+    rec = _peek(ctx)  # persistent registration: not popped
+    if rec and rec[0] is not None:
+        try:
+            rec[0](int(conn_id))
+        except Exception:
+            logger.exception("starway native accept callback raised")
+
+
+# ------------------------------------------------------------- endpoints
+
+
+class NativeConn:
+    """Lightweight stand-in for the Python engine's conn objects: carries
+    the native conn id plus lazily-fetched metadata."""
+
+    kind = "tcp"
+
+    def __init__(self, worker: "NativeWorkerBase", conn_id: int):
+        self.worker = worker
+        self.conn_id = conn_id
+
+    def _info(self) -> dict:
+        lib = load()
+        buf = ctypes.create_string_buffer(512)
+        n = lib.sw_conn_info(self.worker._h, self.conn_id, buf, 512)
+        if n <= 0:
+            return {}
+        return json.loads(buf.value.decode())
+
+    @property
+    def peer_name(self) -> str:
+        return self._info().get("name", "")
+
+    @property
+    def alive(self) -> bool:
+        return bool(self._info().get("alive", 0))
+
+    @property
+    def mode(self) -> str:
+        return self._info().get("mode", "socket")
+
+    @property
+    def local_addr(self) -> str:
+        return self._info().get("local_addr", "")
+
+    @property
+    def local_port(self) -> int:
+        return int(self._info().get("local_port", 0))
+
+    @property
+    def remote_addr(self) -> str:
+        return self._info().get("remote_addr", "")
+
+    @property
+    def remote_port(self) -> int:
+        return int(self._info().get("remote_port", 0))
+
+    def transports(self) -> list[tuple[str, str]]:
+        dev = "lo" if self.remote_addr.startswith("127.") else "eth0"
+        return [(dev, "tcp+native")]
+
+
+# --------------------------------------------------------------- workers
+
+
+class NativeWorkerBase:
+    kind = "worker"
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native engine unavailable: {_lib_err}")
+        self._lib = lib
+        self.worker_id = uuid.uuid4().hex
+        self._h = None
+        self._address_blob: Optional[bytes] = None
+        self._conn_cache: dict[int, NativeConn] = {}
+
+    @property
+    def status(self) -> int:
+        if self._h is None:
+            return state.VOID
+        return int(self._lib.sw_status(self._h))
+
+    def _require_running(self) -> None:
+        if self.status != state.RUNNING:
+            raise StarwayStateError(
+                f"starway {self.kind} is not in a running state "
+                f"(status={state.NAMES.get(self.status, self.status)})"
+            )
+
+    def _conn(self, conn_id: int) -> NativeConn:
+        c = self._conn_cache.get(conn_id)
+        if c is None:
+            c = self._conn_cache[conn_id] = NativeConn(self, conn_id)
+        return c
+
+    # ------------------------------------------------------------- ops
+    @staticmethod
+    def _mv_pointer(mv: memoryview):
+        """(address, keepalive) for a flat memoryview.  Writable views are
+        zero-copy; readonly payloads (bytes) take one copy."""
+        if len(mv) == 0:
+            return 0, None
+        if not mv.readonly:
+            keep = ctypes.c_char.from_buffer(mv)
+            return ctypes.addressof(keep), keep
+        keep = (ctypes.c_char * len(mv)).from_buffer_copy(mv)
+        return ctypes.addressof(keep), keep
+
+    def submit_send(self, conn, view, tag: int, done, fail, owner=None) -> None:
+        self._require_running()
+        conn_id = conn.conn_id if isinstance(conn, NativeConn) else 0
+        mv = memoryview(view)
+        addr, keep = self._mv_pointer(mv)
+        key = _register(done, fail, mv, owner, keep)
+        rc = self._lib.sw_send(self._h, conn_id, addr, len(mv), tag, _on_done, _on_fail, key)
+        if rc != 0:
+            _take(key)
+            raise StarwayStateError("starway native send rejected (not running)")
+
+    def post_recv(self, buf, tag: int, mask: int, done, fail, owner=None) -> None:
+        self._require_running()
+        if isinstance(buf, memoryview):
+            mv = buf
+        else:
+            mv = buf.host_staging()  # DeviceRecvSink
+            inner_done = done
+
+            def done(st, ln, _sink=buf, _cb=inner_done):
+                _sink.finalize_from_host(ln)
+                _cb(st, ln)
+
+        if mv.readonly:
+            raise TypeError("receive buffer must be writable")
+        addr, keep = self._mv_pointer(mv)
+        key = _register(done, fail, mv, owner, keep)
+        rc = self._lib.sw_recv(self._h, addr, len(mv), tag, mask, _on_recv, _on_fail, key)
+        if rc != 0:
+            _take(key)
+            raise StarwayStateError("starway native recv rejected (not running)")
+
+    def submit_flush(self, done, fail, conns=None) -> None:
+        self._require_running()
+        key = _register(done, fail)
+        if conns:
+            conn_id = conns[0].conn_id if isinstance(conns[0], NativeConn) else 0
+            rc = self._lib.sw_flush(self._h, conn_id, 1, _on_done, _on_fail, key)
+        else:
+            rc = self._lib.sw_flush(self._h, 0, 0, _on_done, _on_fail, key)
+        if rc != 0:
+            _take(key)
+            raise StarwayStateError("starway native flush rejected (not running)")
+
+    def close(self, cb) -> None:
+        self._require_running()
+        key = _register(cb, None)
+        rc = self._lib.sw_close(self._h, _on_done, key)
+        if rc != 0:
+            _take(key)
+            raise StarwayStateError(
+                f"starway {self.kind} is not in a running state (native close rejected)"
+            )
+
+    def force_close(self) -> None:
+        pass  # sw_free in __del__ handles signalling
+
+    def get_worker_address(self) -> bytes:
+        if self._address_blob is None:
+            self._address_blob = json.dumps(
+                {"worker_id": self.worker_id, "host": config.advertised_host(),
+                 "port": 0, "fabric": "starway-tpu"}
+            ).encode()
+        return self._address_blob
+
+    def evaluate_perf(self, conn, msg_size: int) -> float:
+        from .. import perf
+
+        self._require_running()
+        return perf.estimate("tcp", msg_size)
+
+    def __del__(self):
+        try:
+            if self._h is not None:
+                self._lib.sw_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class NativeClientWorker(NativeWorkerBase):
+    kind = "client"
+
+    def __init__(self):
+        super().__init__()
+        self._h = self._lib.sw_client_new(self.worker_id.encode())
+        self._connected = False
+
+    @property
+    def primary_conn(self) -> Optional[NativeConn]:
+        cid = int(self._lib.sw_primary_conn(self._h))
+        return self._conn(cid) if cid else None
+
+    def _do_connect(self, host: str, port: int, mode: str, cb) -> None:
+        if self.status != state.VOID:
+            raise StarwayStateError(
+                "starway client supports a single connect "
+                f"(status={state.NAMES.get(self.status, self.status)})"
+            )
+        key = _register(cb, None)
+        rc = self._lib.sw_client_connect(
+            self._h, host.encode(), port, mode.encode(), _on_status, key
+        )
+        if rc != 0:
+            _take(key)
+            raise StarwayStateError("starway client supports a single connect")
+
+    def connect(self, addr: str, port: int, cb) -> None:
+        self._do_connect(addr, port, "socket", cb)
+
+    def connect_address(self, blob: bytes, cb) -> None:
+        info = json.loads(bytes(blob).decode())
+        self._do_connect(info.get("host", "127.0.0.1"), int(info.get("port", 0)),
+                         "address", cb)
+
+
+class NativeServerWorker(NativeWorkerBase):
+    kind = "server"
+
+    def __init__(self):
+        super().__init__()
+        self._h = self._lib.sw_server_new(self.worker_id.encode())
+        self._accept_key: Optional[int] = None
+        self._eps: dict[int, object] = {}
+        self._eps_lock = threading.Lock()
+        self._user_accept_cb = None
+
+    def set_accept_cb(self, cb) -> None:
+        self._user_accept_cb = cb
+
+    def _on_native_accept(self, conn_id: int) -> None:
+        from .endpoint import ServerEndpoint
+
+        ep = ServerEndpoint(self._conn(conn_id))
+        with self._eps_lock:
+            self._eps[conn_id] = ep
+        if self._user_accept_cb is not None:
+            self._user_accept_cb(ep)
+
+    def _install_accept(self) -> None:
+        self._accept_key = _register(self._on_native_accept, None)
+        self._lib.sw_server_set_accept_cb(self._h, _on_accept, self._accept_key)
+
+    def listen(self, addr: str, port: int) -> None:
+        if self.status != state.VOID:
+            raise StarwayStateError("starway server already listening or closed")
+        self._install_accept()
+        rc = int(self._lib.sw_server_listen(self._h, addr.encode(), port))
+        if rc <= 0:
+            raise OSError(-rc, f"native listen failed on {addr}:{port}")
+        self._address_blob = json.dumps(
+            {"worker_id": self.worker_id,
+             "host": addr if addr not in ("0.0.0.0", "") else config.advertised_host(),
+             "port": rc, "fabric": "starway-tpu"}
+        ).encode()
+
+    def listen_address(self) -> bytes:
+        if self.status != state.VOID:
+            raise StarwayStateError("starway server already listening or closed")
+        self._install_accept()
+        rc = int(self._lib.sw_server_listen(self._h, b"0.0.0.0", 0))
+        if rc <= 0:
+            raise OSError(-rc, "native listen_address failed")
+        self._address_blob = json.dumps(
+            {"worker_id": self.worker_id, "host": config.advertised_host(),
+             "port": rc, "fabric": "starway-tpu"}
+        ).encode()
+        return self._address_blob
+
+    def list_clients(self) -> set:
+        with self._eps_lock:
+            return set(self._eps.values())

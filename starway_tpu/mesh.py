@@ -1,0 +1,110 @@
+"""Mesh addressing and multi-host bootstrap.
+
+The reference's endpoint identity is a UCX worker address (opaque bytes
+moved out-of-band, reference: src/bindings/main.cpp:241-251,834-860).  The
+TPU-native equivalent enriches the worker-address blob with *mesh
+coordinates*: which process, which devices, where in the logical mesh --
+"peers resolve to mesh coordinates rather than IB addresses"
+(BASELINE.json north star).
+
+Two layers:
+
+* :class:`MeshAddress` -- the serialized identity: host contact info plus
+  ``process_index``, device kind/count and optional logical coords.  This is
+  what ``listen_address()`` blobs become when minted through
+  :func:`export_mesh_address`; plain blobs still parse (fields default).
+* :func:`bootstrap_distributed` -- thin gate over ``jax.distributed``: on a
+  real multi-host pod this initialises the DCN-side runtime so cross-host
+  jax.Arrays and collectives work; the P2P layer then uses host TCP for
+  control and the device plane for data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAddress:
+    worker_id: str
+    host: str
+    port: int
+    process_index: int = 0
+    device_kind: str = ""
+    device_count: int = 0
+    coords: Optional[tuple] = None  # logical mesh coords of this worker
+    mesh_shape: Optional[dict] = None  # {"dp": 2, "tp": 4}
+
+    def to_bytes(self) -> bytes:
+        d = dataclasses.asdict(self)
+        d["fabric"] = "starway-tpu"
+        if d["coords"] is not None:
+            d["coords"] = list(d["coords"])
+        return json.dumps(d).encode()
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "MeshAddress":
+        info = json.loads(bytes(blob).decode())
+        coords = info.get("coords")
+        return cls(
+            worker_id=info.get("worker_id", ""),
+            host=info.get("host", "127.0.0.1"),
+            port=int(info.get("port", 0)),
+            process_index=int(info.get("process_index", 0)),
+            device_kind=info.get("device_kind", ""),
+            device_count=int(info.get("device_count", 0)),
+            coords=tuple(coords) if coords is not None else None,
+            mesh_shape=info.get("mesh_shape"),
+        )
+
+
+def export_mesh_address(server, *, coords: Optional[Sequence[int]] = None,
+                        mesh_shape: Optional[dict] = None) -> bytes:
+    """Augment a Server's worker-address blob with local device/mesh info.
+
+    The result still works with ``Client.aconnect_address`` (the extra keys
+    are ignored by the bootstrap path) while letting mesh-aware peers route
+    by coordinates.
+    """
+    base = json.loads(server.get_worker_address().decode())
+    info = dict(base)
+    try:
+        import jax
+
+        devs = jax.devices()
+        info["process_index"] = jax.process_index()
+        info["device_kind"] = devs[0].device_kind if devs else ""
+        info["device_count"] = len(devs)
+    except Exception:
+        info.setdefault("process_index", 0)
+        info.setdefault("device_kind", "")
+        info.setdefault("device_count", 0)
+    if coords is not None:
+        info["coords"] = list(coords)
+    if mesh_shape is not None:
+        info["mesh_shape"] = dict(mesh_shape)
+    return json.dumps(info).encode()
+
+
+def parse_mesh_address(blob: bytes) -> MeshAddress:
+    return MeshAddress.from_bytes(blob)
+
+
+def bootstrap_distributed(coordinator_address: str, num_processes: int,
+                          process_id: int) -> None:
+    """Initialise the cross-host (DCN) jax runtime.
+
+    On a multi-host TPU pod this is the analogue of exchanging UCX worker
+    addresses out-of-band: after it returns, ``jax.devices()`` spans all
+    hosts and mesh collectives ride ICI within a slice / DCN across slices.
+    Safe to call once per process; raises RuntimeError where unsupported.
+    """
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

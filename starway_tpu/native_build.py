@@ -1,0 +1,36 @@
+"""On-demand build of the native engine shared library.
+
+No pip/pybind11 in the image, so the extension is a plain shared object
+compiled with g++ and driven through ctypes.  Built lazily into the package
+directory; rebuilt when the source is newer than the artifact.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from pathlib import Path
+
+_PKG_DIR = Path(__file__).parent
+_SRC = _PKG_DIR.parent / "native" / "sw_engine.cpp"
+_OUT = _PKG_DIR / "_sw_native.so"
+
+
+def ensure_built(force: bool = False) -> Path:
+    """Compile native/sw_engine.cpp -> starway_tpu/_sw_native.so if stale."""
+    if not _SRC.exists():
+        raise FileNotFoundError(f"native source missing: {_SRC}")
+    if not force and _OUT.exists() and _OUT.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _OUT
+    cmd = [
+        "g++", "-std=c++20", "-O2", "-fPIC", "-shared", "-pthread",
+        "-Wall", "-Wextra",
+        str(_SRC), "-o", str(_OUT),
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{proc.stderr[-4000:]}")
+    return _OUT
+
+
+if __name__ == "__main__":
+    print(ensure_built(force=True))

@@ -1,0 +1,5 @@
+"""Utilities: tracing/telemetry helpers."""
+
+from .trace import OpTimer, trace_span, profile_to
+
+__all__ = ["OpTimer", "trace_span", "profile_to"]

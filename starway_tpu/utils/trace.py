@@ -1,0 +1,81 @@
+"""Tracing / telemetry.
+
+The reference's observability is thin by design (SURVEY.md section 5):
+``debug_print`` compiled out in release, ``evaluate_perf`` transport
+estimates, and per-iteration benchmark samples.  The TPU build keeps the
+same shape and adds the two tools that matter on this stack:
+
+* :func:`trace_span` / :func:`profile_to` -- ``jax.profiler`` integration:
+  annotate host-side phases so they show up alongside device traces in
+  Perfetto/TensorBoard.
+* :class:`OpTimer` -- a tiny host-side span recorder for the comm runtime
+  (p50/p95/mean summaries, the same metric vocabulary as the bench suite).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import statistics
+import time
+from collections import defaultdict
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def trace_span(name: str) -> Iterator[None]:
+    """Wall-clock span that also annotates the jax profiler timeline when a
+    trace is active (no-op overhead otherwise)."""
+    try:
+        import jax.profiler as _prof
+
+        ctx = _prof.TraceAnnotation(name)
+    except Exception:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+@contextlib.contextmanager
+def profile_to(log_dir: str) -> Iterator[None]:
+    """Capture a jax profiler trace (device + annotated host spans) into
+    ``log_dir`` for TensorBoard / Perfetto."""
+    import jax.profiler as _prof
+
+    _prof.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        _prof.stop_trace()
+
+
+class OpTimer:
+    """Accumulates named durations; summarises like the bench metrics."""
+
+    def __init__(self) -> None:
+        self._samples: dict[str, list[float]] = defaultdict(list)
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._samples[name].append(time.perf_counter() - t0)
+
+    def record(self, name: str, seconds: float) -> None:
+        self._samples[name].append(seconds)
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        out: dict[str, dict[str, float]] = {}
+        for name, xs in self._samples.items():
+            if not xs:
+                continue
+            s = sorted(xs)
+            out[name] = {
+                "count": float(len(s)),
+                "mean_us": statistics.fmean(s) * 1e6,
+                "p50_us": s[len(s) // 2] * 1e6,
+                "p95_us": s[min(len(s) - 1, int(len(s) * 0.95))] * 1e6,
+                "total_s": sum(s),
+            }
+        return out

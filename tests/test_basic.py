@@ -35,10 +35,20 @@ def port():
     return random.randint(10000, 50000)
 
 
-@pytest.fixture(params=["inproc", "tcp"])
+@pytest.fixture(params=["inproc", "tcp", "native"])
 def transport(request, monkeypatch):
+    """Three data planes behind one contract: in-process fast path, Python
+    TCP engine, C++ native TCP engine (parity-tested by the same suite)."""
     if request.param == "tcp":
         monkeypatch.setenv("STARWAY_TLS", "tcp")
+        monkeypatch.setenv("STARWAY_NATIVE", "0")
+    elif request.param == "native":
+        from starway_tpu.core import native
+
+        if not native.available():
+            pytest.skip("native engine unavailable (no toolchain)")
+        monkeypatch.setenv("STARWAY_TLS", "tcp")
+        monkeypatch.setenv("STARWAY_NATIVE", "1")
     return request.param
 
 
