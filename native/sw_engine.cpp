@@ -265,9 +265,23 @@ struct TxItem {
   sw_done_cb done = nullptr;
   sw_fail_cb fail = nullptr;
   void* ctx = nullptr;
+  // Fired exactly once when the engine is finished with `payload` (fully
+  // written OR cancelled): the buffer-keepalive signal.  Rendezvous sends
+  // complete `done` at header-write while the payload keeps streaming, so
+  // `done` must NOT be the release point.
+  sw_done_cb release = nullptr;
+  void* release_ctx = nullptr;
 
   uint64_t total() const { return header.size() + paylen; }
 };
+
+void fire_release(TxItem& item, FireList& fires) {
+  if (item.is_data && item.release) {
+    auto rel = item.release; auto rctx = item.release_ctx;
+    item.release = nullptr;
+    fires.push_back([rel, rctx] { rel(rctx); });
+  }
+}
 
 struct Conn {
   uint64_t id = 0;
@@ -319,6 +333,8 @@ struct Op {
   sw_recv_cb rdone = nullptr;
   sw_fail_cb fail = nullptr;
   void* ctx = nullptr;
+  sw_done_cb release = nullptr;
+  void* release_ctx = nullptr;
 };
 
 // --------------------------------------------------------------- worker
@@ -385,10 +401,18 @@ struct Worker {
   void ep_del(int fd) { epoll_ctl(epfd, EPOLL_CTL_DEL, fd, nullptr); }
 
   // -------------------------------------------------------------- sends
+  static void fire_op_release(const Op& op, FireList& fires) {
+    if (op.release) {
+      auto rel = op.release; auto rctx = op.release_ctx;
+      fires.push_back([rel, rctx] { rel(rctx); });
+    }
+  }
+
   void conn_send_data(Conn* c, const Op& op, FireList& fires) {
     if (!c->alive) {
       auto fail = op.fail; auto ctx = op.ctx;
       if (fail) fires.push_back([fail, ctx] { fail(ctx, "Endpoint is not connected (connection reset)"); });
+      fire_op_release(op, fires);
       return;
     }
     c->dirty = true;
@@ -403,6 +427,8 @@ struct Worker {
     item.done = op.done;
     item.fail = op.fail;
     item.ctx = op.ctx;
+    item.release = op.release;
+    item.release_ctx = op.release_ctx;
     c->tx.push_back(std::move(item));
     kick_tx(c, fires);
   }
@@ -469,6 +495,7 @@ struct Worker {
           fires.push_back([done, ctx] { done(ctx); });
         }
       }
+      fire_release(item, fires);
       c->tx.pop_front();
     }
     if (c->want_write) {
@@ -676,6 +703,7 @@ struct Worker {
         auto fail = item.fail; auto ctx = item.ctx;
         fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
       }
+      fire_release(item, fires);
     }
     c->tx.clear();
     if (c->rx_msg) {
@@ -699,6 +727,7 @@ struct Worker {
         auto fail = item.fail; auto ctx = item.ctx;
         fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
       }
+      fire_release(item, fires);
     }
     c->tx.clear();
     c->alive = false;
@@ -756,6 +785,7 @@ struct Worker {
         if (!c || !c->alive) {
           auto fail = op.fail; auto ctx = op.ctx;
           if (fail) fires.push_back([fail, ctx] { fail(ctx, kNotConnected); });
+          fire_op_release(op, fires);
         } else {
           conn_send_data(c, op, fires);
         }
@@ -772,6 +802,7 @@ struct Worker {
         Op& op = ops.front();
         auto fail = op.fail; auto ctx = op.ctx;
         if (fail) fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
+        fire_op_release(op, fires);
         ops.pop_front();
       }
       matcher.cancel_all(fires);
@@ -1100,7 +1131,8 @@ int sw_server_listen(void* h, const char* addr, int port) {
 static Worker* W(void* h) { return (Worker*)h; }
 
 int sw_send(void* h, uint64_t conn_id, const void* buf, uint64_t len, uint64_t tag,
-            sw_done_cb done, sw_fail_cb fail, void* ctx) {
+            sw_done_cb done, sw_fail_cb fail, void* ctx,
+            sw_done_cb release, void* release_ctx) {
   Worker* w = W(h);
   {
     std::lock_guard<std::mutex> g(w->mu);
@@ -1114,6 +1146,8 @@ int sw_send(void* h, uint64_t conn_id, const void* buf, uint64_t len, uint64_t t
     op.done = done;
     op.fail = fail;
     op.ctx = ctx;
+    op.release = release;
+    op.release_ctx = release_ctx;
     w->ops.push_back(op);
   }
   w->wake();

@@ -22,6 +22,7 @@ import itertools
 import json
 import threading
 import uuid
+import weakref
 from typing import Optional
 
 from .. import config
@@ -63,6 +64,7 @@ def load() -> Optional[ctypes.CDLL]:
         lib.sw_send.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
             ctypes.c_uint64, _DONE_CB, _FAIL_CB, ctypes.c_void_p,
+            _DONE_CB, ctypes.c_void_p,
         ]
         lib.sw_recv.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
@@ -146,6 +148,15 @@ def _on_recv(ctx, sender_tag, length):
             rec[0](int(sender_tag), int(length))
         except Exception:
             logger.exception("starway native recv callback raised")
+
+
+@_DONE_CB
+def _on_release(ctx):
+    # Buffer-keepalive release: the engine is finished with the payload
+    # (fully written or cancelled).  Fired separately from the op's done
+    # callback because rendezvous sends complete locally at header-write
+    # while the payload keeps streaming.
+    _take(ctx)
 
 
 @_STATUS_CB
@@ -275,10 +286,16 @@ class NativeWorkerBase:
         conn_id = conn.conn_id if isinstance(conn, NativeConn) else 0
         mv = memoryview(view)
         addr, keep = self._mv_pointer(mv)
-        key = _register(done, fail, mv, owner, keep)
-        rc = self._lib.sw_send(self._h, conn_id, addr, len(mv), tag, _on_done, _on_fail, key)
+        key = _register(done, fail)
+        # The payload must outlive the op past local completion (rndv sends
+        # stream after `done` fires); the engine's release callback is the
+        # only thing allowed to drop this reference.
+        rel_key = _register(None, None, mv, owner, keep)
+        rc = self._lib.sw_send(self._h, conn_id, addr, len(mv), tag,
+                               _on_done, _on_fail, key, _on_release, rel_key)
         if rc != 0:
             _take(key)
+            _take(rel_key)
             raise StarwayStateError("starway native send rejected (not running)")
 
     def post_recv(self, buf, tag: int, mask: int, done, fail, owner=None) -> None:
@@ -410,8 +427,40 @@ class NativeServerWorker(NativeWorkerBase):
             self._user_accept_cb(ep)
 
     def _install_accept(self) -> None:
-        self._accept_key = _register(self._on_native_accept, None)
+        # Weakref dispatch: the persistent registry entry must not keep the
+        # worker alive (it would never be GC'd and sw_free never called).
+        wself = weakref.ref(self)
+
+        def dispatch(conn_id: int) -> None:
+            s = wself()
+            if s is not None:
+                s._on_native_accept(conn_id)
+
+        self._accept_key = _register(dispatch, None)
         self._lib.sw_server_set_accept_cb(self._h, _on_accept, self._accept_key)
+
+    def _drop_accept(self) -> None:
+        if self._accept_key is not None:
+            _take(self._accept_key)
+            self._accept_key = None
+
+    def close(self, cb) -> None:
+        def cb_and_cleanup():
+            self._drop_accept()
+            if cb is not None:
+                cb()
+
+        super().close(cb_and_cleanup)
+
+    def __del__(self):
+        try:
+            self._drop_accept()
+        except Exception:
+            pass
+        try:
+            super().__del__()
+        except Exception:
+            pass
 
     def listen(self, addr: str, port: int) -> None:
         if self.status != state.VOID:

@@ -16,19 +16,30 @@ _OUT = _PKG_DIR / "_sw_native.so"
 
 
 def ensure_built(force: bool = False) -> Path:
-    """Compile native/sw_engine.cpp -> starway_tpu/_sw_native.so if stale."""
+    """Compile native/sw_engine.cpp -> starway_tpu/_sw_native.so if stale.
+
+    Builds to a per-process temp path and atomically renames into place, so
+    concurrent ranks/test workers never load a half-written artifact.
+    """
+    import os
+
     if not _SRC.exists():
         raise FileNotFoundError(f"native source missing: {_SRC}")
     if not force and _OUT.exists() and _OUT.stat().st_mtime >= _SRC.stat().st_mtime:
         return _OUT
+    tmp = _OUT.with_suffix(f".tmp.{os.getpid()}.so")
     cmd = [
         "g++", "-std=c++20", "-O2", "-fPIC", "-shared", "-pthread",
         "-Wall", "-Wextra",
-        str(_SRC), "-o", str(_OUT),
+        str(_SRC), "-o", str(tmp),
     ]
-    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
-    if proc.returncode != 0:
-        raise RuntimeError(f"native build failed:\n{proc.stderr[-4000:]}")
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            raise RuntimeError(f"native build failed:\n{proc.stderr[-4000:]}")
+        os.replace(tmp, _OUT)
+    finally:
+        tmp.unlink(missing_ok=True)
     return _OUT
 
 
