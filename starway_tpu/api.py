@@ -109,8 +109,13 @@ def _tag(tag: int) -> int:
 
 
 def _future_pair(loop: Optional[asyncio.AbstractEventLoop], result_factory=None):
-    """Build (future, done_cb, fail_cb) bridging engine-thread completions to
-    asyncio, tolerant of the loop having shut down underneath us."""
+    """Build (future, done_cb, fail_cb) bridging completions to asyncio.
+
+    Completions from engine threads hop via ``call_soon_threadsafe``
+    (reference: src/starway/__init__.py:124-128).  Completions fired on the
+    loop thread itself (the in-process inline fast path) resolve directly --
+    no self-pipe write, no extra scheduler pass.
+    """
     if loop is None:
         loop = asyncio.get_running_loop()
     fut: asyncio.Future = asyncio.Future(loop=loop)
@@ -120,6 +125,13 @@ def _future_pair(loop: Optional[asyncio.AbstractEventLoop], result_factory=None)
             if not fut.done():
                 call(*args)
 
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            apply()
+            return
         try:
             loop.call_soon_threadsafe(apply)
         except RuntimeError:

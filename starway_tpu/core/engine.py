@@ -79,6 +79,11 @@ class Worker:
         self.name = name or self.worker_id[:8]
         self.matcher = TagMatcher()
         self.ops: deque = deque()
+        # Ops queued or currently executing on the engine thread.  When zero,
+        # in-process sends/flushes may run inline on the caller thread (no
+        # thread hop) without breaking FIFO ordering: submissions are
+        # serialized by the caller, and nothing is concurrently draining.
+        self._busy = 0
         self.conns: dict = {}  # conn_id -> conn
         self.flush_records: list[FlushRec] = []
         self.close_cb: Optional[Callable[[], None]] = None
@@ -106,15 +111,41 @@ class Worker:
         _run_fires(fires)
 
     def submit_send(self, conn, view, tag: int, done, fail, owner=None) -> None:
+        inline = False
         with self.lock:
             self._require_running()
-            self.ops.append(("send", conn, view, tag, done, fail, owner))
+            if self._busy == 0 and conn is not None and conn.kind == "inproc" and conn.alive:
+                inline = True
+            else:
+                self._busy += 1
+                self.ops.append(("send", conn, view, tag, done, fail, owner))
+        if inline:
+            fires: list = []
+            conn.send_data(tag, view, done, fail, owner, fires)
+            _run_fires(fires)
+            return
         self._wake()
 
     def submit_flush(self, done, fail, conns=None) -> None:
+        inline = False
         with self.lock:
             self._require_running()
-            self.ops.append(("flush", done, fail, conns))
+            targets = conns if conns is not None else list(self.conns.values())
+            # Inline only when the engine owns no TCP state at all: flush
+            # bookkeeping (flush_records) is engine-thread territory
+            # otherwise (TCP acks mutate it concurrently).
+            if self._busy == 0 and all(c.kind == "inproc" for c in self.conns.values()):
+                inline = True
+            else:
+                self._busy += 1
+                self.ops.append(("flush", done, fail, conns))
+        if inline:
+            # All in-process traffic already delivered synchronously in
+            # submission order: the barrier is trivially met.
+            fires = []
+            self._start_flush(done, fail, targets, fires)
+            _run_fires(fires)
+            return
         self._wake()
 
     def close(self, cb) -> None:
@@ -217,7 +248,11 @@ class Worker:
                     return
                 op = self.ops.popleft()
             fires: list = []
-            self._process_op(op, fires)
+            try:
+                self._process_op(op, fires)
+            finally:
+                with self.lock:
+                    self._busy -= 1
             _run_fires(fires)
 
     def _process_op(self, op, fires) -> None:

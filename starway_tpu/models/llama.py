@@ -159,6 +159,12 @@ def apply_rope(x, cos, sin):
 
 
 def default_attn(q, k, v):
+    """Causal attention: the hand-tiled pallas kernel on TPU, the lax
+    blockwise scan elsewhere (bit-compatible algebra, same GQA handling)."""
+    if jax.default_backend() == "tpu":
+        from ..ops.pallas_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True, interpret=False)
     return blockwise_attention(q, k, v, causal=True)
 
 

@@ -1,0 +1,152 @@
+"""Pallas TPU flash-attention forward kernel.
+
+The hand-scheduled counterpart of ops/attention.py's lax implementation:
+same online-softmax algebra, but tiled explicitly onto VMEM with f32
+accumulator scratch that persists across the (sequential, innermost) kv-block
+grid dimension, bf16 inputs feeding the MXU, and causal blocks that are
+entirely masked skipped outright.
+
+Layouts: ``q [B, Hq, S, D]``, ``k/v [B, Hkv, S, D]`` (grouped kv accepted
+directly -- the kernel indexes the right kv head per q head, no repeat_kv
+materialisation).  Use :func:`flash_attention`; it lowers to the kernel on
+TPU and to interpret mode elsewhere (tests run it on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_BIG = -0.9e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  causal: bool, sm_scale: float, block_q: int, block_k: int,
+                  kv_len: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _body():
+        q = q_ref[0]  # [block_q, D]
+        k = k_ref[0]  # [block_k, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [block_q, block_k]
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = k_pos < kv_len
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_BIG)
+
+        # Row stats live in (block_q, 128) lanes (TPU tile granularity);
+        # column 0 is authoritative.
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(s > NEG_BIG / 2, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # Live iff the block's first key position can be visible to the
+        # block's last query position.
+        pl.when(k_start <= q_start + block_q - 1)(_body)
+    else:
+        _body()
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Flash attention forward.  q: [B,Hq,S,D]; k/v: [B,Hkv,S,D] (grouped).
+
+    Pads S to the block size internally; padded keys are masked, padded
+    query rows are sliced off the output.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    n_rep = hq // hkv
+    kv_len = k.shape[2]
+
+    block_q = min(block_q, _round_up(s, 8))
+    block_k = min(block_k, _round_up(kv_len, 8))
+    s_pad = _round_up(s, block_q)
+    kv_pad = _round_up(kv_len, block_k)
+    if s_pad != s:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, s_pad - s), (0, 0)))
+    if kv_pad != kv_len:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, kv_pad - kv_len), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, kv_pad - kv_len), (0, 0)))
+
+    qf = q.reshape(b * hq, s_pad, d)
+    kf = k.reshape(b * hkv, kv_pad, d)
+    vf = v.reshape(b * hkv, kv_pad, d)
+
+    def kv_head(bh):  # q-head flat index -> kv-head flat index
+        return (bh // hq) * hkv + (bh % hq) // n_rep
+
+    grid = (b * hq, s_pad // block_q, kv_pad // block_k)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, kv_len=kv_len,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (kv_head(bh), j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (kv_head(bh), j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * hq, s_pad, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, hq, s_pad, d)[:, :, :s, :]
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m

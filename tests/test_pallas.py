@@ -1,0 +1,35 @@
+"""Pallas flash-attention kernel vs the lax oracle (interpret mode on CPU;
+the same kernel lowers through Mosaic on TPU -- validated on hardware via
+the bench path)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from starway_tpu.ops.attention import attention_reference, repeat_kv
+from starway_tpu.ops.pallas_attention import flash_attention
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("seq", [128, 200])  # 200 exercises padding
+def test_flash_matches_reference(causal, seq):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    B, Hq, Hkv, D = 2, 4, 2, 32
+    q = jax.random.normal(k1, (B, Hq, seq, D), jnp.float32)
+    k = jax.random.normal(k2, (B, Hkv, seq, D), jnp.float32)
+    v = jax.random.normal(k3, (B, Hkv, seq, D), jnp.float32)
+    ref = attention_reference(q, repeat_kv(k, 2), repeat_kv(v, 2), causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5)
+
+
+def test_flash_no_gqa():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(k1, (1, 2, 64, 16), jnp.float32)
+    k = jax.random.normal(k2, (1, 2, 64, 16), jnp.float32)
+    v = jax.random.normal(k3, (1, 2, 64, 16), jnp.float32)
+    ref = attention_reference(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5)
