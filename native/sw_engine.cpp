@@ -53,6 +53,24 @@ typedef void (*sw_accept_cb)(void* ctx, uint64_t conn_id);
 typedef void (*sw_status_cb)(void* ctx, const char* status);  // "" = ok
 }
 
+// Debug/fatal print macros: debug output compiled out under NDEBUG (release
+// builds are silent); fatal always reaches stderr.  Mirrors the reference's
+// macro pair (src/bindings/main.cpp debug_print/fatal_print).
+#ifdef NDEBUG
+#define SW_DEBUG(...) ((void)0)
+#else
+#define SW_DEBUG(...)                        \
+  do {                                       \
+    fprintf(stderr, "[sw-engine] " __VA_ARGS__); \
+    fputc('\n', stderr);                     \
+  } while (0)
+#endif
+#define SW_FATAL(...)                        \
+  do {                                       \
+    fprintf(stderr, "[sw-engine FATAL] " __VA_ARGS__); \
+    fputc('\n', stderr);                     \
+  } while (0)
+
 namespace {
 
 constexpr uint8_t T_HELLO = 1;
