@@ -1,0 +1,24 @@
+"""Drop-in compatibility alias: ``import starway`` -> starway-tpu.
+
+A user of the reference library can switch to this framework without
+touching imports: the public surface (reference: src/starway/__init__.py:
+351-358) re-exports from :mod:`starway_tpu`.
+"""
+
+from starway_tpu import (  # noqa: F401
+    Client,
+    DeviceBuffer,
+    Server,
+    ServerEndpoint,
+    check_sys_libs,
+    list_benchmark_scenarios,
+)
+
+__all__ = [
+    "Server",
+    "Client",
+    "ServerEndpoint",
+    "DeviceBuffer",
+    "check_sys_libs",
+    "list_benchmark_scenarios",
+]

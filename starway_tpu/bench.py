@@ -66,6 +66,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--connect-mode", choices=("socket", "worker"), default="socket")
     p.add_argument("--worker-address", help="Hex-encoded worker address blob for connect-mode=worker.")
     p.add_argument("--tls", help="Transport list written to STARWAY_TLS (e.g. 'tcp' or 'inproc,tcp').")
+    p.add_argument(
+        "--payload", choices=("host", "device"),
+        help="Buffer kind for large-array/streaming-duplex: host numpy (default) or jax.Array device buffers.",
+    )
     p.add_argument("--scenarios", nargs="*", help="Scenarios to run (default: all). Options: " + ", ".join(list_scenarios()))
     p.add_argument("--large-bytes", type=parse_size)
     p.add_argument("--large-iterations", type=int)
@@ -113,6 +117,8 @@ def scenario_plan(args: argparse.Namespace) -> list[tuple[str, dict[str, Any]]]:
             val = getattr(args, arg_name, None)
             if val is not None:
                 overrides[cfg_key] = val
+        if getattr(args, "payload", None) and name in ("large-array", "streaming-duplex"):
+            overrides["payload"] = args.payload
         plan.append((name, overrides))
     return plan
 

@@ -63,6 +63,23 @@ def _pct(values_us: np.ndarray, q: float) -> float:
     return float(np.percentile(values_us, q)) if len(values_us) else 0.0
 
 
+def _make_payload(size: int, fill: int, kind: str):
+    """Host numpy buffer or a device jax.Array (the TPU-native path)."""
+    if kind == "device":
+        import jax.numpy as jnp
+
+        return jnp.full((size,), fill % 256, dtype=jnp.uint8)
+    return np.full(size, fill % 256, dtype=np.uint8)
+
+
+def _make_sink(size: int, kind: str):
+    if kind == "device":
+        from ..device import DeviceBuffer
+
+        return DeviceBuffer((size,), np.uint8)
+    return np.empty(size, dtype=np.uint8)
+
+
 class Scenario:
     """Base: a named scenario with defaults; subclasses implement the client
     (measuring) and server (echo/sink) coroutines."""
@@ -86,12 +103,12 @@ class Scenario:
 class LargeArray(Scenario):
     name = "large-array"
     description = "Measure one-way bandwidth by transferring a single large buffer."
-    defaults = {"message_bytes": 1 << 30, "warmup": 1, "iterations": 3}
+    defaults = {"message_bytes": 1 << 30, "warmup": 1, "iterations": 3, "payload": "host"}
 
     async def run_client(self, ctx, overrides) -> ScenarioResult:
         cfg = self.config(overrides)
         size, warmup, iters = int(cfg["message_bytes"]), int(cfg["warmup"]), int(cfg["iterations"])
-        payload = np.full(size, 0x5A, dtype=np.uint8)
+        payload = _make_payload(size, 0x5A, cfg.get("payload", "host"))
         secs: list[float] = []
         gbps: list[float] = []
         for i in range(warmup + iters):
@@ -120,7 +137,7 @@ class LargeArray(Scenario):
     async def run_server(self, ctx, overrides) -> None:
         cfg = self.config(overrides)
         size, total = int(cfg["message_bytes"]), int(cfg["warmup"]) + int(cfg["iterations"])
-        sink = np.empty(size, dtype=np.uint8)
+        sink = _make_sink(size, cfg.get("payload", "host"))
         await ctx.signal_ready()
         for _ in range(total):
             await ctx.server.arecv(sink, LARGE_DATA_TAG, ctx.tag_mask)
@@ -226,14 +243,14 @@ class PingpongFlag(Scenario):
 class StreamingDuplex(Scenario):
     name = "streaming-duplex"
     description = "Bidirectional medium-sized streaming in both directions."
-    defaults = {"message_bytes": 4 * 1024 * 1024, "warmup": 8, "iterations": 64}
+    defaults = {"message_bytes": 4 * 1024 * 1024, "warmup": 8, "iterations": 64, "payload": "host"}
 
     async def run_client(self, ctx, overrides) -> ScenarioResult:
         cfg = self.config(overrides)
         size = int(cfg["message_bytes"])
         warmup, iters = int(cfg["warmup"]), int(cfg["iterations"])
-        up = np.full(size, 0x7B, dtype=np.uint8)
-        down = np.empty(size, dtype=np.uint8)
+        up = _make_payload(size, 0x7B, cfg.get("payload", "host"))
+        down = _make_sink(size, cfg.get("payload", "host"))
         secs: list[float] = []
         for i in range(warmup + iters):
             down_fut = ctx.client.arecv(down, STREAM_DOWN_TAG, ctx.tag_mask)
@@ -263,8 +280,8 @@ class StreamingDuplex(Scenario):
         cfg = self.config(overrides)
         size = int(cfg["message_bytes"])
         total = int(cfg["warmup"]) + int(cfg["iterations"])
-        down = np.full(size, 0x3C, dtype=np.uint8)
-        up = np.empty(size, dtype=np.uint8)
+        down = _make_payload(size, 0x3C, cfg.get("payload", "host"))
+        up = _make_sink(size, cfg.get("payload", "host"))
         await ctx.signal_ready()
         for _ in range(total):
             await asyncio.gather(

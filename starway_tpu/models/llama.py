@@ -45,6 +45,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     remat: bool = False
+    # Mixture-of-experts FFN (0 = dense SwiGLU).  Experts shard over the
+    # mesh "ep" axis (models/moe.py).
+    n_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -87,19 +92,27 @@ def init_params(key, cfg: LlamaConfig) -> dict:
 
     L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
     Hq, Hkv = cfg.n_heads, cfg.n_kv_heads
+    layers = {
+        "wq": norm(keys[1], (L, D, Hq * hd), D**-0.5),
+        "wk": norm(keys[2], (L, D, Hkv * hd), D**-0.5),
+        "wv": norm(keys[3], (L, D, Hkv * hd), D**-0.5),
+        "wo": norm(keys[4], (L, Hq * hd, D), (Hq * hd) ** -0.5),
+        "attn_norm": jnp.ones((L, D), dt),
+        "mlp_norm": jnp.ones((L, D), dt),
+    }
+    if cfg.n_experts > 0:
+        from .moe import init_moe_params
+
+        layers["moe"] = init_moe_params(jax.random.fold_in(key, 17), L, cfg.n_experts, D, F, dt)
+    else:
+        layers.update(
+            w_gate=norm(keys[5], (L, D, F), D**-0.5),
+            w_up=norm(keys[6], (L, D, F), D**-0.5),
+            w_down=norm(keys[7], (L, F, D), F**-0.5),
+        )
     return {
         "embed": norm(keys[0], (cfg.vocab_size, D), 0.02),
-        "layers": {
-            "wq": norm(keys[1], (L, D, Hq * hd), D**-0.5),
-            "wk": norm(keys[2], (L, D, Hkv * hd), D**-0.5),
-            "wv": norm(keys[3], (L, D, Hkv * hd), D**-0.5),
-            "wo": norm(keys[4], (L, Hq * hd, D), (Hq * hd) ** -0.5),
-            "w_gate": norm(keys[5], (L, D, F), D**-0.5),
-            "w_up": norm(keys[6], (L, D, F), D**-0.5),
-            "w_down": norm(keys[7], (L, F, D), F**-0.5),
-            "attn_norm": jnp.ones((L, D), dt),
-            "mlp_norm": jnp.ones((L, D), dt),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((D,), dt),
         "lm_head": norm(keys[8], (D, cfg.vocab_size), D**-0.5),
     }
@@ -112,19 +125,27 @@ def param_specs(cfg: LlamaConfig) -> dict:
     over the tp-sharded dim, so XLA inserts the reduce-scatter/all-reduce
     pattern over ICI automatically.  Embedding/lm_head shard the vocab dim.
     """
+    layers = {
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.n_experts > 0:
+        from .moe import moe_specs
+
+        layers["moe"] = moe_specs()
+    else:
+        layers.update(
+            w_gate=P(None, None, "tp"),
+            w_up=P(None, None, "tp"),
+            w_down=P(None, "tp", None),
+        )
     return {
         "embed": P("tp", None),
-        "layers": {
-            "wq": P(None, None, "tp"),
-            "wk": P(None, None, "tp"),
-            "wv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
-            "w_gate": P(None, None, "tp"),
-            "w_up": P(None, None, "tp"),
-            "w_down": P(None, "tp", None),
-            "attn_norm": P(None, None),
-            "mlp_norm": P(None, None),
-        },
+        "layers": layers,
         "final_norm": P(None),
         "lm_head": P(None, "tp"),
     }
@@ -172,7 +193,7 @@ def default_attn(q, k, v):
 
 
 def forward(params: dict, tokens, cfg: LlamaConfig,
-            attn_fn: Optional[Callable] = None):
+            attn_fn: Optional[Callable] = None, *, return_aux: bool = False):
     """Next-token logits ``[B, S, V]`` for token ids ``[B, S]``.
 
     ``attn_fn(q, k, v) -> out`` takes q ``[B, Hq, S, Dh]`` and *grouped*
@@ -188,7 +209,8 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
 
     h = params["embed"][tokens]  # [B, S, D]
 
-    def layer(h, lp):
+    def layer(carry, lp):
+        h, aux = carry
         x = rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
         q = (x @ lp["wq"]).reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
         k = (x @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
@@ -202,14 +224,27 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
         h = h + o @ lp["wo"]
 
         x = rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-        h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
-        return h, None
+        if cfg.n_experts > 0:
+            from .moe import switch_moe
+
+            y, layer_aux = switch_moe(
+                x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"],
+                capacity_factor=cfg.moe_capacity_factor,
+            )
+            h = h + y
+            aux = aux + layer_aux
+        else:
+            gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+            h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
+        return (h, aux), None
 
     body = jax.checkpoint(layer) if cfg.remat else layer
-    h, _ = lax.scan(body, h, params["layers"])
+    (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)), params["layers"])
     h = rmsnorm(h, params["final_norm"], cfg.norm_eps)
-    return (h @ params["lm_head"]).astype(jnp.float32)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    if return_aux:
+        return logits, aux
+    return logits
 
 
 def loss_fn(params: dict, batch, cfg: LlamaConfig,
@@ -217,10 +252,13 @@ def loss_fn(params: dict, batch, cfg: LlamaConfig,
     """Causal LM loss: batch ``[B, S+1]`` token ids -> mean next-token
     cross-entropy."""
     tokens, targets = batch[:, :-1], batch[:, 1:]
-    logits = forward(params, tokens, cfg, attn_fn)
+    logits, aux = forward(params, tokens, cfg, attn_fn, return_aux=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    loss = -jnp.mean(ll)
+    if cfg.n_experts > 0:
+        loss = loss + cfg.moe_aux_coef * aux / cfg.n_layers
+    return loss
 
 
 def make_train_step(cfg: LlamaConfig, tx, attn_fn: Optional[Callable] = None):

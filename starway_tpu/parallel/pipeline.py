@@ -1,0 +1,97 @@
+"""Pipeline parallelism: GPipe-style microbatch flow over a mesh axis.
+
+The reference's nearest analogue is the streaming-duplex scenario
+("simulate ... model parallelism, gradient + activation exchange",
+benchmark.md:91-99).  Here the pattern is a real SPMD pipeline: each device
+on the ``pp`` axis owns one stage's parameters; microbatches enter at stage
+0, activations hop stage-to-stage with ``ppermute`` over ICI, and the last
+stage emits outputs.  The schedule is the classic skewed loop: with S
+stages and M microbatches the pipeline runs ``M + S - 1`` ticks, every
+device computing on every tick once the pipe is full (bubble fraction
+``(S-1)/(M+S-1)``).
+
+This is the forward building block; paired with ``jax.vjp`` it extends to
+1F1B-style training schedules.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .sharding import shard_map_fn
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, microbatches, axis_name: str):
+    """Per-device body (call inside shard_map).
+
+    ``stage_params``: this device's stage parameters (leading pp dim already
+    sharded away by shard_map).  ``microbatches``: [M, mb, ...] -- the full
+    microbatch stream (replicated; only stage 0 reads it).  Returns
+    [M, mb, ...] outputs (valid on the last stage; other stages return
+    zeros, letting the caller psum/gather as needed).
+    """
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    ticks = m + n - 1
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 injects microbatch t (while available); others take the
+        # activation handed over from the previous stage.
+        inject = microbatches[jnp.minimum(t, m - 1)]
+        x = jnp.where(stage == 0, inject, state)
+        y = stage_fn(stage_params, x)
+        # Hand activations down the pipe: stage i -> stage i+1.
+        state_next = lax.ppermute(
+            y, axis_name, [(i, (i + 1) % n) for i in range(n)]
+        )
+        # Last stage emits: its output for tick t corresponds to microbatch
+        # t - (n - 1).
+        out_idx = t - (n - 1)
+        emit = (stage == n - 1) & (out_idx >= 0)
+        outputs = lax.cond(
+            emit,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.maximum(out_idx, 0), axis=0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        return (state_next, outputs), None
+
+    init_state = jnp.zeros(mb_shape, microbatches.dtype)
+    init_out = jnp.zeros((m,) + mb_shape, microbatches.dtype)
+    (_, outputs), _ = lax.scan(tick, (init_state, init_out), jnp.arange(ticks))
+    return outputs
+
+
+def make_pipeline(mesh, stage_fn: Callable, axis_name: str = "pp"):
+    """Jitted global-view pipeline.
+
+    ``stage_params`` global view: leading dim = number of stages, sharded
+    over ``axis_name``.  ``microbatches`` replicated in; outputs returned
+    sharded on the pp axis (only the last stage's shard is meaningful --
+    sum over the axis with ``collect=True`` semantics handled by caller) --
+    here we psum so every device returns the full outputs.
+    """
+
+    def local(stage_params, microbatches):
+        out = pipeline_apply(stage_fn, stage_params, microbatches, axis_name)
+        # Only the last stage holds real outputs; share them with everyone.
+        return lax.psum(out, axis_name)
+
+    return jax.jit(
+        shard_map_fn(
+            mesh,
+            local,
+            in_specs=(P(axis_name), P()),
+            out_specs=P(),
+        )
+    )

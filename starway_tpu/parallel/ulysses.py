@@ -1,0 +1,55 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence re-sharding.
+
+The second long-context strategy next to ring attention (SURVEY.md section 5
+names both: "Ulysses = all-to-all composed from P2P").  Where the ring keeps
+queries resident and rotates kv, Ulysses re-shards: an all-to-all over the
+sequence axis converts [heads: full, seq: sharded] into [heads: sharded,
+seq: full], attention runs locally over the whole sequence, and a reverse
+all-to-all restores the layout.  Two collectives total per attention call --
+cheaper than a ring when n_heads >= mesh axis size and sequence length
+dominates; the ring wins for GQA models with few kv heads.
+
+Requires ``n_heads % axis_size == 0`` (and kv heads are pre-expanded when
+grouped, since head shards must align).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import blockwise_attention, repeat_kv
+from .sharding import shard_map_fn
+
+
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True,
+                      sm_scale: Optional[float] = None):
+    """Per-device body (call inside shard_map): q/k/v are sequence shards
+    ``[B, H, T_local, D]`` with the FULL head dimension; returns the local
+    sequence shard of the output."""
+    n = lax.axis_size(axis_name)
+    if k.shape[1] != q.shape[1]:
+        n_rep = q.shape[1] // k.shape[1]
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
+    # [B, H, T/n, D] -> [B, H/n, T, D]: scatter heads, gather sequence.
+    q2 = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    k2 = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    v2 = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    o2 = blockwise_attention(q2, k2, v2, causal=causal, sm_scale=sm_scale)
+    # Restore: [B, H/n, T, D] -> [B, H, T/n, D].
+    return lax.all_to_all(o2, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def make_ulysses_attention(mesh, axis_name: str = "sp", *, causal: bool = True,
+                           sm_scale: Optional[float] = None):
+    """Jitted global-view Ulysses attention over sequence-sharded q/k/v."""
+    spec = P(None, None, axis_name, None)
+
+    def local(q, k, v):
+        return ulysses_attention(q, k, v, axis_name, causal=causal, sm_scale=sm_scale)
+
+    return jax.jit(shard_map_fn(mesh, local, in_specs=(spec, spec, spec), out_specs=spec))

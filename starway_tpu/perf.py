@@ -34,6 +34,32 @@ def estimate(transport: str, msg_size: int) -> float:
     return alpha + max(0, int(msg_size)) / beta
 
 
+async def autocalibrate(client, transport: str = "inproc",
+                        sizes=(1 << 10, 1 << 16, 1 << 20, 1 << 24)) -> tuple[float, float]:
+    """Fit the link model from live round-trips on a connected Client.
+
+    Probes each size with a tagged echo against whatever the peer reflects
+    is not required: it measures one-way enqueue-to-flush time, which tracks
+    the transport's alpha/beta closely enough to rank transports -- the same
+    role ucp_ep_evaluate_perf's model plays in the reference.
+    """
+    import time
+
+    import numpy as np
+
+    samples = []
+    for size in sizes:
+        buf = np.zeros(size, dtype=np.uint8)
+        # warmup
+        await client.asend(buf, 0x7E57)
+        await client.aflush()
+        t0 = time.perf_counter()
+        await client.asend(buf, 0x7E57)
+        await client.aflush()
+        samples.append((size, time.perf_counter() - t0))
+    return calibrate(transport, samples)
+
+
 def calibrate(transport: str, samples: list[tuple[int, float]]) -> tuple[float, float]:
     """Least-squares fit of (alpha, beta) from (bytes, seconds) samples and
     update the model in place.  Returns the fitted (alpha, beta)."""

@@ -1,0 +1,52 @@
+"""Checkpoint round-trip, perf-model calibration, trace timer."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from starway_tpu import perf
+from starway_tpu.utils import OpTimer
+from starway_tpu.utils.checkpoint import restore_pytree, save_pytree
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    tree = {
+        "w": jnp.arange(24, dtype=jnp.float32).reshape(4, 6),
+        "b": jnp.full((6,), 2, dtype=jnp.bfloat16),
+        "nested": {"step": jnp.asarray(7, dtype=jnp.int32)},
+    }
+    backend = save_pytree(str(tmp_path / "ckpt"), tree)
+    assert backend in ("orbax", "npz")
+    restored = restore_pytree(str(tmp_path / "ckpt"), like=tree)
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(restored)):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a, dtype=np.float32),
+                                      np.asarray(b, dtype=np.float32))
+
+
+def test_perf_estimate_positive_and_monotone():
+    for t in ("inproc", "tcp", "ici", "dcn", "unknown"):
+        small = perf.estimate(t, 1)
+        big = perf.estimate(t, 1 << 30)
+        assert 0 < small < big
+
+
+def test_perf_calibrate():
+    # Synthetic samples from a known alpha/beta model round-trip the fit.
+    alpha, beta = 5e-6, 2e9
+    samples = [(n, alpha + n / beta) for n in (1024, 1 << 16, 1 << 20, 1 << 24)]
+    a, b = perf.calibrate("tcp", samples)
+    assert abs(a - alpha) / alpha < 0.05
+    assert abs(b - beta) / beta < 0.05
+    assert abs(perf.estimate("tcp", 1 << 20) - (alpha + (1 << 20) / beta)) < 1e-6
+
+
+def test_op_timer_summary():
+    t = OpTimer()
+    for _ in range(10):
+        with t.span("op"):
+            pass
+    s = t.summary()["op"]
+    assert s["count"] == 10 and s["p50_us"] >= 0
