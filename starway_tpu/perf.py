@@ -36,12 +36,14 @@ def estimate(transport: str, msg_size: int) -> float:
 
 async def autocalibrate(client, transport: str = "inproc",
                         sizes=(1 << 10, 1 << 16, 1 << 20, 1 << 24)) -> tuple[float, float]:
-    """Fit the link model from live round-trips on a connected Client.
+    """Fit the link model from live one-way probes on a connected Client.
 
-    Probes each size with a tagged echo against whatever the peer reflects
-    is not required: it measures one-way enqueue-to-flush time, which tracks
-    the transport's alpha/beta closely enough to rank transports -- the same
-    role ucp_ep_evaluate_perf's model plays in the reference.
+    Measures enqueue-to-flush time per size (tag 0x7E57), which tracks the
+    transport's alpha/beta -- the role ucp_ep_evaluate_perf's model plays in
+    the reference.  NOTE: the peer retains the probe payloads in its
+    unexpected queue; the receiving side should drain tag 0x7E57 (wildcard
+    recvs will also see them), so prefer running this before real traffic
+    or on a dedicated probe connection.
     """
     import time
 
