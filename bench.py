@@ -66,8 +66,12 @@ async def _framework_pingpong(devices) -> list[float]:
         if two_dev
         else np.empty(MSG_BYTES, dtype=np.uint8)
     )
+    # Adapt iteration count to the observed latency (the real-chip tunnel
+    # runs ~100 ms/dispatch; don't spend minutes on warmup).
+    warmup, iters = WARMUP, ITERS
     rtts: list[float] = []
-    for i in range(WARMUP + ITERS):
+    i = 0
+    while i < warmup + iters:
         t0 = time.perf_counter()
         srv_fut = server.arecv(sink, PING, MASK)
         cli_fut = client.arecv(ret, PONG, MASK)
@@ -75,8 +79,12 @@ async def _framework_pingpong(devices) -> list[float]:
         await srv_fut
         await server.asend(ep, sink.array if two_dev else sink, PONG)
         await cli_fut
-        if i >= WARMUP:
-            rtts.append(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        if i == 0 and dt > 0.05:
+            warmup, iters = 2, 10  # tunnel-latency regime
+        if i >= warmup:
+            rtts.append(dt)
+        i += 1
     await client.aclose()
     await server.aclose()
     return rtts
@@ -96,8 +104,10 @@ def _raw_pingpong(devices) -> list[float]:
     else:
         host = np.zeros(MSG_BYTES, dtype=np.uint8)
 
+    warmup, iters = WARMUP, ITERS
     rtts: list[float] = []
-    for i in range(WARMUP + ITERS):
+    i = 0
+    while i < warmup + iters:
         t0 = time.perf_counter()
         if two_dev:
             there = jax.device_put(src, devices[1])
@@ -108,8 +118,12 @@ def _raw_pingpong(devices) -> list[float]:
             dev = jax.device_put(host, devices[0])
             dev.block_until_ready()
             np.asarray(dev)
-        if i >= WARMUP:
-            rtts.append(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        if i == 0 and dt > 0.05:
+            warmup, iters = 2, 10  # tunnel-latency regime
+        if i >= warmup:
+            rtts.append(dt)
+        i += 1
     return rtts
 
 
@@ -131,7 +145,7 @@ def main() -> None:
             {
                 "metric": "1MiB jax.Array pingpong bandwidth via asend/arecv "
                 f"({'device-to-device' if len(devices) >= 2 else 'host-to-device'}, "
-                f"{len(devices)} dev, p50 of {ITERS} iters; "
+                f"{len(devices)} dev, p50 of {len(fw)} iters; "
                 f"raw={raw_gbps:.2f}GB/s p50_rtt={fw_p50 * 1e6:.0f}us)",
                 "value": round(fw_gbps, 3),
                 "unit": "GB/s",

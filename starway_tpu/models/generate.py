@@ -1,0 +1,136 @@
+"""KV-cache inference for the Llama family: prefill + single-token decode.
+
+Static-shape, jit-compiled decode: the cache holds ``max_len`` slots per
+layer and attention masks by position, so one compiled step serves the whole
+generation (``lax.scan`` over steps; no retracing, no dynamic shapes -- the
+XLA-friendly decode loop).
+
+The cache layout is scan-stacked like the parameters: ``k/v
+[n_layers, B, Hkv, max_len, head_dim]``, updated in place with
+``dynamic_update_slice`` (donate the cache under jit for in-place HBM
+updates).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .llama import LlamaConfig, apply_rope, rmsnorm, rope_tables
+from ..ops.attention import NEG_BIG, repeat_kv
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
+    hd = cfg.head_dim
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, hd)
+    return {
+        "k": jnp.zeros(shape, cfg.compute_dtype),
+        "v": jnp.zeros(shape, cfg.compute_dtype),
+    }
+
+
+def _attend_cached(q, k_cache, v_cache, pos, n_rep):
+    """q: [B, Hq, 1, D]; caches: [B, Hkv, T, D]; mask positions > pos."""
+    k = repeat_kv(k_cache, n_rep)
+    v = repeat_kv(v_cache, n_rep)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s / (q.shape[-1] ** 0.5)
+    kv_pos = jnp.arange(k.shape[2])
+    s = jnp.where((kv_pos <= pos)[None, None, None, :], s, NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
+                rope=None):
+    """One token in, next-token logits out.  token: [B] int32; pos: scalar
+    position of ``token``.  Returns (logits [B, V], updated cache)."""
+    B = token.shape[0]
+    hd = cfg.head_dim
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    if rope is None:
+        rope = rope_tables(cache["k"].shape[3], hd, cfg.rope_theta)
+    cos, sin = rope
+    cos_p = lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
+    sin_p = lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
+
+    h = params["embed"][token][:, None, :]  # [B, 1, D]
+
+    def layer(carry, lp_and_cache):
+        h, = carry
+        lp, kc, vc = lp_and_cache
+        x = rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
+        q = (x @ lp["wq"]).reshape(B, 1, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        k = (x @ lp["wk"]).reshape(B, 1, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+        v = (x @ lp["wv"]).reshape(B, 1, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+        q = apply_rope(q, cos_p, sin_p)
+        k = apply_rope(k, cos_p, sin_p)
+        kc = lax.dynamic_update_slice_in_dim(kc, k, pos, axis=2)
+        vc = lax.dynamic_update_slice_in_dim(vc, v, pos, axis=2)
+        o = _attend_cached(q, kc, vc, pos, n_rep)
+        o = o.transpose(0, 2, 1, 3).reshape(B, 1, cfg.n_heads * hd)
+        h = h + o @ lp["wo"]
+
+        x = rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
+        if cfg.n_experts > 0:
+            from .moe import switch_moe
+
+            y, _ = switch_moe(
+                x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"],
+                capacity_factor=cfg.moe_capacity_factor,
+            )
+            h = h + y
+        else:
+            gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+            h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
+        return (h,), (kc, vc)
+
+    (h,), (k_new, v_new) = lax.scan(
+        layer, (h,), (params["layers"], cache["k"], cache["v"])
+    )
+    h = rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    logits = (h[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
+             *, temperature: float = 0.0, key: Optional[jax.Array] = None,
+             max_len: Optional[int] = None):
+    """Autoregressive generation.  prompt: [B, P] int32.  Returns
+    [B, P + max_new_tokens].  temperature=0 -> greedy; otherwise softmax
+    sampling with ``key``."""
+    B, P = prompt.shape
+    total = P + max_new_tokens
+    if max_len is None:
+        max_len = total
+    if temperature > 0 and key is None:
+        key = jax.random.PRNGKey(0)
+    cache = init_cache(cfg, B, max_len)
+    rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
+
+    step = jax.jit(
+        lambda p, c, t, pos: decode_step(p, c, t, pos, cfg, rope),
+        donate_argnums=(1,),
+    )
+
+    # Prefill: run the prompt through the cached decode path one position at
+    # a time (single compiled step; prompt lengths are short in the demos).
+    logits = None
+    for i in range(P):
+        logits, cache = step(params, cache, prompt[:, i], i)
+
+    tokens = [prompt]
+    cur = None
+    for i in range(max_new_tokens):
+        if temperature > 0:
+            key, sub = jax.random.split(key)
+            cur = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            cur = jnp.argmax(logits, axis=-1)
+        cur = cur.astype(jnp.int32)
+        tokens.append(cur[:, None])
+        logits, cache = step(params, cache, cur, P + i)
+    return jnp.concatenate(tokens, axis=1)

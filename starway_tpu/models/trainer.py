@@ -1,0 +1,109 @@
+"""Minimal training harness for the model family.
+
+Wires the pieces the framework already provides into one loop: the jitted
+(optionally sharded) train step, telemetry (utils.OpTimer), checkpointing
+(utils.checkpoint), and -- when a DP-boundary port is supplied -- averaged
+gradient exchange with a peer host over the async P2P fabric
+(parallel/dp_exchange.py; the examples/dp_training_2proc.py pattern as a
+library).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+
+from ..utils import OpTimer
+from .llama import LlamaConfig, loss_fn
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: int = 0
+
+
+class Trainer:
+    def __init__(self, cfg: LlamaConfig, tx, params,
+                 attn_fn: Optional[Callable] = None,
+                 donate: bool = True,
+                 dp_port=None, dp_base_tag: int = 0x6000):
+        """``dp_port``: a ClientPort/ServerPort to a peer rank; when set,
+        gradients are averaged with the peer every step before the update."""
+        import optax  # noqa: F401  (tx is an optax GradientTransformation)
+
+        self.cfg = cfg
+        self.tx = tx
+        self.state = TrainState(params=params, opt_state=tx.init(params))
+        self.timer = OpTimer()
+        self.dp_port = dp_port
+        self.dp_base_tag = dp_base_tag
+        self._grad_fn = jax.jit(
+            lambda p, b: jax.value_and_grad(loss_fn)(p, b, cfg, attn_fn)
+        )
+
+        def apply(params, opt_state, grads):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda x, u: x + u.astype(x.dtype), params, updates
+            )
+            return params, opt_state
+
+        self._apply_fn = jax.jit(apply, donate_argnums=(0, 1) if donate else ())
+
+    def step_sync(self, batch) -> float:
+        """One local step (no DP exchange)."""
+        with self.timer.span("grad"):
+            loss, grads = self._grad_fn(self.state.params, batch)
+        with self.timer.span("apply"):
+            self.state.params, self.state.opt_state = self._apply_fn(
+                self.state.params, self.state.opt_state, grads
+            )
+        self.state.step += 1
+        return float(loss)
+
+    async def step_dp(self, batch) -> float:
+        """One step with averaged gradient exchange across the DP port."""
+        import asyncio
+
+        from ..parallel.dp_exchange import recv_pytree, send_pytree
+
+        with self.timer.span("grad"):
+            loss, grads = self._grad_fn(self.state.params, batch)
+        with self.timer.span("dp_exchange"):
+            base = self.dp_base_tag + (self.state.step % 1024) * 256
+            send_task = asyncio.ensure_future(
+                send_pytree(self.dp_port, grads, base_tag=base)
+            )
+            peer = await recv_pytree(self.dp_port, like=grads, base_tag=base)
+            await send_task
+            grads = jax.tree_util.tree_map(lambda a, b: (a + b) / 2, grads, peer)
+        with self.timer.span("apply"):
+            self.state.params, self.state.opt_state = self._apply_fn(
+                self.state.params, self.state.opt_state, grads
+            )
+        self.state.step += 1
+        return float(loss)
+
+    # ------------------------------------------------------------ ckpt
+    def save(self, path: str) -> str:
+        from ..utils.checkpoint import save_pytree
+
+        return save_pytree(path, {"params": self.state.params,
+                                  "opt_state": self.state.opt_state,
+                                  "step": jax.numpy.asarray(self.state.step)})
+
+    def restore(self, path: str) -> None:
+        from ..utils.checkpoint import restore_pytree
+
+        like = {"params": self.state.params, "opt_state": self.state.opt_state,
+                "step": jax.numpy.asarray(self.state.step)}
+        got = restore_pytree(path, like)
+        self.state = TrainState(params=got["params"], opt_state=got["opt_state"],
+                                step=int(got["step"]))
+
+    def telemetry(self) -> dict:
+        return self.timer.summary()
