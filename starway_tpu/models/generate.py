@@ -106,6 +106,12 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
     total = P + max_new_tokens
     if max_len is None:
         max_len = total
+    elif max_len < total:
+        # Without this, dynamic_update_slice clamps every position >= max_len
+        # onto the last cache slot and generation silently corrupts.
+        raise ValueError(
+            f"max_len={max_len} is smaller than prompt + max_new_tokens={total}"
+        )
     if temperature > 0 and key is None:
         key = jax.random.PRNGKey(0)
     cache = init_cache(cfg, B, max_len)
@@ -132,5 +138,6 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
             cur = jnp.argmax(logits, axis=-1)
         cur = cur.astype(jnp.int32)
         tokens.append(cur[:, None])
-        logits, cache = step(params, cache, cur, P + i)
+        if i + 1 < max_new_tokens:  # the final token needs no further logits
+            logits, cache = step(params, cache, cur, P + i)
     return jnp.concatenate(tokens, axis=1)

@@ -261,16 +261,24 @@ def loss_fn(params: dict, batch, cfg: LlamaConfig,
     return loss
 
 
+def apply_updates(tx, params, opt_state, grads):
+    """Optimizer transform + parameter update, shared by make_train_step and
+    the Trainer's standalone apply step (keeps the two jitted paths
+    identical)."""
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = jax.tree_util.tree_map(
+        lambda p, u: (p + u.astype(p.dtype)), params, updates
+    )
+    return params, opt_state
+
+
 def make_train_step(cfg: LlamaConfig, tx, attn_fn: Optional[Callable] = None):
     """One optimizer step, jit-ready (donate params+opt_state for in-place
     HBM updates)."""
 
     def train_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, attn_fn)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(
-            lambda p, u: (p + u.astype(p.dtype)), params, updates
-        )
+        params, opt_state = apply_updates(tx, params, opt_state, grads)
         return params, opt_state, loss
 
     return train_step

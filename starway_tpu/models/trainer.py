@@ -16,7 +16,7 @@ from typing import Any, Callable, Optional
 import jax
 
 from ..utils import OpTimer
-from .llama import LlamaConfig, loss_fn
+from .llama import LlamaConfig, apply_updates, loss_fn
 
 
 @dataclasses.dataclass
@@ -32,27 +32,36 @@ class Trainer:
                  donate: bool = True,
                  dp_port=None, dp_base_tag: int = 0x6000):
         """``dp_port``: a ClientPort/ServerPort to a peer rank; when set,
-        gradients are averaged with the peer every step before the update."""
-        import optax  # noqa: F401  (tx is an optax GradientTransformation)
+        gradients are averaged with the peer every step before the update.
 
+        ``dp_base_tag``: start of the tag range the exchange occupies.  The
+        rolling window spans ``[dp_base_tag, dp_base_tag + 1024*256)`` —
+        1024 in-flight steps x 256 leaves — so any *other* pytree exchange
+        sharing this worker must use tags outside that 0x40000-wide range.
+        """
         self.cfg = cfg
         self.tx = tx
         self.state = TrainState(params=params, opt_state=tx.init(params))
         self.timer = OpTimer()
         self.dp_port = dp_port
         self.dp_base_tag = dp_base_tag
+        if dp_port is not None:
+            # step_dp gives each step a 256-tag window (base advances by 256
+            # per step); more leaves than that would collide across steps.
+            n_leaves = len(jax.tree_util.tree_leaves(params))
+            if n_leaves >= 256:
+                raise ValueError(
+                    f"DP gradient exchange supports < 256 pytree leaves per "
+                    f"step; got {n_leaves} (stack per-layer params, or widen "
+                    f"the tag window)"
+                )
         self._grad_fn = jax.jit(
             lambda p, b: jax.value_and_grad(loss_fn)(p, b, cfg, attn_fn)
         )
-
-        def apply(params, opt_state, grads):
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = jax.tree_util.tree_map(
-                lambda x, u: x + u.astype(x.dtype), params, updates
-            )
-            return params, opt_state
-
-        self._apply_fn = jax.jit(apply, donate_argnums=(0, 1) if donate else ())
+        self._apply_fn = jax.jit(
+            lambda p, o, g: apply_updates(tx, p, o, g),
+            donate_argnums=(0, 1) if donate else (),
+        )
 
     def step_sync(self, batch) -> float:
         """One local step (no DP exchange)."""

@@ -10,8 +10,8 @@ user of the reference would build by hand from asend/arecv
 Ports unify the two directions of the Client/Server API so the same transfer
 code runs on either side:
 
->>> await send_pytree(ClientPort(client), grads, base_tag=0x9000)
->>> grads2 = await recv_pytree(ServerPort(server), like=grads, base_tag=0x9000)
+>>> await send_pytree(ClientPort(client), grads, base_tag=0x50000)
+>>> grads2 = await recv_pytree(ServerPort(server), like=grads, base_tag=0x50000)
 """
 
 from __future__ import annotations
@@ -47,7 +47,8 @@ class ServerPort:
     Sends are bound to one endpoint; receives are worker-wide tag matches
     (the core contract -- reference recvs post on the worker, not the
     endpoint, src/bindings/main.cpp:1172).  With multiple peers exchanging
-    concurrently, give each peer a disjoint ``base_tag`` range; tags are the
+    concurrently, give each peer a disjoint ``base_tag`` range (note the Trainer's DP
+    exchange occupies ``[dp_base_tag, dp_base_tag + 0x40000)``); tags are the
     routing key, exactly as in the reference's multi-client fan-in pattern
     (tests/test_basic.py:526-554)."""
 

@@ -1,0 +1,78 @@
+"""Both engines must satisfy the typed worker contract.
+
+The reference pinned its binding surface with an unchecked hand-written stub
+(src/starway/_bindings.pyi); here the contract is a runtime-checkable Protocol
+(starway_tpu/core/worker_protocol.py) and this test enforces it for the
+Python engine, the native C++ engine (when built), and the connection object
+each exposes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from starway_tpu.core.engine import ClientWorker, ServerWorker
+from starway_tpu.core.worker_protocol import (
+    ClientWorkerProtocol,
+    ConnectionLike,
+    ServerWorkerProtocol,
+)
+
+
+def test_python_engine_conforms():
+    c = ClientWorker()
+    s = ServerWorker()
+    try:
+        assert isinstance(c, ClientWorkerProtocol)
+        assert isinstance(s, ServerWorkerProtocol)
+    finally:
+        c.force_close()
+        s.force_close()
+
+
+def test_native_engine_conforms():
+    from starway_tpu.core import native
+
+    if not native.available():
+        pytest.skip("native engine not built")
+    from starway_tpu.core.native import NativeClientWorker, NativeServerWorker
+
+    c = NativeClientWorker()
+    s = NativeServerWorker()
+    try:
+        assert isinstance(c, ClientWorkerProtocol)
+        assert isinstance(s, ServerWorkerProtocol)
+    finally:
+        c.force_close()
+        s.force_close()
+
+
+async def test_connection_objects_conform():
+    """The live conn objects behind ServerEndpoint satisfy ConnectionLike."""
+    import asyncio
+
+    from starway_tpu import Client, Server
+
+    server = Server()
+    server.listen("127.0.0.1", 0)
+    client = Client()
+    await client.aconnect_address(server.get_worker_address())
+    for _ in range(200):
+        if server.list_clients():
+            break
+        await asyncio.sleep(0.005)
+    try:
+        ep = server.list_clients().pop()
+        assert isinstance(ep._conn, ConnectionLike)
+        assert isinstance(client._client.primary_conn, ConnectionLike)
+        # and the contract is live: a send/recv pair works through it
+        sink = np.zeros(8, dtype=np.uint8)
+        fut = server.arecv(sink, 0x77, (1 << 64) - 1)
+        await client.asend(np.arange(8, dtype=np.uint8), 0x77)
+        sender_tag, length = await fut
+        assert length == 8
+        np.testing.assert_array_equal(sink, np.arange(8, dtype=np.uint8))
+    finally:
+        await client.aclose()
+        await server.aclose()
