@@ -9,6 +9,11 @@ hardware does without the framework.  ``vs_baseline`` is
 With >= 2 visible devices the pingpong crosses devices (ICI on TPU hardware);
 with one device it is a host<->device round trip (the only real data motion a
 single chip can do).
+
+Framework and raw iterations are interleaved (one of each per loop pass):
+on a 1-core host, allocator and cache state drift enough between separate
+phases to swing either side's p50 by ~30%, so measuring them back-to-back is
+the only way the ratio reflects the framework rather than the phase.
 """
 
 from __future__ import annotations
@@ -28,7 +33,8 @@ MASK = (1 << 64) - 1
 PING, PONG = 0x51, 0x52
 
 
-async def _framework_pingpong(devices) -> list[float]:
+async def _pingpong(devices) -> tuple[list[float], list[float]]:
+    """Interleaved framework/raw pingpong; returns (fw_rtts, raw_rtts)."""
     import numpy as np
 
     from starway_tpu import Client, DeviceBuffer, Server
@@ -53,10 +59,9 @@ async def _framework_pingpong(devices) -> list[float]:
     if two_dev:
         payload = jax.device_put(jnp.zeros(MSG_BYTES, dtype=jnp.uint8), d_src)
         payload.block_until_ready()
-        back = jax.device_put(jnp.zeros(MSG_BYTES, dtype=jnp.uint8), d_dst)
-        back.block_until_ready()
     else:
         payload = np.zeros(MSG_BYTES, dtype=np.uint8)
+        host = np.zeros(MSG_BYTES, dtype=np.uint8)
 
     # Receive targets are reused across iterations, like the reference's
     # scenarios reuse their recv buffers (benchmarks/scenarios.py).
@@ -66,13 +71,8 @@ async def _framework_pingpong(devices) -> list[float]:
         if two_dev
         else np.empty(MSG_BYTES, dtype=np.uint8)
     )
-    # Adapt iteration count to the observed latency (the real-chip tunnel
-    # runs ~100 ms/dispatch; don't spend minutes on warmup).
-    warmup, iters = WARMUP, ITERS
-    rtts: list[float] = []
-    first_two: list[float] = []
-    i = 0
-    while i < warmup + iters:
+
+    async def fw_iter() -> float:
         t0 = time.perf_counter()
         srv_fut = server.arecv(sink, PING, MASK)
         cli_fut = client.arecv(ret, PONG, MASK)
@@ -80,67 +80,53 @@ async def _framework_pingpong(devices) -> list[float]:
         await srv_fut
         await server.asend(ep, sink.array if two_dev else sink, PONG)
         await cli_fut
-        dt = time.perf_counter() - t0
-        # Decide the regime from min of the first two iterations: iteration 0
-        # alone conflates one-time jit/alloc cold-start with link latency.
-        if i < 2:
-            first_two.append(dt)
-            if i == 1 and min(first_two) > 0.05:
-                warmup, iters = 2, 10  # tunnel-latency regime
-        if i >= warmup:
-            rtts.append(dt)
-        i += 1
-    await client.aclose()
-    await server.aclose()
-    return rtts
+        return time.perf_counter() - t0
 
-
-def _raw_pingpong(devices) -> list[float]:
-    """The same data motion without the framework: the raw-link baseline."""
-    import numpy as np
-
-    import jax
-    import jax.numpy as jnp
-
-    two_dev = len(devices) >= 2
-    if two_dev:
-        src = jax.device_put(jnp.zeros(MSG_BYTES, dtype=jnp.uint8), devices[0])
-        src.block_until_ready()
-    else:
-        host = np.zeros(MSG_BYTES, dtype=np.uint8)
-
-    warmup, iters = WARMUP, ITERS
-    rtts: list[float] = []
-    first_two: list[float] = []
-    i = 0
-    while i < warmup + iters:
+    def raw_iter() -> float:
+        """The same data motion without the framework: the raw-link baseline."""
         t0 = time.perf_counter()
         if two_dev:
-            there = jax.device_put(src, devices[1])
+            there = jax.device_put(payload, d_dst)
             there.block_until_ready()
-            back = jax.device_put(there, devices[0])
+            back = jax.device_put(there, d_src)
             back.block_until_ready()
         else:
-            dev = jax.device_put(host, devices[0])
+            dev = jax.device_put(host, d_src)
             dev.block_until_ready()
             np.asarray(dev)
-        dt = time.perf_counter() - t0
+        return time.perf_counter() - t0
+
+    # Adapt iteration count to the observed latency (the real-chip tunnel
+    # runs ~100 ms/dispatch; don't spend minutes on warmup).  Decide from the
+    # min over the first two passes: the first pass alone conflates one-time
+    # jit/alloc cold-start with link latency.
+    warmup, iters = WARMUP, ITERS
+    fw_rtts: list[float] = []
+    raw_rtts: list[float] = []
+    first: list[float] = []
+    i = 0
+    while i < warmup + iters:
+        fw_dt = await fw_iter()
+        raw_dt = raw_iter()
         if i < 2:
-            first_two.append(dt)
-            if i == 1 and min(first_two) > 0.05:
+            first.extend((fw_dt, raw_dt))
+            if i == 1 and min(first) > 0.05:
                 warmup, iters = 2, 10  # tunnel-latency regime
         if i >= warmup:
-            rtts.append(dt)
+            fw_rtts.append(fw_dt)
+            raw_rtts.append(raw_dt)
         i += 1
-    return rtts
+
+    await client.aclose()
+    await server.aclose()
+    return fw_rtts, raw_rtts
 
 
 def main() -> None:
     import jax
 
     devices = jax.devices()
-    fw = asyncio.run(_framework_pingpong(devices))
-    raw = _raw_pingpong(devices)
+    fw, raw = asyncio.run(_pingpong(devices))
 
     fw_p50 = statistics.median(fw)
     raw_p50 = statistics.median(raw)
@@ -153,7 +139,7 @@ def main() -> None:
             {
                 "metric": "1MiB jax.Array pingpong bandwidth via asend/arecv "
                 f"({'device-to-device' if len(devices) >= 2 else 'host-to-device'}, "
-                f"{len(devices)} dev, p50 of {len(fw)} iters; "
+                f"{len(devices)} dev, p50 of {len(fw)} interleaved iters; "
                 f"raw={raw_gbps:.2f}GB/s p50_rtt={fw_p50 * 1e6:.0f}us)",
                 "value": round(fw_gbps, 3),
                 "unit": "GB/s",

@@ -59,10 +59,16 @@ def _new_server_worker():
 _U64_MASK = (1 << 64) - 1
 
 
-def _is_device_payload(buffer) -> bool:
-    from . import device
+_device_mod = None
 
-    return device.is_device_payload(buffer)
+
+def _is_device_payload(buffer) -> bool:
+    global _device_mod
+    if _device_mod is None:
+        from . import device as _device_mod_local
+
+        _device_mod = _device_mod_local
+    return _device_mod.is_device_payload(buffer)
 
 
 def _send_view(buffer):

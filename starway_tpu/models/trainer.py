@@ -49,9 +49,9 @@ class Trainer:
             # step_dp gives each step a 256-tag window (base advances by 256
             # per step); more leaves than that would collide across steps.
             n_leaves = len(jax.tree_util.tree_leaves(params))
-            if n_leaves >= 256:
+            if n_leaves > 256:
                 raise ValueError(
-                    f"DP gradient exchange supports < 256 pytree leaves per "
+                    f"DP gradient exchange supports <= 256 pytree leaves per "
                     f"step; got {n_leaves} (stack per-layer params, or widen "
                     f"the tag window)"
                 )
