@@ -17,6 +17,8 @@
 // (starway_tpu/core/native.py).  Callbacks are invoked from the engine
 // thread with no locks held; the ctypes trampoline re-acquires the GIL.
 
+#include "sw_engine.h"
+
 #include <arpa/inet.h>
 #include <fcntl.h>
 #include <netinet/in.h>
@@ -43,15 +45,8 @@
 #include <unordered_set>
 #include <vector>
 
-// ---------------------------------------------------------------- C ABI
-
-extern "C" {
-typedef void (*sw_done_cb)(void* ctx);
-typedef void (*sw_fail_cb)(void* ctx, const char* reason);
-typedef void (*sw_recv_cb)(void* ctx, uint64_t sender_tag, uint64_t length);
-typedef void (*sw_accept_cb)(void* ctx, uint64_t conn_id);
-typedef void (*sw_status_cb)(void* ctx, const char* status);  // "" = ok
-}
+// C ABI (functions + callback typedefs) is declared in sw_engine.h — the
+// authoritative contract the ctypes bridge mirrors.
 
 // Debug/fatal print macros: debug output compiled out under NDEBUG (release
 // builds are silent); fatal always reaches stderr.  Mirrors the reference's
@@ -1120,7 +1115,11 @@ int sw_server_listen(void* h, const char* addr, int port) {
   int expect = ST_VOID;
   if (!w->status.compare_exchange_strong(expect, ST_INIT)) return -EALREADY;
   int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
-  if (fd < 0) return -errno;
+  if (fd < 0) {
+    int e = errno;
+    w->status.store(ST_VOID);
+    return -e;
+  }
   int one = 1;
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in sa{};
@@ -1128,6 +1127,7 @@ int sw_server_listen(void* h, const char* addr, int port) {
   sa.sin_port = htons((uint16_t)port);
   if (inet_pton(AF_INET, addr, &sa.sin_addr) != 1) {
     close(fd);
+    w->status.store(ST_VOID);
     return -EINVAL;
   }
   if (bind(fd, (sockaddr*)&sa, sizeof(sa)) < 0 || listen(fd, 512) < 0) {

@@ -1,0 +1,153 @@
+/* starway-tpu native engine — public C ABI.
+ *
+ * This header is the contract between the C++ engine (sw_engine.cpp) and the
+ * Python ctypes bridge (starway_tpu/core/native.py).  It plays the role the
+ * reference's hand-written type stub plays for its nanobind module
+ * (reference: src/starway/_bindings.pyi — "the contract the Python layer
+ * codes against"): a single authoritative description of every function,
+ * callback signature, and lifetime rule crossing the language boundary.
+ * The ctypes argtypes/restype declarations in core/native.py:load() must
+ * mirror this file exactly.
+ *
+ * General rules:
+ *  - sw_send/sw_recv/sw_flush/sw_close/sw_free are thread-safe entry points
+ *    that enqueue work for the worker's engine thread and return
+ *    immediately.  sw_status/sw_primary_conn/sw_list_conns/sw_conn_info are
+ *    synchronous thread-safe queries.  sw_server_listen runs
+ *    socket/bind/listen synchronously (returns the bound port);
+ *    sw_server_set_accept_cb and sw_client_connect are setup calls that
+ *    must happen-before listen / are once-only respectively.
+ *  - Callbacks fire on the engine thread with NO engine lock held (the
+ *    FireList discipline, DESIGN.md §2).  The ctypes trampoline re-acquires
+ *    the GIL.  A callback may re-enter any sw_* function.
+ *  - `ctx` values are opaque cookies round-tripped to the callbacks; the
+ *    Python side uses integer keys into a registry that keeps buffers and
+ *    closures alive (core/native.py:_register/_take).
+ *  - Buffers are BORROWED: sw_send/sw_recv capture the raw pointer only.
+ *    The caller must keep the memory alive until the op's release/done/fail
+ *    callback fires (reference semantics: src/bindings/main.hpp:55-59).
+ */
+
+#ifndef STARWAY_TPU_SW_ENGINE_H_
+#define STARWAY_TPU_SW_ENGINE_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ----------------------------------------------------------- callbacks */
+
+/* Op completed successfully (send local-complete, flush barrier reached,
+ * close finished). */
+typedef void (*sw_done_cb)(void* ctx);
+
+/* Op failed; `reason` is a NUL-terminated human-readable string, valid only
+ * for the duration of the call.  Cancellation reasons contain "cancel"
+ * (the reference-pinned contract, tests/test_basic.py shutdown section). */
+typedef void (*sw_fail_cb)(void* ctx, const char* reason);
+
+/* Receive completed: `sender_tag` is the peer's send tag, `length` the
+ * delivered payload size (<= posted capacity). */
+typedef void (*sw_recv_cb)(void* ctx, uint64_t sender_tag, uint64_t length);
+
+/* Server accepted a new handshaken connection. */
+typedef void (*sw_accept_cb)(void* ctx, uint64_t conn_id);
+
+/* Connect outcome: status == "" on success, error text otherwise. */
+typedef void (*sw_status_cb)(void* ctx, const char* status);
+
+/* ----------------------------------------------------------- lifecycle */
+
+/* Engine identification string ("starway-native-1"). */
+const char* sw_version(void);
+
+/* Allocate a client/server worker in the VOID state.  `worker_id` is the
+ * UUID hex advertised in the HELLO handshake.  Returned handle must be
+ * released with sw_free(). */
+void* sw_client_new(const char* worker_id);
+void* sw_server_new(const char* worker_id);
+
+/* Start the client engine thread and connect to host:port ("socket" mode)
+ * or to a peer advertised by a worker-address blob ("address" mode — the
+ * Python layer resolves the blob to host/port first).  Once-only: returns
+ * -1 if the worker ever left VOID.  `cb` fires with "" or an error. */
+int sw_client_connect(void* h, const char* host, int port, const char* mode,
+                      sw_status_cb cb, void* ctx);
+
+/* Install the accept callback (before listen).  Persistent registration:
+ * fires once per accepted connection until close. */
+int sw_server_set_accept_cb(void* h, sw_accept_cb cb, void* ctx);
+
+/* Bind + listen (synchronously) and start the server engine thread.
+ * port 0 = ephemeral.  Returns the bound port (>0) or -errno; any failure
+ * rolls the worker back to VOID so a corrected retry is allowed.  A second
+ * call while listening returns -EALREADY. */
+int sw_server_listen(void* h, const char* addr, int port);
+
+/* ------------------------------------------------------- data-plane ops */
+
+/* Tag-matched send of `len` bytes to `conn_id` (0 = the client's primary
+ * connection).  Local-completion semantics: `done` fires when the payload
+ * is handed to the transport (eager, len <= STARWAY_RNDV_THRESHOLD) or when
+ * transmission has begun (rendezvous); delivery needs sw_flush.  `release`
+ * fires exactly once when the engine is finished with the buffer (fully
+ * written OR cancelled) — the buffer-keepalive signal, distinct from `done`
+ * because rendezvous sends stream on after local completion.
+ * Returns 0, or -1 if the worker is not RUNNING (no callback fires). */
+int sw_send(void* h, uint64_t conn_id, const void* buf, uint64_t len,
+            uint64_t tag, sw_done_cb done, sw_fail_cb fail, void* ctx,
+            sw_done_cb release, void* release_ctx);
+
+/* Post a receive: worker-wide (any connection), matched by
+ * (sender_tag & mask) == (tag & mask); mask 0 = wildcard.  FIFO against
+ * both the posted queue and the unexpected-message queue.  A matching
+ * message larger than `cap` fails the recv ("truncated").
+ * Returns 0, or -1 if not RUNNING. */
+int sw_recv(void* h, void* buf, uint64_t cap, uint64_t tag, uint64_t mask,
+            sw_recv_cb done, sw_fail_cb fail, void* ctx);
+
+/* Delivery barrier: `done` fires when every DATA frame sent so far on the
+ * selected connections has been acknowledged by the peer's engine
+ * (FLUSH/FLUSH_ACK round trip).  conn_scoped != 0 limits the barrier to
+ * `conn_id` (the reference's flush_ep); otherwise all connections.
+ * Fails if a dirty peer died ("peer reset").  Returns 0 or -1. */
+int sw_flush(void* h, uint64_t conn_id, int conn_scoped,
+             sw_done_cb done, sw_fail_cb fail, void* ctx);
+
+/* Graceful close: RUNNING -> CLOSING; the engine thread cancels queued and
+ * in-flight ops (reason contains "cancel"), closes sockets (RST if a data
+ * frame was partially written), fires `done`, and parks in CLOSED.
+ * Returns 0, or -1 if not RUNNING (double close). */
+int sw_close(void* h, sw_done_cb done, void* ctx);
+
+/* ------------------------------------------------------------- queries */
+
+/* Lifecycle status: 0 VOID, 1 INIT, 2 RUNNING, 3 CLOSING, 4 CLOSED
+ * (mirrors the reference's 5-state atomic, src/bindings/main.hpp). */
+int sw_status(void* h);
+
+/* The client's single connection id (0 until connected). */
+uint64_t sw_primary_conn(void* h);
+
+/* Copy up to `cap` handshaken conn ids into `out`; returns the total count
+ * (which may exceed `cap` — call again with a larger buffer). */
+int sw_list_conns(void* h, uint64_t* out, int cap);
+
+/* Write a JSON object {name, mode, alive, local_addr, local_port,
+ * remote_addr, remote_port} for `conn_id` into `out` (NUL-terminated).
+ * Returns the body length, or -1 if unknown/too small. */
+int sw_conn_info(void* h, uint64_t conn_id, char* out, int cap);
+
+/* Destructor path: never blocks, never fails.  Signals close if RUNNING
+ * and drops the caller's reference; the engine thread frees the worker
+ * when it finishes (reference analogue: destructor-without-close must not
+ * hang, tests/test_basic.py implicit-destruction test). */
+void sw_free(void* h);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* STARWAY_TPU_SW_ENGINE_H_ */

@@ -1,5 +1,11 @@
 """ctypes bridge to the C++ native engine (native/sw_engine.cpp).
 
+The C ABI this module mirrors is declared authoritatively in
+``native/sw_engine.h`` — the analogue of the reference's hand-written type
+stub (src/starway/_bindings.pyi), documenting every function, callback
+signature, and buffer-lifetime rule crossing the language boundary.  Keep
+``load()``'s argtypes in lockstep with that header.
+
 Presents the same worker protocol as the pure-Python engine
 (core/engine.py): ``NativeClientWorker`` / ``NativeServerWorker`` with
 ``submit_send`` / ``post_recv`` / ``submit_flush`` / ``close`` / endpoint

@@ -12,6 +12,7 @@ from pathlib import Path
 
 _PKG_DIR = Path(__file__).parent
 _SRC = _PKG_DIR.parent / "native" / "sw_engine.cpp"
+_HDR = _PKG_DIR.parent / "native" / "sw_engine.h"
 _OUT = _PKG_DIR / "_sw_native.so"
 
 
@@ -23,9 +24,11 @@ def ensure_built(force: bool = False) -> Path:
     """
     import os
 
-    if not _SRC.exists():
-        raise FileNotFoundError(f"native source missing: {_SRC}")
-    if not force and _OUT.exists() and _OUT.stat().st_mtime >= _SRC.stat().st_mtime:
+    if not _SRC.exists() or not _HDR.exists():
+        missing = _SRC if not _SRC.exists() else _HDR
+        raise FileNotFoundError(f"native source missing: {missing}")
+    src_mtime = max(_SRC.stat().st_mtime, _HDR.stat().st_mtime)
+    if not force and _OUT.exists() and _OUT.stat().st_mtime >= src_mtime:
         return _OUT
     tmp = _OUT.with_suffix(f".tmp.{os.getpid()}.so")
     cmd = [
