@@ -1059,7 +1059,10 @@ struct ClientWorker : Worker {
 int worker_start(Worker* w) {
   w->epfd = epoll_create1(EPOLL_CLOEXEC);
   w->evfd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
-  if (w->epfd < 0 || w->evfd < 0) return -1;
+  if (w->epfd < 0 || w->evfd < 0) {
+    w->cleanup_fds();
+    return -1;
+  }
   w->refs.fetch_add(1);  // engine thread reference
   std::thread([w] { w->run(); }).detach();
   return 0;
@@ -1140,7 +1143,12 @@ int sw_server_listen(void* h, const char* addr, int port) {
   getsockname(fd, (sockaddr*)&sa, &slen);
   w->listen_fd = fd;
   w->status.store(ST_RUNNING);
-  if (worker_start(w) != 0) return -EIO;
+  if (worker_start(w) != 0) {
+    close(fd);
+    w->listen_fd = -1;
+    w->status.store(ST_VOID);
+    return -EIO;
+  }
   return ntohs(sa.sin_port);
 }
 

@@ -1,0 +1,129 @@
+"""Pallas TPU decode-attention kernel for KV-cache inference.
+
+Single-token decode is HBM-bandwidth bound: the whole KV cache streams
+through the core once per generated token.  The lax path
+(models/generate.py:_attend_cached) materialises ``repeat_kv`` — expanding
+the grouped cache ``n_rep``× before the einsum — so a GQA model reads (and
+first writes) n_rep times more HBM than the cache actually holds.  This
+kernel keeps the cache narrow: the grid walks ``(batch*kv_head, kv_block)``,
+loads each cache block exactly once, and attends all ``n_rep`` query heads
+of the group against it as the rows of one MXU matmul.  Masking and the
+online-softmax accumulation are fused; fully-masked blocks (beyond the
+current position) are skipped via scalar-prefetched ``pos``.
+
+Same online-softmax algebra as ops/pallas_attention.py; layouts follow
+models/generate.py: ``q [B, Hq, 1, D]``, caches ``[B, Hkv, T, D]``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import NEG_BIG
+from .pallas_attention import _round_up
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                   *, sm_scale: float, block_k: int):
+    ki = pl.program_id(1)
+    n_k = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[0]
+    k_start = ki * block_k
+
+    @pl.when(k_start <= pos)
+    def _body():
+        q = q_ref[0]  # [rows, D] — the group's query heads (padded to tile)
+        k = k_ref[0]  # [block_k, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [rows, block_k]
+        kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kv_pos <= pos, s, NEG_BIG)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(s > NEG_BIG / 2, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
+                     block_k: int = 128, interpret=None):
+    """Cached single-query attention without expanding the grouped cache.
+
+    q: [B, Hq, 1, D]; k_cache/v_cache: [B, Hkv, T, D]; pos: scalar int —
+    positions > pos are masked.  Returns [B, Hq, 1, D].  Numerically matches
+    models/generate.py:_attend_cached (softmax in f32).
+    """
+    b, hq, one, d = q.shape
+    assert one == 1, "decode kernel takes a single query position"
+    hkv, t = k_cache.shape[1], k_cache.shape[2]
+    n_rep = hq // hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # Group query heads by their kv head: rows of the per-group matmul.
+    # repeat_kv maps q head h -> kv head h // n_rep, so this reshape groups
+    # correctly (ops/attention.py:repeat_kv).
+    rows = _round_up(max(n_rep, 8), 8)  # TPU sublane tile
+    qg = q.reshape(b, hkv, n_rep, d)
+    if rows != n_rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - n_rep), (0, 0)))
+    qf = qg.reshape(b * hkv, rows, d)
+
+    block_k = min(block_k, _round_up(t, 128))
+    t_pad = _round_up(t, block_k)
+    kf = k_cache.reshape(b * hkv, t, d)
+    vf = v_cache.reshape(b * hkv, t, d)
+    if t_pad != t:
+        kf = jnp.pad(kf, ((0, 0), (0, t_pad - t), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, t_pad - t), (0, 0)))
+
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    grid = (b * hkv, t_pad // block_k)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=sm_scale, block_k=block_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, rows, d), lambda bh, ki, pos_ref: (bh, 0, 0)),
+                pl.BlockSpec((1, block_k, d), lambda bh, ki, pos_ref: (bh, ki, 0)),
+                pl.BlockSpec((1, block_k, d), lambda bh, ki, pos_ref: (bh, ki, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, rows, d), lambda bh, ki, pos_ref: (bh, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * hkv, rows, d), q.dtype),
+        interpret=interpret,
+    )(pos_arr, qf, kf, vf)
+    return out.reshape(b, hkv, rows, d)[:, :, :n_rep, :].reshape(b, hq, 1, d)

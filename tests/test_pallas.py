@@ -33,3 +33,33 @@ def test_flash_no_gqa():
     ref = attention_reference(q, k, v, causal=True)
     out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("pos", [0, 5, 128, 299])
+def test_decode_kernel_matches_lax(pos):
+    from starway_tpu.models.generate import _attend_cached
+    from starway_tpu.ops.pallas_decode import decode_attention
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
+    B, Hq, Hkv, T, D = 2, 8, 2, 300, 64
+    q = jax.random.normal(k1, (B, Hq, 1, D), jnp.float32)
+    k = jax.random.normal(k2, (B, Hkv, T, D), jnp.float32)
+    v = jax.random.normal(k3, (B, Hkv, T, D), jnp.float32)
+    ref = _attend_cached(q, k, v, pos, Hq // Hkv, use_pallas=False)
+    out = decode_attention(q, k, v, pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_decode_kernel_traced_pos_under_jit():
+    from starway_tpu.models.generate import _attend_cached
+    from starway_tpu.ops.pallas_decode import decode_attention
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(3), 3)
+    B, Hq, Hkv, T, D = 1, 4, 4, 130, 32  # no-GQA shape + padding tail
+    q = jax.random.normal(k1, (B, Hq, 1, D), jnp.float32)
+    k = jax.random.normal(k2, (B, Hkv, T, D), jnp.float32)
+    v = jax.random.normal(k3, (B, Hkv, T, D), jnp.float32)
+    step = jax.jit(lambda q, k, v, p: decode_attention(q, k, v, p, interpret=True))
+    ref = _attend_cached(q, k, v, 77, 1, use_pallas=False)
+    out = step(q, k, v, jnp.int32(77))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
