@@ -7,9 +7,16 @@ benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
 ``STARWAY_TLS``
     Comma-separated transport preference list, analogous to ``UCX_TLS``.
     Known transports: ``inproc`` (same-process fast path, what ICI device
-    transfers ride on), ``tcp`` (cross-process / DCN bootstrap path),
-    ``ici`` / ``dcn`` (device-plane selectors used by the device layer).
-    Default: all enabled.
+    transfers ride on), ``sm`` (same-host shared-memory rings negotiated
+    over the TCP handshake, see core/shmring.py -- the analogue of UCX's
+    posix/sysv shm transport), ``tcp`` (cross-process / DCN bootstrap
+    path), ``ici`` / ``dcn`` (device-plane selectors used by the device
+    layer).  Default: all enabled.
+
+``STARWAY_SM_RING``
+    Per-direction shared-memory ring size in bytes (rounded up to a power
+    of two; default 1 MiB -- sized to stay cache-resident, see
+    core/shmring.py).
 
 ``STARWAY_HOST``
     Routable host address advertised in worker-address blobs (default
@@ -47,12 +54,25 @@ def _env(name: str, default: str) -> str:
 
 
 def transports_enabled() -> list[str]:
-    raw = _env("STARWAY_TLS", "inproc,tcp,ici,dcn")
+    raw = _env("STARWAY_TLS", "inproc,sm,tcp,ici,dcn")
     return [t.strip() for t in raw.split(",") if t.strip()]
 
 
 def inproc_enabled() -> bool:
     return "inproc" in transports_enabled()
+
+
+def sm_enabled() -> bool:
+    # The pure-Python ring relies on x86-TSO store ordering for its
+    # data-before-tail publication (core/shmring.py); ARM permits
+    # store-store reordering and Python cannot fence, so the Python engine
+    # neither offers nor accepts sm elsewhere.  (The C++ engine uses real
+    # atomics and carries sm on any architecture.)
+    import platform
+
+    if platform.machine() not in ("x86_64", "AMD64"):
+        return False
+    return "sm" in transports_enabled()
 
 
 def advertised_host() -> str:

@@ -8,7 +8,14 @@ XLA dispatch, not to spin loops):
 
 * :class:`TcpConn` -- framed stream socket (core/frames.py).  This is the
   bootstrap / cross-process / DCN-adjacent path and carries the reference's
-  flush-vs-close delivery semantics (tests/test_basic.py:190-415).
+  flush-vs-close delivery semantics (tests/test_basic.py:190-415).  When
+  both peers share a host and ``STARWAY_TLS`` allows ``sm``, the handshake
+  upgrades the conn to shared-memory rings (core/shmring.py): the same
+  framed byte stream flows through the rings, the socket stays open as the
+  doorbell + liveness channel, and every semantic above is unchanged --
+  the frame parser reads from ``_rx_read`` and cannot tell the transports
+  apart.  This mirrors UCX negotiating posix shm over the same API when
+  ``UCX_TLS`` includes ``sm`` (reference: benchmark.md:114-126).
 * :class:`InprocConn` -- same-process fast path.  Delivery is a single copy
   into the matched receive buffer under the receiver's lock; device-buffer
   (jax.Array) payloads hand over array references and move HBM-to-HBM over
@@ -64,7 +71,7 @@ class TxData:
     def total(self) -> int:
         return len(self.header) + len(self.payload)
 
-    def write(self, sock: socket.socket, fires: list) -> bool:
+    def write(self, conn: "TcpConn", fires: list) -> bool:
         """Write as much as possible.  True when fully written."""
         hlen = len(self.header)
         while self.off < self.total:
@@ -74,7 +81,7 @@ class TxData:
                 p = self.off - hlen
                 chunk = self.payload[p : p + TX_CHUNK]
             try:
-                n = sock.send(chunk)
+                n = conn._tx_write(chunk)
             except BlockingIOError:
                 self._maybe_local_complete(fires)
                 return False
@@ -109,10 +116,10 @@ class TxCtl:
         self.data = data
         self.off = 0
 
-    def write(self, sock: socket.socket, fires: list) -> bool:
+    def write(self, conn: "TcpConn", fires: list) -> bool:
         while self.off < len(self.data):
             try:
-                n = sock.send(memoryview(self.data)[self.off :])
+                n = conn._tx_write(memoryview(self.data)[self.off :])
             except BlockingIOError:
                 return False
             self.off += n
@@ -168,6 +175,15 @@ class TcpConn(BaseConn):
         self._ctl: Optional[tuple[int, bytearray, int]] = None  # (ftype, body, got)
         self._rx_msg: Optional[InboundMsg] = None
         self._scratch: Optional[bytearray] = None
+        # Shared-memory upgrade state (core/shmring.py).  ``sm_active`` =
+        # negotiated; ``_tx_via_ring`` flips once everything queued before
+        # the switch (the HELLO_ACK) has drained to the socket, so stream
+        # bytes never interleave across transports.
+        self._sm = None
+        self.sm_tx = None
+        self.sm_rx = None
+        self.sm_active = False
+        self._tx_via_ring = False
         if mode == "socket":
             try:
                 self.local_addr, self.local_port = sock.getsockname()[:2]
@@ -177,7 +193,59 @@ class TcpConn(BaseConn):
         # In address mode the endpoint reports empty socket fields, mirroring
         # the reference (README.md:141-143).
 
+    # ------------------------------------------------------------------ sm
+    def adopt_sm(self, seg, creator: bool, defer_tx: bool = False) -> None:
+        """Switch this conn's framed stream onto shared-memory rings.
+
+        Called on the connector after HELLO_ACK confirms ``sm: ok`` and on
+        the acceptor before queueing that ACK (``defer_tx=True``: the ACK
+        itself must still go over the socket, so TX moves to the ring only
+        once the tx queue drains -- see kick_tx).  RX moves immediately:
+        the peer writes no stream bytes to the socket past its own switch
+        point.
+        """
+        self._sm = seg
+        self.sm_tx, self.sm_rx = seg.tx_rx(creator)
+        self.sm_active = True
+        seg.unlink()
+        if not defer_tx and not self.tx:
+            self._tx_via_ring = True
+
+    def _doorbell(self, fires: list) -> None:
+        try:
+            self.sock.send(b"\x01")
+        except BlockingIOError:
+            pass  # socket buffer already holds unread doorbells: peer will wake
+        except OSError:
+            self.worker._conn_broken(self, fires)
+
+    def _close_sm(self) -> None:
+        if self._sm is not None:
+            self.worker._sm_blocked_conns.discard(self)
+            seg, self._sm = self._sm, None
+            self.sm_tx = self.sm_rx = None
+            seg.unlink()
+            seg.close()
+
     # ------------------------------------------------------------------ tx
+    def _tx_write(self, chunk) -> int:
+        """Write bytes to the active transport; raises BlockingIOError when
+        it cannot take any (socket buffer / ring full)."""
+        if not self._tx_via_ring:
+            return self.sock.send(chunk)
+        ring = self.sm_tx
+        n = ring.write(chunk)
+        if n == 0:
+            # Two-phase sleep: publish the blocked flag, then re-check.  The
+            # residual store-load race is covered by the engine's short poll
+            # timeout while any producer is blocked (core/shmring.py notes).
+            ring.producer_blocked = 1
+            n = ring.write(chunk)
+            if n == 0:
+                raise BlockingIOError
+            ring.producer_blocked = 0
+        return n
+
     def send_data(self, tag: int, payload: memoryview, done, fail, owner, fires: list) -> None:
         if not self.alive:
             if fail is not None:
@@ -205,19 +273,41 @@ class TcpConn(BaseConn):
     def kick_tx(self, fires: list) -> None:
         if not self.alive:
             return
+        t0 = self.sm_tx.tail if self.sm_active else 0
+        blocked = False
         try:
             while self.tx:
                 item = self.tx[0]
-                if not item.write(self.sock, fires):
+                if not item.write(self, fires):
                     self._set_want_write(True)
-                    return
+                    blocked = True
+                    break
                 self.tx.popleft()
         except (BrokenPipeError, ConnectionResetError, OSError):
             self.worker._conn_broken(self, fires)
             return
-        self._set_want_write(False)
+        if not blocked:
+            self._set_want_write(False)
+            if self.sm_active and not self._tx_via_ring:
+                # Pre-switch TCP bytes (the HELLO_ACK) fully drained: all
+                # stream traffic from here on rides the ring.
+                self._tx_via_ring = True
+        if self.sm_active and self.sm_tx.tail != t0:
+            self._doorbell(fires)
 
     def _set_want_write(self, want: bool) -> None:
+        if self._tx_via_ring:
+            # The block is on the ring, not the socket: EPOLLOUT would spin
+            # (the socket is almost always writable).  The peer doorbells
+            # when it frees space; the engine also sweeps blocked producers
+            # on a short timeout (see Worker._run).
+            if want:
+                self.worker._sm_blocked_conns.add(self)
+            else:
+                self.worker._sm_blocked_conns.discard(self)
+                if self.sm_tx is not None:
+                    self.sm_tx.producer_blocked = 0
+            return
         if want != self._want_write:
             self._want_write = want
             self.worker._update_conn_interest(self)
@@ -226,7 +316,53 @@ class TcpConn(BaseConn):
         return any(isinstance(it, TxData) and not (it.off >= it.total) for it in self.tx)
 
     # ------------------------------------------------------------------ rx
+    def _rx_read(self, target) -> int:
+        """Read stream bytes from the active transport into ``target``.
+
+        Raises BlockingIOError when nothing is available; returns 0 only on
+        TCP EOF (the ring has no EOF -- peer death surfaces on the socket).
+        """
+        if self.sm_active:
+            n = self.sm_rx.read_into(target)
+            if n == 0:
+                raise BlockingIOError
+            return n
+        return self.sock.recv_into(target)
+
     def on_readable(self, fires: list) -> None:
+        if not self.sm_active:
+            self._pump_frames(fires)
+            return
+        # sm mode: the socket carries only doorbells (and EOF/RST).  Drain
+        # it, then pump the ring.  On EOF the peer is gone, but bytes it
+        # published before dying are still in the ring: pump first, then
+        # declare the conn broken (graceful close must deliver).
+        eof = False
+        while True:
+            try:
+                b = self.sock.recv(4096)
+            except BlockingIOError:
+                break
+            except (ConnectionResetError, OSError):
+                eof = True
+                break
+            if not b:
+                eof = True
+                break
+        h0 = self.sm_rx.head
+        self._pump_frames(fires)
+        if not self.alive:
+            return
+        if self.sm_rx.head != h0 and self.sm_rx.producer_blocked:
+            self.sm_rx.producer_blocked = 0
+            self._doorbell(fires)
+        if self.tx:
+            self.kick_tx(fires)  # the doorbell may mean tx-ring space freed
+        if eof and self.alive:
+            self._pump_frames(fires)
+            self.worker._conn_broken(self, fires)
+
+    def _pump_frames(self, fires: list) -> None:
         matcher = self.worker.matcher
         lock = self.worker.lock
         while self.alive:
@@ -240,7 +376,7 @@ class TcpConn(BaseConn):
                 else:
                     target = m.sink[m.received : m.received + min(remaining, RX_CHUNK)]
                 try:
-                    n = self.sock.recv_into(target)
+                    n = self._rx_read(target)
                 except BlockingIOError:
                     return
                 except (ConnectionResetError, OSError):
@@ -258,7 +394,7 @@ class TcpConn(BaseConn):
             if self._ctl is not None:
                 ftype, body, got = self._ctl
                 try:
-                    n = self.sock.recv_into(memoryview(body)[got:])
+                    n = self._rx_read(memoryview(body)[got:])
                 except BlockingIOError:
                     return
                 except (ConnectionResetError, OSError):
@@ -280,7 +416,7 @@ class TcpConn(BaseConn):
                 continue
             # header state
             try:
-                n = self.sock.recv_into(memoryview(self._hdr)[self._hdr_got :])
+                n = self._rx_read(memoryview(self._hdr)[self._hdr_got :])
             except BlockingIOError:
                 return
             except (ConnectionResetError, OSError):
@@ -339,6 +475,7 @@ class TcpConn(BaseConn):
                 self.sock.close()
             except OSError:
                 pass
+        self._close_sm()
 
     def mark_dead(self, fires: list) -> None:
         if self.alive:
@@ -355,8 +492,11 @@ class TcpConn(BaseConn):
                 self.sock.close()
             except OSError:
                 pass
+        self._close_sm()
 
     def transports(self) -> list[tuple[str, str]]:
+        if self.sm_active:
+            return [("shm", "sm")]
         dev = "lo" if self.remote_addr.startswith("127.") else "eth0"
         return [(dev, "tcp")]
 

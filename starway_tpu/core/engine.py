@@ -95,6 +95,11 @@ class Worker:
         self._listener: Optional[socket.socket] = None
         self.mode = "socket"
         self._address_blob: Optional[bytes] = None
+        # sm conns whose producer is blocked on a full ring.  While any
+        # exist the select() below uses a short timeout: the doorbell-back
+        # protocol has an unfenceable store-load race in pure Python (see
+        # core/shmring.py), so the timeout bounds a missed wakeup.
+        self._sm_blocked_conns: set = set()
 
     # ------------------------------------------------------------ app side
     def _require_running(self) -> None:
@@ -215,12 +220,16 @@ class Worker:
                     if self.status == state.CLOSING:
                         break
                 try:
-                    events = self.selector.select()
+                    events = self.selector.select(0.002 if self._sm_blocked_conns else None)
                 except OSError:
                     break
                 for key, mask in events:
                     fires: list = []
                     key.data(mask, fires)
+                    _run_fires(fires)
+                for conn in list(self._sm_blocked_conns):
+                    fires = []
+                    conn.kick_tx(fires)
                     _run_fires(fires)
                 self._drain_ops()
             self._do_close()
@@ -494,21 +503,47 @@ class ClientWorker(Worker):
                 if cb is not None:
                     _run_fires([lambda: cb("")])
                 return True
-        # Real TCP path (cross-process / DCN bootstrap).
+        # Real TCP path (cross-process / DCN bootstrap).  The HELLO offers a
+        # same-host shared-memory upgrade when enabled; a peer that mapped
+        # the segment confirms with "sm": "ok" (core/shmring.py).
+        sm_offer = None
+        if config.sm_enabled():
+            try:
+                from . import shmring
+
+                sm_offer = shmring.ShmSegment.create(self.worker_id[:8])
+            except Exception:
+                sm_offer = None
         try:
+            extra = None
+            if sm_offer is not None:
+                extra = {
+                    "sm_key": sm_offer.key,
+                    "sm_nonce": f"{sm_offer.nonce:016x}",
+                    "sm_ring": str(sm_offer.ring_size),
+                }
             sock = socket.create_connection((addr, port), timeout=CONNECT_TIMEOUT_S)
             sock.settimeout(CONNECT_TIMEOUT_S)
-            sock.sendall(frames.pack_hello(self.worker_id, mode, self.name))
+            sock.sendall(frames.pack_hello(self.worker_id, mode, self.name, extra))
             hdr = _read_exact(sock, frames.HEADER_SIZE)
             ftype, _, blen = frames.unpack_header(hdr)
             if ftype != frames.T_HELLO_ACK:
                 raise ConnectionError("unexpected frame during handshake")
             ack = frames.unpack_json_body(bytes(_read_exact(sock, blen)))
         except Exception as e:
+            if sm_offer is not None:
+                sm_offer.unlink()
+                sm_offer.close()
             self._fail_connect(cb, f"{REASON_NOT_CONNECTED}: {e}")
             return False
         conn = TcpConn(self, sock, mode, handshaken=True)
         conn.peer_name = ack.get("worker_id", "")
+        if sm_offer is not None:
+            if ack.get("sm") == "ok":
+                conn.adopt_sm(sm_offer, creator=True)
+            else:
+                sm_offer.unlink()
+                sm_offer.close()
         self.primary_conn = conn
         with self.lock:
             self.conns[conn.conn_id] = conn
@@ -626,11 +661,32 @@ class ServerWorker(Worker):
             conn.local_port = conn.remote_port = 0
         conn.handshaken = True
         self._half_open.discard(conn)
+        # Same-host shared-memory offer: map + validate the segment, confirm
+        # in the ACK.  Any failure (different host, bad nonce, sm disabled)
+        # silently stays on TCP.
+        sm_seg = None
+        if config.sm_enabled() and "sm_key" in info:
+            try:
+                from . import shmring
+
+                sm_seg = shmring.ShmSegment.attach(
+                    str(info["sm_key"]),
+                    int(str(info.get("sm_nonce", "0")), 16),
+                    int(str(info.get("sm_ring", "0"))),
+                )
+            except Exception:
+                sm_seg = None
+        # Settle the transport before the endpoint becomes visible, but
+        # register before the ACK goes out: by the time the client's connect
+        # completes, list_clients() must already contain it.
+        if sm_seg is not None:
+            conn.adopt_sm(sm_seg, creator=False, defer_tx=True)
         ep = ServerEndpoint(conn)
         with self.lock:
             self.conns[conn.conn_id] = conn
             self.eps[conn.conn_id] = ep
-        conn.send_ctl(frames.pack_hello_ack(self.worker_id), fires)
+        ack_extra = {"sm": "ok"} if sm_seg is not None else None
+        conn.send_ctl(frames.pack_hello_ack(self.worker_id, ack_extra), fires)
         if self.accept_cb is not None:
             fires.append(lambda ep=ep: self.accept_cb(ep))
 

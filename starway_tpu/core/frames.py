@@ -30,6 +30,16 @@ HELLO is sent by the connector and carries ``{"worker_id", "mode", "name"}``
 ``"socket"`` or ``"address"``; in address mode the accepted endpoint reports
 empty socket fields, mirroring the reference (README.md:141-143).
 
+HELLO may additionally offer a same-host shared-memory upgrade
+(``sm_key`` / ``sm_nonce`` / ``sm_ring`` -- see core/shmring.py); an
+acceptor that successfully maps the segment confirms with ``"sm": "ok"``
+in HELLO_ACK and both sides move the framed stream onto the rings, keeping
+the socket as doorbell + liveness channel.  All extra values are JSON
+strings so the native engine's minimal extractor can read them, and both
+engines ignore unknown keys -- old and new peers interoperate, falling
+back to plain TCP.  This mirrors UCX's transport negotiation
+(``UCX_TLS`` including ``sm``; reference: benchmark.md:114-126).
+
 FLUSH / FLUSH_ACK implement the delivery barrier: because the byte stream is
 processed in order, a FLUSH_ACK for sequence *n* proves every DATA payload
 enqueued before flush *n* has been fully ingested by the peer's matching
@@ -60,16 +70,19 @@ def unpack_header(buf) -> tuple[int, int, int]:
     return HEADER.unpack(buf)
 
 
-def pack_hello(worker_id: str, mode: str, name: str = "") -> bytes:
-    body = json.dumps(
-        {"worker_id": worker_id, "mode": mode, "name": name},
-        separators=(",", ":"),
-    ).encode()
+def pack_hello(worker_id: str, mode: str, name: str = "", extra: dict | None = None) -> bytes:
+    fields = {"worker_id": worker_id, "mode": mode, "name": name}
+    if extra:
+        fields.update(extra)
+    body = json.dumps(fields, separators=(",", ":")).encode()
     return pack_header(T_HELLO, 0, len(body)) + body
 
 
-def pack_hello_ack(worker_id: str) -> bytes:
-    body = json.dumps({"worker_id": worker_id}, separators=(",", ":")).encode()
+def pack_hello_ack(worker_id: str, extra: dict | None = None) -> bytes:
+    fields = {"worker_id": worker_id}
+    if extra:
+        fields.update(extra)
+    body = json.dumps(fields, separators=(",", ":")).encode()
     return pack_header(T_HELLO_ACK, 0, len(body)) + body
 
 

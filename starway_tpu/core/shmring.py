@@ -1,0 +1,272 @@
+"""Shared-memory ring transport: the same-host fast path ("sm").
+
+The reference's UCX layer negotiates a shared-memory transport between
+same-host processes whenever ``UCX_TLS`` allows it (reference:
+benchmark.md:114-126 lists ``sm`` among the transports; posix/sysv shm are
+UCX's loopback default).  This module is the TPU build's equivalent: a
+pair of SPSC byte rings in a ``/dev/shm`` segment, negotiated over the
+existing HELLO/HELLO_ACK handshake (core/frames.py) and carrying the exact
+same framed byte stream as the TCP path -- the frame parser cannot tell the
+transports apart.  The TCP connection stays open as the doorbell + liveness
+channel (peer death is still detected by EOF/RST; wakeups are 1-byte
+writes), so no busy-polling is needed: both engines stay event-driven.
+
+Segment layout (all little-endian, offsets in bytes)::
+
+    0    u64  magic      0x31676e69726d7773  ("swmring1")
+    8    u64  nonce      random; echoed in HELLO to authenticate the segment
+    16   u64  ring_size  bytes per direction, power of two
+    24..63    reserved
+    64   ring 0 header (connector->acceptor direction)
+           +0   u64 tail              producer cursor, free-running
+           +8   u64 producer_blocked  producer is waiting for free space
+           +64  u64 head              consumer cursor, free-running
+    192  ring 1 header (acceptor->connector direction), same shape
+    320..383  reserved
+    384             ring 0 data [ring_size]
+    384+ring_size   ring 1 data [ring_size]
+
+``head``/``tail`` live on separate cache lines (the producer writes tail and
+reads head; the consumer the reverse).  Cursors are free-running u64s:
+``avail = tail - head``, ``free = ring_size - avail``; data index is
+``cursor & (ring_size - 1)``.  The pure-Python implementation depends on
+x86-TSO: aligned 8-byte stores are atomic and store-store ordered, which is
+exactly the data-before-tail publication this protocol needs; Python cannot
+emit fences, so ``config.sm_enabled()`` gates the Python engine to x86-64.
+The C++ engine implements the same layout with real acquire/release
+atomics and carries sm on any architecture.  This layout is the
+cross-engine contract: any change here must land in both engines
+(CLAUDE.md "two engines, one contract").
+
+Wakeup protocol (the part a memory-model purist would flag): a producer
+that advances ``tail`` always sends a doorbell byte on the TCP socket, so a
+sleeping consumer cannot miss data.  A producer that finds the ring full
+sets ``producer_blocked`` and stops; the consumer doorbells back when it
+frees space and sees the flag.  The flag check races (store-load reordering
+is possible on both sides, and pure Python cannot fence), so a blocked
+producer's engine additionally polls with a short timeout
+(core/engine.py/_sm_poll_timeout) -- the race costs at most one timeout
+tick, never a deadlock.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import secrets
+import struct
+
+MAGIC = 0x31676E69726D7773  # b"swmring1" little-endian
+
+_HDR = struct.Struct("<QQQ")  # magic, nonce, ring_size
+
+GLOBAL_HDR = 64
+RING_HDR = 128
+DATA_OFF = GLOBAL_HDR + 2 * RING_HDR  # 384
+
+OFF_TAIL = 0
+OFF_BLOCKED = 8
+OFF_HEAD = 64
+
+SHM_DIR = "/dev/shm"
+
+# 1 MiB keeps the ring + both working chunks cache-resident: measured on the
+# dev box, 256K-1M rings stream at ~11-12 GB/s single-process while 4M+ rings
+# fall to ~5 GB/s (DRAM eviction).  Large transfers are DRAM-bound anyway;
+# small rings also bound the wakeup ping-pong granularity.
+DEFAULT_RING = 1 << 20
+MAX_RING = 1 << 30
+
+
+def default_ring_size() -> int:
+    raw = os.environ.get("STARWAY_SM_RING", "")
+    if not raw:
+        return DEFAULT_RING
+    try:
+        v = int(raw)
+    except ValueError:
+        return DEFAULT_RING
+    # round up to a power of two within sane bounds
+    v = max(4096, min(v, MAX_RING))
+    return 1 << (v - 1).bit_length()
+
+
+class Ring:
+    """One direction of the segment, viewed as a byte stream.
+
+    Exactly one process calls :meth:`write` (the producer) and exactly one
+    calls :meth:`read_into` (the consumer); both may inspect cursors.
+    """
+
+    __slots__ = ("_u64", "_data", "size", "_hdr_idx")
+
+    def __init__(self, seg_mv: memoryview, hdr_off: int, data_off: int, size: int):
+        # One u64 view over the whole segment: index = byte offset / 8.
+        self._u64 = seg_mv.cast("B").cast("Q")
+        self._data = seg_mv[data_off : data_off + size]
+        self.size = size
+        self._hdr_idx = hdr_off // 8
+
+    # cursor accessors (aligned 8-byte ops; atomic on the platforms we run on)
+    @property
+    def tail(self) -> int:
+        return self._u64[self._hdr_idx + OFF_TAIL // 8]
+
+    @tail.setter
+    def tail(self, v: int) -> None:
+        self._u64[self._hdr_idx + OFF_TAIL // 8] = v
+
+    @property
+    def head(self) -> int:
+        return self._u64[self._hdr_idx + OFF_HEAD // 8]
+
+    @head.setter
+    def head(self, v: int) -> None:
+        self._u64[self._hdr_idx + OFF_HEAD // 8] = v
+
+    @property
+    def producer_blocked(self) -> int:
+        return self._u64[self._hdr_idx + OFF_BLOCKED // 8]
+
+    @producer_blocked.setter
+    def producer_blocked(self, v: int) -> None:
+        self._u64[self._hdr_idx + OFF_BLOCKED // 8] = v
+
+    def readable(self) -> int:
+        return self.tail - self.head
+
+    def free(self) -> int:
+        return self.size - (self.tail - self.head)
+
+    # ------------------------------------------------------------------ I/O
+    def write(self, src: memoryview) -> int:
+        """Producer: append up to ``len(src)`` bytes; returns bytes written
+        (0 when full).  Data is copied before the tail store publishes it."""
+        tail = self.tail
+        n = min(len(src), self.size - (tail - self.head))
+        if n <= 0:
+            return 0
+        idx = tail & (self.size - 1)
+        first = min(n, self.size - idx)
+        self._data[idx : idx + first] = src[:first]
+        if n > first:
+            self._data[: n - first] = src[first:n]
+        self.tail = tail + n
+        return n
+
+    def read_into(self, dst: memoryview) -> int:
+        """Consumer: read up to ``len(dst)`` bytes; returns bytes read."""
+        head = self.head
+        n = min(len(dst), self.tail - head)
+        if n <= 0:
+            return 0
+        idx = head & (self.size - 1)
+        first = min(n, self.size - idx)
+        dst[:first] = self._data[idx : idx + first]
+        if n > first:
+            dst[first:n] = self._data[: n - first]
+        self.head = head + n
+        return n
+
+    def release(self) -> None:
+        self._data.release()
+        self._u64.release()
+
+
+class ShmSegment:
+    """A mapped segment holding both rings of one connection.
+
+    The connector *creates* (and offers the name in HELLO); the acceptor
+    *attaches* and validates magic+nonce, then the name is unlinked by
+    whichever side gets there first -- after both are mapped the name is
+    dead weight and the pages live until the last mapping goes away.
+    """
+
+    __slots__ = ("key", "nonce", "ring_size", "_mm", "_mv", "rings", "creator")
+
+    def __init__(self, key: str, nonce: int, ring_size: int, mm: mmap.mmap, creator: bool):
+        self.key = key
+        self.nonce = nonce
+        self.ring_size = ring_size
+        self._mm = mm
+        self._mv = memoryview(mm)
+        self.rings = (
+            Ring(self._mv, GLOBAL_HDR, DATA_OFF, ring_size),
+            Ring(self._mv, GLOBAL_HDR + RING_HDR, DATA_OFF + ring_size, ring_size),
+        )
+        self.creator = creator
+
+    # ------------------------------------------------------------ lifecycle
+    @classmethod
+    def create(cls, key_hint: str, ring_size: int | None = None) -> "ShmSegment":
+        size = ring_size or default_ring_size()
+        if size & (size - 1):
+            raise ValueError("ring size must be a power of two")
+        key = f"sw-{key_hint}-{secrets.token_hex(4)}"
+        path = os.path.join(SHM_DIR, key)
+        total = DATA_OFF + 2 * size
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, total)
+            mm = mmap.mmap(fd, total)
+        finally:
+            os.close(fd)
+        nonce = secrets.randbits(64)
+        _HDR.pack_into(mm, 0, MAGIC, nonce, size)
+        return cls(key, nonce, size, mm, creator=True)
+
+    @classmethod
+    def attach(cls, key: str, nonce: int, ring_size: int) -> "ShmSegment":
+        """Map an offered segment; raises on any mismatch (caller falls back
+        to TCP)."""
+        if "/" in key or not key.startswith("sw-"):
+            raise ValueError(f"bad sm key {key!r}")
+        if ring_size & (ring_size - 1) or not 4096 <= ring_size <= MAX_RING:
+            raise ValueError(f"bad sm ring size {ring_size}")
+        path = os.path.join(SHM_DIR, key)
+        total = DATA_OFF + 2 * ring_size
+        fd = os.open(path, os.O_RDWR)
+        try:
+            st = os.fstat(fd)
+            # /dev/shm is world-writable: only map segments our own uid
+            # created, or a hostile local process could offer a file it can
+            # truncate under us later (SIGBUS on the next ring access).
+            if st.st_uid != os.geteuid():
+                raise ValueError("sm segment owned by another uid")
+            if st.st_size != total:
+                raise ValueError("sm segment size mismatch")
+            mm = mmap.mmap(fd, total)
+        finally:
+            os.close(fd)
+        magic, got_nonce, got_size = _HDR.unpack_from(mm, 0)
+        if magic != MAGIC or got_nonce != nonce or got_size != ring_size:
+            mm.close()
+            raise ValueError("sm segment header mismatch")
+        return cls(key, nonce, ring_size, mm, creator=False)
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(os.path.join(SHM_DIR, self.key))
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        for r in self.rings:
+            try:
+                r.release()
+            except Exception:
+                pass
+        try:
+            self._mv.release()
+        except Exception:
+            pass
+        try:
+            self._mm.close()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------- role selection
+    def tx_rx(self, creator: bool) -> tuple[Ring, Ring]:
+        """(producer ring, consumer ring) for this side.  Ring 0 carries
+        connector->acceptor traffic."""
+        return (self.rings[0], self.rings[1]) if creator else (self.rings[1], self.rings[0])

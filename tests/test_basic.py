@@ -35,12 +35,16 @@ def port():
     return random.randint(10000, 50000)
 
 
-@pytest.fixture(params=["inproc", "tcp", "native"])
+@pytest.fixture(params=["inproc", "tcp", "sm", "native"])
 def transport(request, monkeypatch):
-    """Three data planes behind one contract: in-process fast path, Python
-    TCP engine, C++ native TCP engine (parity-tested by the same suite)."""
+    """Four data planes behind one contract: in-process fast path, Python
+    TCP engine, shared-memory rings negotiated over TCP, C++ native TCP
+    engine (parity-tested by the same suite)."""
     if request.param == "tcp":
         monkeypatch.setenv("STARWAY_TLS", "tcp")
+        monkeypatch.setenv("STARWAY_NATIVE", "0")
+    elif request.param == "sm":
+        monkeypatch.setenv("STARWAY_TLS", "tcp,sm")
         monkeypatch.setenv("STARWAY_NATIVE", "0")
     elif request.param == "native":
         from starway_tpu.core import native

@@ -1,0 +1,304 @@
+"""Shared-memory transport ("sm") semantics.
+
+The reference's UCX layer negotiates shared memory between same-host
+processes whenever ``UCX_TLS`` allows it (reference: benchmark.md:114-126);
+its tests exercise whichever transport UCX picks on loopback.  This suite
+pins the TPU build's explicit sm upgrade (core/shmring.py): negotiation and
+fallback, integrity across process boundaries, the flush-vs-close delivery
+contract (the reference's core semantic, tests/test_basic.py:190-415), ring
+wrap/backpressure with a deliberately tiny ring, and segment cleanup (no
+``/dev/shm`` leaks).
+
+The main suite (test_basic.py) additionally runs its whole transport matrix
+over ``sm`` in-process; this file covers what only dedicated setups can.
+"""
+
+import asyncio
+import contextlib
+import multiprocessing as mp
+import os
+import random
+
+import numpy as np
+import pytest
+
+from starway_tpu import Client, Server
+from starway_tpu.core import shmring
+
+pytestmark = pytest.mark.asyncio
+
+SERVER_ADDR = "127.0.0.1"
+# Mid-stream-at-close must not be winnable by a fast machine: like the
+# reference (8 GiB, tests/test_basic.py:190-415) and test_basic.py here
+# (1 GiB), the margin is sheer size -- far beyond ring + socket buffering.
+INFLIGHT_BYTES = 1 << 30
+
+
+def _shm_segments() -> set[str]:
+    return {f for f in os.listdir(shmring.SHM_DIR) if f.startswith("sw-")}
+
+
+@pytest.fixture
+def shm_baseline():
+    """Segments present before the test (e.g. another process's) are not this
+    test's leaks; only a delta is."""
+    return _shm_segments()
+
+
+def _shm_leftovers(baseline=frozenset()) -> set[str]:
+    return _shm_segments() - set(baseline)
+
+
+@pytest.fixture
+def port():
+    return random.randint(10000, 50000)
+
+
+@pytest.fixture
+def sm_env(monkeypatch):
+    monkeypatch.setenv("STARWAY_TLS", "tcp,sm")
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+
+
+# ==============================================================================
+# Ring unit behaviour
+# ==============================================================================
+
+
+def test_ring_byte_stream_wrap_and_backpressure():
+    seg = shmring.ShmSegment.create("ringunit", ring_size=4096)
+    try:
+        tx, rx = seg.tx_rx(creator=True)
+        peer_tx, peer_rx = seg.tx_rx(creator=False)
+        assert tx is peer_rx is seg.rings[0] and rx is peer_tx is seg.rings[1]
+
+        # fill to capacity; writes beyond it are refused
+        blob = bytes(range(256)) * 16  # 4096
+        assert tx.write(memoryview(blob)) == 4096
+        assert tx.write(memoryview(b"x")) == 0
+        assert tx.free() == 0 and peer_rx.readable() == 4096
+
+        # partial consume frees space; subsequent write wraps the boundary
+        out = bytearray(3000)
+        assert peer_rx.read_into(memoryview(out)) == 3000
+        assert out == bytearray(blob[:3000])
+        assert tx.write(memoryview(blob[:2000])) == 2000
+        out2 = bytearray(4096)
+        n = peer_rx.read_into(memoryview(out2))
+        assert n == 4096 - 3000 + 2000
+        assert bytes(out2[:n]) == blob[3000:] + blob[:2000]
+        assert peer_rx.readable() == 0
+    finally:
+        seg.unlink()
+        seg.close()
+    assert seg.key not in _shm_segments()
+
+
+def test_segment_attach_validation():
+    seg = shmring.ShmSegment.create("attach", ring_size=8192)
+    try:
+        with pytest.raises(ValueError):
+            shmring.ShmSegment.attach(seg.key, seg.nonce ^ 1, seg.ring_size)
+        with pytest.raises(ValueError):
+            shmring.ShmSegment.attach(seg.key, seg.nonce, seg.ring_size * 2)
+        with pytest.raises(ValueError):
+            shmring.ShmSegment.attach("../etc/passwd", 0, 8192)
+        with pytest.raises(OSError):
+            shmring.ShmSegment.attach("sw-no-such-segment", 0, 8192)
+        ok = shmring.ShmSegment.attach(seg.key, seg.nonce, seg.ring_size)
+        ok.close()
+    finally:
+        seg.unlink()
+        seg.close()
+
+
+# ==============================================================================
+# In-process negotiation details
+# ==============================================================================
+
+
+@contextlib.asynccontextmanager
+async def _pair(port):
+    server = Server()
+    client = Client()
+    server.listen(SERVER_ADDR, port)
+    await client.aconnect(SERVER_ADDR, port)
+    try:
+        yield server, client
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_sm_negotiated_transport_visible(port, sm_env, shm_baseline):
+    async with _pair(port) as (server, client):
+        ep = server.list_clients().pop()
+        assert ep.view_transports() == [("shm", "sm")]
+    assert not _shm_leftovers(shm_baseline)
+
+
+async def test_sm_fallback_when_acceptor_disables(port, monkeypatch, shm_baseline):
+    # Server side never maps the offer => ACK carries no "sm": traffic stays
+    # on TCP and the offered segment is cleaned up.
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+    monkeypatch.setenv("STARWAY_TLS", "tcp,sm")
+    server = Server()
+    server.listen(SERVER_ADDR, port)
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+
+    from starway_tpu.core import engine as engine_mod
+
+    orig = engine_mod.ServerWorker._on_hello
+
+    def no_sm_hello(self, conn, info, fires):
+        info = {k: v for k, v in info.items() if not k.startswith("sm_")}
+        return orig(self, conn, info, fires)
+
+    monkeypatch.setattr(engine_mod.ServerWorker, "_on_hello", no_sm_hello)
+    monkeypatch.setenv("STARWAY_TLS", "tcp,sm")
+
+    client = Client()
+    await client.aconnect(SERVER_ADDR, port)
+    ep = server.list_clients().pop()
+    assert ep.view_transports() == [("lo", "tcp")]
+
+    buf = np.zeros(64, dtype=np.uint8)
+    fut = server.arecv(buf, 0, 0)
+    await client.asend(np.arange(64, dtype=np.uint8), 7)
+    await fut
+    np.testing.assert_array_equal(buf, np.arange(64, dtype=np.uint8))
+    await client.aclose()
+    await server.aclose()
+    assert not _shm_leftovers(shm_baseline)
+
+
+async def test_sm_tiny_ring_streams_large_messages(port, sm_env, monkeypatch, shm_baseline):
+    # 4 KiB rings force hundreds of wrap/backpressure cycles per message.
+    monkeypatch.setenv("STARWAY_SM_RING", "4096")
+    async with _pair(port) as (server, client):
+        ep = server.list_clients().pop()
+        assert ep.view_transports() == [("shm", "sm")]
+        rng = np.random.default_rng(7)
+        payload = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        buf = np.zeros(1 << 20, dtype=np.uint8)
+        fut = server.arecv(buf, 0, 0)
+        await client.asend(payload, 5)
+        await fut
+        np.testing.assert_array_equal(buf, payload)
+        # reverse direction across the same rings
+        buf2 = np.zeros(1 << 20, dtype=np.uint8)
+        fut2 = client.arecv(buf2, 0, 0)
+        await server.asend(ep, payload, 6)
+        await fut2
+        np.testing.assert_array_equal(buf2, payload)
+    assert not _shm_leftovers(shm_baseline)
+
+
+# ==============================================================================
+# Cross-process: integrity, flush-vs-close, peer death
+# ==============================================================================
+
+
+def _child_client_send_sm(port, with_flush, nbytes):
+    os.environ["STARWAY_TLS"] = "tcp,sm"
+    os.environ["STARWAY_NATIVE"] = "0"
+
+    async def inner():
+        client = None
+        for i in range(60):
+            client = Client()
+            try:
+                await client.aconnect(SERVER_ADDR, port)
+                break
+            except Exception:
+                if i == 59:
+                    raise
+                await asyncio.sleep(0.25)
+        send_buf = np.arange(nbytes, dtype=np.uint8)
+        await client.asend(send_buf, 0)
+        if with_flush:
+            await client.aflush()
+        await client.aclose()
+
+    asyncio.run(inner())
+
+
+@pytest.mark.parametrize("with_flush", [False, True])
+async def test_sm_client_send_flush_semantics(port, sm_env, with_flush, shm_baseline):
+    """The delivery contract holds over rings: close-without-flush aborts the
+    in-flight rendezvous send; flush guarantees delivery (the reference pins
+    this with 8 GiB in-flight sends, tests/test_basic.py:190-415)."""
+    server = Server()
+    server.listen(SERVER_ADDR, port)
+    connected = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    server.set_accept_cb(lambda ep: loop.call_soon_threadsafe(connected.set))
+
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_child_client_send_sm, args=(port, with_flush, INFLIGHT_BYTES), daemon=True)
+    p.start()
+    await asyncio.wait_for(connected.wait(), timeout=120)
+    ep = next(iter(server.list_clients()))
+    assert ep.view_transports() == [("shm", "sm")]
+
+    recv_buf = np.zeros(INFLIGHT_BYTES, dtype=np.uint8)
+    if with_flush:
+        await server.arecv(recv_buf, 0, 0)
+        np.testing.assert_array_equal(recv_buf, np.arange(INFLIGHT_BYTES, dtype=np.uint8))
+        p.join()
+    else:
+        done = False
+
+        def _done(sender_tag, length):
+            nonlocal done
+            done = True
+
+        def _fail(error):
+            nonlocal done
+            done = True
+
+        server.recv(recv_buf, 0, 0, _done, _fail)
+        await asyncio.sleep(1.5)
+        assert not done
+        p.kill()
+        p.join()
+    p.close()
+    await server.aclose()
+    assert not _shm_leftovers(shm_baseline)
+
+
+async def test_sm_peer_kill_leaves_recv_pending(port, sm_env, shm_baseline):
+    """SIGKILL mid-transfer: posted receives stay pending (reference peer
+    -death semantics), the engine survives, and the segment pages are
+    reclaimed because both sides unlinked the name at negotiation."""
+    server = Server()
+    server.listen(SERVER_ADDR, port)
+    connected = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    server.set_accept_cb(lambda ep: loop.call_soon_threadsafe(connected.set))
+
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_child_client_send_sm, args=(port, True, INFLIGHT_BYTES), daemon=True)
+    p.start()
+    await asyncio.wait_for(connected.wait(), timeout=120)
+
+    done = False
+
+    def _done(sender_tag, length):
+        nonlocal done
+        done = True
+
+    def _fail(error):
+        nonlocal done
+        done = True
+
+    recv_buf = np.zeros(INFLIGHT_BYTES, dtype=np.uint8)
+    server.recv(recv_buf, 0, 0, _done, _fail)
+    await asyncio.sleep(0.2)  # transfer underway
+    p.kill()
+    p.join()
+    p.close()
+    await asyncio.sleep(1.0)
+    assert not done  # pending forever, not failed
+    await server.aclose()
+    assert not _shm_leftovers(shm_baseline)
