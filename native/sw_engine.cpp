@@ -26,7 +26,10 @@
 #include <poll.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
+#include <sys/mman.h>
+#include <sys/random.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -88,6 +91,179 @@ uint64_t rndv_threshold() {
   }();
   return v;
 }
+
+// ------------------------------------------------------- shared-memory rings
+//
+// Same-host fast path, wire-identical to the Python engine's
+// starway_tpu/core/shmring.py (the layout there is the cross-engine
+// contract).  The connector offers a /dev/shm segment in HELLO
+// (sm_key/sm_nonce/sm_ring), the acceptor maps+validates it and confirms
+// with "sm": "ok" in HELLO_ACK, and the framed byte stream moves onto two
+// SPSC rings; the socket stays open as doorbell + liveness channel.  The
+// analogue of UCX negotiating posix shm when UCX_TLS allows "sm"
+// (reference: benchmark.md:114-126).
+
+constexpr uint64_t SM_MAGIC = 0x31676E69726D7773ull;  // "swmring1" LE
+constexpr size_t SM_GLOBAL_HDR = 64;
+constexpr size_t SM_RING_HDR = 128;
+constexpr size_t SM_DATA_OFF = SM_GLOBAL_HDR + 2 * SM_RING_HDR;  // 384
+constexpr size_t SM_OFF_TAIL = 0, SM_OFF_BLOCKED = 8, SM_OFF_HEAD = 64;
+
+// Read the env per handshake (not cached): the embedding process may flip
+// STARWAY_TLS between connections (the test matrix does), and handshakes
+// are rare enough that getenv cost is irrelevant.
+bool sm_enabled() {
+  const char* e = getenv("STARWAY_TLS");
+  std::string tls = e ? e : "inproc,sm,tcp,ici,dcn";
+  tls = "," + tls + ",";
+  return tls.find(",sm,") != std::string::npos;
+}
+
+uint64_t sm_ring_size() {
+  const char* e = getenv("STARWAY_SM_RING");
+  uint64_t r = e ? strtoull(e, nullptr, 10) : (uint64_t)(1u << 20);
+  if (r < 4096) r = 4096;
+  if (r > (1ull << 30)) r = 1ull << 30;
+  // round up to a power of two
+  uint64_t p = 4096;
+  while (p < r) p <<= 1;
+  return p;
+}
+
+// One direction of the segment viewed as a byte stream.  Producer writes
+// data then publishes tail with release; consumer reads after an acquire
+// load of tail -- the real-atomics version of the Python TSO protocol.
+struct SmRing {
+  uint8_t* hdr = nullptr;
+  uint8_t* data = nullptr;
+  uint64_t size = 0;
+
+  std::atomic<uint64_t>& tail() const { return *reinterpret_cast<std::atomic<uint64_t>*>(hdr + SM_OFF_TAIL); }
+  std::atomic<uint64_t>& blocked() const { return *reinterpret_cast<std::atomic<uint64_t>*>(hdr + SM_OFF_BLOCKED); }
+  std::atomic<uint64_t>& head() const { return *reinterpret_cast<std::atomic<uint64_t>*>(hdr + SM_OFF_HEAD); }
+
+  uint64_t readable() const { return tail().load(std::memory_order_acquire) - head().load(std::memory_order_relaxed); }
+
+  size_t write(const uint8_t* src, size_t len) {
+    uint64_t t = tail().load(std::memory_order_relaxed);
+    uint64_t h = head().load(std::memory_order_acquire);
+    uint64_t free_b = size - (t - h);
+    size_t n = len < free_b ? len : (size_t)free_b;
+    if (n == 0) return 0;
+    uint64_t idx = t & (size - 1);
+    size_t first = (size_t)(size - idx) < n ? (size_t)(size - idx) : n;
+    memcpy(data + idx, src, first);
+    if (n > first) memcpy(data, src + first, n - first);
+    tail().store(t + n, std::memory_order_release);
+    return n;
+  }
+
+  size_t read_into(uint8_t* dst, size_t len) {
+    uint64_t t = tail().load(std::memory_order_acquire);
+    uint64_t h = head().load(std::memory_order_relaxed);
+    uint64_t avail = t - h;
+    size_t n = len < avail ? len : (size_t)avail;
+    if (n == 0) return 0;
+    uint64_t idx = h & (size - 1);
+    size_t first = (size_t)(size - idx) < n ? (size_t)(size - idx) : n;
+    memcpy(dst, data + idx, first);
+    if (n > first) memcpy(dst + first, data, n - first);
+    head().store(h + n, std::memory_order_release);
+    return n;
+  }
+};
+
+struct SmSegment {
+  std::string key;  // "sw-..." (no leading slash; shm_open adds it)
+  uint64_t nonce = 0, ring_size = 0;
+  uint8_t* base = nullptr;
+  size_t total = 0;
+  bool creator = false;
+
+  static SmSegment* create(const std::string& hint) {
+    uint64_t rsize = sm_ring_size();
+    uint64_t nonce = 0, rand_tag = 0;
+    if (getrandom(&nonce, 8, 0) != 8 || getrandom(&rand_tag, 8, 0) != 8) return nullptr;
+    char keybuf[96];
+    snprintf(keybuf, sizeof(keybuf), "sw-%s-%08x", hint.c_str(), (uint32_t)rand_tag);
+    std::string shm_name = std::string("/") + keybuf;
+    int fd = shm_open(shm_name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    size_t total = SM_DATA_OFF + 2 * (size_t)rsize;
+    if (ftruncate(fd, (off_t)total) != 0) {
+      close(fd);
+      shm_unlink(shm_name.c_str());
+      return nullptr;
+    }
+    void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    if (base == MAP_FAILED) {
+      shm_unlink(shm_name.c_str());
+      return nullptr;
+    }
+    auto* seg = new SmSegment();
+    seg->key = keybuf;
+    seg->nonce = nonce;
+    seg->ring_size = rsize;
+    seg->base = (uint8_t*)base;
+    seg->total = total;
+    seg->creator = true;
+    memcpy(seg->base + 0, &SM_MAGIC, 8);
+    memcpy(seg->base + 8, &nonce, 8);
+    memcpy(seg->base + 16, &rsize, 8);
+    return seg;
+  }
+
+  static SmSegment* attach(const std::string& key, uint64_t nonce, uint64_t rsize) {
+    if (key.rfind("sw-", 0) != 0 || key.find('/') != std::string::npos) return nullptr;
+    if (rsize < 4096 || rsize > (1ull << 30) || (rsize & (rsize - 1))) return nullptr;
+    std::string shm_name = std::string("/") + key;
+    int fd = shm_open(shm_name.c_str(), O_RDWR, 0);
+    if (fd < 0) return nullptr;
+    size_t total = SM_DATA_OFF + 2 * (size_t)rsize;
+    struct stat st{};
+    // /dev/shm is world-writable: only map our own uid's segments, or a
+    // hostile local peer could truncate the file under us later (SIGBUS).
+    if (fstat(fd, &st) != 0 || st.st_uid != geteuid() || (size_t)st.st_size != total) {
+      close(fd);
+      return nullptr;
+    }
+    void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    if (base == MAP_FAILED) return nullptr;
+    uint64_t magic = 0, got_nonce = 0, got_size = 0;
+    memcpy(&magic, (uint8_t*)base + 0, 8);
+    memcpy(&got_nonce, (uint8_t*)base + 8, 8);
+    memcpy(&got_size, (uint8_t*)base + 16, 8);
+    if (magic != SM_MAGIC || got_nonce != nonce || got_size != rsize) {
+      munmap(base, total);
+      return nullptr;
+    }
+    auto* seg = new SmSegment();
+    seg->key = key;
+    seg->nonce = nonce;
+    seg->ring_size = rsize;
+    seg->base = (uint8_t*)base;
+    seg->total = total;
+    seg->creator = false;
+    return seg;
+  }
+
+  // (producer, consumer) rings for this side; ring 0 carries
+  // connector->acceptor traffic.
+  void tx_rx(bool is_creator, SmRing* tx, SmRing* rx) const {
+    SmRing r0{base + SM_GLOBAL_HDR, base + SM_DATA_OFF, ring_size};
+    SmRing r1{base + SM_GLOBAL_HDR + SM_RING_HDR, base + SM_DATA_OFF + ring_size, ring_size};
+    *tx = is_creator ? r0 : r1;
+    *rx = is_creator ? r1 : r0;
+  }
+
+  void unlink() { shm_unlink((std::string("/") + key).c_str()); }
+
+  ~SmSegment() {
+    if (base) munmap(base, total);
+  }
+};
 
 void pack_header(uint8_t* out, uint8_t type, uint64_t a, uint64_t b) {
   out[0] = type;
@@ -318,12 +494,41 @@ struct Conn {
   uint64_t flush_seq = 0, flush_acked = 0, data_counter = 0;
   std::unordered_map<uint64_t, uint64_t> flush_marks;
   bool dirty = false;
+  // shared-memory upgrade state (mirrors core/conn.py): sm_active switches
+  // RX to the ring; tx_via_ring flips once pre-switch TCP bytes (the
+  // HELLO_ACK) have drained, so stream bytes never interleave transports.
+  SmSegment* sm = nullptr;
+  SmRing sm_tx{}, sm_rx{};
+  bool sm_active = false;
+  bool sm_negotiated = false;  // sticky: survives teardown for introspection
+  bool tx_via_ring = false;
 
   bool has_unfinished_data() const {
     for (auto& t : tx)
       if (t.is_data && t.off < t.total()) return true;
     return false;
   }
+
+  void adopt_sm(SmSegment* seg, bool creator, bool defer_tx) {
+    sm = seg;
+    seg->tx_rx(creator, &sm_tx, &sm_rx);
+    sm_active = true;
+    sm_negotiated = true;
+    seg->unlink();
+    if (!defer_tx && tx.empty()) tx_via_ring = true;
+  }
+
+  void drop_sm() {
+    if (sm) {
+      sm->unlink();
+      delete sm;
+      sm = nullptr;
+      sm_active = false;
+      tx_via_ring = false;
+    }
+  }
+
+  ~Conn() { drop_sm(); }
 };
 
 struct FlushRec {
@@ -372,6 +577,8 @@ struct Worker {
   sw_accept_cb accept_cb = nullptr;
   void* accept_ctx = nullptr;
   std::unordered_set<Conn*> half_open;
+  // sm conns whose producer is blocked on a full ring (see conn_tx_write).
+  std::unordered_set<Conn*> sm_blocked;
   // client bits
   std::string c_host, c_mode;
   int c_port = 0;
@@ -457,8 +664,41 @@ struct Worker {
     kick_tx(c, fires);
   }
 
+  // Write to the active transport: >0 bytes taken, 0 = blocked, -1 = dead.
+  ssize_t conn_tx_write(Conn* c, const uint8_t* p, size_t n, FireList& fires) {
+    if (c->tx_via_ring) {
+      size_t w = c->sm_tx.write(p, n);
+      if (w == 0) {
+        // Two-phase sleep: publish the blocked flag, re-check.  seq_cst on
+        // both sides makes the native<->native eventcount sound; a pure-
+        // Python peer cannot fence, which the blocked-producer epoll
+        // timeout below covers.
+        c->sm_tx.blocked().store(1, std::memory_order_seq_cst);
+        w = c->sm_tx.write(p, n);
+        if (w == 0) return 0;
+        c->sm_tx.blocked().store(0, std::memory_order_relaxed);
+      }
+      return (ssize_t)w;
+    }
+    ssize_t w = ::send(c->fd, p, n, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+      conn_broken(c, fires);
+      return -1;
+    }
+    return w;
+  }
+
+  void doorbell(Conn* c, FireList& fires) {
+    uint8_t one = 1;
+    ssize_t w = ::send(c->fd, &one, 1, MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK) conn_broken(c, fires);
+    // EAGAIN: socket buffer already full of unread doorbells - peer will wake.
+  }
+
   void kick_tx(Conn* c, FireList& fires) {
     if (!c->alive) return;
+    uint64_t t0 = c->sm_active ? c->sm_tx.tail().load(std::memory_order_relaxed) : 0;
     while (!c->tx.empty()) {
       TxItem& item = c->tx.front();
       uint64_t hlen = item.header.size();
@@ -475,14 +715,11 @@ struct Worker {
           uint64_t left = item.paylen - po;
           n = left > (4u << 20) ? (4u << 20) : (size_t)left;
         }
-        ssize_t w = ::send(c->fd, p, n, MSG_NOSIGNAL);
-        if (w < 0) {
-          if (errno == EAGAIN || errno == EWOULDBLOCK) {
-            blocked = true;
-            break;
-          }
-          conn_broken(c, fires);
-          return;
+        ssize_t w = conn_tx_write(c, p, n, fires);
+        if (w < 0) return;  // conn_broken already ran
+        if (w == 0) {
+          blocked = true;
+          break;
         }
         item.off += (uint64_t)w;
         // Rendezvous local completion: transmission begun (header written).
@@ -495,10 +732,17 @@ struct Worker {
         }
       }
       if (blocked) {
-        if (!c->want_write) {
+        if (c->tx_via_ring) {
+          // Blocked on the ring, not the socket: EPOLLOUT would spin.  The
+          // consumer doorbells us when it frees space; the blocked sweep in
+          // run() covers a peer whose flag check raced.
+          sm_blocked.insert(c);
+        } else if (!c->want_write) {
           c->want_write = true;
           ep_mod_conn(c);
         }
+        if (c->sm_active && c->sm_tx.tail().load(std::memory_order_relaxed) != t0)
+          doorbell(c, fires);
         return;
       }
       if (item.is_data && !item.local_done) {
@@ -511,14 +755,67 @@ struct Worker {
       fire_release(item, fires);
       c->tx.pop_front();
     }
+    sm_blocked.erase(c);
+    if (c->sm_active) c->sm_tx.blocked().store(0, std::memory_order_relaxed);
     if (c->want_write) {
       c->want_write = false;
       ep_mod_conn(c);
     }
+    if (c->sm_active && !c->tx_via_ring) {
+      // Pre-switch TCP bytes (the HELLO_ACK) fully drained.
+      c->tx_via_ring = true;
+    }
+    if (c->sm_active && c->sm_tx.tail().load(std::memory_order_relaxed) != t0)
+      doorbell(c, fires);
   }
 
   // ----------------------------------------------------------------- rx
+  // Stream-read dispatch: >0 bytes, 0 = nothing available, -1 = conn broken
+  // (conn_broken already ran).  The ring has no EOF: peer death surfaces on
+  // the socket (doorbell channel) in conn_readable.
+  ssize_t stream_read(Conn* c, uint8_t* dst, size_t want, FireList& fires) {
+    if (c->sm_active) {
+      size_t n = c->sm_rx.read_into(dst, want);
+      return (ssize_t)n;
+    }
+    ssize_t r = ::recv(c->fd, dst, want, 0);
+    if (r > 0) return r;
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return 0;
+    conn_broken(c, fires);
+    return -1;
+  }
+
   void conn_readable(Conn* c, FireList& fires) {
+    if (!c->sm_active) {
+      pump_frames(c, fires);
+      return;
+    }
+    // sm mode: the socket carries only doorbells (and EOF/RST).  Drain it,
+    // pump the ring; on EOF pump once more (bytes published before the peer
+    // died must still deliver -- graceful close), then break the conn.
+    bool eof = false;
+    for (;;) {
+      char buf[4096];
+      ssize_t r = ::recv(c->fd, buf, sizeof(buf), 0);
+      if (r > 0) continue;
+      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+      eof = true;
+      break;
+    }
+    uint64_t h0 = c->sm_rx.head().load(std::memory_order_relaxed);
+    pump_frames(c, fires);
+    if (!c->alive) return;
+    if (c->sm_rx.head().load(std::memory_order_relaxed) != h0 &&
+        c->sm_rx.blocked().load(std::memory_order_seq_cst))
+      doorbell(c, fires);
+    if (!c->tx.empty()) kick_tx(c, fires);  // doorbell may mean tx space freed
+    if (eof && c->alive) {
+      pump_frames(c, fires);
+      if (c->alive) conn_broken(c, fires);
+    }
+  }
+
+  void pump_frames(Conn* c, FireList& fires) {
     while (c->alive) {
       if (c->rx_msg) {
         InboundMsg* m = c->rx_msg;
@@ -536,16 +833,8 @@ struct Worker {
           target = m->spill.data() + m->received;
           want = remaining > (4u << 20) ? (4u << 20) : (size_t)remaining;
         }
-        ssize_t r = ::recv(c->fd, target, want, 0);
-        if (r < 0) {
-          if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-          conn_broken(c, fires);
-          return;
-        }
-        if (r == 0) {
-          conn_broken(c, fires);
-          return;
-        }
+        ssize_t r = stream_read(c, target, want, fires);
+        if (r <= 0) return;
         m->received += (uint64_t)r;
         if (m->received >= m->length) {
           {
@@ -559,18 +848,10 @@ struct Worker {
       if (c->ctl_need) {
         size_t have = c->ctl_body.size();
         size_t want = c->ctl_need - have;
-        char tmp[4096];
-        ssize_t r = ::recv(c->fd, tmp, want > sizeof(tmp) ? sizeof(tmp) : want, 0);
-        if (r < 0) {
-          if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-          conn_broken(c, fires);
-          return;
-        }
-        if (r == 0) {
-          conn_broken(c, fires);
-          return;
-        }
-        c->ctl_body.append(tmp, (size_t)r);
+        uint8_t tmp[4096];
+        ssize_t r = stream_read(c, tmp, want > sizeof(tmp) ? sizeof(tmp) : want, fires);
+        if (r <= 0) return;
+        c->ctl_body.append((char*)tmp, (size_t)r);
         if (c->ctl_body.size() < c->ctl_need) continue;
         int t = c->ctl_type;
         std::string body = std::move(c->ctl_body);
@@ -581,16 +862,8 @@ struct Worker {
         // T_HELLO_ACK handled synchronously during client connect
         continue;
       }
-      ssize_t r = ::recv(c->fd, c->hdr + c->hdr_got, HEADER_SIZE - c->hdr_got, 0);
-      if (r < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-        conn_broken(c, fires);
-        return;
-      }
-      if (r == 0) {
-        conn_broken(c, fires);
-        return;
-      }
+      ssize_t r = stream_read(c, c->hdr + c->hdr_got, HEADER_SIZE - c->hdr_got, fires);
+      if (r <= 0) return;
       c->hdr_got += (size_t)r;
       if (c->hdr_got < HEADER_SIZE) continue;
       c->hdr_got = 0;
@@ -726,6 +999,8 @@ struct Worker {
     }
     close(c->fd);
     c->fd = -1;
+    sm_blocked.erase(c);
+    c->drop_sm();
     bool was_half_open = half_open.erase(c) > 0;
     auto snapshot = flushes;
     for (auto* rec : snapshot) try_complete_flush(rec, fires);
@@ -752,6 +1027,8 @@ struct Worker {
     }
     close(c->fd);
     c->fd = -1;
+    sm_blocked.erase(c);
+    c->drop_sm();
   }
 
   // -------------------------------------------------------------- hello
@@ -766,11 +1043,24 @@ struct Worker {
     }
     c->handshaken = true;
     half_open.erase(c);
+    // Shared-memory offer: map + validate, confirm in the ACK; any failure
+    // silently stays on TCP (mirrors core/engine.py ServerWorker._on_hello).
+    SmSegment* seg = nullptr;
+    if (sm_enabled()) {
+      std::string key = json_field(body, "sm_key");
+      if (!key.empty()) {
+        uint64_t nonce = strtoull(json_field(body, "sm_nonce").c_str(), nullptr, 16);
+        uint64_t rsz = strtoull(json_field(body, "sm_ring").c_str(), nullptr, 10);
+        seg = SmSegment::attach(key, nonce, rsz);
+      }
+    }
+    if (seg) c->adopt_sm(seg, /*creator=*/false, /*defer_tx=*/true);
     {
       std::lock_guard<std::mutex> g(mu);
       conns[c->id] = c;
     }
-    std::string ack = std::string("{\"worker_id\": \"") + worker_id + "\"}";
+    std::string ack = std::string("{\"worker_id\": \"") + worker_id + "\"" +
+                      (seg ? ", \"sm\": \"ok\"" : "") + "}";
     conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires);
     if (accept_cb) {
       auto cb = accept_cb; auto ctx = accept_ctx; uint64_t id = c->id;
@@ -866,7 +1156,10 @@ struct Worker {
     epoll_event events[64];
     for (;;) {
       if (status.load() == ST_CLOSING) break;
-      int n = epoll_wait(epfd, events, 64, -1);
+      // Short timeout while any sm producer is blocked: a pure-Python peer
+      // cannot fence its doorbell-back flag check, so a missed wakeup costs
+      // one tick instead of a deadlock.
+      int n = epoll_wait(epfd, events, 64, sm_blocked.empty() ? -1 : 2);
       if (n < 0) {
         if (errno == EINTR) continue;
         break;
@@ -886,6 +1179,10 @@ struct Worker {
           if ((events[i].events & (EPOLLIN | EPOLLHUP | EPOLLERR)) && c->alive)
             conn_readable(c, fires);
         }
+      }
+      if (!sm_blocked.empty()) {
+        std::vector<Conn*> blocked(sm_blocked.begin(), sm_blocked.end());
+        for (Conn* c : blocked) kick_tx(c, fires);
       }
       drain_ops(fires);
       for (auto& f : fires) f();
@@ -953,8 +1250,14 @@ struct ClientWorker : Worker {
     ep_add(evfd, EPOLLIN, &evfd);
     // Nonblocking connect with 3s timeout.
     int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    SmSegment* sm_offer = nullptr;
     auto fail_connect = [&](const std::string& why) {
       if (fd >= 0) close(fd);
+      if (sm_offer) {
+        sm_offer->unlink();
+        delete sm_offer;
+        sm_offer = nullptr;
+      }
       status.store(ST_CLOSED);
       if (c_status_cb) {
         auto cb = c_status_cb; auto ctx = c_status_ctx;
@@ -979,9 +1282,18 @@ struct ClientWorker : Worker {
     if (err != 0) return fail_connect(strerror(err));
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    // HELLO / HELLO_ACK handshake (blocking with poll deadlines).
+    // HELLO / HELLO_ACK handshake (blocking with poll deadlines).  Offer a
+    // same-host shared-memory upgrade when enabled (see SmSegment).
+    if (sm_enabled()) sm_offer = SmSegment::create(worker_id.substr(0, 8));
     std::string hello = std::string("{\"worker_id\": \"") + worker_id +
-                        "\", \"mode\": \"" + c_mode + "\", \"name\": \"\"}";
+                        "\", \"mode\": \"" + c_mode + "\", \"name\": \"\"";
+    if (sm_offer) {
+      char nonce_hex[17];
+      snprintf(nonce_hex, sizeof(nonce_hex), "%016llx", (unsigned long long)sm_offer->nonce);
+      hello += std::string(", \"sm_key\": \"") + sm_offer->key + "\", \"sm_nonce\": \"" +
+               nonce_hex + "\", \"sm_ring\": \"" + std::to_string(sm_offer->ring_size) + "\"";
+    }
+    hello += "}";
     std::vector<uint8_t> frame(HEADER_SIZE + hello.size());
     pack_header(frame.data(), T_HELLO, 0, hello.size());
     memcpy(frame.data() + HEADER_SIZE, hello.data(), hello.size());
@@ -1028,7 +1340,18 @@ struct ClientWorker : Worker {
     c->fd = fd;
     c->handshaken = true;
     c->mode = c_mode;
-    c->peer_name = json_field(std::string((char*)body.data(), body.size()), "worker_id");
+    std::string ack_body((char*)body.data(), body.size());
+    c->peer_name = json_field(ack_body, "worker_id");
+    if (sm_offer) {
+      if (json_field(ack_body, "sm") == "ok") {
+        c->adopt_sm(sm_offer, /*creator=*/true, /*defer_tx=*/false);
+        sm_offer = nullptr;  // owned by the conn now
+      } else {
+        sm_offer->unlink();
+        delete sm_offer;
+        sm_offer = nullptr;
+      }
+    }
     sockaddr_in local{};
     socklen_t llen = sizeof(local);
     char buf[64];
@@ -1074,7 +1397,7 @@ int worker_start(Worker* w) {
 
 extern "C" {
 
-const char* sw_version() { return "starway-native-1"; }
+const char* sw_version() { return "starway-native-2"; }  // 2: sm transport
 
 // ----- client
 
@@ -1261,10 +1584,12 @@ int sw_conn_info(void* h, uint64_t conn_id, char* out, int cap) {
   int n = snprintf(buf, sizeof(buf),
                    "{\"name\": \"%s\", \"mode\": \"%s\", \"alive\": %d, "
                    "\"local_addr\": \"%s\", \"local_port\": %d, "
-                   "\"remote_addr\": \"%s\", \"remote_port\": %d}",
+                   "\"remote_addr\": \"%s\", \"remote_port\": %d, "
+                   "\"transport\": \"%s\"}",
                    c->peer_name.c_str(), c->mode.c_str(), c->alive ? 1 : 0,
                    c->local_addr.c_str(), c->local_port,
-                   c->remote_addr.c_str(), c->remote_port);
+                   c->remote_addr.c_str(), c->remote_port,
+                   c->sm_negotiated ? "sm" : "tcp");
   if (n < 0 || n >= cap) return -1;
   memcpy(out, buf, (size_t)n + 1);
   return n;

@@ -183,6 +183,7 @@ class TcpConn(BaseConn):
         self.sm_tx = None
         self.sm_rx = None
         self.sm_active = False
+        self.sm_negotiated = False  # sticky: survives teardown for introspection
         self._tx_via_ring = False
         if mode == "socket":
             try:
@@ -207,6 +208,7 @@ class TcpConn(BaseConn):
         self._sm = seg
         self.sm_tx, self.sm_rx = seg.tx_rx(creator)
         self.sm_active = True
+        self.sm_negotiated = True
         seg.unlink()
         if not defer_tx and not self.tx:
             self._tx_via_ring = True
@@ -224,6 +226,10 @@ class TcpConn(BaseConn):
             self.worker._sm_blocked_conns.discard(self)
             seg, self._sm = self._sm, None
             self.sm_tx = self.sm_rx = None
+            # sm_negotiated stays set: introspection on dead endpoints still
+            # reports what the conn ran on (same as the native engine).
+            self.sm_active = False
+            self._tx_via_ring = False
             seg.unlink()
             seg.close()
 
@@ -495,7 +501,7 @@ class TcpConn(BaseConn):
         self._close_sm()
 
     def transports(self) -> list[tuple[str, str]]:
-        if self.sm_active:
+        if self.sm_negotiated:
             return [("shm", "sm")]
         dev = "lo" if self.remote_addr.startswith("127.") else "eth0"
         return [(dev, "tcp")]

@@ -192,7 +192,12 @@ class Worker:
 
         with self.lock:
             self._require_running()
-            transport = conn.kind if conn is not None else "tcp"
+            if conn is None:
+                transport = "tcp"
+            elif getattr(conn, "sm_negotiated", False):
+                transport = "sm"
+            else:
+                transport = conn.kind
         return perf.estimate(transport, msg_size)
 
     # --------------------------------------------------------- engine side

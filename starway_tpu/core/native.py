@@ -10,10 +10,12 @@ Presents the same worker protocol as the pure-Python engine
 (core/engine.py): ``NativeClientWorker`` / ``NativeServerWorker`` with
 ``submit_send`` / ``post_recv`` / ``submit_flush`` / ``close`` / endpoint
 introspection, so the api layer swaps engines transparently.  The native
-engine covers the TCP path (it speaks the same wire protocol as the Python
-engine, so mixed-engine processes interoperate); the in-process fast path
-and device plane stay in Python, which is why native selection requires
-pure-TCP mode (``STARWAY_TLS=tcp`` + ``STARWAY_NATIVE=1``).
+engine covers the host paths -- TCP and the negotiated same-host
+shared-memory rings (``sm``, core/shmring.py) -- speaking the same wire
+protocol as the Python engine, so mixed-engine processes interoperate over
+either.  The in-process fast path and device plane stay in Python, which
+is why native selection requires inproc-free mode (``STARWAY_TLS=tcp`` or
+``tcp,sm``, plus ``STARWAY_NATIVE=1``).
 
 Lifetime/GIL notes: callbacks cross from the engine thread through ctypes
 trampolines, which acquire the GIL.  Each pending op holds its Python buffer
@@ -197,6 +199,7 @@ class NativeConn:
     def __init__(self, worker: "NativeWorkerBase", conn_id: int):
         self.worker = worker
         self.conn_id = conn_id
+        self._transports: Optional[list[tuple[str, str]]] = None
 
     def _info(self) -> dict:
         lib = load()
@@ -235,8 +238,15 @@ class NativeConn:
         return int(self._info().get("remote_port", 0))
 
     def transports(self) -> list[tuple[str, str]]:
-        dev = "lo" if self.remote_addr.startswith("127.") else "eth0"
-        return [(dev, "tcp+native")]
+        # The transport is fixed at handshake time: memoize so per-message
+        # callers (evaluate_perf) pay the FFI round-trip once.
+        if self._transports is None:
+            if self._info().get("transport") == "sm":
+                self._transports = [("shm", "sm")]
+            else:
+                dev = "lo" if self.remote_addr.startswith("127.") else "eth0"
+                self._transports = [(dev, "tcp+native")]
+        return self._transports
 
 
 # --------------------------------------------------------------- workers
@@ -362,7 +372,10 @@ class NativeWorkerBase:
         from .. import perf
 
         self._require_running()
-        return perf.estimate("tcp", msg_size)
+        transport = "tcp"
+        if isinstance(conn, NativeConn) and conn.transports() == [("shm", "sm")]:
+            transport = "sm"
+        return perf.estimate(transport, msg_size)
 
     def __del__(self):
         try:

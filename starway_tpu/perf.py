@@ -19,6 +19,7 @@ from __future__ import annotations
 # transport -> (alpha seconds, beta bytes/second)
 LINK_MODELS: dict[str, tuple[float, float]] = {
     "inproc": (2.0e-6, 30.0e9),  # same-process memcpy / HBM-to-HBM handoff
+    "sm": (25.0e-6, 5.0e9),  # same-host shared-memory rings (core/shmring.py)
     "tcp": (30.0e-6, 2.5e9),  # host loopback / DCN-adjacent bootstrap path
     "ici": (1.0e-6, 45.0e9),  # v5e ICI per-link, one direction
     "dcn": (50.0e-6, 12.5e9),  # cross-slice data-center network

@@ -35,23 +35,29 @@ def port():
     return random.randint(10000, 50000)
 
 
-@pytest.fixture(params=["inproc", "tcp", "sm", "native"])
+@pytest.fixture(params=["inproc", "tcp", "sm", "native", "native-sm"])
 def transport(request, monkeypatch):
-    """Four data planes behind one contract: in-process fast path, Python
-    TCP engine, shared-memory rings negotiated over TCP, C++ native TCP
-    engine (parity-tested by the same suite)."""
+    """Five data planes behind one contract: in-process fast path, Python
+    TCP engine, shared-memory rings negotiated over TCP (Python and C++
+    engines), C++ native TCP engine (parity-tested by the same suite)."""
     if request.param == "tcp":
         monkeypatch.setenv("STARWAY_TLS", "tcp")
         monkeypatch.setenv("STARWAY_NATIVE", "0")
     elif request.param == "sm":
+        import platform
+
+        if platform.machine() not in ("x86_64", "AMD64"):
+            # The Python ring needs TSO (config.sm_enabled gates it); don't
+            # silently rerun the tcp path under an sm label.
+            pytest.skip("python sm transport requires x86-64")
         monkeypatch.setenv("STARWAY_TLS", "tcp,sm")
         monkeypatch.setenv("STARWAY_NATIVE", "0")
-    elif request.param == "native":
+    elif request.param in ("native", "native-sm"):
         from starway_tpu.core import native
 
         if not native.available():
             pytest.skip("native engine unavailable (no toolchain)")
-        monkeypatch.setenv("STARWAY_TLS", "tcp")
+        monkeypatch.setenv("STARWAY_TLS", "tcp" if request.param == "native" else "tcp,sm")
         monkeypatch.setenv("STARWAY_NATIVE", "1")
     return request.param
 

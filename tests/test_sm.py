@@ -56,6 +56,10 @@ def port():
 
 @pytest.fixture
 def sm_env(monkeypatch):
+    import platform
+
+    if platform.machine() not in ("x86_64", "AMD64"):
+        pytest.skip("python sm transport requires x86-64 (TSO ring publication)")
     monkeypatch.setenv("STARWAY_TLS", "tcp,sm")
     monkeypatch.setenv("STARWAY_NATIVE", "0")
 
@@ -262,6 +266,74 @@ async def test_sm_client_send_flush_semantics(port, sm_env, with_flush, shm_base
         assert not done
         p.kill()
         p.join()
+    p.close()
+    await server.aclose()
+    assert not _shm_leftovers(shm_baseline)
+
+
+def _child_client_echo(port, native_engine):
+    """Send 32 MiB, flush, then expect a 1 KiB echo; exit 0 proves both
+    directions delivered through whatever transport was negotiated."""
+    os.environ["STARWAY_TLS"] = "tcp,sm"
+    os.environ["STARWAY_NATIVE"] = "1" if native_engine else "0"
+
+    async def inner():
+        client = None
+        for i in range(60):
+            client = Client()
+            try:
+                await client.aconnect(SERVER_ADDR, port)
+                break
+            except Exception:
+                if i == 59:
+                    raise
+                await asyncio.sleep(0.25)
+        payload = np.arange(32 << 20, dtype=np.uint8)
+        await client.asend(payload, 0x7)
+        await client.aflush()
+        buf = np.zeros(1024, dtype=np.uint8)
+        _, ln = await client.arecv(buf, 0x8, (1 << 64) - 1)
+        assert ln == 1024 and np.array_equal(buf, (np.arange(1024) % 256).astype(np.uint8))
+        await client.aclose()
+
+    asyncio.run(inner())
+
+
+@pytest.mark.parametrize(
+    "server_native,client_native",
+    [(False, True), (True, False), (True, True)],
+    ids=["py-server/native-client", "native-server/py-client", "native/native"],
+)
+async def test_sm_engine_interop(port, monkeypatch, shm_baseline, server_native, client_native):
+    """The sm ring layout is a cross-engine contract (CLAUDE.md "two
+    engines, one contract"): every engine pairing must negotiate sm and move
+    data both ways across a real process boundary."""
+    from starway_tpu.core import native
+
+    if not native.available():
+        pytest.skip("native engine unavailable (no toolchain)")
+    monkeypatch.setenv("STARWAY_TLS", "tcp,sm")
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if server_native else "0")
+
+    server = Server()
+    server.listen(SERVER_ADDR, port)
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_child_client_echo, args=(port, client_native), daemon=True)
+    p.start()
+    for _ in range(3000):
+        if server.list_clients():
+            break
+        await asyncio.sleep(0.01)
+    ep = next(iter(server.list_clients()))
+
+    recv_buf = np.zeros(32 << 20, dtype=np.uint8)
+    _, ln = await server.arecv(recv_buf, 0x7, (1 << 64) - 1)
+    assert ln == 32 << 20
+    np.testing.assert_array_equal(recv_buf, np.arange(32 << 20, dtype=np.uint8))
+    assert ep.view_transports() == [("shm", "sm")]
+    await server.asend(ep, (np.arange(1024) % 256).astype(np.uint8), 0x8)
+    p.join(120)  # child asserts the echo landed; exit 0 proves delivery
+    assert p.exitcode == 0
     p.close()
     await server.aclose()
     assert not _shm_leftovers(shm_baseline)
