@@ -106,6 +106,15 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
 
     pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
     grid = (b * hkv, t_pad // block_k)
+
+    # Clamp the K/V block index at the last block containing <= pos: the
+    # kernel body is skipped for blocks past pos (pl.when), and a repeated
+    # block index makes the Pallas pipeline elide the HBM copy entirely --
+    # so a decode at pos streams only ceil((pos+1)/block_k) blocks, not the
+    # whole padded cache.  (pl.when alone skips compute, not DMA.)
+    def _kv_index(bh, ki, pos_ref):
+        return (bh, jnp.minimum(ki, pos_ref[0] // block_k), 0)
+
     out = pl.pallas_call(
         functools.partial(_decode_kernel, sm_scale=sm_scale, block_k=block_k),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -113,8 +122,8 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, rows, d), lambda bh, ki, pos_ref: (bh, 0, 0)),
-                pl.BlockSpec((1, block_k, d), lambda bh, ki, pos_ref: (bh, ki, 0)),
-                pl.BlockSpec((1, block_k, d), lambda bh, ki, pos_ref: (bh, ki, 0)),
+                pl.BlockSpec((1, block_k, d), _kv_index),
+                pl.BlockSpec((1, block_k, d), _kv_index),
             ],
             out_specs=pl.BlockSpec((1, rows, d), lambda bh, ki, pos_ref: (bh, 0, 0)),
             scratch_shapes=[

@@ -35,7 +35,7 @@ def test_flash_no_gqa():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5)
 
 
-@pytest.mark.parametrize("pos", [0, 5, 128, 299])
+@pytest.mark.parametrize("pos", [0, 5, 127, 128, 299])
 def test_decode_kernel_matches_lax(pos):
     from starway_tpu.models.generate import _attend_cached
     from starway_tpu.ops.pallas_decode import decode_attention
