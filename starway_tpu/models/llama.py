@@ -285,15 +285,36 @@ def make_train_step(cfg: LlamaConfig, tx, attn_fn: Optional[Callable] = None):
 
 
 def make_sharded_attn(mesh, *, seq_axis: str = "sp", dp_axis: str = "dp",
-                      tp_axis: str = "tp"):
+                      tp_axis: str = "tp", layout: str = "ring"):
     """Sequence-parallel ring attention for use as ``attn_fn`` inside the
     GSPMD-jitted forward: q/k/v arrive [B, H, S, Dh] with batch sharded over
     dp, heads over tp, sequence over sp; the (grouped, narrow) kv shards
-    ride the ICI ring.  Requires n_kv_heads % tp == 0."""
-    from ..parallel.ring_attention import ring_attention
+    ride the ICI ring.  Requires n_kv_heads % tp == 0.
+
+    ``layout="zigzag"`` uses the load-balanced causal layout
+    (parallel/ring_attention.py:zigzag_indices): ~2x causal wall-clock at
+    long S because no device spends ring steps on fully-masked blocks, at
+    the cost of a sequence permutation (an sp-axis shuffle) per call --
+    worth it when S is large enough that attention compute dominates.
+    """
+    from ..parallel.ring_attention import (
+        ring_attention,
+        zigzag_ring_attention,
+        zigzag_wrap,
+    )
     from ..parallel.sharding import shard_map_fn
 
+    if layout not in ("ring", "zigzag"):
+        raise ValueError(f"unknown attention layout {layout!r}; expected 'ring' or 'zigzag'")
+
     spec = P(dp_axis, tp_axis, seq_axis, None)
+
+    if layout == "zigzag":
+        def local_z(q, k, v):
+            return zigzag_ring_attention(q, k, v, seq_axis)
+
+        inner = shard_map_fn(mesh, local_z, in_specs=(spec, spec, spec), out_specs=spec)
+        return zigzag_wrap(inner, mesh.shape[seq_axis])
 
     def local(q, k, v):
         return ring_attention(q, k, v, seq_axis, causal=True)

@@ -14,7 +14,13 @@ those patterns are first-class, TPU-native:
 """
 
 from .sharding import make_mesh, mesh_sharding
-from .ring_attention import ring_attention, make_ring_attention
+from .ring_attention import (
+    make_ring_attention,
+    make_zigzag_ring_attention,
+    ring_attention,
+    zigzag_indices,
+    zigzag_ring_attention,
+)
 from .all_to_all import make_shuffle
 from .dp_exchange import ClientPort, ServerPort, recv_pytree, send_pytree
 

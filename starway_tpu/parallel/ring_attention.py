@@ -18,6 +18,8 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -71,6 +73,145 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
     acc, k_last, v_last = lax.fori_loop(0, n - 1, body, (zero_partial(q), k, v))
     acc = compute(n - 1, acc, k_last, v_last)
     return finalize_partial(*acc, out_dtype=q.dtype)
+
+
+def zigzag_indices(s: int, n: int) -> np.ndarray:
+    """Global sequence permutation for the zigzag causal layout.
+
+    The plain ring layout is causally imbalanced: device ``d`` has useful
+    (unmasked) work on only ``d+1`` of ``n`` ring steps, and SPMD lockstep
+    makes every step as slow as the busiest device -- so half the ring's
+    MXU time is spent computing fully-masked scores.  Zigzag (the "striped"
+    fix, cf. Brandon et al., Striped Attention, arXiv:2311.09431) gives
+    each device one block from the front of the sequence and its mirror
+    from the back: blocks ``d`` and ``2n-1-d``.  Every device then has
+    exactly one fully-live pair plus one conditionally-live pair per step
+    -- uniform work, ~2x causal wall-clock at scale.
+
+    Returns the gather indices (length ``s``, requires ``2n | s``) mapping
+    the natural sequence into zigzag order; invert with ``np.argsort``.
+    """
+    if s % (2 * n):
+        raise ValueError(f"zigzag needs sequence length divisible by 2n={2*n}, got {s}")
+    sb = s // (2 * n)
+    blocks = []
+    for d in range(n):
+        blocks.append(d)
+        blocks.append(2 * n - 1 - d)
+    return np.concatenate([np.arange(b * sb, (b + 1) * sb) for b in blocks])
+
+
+def zigzag_ring_attention(q, k, v, axis_name: str, *, sm_scale: Optional[float] = None):
+    """Per-device body (call inside shard_map) for causal zigzag ring
+    attention.  Local shards are in zigzag layout (see :func:`zigzag_indices`):
+    the first half of the local sequence is original block ``my`` (global
+    offset ``my*sb``), the second half is block ``2n-1-my``.
+
+    Per ring step the four (q-half, kv-half) pairs are either fully live,
+    diagonal, or fully in the future; the future pairs are skipped with
+    ``lax.cond`` so no MXU time is spent on all-masked scores:
+
+    * ``q_hi  vs kv_lo`` -- always live (back blocks see all front blocks)
+    * ``q_lo  vs kv_lo`` -- live iff ``my >= src`` (diagonal at ``my == src``)
+    * ``q_hi  vs kv_hi`` -- live iff ``my <= src``
+    * ``q_lo  vs kv_hi`` -- never live (front blocks never see back blocks)
+
+    Exactness comes from the same associative merge as :func:`ring_attention`;
+    skipped pairs contribute nothing by construction.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    sb = q.shape[2] // 2
+    n_rep = q.shape[1] // k.shape[1]
+
+    q_lo, q_hi = q[:, :, :sb], q[:, :, sb:]
+    off_lo = my * sb                 # global offset of our front block
+    off_hi = (2 * n - 1 - my) * sb   # global offset of our mirrored back block
+
+    def compute(src, acc_lo, acc_hi, k_cur, v_cur):
+        ke = repeat_kv(k_cur, n_rep)
+        ve = repeat_kv(v_cur, n_rep)
+        k_lo, k_hi = ke[:, :, :sb], ke[:, :, sb:]
+        v_lo, v_hi = ve[:, :, :sb], ve[:, :, sb:]
+        src_lo = src * sb
+        src_hi = (2 * n - 1 - src) * sb
+
+        acc_hi = merge_partials(
+            acc_hi,
+            partial_attention(q_hi, k_lo, v_lo, q_offset=off_hi,
+                              kv_offset=src_lo, causal=True, sm_scale=sm_scale),
+        )
+        acc_lo = lax.cond(
+            my >= src,
+            lambda a: merge_partials(
+                a,
+                partial_attention(q_lo, k_lo, v_lo, q_offset=off_lo,
+                                  kv_offset=src_lo, causal=True, sm_scale=sm_scale),
+            ),
+            lambda a: a,
+            acc_lo,
+        )
+        acc_hi = lax.cond(
+            my <= src,
+            lambda a: merge_partials(
+                a,
+                partial_attention(q_hi, k_hi, v_hi, q_offset=off_hi,
+                                  kv_offset=src_hi, causal=True, sm_scale=sm_scale),
+            ),
+            lambda a: a,
+            acc_hi,
+        )
+        return acc_lo, acc_hi
+
+    def body(i, carry):
+        acc_lo, acc_hi, k_cur, v_cur = carry
+        src = (my - i) % n
+        acc_lo, acc_hi = compute(src, acc_lo, acc_hi, k_cur, v_cur)
+        k_cur = ring_shift(k_cur, axis_name, 1)
+        v_cur = ring_shift(v_cur, axis_name, 1)
+        return acc_lo, acc_hi, k_cur, v_cur
+
+    acc_lo, acc_hi, k_last, v_last = lax.fori_loop(
+        0, n - 1, body, (zero_partial(q_lo), zero_partial(q_hi), k, v)
+    )
+    acc_lo, acc_hi = compute((my - (n - 1)) % n, acc_lo, acc_hi, k_last, v_last)
+    out_lo = finalize_partial(*acc_lo, out_dtype=q.dtype)
+    out_hi = finalize_partial(*acc_hi, out_dtype=q.dtype)
+    return jnp.concatenate([out_lo, out_hi], axis=2)
+
+
+def zigzag_wrap(inner, n: int):
+    """Wrap a zigzag-layout attention callable (global view, natural-order
+    in/out): permutes q/k/v into zigzag order, runs ``inner``, inverts the
+    permutation on the output.  Persistent-layout users skip this and call
+    :func:`zigzag_ring_attention` directly inside their own shard_map,
+    keeping activations zigzagged across layers and paying the shuffle
+    once."""
+
+    def fn(q, k, v):
+        perm = zigzag_indices(q.shape[2], n)
+        inv = np.argsort(perm)
+        qz = jnp.take(q, perm, axis=2)
+        kz = jnp.take(k, perm, axis=2)
+        vz = jnp.take(v, perm, axis=2)
+        return jnp.take(inner(qz, kz, vz), inv, axis=2)
+
+    return fn
+
+
+def make_zigzag_ring_attention(mesh, axis_name: str = "sp", *,
+                               sm_scale: Optional[float] = None):
+    """Jitted global-view causal ring attention in the load-balanced zigzag
+    layout: q/k/v are natural-order global arrays ``[B, H, S, D]`` sharded
+    on the sequence dimension; the permutation into and out of zigzag order
+    is applied at the jit boundary."""
+    spec = P(None, None, axis_name, None)
+
+    def local(q, k, v):
+        return zigzag_ring_attention(q, k, v, axis_name, sm_scale=sm_scale)
+
+    inner = shard_map_fn(mesh, local, in_specs=(spec, spec, spec), out_specs=spec)
+    return jax.jit(zigzag_wrap(inner, mesh.shape[axis_name]))
 
 
 def make_ring_attention(mesh, axis_name: str = "sp", *, causal: bool = True,

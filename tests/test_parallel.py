@@ -75,6 +75,50 @@ def test_ring_attention_bf16():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.06, rtol=0.06)
 
 
+def test_zigzag_indices_is_permutation():
+    from starway_tpu.parallel import zigzag_indices
+
+    idx = zigzag_indices(256, 8)
+    assert sorted(idx) == list(range(256))
+    # device 0's shard = first S/n entries = blocks 0 and 2n-1
+    sb = 256 // 16
+    np.testing.assert_array_equal(idx[:sb], np.arange(0, sb))
+    np.testing.assert_array_equal(idx[sb : 2 * sb], np.arange(15 * sb, 16 * sb))
+    with pytest.raises(ValueError):
+        zigzag_indices(100, 8)  # not divisible by 2n
+
+
+@pytest.mark.parametrize("gqa", [1, 2])
+def test_zigzag_ring_attention_matches_reference(gqa):
+    """Load-balanced causal layout must be exact, including grouped kv."""
+    from starway_tpu.parallel import make_zigzag_ring_attention
+
+    mesh = make_mesh({"sp": 8})
+    q, _, _ = _qkv(jax.random.PRNGKey(3), t=256)
+    _, k, v = _qkv(jax.random.PRNGKey(4), h=4 // gqa, t=256)
+    ref = attention_reference(q, repeat_kv(k, gqa), repeat_kv(v, gqa), causal=True)
+
+    zig = make_zigzag_ring_attention(mesh, "sp")
+    qs = shard_array(mesh, q, None, None, "sp", None)
+    ks = shard_array(mesh, k, None, None, "sp", None)
+    vs = shard_array(mesh, v, None, None, "sp", None)
+    out = zig(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_zigzag_via_model_sharded_attn():
+    """make_sharded_attn(layout='zigzag') slots in as the model's attn_fn."""
+    from starway_tpu.models.llama import make_sharded_attn
+    from starway_tpu.parallel import make_mesh as _mm
+
+    mesh = _mm({"dp": 1, "tp": 1, "sp": 8})
+    q, k, v = _qkv(jax.random.PRNGKey(5), t=128)
+    ref = attention_reference(q, k, v, causal=True)
+    attn = make_sharded_attn(mesh, layout="zigzag")
+    out = jax.jit(attn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
 def test_shuffle_transposes_ownership():
     mesh = make_mesh({"x": 8})
     s, b, d = 16, 8, 4
