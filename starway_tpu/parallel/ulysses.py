@@ -9,8 +9,11 @@ all-to-all restores the layout.  Two collectives total per attention call --
 cheaper than a ring when n_heads >= mesh axis size and sequence length
 dominates; the ring wins for GQA models with few kv heads.
 
-Requires ``n_heads % axis_size == 0`` (and kv heads are pre-expanded when
-grouped, since head shards must align).
+Requires ``n_heads % axis_size == 0``.  Grouped kv stays narrow across the
+all-to-all whenever ``n_kv_heads % axis_size == 0`` -- the collectives move
+``1/n_rep`` of the expanded traffic and the expansion happens locally after
+re-sharding (block-aligned head ranges keep the q-head -> kv-head mapping
+exact); otherwise kv is pre-expanded so head shards align.
 """
 
 from __future__ import annotations
@@ -31,14 +34,24 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True,
     ``[B, H, T_local, D]`` with the FULL head dimension; returns the local
     sequence shard of the output."""
     n = lax.axis_size(axis_name)
-    if k.shape[1] != q.shape[1]:
-        n_rep = q.shape[1] // k.shape[1]
+    n_rep = q.shape[1] // k.shape[1]
+    if n_rep > 1 and k.shape[1] % n != 0:
+        # Narrow heads don't split evenly over the axis: pre-expand.
         k = repeat_kv(k, n_rep)
         v = repeat_kv(v, n_rep)
+        n_rep = 1
     # [B, H, T/n, D] -> [B, H/n, T, D]: scatter heads, gather sequence.
+    # Grouped kv rides the all-to-all narrow (1/n_rep of the bytes): device
+    # d ends up with q heads [d*H/n, (d+1)*H/n) and kv heads
+    # [d*Hkv/n, (d+1)*Hkv/n), which are exactly each other's GQA partners
+    # (q head h uses kv head h // n_rep), so the local repeat_kv below
+    # reproduces the global mapping.
     q2 = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
     k2 = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
     v2 = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    if n_rep > 1:
+        k2 = repeat_kv(k2, n_rep)
+        v2 = repeat_kv(v2, n_rep)
     o2 = blockwise_attention(q2, k2, v2, causal=causal, sm_scale=sm_scale)
     # Restore: [B, H/n, T, D] -> [B, H, T/n, D].
     return lax.all_to_all(o2, axis_name, split_axis=2, concat_axis=1, tiled=True)

@@ -35,6 +35,25 @@ def test_ulysses_matches_reference(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+def test_ulysses_gqa_narrow_fallback():
+    """Hkv not divisible by the axis: kv pre-expands (the non-narrow path)
+    and results stay exact."""
+    mesh = make_mesh({"sp": 4})
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(6), 3)
+    B, Hq, Hkv, S, D = 2, 8, 2, 128, 32
+    q = jax.random.normal(k1, (B, Hq, S, D), jnp.float32)
+    k = jax.random.normal(k2, (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(k3, (B, Hkv, S, D), jnp.float32)
+    ref = attention_reference(q, repeat_kv(k, 4), repeat_kv(v, 4), causal=True)
+
+    ul = make_ulysses_attention(mesh, "sp", causal=True)
+    qs = shard_array(mesh, q, None, None, "sp", None)
+    ks = shard_array(mesh, k, None, None, "sp", None)
+    vs = shard_array(mesh, v, None, None, "sp", None)
+    out = ul(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
 def test_pipeline_matches_sequential():
     mesh = make_mesh({"pp": 4})
     n_stages, m, mb, d = 4, 6, 2, 16
