@@ -30,6 +30,7 @@
 #include <sys/random.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -696,13 +697,97 @@ struct Worker {
     // EAGAIN: socket buffer already full of unread doorbells - peer will wake.
   }
 
+  // Gather pending tx bytes across queue items into one sendmsg: small
+  // messages cost one syscall (and one TCP segment) for header+payload
+  // instead of two, and bursts of messages coalesce.  Returns bytes
+  // written, 0 when the socket is full, -1 when the conn broke.
+  ssize_t tcp_tx_gather(Conn* c, FireList& fires) {
+    constexpr int kMaxIov = 64;
+    constexpr uint64_t kMaxBytes = 4u << 20;
+    struct iovec iov[kMaxIov];
+    int niov = 0;
+    uint64_t bytes = 0;
+    for (auto& item : c->tx) {
+      if (niov >= kMaxIov || bytes >= kMaxBytes) break;
+      uint64_t hlen = item.header.size();
+      uint64_t off = item.off;
+      if (off < hlen) {
+        iov[niov].iov_base = (void*)(item.header.data() + off);
+        iov[niov].iov_len = (size_t)(hlen - off);
+        bytes += iov[niov].iov_len;
+        niov++;
+        off = hlen;
+      }
+      if (niov < kMaxIov && off < item.total() && bytes < kMaxBytes) {
+        uint64_t po = off - hlen;
+        uint64_t left = item.paylen - po;
+        uint64_t room = kMaxBytes - bytes;
+        size_t n = (size_t)(left < room ? left : room);
+        iov[niov].iov_base = (void*)(item.payload + po);
+        iov[niov].iov_len = n;
+        bytes += n;
+        niov++;
+      }
+    }
+    if (niov == 0) return 0;
+    msghdr msg{};
+    msg.msg_iov = iov;
+    msg.msg_iovlen = (size_t)niov;
+    ssize_t w = ::sendmsg(c->fd, &msg, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+      conn_broken(c, fires);
+      return -1;
+    }
+    return w;
+  }
+
   void kick_tx(Conn* c, FireList& fires) {
     if (!c->alive) return;
     uint64_t t0 = c->sm_active ? c->sm_tx.tail().load(std::memory_order_relaxed) : 0;
-    while (!c->tx.empty()) {
+    bool blocked = false;
+    while (!c->tx.empty() && !blocked) {
+      if (!c->tx_via_ring) {
+        // TCP: one gathered sendmsg per pass, then account the bytes to
+        // the queued items in order.
+        ssize_t w = tcp_tx_gather(c, fires);
+        if (w < 0) return;  // conn_broken already ran
+        if (w == 0) {
+          blocked = true;
+          break;
+        }
+        uint64_t budget = (uint64_t)w;
+        while (budget > 0 && !c->tx.empty()) {
+          TxItem& item = c->tx.front();
+          uint64_t take = item.total() - item.off;
+          if (take > budget) take = budget;
+          item.off += take;
+          budget -= take;
+          if (item.is_data && item.rndv && !item.local_done &&
+              item.off >= item.header.size()) {
+            item.local_done = true;
+            if (item.done) {
+              auto done = item.done; auto ctx = item.ctx;
+              fires.push_back([done, ctx] { done(ctx); });
+            }
+          }
+          if (item.off >= item.total()) {
+            if (item.is_data && !item.local_done) {
+              item.local_done = true;
+              if (item.done) {
+                auto done = item.done; auto ctx = item.ctx;
+                fires.push_back([done, ctx] { done(ctx); });
+              }
+            }
+            fire_release(item, fires);
+            c->tx.pop_front();
+          }
+        }
+        continue;
+      }
+      // Ring path: stream the front item chunk-by-chunk (no syscalls).
       TxItem& item = c->tx.front();
       uint64_t hlen = item.header.size();
-      bool blocked = false;
       while (item.off < item.total()) {
         const uint8_t* p;
         size_t n;
@@ -716,13 +801,12 @@ struct Worker {
           n = left > (4u << 20) ? (4u << 20) : (size_t)left;
         }
         ssize_t w = conn_tx_write(c, p, n, fires);
-        if (w < 0) return;  // conn_broken already ran
+        if (w < 0) return;
         if (w == 0) {
           blocked = true;
           break;
         }
         item.off += (uint64_t)w;
-        // Rendezvous local completion: transmission begun (header written).
         if (item.is_data && item.rndv && !item.local_done && item.off >= hlen) {
           item.local_done = true;
           if (item.done) {
@@ -731,29 +815,31 @@ struct Worker {
           }
         }
       }
-      if (blocked) {
-        if (c->tx_via_ring) {
-          // Blocked on the ring, not the socket: EPOLLOUT would spin.  The
-          // consumer doorbells us when it frees space; the blocked sweep in
-          // run() covers a peer whose flag check raced.
-          sm_blocked.insert(c);
-        } else if (!c->want_write) {
-          c->want_write = true;
-          ep_mod_conn(c);
+      if (!blocked) {
+        if (item.is_data && !item.local_done) {
+          item.local_done = true;
+          if (item.done) {
+            auto done = item.done; auto ctx = item.ctx;
+            fires.push_back([done, ctx] { done(ctx); });
+          }
         }
-        if (c->sm_active && c->sm_tx.tail().load(std::memory_order_relaxed) != t0)
-          doorbell(c, fires);
-        return;
+        fire_release(item, fires);
+        c->tx.pop_front();
       }
-      if (item.is_data && !item.local_done) {
-        item.local_done = true;
-        if (item.done) {
-          auto done = item.done; auto ctx = item.ctx;
-          fires.push_back([done, ctx] { done(ctx); });
-        }
+    }
+    if (blocked) {
+      if (c->tx_via_ring) {
+        // Blocked on the ring, not the socket: EPOLLOUT would spin.  The
+        // consumer doorbells us when it frees space; the blocked sweep in
+        // run() covers a peer whose flag check raced.
+        sm_blocked.insert(c);
+      } else if (!c->want_write) {
+        c->want_write = true;
+        ep_mod_conn(c);
       }
-      fire_release(item, fires);
-      c->tx.pop_front();
+      if (c->sm_active && c->sm_tx.tail().load(std::memory_order_relaxed) != t0)
+        doorbell(c, fires);
+      return;
     }
     sm_blocked.erase(c);
     if (c->sm_active) c->sm_tx.blocked().store(0, std::memory_order_relaxed);

@@ -76,15 +76,23 @@ class TxData:
         hlen = len(self.header)
         while self.off < self.total:
             if self.off < hlen:
-                chunk = memoryview(self.header)[self.off :]
+                # Header + first payload chunk in one gathered write: small
+                # messages cost one syscall (and one TCP segment), not two.
+                views = [memoryview(self.header)[self.off :]]
+                if len(self.payload):
+                    views.append(self.payload[:TX_CHUNK])
+                try:
+                    n = conn._tx_writev(views)
+                except BlockingIOError:
+                    self._maybe_local_complete(fires)
+                    return False
             else:
                 p = self.off - hlen
-                chunk = self.payload[p : p + TX_CHUNK]
-            try:
-                n = conn._tx_write(chunk)
-            except BlockingIOError:
-                self._maybe_local_complete(fires)
-                return False
+                try:
+                    n = conn._tx_write(self.payload[p : p + TX_CHUNK])
+                except BlockingIOError:
+                    self._maybe_local_complete(fires)
+                    return False
             self.off += n
             self._maybe_local_complete(fires)
         if not self.local_done:
@@ -251,6 +259,24 @@ class TcpConn(BaseConn):
                 raise BlockingIOError
             ring.producer_blocked = 0
         return n
+
+    def _tx_writev(self, views: list) -> int:
+        """Gathered write of several views; raises BlockingIOError when the
+        transport cannot take any bytes."""
+        if not self._tx_via_ring:
+            return self.sock.sendmsg(views)
+        total = 0
+        for v in views:
+            try:
+                n = self._tx_write(v)
+            except BlockingIOError:
+                if total == 0:
+                    raise
+                break
+            total += n
+            if n < len(v):
+                break
+        return total
 
     def send_data(self, tag: int, payload: memoryview, done, fail, owner, fires: list) -> None:
         if not self.alive:
