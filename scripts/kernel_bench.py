@@ -1,0 +1,200 @@
+"""On-chip kernel benchmarks: tunnel-immune TFLOP/s for the hot kernels.
+
+Every benchmark jits a ``lax.fori_loop`` of N dependent kernel invocations so
+the whole measurement is ONE dispatch — the sandbox tunnel's ~100 ms RTT is
+amortized away and the number reflects on-device compute only.  The loop body
+perturbs the input with the previous output (``q + o*0``-style chaining would
+be folded; we add a tiny carry-dependent epsilon) so XLA cannot CSE the calls.
+
+Reference hook: /root/reference/benchmark.md defines transfer scenarios only;
+compute-efficiency benchmarks are the TPU build's own north star (VERDICT r1
+next-round #1/#4).
+
+Usage:  python scripts/kernel_bench.py [--iters 8] [--which all|matmul|flash|...]
+Emits one JSON line per benchmark row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _timeit(fn, *args, iters: int, reps: int = 4):
+    """Per-call seconds for `fn`'s kernel, tunnel-immune.
+
+    On this sandbox the device sits behind a tunnel with ~70-100 ms dispatch
+    RTT and `block_until_ready` returns before execution finishes, so we (a)
+    force a scalar device->host read to synchronize and (b) time the SAME
+    compiled loop at `iters` and at 1 iteration, using the difference to
+    cancel the constant tunnel/dispatch/readback cost.
+    """
+
+    def run(n):
+        c = jax.jit(functools.partial(fn, iters=n)).lower(*args).compile()
+        float(c(*args))  # warmup (compile transfer etc.)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(c(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # Difference two LONG runs: a tunnel hiccup in a short baseline run
+    # deflates the subtracted constant and wildly inflates the rate.  With
+    # both runs >> RTT the constant cancels and hiccups only shrink the
+    # reported rate slightly (best-of-reps already dampens them).
+    mid = max(iters // 2, 1)
+    t_hi, t_mid = run(iters), run(mid)
+    return max(t_hi - t_mid, 1e-9) / (iters - mid)
+
+
+def _chain(kernel, q, *rest, iters):
+    """fori_loop of `iters` dependent kernel calls; returns a sync scalar."""
+
+    def body(_, carry):
+        # carry-dependent zero-ish perturbation defeats CSE without changing
+        # the math measurably.
+        qq = q + carry[(0,) * carry.ndim].astype(q.dtype) * jnp.asarray(
+            1e-30, q.dtype
+        )
+        return kernel(qq, *rest)
+
+    out0 = kernel(q, *rest)
+    out = lax.fori_loop(0, iters - 1, body, out0)
+    return out[(0,) * out.ndim].astype(jnp.float32)
+
+
+def bench_matmul(n: int = 8192, iters: int = 8):
+    """bf16 n^3 matmul + tanh — the chip's demonstrated compute ceiling."""
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+
+    def k(a, b):
+        return jnp.tanh(jnp.dot(a, b, preferred_element_type=jnp.float32)).astype(
+            jnp.bfloat16
+        )
+
+    dt = _timeit(lambda a, b, iters: _chain(k, a, b, iters=iters), a, b, iters=iters)
+    tflops = 2 * n**3 / dt / 1e12
+    return {"metric": "matmul_ceiling_tflops", "value": round(tflops, 2),
+            "unit": "TFLOP/s", "detail": f"bf16 {n}^3, {dt*1e3:.2f} ms/iter"}
+
+
+def _attn_flops(b, hq, s, d, causal):
+    f = 4 * b * hq * s * s * d
+    return f // 2 if causal else f
+
+
+def bench_flash_fwd(b=1, hq=8, hkv=2, s=8192, d=128, causal=True, iters: int = 8,
+                    impl="ours"):
+    from starway_tpu.ops.pallas_attention import flash_attention
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, hq, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, hkv, s, d), jnp.bfloat16)
+
+    if impl == "ours":
+        kern = functools.partial(flash_attention, causal=causal)
+    elif impl == "stock":
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as stock,
+        )
+
+        # Stock kernel wants hq == hkv; expand grouped kv like repeat_kv.
+        def kern(q, k, v):
+            n_rep = hq // hkv
+            ke = jnp.repeat(k, n_rep, axis=1)
+            ve = jnp.repeat(v, n_rep, axis=1)
+            return stock(q, ke, ve, causal=causal,
+                         sm_scale=1.0 / d**0.5)
+    else:
+        raise ValueError(impl)
+
+    dt = _timeit(lambda q, k, v, iters: _chain(kern, q, k, v, iters=iters),
+                 q, k, v, iters=iters)
+    tflops = _attn_flops(b, hq, s, d, causal) / dt / 1e12
+    return {"metric": f"flash_fwd_{impl}_tflops", "value": round(tflops, 2),
+            "unit": "TFLOP/s",
+            "detail": f"B={b} Hq={hq} Hkv={hkv} S={s} D={d} causal={causal} "
+                      f"bf16, {dt*1e3:.2f} ms/iter"}
+
+
+def bench_flash_bwd(b=1, hq=8, hkv=2, s=8192, d=128, causal=True, iters: int = 4,
+                    impl="ours"):
+    from starway_tpu.ops.pallas_attention import flash_attention
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, hq, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, hkv, s, d), jnp.bfloat16)
+
+    if impl == "ours":
+        base = functools.partial(flash_attention, causal=causal)
+    elif impl == "stock":
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as stock,
+        )
+
+        def base(q, k, v):
+            n_rep = hq // hkv
+            return stock(q, jnp.repeat(k, n_rep, axis=1),
+                         jnp.repeat(v, n_rep, axis=1), causal=causal,
+                         sm_scale=1.0 / d**0.5)
+    else:
+        raise ValueError(impl)
+
+    def kern(q, k, v):
+        loss = lambda q, k, v: base(q, k, v).astype(jnp.float32).sum()
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return dq + 0 * dk.sum() + 0 * dv.sum()
+
+    dt = _timeit(lambda q, k, v, iters: _chain(kern, q, k, v, iters=iters),
+                 q, k, v, iters=iters)
+    # fwd (recomputed) + bwd ≈ 3.5x fwd flops (2 fwd matmuls + 5 bwd matmuls)
+    tflops = _attn_flops(b, hq, s, d, causal) * 3.5 / dt / 1e12
+    return {"metric": f"flash_fwdbwd_{impl}_tflops", "value": round(tflops, 2),
+            "unit": "TFLOP/s",
+            "detail": f"B={b} Hq={hq} Hkv={hkv} S={s} D={d} causal={causal} "
+                      f"bf16, {dt*1e3:.2f} ms/iter (fwd+bwd)"}
+
+
+BENCHES = {
+    "matmul": bench_matmul,
+    "flash": bench_flash_fwd,
+    "flash_stock": functools.partial(bench_flash_fwd, impl="stock"),
+    "flash_bwd": bench_flash_bwd,
+    "flash_bwd_stock": functools.partial(bench_flash_bwd, impl="stock"),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--which", default="all")
+    ap.add_argument("--iters", type=int, default=None)
+    args = ap.parse_args()
+    names = list(BENCHES) if args.which == "all" else args.which.split(",")
+    for name in names:
+        fn = BENCHES[name]
+        kw = {"iters": args.iters} if args.iters else {}
+        try:
+            row = fn(**kw)
+        except Exception as e:  # keep going; report the failure as a row
+            row = {"metric": name, "error": f"{type(e).__name__}: {e}"[:300]}
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
