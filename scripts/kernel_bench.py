@@ -54,6 +54,7 @@ def _timeit(fn, *args, iters: int, reps: int = 4):
     # deflates the subtracted constant and wildly inflates the rate.  With
     # both runs >> RTT the constant cancels and hiccups only shrink the
     # reported rate slightly (best-of-reps already dampens them).
+    iters = max(iters, 2)  # the difference needs two distinct loop counts
     mid = max(iters // 2, 1)
     t_hi, t_mid = run(iters), run(mid)
     return max(t_hi - t_mid, 1e-9) / (iters - mid)

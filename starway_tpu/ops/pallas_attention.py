@@ -87,8 +87,14 @@ def _round_up(x: int, m: int) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _mask_scores(s, q_start, k_start, kv_len, kv_pad, causal):
+def _mask_scores(s, q_start, k_start, kv_len, kv_pad, causal,
+                 k_start_local=None):
     """Apply causal/padding masking to a score block.
+
+    ``q_start``/``k_start`` are GLOBAL sequence coordinates (they differ
+    from the in-array block position when a ring step supplies offsets);
+    ``k_start_local`` is the in-array key position the padding compare
+    needs — it defaults to ``k_start`` for the offset-free path.
 
     The kv-padding compare is skipped at *trace* time when the sequence
     needs no padding (the common case); a scalar `lax.cond` around the
@@ -96,9 +102,11 @@ def _mask_scores(s, q_start, k_start, kv_len, kv_pad, causal):
     fuses the iota/compare/select into the softmax chain, a vector branch
     does not.
     """
+    if k_start_local is None:
+        k_start_local = k_start
     mask = None
     if kv_pad != kv_len:  # Python-level: only traced when padding exists
-        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        k_pos = k_start_local + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = k_pos < kv_len
     if causal:
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -239,7 +247,7 @@ def _fwd_impl(q, k, v, cfg: _Cfg, save_lse: bool):
 
 
 # ---------------------------------------------------------------------------
-# backward
+# backward + ring-step partials
 # ---------------------------------------------------------------------------
 #
 # Same [block_q, block_k] score layout as the forward.  p is recomputed
@@ -250,15 +258,23 @@ def _fwd_impl(q, k, v, cfg: _Cfg, save_lse: bool):
 # The transposed products contract dim 0 of both operands (A^T B form) —
 # the MXU takes them directly.  sm_scale on dK/dQ is applied once at
 # emission, not per block element.
+#
+# Every kernel below takes a scalar-prefetch int32[2] = [q_offset, kv_offset]
+# in GLOBAL sequence coordinates.  The plain flash_attention backward passes
+# zeros; ring attention (parallel/ring_attention.py) passes the traced
+# rotation offsets, which feed both the causal masking and the runtime
+# DMA-elision clamps in the index maps — dead blocks cost neither MXU nor
+# HBM bandwidth regardless of which ring step is executing.
 
 
-def _bwd_block(q, do, k, v, lse, delta, *, causal, sm_scale, q_start,
-               k_start, kv_len, kv_pad):
+def _bwd_block(q, do, k, v, lse, delta, *, causal, sm_scale, q_glob, k_glob,
+               k_local, kv_len, kv_pad):
     """Shared recompute: returns (p, ds), both [block_q, block_k] f32."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * sm_scale
-    s = _mask_scores(s, q_start, k_start, kv_len, kv_pad, causal)
+    s = _mask_scores(s, q_glob, k_glob, kv_len, kv_pad, causal,
+                     k_start_local=k_local)
     p = jnp.exp(s - lse)  # normalised probs; masked entries -> 0
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -267,7 +283,7 @@ def _bwd_block(q, do, k, v, lse, delta, *, causal, sm_scale, q_start,
     return p, ds
 
 
-def _bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(offs_ref, q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
                     sm_scale: float, block_q: int, block_k: int,
                     kv_len: int, kv_pad: int, n_q: int):
@@ -281,16 +297,17 @@ def _bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    k_start = ki * block_k
-    q_start = qi * block_q
+    k_local = ki * block_k
+    q_glob = offs_ref[0] + qi * block_q
+    k_glob = offs_ref[1] + k_local
 
     def _body():
         q = q_ref[0]                 # [block_q, D]
         do = do_ref[0]
         p, ds = _bwd_block(
             q, do, k_ref[0], v_ref[0], lse_ref[0][:, :1], delta_ref[0][:, :1],
-            causal=causal, sm_scale=sm_scale, q_start=q_start,
-            k_start=k_start, kv_len=kv_len, kv_pad=kv_pad,
+            causal=causal, sm_scale=sm_scale, q_glob=q_glob,
+            k_glob=k_glob, k_local=k_local, kv_len=kv_len, kv_pad=kv_pad,
         )
         # P^T dO and dS^T Q: contract the shared block_q dim (dim 0 of both).
         dv_scr[:] += jax.lax.dot_general(
@@ -304,7 +321,7 @@ def _bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
 
     if causal:
         # Live iff this q block reaches at or below the kv block's first row.
-        pl.when(q_start + block_q - 1 >= k_start)(_body)
+        pl.when(q_glob + block_q - 1 >= k_glob)(_body)
     else:
         _body()
 
@@ -314,7 +331,7 @@ def _bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
+def _bwd_dq_kernel(offs_ref, q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr, *, causal: bool, sm_scale: float,
                    block_q: int, block_k: int, kv_len: int, kv_pad: int):
     qi = pl.program_id(1)
@@ -325,15 +342,17 @@ def _bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    q_start = qi * block_q
-    k_start = ki * block_k
+    k_local = ki * block_k
+    q_glob = offs_ref[0] + qi * block_q
+    k_glob = offs_ref[1] + k_local
 
     def _body():
         k = k_ref[0]
         _, ds = _bwd_block(
             q_ref[0], do_ref[0], k, v_ref[0], lse_ref[0][:, :1],
             delta_ref[0][:, :1], causal=causal, sm_scale=sm_scale,
-            q_start=q_start, k_start=k_start, kv_len=kv_len, kv_pad=kv_pad,
+            q_glob=q_glob, k_glob=k_glob, k_local=k_local, kv_len=kv_len,
+            kv_pad=kv_pad,
         )
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -341,7 +360,7 @@ def _bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
         )
 
     if causal:
-        pl.when(k_start <= q_start + block_q - 1)(_body)
+        pl.when(k_glob <= q_glob + block_q - 1)(_body)
     else:
         _body()
 
@@ -350,44 +369,15 @@ def _bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
         dq_ref[0] = (dq_scr[:] * sm_scale).astype(dq_ref.dtype)
 
 
-def _bwd_impl(q, k, v, o, lse, do, cfg: _Cfg):
-    b, hq, s, d = q.shape
-    hkv = k.shape[1]
+def _run_bwd_passes(qf, dof, kf, vf, lse8, delta8, offs, *, b, hq, hkv,
+                    s_pad, kv_pad, d, kv_len, block_q, block_k, causal,
+                    sm_scale, interpret, dq_dtype, dkv_dtype):
+    """Both backward passes over flattened [BH, S, D] operands.
+
+    ``offs`` is the int32[2] global-offset vector (zeros for the plain
+    path).  Returns (dq [b*hq, s_pad, d], dk, dv [b*hkv, kv_pad, d]).
+    """
     n_rep = hq // hkv
-    kv_len = k.shape[2]
-
-    block_q = min(cfg.bwd_block_q, _round_up(s, 8))
-    block_k = min(cfg.bwd_block_k, _round_up(kv_len, 8))
-    s_pad = _round_up(s, block_q)
-    kv_pad = _round_up(kv_len, block_k)
-
-    # delta = rowsum(dO o O): one cheap fused XLA pass, [B,Hq,S].
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-
-    if s_pad != s:
-        pad = ((0, 0), (0, 0), (0, s_pad - s), (0, 0))
-        q = jnp.pad(q, pad)
-        do = jnp.pad(do, pad)  # zero rows -> zero dk/dv/ds contributions
-        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, s_pad - s)))
-        # Padded q rows contribute nothing (do = 0), but pad lse with +big
-        # so p = exp(s - lse) underflows to 0 instead of risking inf*0.
-        lse = jnp.pad(lse, ((0, 0), (0, s_pad - s), (0, 0)),
-                      constant_values=-NEG_BIG)
-    if kv_pad != kv_len:
-        pad = ((0, 0), (0, 0), (0, kv_pad - kv_len), (0, 0))
-        k = jnp.pad(k, pad)
-        v = jnp.pad(v, pad)
-
-    qf = q.reshape(b * hq, s_pad, d)
-    dof = do.reshape(b * hq, s_pad, d)
-    kf = k.reshape(b * hkv, kv_pad, d)
-    vf = v.reshape(b * hkv, kv_pad, d)
-    # Row constants in the [BH, S, 8] lane-8 layout (see module docstring);
-    # lse arrives that way from the forward already.
-    lsef = lse
-    deltaf = jnp.broadcast_to(
-        delta.reshape(b * hq, s_pad)[:, :, None], (b * hq, s_pad, 8))
-
     n_q = s_pad // block_q
     n_kv = kv_pad // block_k
 
@@ -396,72 +386,346 @@ def _bwd_impl(q, k, v, o, lse, do, cfg: _Cfg):
         r = inner // n_q
         return (bkv // hkv) * hq + (bkv % hkv) * n_rep + r
 
-    def qi_eff(ki, inner):
+    def qi_eff(ki, inner, offs):
         qi = jax.lax.rem(inner, n_q)
-        if cfg.causal:
+        if causal:
             # Clamp dead (above-diagonal) q blocks onto the first live one:
-            # their compute is skipped and their HBM DMA elided.
-            qi = jnp.maximum(qi, (ki * block_k) // block_q)
+            # their compute is skipped and their HBM DMA elided.  Global
+            # coords: first live q row is kv_off + ki*bk - q_off.
+            first = (offs[1] + ki * block_k - offs[0]) // block_q
+            qi = jnp.maximum(qi, jnp.clip(first, 0, n_q - 1))
         return qi
 
     qdo_spec = pl.BlockSpec(
-        (1, block_q, d), lambda bkv, ki, inner: (q_head(bkv, inner), qi_eff(ki, inner), 0))
+        (1, block_q, d),
+        lambda bkv, ki, inner, offs: (q_head(bkv, inner),
+                                      qi_eff(ki, inner, offs), 0))
     row_spec = pl.BlockSpec(
         (1, block_q, 8),
-        lambda bkv, ki, inner: (q_head(bkv, inner), qi_eff(ki, inner), 0))
-    kv_spec = pl.BlockSpec((1, block_k, d), lambda bkv, ki, inner: (bkv, ki, 0))
+        lambda bkv, ki, inner, offs: (q_head(bkv, inner),
+                                      qi_eff(ki, inner, offs), 0))
+    kv_spec = pl.BlockSpec(
+        (1, block_k, d), lambda bkv, ki, inner, offs: (bkv, ki, 0))
 
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, causal=cfg.causal, sm_scale=cfg.sm_scale,
-            block_q=block_q, block_k=block_k, kv_len=kv_len, kv_pad=kv_pad,
-            n_q=n_q,
-        ),
+    grid_a = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(b * hkv, n_kv, n_rep * n_q),
         in_specs=[qdo_spec, qdo_spec, kv_spec, kv_spec, row_spec, row_spec],
         out_specs=[kv_spec, kv_spec],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * hkv, kv_pad, d), k.dtype),
-            jax.ShapeDtypeStruct((b * hkv, kv_pad, d), v.dtype),
-        ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        interpret=cfg.interpret,
-    )(qf, dof, kf, vf, lsef, deltaf)
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, kv_len=kv_len, kv_pad=kv_pad,
+            n_q=n_q,
+        ),
+        grid_spec=grid_a,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hkv, kv_pad, d), dkv_dtype),
+            jax.ShapeDtypeStruct((b * hkv, kv_pad, d), dkv_dtype),
+        ],
+        interpret=interpret,
+    )(offs, qf, dof, kf, vf, lse8, delta8)
 
     # ---- pass B: dQ (q-stationary, sweeps kv blocks) ----
     def kv_head(bh):
         return (bh // hq) * hkv + (bh % hq) // n_rep
 
-    def ki_eff(i, j):
-        if cfg.causal:
-            j = jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
+    def ki_eff(i, j, offs):
+        if causal:
+            # Last kv block any row of q block i can see, in global coords.
+            last = (offs[0] + i * block_q + block_q - 1 - offs[1]) // block_k
+            j = jnp.minimum(j, jnp.clip(last, 0, n_kv - 1))
         return j
 
-    qdo_spec_b = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
-    row_spec_b = pl.BlockSpec((1, block_q, 8), lambda bh, i, j: (bh, i, 0))
+    qdo_spec_b = pl.BlockSpec(
+        (1, block_q, d), lambda bh, i, j, offs: (bh, i, 0))
+    row_spec_b = pl.BlockSpec(
+        (1, block_q, 8), lambda bh, i, j, offs: (bh, i, 0))
     kv_spec_b = pl.BlockSpec(
-        (1, block_k, d), lambda bh, i, j: (kv_head(bh), ki_eff(i, j), 0))
+        (1, block_k, d), lambda bh, i, j, offs: (kv_head(bh),
+                                                 ki_eff(i, j, offs), 0))
 
-    dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, causal=cfg.causal, sm_scale=cfg.sm_scale,
-            block_q=block_q, block_k=block_k, kv_len=kv_len, kv_pad=kv_pad,
-        ),
-        grid=(b * hq, n_q, n_kv),
+    grid_b = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * hq, s_pad // block_q, n_kv),
         in_specs=[qdo_spec_b, qdo_spec_b, kv_spec_b, kv_spec_b, row_spec_b,
                   row_spec_b],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * hq, s_pad, d), q.dtype),
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda bh, i, j, offs: (bh, i, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        interpret=cfg.interpret,
-    )(qf, dof, kf, vf, lsef, deltaf)
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, kv_len=kv_len, kv_pad=kv_pad,
+        ),
+        grid_spec=grid_b,
+        out_shape=jax.ShapeDtypeStruct((b * hq, s_pad, d), dq_dtype),
+        interpret=interpret,
+    )(offs, qf, dof, kf, vf, lse8, delta8)
+    return dq, dk, dv
 
-    dq = dq.reshape(b, hq, s_pad, d)[:, :, :s, :]
-    dk = dk.reshape(b, hkv, kv_pad, d)[:, :, :kv_len, :]
-    dv = dv.reshape(b, hkv, kv_pad, d)[:, :, :kv_len, :]
+
+def _bwd_operands(q, do, k, v, lse8, delta, block_q, block_k):
+    """Pad + flatten backward operands; returns dict of kernel inputs."""
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    kv_len = k.shape[2]
+    s_pad = _round_up(s, block_q)
+    kv_pad = _round_up(kv_len, block_k)
+
+    if s_pad != s:
+        pad = ((0, 0), (0, 0), (0, s_pad - s), (0, 0))
+        q = jnp.pad(q, pad)
+        do = jnp.pad(do, pad)  # zero rows -> zero dk/dv/ds contributions
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, s_pad - s)))
+        # Padded q rows contribute nothing (do = 0), but pad lse with +big
+        # so p = exp(s - lse) underflows to 0 instead of risking inf*0.
+        lse8 = jnp.pad(lse8, ((0, 0), (0, s_pad - s), (0, 0)),
+                       constant_values=-NEG_BIG)
+    if kv_pad != kv_len:
+        pad = ((0, 0), (0, 0), (0, kv_pad - kv_len), (0, 0))
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+
+    return dict(
+        qf=q.reshape(b * hq, s_pad, d),
+        dof=do.reshape(b * hq, s_pad, d),
+        kf=k.reshape(b * hkv, kv_pad, d),
+        vf=v.reshape(b * hkv, kv_pad, d),
+        lse8=lse8,
+        delta8=jnp.broadcast_to(
+            delta.reshape(b * hq, s_pad)[:, :, None], (b * hq, s_pad, 8)),
+        b=b, hq=hq, hkv=hkv, s_pad=s_pad, kv_pad=kv_pad, d=d, kv_len=kv_len,
+    )
+
+
+def _bwd_impl(q, k, v, o, lse, do, cfg: _Cfg):
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    kv_len = k.shape[2]
+    block_q = min(cfg.bwd_block_q, _round_up(s, 8))
+    block_k = min(cfg.bwd_block_k, _round_up(kv_len, 8))
+
+    # delta = rowsum(dO o O): one cheap fused XLA pass, [B,Hq,S].
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    ops = _bwd_operands(q, do, k, v, lse, delta, block_q, block_k)
+    dq, dk, dv = _run_bwd_passes(
+        ops.pop("qf"), ops.pop("dof"), ops.pop("kf"), ops.pop("vf"),
+        ops.pop("lse8"), ops.pop("delta8"), jnp.zeros((2,), jnp.int32),
+        block_q=block_q, block_k=block_k, causal=cfg.causal,
+        sm_scale=cfg.sm_scale, interpret=cfg.interpret,
+        dq_dtype=q.dtype, dkv_dtype=k.dtype, **ops)
+
+    dq = dq.reshape(b, hq, -1, d)[:, :, :s, :]
+    dk = dk.reshape(b, hkv, -1, d)[:, :, :kv_len, :]
+    dv = dv.reshape(b, hkv, -1, d)[:, :, :kv_len, :]
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# ring-step primitives: unnormalised partials at traced global offsets
+# ---------------------------------------------------------------------------
+
+
+def _partial_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                    m_scr, l_scr, acc_scr, *, causal: bool, sm_scale: float,
+                    block_q: int, block_k: int, kv_len: int, kv_pad: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    k_local = ki * block_k
+    q_glob = offs_ref[0] + qi * block_q
+    k_glob = offs_ref[1] + k_local
+
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        s = _mask_scores(s, q_glob, k_glob, kv_len, kv_pad, causal,
+                         k_start_local=k_local)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # Rows with NO visible key in any block so far have m_new = NEG_BIG;
+        # clamping only exp's argument (not the emitted m) keeps their p at
+        # exactly 0, so the emitted partial is the true identity (o=0, l=0,
+        # m=NEG_BIG) per partial_attention's mergeable contract.  Live rows
+        # always have m_new > NEG_BIG/2, so this is a no-op for them.
+        p = jnp.exp(s - jnp.maximum(m_new, NEG_BIG / 2))
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        pl.when(k_glob <= q_glob + block_q - 1)(_body)
+    else:
+        _body()
+
+    @pl.when(ki == n_k - 1)
+    def _emit():
+        # Unnormalised partial: (acc, m, l) merge associatively across ring
+        # steps (ops/attention.py::merge_partials).  Fully-masked rows --
+        # whether from skipped blocks or from masking inside a live block --
+        # emit the identity partial (acc=0, m=NEG_BIG, l=0; see the exp
+        # clamp above).
+        o_ref[0] = acc_scr[:].astype(o_ref.dtype)
+        m_ref[0] = jnp.broadcast_to(m_scr[:, :1], m_ref.shape[1:])
+        l_ref[0] = jnp.broadcast_to(l_scr[:, :1], l_ref.shape[1:])
+
+
+def flash_partial(q, k, v, q_offset, kv_offset, *, causal: bool = True,
+                  sm_scale: Optional[float] = None,
+                  block_q: Optional[int] = None,
+                  block_k: Optional[int] = None,
+                  interpret: Optional[bool] = None):
+    """One ring step's attention partial, Pallas-tiled.
+
+    ``q [B,Hq,T,D]`` against one kv shard ``[B,Hkv,Tkv,D]`` (grouped heads
+    accepted) whose global sequence positions start at ``kv_offset`` while
+    the queries start at ``q_offset`` — both may be traced scalars (they
+    ride a scalar-prefetch SMEM operand into the kernel and its index-map
+    DMA clamps).  Returns ``(o, m, l)`` in the mergeable unnormalised form
+    of ops/attention.py::partial_attention: o f32 ``[B,Hq,T,D]``, m/l f32
+    ``[B,Hq,T]``.
+
+    NOT differentiable — ring attention's custom_vjp (parallel/
+    ring_attention.py) pairs it with :func:`flash_partial_bwd`.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    n_rep = hq // hkv
+    kv_len = k.shape[2]
+
+    block_q = min(block_q or DEFAULT_BLOCK_Q, _round_up(s, 8))
+    block_k = min(block_k or DEFAULT_BLOCK_K, _round_up(kv_len, 8))
+    s_pad = _round_up(s, block_q)
+    kv_pad = _round_up(kv_len, block_k)
+    if s_pad != s:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, s_pad - s), (0, 0)))
+    if kv_pad != kv_len:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, kv_pad - kv_len), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, kv_pad - kv_len), (0, 0)))
+
+    qf = q.reshape(b * hq, s_pad, d)
+    kf = k.reshape(b * hkv, kv_pad, d)
+    vf = v.reshape(b * hkv, kv_pad, d)
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(kv_offset, jnp.int32)])
+
+    n_q = s_pad // block_q
+    n_kv = kv_pad // block_k
+
+    def kv_head(bh):
+        return (bh // hq) * hkv + (bh % hq) // n_rep
+
+    def kv_index(bh, i, j, offs):
+        if causal:
+            last = (offs[0] + i * block_q + block_q - 1 - offs[1]) // block_k
+            j = jnp.minimum(j, jnp.clip(last, 0, n_kv - 1))
+        return (kv_head(bh), j, 0)
+
+    row8 = pl.BlockSpec((1, block_q, 8), lambda bh, i, j, offs: (bh, i, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * hq, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j, offs: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j, offs: (bh, i, 0)),
+            row8,
+            row8,
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    o, m8, l8 = pl.pallas_call(
+        functools.partial(
+            _partial_kernel, causal=causal, sm_scale=float(sm_scale),
+            block_q=block_q, block_k=block_k, kv_len=kv_len, kv_pad=kv_pad,
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hq, s_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * hq, s_pad, 8), jnp.float32),
+            jax.ShapeDtypeStruct((b * hq, s_pad, 8), jnp.float32),
+        ],
+        interpret=bool(interpret),
+    )(offs, qf, kf, vf)
+    o = o.reshape(b, hq, s_pad, d)[:, :, :s, :]
+    m = m8[:, :, 0].reshape(b, hq, s_pad)[:, :, :s]
+    l = l8[:, :, 0].reshape(b, hq, s_pad)[:, :, :s]
+    return o, m, l
+
+
+def flash_partial_bwd(q, do, k, v, lse, delta, q_offset, kv_offset, *,
+                      causal: bool = True, sm_scale: Optional[float] = None,
+                      block_q: Optional[int] = None,
+                      block_k: Optional[int] = None,
+                      interpret: Optional[bool] = None):
+    """Gradient contributions of one ring step.
+
+    Inputs mirror :func:`flash_partial` plus the *globally merged* ``lse``
+    and ``delta = rowsum(dO o O)`` (both ``[B,Hq,T]`` f32) — with global
+    statistics, each step's contribution is exactly its slice of the full
+    attention gradient, so contributions sum across ring steps.  Returns
+    ``(dq, dk, dv)`` in f32 with dk/dv GROUPED ``[B,Hkv,Tkv,D]``.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    kv_len = k.shape[2]
+    block_q = min(block_q or DEFAULT_BWD_BLOCK_Q, _round_up(s, 8))
+    block_k = min(block_k or DEFAULT_BWD_BLOCK_K, _round_up(kv_len, 8))
+
+    lse8 = jnp.broadcast_to(
+        lse.astype(jnp.float32).reshape(b * hq, s)[:, :, None],
+        (b * hq, s, 8))
+    ops = _bwd_operands(q, do, k, v, lse8, delta.astype(jnp.float32),
+                        block_q, block_k)
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(kv_offset, jnp.int32)])
+    dq, dk, dv = _run_bwd_passes(
+        ops.pop("qf"), ops.pop("dof"), ops.pop("kf"), ops.pop("vf"),
+        ops.pop("lse8"), ops.pop("delta8"), offs,
+        block_q=block_q, block_k=block_k, causal=causal,
+        sm_scale=float(sm_scale), interpret=bool(interpret),
+        dq_dtype=jnp.float32, dkv_dtype=jnp.float32, **ops)
+    dq = dq.reshape(b, hq, -1, d)[:, :, :s, :]
+    dk = dk.reshape(b, hkv, -1, d)[:, :, :kv_len, :]
+    dv = dv.reshape(b, hkv, -1, d)[:, :, :kv_len, :]
     return dq, dk, dv
 
 

@@ -11,10 +11,24 @@ block's matmuls, so the ring rides ICI concurrently with MXU compute.
 Exactness comes from the associative merge in ops/attention.py -- blocks may
 arrive in any rotation order, which is also what makes the accumulation
 robust to mesh axis ordering.
+
+Both ring variants carry a ``jax.custom_vjp``:
+
+* forward: per-step partials come from the Pallas kernel
+  (ops/pallas_attention.py::flash_partial, ~7x the lax step rate on TPU) or
+  from the lax path elsewhere, selected per-backend at trace time.
+* backward: a second ring pass.  Each device keeps its q/do/lse/delta
+  resident and accumulates dq locally, while dk/dv accumulators *rotate
+  with their kv shard* -- after the full rotation each shard's gradient
+  arrives back at its home device having summed every device's
+  contribution.  Per-step math uses the globally merged lse/delta, so each
+  step's contribution is exactly its slice of the full attention gradient
+  (ops/pallas_attention.py::flash_partial_bwd).
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -24,6 +38,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import (
+    NEG_BIG,
     finalize_partial,
     merge_partials,
     partial_attention,
@@ -34,31 +49,87 @@ from ..ops.collectives import ring_shift
 from .sharding import shard_map_fn
 
 
-def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
-                   sm_scale: Optional[float] = None):
-    """Per-device body (call inside shard_map): q/k/v are local sequence
-    shards ``[B, H, T_local, D]``; returns the local output shard.
+def _use_kernel_default() -> bool:
+    return jax.default_backend() == "tpu"
 
-    Grouped-query kv is accepted unexpanded (``k/v`` with fewer heads): the
-    ring rotates the *narrow* kv shards and expands per step, so ICI moves
-    1/n_rep of the naive traffic.  Rotation schedule: after step ``i`` the
-    device holds kv shard ``(my_index - i) mod n``; global offsets feed the
-    causal mask so no cross-shard attention is wrongly masked or admitted.
-    The last compute step skips the rotation (n-1 ppermutes for n shards).
-    """
+
+# ---------------------------------------------------------------------------
+# per-step primitives (kernel + lax pairs, same contract)
+# ---------------------------------------------------------------------------
+
+
+def _step_fwd(q, k, v, q_off, kv_off, causal, sm_scale, use_kernel):
+    """One kv shard's unnormalised partial: (o f32, m f32, l f32)."""
+    if use_kernel:
+        from ..ops.pallas_attention import flash_partial
+
+        return flash_partial(q, k, v, q_off, kv_off, causal=causal,
+                             sm_scale=sm_scale)
+    n_rep = q.shape[1] // k.shape[1]
+    return partial_attention(
+        q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
+        q_offset=q_off, kv_offset=kv_off, causal=causal, sm_scale=sm_scale,
+    )
+
+
+def _step_bwd(q, do, k, v, lse, delta, q_off, kv_off, causal, sm_scale,
+              use_kernel):
+    """One kv shard's gradient contributions: (dq, dk, dv), f32, dk/dv
+    grouped.  lse/delta are the globally merged statistics."""
+    if use_kernel:
+        from ..ops.pallas_attention import flash_partial_bwd
+
+        return flash_partial_bwd(q, do, k, v, lse, delta, q_off, kv_off,
+                                 causal=causal, sm_scale=sm_scale)
+    b, hq, tq, d = q.shape
+    hkv, tk = k.shape[1], k.shape[2]
+    n_rep = hq // hkv
+    ke = repeat_kv(k, n_rep).astype(jnp.float32)
+    ve = repeat_kv(v, n_rep).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, ke) * sm_scale
+    if causal:
+        q_pos = q_off + jnp.arange(tq)
+        kv_pos = kv_off + jnp.arange(tk)
+        s = jnp.where((q_pos[:, None] >= kv_pos[None, :])[None, None], s,
+                      NEG_BIG)
+    p = jnp.exp(s - lse[..., None])  # normalised; masked entries -> 0
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, ve)
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, ke) * sm_scale
+    dke = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * sm_scale
+    dve = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    dk = dke.reshape(b, hkv, n_rep, tk, d).sum(2)
+    dv = dve.reshape(b, hkv, n_rep, tk, d).sum(2)
+    return dq, dk, dv
+
+
+def _lse_of(acc):
+    """Merged partial -> log-sum-exp (f32), the backward's row statistic."""
+    o, m, l = acc
+    return m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _rotate(xs, axis_name):
+    return tuple(ring_shift(x, axis_name, 1) for x in xs)
+
+
+# ---------------------------------------------------------------------------
+# plain ring (natural layout)
+# ---------------------------------------------------------------------------
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, use_kernel):
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     t_local = q.shape[2]
     q_off = my * t_local
-    n_rep = q.shape[1] // k.shape[1]
 
     def compute(i, acc, k_cur, v_cur):
         src = (my - i) % n  # owner of the kv shard currently resident here
-        part = partial_attention(
-            q, repeat_kv(k_cur, n_rep), repeat_kv(v_cur, n_rep),
-            q_offset=q_off, kv_offset=src * t_local,
-            causal=causal, sm_scale=sm_scale,
-        )
+        part = _step_fwd(q, k_cur, v_cur, q_off, src * t_local, causal,
+                         sm_scale, use_kernel)
         return merge_partials(acc, part)
 
     def body(i, carry):
@@ -66,13 +137,98 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
         acc = compute(i, acc, k_cur, v_cur)
         # Rotate kv to the next device; XLA overlaps this ppermute with the
         # next iteration's compute.
-        k_cur = ring_shift(k_cur, axis_name, 1)
-        v_cur = ring_shift(v_cur, axis_name, 1)
+        k_cur, v_cur = _rotate((k_cur, v_cur), axis_name)
         return acc, k_cur, v_cur
 
     acc, k_last, v_last = lax.fori_loop(0, n - 1, body, (zero_partial(q), k, v))
     acc = compute(n - 1, acc, k_last, v_last)
-    return finalize_partial(*acc, out_dtype=q.dtype)
+    out = finalize_partial(*acc, out_dtype=q.dtype)
+    return out, _lse_of(acc)
+
+
+def _ring_bwd_impl(q, k, v, out, lse, do, axis_name, causal, sm_scale,
+                   use_kernel):
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    q_off = my * t_local
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    def step(i, carry):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = (my - i) % n
+        dq_c, dk_c, dv_c = _step_bwd(q, do, k_cur, v_cur, lse, delta,
+                                     q_off, src * t_local, causal, sm_scale,
+                                     use_kernel)
+        return dq + dq_c, k_cur, v_cur, dk_cur + dk_c, dv_cur + dv_c
+
+    def body(i, carry):
+        carry = step(i, carry)
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        # dk/dv accumulators rotate WITH their kv shard, so each shard's
+        # gradient keeps collecting contributions device by device.
+        k_cur, v_cur, dk_cur, dv_cur = _rotate(
+            (k_cur, v_cur, dk_cur, dv_cur), axis_name)
+        return dq, k_cur, v_cur, dk_cur, dv_cur
+
+    init = (jnp.zeros(q.shape, jnp.float32), k, v,
+            jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32))
+    carry = lax.fori_loop(0, n - 1, body, init)
+    dq, _, _, dk, dv = step(n - 1, carry)
+    # One final rotation sends each kv shard's gradient home (shard s ends
+    # on device s); the kv tensors themselves are no longer needed.
+    dk, dv = _rotate((dk, dv), axis_name)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring(q, k, v, axis_name, causal, sm_scale, use_kernel):
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, use_kernel)
+    return out
+
+
+def _ring_vjp_fwd(q, k, v, axis_name, causal, sm_scale, use_kernel):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                              use_kernel)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_vjp_bwd(axis_name, causal, sm_scale, use_kernel, res, do):
+    q, k, v, out, lse = res
+    return _ring_bwd_impl(q, k, v, out, lse, do, axis_name, causal, sm_scale,
+                          use_kernel)
+
+
+_ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
+                   sm_scale: Optional[float] = None,
+                   use_kernel: Optional[bool] = None):
+    """Per-device body (call inside shard_map): q/k/v are local sequence
+    shards ``[B, H, T_local, D]``; returns the local output shard.
+
+    Grouped-query kv is accepted unexpanded (``k/v`` with fewer heads): the
+    ring rotates the *narrow* kv shards and the per-step compute expands (or
+    the Pallas kernel indexes) per head group, so ICI moves 1/n_rep of the
+    naive traffic.  Rotation schedule: after step ``i`` the device holds kv
+    shard ``(my_index - i) mod n``; global offsets feed the causal mask so
+    no cross-shard attention is wrongly masked or admitted.  The last
+    compute step skips the rotation (n-1 ppermutes for n shards).
+
+    Differentiable: gradients run the backward ring (module docstring).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if use_kernel is None:
+        use_kernel = _use_kernel_default()
+    return _ring(q, k, v, axis_name, bool(causal), float(sm_scale),
+                 bool(use_kernel))
+
+
+# ---------------------------------------------------------------------------
+# zigzag (load-balanced causal) ring
+# ---------------------------------------------------------------------------
 
 
 def zigzag_indices(s: int, n: int) -> np.ndarray:
@@ -101,7 +257,155 @@ def zigzag_indices(s: int, n: int) -> np.ndarray:
     return np.concatenate([np.arange(b * sb, (b + 1) * sb) for b in blocks])
 
 
-def zigzag_ring_attention(q, k, v, axis_name: str, *, sm_scale: Optional[float] = None):
+def _zz_offsets(my, src, n, sb):
+    """Global offsets of the four half-blocks in play at one zigzag step."""
+    return dict(
+        off_lo=my * sb,                   # our front block
+        off_hi=(2 * n - 1 - my) * sb,     # our mirrored back block
+        src_lo=src * sb,                  # visiting front block
+        src_hi=(2 * n - 1 - src) * sb,    # visiting back block
+    )
+
+
+def _zz_fwd_impl(q, k, v, axis_name, sm_scale, use_kernel):
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    sb = q.shape[2] // 2
+
+    q_lo, q_hi = q[:, :, :sb], q[:, :, sb:]
+
+    def compute(src, acc_lo, acc_hi, k_cur, v_cur):
+        o = _zz_offsets(my, src, n, sb)
+        k_lo, k_hi = k_cur[:, :, :sb], k_cur[:, :, sb:]
+        v_lo, v_hi = v_cur[:, :, :sb], v_cur[:, :, sb:]
+
+        # Back blocks start at >= n*sb while front blocks end at <= n*sb:
+        # this pair's causal mask is provably all-ones, so skip the mask.
+        acc_hi = merge_partials(
+            acc_hi,
+            _step_fwd(q_hi, k_lo, v_lo, o["off_hi"], o["src_lo"], False,
+                      sm_scale, use_kernel),
+        )
+        acc_lo = lax.cond(
+            my >= src,
+            lambda a: merge_partials(
+                a, _step_fwd(q_lo, k_lo, v_lo, o["off_lo"], o["src_lo"],
+                             True, sm_scale, use_kernel)),
+            lambda a: a,
+            acc_lo,
+        )
+        acc_hi = lax.cond(
+            my <= src,
+            lambda a: merge_partials(
+                a, _step_fwd(q_hi, k_hi, v_hi, o["off_hi"], o["src_hi"],
+                             True, sm_scale, use_kernel)),
+            lambda a: a,
+            acc_hi,
+        )
+        return acc_lo, acc_hi
+
+    def body(i, carry):
+        acc_lo, acc_hi, k_cur, v_cur = carry
+        acc_lo, acc_hi = compute((my - i) % n, acc_lo, acc_hi, k_cur, v_cur)
+        k_cur, v_cur = _rotate((k_cur, v_cur), axis_name)
+        return acc_lo, acc_hi, k_cur, v_cur
+
+    acc_lo, acc_hi, k_last, v_last = lax.fori_loop(
+        0, n - 1, body, (zero_partial(q_lo), zero_partial(q_hi), k, v)
+    )
+    acc_lo, acc_hi = compute((my - (n - 1)) % n, acc_lo, acc_hi, k_last,
+                             v_last)
+    out = jnp.concatenate(
+        [finalize_partial(*acc_lo, out_dtype=q.dtype),
+         finalize_partial(*acc_hi, out_dtype=q.dtype)], axis=2)
+    lse = jnp.concatenate([_lse_of(acc_lo), _lse_of(acc_hi)], axis=2)
+    return out, lse
+
+
+def _zz_bwd_impl(q, k, v, out, lse, do, axis_name, sm_scale, use_kernel):
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    sb = q.shape[2] // 2
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    q_lo, q_hi = q[:, :, :sb], q[:, :, sb:]
+    do_lo, do_hi = do[:, :, :sb], do[:, :, sb:]
+    lse_lo, lse_hi = lse[:, :, :sb], lse[:, :, sb:]
+    d_lo, d_hi = delta[:, :, :sb], delta[:, :, sb:]
+
+    kv_zero = jnp.zeros(k.shape[:2] + (sb,) + k.shape[3:], jnp.float32)
+
+    def step(i, carry):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = (my - i) % n
+        o = _zz_offsets(my, src, n, sb)
+        k_lo, k_hi = k_cur[:, :, :sb], k_cur[:, :, sb:]
+        v_lo, v_hi = v_cur[:, :, :sb], v_cur[:, :, sb:]
+
+        # Pair hi-lo: always live, mask-free.
+        dqh, dkl, dvl = _step_bwd(q_hi, do_hi, k_lo, v_lo, lse_hi, d_hi,
+                                  o["off_hi"], o["src_lo"], False, sm_scale,
+                                  use_kernel)
+        # Pair lo-lo: live iff my >= src (diagonal at equality).
+        z3 = (jnp.zeros(q_lo.shape, jnp.float32), kv_zero, kv_zero)
+        dql, dkl2, dvl2 = lax.cond(
+            my >= src,
+            lambda: _step_bwd(q_lo, do_lo, k_lo, v_lo, lse_lo, d_lo,
+                              o["off_lo"], o["src_lo"], True, sm_scale,
+                              use_kernel),
+            lambda: z3,
+        )
+        # Pair hi-hi: live iff my <= src.
+        dqh2, dkh, dvh = lax.cond(
+            my <= src,
+            lambda: _step_bwd(q_hi, do_hi, k_hi, v_hi, lse_hi, d_hi,
+                              o["off_hi"], o["src_hi"], True, sm_scale,
+                              use_kernel),
+            lambda: z3,
+        )
+        dq = dq + jnp.concatenate([dql, dqh + dqh2], axis=2)
+        dk_cur = dk_cur + jnp.concatenate([dkl + dkl2, dkh], axis=2)
+        dv_cur = dv_cur + jnp.concatenate([dvl + dvl2, dvh], axis=2)
+        return dq, k_cur, v_cur, dk_cur, dv_cur
+
+    def body(i, carry):
+        carry = step(i, carry)
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        k_cur, v_cur, dk_cur, dv_cur = _rotate(
+            (k_cur, v_cur, dk_cur, dv_cur), axis_name)
+        return dq, k_cur, v_cur, dk_cur, dv_cur
+
+    init = (jnp.zeros(q.shape, jnp.float32), k, v,
+            jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32))
+    carry = lax.fori_loop(0, n - 1, body, init)
+    dq, _, _, dk, dv = step(n - 1, carry)
+    dk, dv = _rotate((dk, dv), axis_name)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _zigzag(q, k, v, axis_name, sm_scale, use_kernel):
+    out, _ = _zz_fwd_impl(q, k, v, axis_name, sm_scale, use_kernel)
+    return out
+
+
+def _zz_vjp_fwd(q, k, v, axis_name, sm_scale, use_kernel):
+    out, lse = _zz_fwd_impl(q, k, v, axis_name, sm_scale, use_kernel)
+    return out, (q, k, v, out, lse)
+
+
+def _zz_vjp_bwd(axis_name, sm_scale, use_kernel, res, do):
+    q, k, v, out, lse = res
+    return _zz_bwd_impl(q, k, v, out, lse, do, axis_name, sm_scale,
+                        use_kernel)
+
+
+_zigzag.defvjp(_zz_vjp_fwd, _zz_vjp_bwd)
+
+
+def zigzag_ring_attention(q, k, v, axis_name: str, *,
+                          sm_scale: Optional[float] = None,
+                          use_kernel: Optional[bool] = None):
     """Per-device body (call inside shard_map) for causal zigzag ring
     attention.  Local shards are in zigzag layout (see :func:`zigzag_indices`):
     the first half of the local sequence is original block ``my`` (global
@@ -117,73 +421,19 @@ def zigzag_ring_attention(q, k, v, axis_name: str, *, sm_scale: Optional[float] 
     * ``q_lo  vs kv_hi`` -- never live (front blocks never see back blocks)
 
     Exactness comes from the same associative merge as :func:`ring_attention`;
-    skipped pairs contribute nothing by construction.
+    skipped pairs contribute nothing by construction.  Differentiable via
+    the backward ring (module docstring); the backward mirrors the same
+    pair liveness so skipped pairs cost nothing there either.
     """
-    n = lax.axis_size(axis_name)
-    my = lax.axis_index(axis_name)
     if q.shape[2] % 2:
         raise ValueError(
             f"zigzag local sequence must be even (two half-blocks), got {q.shape[2]}"
         )
-    sb = q.shape[2] // 2
-    n_rep = q.shape[1] // k.shape[1]
-
-    q_lo, q_hi = q[:, :, :sb], q[:, :, sb:]
-    off_lo = my * sb                 # global offset of our front block
-    off_hi = (2 * n - 1 - my) * sb   # global offset of our mirrored back block
-
-    def compute(src, acc_lo, acc_hi, k_cur, v_cur):
-        ke = repeat_kv(k_cur, n_rep)
-        ve = repeat_kv(v_cur, n_rep)
-        k_lo, k_hi = ke[:, :, :sb], ke[:, :, sb:]
-        v_lo, v_hi = ve[:, :, :sb], ve[:, :, sb:]
-        src_lo = src * sb
-        src_hi = (2 * n - 1 - src) * sb
-
-        # Back blocks start at >= n*sb while front blocks end at <= n*sb:
-        # this pair's causal mask is provably all-ones, so skip the mask.
-        acc_hi = merge_partials(
-            acc_hi,
-            partial_attention(q_hi, k_lo, v_lo, q_offset=off_hi,
-                              kv_offset=src_lo, causal=False, sm_scale=sm_scale),
-        )
-        acc_lo = lax.cond(
-            my >= src,
-            lambda a: merge_partials(
-                a,
-                partial_attention(q_lo, k_lo, v_lo, q_offset=off_lo,
-                                  kv_offset=src_lo, causal=True, sm_scale=sm_scale),
-            ),
-            lambda a: a,
-            acc_lo,
-        )
-        acc_hi = lax.cond(
-            my <= src,
-            lambda a: merge_partials(
-                a,
-                partial_attention(q_hi, k_hi, v_hi, q_offset=off_hi,
-                                  kv_offset=src_hi, causal=True, sm_scale=sm_scale),
-            ),
-            lambda a: a,
-            acc_hi,
-        )
-        return acc_lo, acc_hi
-
-    def body(i, carry):
-        acc_lo, acc_hi, k_cur, v_cur = carry
-        src = (my - i) % n
-        acc_lo, acc_hi = compute(src, acc_lo, acc_hi, k_cur, v_cur)
-        k_cur = ring_shift(k_cur, axis_name, 1)
-        v_cur = ring_shift(v_cur, axis_name, 1)
-        return acc_lo, acc_hi, k_cur, v_cur
-
-    acc_lo, acc_hi, k_last, v_last = lax.fori_loop(
-        0, n - 1, body, (zero_partial(q_lo), zero_partial(q_hi), k, v)
-    )
-    acc_lo, acc_hi = compute((my - (n - 1)) % n, acc_lo, acc_hi, k_last, v_last)
-    out_lo = finalize_partial(*acc_lo, out_dtype=q.dtype)
-    out_hi = finalize_partial(*acc_hi, out_dtype=q.dtype)
-    return jnp.concatenate([out_lo, out_hi], axis=2)
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if use_kernel is None:
+        use_kernel = _use_kernel_default()
+    return _zigzag(q, k, v, axis_name, float(sm_scale), bool(use_kernel))
 
 
 def zigzag_wrap(inner, n: int):
@@ -206,7 +456,8 @@ def zigzag_wrap(inner, n: int):
 
 
 def make_zigzag_ring_attention(mesh, axis_name: str = "sp", *,
-                               sm_scale: Optional[float] = None):
+                               sm_scale: Optional[float] = None,
+                               use_kernel: Optional[bool] = None):
     """Jitted global-view causal ring attention in the load-balanced zigzag
     layout: q/k/v are natural-order global arrays ``[B, H, S, D]`` sharded
     on the sequence dimension; the permutation into and out of zigzag order
@@ -214,19 +465,22 @@ def make_zigzag_ring_attention(mesh, axis_name: str = "sp", *,
     spec = P(None, None, axis_name, None)
 
     def local(q, k, v):
-        return zigzag_ring_attention(q, k, v, axis_name, sm_scale=sm_scale)
+        return zigzag_ring_attention(q, k, v, axis_name, sm_scale=sm_scale,
+                                     use_kernel=use_kernel)
 
     inner = shard_map_fn(mesh, local, in_specs=(spec, spec, spec), out_specs=spec)
     return jax.jit(zigzag_wrap(inner, mesh.shape[axis_name]))
 
 
 def make_ring_attention(mesh, axis_name: str = "sp", *, causal: bool = True,
-                        sm_scale: Optional[float] = None):
+                        sm_scale: Optional[float] = None,
+                        use_kernel: Optional[bool] = None):
     """Jitted global-view ring attention: q/k/v are global arrays sharded on
     the sequence dimension over ``axis_name`` ([B, H, S, D], S sharded)."""
     spec = P(None, None, axis_name, None)
 
     def local(q, k, v):
-        return ring_attention(q, k, v, axis_name, causal=causal, sm_scale=sm_scale)
+        return ring_attention(q, k, v, axis_name, causal=causal,
+                              sm_scale=sm_scale, use_kernel=use_kernel)
 
     return jax.jit(shard_map_fn(mesh, local, in_specs=(spec, spec, spec), out_specs=spec))
