@@ -1,17 +1,42 @@
-"""Pipeline parallelism: GPipe-style microbatch flow over a mesh axis.
+"""Pipeline parallelism: GPipe-style forward + a 1F1B training schedule.
 
 The reference's nearest analogue is the streaming-duplex scenario
 ("simulate ... model parallelism, gradient + activation exchange",
-benchmark.md:91-99).  Here the pattern is a real SPMD pipeline: each device
-on the ``pp`` axis owns one stage's parameters; microbatches enter at stage
-0, activations hop stage-to-stage with ``ppermute`` over ICI, and the last
-stage emits outputs.  The schedule is the classic skewed loop: with S
-stages and M microbatches the pipeline runs ``M + S - 1`` ticks, every
-device computing on every tick once the pipe is full (bubble fraction
-``(S-1)/(M+S-1)``).
+/root/reference/benchmark.md:91-99).  Here the pattern is a real SPMD
+pipeline: each device on the ``pp`` axis owns one stage's parameters;
+microbatches enter at stage 0, activations hop stage-to-stage with
+``ppermute`` over ICI, gradients hop back the other way.
 
-This is the forward building block; paired with ``jax.vjp`` it extends to
-1F1B-style training schedules.
+Forward-only (``pipeline_apply``/``make_pipeline``): the classic skewed
+loop — with S stages and M microbatches the pipeline runs ``M + S - 1``
+ticks.  Outputs are emitted from the last stage's shard only (no
+full-tensor psum broadcast).
+
+Training (``pipeline_train_apply``/``make_pipeline_train``): a collective
+1F1B schedule in a single ``lax.scan``.  Every tick runs one forward slot
+and one backward slot on every device:
+
+* F slot, stage ``s``, tick ``t``: microbatch ``i = t - s`` (injection
+  rate one microbatch per tick, same as GPipe).
+* B slot, stage ``s``, tick ``t``: microbatch ``j = t - 2(S-1) + s`` —
+  the last stage backpropagates a microbatch the same tick it finishes
+  its forward; the cotangent then hops backward one stage per tick.
+
+Total ticks ``M + 2(S-1)``; bubble fraction ``2(S-1) / (M + 2(S-1))``
+(each tick is one F plus one B application, so the 2(S-1) idle slots are
+the textbook 1F1B bubble ``(S-1)(t_F + t_B)``).  The schedule's memory
+profile is what distinguishes 1F1B from GPipe: a stage holds at most
+``2(S-1-s) + 1 <= 2S-1`` in-flight activations, so the stash is a ring
+buffer of depth ``stash_depth(S) = 2(S-1) + 1`` (+1 trash slot for
+invalid ticks) — O(S), independent of M.  Backward slots rematerialise
+the stage forward inside ``jax.vjp`` (activation-checkpoint trade).
+
+Design constraint (standard for collective SPMD pipelines): stages are
+homogeneous — every stage maps activations ``[mb, ...] -> [mb, ...]`` of
+one shape/dtype.  Token embedding runs outside the pipeline (inject
+embedded activations); the last-stage loss is parameter-free w.r.t. the
+pipeline (head params can be closed over but do not receive pipeline
+gradients in v1).
 """
 
 from __future__ import annotations
@@ -26,14 +51,29 @@ from jax.sharding import PartitionSpec as P
 from .sharding import shard_map_fn
 
 
+def pipeline_ticks(n_micro: int, n_stages: int, *, train: bool = True) -> int:
+    """Scan length of the schedule (see module docstring)."""
+    return n_micro + (2 if train else 1) * (n_stages - 1)
+
+
+def bubble_fraction(n_micro: int, n_stages: int) -> float:
+    """Fraction of 1F1B tick-slots that are idle."""
+    return 2 * (n_stages - 1) / pipeline_ticks(n_micro, n_stages)
+
+
+def stash_depth(n_stages: int) -> int:
+    """Max in-flight activations any stage holds under 1F1B: O(S), not O(M)."""
+    return 2 * (n_stages - 1) + 1
+
+
 def pipeline_apply(stage_fn: Callable, stage_params, microbatches, axis_name: str):
-    """Per-device body (call inside shard_map).
+    """Per-device forward body (call inside shard_map).
 
     ``stage_params``: this device's stage parameters (leading pp dim already
     sharded away by shard_map).  ``microbatches``: [M, mb, ...] -- the full
     microbatch stream (replicated; only stage 0 reads it).  Returns
     [M, mb, ...] outputs (valid on the last stage; other stages return
-    zeros, letting the caller psum/gather as needed).
+    zeros, letting the caller gather from the last shard).
     """
     n = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -73,25 +113,143 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches, axis_name: st
 
 
 def make_pipeline(mesh, stage_fn: Callable, axis_name: str = "pp"):
-    """Jitted global-view pipeline.
+    """Jitted global-view forward pipeline.
 
     ``stage_params`` global view: leading dim = number of stages, sharded
-    over ``axis_name``.  ``microbatches`` replicated in; outputs returned
-    sharded on the pp axis (only the last stage's shard is meaningful --
-    sum over the axis with ``collect=True`` semantics handled by caller) --
-    here we psum so every device returns the full outputs.
+    over ``axis_name``; ``microbatches`` replicated in.  Outputs come from
+    the LAST stage's shard only — no cross-device broadcast; the caller
+    receives the [M, mb, ...] tensor and any further resharding moves just
+    that one shard.
     """
+    n = mesh.shape[axis_name]
 
     def local(stage_params, microbatches):
         out = pipeline_apply(stage_fn, stage_params, microbatches, axis_name)
-        # Only the last stage holds real outputs; share them with everyone.
-        return lax.psum(out, axis_name)
+        return out[None]  # [1, M, ...]: this stage's emission slot
 
-    return jax.jit(
-        shard_map_fn(
-            mesh,
-            local,
-            in_specs=(P(axis_name), P()),
-            out_specs=P(),
-        )
+    stacked = shard_map_fn(
+        mesh, local, in_specs=(P(axis_name), P()), out_specs=P(axis_name),
     )
+
+    def run(stage_params, microbatches):
+        return stacked(stage_params, microbatches)[n - 1]
+
+    return jax.jit(run)
+
+
+def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
+                         inputs, targets, axis_name: str):
+    """Per-device 1F1B body (call inside shard_map).
+
+    ``inputs``: [M, mb, ...] activation microbatches (replicated; stage 0
+    injects them).  ``targets``: [M, ...] per-microbatch targets consumed by
+    ``loss_fn(y, target) -> scalar`` at the last stage (mean over the M
+    microbatches is returned).  Returns ``(loss, dparams)`` where
+    ``dparams`` is THIS stage's parameter gradient (f32) — exactly the
+    sharded gradient the optimizer wants; only the scalar loss crosses
+    devices (psum), never activations-sized tensors.
+    """
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m = inputs.shape[0]
+    mb_shape = inputs.shape[1:]
+    depth = stash_depth(n)
+    ticks = pipeline_ticks(m, n, train=True)
+
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+
+    def f32_zeros_like(tree):
+        return jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), tree)
+
+    def tick(carry, t):
+        fwd_in, bwd_in, stash, dparams, loss_acc = carry
+
+        # ---- F slot: microbatch i = t - stage ----
+        i = t - stage
+        f_valid = (i >= 0) & (i < m)
+        x = jnp.where(stage == 0, inputs[jnp.clip(i, 0, m - 1)], fwd_in)
+        y = stage_fn(stage_params, x)
+        # Stash the stage INPUT for the backward remat; invalid ticks write
+        # to the dedicated trash slot `depth`.
+        slot = jnp.where(f_valid, jax.lax.rem(jnp.clip(i, 0, m - 1), depth),
+                         depth)
+        stash = lax.dynamic_update_index_in_dim(stash, x, slot, axis=0)
+        # Scan carries have fixed dtype: stages must be dtype-preserving
+        # (homogeneous-stage constraint); the cast makes that explicit.
+        fwd_out = lax.ppermute(y.astype(inputs.dtype), axis_name, fwd_perm)
+
+        # ---- B slot: microbatch j = t - 2(n-1) + stage ----
+        j = t - 2 * (n - 1) + stage
+        b_valid = (j >= 0) & (j < m)
+        jc = jnp.clip(j, 0, m - 1)
+        x_saved = stash[jax.lax.rem(jc, depth)]
+        target = targets[jc]
+
+        def last_branch(_):
+            # Backprop through loss o stage in one vjp; at the last stage
+            # j == i, so x_saved is the activation stashed THIS tick.
+            def h(p, x):
+                return loss_fn(stage_fn(p, x), target)
+
+            loss_j, grads = jax.value_and_grad(h, argnums=(0, 1))(
+                stage_params, x_saved)
+            dp, dx = grads
+            return (f32_tree(dp), dx.astype(jnp.float32),
+                    jnp.asarray(loss_j, jnp.float32))
+
+        def mid_branch(_):
+            _, vjp_fn = jax.vjp(lambda p, x: stage_fn(p, x), stage_params,
+                                x_saved)
+            dp, dx = vjp_fn(bwd_in.astype(y.dtype))
+            return f32_tree(dp), dx.astype(jnp.float32), jnp.float32(0)
+
+        def f32_tree(tree):
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32), tree)
+
+        dp, dx, loss_j = lax.cond(stage == n - 1, last_branch, mid_branch,
+                                  None)
+        mask = b_valid.astype(jnp.float32)
+        dparams = jax.tree_util.tree_map(
+            lambda acc, g: acc + mask * g, dparams, dp)
+        loss_acc = loss_acc + mask * loss_j
+        bwd_out = lax.ppermute(dx * mask, axis_name, bwd_perm)
+
+        return (fwd_out, bwd_out, stash, dparams, loss_acc), None
+
+    init = (
+        jnp.zeros(mb_shape, inputs.dtype),
+        jnp.zeros(mb_shape, jnp.float32),
+        jnp.zeros((depth + 1,) + mb_shape, inputs.dtype),
+        f32_zeros_like(stage_params),
+        jnp.float32(0),
+    )
+    (_, _, _, dparams, loss_acc), _ = lax.scan(tick, init, jnp.arange(ticks))
+    # Only the last stage saw losses; the scalar psum is the single
+    # cross-stage collective outside the activation/cotangent hops.
+    loss = lax.psum(loss_acc, axis_name) / m
+    return loss, dparams
+
+
+def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
+                        axis_name: str = "pp"):
+    """Jitted global-view 1F1B training step builder.
+
+    Returns ``grad_step(stage_params, inputs, targets) -> (loss, grads)``
+    with ``stage_params``/``grads`` global ``[S, ...]`` sharded over
+    ``axis_name`` and ``inputs [M, mb, ...]``/``targets [M, ...]``
+    replicated.  Feed ``grads`` straight to an optax update — they are
+    already laid out like the params.
+    """
+
+    def local(stage_params, inputs, targets):
+        return pipeline_train_apply(stage_fn, loss_fn, stage_params, inputs,
+                                    targets, axis_name)
+
+    return jax.jit(shard_map_fn(
+        mesh, local,
+        in_specs=(P(axis_name), P(), P()),
+        out_specs=(P(), P(axis_name)),
+    ))
