@@ -230,6 +230,9 @@ def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
     # Only the last stage saw losses; the scalar psum is the single
     # cross-stage collective outside the activation/cotangent hops.
     loss = lax.psum(loss_acc, axis_name) / m
+    # Cotangents were seeded per-microbatch with scale 1, so the stash is a
+    # sum over microbatches; the returned gradient must match the MEAN loss.
+    dparams = jax.tree_util.tree_map(lambda g: g / m, dparams)
     return loss, dparams
 
 

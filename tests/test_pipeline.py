@@ -115,6 +115,6 @@ def test_forward_emits_from_last_stage_only():
 
     h = micro
     for s in range(n):
-        h = jax.vmap(lambda x: _stage_fn(ws[s], x))(h)
+        h = jax.vmap(lambda x, s=s: _stage_fn(ws[s : s + 1], x))(h)
     np.testing.assert_allclose(np.asarray(out), np.asarray(h), atol=1e-5,
                                rtol=1e-5)
