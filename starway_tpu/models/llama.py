@@ -50,6 +50,7 @@ class LlamaConfig:
     n_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    moe_top_k: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -193,13 +194,20 @@ def default_attn(q, k, v):
 
 
 def forward(params: dict, tokens, cfg: LlamaConfig,
-            attn_fn: Optional[Callable] = None, *, return_aux: bool = False):
+            attn_fn: Optional[Callable] = None, *, return_aux: bool = False,
+            moe_fn: Optional[Callable] = None):
     """Next-token logits ``[B, S, V]`` for token ids ``[B, S]``.
 
     ``attn_fn(q, k, v) -> out`` takes q ``[B, Hq, S, Dh]`` and *grouped*
     kv ``[B, Hkv, S, Dh]`` (impls expand GQA heads internally); defaults to
     single-device blockwise attention.  Pass :func:`make_sharded_attn`'s
     result for sequence-parallel ring attention.
+
+    ``moe_fn(x, router_w, w_in, w_out) -> (y, aux)`` overrides the MoE FFN
+    when ``cfg.n_experts > 0``; defaults to the global-view
+    :func:`~starway_tpu.models.moe.switch_moe` (GSPMD dispatch).  Pass
+    :func:`~starway_tpu.models.moe.make_sharded_moe`'s result to pin the
+    expert all-to-all over the "ep" mesh axis explicitly.
     """
     if attn_fn is None:
         attn_fn = default_attn
@@ -225,12 +233,17 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
 
         x = rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
         if cfg.n_experts > 0:
-            from .moe import switch_moe
+            if moe_fn is not None:
+                y, layer_aux = moe_fn(
+                    x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"]
+                )
+            else:
+                from .moe import switch_moe
 
-            y, layer_aux = switch_moe(
-                x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"],
-                capacity_factor=cfg.moe_capacity_factor,
-            )
+                y, layer_aux = switch_moe(
+                    x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"],
+                    capacity_factor=cfg.moe_capacity_factor, k=cfg.moe_top_k,
+                )
             h = h + y
             aux = aux + layer_aux
         else:
@@ -248,11 +261,13 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
 
 
 def loss_fn(params: dict, batch, cfg: LlamaConfig,
-            attn_fn: Optional[Callable] = None):
+            attn_fn: Optional[Callable] = None,
+            moe_fn: Optional[Callable] = None):
     """Causal LM loss: batch ``[B, S+1]`` token ids -> mean next-token
     cross-entropy."""
     tokens, targets = batch[:, :-1], batch[:, 1:]
-    logits, aux = forward(params, tokens, cfg, attn_fn, return_aux=True)
+    logits, aux = forward(params, tokens, cfg, attn_fn, return_aux=True,
+                          moe_fn=moe_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     loss = -jnp.mean(ll)
@@ -272,12 +287,14 @@ def apply_updates(tx, params, opt_state, grads):
     return params, opt_state
 
 
-def make_train_step(cfg: LlamaConfig, tx, attn_fn: Optional[Callable] = None):
+def make_train_step(cfg: LlamaConfig, tx, attn_fn: Optional[Callable] = None,
+                    moe_fn: Optional[Callable] = None):
     """One optimizer step, jit-ready (donate params+opt_state for in-place
     HBM updates)."""
 
     def train_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, attn_fn)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, batch, cfg, attn_fn, moe_fn)
         params, opt_state = apply_updates(tx, params, opt_state, grads)
         return params, opt_state, loss
 

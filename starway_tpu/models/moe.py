@@ -1,20 +1,34 @@
-"""Switch-style mixture-of-experts FFN with expert parallelism.
+"""Mixture-of-experts FFN with expert parallelism and top-k routing.
 
-Top-1 routing with static capacity (Switch Transformer recipe): one-hot
-dispatch/combine tensors keep every shape static so XLA can plan the
-expert all-to-all, and the expert weight tables shard over the mesh "ep"
-axis (``moe_specs``) -- GSPMD inserts the dispatch collectives over ICI.
-Gives the framework a real expert-parallel (EP) axis next to dp/tp/sp/pp.
+Switch/GShard-style static-capacity routing built TPU-first:
 
-All einsum contractions run in the model compute dtype with f32 router
-statistics; the load-balancing auxiliary loss is the standard
-``E * mean(frac_tokens_e * mean_router_prob_e)``.
+* **Dispatch is scatter/gather, not a dense one-hot einsum.**  Each routed
+  (token, choice) computes an integer slot ``expert * C + position`` and the
+  token rows are scattered into an ``[E*C, D]`` send buffer (overflow goes to
+  a trash row) -- O(T*k) index work plus the O(E*C*D) = O(T*cf*D) buffer the
+  all-to-all needs anyway, instead of the O(T*E*C) dispatch tensor of the
+  textbook formulation.  Shapes stay static so XLA can plan the collectives.
+* **Top-k routing** (k=1 Switch, k=2 GShard/Mixtral): first choices take
+  capacity priority over second choices; top-2 gates are renormalised over
+  the chosen pair.
+* Two views of the same math:
+  :func:`switch_moe` -- global view; expert tables shard over the mesh "ep"
+  axis via :func:`moe_specs` and GSPMD inserts the dispatch collectives.
+  :func:`sharded_switch_moe` -- local (shard_map) view with an explicit
+  ``lax.all_to_all`` over the "ep" axis, for when the collective schedule
+  should be pinned rather than inferred; :func:`make_sharded_moe` wraps it
+  for use as ``forward(..., moe_fn=...)``.
+
+The load-balancing auxiliary loss is the standard
+``E * sum_e(frac_first_choice_e * mean_router_prob_e)`` (Switch eq. 4;
+reduces to GShard's aux for k>=2 with first-choice fractions).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
@@ -41,43 +55,175 @@ def moe_specs() -> dict:
     }
 
 
-def switch_moe(x, router_w, w_in, w_out, *, capacity_factor: float = 1.25):
-    """x: [B, S, D] -> (y: [B, S, D], aux_loss: scalar f32).
+def moe_capacity(n_tokens: int, n_experts: int, capacity_factor: float) -> int:
+    """Static per-expert capacity for ``n_tokens`` routed tokens."""
+    return max(1, int(n_tokens / n_experts * capacity_factor))
 
-    Tokens over capacity are dropped (their residual path carries them),
-    matching the Switch formulation.
+
+def _route(xt, router_w, k: int):
+    """Router statistics for ``xt [T, D]``.
+
+    Returns ``(expert_flat [T*k], gate_flat [T*k] f32, aux scalar f32)``
+    in choice-major order (all first choices in token order, then all
+    second choices, ...), so a cumsum over the flat order gives first
+    choices capacity priority.
+    """
+    e = router_w.shape[-1]
+    logits = (xt @ router_w).astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_i = lax.top_k(probs, k)  # [T, k]
+    if k > 1:
+        # Mixtral/GShard: renormalise the chosen gates over the pair.
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+
+    # Load-balancing aux loss from FIRST choices (Switch eq. 4).
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(top_i[:, 0], e, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+
+    expert_flat = top_i.T.reshape(-1)  # choice-major
+    gate_flat = top_p.T.reshape(-1)
+    return expert_flat, gate_flat, aux
+
+
+def _dispatch_slots(expert_flat, n_experts: int, capacity: int):
+    """Slot index per routed (token, choice): ``expert * C + position``.
+
+    ``position`` counts prior assignments to the same expert in flat order
+    (choice-major -> first choices win capacity).  Overflow maps to the
+    trash slot ``E*C``.  Returns ``(slot [T*k] int32, keep [T*k] bool)``.
+    """
+    # int32 counting stays exact however many tokens are routed (an f32
+    # cumsum would misnumber positions past 2^24 assignments).
+    onehot = jax.nn.one_hot(expert_flat, n_experts, dtype=jnp.int32)
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
+    keep = pos < capacity
+    pos = jnp.clip(pos, 0, capacity - 1)
+    slot = jnp.where(keep, expert_flat * capacity + pos,
+                     n_experts * capacity)
+    return slot.astype(jnp.int32), keep
+
+
+def _scatter_tokens(xt, slot, k: int, n_experts: int, capacity: int):
+    """Gather routed token rows into the ``[E*C, D]`` send buffer."""
+    t, d = xt.shape
+    token_flat = jnp.tile(jnp.arange(t, dtype=jnp.int32), k)
+    buf = jnp.zeros((n_experts * capacity + 1, d), xt.dtype)
+    return buf.at[slot].set(xt[token_flat], mode="drop")[:-1]
+
+
+def _combine_tokens(y_buf, slot, keep, gate_flat, k: int, t: int):
+    """Inverse of :func:`_scatter_tokens`: gather each routed choice's
+    expert output, weight by its gate, sum the k choices per token."""
+    ec = y_buf.shape[0]
+    y = y_buf[jnp.clip(slot, 0, ec - 1)]  # [T*k, D]
+    w = (gate_flat * keep.astype(jnp.float32)).astype(y.dtype)
+    return jnp.sum((y * w[:, None]).reshape(k, t, -1), axis=0)
+
+
+def _expert_ffn(expert_in, w_in, w_out):
+    """``[E, C', D] -> [E, C', D]`` through each expert's gelu MLP."""
+    cd = expert_in.dtype
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", expert_in, w_in).astype(jnp.float32)
+    ).astype(cd)
+    return jnp.einsum("ecf,efd->ecd", h, w_out)
+
+
+def switch_moe(x, router_w, w_in, w_out, *, capacity_factor: float = 1.25,
+               k: int = 1):
+    """x: [B, S, D] -> (y: [B, S, D], aux_loss: scalar f32).  Global view.
+
+    Tokens over capacity are dropped (their residual path carries them).
+    Under a GSPMD mesh with ``moe_specs`` the expert dimension of the
+    ``[E, C, D]`` buffers shards over "ep" and XLA inserts the all-to-alls.
     """
     b, s, d = x.shape
     e = router_w.shape[-1]
     t = b * s
     xt = x.reshape(t, d)
+    capacity = moe_capacity(t, e, capacity_factor)
 
-    logits = (xt @ router_w).astype(jnp.float32)  # [T, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T, E]
-    gate = jnp.sum(probs * onehot, axis=-1)  # [T]
-
-    # Load-balancing aux loss (Switch eq. 4).
-    frac_tokens = jnp.mean(onehot, axis=0)
-    frac_probs = jnp.mean(probs, axis=0)
-    aux = e * jnp.sum(frac_tokens * frac_probs)
-
-    capacity = max(1, int(t / e * capacity_factor))
-    pos_in_expert = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1.0  # [T]
-    keep = pos_in_expert < capacity
-    # [T, E, C] dispatch tensor: token -> (expert, slot).
-    disp = (onehot * keep[:, None])[:, :, None] * jax.nn.one_hot(
-        jnp.clip(pos_in_expert, 0, capacity - 1).astype(jnp.int32),
-        capacity, dtype=jnp.float32,
-    )[:, None, :]
-
-    cd = x.dtype
-    expert_in = jnp.einsum("tec,td->ecd", disp.astype(cd), xt)  # [E, C, D]
-    h = jax.nn.gelu(
-        jnp.einsum("ecd,edf->ecf", expert_in, w_in).astype(jnp.float32)
-    ).astype(cd)
-    expert_out = jnp.einsum("ecf,efd->ecd", h, w_out)  # [E, C, D]
-    y = jnp.einsum("tec,ecd->td", disp.astype(cd), expert_out)
-    y = y * gate.astype(cd)[:, None]
+    expert_flat, gate_flat, aux = _route(xt, router_w, k)
+    slot, keep = _dispatch_slots(expert_flat, e, capacity)
+    expert_in = _scatter_tokens(xt, slot, k, e, capacity).reshape(e, capacity, d)
+    expert_out = _expert_ffn(expert_in, w_in, w_out)
+    y = _combine_tokens(expert_out.reshape(e * capacity, d), slot, keep,
+                        gate_flat, k, t)
     return y.reshape(b, s, d), aux
+
+
+def sharded_switch_moe(x, router_w, w_in, w_out, axis_name: str, *,
+                       capacity_factor: float = 1.25, k: int = 1):
+    """Local (shard_map) view with an explicit expert all-to-all.
+
+    ``x [B_loc, S_loc, D]``: this shard's tokens.  ``w_in/w_out
+    [E_loc, D, F] / [E_loc, F, D]``: this shard's experts (E = E_loc * ep).
+    Capacity is per (source shard, expert) from the LOCAL token count, so
+    the all-to-all payload is O(T_loc * cf * D) per device.
+
+    The aux loss is the pmean over the axis of per-shard aux statistics --
+    statistically the global Switch aux (equal shard sizes) though not
+    bit-identical to the global-view formula (mean of products vs product
+    of means across shards).
+    """
+    ep = lax.axis_size(axis_name)
+    b, s, d = x.shape
+    e_loc = w_in.shape[0]
+    e = e_loc * ep
+    t = b * s
+    xt = x.reshape(t, d)
+    capacity = moe_capacity(t, e, capacity_factor)
+
+    expert_flat, gate_flat, aux = _route(xt, router_w, k)
+    slot, keep = _dispatch_slots(expert_flat, e, capacity)
+    send = _scatter_tokens(xt, slot, k, e, capacity)  # [E*C, D]
+
+    # [ep, E_loc, C, D] -> all-to-all -> leading axis becomes source shard.
+    send = send.reshape(ep, e_loc, capacity, d)
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)
+    # Each local expert sees the rows every shard bucketed for it.
+    expert_in = recv.transpose(1, 0, 2, 3).reshape(e_loc, ep * capacity, d)
+    expert_out = _expert_ffn(expert_in, w_in, w_out)
+    back = expert_out.reshape(e_loc, ep, capacity, d).transpose(1, 0, 2, 3)
+    got = lax.all_to_all(back, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)
+
+    y = _combine_tokens(got.reshape(e * capacity, d), slot, keep, gate_flat,
+                        k, t)
+    return y.reshape(b, s, d), lax.pmean(aux, axis_name)
+
+
+def make_sharded_moe(mesh, *, ep_axis: str = "ep", dp_axis: str = "dp",
+                     capacity_factor: float = 1.25, k: int = 1):
+    """Build a ``moe_fn(x, router_w, w_in, w_out) -> (y, aux)`` running
+    :func:`sharded_switch_moe` under shard_map: tokens shard over
+    (dp, ep) -- batch over dp, sequence over ep -- experts over ep, and the
+    dispatch rides one explicit ``all_to_all`` pair over the ep axis.
+
+    Plug into ``forward(..., moe_fn=...)`` /
+    ``make_train_step(..., moe_fn=...)``.
+    """
+    from ..parallel.sharding import shard_map_fn
+
+    other_axes = tuple(a for a in mesh.axis_names if a != ep_axis)
+
+    def local(x, router_w, w_in, w_out):
+        y, aux = sharded_switch_moe(
+            x, router_w, w_in, w_out, ep_axis,
+            capacity_factor=capacity_factor, k=k)
+        # aux is ep-uniform already; replicate across the remaining axes so
+        # the scalar can leave the shard_map with spec P().
+        if other_axes:
+            aux = lax.pmean(aux, other_axes)
+        return y, aux
+
+    x_spec = P(dp_axis if dp_axis in mesh.shape else None, ep_axis, None)
+    return shard_map_fn(
+        mesh, local,
+        in_specs=(x_spec, P(None, None), P(ep_axis, None, None),
+                  P(ep_axis, None, None)),
+        out_specs=(x_spec, P()),
+    )

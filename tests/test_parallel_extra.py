@@ -113,6 +113,140 @@ def test_moe_model_trains():
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
 
 
+def _dense_oracle_moe(x, router_w, w_in, w_out, *, capacity_factor=1.25):
+    """The textbook [T, E, C] one-hot dispatch (the formulation the scalable
+    scatter/gather path replaced) -- kept here as the numerics oracle."""
+    b, s, d = x.shape
+    e = router_w.shape[-1]
+    t = b * s
+    xt = x.reshape(t, d)
+    logits = (xt @ router_w).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+    gate = jnp.sum(probs * onehot, axis=-1)
+    frac_tokens = jnp.mean(onehot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    capacity = max(1, int(t / e * capacity_factor))
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1.0
+    keep = pos < capacity
+    disp = (onehot * keep[:, None])[:, :, None] * jax.nn.one_hot(
+        jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity,
+        dtype=jnp.float32)[:, None, :]
+    cd = x.dtype
+    expert_in = jnp.einsum("tec,td->ecd", disp.astype(cd), xt)
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", expert_in, w_in).astype(jnp.float32)
+    ).astype(cd)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w_out)
+    y = jnp.einsum("tec,ecd->td", disp.astype(cd), expert_out)
+    y = y * gate.astype(cd)[:, None]
+    return y.reshape(b, s, d), aux
+
+
+@pytest.mark.parametrize("cf", [2.0, 0.5])  # 0.5 forces capacity drops
+def test_switch_moe_matches_dense_oracle(cf):
+    """Scatter/gather dispatch == the dense one-hot formulation, including
+    which tokens get dropped when capacity binds (same token-order
+    priority)."""
+    from starway_tpu.models.moe import init_moe_params, switch_moe
+
+    key = jax.random.PRNGKey(11)
+    p = init_moe_params(key, 1, 4, 32, 64, jnp.float32)
+    x = jax.random.normal(key, (2, 16, 32), jnp.float32)
+    y, aux = switch_moe(x, p["router"][0], p["w_in"][0], p["w_out"][0],
+                        capacity_factor=cf)
+    y_ref, aux_ref = _dense_oracle_moe(x, p["router"][0], p["w_in"][0],
+                                       p["w_out"][0], capacity_factor=cf)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5,
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-6)
+
+
+def test_switch_moe_top2():
+    """k=2, capacity ample: every token's output is the gate-weighted blend
+    of its two top experts (brute-force per-token oracle)."""
+    from starway_tpu.models.moe import init_moe_params, switch_moe
+
+    key = jax.random.PRNGKey(12)
+    e, d, f = 4, 16, 32
+    p = init_moe_params(key, 1, e, d, f, jnp.float32)
+    x = jax.random.normal(key, (1, 8, d), jnp.float32)
+    y, aux = switch_moe(x, p["router"][0], p["w_in"][0], p["w_out"][0],
+                        capacity_factor=4.0, k=2)
+
+    xt = x.reshape(-1, d)
+    probs = jax.nn.softmax((xt @ p["router"][0]).astype(jnp.float32), axis=-1)
+    top_p, top_i = jax.lax.top_k(probs, 2)
+    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+
+    def ffn(e_idx, tok):
+        h = jax.nn.gelu(tok @ p["w_in"][0][e_idx])
+        return h @ p["w_out"][0][e_idx]
+
+    expect = jnp.stack([
+        top_p[t, 0] * ffn(top_i[t, 0], xt[t]) + top_p[t, 1] * ffn(top_i[t, 1], xt[t])
+        for t in range(xt.shape[0])
+    ]).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(expect), atol=1e-5,
+                               rtol=1e-5)
+    assert bool(jnp.isfinite(aux))
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_sharded_moe_matches_global(k):
+    """shard_map + explicit all_to_all over ep == the global-view dispatch
+    when capacity is ample (no drops on either path)."""
+    from starway_tpu.models.moe import (
+        init_moe_params, make_sharded_moe, switch_moe)
+
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    key = jax.random.PRNGKey(13)
+    e, d, f = 4, 16, 32
+    p = init_moe_params(key, 1, e, d, f, jnp.float32)
+    x = jax.random.normal(key, (4, 8, d), jnp.float32)
+
+    y_ref, _ = switch_moe(x, p["router"][0], p["w_in"][0], p["w_out"][0],
+                          capacity_factor=float(e), k=k)
+
+    moe_fn = make_sharded_moe(mesh, capacity_factor=float(e), k=k)
+    xs = shard_array(mesh, x, "dp", "ep", None)
+    wi = shard_array(mesh, p["w_in"][0], "ep", None, None)
+    wo = shard_array(mesh, p["w_out"][0], "ep", None, None)
+    y, aux = jax.jit(moe_fn)(xs, p["router"][0], wi, wo)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5,
+                               rtol=1e-5)
+    assert bool(jnp.isfinite(aux))
+
+
+def test_moe_train_step_with_sharded_moe_fn():
+    """Full train step where the MoE FFN runs under shard_map with the
+    explicit ep all_to_all (loss finite, top-2)."""
+    from starway_tpu.models import LlamaConfig, init_params, make_train_step, param_specs
+    from starway_tpu.models.moe import make_sharded_moe
+
+    mesh = make_mesh({"dp": 2, "ep": 4, "tp": 1})
+    cfg = LlamaConfig.preset("debug", n_experts=4, moe_top_k=2)
+    params = init_params(jax.random.PRNGKey(14), cfg)
+    specs = param_specs(cfg)
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+    tx = optax.adamw(1e-3)
+    opt = tx.init(sharded)
+    moe_fn = make_sharded_moe(mesh, capacity_factor=2.0, k=2)
+    step = jax.jit(make_train_step(cfg, tx, moe_fn=moe_fn),
+                   donate_argnums=(0, 1))
+    batch = jax.device_put(
+        jnp.asarray(np.random.default_rng(15).integers(
+            0, cfg.vocab_size, (4, 33), dtype=np.int32)),
+        NamedSharding(mesh, P("dp", None)),
+    )
+    _, _, loss = step(sharded, opt, batch)
+    assert bool(jnp.isfinite(loss))
+
+
 def test_moe_expert_parallel_step():
     """Full train step with experts sharded over a real ep mesh axis."""
     from starway_tpu.models import LlamaConfig, init_params, make_train_step, param_specs
