@@ -108,7 +108,16 @@ constexpr uint64_t SM_MAGIC = 0x31676E69726D7773ull;  // "swmring1" LE
 constexpr size_t SM_GLOBAL_HDR = 64;
 constexpr size_t SM_RING_HDR = 128;
 constexpr size_t SM_DATA_OFF = SM_GLOBAL_HDR + 2 * SM_RING_HDR;  // 384
-constexpr size_t SM_OFF_TAIL = 0, SM_OFF_BLOCKED = 8, SM_OFF_HEAD = 64;
+constexpr size_t SM_OFF_TAIL = 0, SM_OFF_HEAD = 64;  // +8: reserved (legacy flag)
+
+// Doorbell byte values on an sm-upgraded conn's socket (contract shared
+// with the Python engine -- core/conn.py).  Any byte wakes the peer;
+// DB_STARVING additionally asks it to reply with a doorbell after draining
+// its rx ring -- the wakeup for a producer sleeping on a full ring.  All
+// wakeups ride the socket: the send/recv syscall pair orders cursor stores
+// between processes, so the sleep needs no shared flag (and works against
+// a pure-Python peer that cannot fence).
+constexpr uint8_t DB_DATA = 1, DB_STARVING = 2;
 
 // Read the env per handshake (not cached): the embedding process may flip
 // STARWAY_TLS between connections (the test matrix does), and handshakes
@@ -140,7 +149,6 @@ struct SmRing {
   uint64_t size = 0;
 
   std::atomic<uint64_t>& tail() const { return *reinterpret_cast<std::atomic<uint64_t>*>(hdr + SM_OFF_TAIL); }
-  std::atomic<uint64_t>& blocked() const { return *reinterpret_cast<std::atomic<uint64_t>*>(hdr + SM_OFF_BLOCKED); }
   std::atomic<uint64_t>& head() const { return *reinterpret_cast<std::atomic<uint64_t>*>(hdr + SM_OFF_HEAD); }
 
   uint64_t readable() const { return tail().load(std::memory_order_acquire) - head().load(std::memory_order_relaxed); }
@@ -461,6 +469,10 @@ struct TxItem {
   // `done` must NOT be the release point.
   sw_done_cb release = nullptr;
   void* release_ctx = nullptr;
+  // The sm transport switch point (the HELLO_ACK): once this item finishes
+  // writing to the socket, TX flips to the ring -- items queued behind it
+  // ride the ring even while this one is still draining.
+  bool switch_after = false;
 
   uint64_t total() const { return header.size() + paylen; }
 };
@@ -503,6 +515,10 @@ struct Conn {
   bool sm_active = false;
   bool sm_negotiated = false;  // sticky: survives teardown for introspection
   bool tx_via_ring = false;
+  // Doorbell bytes that hit a full socket buffer: flushed on EPOLLOUT.  A
+  // starving byte is the only wakeup a ring-blocked producer gets, so
+  // doorbells are queued, never dropped.
+  std::string db_out;
 
   bool has_unfinished_data() const {
     for (auto& t : tx)
@@ -516,7 +532,10 @@ struct Conn {
     sm_active = true;
     sm_negotiated = true;
     seg->unlink();
-    if (!defer_tx && tx.empty()) tx_via_ring = true;
+    if (!defer_tx) {
+      if (tx.empty()) tx_via_ring = true;
+      else tx.back().switch_after = true;  // pre-switch items drain first
+    }
   }
 
   void drop_sm() {
@@ -578,8 +597,6 @@ struct Worker {
   sw_accept_cb accept_cb = nullptr;
   void* accept_ctx = nullptr;
   std::unordered_set<Conn*> half_open;
-  // sm conns whose producer is blocked on a full ring (see conn_tx_write).
-  std::unordered_set<Conn*> sm_blocked;
   // client bits
   std::string c_host, c_mode;
   int c_port = 0;
@@ -655,12 +672,14 @@ struct Worker {
   }
 
   void conn_send_ctl(Conn* c, uint8_t type, uint64_t a, uint64_t b,
-                     const std::string& body, FireList& fires) {
+                     const std::string& body, FireList& fires,
+                     bool switch_after = false) {
     if (!c->alive) return;
     TxItem item;
     item.header.resize(HEADER_SIZE + body.size());
     pack_header(item.header.data(), type, a, b);
     if (!body.empty()) memcpy(item.header.data() + HEADER_SIZE, body.data(), body.size());
+    item.switch_after = switch_after;
     c->tx.push_back(std::move(item));
     kick_tx(c, fires);
   }
@@ -668,18 +687,9 @@ struct Worker {
   // Write to the active transport: >0 bytes taken, 0 = blocked, -1 = dead.
   ssize_t conn_tx_write(Conn* c, const uint8_t* p, size_t n, FireList& fires) {
     if (c->tx_via_ring) {
-      size_t w = c->sm_tx.write(p, n);
-      if (w == 0) {
-        // Two-phase sleep: publish the blocked flag, re-check.  seq_cst on
-        // both sides makes the native<->native eventcount sound; a pure-
-        // Python peer cannot fence, which the blocked-producer epoll
-        // timeout below covers.
-        c->sm_tx.blocked().store(1, std::memory_order_seq_cst);
-        w = c->sm_tx.write(p, n);
-        if (w == 0) return 0;
-        c->sm_tx.blocked().store(0, std::memory_order_relaxed);
-      }
-      return (ssize_t)w;
+      // 0 = ring full; kick_tx signals the peer with a starving doorbell
+      // and its reply (after draining) re-enters kick_tx.
+      return (ssize_t)c->sm_tx.write(p, n);
     }
     ssize_t w = ::send(c->fd, p, n, MSG_NOSIGNAL);
     if (w < 0) {
@@ -690,11 +700,40 @@ struct Worker {
     return w;
   }
 
-  void doorbell(Conn* c, FireList& fires) {
-    uint8_t one = 1;
-    ssize_t w = ::send(c->fd, &one, 1, MSG_NOSIGNAL | MSG_DONTWAIT);
-    if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK) conn_broken(c, fires);
-    // EAGAIN: socket buffer already full of unread doorbells - peer will wake.
+  void doorbell(Conn* c, FireList& fires, uint8_t val = DB_DATA) {
+    if (!c->db_out.empty()) {
+      if (c->db_out.find((char)val) == std::string::npos) c->db_out.push_back((char)val);
+      return;
+    }
+    ssize_t w = ::send(c->fd, &val, 1, MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (w == 1) return;
+    if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+      conn_broken(c, fires);
+      return;
+    }
+    // Socket buffer full: queue + EPOLLOUT so the byte is never lost (a
+    // starving byte is the one wakeup a sleeping producer depends on).
+    c->db_out.push_back((char)val);
+    if (!c->want_write) {
+      c->want_write = true;
+      ep_mod_conn(c);
+    }
+  }
+
+  // EPOLLOUT: flush queued doorbell bytes, then retry the tx queue.
+  void conn_writable(Conn* c, FireList& fires) {
+    while (!c->db_out.empty()) {
+      ssize_t w = ::send(c->fd, c->db_out.data(), c->db_out.size(),
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (w > 0) {
+        c->db_out.erase(0, (size_t)w);
+        continue;
+      }
+      if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+      conn_broken(c, fires);
+      return;
+    }
+    kick_tx(c, fires);
   }
 
   // Gather pending tx bytes across queue items into one sendmsg: small
@@ -728,6 +767,8 @@ struct Worker {
         bytes += n;
         niov++;
       }
+      // Never batch bytes past the sm switch point onto the socket.
+      if (item.switch_after) break;
     }
     if (niov == 0) return 0;
     msghdr msg{};
@@ -779,8 +820,14 @@ struct Worker {
                 fires.push_back([done, ctx] { done(ctx); });
               }
             }
+            bool flip = item.switch_after;
             fire_release(item, fires);
             c->tx.pop_front();
+            if (flip) {
+              // Switch point left the socket: later items ride the ring.
+              c->tx_via_ring = true;
+              break;
+            }
           }
         }
         continue;
@@ -829,21 +876,23 @@ struct Worker {
     }
     if (blocked) {
       if (c->tx_via_ring) {
-        // Blocked on the ring, not the socket: EPOLLOUT would spin.  The
-        // consumer doorbells us when it frees space; the blocked sweep in
-        // run() covers a peer whose flag check raced.
-        sm_blocked.insert(c);
+        // Blocked on the ring, not the socket (EPOLLOUT would spin).  Ask
+        // the peer to reply once it drains; the starving byte doubles as
+        // the data doorbell for anything published this pass.  Drop any
+        // stale EPOLLOUT interest (unless doorbell() queued a byte): the
+        // socket stays writable, so leaving it set would busy-spin.
+        doorbell(c, fires, DB_STARVING);
+        if (c->want_write && c->db_out.empty()) {
+          c->want_write = false;
+          ep_mod_conn(c);
+        }
       } else if (!c->want_write) {
         c->want_write = true;
         ep_mod_conn(c);
       }
-      if (c->sm_active && c->sm_tx.tail().load(std::memory_order_relaxed) != t0)
-        doorbell(c, fires);
       return;
     }
-    sm_blocked.erase(c);
-    if (c->sm_active) c->sm_tx.blocked().store(0, std::memory_order_relaxed);
-    if (c->want_write) {
+    if (c->want_write && c->db_out.empty()) {
       c->want_write = false;
       ep_mod_conn(c);
     }
@@ -879,21 +928,27 @@ struct Worker {
     // sm mode: the socket carries only doorbells (and EOF/RST).  Drain it,
     // pump the ring; on EOF pump once more (bytes published before the peer
     // died must still deliver -- graceful close), then break the conn.
-    bool eof = false;
+    bool eof = false, starving = false;
     for (;;) {
       char buf[4096];
       ssize_t r = ::recv(c->fd, buf, sizeof(buf), 0);
-      if (r > 0) continue;
+      if (r > 0) {
+        if (memchr(buf, DB_STARVING, (size_t)r)) starving = true;
+        continue;
+      }
       if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
       eof = true;
       break;
     }
-    uint64_t h0 = c->sm_rx.head().load(std::memory_order_relaxed);
     pump_frames(c, fires);
     if (!c->alive) return;
-    if (c->sm_rx.head().load(std::memory_order_relaxed) != h0 &&
-        c->sm_rx.blocked().load(std::memory_order_seq_cst))
+    if (starving) {
+      // The peer's producer sleeps on a full ring.  The pump above freed
+      // space (or it was already free); reply unconditionally -- our send
+      // comes after the head store, so the peer's post-recv cursor reads
+      // are current.
       doorbell(c, fires);
+    }
     if (!c->tx.empty()) kick_tx(c, fires);  // doorbell may mean tx space freed
     if (eof && c->alive) {
       pump_frames(c, fires);
@@ -1085,7 +1140,6 @@ struct Worker {
     }
     close(c->fd);
     c->fd = -1;
-    sm_blocked.erase(c);
     c->drop_sm();
     bool was_half_open = half_open.erase(c) > 0;
     auto snapshot = flushes;
@@ -1113,7 +1167,6 @@ struct Worker {
     }
     close(c->fd);
     c->fd = -1;
-    sm_blocked.erase(c);
     c->drop_sm();
   }
 
@@ -1147,7 +1200,9 @@ struct Worker {
     }
     std::string ack = std::string("{\"worker_id\": \"") + worker_id + "\"" +
                       (seg ? ", \"sm\": \"ok\"" : "") + "}";
-    conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires);
+    // The ACK is the transport switch point (see TxItem::switch_after).
+    conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires,
+                  /*switch_after=*/seg != nullptr);
     if (accept_cb) {
       auto cb = accept_cb; auto ctx = accept_ctx; uint64_t id = c->id;
       fires.push_back([cb, ctx, id] { cb(ctx, id); });
@@ -1242,10 +1297,7 @@ struct Worker {
     epoll_event events[64];
     for (;;) {
       if (status.load() == ST_CLOSING) break;
-      // Short timeout while any sm producer is blocked: a pure-Python peer
-      // cannot fence its doorbell-back flag check, so a missed wakeup costs
-      // one tick instead of a deadlock.
-      int n = epoll_wait(epfd, events, 64, sm_blocked.empty() ? -1 : 2);
+      int n = epoll_wait(epfd, events, 64, -1);
       if (n < 0) {
         if (errno == EINTR) continue;
         break;
@@ -1261,14 +1313,10 @@ struct Worker {
           accept_loop(fires);
         } else {
           Conn* c = (Conn*)ptr;
-          if (events[i].events & EPOLLOUT) kick_tx(c, fires);
+          if (events[i].events & EPOLLOUT) conn_writable(c, fires);
           if ((events[i].events & (EPOLLIN | EPOLLHUP | EPOLLERR)) && c->alive)
             conn_readable(c, fires);
         }
-      }
-      if (!sm_blocked.empty()) {
-        std::vector<Conn*> blocked(sm_blocked.begin(), sm_blocked.end());
-        for (Conn* c : blocked) kick_tx(c, fires);
       }
       drain_ops(fires);
       for (auto& f : fires) f();

@@ -66,11 +66,16 @@ def sm_enabled() -> bool:
     # The pure-Python ring relies on x86-TSO store ordering for its
     # data-before-tail publication (core/shmring.py); ARM permits
     # store-store reordering and Python cannot fence, so the Python engine
-    # neither offers nor accepts sm elsewhere.  (The C++ engine uses real
-    # atomics and carries sm on any architecture.)
+    # neither offers nor accepts sm elsewhere.  It also relies on CPython's
+    # aligned 8-byte memoryview stores being single machine stores in
+    # program order -- a JIT (PyPy, future CPython tiers) may reorder or
+    # tear them, so gate on the implementation too.  (The C++ engine uses
+    # real atomics and carries sm on any architecture/runtime.)
     import platform
 
     if platform.machine() not in ("x86_64", "AMD64"):
+        return False
+    if platform.python_implementation() != "CPython":
         return False
     return "sm" in transports_enabled()
 

@@ -51,11 +51,22 @@ _conn_ids = itertools.count(1)
 TX_CHUNK = 1 << 22  # 4 MiB socket write granularity
 RX_CHUNK = 1 << 22
 
+# Doorbell byte values on an sm-upgraded conn's socket (the contract shared
+# with the native engine -- native/sw_engine.cpp).  Any byte wakes the peer
+# (drain socket, pump ring, retry tx); DB_STARVING additionally asks the
+# peer to reply with a doorbell after it drains, which is the wakeup for a
+# producer sleeping on a full ring.  Wakeups ride the socket exclusively:
+# the send/recv syscall pair orders the cursor stores between processes, so
+# the sleep needs no shared flag and no timed poll (see shmring.py).
+DB_DATA = 1
+DB_STARVING = 2
+
 
 class TxData:
     """An outgoing tagged message (header + zero-copy payload view)."""
 
-    __slots__ = ("header", "payload", "off", "done", "fail", "owner", "rndv", "local_done")
+    __slots__ = ("header", "payload", "off", "done", "fail", "owner", "rndv",
+                 "local_done", "switch_after")
 
     def __init__(self, tag: int, payload: memoryview, done, fail, owner):
         self.header = frames.pack_data_header(tag, len(payload))
@@ -66,6 +77,7 @@ class TxData:
         self.owner = owner
         self.rndv = len(payload) > config.rndv_threshold()
         self.local_done = False
+        self.switch_after = False
 
     @property
     def total(self) -> int:
@@ -116,13 +128,20 @@ class TxData:
 
 
 class TxCtl:
-    """A small control frame (HELLO/HELLO_ACK/FLUSH/FLUSH_ACK)."""
+    """A small control frame (HELLO/HELLO_ACK/FLUSH/FLUSH_ACK).
 
-    __slots__ = ("data", "off")
+    ``switch_after`` marks the sm transport switch point (the HELLO_ACK):
+    once this item finishes writing to the socket, TX flips to the ring --
+    items queued behind it ride the ring even while it is still draining,
+    so stream bytes can never follow the ACK onto the socket.
+    """
 
-    def __init__(self, data: bytes):
+    __slots__ = ("data", "off", "switch_after")
+
+    def __init__(self, data: bytes, switch_after: bool = False):
         self.data = data
         self.off = 0
+        self.switch_after = switch_after
 
     def write(self, conn: "TcpConn", fires: list) -> bool:
         while self.off < len(self.data):
@@ -193,6 +212,11 @@ class TcpConn(BaseConn):
         self.sm_active = False
         self.sm_negotiated = False  # sticky: survives teardown for introspection
         self._tx_via_ring = False
+        # Doorbell bytes that hit a full socket buffer: flushed on EPOLLOUT.
+        # A starving byte (DB_STARVING) is the only wakeup a ring-blocked
+        # producer gets, so doorbells must never be silently dropped.
+        self._db_out = bytearray()
+        self._tx_want_sock = False
         if mode == "socket":
             try:
                 self.local_addr, self.local_port = sock.getsockname()[:2]
@@ -218,20 +242,45 @@ class TcpConn(BaseConn):
         self.sm_active = True
         self.sm_negotiated = True
         seg.unlink()
-        if not defer_tx and not self.tx:
-            self._tx_via_ring = True
+        if not defer_tx:
+            if self.tx:
+                # Anything already queued predates the switch: it drains to
+                # the socket, then TX flips (kick_tx sees the marker).
+                self.tx[-1].switch_after = True
+            else:
+                self._tx_via_ring = True
 
-    def _doorbell(self, fires: list) -> None:
+    def _doorbell(self, fires: list, val: int = DB_DATA) -> None:
+        b = bytes([val])
+        if self._db_out:
+            if val not in self._db_out:
+                self._db_out.extend(b)
+            return
         try:
-            self.sock.send(b"\x01")
+            self.sock.send(b)
         except BlockingIOError:
-            pass  # socket buffer already holds unread doorbells: peer will wake
+            # Queue + EPOLLOUT: the peer will drain the socket eventually and
+            # the byte goes out then (never lost, never polled for).
+            self._db_out.extend(b)
+            self._sync_write_interest()
         except OSError:
             self.worker._conn_broken(self, fires)
 
+    def on_writable(self, fires: list) -> None:
+        """EPOLLOUT: flush queued doorbell bytes first, then the tx queue."""
+        while self._db_out:
+            try:
+                n = self.sock.send(self._db_out)
+            except BlockingIOError:
+                return
+            except OSError:
+                self.worker._conn_broken(self, fires)
+                return
+            del self._db_out[:n]
+        self.kick_tx(fires)
+
     def _close_sm(self) -> None:
         if self._sm is not None:
-            self.worker._sm_blocked_conns.discard(self)
             seg, self._sm = self._sm, None
             self.sm_tx = self.sm_rx = None
             # sm_negotiated stays set: introspection on dead endpoints still
@@ -247,17 +296,13 @@ class TcpConn(BaseConn):
         it cannot take any (socket buffer / ring full)."""
         if not self._tx_via_ring:
             return self.sock.send(chunk)
-        ring = self.sm_tx
-        n = ring.write(chunk)
+        n = self.sm_tx.write(chunk)
         if n == 0:
-            # Two-phase sleep: publish the blocked flag, then re-check.  The
-            # residual store-load race is covered by the engine's short poll
-            # timeout while any producer is blocked (core/shmring.py notes).
-            ring.producer_blocked = 1
-            n = ring.write(chunk)
-            if n == 0:
-                raise BlockingIOError
-            ring.producer_blocked = 0
+            # Ring full.  kick_tx signals the peer with a starving doorbell;
+            # its reply (sent after it drains) re-enters kick_tx.  All wakeup
+            # signaling rides the socket, so syscall ordering makes the sleep
+            # race-free even though pure Python cannot fence (shmring.py).
+            raise BlockingIOError
         return n
 
     def _tx_writev(self, views: list) -> int:
@@ -298,8 +343,8 @@ class TcpConn(BaseConn):
         if mark is not None and mark == self._data_counter:
             self.dirty = False
 
-    def send_ctl(self, data: bytes, fires: list) -> None:
-        self.tx.append(TxCtl(data))
+    def send_ctl(self, data: bytes, fires: list, switch_after: bool = False) -> None:
+        self.tx.append(TxCtl(data, switch_after))
         self.kick_tx(fires)
 
     def kick_tx(self, fires: list) -> None:
@@ -311,14 +356,25 @@ class TcpConn(BaseConn):
             while self.tx:
                 item = self.tx[0]
                 if not item.write(self, fires):
-                    self._set_want_write(True)
                     blocked = True
                     break
                 self.tx.popleft()
+                if getattr(item, "switch_after", False):
+                    # The sm switch point (HELLO_ACK) left the socket: every
+                    # later item rides the ring, even those already queued.
+                    self._tx_via_ring = True
         except (BrokenPipeError, ConnectionResetError, OSError):
             self.worker._conn_broken(self, fires)
             return
-        if not blocked:
+        if blocked:
+            self._set_want_write(True)
+            if self._tx_via_ring:
+                # Blocked on the ring, not the socket (EPOLLOUT would spin).
+                # Ask the peer to reply once it drains; the starving byte
+                # doubles as the data doorbell for anything published above.
+                self._doorbell(fires, DB_STARVING)
+                return
+        else:
             self._set_want_write(False)
             if self.sm_active and not self._tx_via_ring:
                 # Pre-switch TCP bytes (the HELLO_ACK) fully drained: all
@@ -328,18 +384,14 @@ class TcpConn(BaseConn):
             self._doorbell(fires)
 
     def _set_want_write(self, want: bool) -> None:
-        if self._tx_via_ring:
-            # The block is on the ring, not the socket: EPOLLOUT would spin
-            # (the socket is almost always writable).  The peer doorbells
-            # when it frees space; the engine also sweeps blocked producers
-            # on a short timeout (see Worker._run).
-            if want:
-                self.worker._sm_blocked_conns.add(self)
-            else:
-                self.worker._sm_blocked_conns.discard(self)
-                if self.sm_tx is not None:
-                    self.sm_tx.producer_blocked = 0
-            return
+        # ``want`` tracks the tx queue's need for the socket.  A ring block
+        # never wants EPOLLOUT (the socket stays writable; the wakeup is the
+        # peer's doorbell reply); queued doorbell bytes always do.
+        self._tx_want_sock = want and not self._tx_via_ring
+        self._sync_write_interest()
+
+    def _sync_write_interest(self) -> None:
+        want = self._tx_want_sock or bool(self._db_out)
         if want != self._want_write:
             self._want_write = want
             self.worker._update_conn_interest(self)
@@ -370,6 +422,7 @@ class TcpConn(BaseConn):
         # published before dying are still in the ring: pump first, then
         # declare the conn broken (graceful close must deliver).
         eof = False
+        starving = False
         while True:
             try:
                 b = self.sock.recv(4096)
@@ -381,12 +434,16 @@ class TcpConn(BaseConn):
             if not b:
                 eof = True
                 break
-        h0 = self.sm_rx.head
+            if DB_STARVING in b:
+                starving = True
         self._pump_frames(fires)
         if not self.alive:
             return
-        if self.sm_rx.head != h0 and self.sm_rx.producer_blocked:
-            self.sm_rx.producer_blocked = 0
+        if starving:
+            # The peer's producer is asleep on a full ring.  The pump above
+            # freed space (or it was already free); reply unconditionally --
+            # our send comes after the head store, so by the time the peer's
+            # recv returns, its view of the cursors is current.
             self._doorbell(fires)
         if self.tx:
             self.kick_tx(fires)  # the doorbell may mean tx-ring space freed

@@ -95,11 +95,6 @@ class Worker:
         self._listener: Optional[socket.socket] = None
         self.mode = "socket"
         self._address_blob: Optional[bytes] = None
-        # sm conns whose producer is blocked on a full ring.  While any
-        # exist the select() below uses a short timeout: the doorbell-back
-        # protocol has an unfenceable store-load race in pure Python (see
-        # core/shmring.py), so the timeout bounds a missed wakeup.
-        self._sm_blocked_conns: set = set()
 
     # ------------------------------------------------------------ app side
     def _require_running(self) -> None:
@@ -225,16 +220,12 @@ class Worker:
                     if self.status == state.CLOSING:
                         break
                 try:
-                    events = self.selector.select(0.002 if self._sm_blocked_conns else None)
+                    events = self.selector.select(None)
                 except OSError:
                     break
                 for key, mask in events:
                     fires: list = []
                     key.data(mask, fires)
-                    _run_fires(fires)
-                for conn in list(self._sm_blocked_conns):
-                    fires = []
-                    conn.kick_tx(fires)
                     _run_fires(fires)
                 self._drain_ops()
             self._do_close()
@@ -359,7 +350,7 @@ class Worker:
 
     def _on_conn_io(self, conn: TcpConn, mask, fires) -> None:
         if mask & selectors.EVENT_WRITE:
-            conn.kick_tx(fires)
+            conn.on_writable(fires)
         if mask & selectors.EVENT_READ and conn.alive:
             conn.on_readable(fires)
 
@@ -691,7 +682,11 @@ class ServerWorker(Worker):
             self.conns[conn.conn_id] = conn
             self.eps[conn.conn_id] = ep
         ack_extra = {"sm": "ok"} if sm_seg is not None else None
-        conn.send_ctl(frames.pack_hello_ack(self.worker_id, ack_extra), fires)
+        # The ACK is the transport switch point: marking it routes anything
+        # queued behind it (e.g. sends from the accept callback) to the ring
+        # even while the ACK itself is still draining to the socket.
+        conn.send_ctl(frames.pack_hello_ack(self.worker_id, ack_extra), fires,
+                      switch_after=sm_seg is not None)
         if self.accept_cb is not None:
             fires.append(lambda ep=ep: self.accept_cb(ep))
 

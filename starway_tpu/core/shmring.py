@@ -19,7 +19,7 @@ Segment layout (all little-endian, offsets in bytes)::
     24..63    reserved
     64   ring 0 header (connector->acceptor direction)
            +0   u64 tail              producer cursor, free-running
-           +8   u64 producer_blocked  producer is waiting for free space
+           +8   u64 (reserved)        legacy producer_blocked flag, unused
            +64  u64 head              consumer cursor, free-running
     192  ring 1 header (acceptor->connector direction), same shape
     320..383  reserved
@@ -38,15 +38,17 @@ atomics and carries sm on any architecture.  This layout is the
 cross-engine contract: any change here must land in both engines
 (CLAUDE.md "two engines, one contract").
 
-Wakeup protocol (the part a memory-model purist would flag): a producer
-that advances ``tail`` always sends a doorbell byte on the TCP socket, so a
-sleeping consumer cannot miss data.  A producer that finds the ring full
-sets ``producer_blocked`` and stops; the consumer doorbells back when it
-frees space and sees the flag.  The flag check races (store-load reordering
-is possible on both sides, and pure Python cannot fence), so a blocked
-producer's engine additionally polls with a short timeout
-(core/engine.py/_sm_poll_timeout) -- the race costs at most one timeout
-tick, never a deadlock.
+Wakeup protocol: every cross-side wakeup rides the TCP socket, never shared
+memory.  A producer that advances ``tail`` sends a doorbell byte (DB_DATA);
+a producer that finds the ring full sends a *starving* byte (DB_STARVING)
+and sleeps; the consumer, upon seeing a starving byte, drains the ring and
+replies with a doorbell.  Because the signal is a send/recv syscall pair,
+the sleeping side's next cursor read is ordered after the waking side's
+cursor write (the kernel transition is a full barrier on both ends) -- the
+classic store-load race of flag-based schemes cannot occur, in any
+language, with no fence and no timed poll.  A doorbell that meets a full
+socket buffer is queued and flushed on EPOLLOUT (core/conn.py), so the one
+wakeup a sleeping producer depends on is never dropped.
 """
 
 from __future__ import annotations
@@ -65,7 +67,6 @@ RING_HDR = 128
 DATA_OFF = GLOBAL_HDR + 2 * RING_HDR  # 384
 
 OFF_TAIL = 0
-OFF_BLOCKED = 8
 OFF_HEAD = 64
 
 SHM_DIR = "/dev/shm"
@@ -123,14 +124,6 @@ class Ring:
     @head.setter
     def head(self, v: int) -> None:
         self._u64[self._hdr_idx + OFF_HEAD // 8] = v
-
-    @property
-    def producer_blocked(self) -> int:
-        return self._u64[self._hdr_idx + OFF_BLOCKED // 8]
-
-    @producer_blocked.setter
-    def producer_blocked(self, v: int) -> None:
-        self._u64[self._hdr_idx + OFF_BLOCKED // 8] = v
 
     def readable(self) -> int:
         return self.tail - self.head
@@ -202,6 +195,9 @@ class ShmSegment:
         size = ring_size or default_ring_size()
         if size & (size - 1):
             raise ValueError("ring size must be a power of two")
+        # Mirror the attach-side validation: the hint feeds a /dev/shm path,
+        # so strip anything that could escape the directory ('/', '..').
+        key_hint = "".join(ch for ch in key_hint if ch.isalnum() or ch in "_-")
         key = f"sw-{key_hint}-{secrets.token_hex(4)}"
         path = os.path.join(SHM_DIR, key)
         total = DATA_OFF + 2 * size

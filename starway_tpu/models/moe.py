@@ -55,9 +55,12 @@ def moe_specs() -> dict:
     }
 
 
-def moe_capacity(n_tokens: int, n_experts: int, capacity_factor: float) -> int:
-    """Static per-expert capacity for ``n_tokens`` routed tokens."""
-    return max(1, int(n_tokens / n_experts * capacity_factor))
+def moe_capacity(n_assignments: int, n_experts: int,
+                 capacity_factor: float) -> int:
+    """Static per-expert capacity for ``n_assignments`` routed (token,
+    choice) pairs -- ``T * k``, not ``T`` (GShard scales capacity by k, or
+    top-2 would drop second choices even under a balanced router)."""
+    return max(1, int(n_assignments / n_experts * capacity_factor))
 
 
 def _route(xt, router_w, k: int):
@@ -143,7 +146,7 @@ def switch_moe(x, router_w, w_in, w_out, *, capacity_factor: float = 1.25,
     e = router_w.shape[-1]
     t = b * s
     xt = x.reshape(t, d)
-    capacity = moe_capacity(t, e, capacity_factor)
+    capacity = moe_capacity(t * k, e, capacity_factor)
 
     expert_flat, gate_flat, aux = _route(xt, router_w, k)
     slot, keep = _dispatch_slots(expert_flat, e, capacity)
@@ -174,7 +177,7 @@ def sharded_switch_moe(x, router_w, w_in, w_out, axis_name: str, *,
     e = e_loc * ep
     t = b * s
     xt = x.reshape(t, d)
-    capacity = moe_capacity(t, e, capacity_factor)
+    capacity = moe_capacity(t * k, e, capacity_factor)
 
     expert_flat, gate_flat, aux = _route(xt, router_w, k)
     slot, keep = _dispatch_slots(expert_flat, e, capacity)
