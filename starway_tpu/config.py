@@ -34,6 +34,17 @@ benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
 
 ``STARWAY_BACKEND``
     Device-plane backend: ``auto`` (default), ``tpu``, or ``cpu``.
+
+``STARWAY_DEVPULL``
+    "1" (default) = negotiate the PJRT transfer-server pull path for device
+    payloads crossing processes (device-to-device, no host staging --
+    see device.py TransferManager); "0" = always stage via the framed
+    stream.
+
+``STARWAY_DEVPULL_MIN``
+    Minimum device payload size in bytes to use the pull path (default
+    65536); smaller payloads ride the framed stream, where one small copy
+    beats a pull round-trip.
 """
 
 from __future__ import annotations
@@ -46,6 +57,8 @@ __all__ = [
     "rndv_threshold",
     "use_native",
     "device_backend",
+    "devpull_enabled",
+    "devpull_threshold",
 ]
 
 
@@ -82,6 +95,14 @@ def sm_enabled() -> bool:
 
 def advertised_host() -> str:
     return _env("STARWAY_HOST", "127.0.0.1")
+
+
+def devpull_enabled() -> bool:
+    return _env("STARWAY_DEVPULL", "1") != "0"
+
+
+def devpull_threshold() -> int:
+    return int(_env("STARWAY_DEVPULL_MIN", str(64 * 1024)))
 
 
 def rndv_threshold() -> int:

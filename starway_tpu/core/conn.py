@@ -127,6 +127,41 @@ class TxData:
                 fires.append(lambda f=self.fail: f(REASON_CANCELLED))
 
 
+class TxDevpull:
+    """A DEVPULL descriptor send: a tagged message whose payload stays on
+    the sender's transfer server (device.py).  Local completion = the
+    descriptor fully handed to the transport (eager semantics: the array
+    itself is already registered for pull)."""
+
+    __slots__ = ("data", "off", "done", "fail", "owner", "switch_after")
+
+    def __init__(self, data: bytes, done, fail, owner):
+        self.data = data
+        self.off = 0
+        self.done = done
+        self.fail = fail
+        self.owner = owner
+        self.switch_after = False
+
+    def write(self, conn: "TcpConn", fires: list) -> bool:
+        while self.off < len(self.data):
+            try:
+                n = conn._tx_write(memoryview(self.data)[self.off :])
+            except BlockingIOError:
+                return False
+            self.off += n
+        if self.done is not None:
+            done, self.done = self.done, None
+            fires.append(done)
+        return True
+
+    def cancel(self, fires: list) -> None:
+        if self.done is not None and self.fail is not None:
+            fail, self.fail = self.fail, None
+            self.done = None
+            fires.append(lambda: fail(REASON_CANCELLED))
+
+
 class TxCtl:
     """A small control frame (HELLO/HELLO_ACK/FLUSH/FLUSH_ACK).
 
@@ -199,7 +234,7 @@ class TcpConn(BaseConn):
         # rx parser state
         self._hdr = bytearray(frames.HEADER_SIZE)
         self._hdr_got = 0
-        self._ctl: Optional[tuple[int, bytearray, int]] = None  # (ftype, body, got)
+        self._ctl: Optional[tuple] = None  # (ftype, body, got, header_a)
         self._rx_msg: Optional[InboundMsg] = None
         self._scratch: Optional[bytearray] = None
         # Shared-memory upgrade state (core/shmring.py).  ``sm_active`` =
@@ -217,6 +252,13 @@ class TcpConn(BaseConn):
         # producer gets, so doorbells must never be silently dropped.
         self._db_out = bytearray()
         self._tx_want_sock = False
+        # PJRT pull extension (frames.py T_DEVPULL): negotiated in the
+        # handshake; descriptors received on this conn that have not yet
+        # resolved (pull done/failed) hold back FLUSH_ACKs so the sender's
+        # flush barrier covers pulled payloads too.
+        self.devpull_ok = False
+        self._remote_msgs: set = set()
+        self._deferred_flush_acks: list = []
         if mode == "socket":
             try:
                 self.local_addr, self.local_port = sock.getsockname()[:2]
@@ -346,6 +388,44 @@ class TcpConn(BaseConn):
     def send_ctl(self, data: bytes, fires: list, switch_after: bool = False) -> None:
         self.tx.append(TxCtl(data, switch_after))
         self.kick_tx(fires)
+
+    def send_devpull(self, data: bytes, done, fail, owner, fires: list) -> None:
+        """Queue a DEVPULL descriptor (counts as data for flush/dirty
+        accounting: the flush barrier must cover the pulled payload)."""
+        if not self.alive:
+            if fail is not None:
+                fires.append(lambda: fail(REASON_NOT_CONNECTED + " (connection reset)"))
+            return
+        self.dirty = True
+        self._data_counter += 1
+        self.tx.append(TxDevpull(data, done, fail, owner))
+        self.kick_tx(fires)
+
+    # ------------------------------------------------- devpull rx tracking
+    def remote_received(self, msg) -> None:
+        self._remote_msgs.add(msg)
+
+    def defer_flush_ack(self, seq: int) -> None:
+        """Hold this barrier's ACK until the descriptors that PRECEDED it in
+        the stream resolve.  Snapshot, not the live set: a descriptor
+        arriving after the barrier must not extend the wait."""
+        self._deferred_flush_acks.append((seq, set(self._remote_msgs)))
+
+    def remote_resolved(self, msg, fires: list) -> None:
+        """A descriptor's pull completed/failed/was discarded: release any
+        FLUSH_ACKs whose snapshot it was the last unresolved member of."""
+        self._remote_msgs.discard(msg)
+        if not self._deferred_flush_acks:
+            return
+        ready = []
+        remaining = []
+        for seq, waiting in self._deferred_flush_acks:
+            waiting.discard(msg)
+            (remaining if waiting else ready).append((seq, waiting))
+        self._deferred_flush_acks = remaining
+        if self.alive:
+            for seq, _ in ready:
+                self.send_ctl(frames.pack_flush_ack(seq), fires)
 
     def kick_tx(self, fires: list) -> None:
         if not self.alive:
@@ -481,7 +561,7 @@ class TcpConn(BaseConn):
                     self._rx_msg = None
                 continue
             if self._ctl is not None:
-                ftype, body, got = self._ctl
+                ftype, body, got, a = self._ctl
                 try:
                     n = self._rx_read(memoryview(body)[got:])
                 except BlockingIOError:
@@ -494,12 +574,14 @@ class TcpConn(BaseConn):
                     return
                 got += n
                 if got < len(body):
-                    self._ctl = (ftype, body, got)
+                    self._ctl = (ftype, body, got, a)
                     continue
                 self._ctl = None
                 info = frames.unpack_json_body(bytes(body))
                 if ftype == frames.T_HELLO:
                     self.worker._on_hello(self, info, fires)
+                elif ftype == frames.T_DEVPULL:
+                    self.worker._on_devpull(self, a, info, fires)
                 else:
                     self.worker._on_hello_ack(self, info, fires)
                 continue
@@ -528,11 +610,19 @@ class TcpConn(BaseConn):
                     else:
                         self._rx_msg = msg
             elif ftype == frames.T_FLUSH:
-                self.send_ctl(frames.pack_flush_ack(a), fires)
+                if self._remote_msgs:
+                    # Unresolved pulls precede this barrier in the stream:
+                    # defer the ACK until they land (the sender's flush must
+                    # mean the payload is resident here), and force-start
+                    # any still waiting for a matching receive.
+                    self.defer_flush_ack(a)
+                    self.worker._force_start_pulls(self, fires)
+                else:
+                    self.send_ctl(frames.pack_flush_ack(a), fires)
             elif ftype == frames.T_FLUSH_ACK:
                 self.worker._on_flush_ack(self, a, fires)
-            elif ftype in (frames.T_HELLO, frames.T_HELLO_ACK):
-                self._ctl = (ftype, bytearray(b), 0)
+            elif ftype in (frames.T_HELLO, frames.T_HELLO_ACK, frames.T_DEVPULL):
+                self._ctl = (ftype, bytearray(b), 0, a)
             else:
                 self.worker._conn_broken(self, fires)
                 return

@@ -95,6 +95,10 @@ class Worker:
         self._listener: Optional[socket.socket] = None
         self.mode = "socket"
         self._address_blob: Optional[bytes] = None
+        # PJRT transfer manager for cross-process device payloads
+        # (device.py TransferManager); created lazily, dropped at close so
+        # unpulled sends die with the worker (close-cancel contract).
+        self._xfer_mgr = None
 
     # ------------------------------------------------------------ app side
     def _require_running(self) -> None:
@@ -147,6 +151,83 @@ class Worker:
             _run_fires(fires)
             return
         self._wake()
+
+    def submit_devpull(self, conn, desc: dict, tag: int, done, fail, owner) -> None:
+        """Queue a DEVPULL descriptor send (device.py decided the payload
+        rides the pull path).  Always via the engine thread: descriptor
+        ordering in the stream is what the flush barrier builds on."""
+        from . import frames as _frames
+
+        data = _frames.pack_devpull(tag, desc)
+        with self.lock:
+            self._require_running()
+            self._busy += 1
+            self.ops.append(("devpull", conn, data, done, fail, owner))
+        self._wake()
+
+    def transfer_manager(self):
+        """The worker's TransferManager, created on first use (None when
+        the PJRT transfer API is unavailable)."""
+        from .. import device as _device
+
+        with self.lock:
+            if self._xfer_mgr is None:
+                if not _device.devpull_supported():
+                    return None
+                self._xfer_mgr = _device.TransferManager(config.advertised_host())
+            return self._xfer_mgr
+
+    # ------------------------------------------------------ devpull inbound
+    def _on_devpull(self, conn, tag: int, desc: dict, fires) -> None:
+        from .. import device as _device
+
+        mgr = self.transfer_manager()
+        if mgr is None:
+            # We never advertised the capability; a peer sending DEVPULL
+            # anyway gets the message dropped (descriptor unpullable here).
+            return
+        remote = _device.RemoteMsg(desc, conn, mgr)
+        with self.lock:
+            msg, f = self.matcher.on_remote_message(tag, int(desc["n"]), remote)
+        fires.extend(f)
+        conn.remote_received(msg)
+        if msg.discard:
+            # Truncation: the receive already failed, but the sender's
+            # transfer server still holds the array.  Drain-pull it (result
+            # dropped by on_remote_complete) so the sender's memory is
+            # released; resolution also releases any flush barriers.
+            fires.append(lambda m=msg: m.remote.start(m))
+
+    def _on_pull_done(self, msg, payload, error) -> None:
+        """Completion callback from the TransferManager thread.
+
+        Conn I/O (deferred flush ACKs) is engine-thread territory, so hop
+        onto the engine via the op queue; a worker already closing only
+        needs the matcher bookkeeping."""
+        with self.lock:
+            if self.status == state.RUNNING:
+                self._busy += 1
+                self.ops.append(("pull_done", msg, payload, error))
+                queued = True
+            else:
+                fires = self.matcher.on_remote_complete(msg, payload, error)
+                queued = False
+        if queued:
+            self._wake()
+        else:
+            _run_fires(fires)
+
+    def _force_start_pulls(self, conn, fires) -> None:
+        """A FLUSH barrier arrived with descriptors still waiting for a
+        matching receive: pull them now (into spill arrays) so the ACK can
+        truthfully mean "payloads resident here".  The posted/started reads
+        race against app-thread claims, but start() is idempotent under the
+        worker lock, so a duplicate thunk is a cheap no-op."""
+        with self.lock:
+            pending = [m for m in conn._remote_msgs
+                       if m.posted is None and not m.remote.started]
+        for msg in pending:
+            fires.append(lambda m=msg: m.remote.start(m))
 
     def close(self, cb) -> None:
         with self.lock:
@@ -268,6 +349,18 @@ class Worker:
                     fires.append(lambda f=fail: f(REASON_NOT_CONNECTED))
                 return
             conn.send_data(tag, view, done, fail, owner, fires)
+        elif op[0] == "devpull":
+            _, conn, data, done, fail, owner = op
+            if conn is None or not conn.alive:
+                if fail is not None:
+                    fires.append(lambda f=fail: f(REASON_NOT_CONNECTED))
+                return
+            conn.send_devpull(data, done, fail, owner, fires)
+        elif op[0] == "pull_done":
+            _, msg, payload, error = op
+            with self.lock:
+                fires.extend(self.matcher.on_remote_complete(msg, payload, error))
+            msg.remote.conn.remote_resolved(msg, fires)
         elif op[0] == "flush":
             _, done, fail, conns = op
             self._start_flush(done, fail, conns, fires)
@@ -360,6 +453,20 @@ class Worker:
         pinned by tests/test_basic.py:250-277) -- only flush barriers
         targeting the connection fail."""
         conn.mark_dead(fires)
+        # Unclaimed, unstarted pull descriptors from the dead peer can never
+        # resolve: drop them (a claimed one keeps its receive pending, the
+        # peer-death contract; a started pull resolves on its own).
+        remote_msgs = getattr(conn, "_remote_msgs", None)
+        if remote_msgs:
+            with self.lock:
+                for msg in list(remote_msgs):
+                    if msg.posted is None and not msg.remote.started:
+                        msg.discard = True
+                        try:
+                            self.matcher.unexpected.remove(msg)
+                        except ValueError:
+                            pass
+                        remote_msgs.discard(msg)
         getattr(self, "_half_open", set()).discard(conn)
         for rec in list(self.flush_records):
             self._try_complete_flush(rec, fires)
@@ -374,14 +481,21 @@ class Worker:
     # --------------------------------------------------------------- close
     def _do_close(self) -> None:
         fires: list = []
+        _fail_idx = {"send": 5, "devpull": 4, "flush": 2}
         with self.lock:
             while self.ops:
                 op = self.ops.popleft()
-                fail = op[5] if op[0] == "send" else op[2]
+                idx = _fail_idx.get(op[0])
+                fail = op[idx] if idx is not None else None
                 if fail is not None:
                     fires.append(lambda f=fail: f(REASON_CANCELLED))
             fires.extend(self.matcher.cancel_all())
             conns = list(self.conns.values())
+            mgr, self._xfer_mgr = self._xfer_mgr, None
+        if mgr is not None:
+            # Dropping the transfer server cancels unpulled offers (the
+            # close-cancels-in-flight contract for device sends).
+            mgr.close()
         for rec in self.flush_records:
             if not rec.completed and rec.fail is not None:
                 fires.append(lambda f=rec.fail: f(REASON_CANCELLED))
@@ -511,13 +625,18 @@ class ClientWorker(Worker):
             except Exception:
                 sm_offer = None
         try:
-            extra = None
+            extra = {}
             if sm_offer is not None:
-                extra = {
-                    "sm_key": sm_offer.key,
-                    "sm_nonce": f"{sm_offer.nonce:016x}",
-                    "sm_ring": str(sm_offer.ring_size),
-                }
+                extra.update(
+                    sm_key=sm_offer.key,
+                    sm_nonce=f"{sm_offer.nonce:016x}",
+                    sm_ring=str(sm_offer.ring_size),
+                )
+            from .. import device as _device
+
+            if _device.devpull_supported():
+                extra["devpull"] = "ok"
+            extra = extra or None
             sock = socket.create_connection((addr, port), timeout=CONNECT_TIMEOUT_S)
             sock.settimeout(CONNECT_TIMEOUT_S)
             sock.sendall(frames.pack_hello(self.worker_id, mode, self.name, extra))
@@ -534,6 +653,7 @@ class ClientWorker(Worker):
             return False
         conn = TcpConn(self, sock, mode, handshaken=True)
         conn.peer_name = ack.get("worker_id", "")
+        conn.devpull_ok = ack.get("devpull") == "ok"
         if sm_offer is not None:
             if ack.get("sm") == "ok":
                 conn.adopt_sm(sm_offer, creator=True)
@@ -681,12 +801,19 @@ class ServerWorker(Worker):
         with self.lock:
             self.conns[conn.conn_id] = conn
             self.eps[conn.conn_id] = ep
-        ack_extra = {"sm": "ok"} if sm_seg is not None else None
+        ack_extra = {}
+        if sm_seg is not None:
+            ack_extra["sm"] = "ok"
+        from .. import device as _device
+
+        if info.get("devpull") == "ok" and _device.devpull_supported():
+            conn.devpull_ok = True
+            ack_extra["devpull"] = "ok"
         # The ACK is the transport switch point: marking it routes anything
         # queued behind it (e.g. sends from the accept callback) to the ring
         # even while the ACK itself is still draining to the socket.
-        conn.send_ctl(frames.pack_hello_ack(self.worker_id, ack_extra), fires,
-                      switch_after=sm_seg is not None)
+        conn.send_ctl(frames.pack_hello_ack(self.worker_id, ack_extra or None),
+                      fires, switch_after=sm_seg is not None)
         if self.accept_cb is not None:
             fires.append(lambda ep=ep: self.accept_cb(ep))
 

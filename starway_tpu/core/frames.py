@@ -22,7 +22,21 @@ HELLO_ACK0                            length of JSON body that follows
 DATA     sender tag                   payload length (bytes that follow)
 FLUSH    flush sequence number        0
 FLUSH_ACK flush sequence number       0
+DEVPULL  sender tag                   length of JSON descriptor that follows
 ======== ============================ =====================================
+
+DEVPULL is a *negotiated extension* (``"devpull": "ok"`` offered in HELLO
+and confirmed in HELLO_ACK, like ``sm``): instead of streaming a device
+payload's bytes, the sender registers the array with its PJRT transfer
+server (``jax.experimental.transfer``) and sends this small descriptor
+``{"u": uuid, "a": server_address, "n": nbytes, "s": shape, "d": dtype}``;
+the receiver pulls the buffer device-to-device over the PJRT socket --
+no host staging in the framework.  An engine that cannot pull (the C++
+engine, or a jax-less process) simply never negotiates the capability and
+peers fall back to staged DATA frames, so all engine pairings interoperate
+(see device.py TransferManager; the flush barrier covers pulls because the
+receiver defers FLUSH_ACK until descriptors received before the FLUSH have
+resolved).
 
 HELLO is sent by the connector and carries ``{"worker_id", "mode", "name"}``
 -- the analogue of the reference's worker-address Active-Message handshake
@@ -60,6 +74,7 @@ T_HELLO_ACK = 2
 T_DATA = 3
 T_FLUSH = 4
 T_FLUSH_ACK = 5
+T_DEVPULL = 6
 
 
 def pack_header(ftype: int, a: int, b: int) -> bytes:
@@ -100,3 +115,8 @@ def pack_flush(seq: int) -> bytes:
 
 def pack_flush_ack(seq: int) -> bytes:
     return pack_header(T_FLUSH_ACK, seq, 0)
+
+
+def pack_devpull(tag: int, desc: dict) -> bytes:
+    body = json.dumps(desc, separators=(",", ":")).encode()
+    return pack_header(T_DEVPULL, tag, len(body)) + body

@@ -88,7 +88,7 @@ class InboundMsg:
     """
 
     __slots__ = ("tag", "length", "sink", "received", "posted", "complete",
-                 "discard", "spill", "device_payload")
+                 "discard", "spill", "device_payload", "remote")
 
     def __init__(self, tag: int, length: int):
         self.tag = tag
@@ -100,6 +100,10 @@ class InboundMsg:
         self.discard = False
         self.spill: Optional[bytearray] = None
         self.device_payload = None
+        # Remote-pull handle (device.py RemoteMsg): the payload lives on the
+        # sender's transfer server until pulled.  Duck-typed: the matcher
+        # only ever calls ``remote.start(msg)`` via fire thunks.
+        self.remote = None
 
 
 def _copy_complete(pr: PostedRecv, payload, length: int) -> None:
@@ -140,6 +144,15 @@ class TagMatcher:
                     fires.append(lambda fail=fail: fail(REASON_TRUNCATED))
                     return fires
                 pr = PostedRecv(buf, tag, mask, done, fail, owner)
+                if msg.remote is not None and not msg.complete:
+                    # Unpulled remote payload: claim it and start the pull
+                    # (outside the lock -- fires run after release).
+                    pr.claimed = True
+                    msg.posted = pr
+                    self.unexpected.remove(msg)
+                    self.inflight.add(msg)
+                    fires.append(lambda m=msg: m.remote.start(m))
+                    return fires
                 if msg.complete:
                     self.unexpected.remove(msg)
                     if msg.device_payload is not None:
@@ -208,6 +221,69 @@ class TagMatcher:
                 pr.buf.finalize_from_host(msg.length)
             fires.append(lambda pr=pr, m=msg: pr.done(m.tag, m.length))
         # else: stays in the unexpected queue until a matching recv is posted.
+        return fires
+
+    # ------------------------------------------------------- remote (pull)
+    def on_remote_message(self, tag: int, length: int, remote) -> tuple[InboundMsg, list]:
+        """A DEVPULL descriptor arrived: the payload stays on the sender's
+        transfer server until pulled.  Matches like :meth:`on_message_start`
+        but starts a pull (via fire thunk) instead of choosing a sink."""
+        fires: list = []
+        msg = InboundMsg(tag, length)
+        msg.remote = remote
+        for pr in self.posted:
+            if not pr.claimed and tags_match(tag, pr.tag, pr.mask):
+                if length > pr.size:
+                    self.posted.remove(pr)
+                    fires.append(lambda pr=pr: pr.fail(REASON_TRUNCATED))
+                    msg.discard = True
+                    return msg, fires
+                pr.claimed = True
+                msg.posted = pr
+                self.posted.remove(pr)
+                self.inflight.add(msg)
+                fires.append(lambda m=msg: m.remote.start(m))
+                return msg, fires
+        self.unexpected.append(msg)
+        return msg, fires
+
+    def on_remote_complete(self, msg: InboundMsg, payload, error: Optional[str]) -> list:
+        """The pull for ``msg`` resolved.  ``payload`` is a device-payload
+        duck type (``.array`` / ``.nbytes`` / ``as_host_view``) on success.
+
+        On failure a claimed receive stays pending -- the peer-death
+        contract (the sender's server died mid-delivery); an unclaimed
+        message is dropped."""
+        fires: list = []
+        self.inflight.discard(msg)
+        if msg.discard:
+            return fires
+        if error is not None:
+            msg.discard = True
+            if msg.posted is None:
+                try:
+                    self.unexpected.remove(msg)
+                except ValueError:
+                    pass
+            else:
+                # The sender's transfer server died mid-delivery: re-arm the
+                # claimed receive so it stays matchable and, at close, gets
+                # the standard cancel sweep (never silently orphaned).
+                pr = msg.posted
+                msg.posted = None
+                pr.claimed = False
+                self.posted.append(pr)
+            return fires
+        msg.complete = True
+        pr = msg.posted
+        if pr is not None:
+            _copy_complete(pr, payload, msg.length)
+            fires.append(lambda pr=pr, m=msg: pr.done(m.tag, m.length))
+        else:
+            # Force-started by a flush barrier before any receive matched:
+            # hold the pulled array; a later post_recv takes the normal
+            # complete-device-payload path.
+            msg.device_payload = payload
         return fires
 
     # ------------------------------------------------------ inproc delivery

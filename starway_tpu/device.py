@@ -149,6 +149,9 @@ class DeviceBuffer:
         self.device = device
         self.array = array
         self._plan = [None]  # resolved copy plan, see _copy_to_device
+        # How the last receive landed: "device" (array handoff -- inproc or
+        # PJRT pull) or "staged" (bytes streamed through host staging).
+        self.last_transport = None
 
     @classmethod
     def like(cls, array, device=None) -> "DeviceBuffer":
@@ -225,6 +228,7 @@ class DeviceRecvSink:
             if self.devbuf.device is not None
             else jax.device_put(arr)
         )
+        self.devbuf.last_transport = "staged"
         self._staging = None
         self._staging_view = None
 
@@ -233,6 +237,7 @@ class DeviceRecvSink:
         source and target devices differ, reference handoff when they match."""
         import jax
 
+        self.devbuf.last_transport = "device"
         target = self.devbuf.device
         if target is not None:
             src_devs = array.devices() if hasattr(array, "devices") else set()
@@ -247,9 +252,246 @@ class DeviceRecvSink:
             self.devbuf.array = array
 
 
+# ------------------------------------------------------ cross-process pull
+#
+# The reference's whole value is zero-copy RDMA directly into the receiver's
+# buffer (reference: src/bindings/main.cpp:370,1172).  The TPU equivalent
+# for device payloads crossing processes is the PJRT transfer server
+# (jax.experimental.transfer, the DCN cross-slice transfer machinery):
+# the sender registers the array for pull, a tiny descriptor rides the
+# framed stream for tag matching, and the receiver pulls the buffer
+# device-to-device over the PJRT data socket -- pinned staging and
+# streaming overlap live inside PJRT, not in Python, and the framework
+# never materialises the payload on the host.  Negotiated per connection
+# ("devpull" in HELLO/HELLO_ACK); peers without it (the C++ engine, or no
+# jax) fall back to staged DATA frames.
+
+
+def devpull_supported() -> bool:
+    """Capability probe (no server started): jax present + API available +
+    a backend the transfer server is known-good on.  Experimental backends
+    (e.g. this sandbox's tunneled 'axon' platform) wedge inside
+    start_transfer_server, and a hang is worse than the staging fallback."""
+    from . import config
+
+    if not config.devpull_enabled():
+        return False
+    try:
+        import jax
+        from jax._src.lib import xla_client as xc
+
+        if not hasattr(xc._xla, "start_transfer_server"):
+            return False
+        if jax.default_backend() not in ("cpu", "tpu", "gpu", "cuda", "rocm"):
+            return False
+        # Tunneled/proxied backends present as "tpu" but run the transfer
+        # server against a remote PJRT endpoint where it wedges; the plugin
+        # name only shows in platform_version.
+        version = getattr(jax.devices()[0].client, "platform_version", "")
+        return "axon" not in version
+    except Exception:
+        return False
+
+
+class TransferManager:
+    """Per-worker PJRT transfer server wrapper.
+
+    Owned by a Worker; dropped at worker close so unpulled sends die with
+    the worker (the close-cancels-in-flight contract).  Server creation and
+    peer connections are lazy; completion waits run on one daemon thread so
+    the engine loop never blocks on a transfer.
+    """
+
+    def __init__(self, host: str):
+        import itertools
+        import queue
+        import threading
+
+        self._host = host
+        self._server = None
+        self._failed = False
+        self._conns: dict = {}  # address -> TransferConnection
+        self._uuid = itertools.count(1)
+        self._lock = threading.Lock()
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = None
+        self._closed = False
+
+    # ------------------------------------------------------------- server
+    def _ensure_server(self):
+        with self._lock:
+            if self._server is None and not self._failed and not self._closed:
+                try:
+                    import jax
+                    from jax.experimental import transfer
+
+                    client = jax.devices()[0].client
+                    # Explicit transport addresses: without them the
+                    # same-host "local bulk transport" path aborts (probed
+                    # on this jax version).
+                    self._server = transfer.start_transfer_server(
+                        client, f"{self._host}:0", [f"{self._host}:0"])
+                except Exception:
+                    logger.warning("PJRT transfer server unavailable; "
+                                   "device payloads fall back to host "
+                                   "staging", exc_info=True)
+                    self._failed = True
+            return self._server
+
+    # -------------------------------------------------------------- sender
+    def offer(self, array):
+        """Register ``array`` for remote pull; returns the descriptor dict
+        (or None when the server cannot start -- caller falls back)."""
+        srv = self._ensure_server()
+        if srv is None:
+            return None
+        uid = next(self._uuid)
+        srv.await_pull(uid, [array])
+        return {
+            "u": uid,
+            "a": srv.address(),
+            "n": int(array.nbytes),
+            "s": list(array.shape),
+            "d": str(array.dtype),
+        }
+
+    # ------------------------------------------------------------ receiver
+    def pull(self, desc: dict, device, on_done, on_fail) -> None:
+        """Pull ``desc`` onto ``device`` (None = default), asynchronously.
+
+        Everything that can block (server start, peer connect, the transfer
+        itself) runs on the manager's completion thread -- the caller is
+        typically the engine thread and must never stall.  Exactly one of
+        the callbacks fires, on that thread.
+        """
+        self._submit(lambda: self._do_pull(desc, device, on_done, on_fail))
+
+    def _do_pull(self, desc: dict, device, on_done, on_fail):
+        try:
+            srv = self._ensure_server()
+            if srv is None:
+                on_fail("transfer server unavailable")
+                return
+            import jax
+            import numpy as np
+            from jax.sharding import SingleDeviceSharding
+
+            with self._lock:
+                conn = self._conns.get(desc["a"])
+            if conn is None:
+                conn = srv.connect(desc["a"])
+                with self._lock:
+                    conn = self._conns.setdefault(desc["a"], conn)
+            dev = device if device is not None else jax.devices()[0]
+            try:
+                dt = np.dtype(desc["d"])
+            except TypeError:
+                import ml_dtypes  # bfloat16 etc. are extension dtypes
+
+                dt = np.dtype(getattr(ml_dtypes, desc["d"]))
+            spec = jax.ShapeDtypeStruct(
+                tuple(desc["s"]), dt, sharding=SingleDeviceSharding(dev))
+            (arr,) = conn.pull(int(desc["u"]), [spec])
+            arr.block_until_ready()
+        except Exception as exc:
+            on_fail(str(exc))
+            return
+        on_done(arr)
+
+    def _submit(self, thunk) -> None:
+        import threading
+
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="starway-devpull", daemon=True)
+                self._thread.start()
+        self._q.put(thunk)
+
+    def _run(self):
+        while True:
+            thunk = self._q.get()
+            if thunk is None:
+                return
+            try:
+                thunk()
+            except Exception:
+                logger.exception("devpull completion callback failed")
+
+    def close(self) -> None:
+        """Drop the server: unpulled offers die (close-cancel contract)."""
+        with self._lock:
+            self._closed = True
+            self._server = None
+            self._conns.clear()
+        self._q.put(None)
+
+
+class PulledPayload:
+    """Duck-typed payload for a pulled array (matcher contract)."""
+
+    __slots__ = ("array", "nbytes", "_host_view")
+
+    def __init__(self, array):
+        self.array = array
+        self.nbytes = int(array.nbytes)
+        self._host_view = None
+
+    def as_host_view(self) -> memoryview:
+        if self._host_view is None:
+            import numpy as np
+
+            host = np.ascontiguousarray(np.asarray(self.array))
+            self._host_view = memoryview(host).cast("B")
+        return self._host_view
+
+
+class RemoteMsg:
+    """Receiver-side handle for one DEVPULL descriptor.
+
+    Owned by the conn that received it (flush accounting) and referenced by
+    the matcher's InboundMsg (``msg.remote``).  ``start(msg)`` is invoked by
+    matcher fire thunks -- after the worker lock is released -- once the
+    message is claimed by a receive (or force-started by a FLUSH barrier).
+    """
+
+    __slots__ = ("desc", "conn", "manager", "started")
+
+    def __init__(self, desc: dict, conn, manager: TransferManager):
+        self.desc = desc
+        self.conn = conn
+        self.manager = manager
+        self.started = False
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.desc["n"])
+
+    def start(self, msg) -> None:
+        worker = self.conn.worker
+        # Start thunks can be queued from two paths concurrently (a
+        # post_recv claim and a FLUSH force-start): the check-and-set must
+        # be atomic or the uuid gets pulled twice.
+        with worker.lock:
+            if self.started:
+                return
+            self.started = True
+            pr = msg.posted
+        device = None
+        if pr is not None and not isinstance(pr.buf, memoryview):
+            device = pr.buf.devbuf.device if isinstance(pr.buf, DeviceRecvSink) else None
+        self.manager.pull(
+            self.desc, device,
+            lambda arr, m=msg: worker._on_pull_done(m, PulledPayload(arr), None),
+            lambda err, m=msg: worker._on_pull_done(m, None, err),
+        )
+
+
 def send_device(worker, conn, buffer, tag, done, fail):
-    """Route a device payload: direct array handoff in-process, host staging
-    over TCP."""
+    """Route a device payload: direct array handoff in-process, PJRT pull
+    when the peer negotiated it, host staging otherwise."""
+    from . import config
+
     if isinstance(buffer, DeviceBuffer):
         if buffer.array is None:
             raise ValueError("DeviceBuffer has no array to send")
@@ -258,9 +500,16 @@ def send_device(worker, conn, buffer, tag, done, fail):
         payload = DevicePayload(buffer)
     if conn is not None and conn.kind == "inproc":
         worker.submit_send(conn, payload, tag, done, fail, payload)
-    else:
-        view = payload.as_host_view()
-        worker.submit_send(conn, view, tag, done, fail, payload)
+        return
+    if (conn is not None and getattr(conn, "devpull_ok", False)
+            and payload.nbytes >= config.devpull_threshold()):
+        mgr = worker.transfer_manager()
+        desc = mgr.offer(payload.array) if mgr is not None else None
+        if desc is not None:
+            worker.submit_devpull(conn, desc, tag, done, fail, payload)
+            return
+    view = payload.as_host_view()
+    worker.submit_send(conn, view, tag, done, fail, payload)
 
 
 def post_device_recv(worker, buffer, tag, mask, done, fail):

@@ -1,0 +1,243 @@
+"""Cross-process device plane: PJRT transfer-server pull (T_DEVPULL).
+
+The reference's value proposition is zero-copy RDMA into the receiver's
+buffer (reference: src/bindings/main.cpp:370,1172).  These tests pin the TPU
+build's equivalent: device payloads crossing processes ride a PJRT pull
+(descriptor over the framed stream, buffer device-to-device over the PJRT
+socket) instead of being staged through host bytes, and the flush barrier
+covers the pulled payload (FLUSH_ACK deferred until pulls resolve).
+
+Runs on the virtual CPU mesh; the same code path carries TPU arrays on real
+hardware (jax.experimental.transfer is the DCN cross-slice machinery).
+"""
+
+import asyncio
+import gc
+import multiprocessing
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from starway_tpu import Client, DeviceBuffer, Server
+
+pytestmark = pytest.mark.asyncio
+
+MASK = (1 << 64) - 1
+N = 1 << 20  # 1 MiB: comfortably above STARWAY_DEVPULL_MIN
+
+
+@pytest.fixture
+def port():
+    return random.randint(10000, 50000)
+
+
+@pytest.fixture(autouse=True)
+def _force_tcp(monkeypatch):
+    # The inproc fast path would bypass the wire; devpull is a wire feature.
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    # devpull is negotiated by the Python engine (the C++ engine cannot run
+    # JAX pulls; negotiation makes mixed pairings fall back safely).
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+
+
+async def _pair(port):
+    server = Server()
+    client = Client()
+    server.listen("127.0.0.1", port)
+    await client.aconnect("127.0.0.1", port)
+    return server, client
+
+
+async def test_devpull_same_host_two_workers(port):
+    """Two workers over a real socket in one process: the payload must
+    arrive via the pull path (array handoff), not host staging."""
+    server, client = await _pair(port)
+    try:
+        src = jax.device_put(jnp.arange(N, dtype=jnp.uint8))
+        sink = DeviceBuffer((N,), jnp.uint8)
+
+        recv_fut = server.arecv(sink, 0x77, MASK)
+        await asyncio.sleep(0.05)
+        send_fut = client.asend(src, 0x77)
+        # Drop the sender-side reference: the transfer server must keep the
+        # buffer alive until pulled.
+        del src
+        gc.collect()
+        await send_fut
+        tag, length = await recv_fut
+
+        assert (tag, length) == (0x77, N)
+        assert sink.last_transport == "device", (
+            f"expected PJRT pull, got {sink.last_transport}")
+        np.testing.assert_array_equal(
+            np.asarray(sink.array), np.arange(N, dtype=np.uint8))
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_devpull_flush_covers_pull(port):
+    """aflush must not complete until the receiver has pulled: after
+    flush + close, the payload is resident at the receiver even though no
+    receive was posted yet (force-started by the FLUSH barrier)."""
+    server, client = await _pair(port)
+    try:
+        src = jax.device_put(jnp.full(N, 7, dtype=jnp.uint8))
+        await client.asend(src, 0x88)
+        await client.aflush()
+        await client.aclose()
+
+        sink = DeviceBuffer((N,), jnp.uint8)
+        tag, length = await asyncio.wait_for(server.arecv(sink, 0x88, MASK), 10)
+        assert (tag, length) == (0x88, N)
+        np.testing.assert_array_equal(
+            np.asarray(sink.array), np.full(N, 7, dtype=np.uint8))
+    finally:
+        await server.aclose()
+
+
+async def test_devpull_disabled_falls_back_to_staging(port, monkeypatch):
+    monkeypatch.setenv("STARWAY_DEVPULL", "0")
+    server, client = await _pair(port)
+    try:
+        src = jax.device_put(jnp.arange(N, dtype=jnp.uint8))
+        sink = DeviceBuffer((N,), jnp.uint8)
+        recv_fut = server.arecv(sink, 0x99, MASK)
+        await asyncio.sleep(0.05)
+        await client.asend(src, 0x99)
+        tag, length = await recv_fut
+        assert (tag, length) == (0x99, N)
+        assert sink.last_transport == "staged"
+        np.testing.assert_array_equal(
+            np.asarray(sink.array), np.arange(N, dtype=np.uint8))
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_devpull_host_buffer_recv(port):
+    """A plain host-byte receive matching a pulled payload still delivers
+    (pull to device, then stage into the host buffer)."""
+    server, client = await _pair(port)
+    try:
+        src = jax.device_put(jnp.arange(N, dtype=jnp.uint8))
+        buf = np.zeros(N, dtype=np.uint8)
+        recv_fut = server.arecv(buf, 0xAA, MASK)
+        await asyncio.sleep(0.05)
+        await client.asend(src, 0xAA)
+        tag, length = await asyncio.wait_for(recv_fut, 10)
+        assert (tag, length) == (0xAA, N)
+        np.testing.assert_array_equal(buf, np.arange(N, dtype=np.uint8))
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_devpull_flush_not_blocked_by_later_send(port):
+    """The FLUSH barrier waits only for descriptors that preceded it: a
+    devpull sent after the flush (for a tag nobody receives) must not hold
+    the barrier hostage."""
+    server, client = await _pair(port)
+    try:
+        a = jax.device_put(jnp.full(N, 1, dtype=jnp.uint8))
+        b = jax.device_put(jnp.full(N, 2, dtype=jnp.uint8))
+        await client.asend(a, 0xC1)
+        flush_fut = client.aflush()
+        await asyncio.sleep(0.02)
+        await client.asend(b, 0xC2)  # never received
+        await asyncio.wait_for(flush_fut, 10)
+
+        sink = DeviceBuffer((N,), jnp.uint8)
+        tag, length = await asyncio.wait_for(server.arecv(sink, 0xC1, MASK), 10)
+        assert (tag, length) == (0xC1, N)
+        np.testing.assert_array_equal(
+            np.asarray(sink.array), np.full(N, 1, dtype=np.uint8))
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+# --------------------------------------------------------- multiprocess
+
+
+def _child_send_device(port, flush_then_close):
+    import os
+
+    os.environ["STARWAY_TLS"] = "tcp"
+    os.environ["STARWAY_NATIVE"] = "0"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import asyncio
+
+    import jax.numpy as jnp
+
+    from starway_tpu import Client
+
+    async def run():
+        client = Client()
+        for _ in range(80):
+            try:
+                await client.aconnect("127.0.0.1", port)
+                break
+            except Exception:
+                client = Client()
+                await asyncio.sleep(0.1)
+        arr = jax.device_put(jnp.arange(N, dtype=jnp.uint8))
+        await client.asend(arr, 0xBB)
+        if flush_then_close:
+            await client.aflush()
+            await client.aclose()
+        else:
+            # keep the worker (and its transfer server) alive for the pull
+            await asyncio.sleep(15)
+
+    asyncio.run(run())
+
+
+async def test_devpull_cross_process(port):
+    """Real two-process transfer: jax.Array crosses processes via the pull
+    path into a DeviceBuffer, bytes never staged through this framework."""
+    ctx = multiprocessing.get_context("spawn")
+    proc = ctx.Process(target=_child_send_device, args=(port, False), daemon=True)
+    server = Server()
+    server.listen("127.0.0.1", port)
+    proc.start()
+    try:
+        sink = DeviceBuffer((N,), jnp.uint8)
+        tag, length = await asyncio.wait_for(server.arecv(sink, 0xBB, MASK), 30)
+        assert (tag, length) == (0xBB, N)
+        assert sink.last_transport == "device", (
+            f"expected PJRT pull, got {sink.last_transport}")
+        np.testing.assert_array_equal(
+            np.asarray(sink.array), np.arange(N, dtype=np.uint8))
+    finally:
+        proc.terminate()
+        proc.join(5)
+        await server.aclose()
+
+
+async def test_devpull_cross_process_flush_close(port):
+    """Sender flushes then closes before the receive is posted: the FLUSH
+    barrier pulls the payload across, so it survives the sender's close."""
+    ctx = multiprocessing.get_context("spawn")
+    proc = ctx.Process(target=_child_send_device, args=(port, True), daemon=True)
+    server = Server()
+    server.listen("127.0.0.1", port)
+    proc.start()
+    try:
+        proc.join(30)  # sender has flushed, closed, and exited
+        sink = DeviceBuffer((N,), jnp.uint8)
+        tag, length = await asyncio.wait_for(server.arecv(sink, 0xBB, MASK), 10)
+        assert (tag, length) == (0xBB, N)
+        np.testing.assert_array_equal(
+            np.asarray(sink.array), np.arange(N, dtype=np.uint8))
+    finally:
+        if proc.is_alive():
+            proc.terminate()
+            proc.join(5)
+        await server.aclose()
