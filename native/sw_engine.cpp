@@ -367,11 +367,20 @@ struct Matcher {
     posted.push_back(pr_in);
   }
 
+  // Reserved probe tag ("SW_PROBE"): consumed and dropped on arrival, never
+  // queued, never matched -- live link probing (perf.autocalibrate) cannot
+  // pollute matching state.  Contract shared with core/matching.py.
+  static constexpr uint64_t kProbeTag = 0x53575F50524F4245ull;
+
   // Header of a streamed message arrived; returns the record.
   InboundMsg* on_start(uint64_t tag, uint64_t length, FireList& fires) {
     auto* m = new InboundMsg();
     m->tag = tag;
     m->length = length;
+    if (tag == kProbeTag) {
+      m->discard = true;  // bytes drain to scratch, nothing is queued
+      return m;
+    }
     inflight.insert(m);
     for (auto it = posted.begin(); it != posted.end(); ++it) {
       if (!it->claimed && tags_match(tag, it->tag, it->mask)) {
@@ -1160,6 +1169,13 @@ struct Worker {
     c->tx.clear();
     c->alive = false;
     ep_del(c->fd);
+    if (c->rx_msg) {
+      // Mirror conn_broken: a message mid-drain (e.g. a discarded probe,
+      // which sits in no matcher queue) must be purged or it leaks.
+      std::lock_guard<std::mutex> g(mu);
+      matcher.purge_inflight(c->rx_msg);
+      c->rx_msg = nullptr;
+    }
     if (abort) {
       // RST: a partially-written message must not look deliverable.
       struct linger lg { 1, 0 };

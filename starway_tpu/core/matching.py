@@ -39,6 +39,14 @@ from ..errors import REASON_CANCELLED, REASON_TRUNCATED
 DoneCb = Callable[[int, int], None]  # (sender_tag, length)
 FailCb = Callable[[str], None]
 
+# Reserved probe tag ("SW_PROBE"): messages sent with this exact tag are
+# consumed and dropped by the matcher on arrival -- they never enter the
+# unexpected queue and never match a receive, wildcard or not.  This is
+# what perf.autocalibrate sends, so live link probing cannot pollute the
+# peer's matching state.  The contract is shared with the native engine
+# (native/sw_engine.cpp).
+PROBE_TAG = 0x53575F50524F4245
+
 
 def tags_match(stag: int, rtag: int, rmask: int) -> bool:
     return (stag & rmask) == (rtag & rmask)
@@ -179,6 +187,9 @@ class TagMatcher:
         """
         fires: list = []
         msg = InboundMsg(tag, length)
+        if tag == PROBE_TAG:
+            msg.discard = True  # bytes drain to scratch, nothing is queued
+            return msg, fires
         self.inflight.add(msg)
         for pr in self.posted:
             if not pr.claimed and tags_match(tag, pr.tag, pr.mask):
@@ -231,6 +242,9 @@ class TagMatcher:
         fires: list = []
         msg = InboundMsg(tag, length)
         msg.remote = remote
+        if tag == PROBE_TAG:
+            msg.discard = True  # engine drain-pulls it, result dropped
+            return msg, fires
         for pr in self.posted:
             if not pr.claimed and tags_match(tag, pr.tag, pr.mask):
                 if length > pr.size:
@@ -296,6 +310,8 @@ class TagMatcher:
         """
         fires: list = []
         length = _size(payload)
+        if tag == PROBE_TAG:
+            return fires  # probe traffic is dropped, never queued
         for pr in self.posted:
             if not pr.claimed and tags_match(tag, pr.tag, pr.mask):
                 self.posted.remove(pr)

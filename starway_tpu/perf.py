@@ -39,25 +39,27 @@ async def autocalibrate(client, transport: str = "inproc",
                         sizes=(1 << 10, 1 << 16, 1 << 20, 1 << 24)) -> tuple[float, float]:
     """Fit the link model from live one-way probes on a connected Client.
 
-    Measures enqueue-to-flush time per size (tag 0x7E57), which tracks the
-    transport's alpha/beta -- the role ucp_ep_evaluate_perf's model plays in
-    the reference.  NOTE: the peer retains the probe payloads in its
-    unexpected queue; the receiving side should drain tag 0x7E57 (wildcard
-    recvs will also see them), so prefer running this before real traffic
-    or on a dedicated probe connection.
+    Measures enqueue-to-flush time per size, which tracks the transport's
+    alpha/beta -- the role ucp_ep_evaluate_perf's model plays in the
+    reference.  Probes ride the reserved PROBE_TAG, which both engines'
+    matchers consume and drop on arrival (core/matching.py) -- probing a
+    live connection cannot pollute the peer's matching state or be claimed
+    by wildcard receives.
     """
     import time
 
     import numpy as np
 
+    from .core.matching import PROBE_TAG
+
     samples = []
     for size in sizes:
         buf = np.zeros(size, dtype=np.uint8)
         # warmup
-        await client.asend(buf, 0x7E57)
+        await client.asend(buf, PROBE_TAG)
         await client.aflush()
         t0 = time.perf_counter()
-        await client.asend(buf, 0x7E57)
+        await client.asend(buf, PROBE_TAG)
         await client.aflush()
         samples.append((size, time.perf_counter() - t0))
     return calibrate(transport, samples)

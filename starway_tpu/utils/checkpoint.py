@@ -6,6 +6,12 @@ This build ships models, so it ships checkpointing: orbax-backed when
 available (sharding-aware, async-capable), with a plain ``.npz`` fallback
 that round-trips any pytree of arrays on hosts without orbax.
 
+Every checkpoint carries a ``manifest.json`` recording the backend that
+wrote it plus the leaf structure (count, shapes, dtypes).  Restore
+dispatches on the recorded backend -- never on file-existence guessing --
+and validates the caller's ``like`` tree against the manifest, so a shape
+or structure mismatch fails loudly instead of silently casting garbage.
+
 >>> save_pytree("/ckpt/step1000", {"params": params, "opt": opt_state})
 >>> restored = restore_pytree("/ckpt/step1000", like={"params": params, "opt": opt_state})
 """
@@ -15,6 +21,8 @@ from __future__ import annotations
 import json
 from pathlib import Path
 from typing import Any
+
+_MANIFEST = "manifest.json"
 
 
 def _have_orbax() -> bool:
@@ -26,39 +34,110 @@ def _have_orbax() -> bool:
         return False
 
 
+def _leaf_specs(leaves) -> list[dict]:
+    import numpy as np
+
+    def dtype_of(x):
+        # No np.asarray fallback unless needed: materialising every leaf on
+        # the host would double save cost and break on multi-host shardings.
+        return str(x.dtype) if hasattr(x, "dtype") else str(np.asarray(x).dtype)
+
+    return [{"shape": list(np.shape(x)), "dtype": dtype_of(x)} for x in leaves]
+
+
 def save_pytree(path: str, tree: Any) -> str:
     """Persist a pytree of arrays; returns the backend used."""
+    import jax
+
     p = Path(path)
-    if _have_orbax():
+    p.mkdir(parents=True, exist_ok=True)
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    backend = "orbax" if _have_orbax() else "npz"
+    if backend == "orbax":
         import orbax.checkpoint as ocp
 
         ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(p.absolute(), tree, force=True)
-        return "orbax"
-    import numpy as np
-    import jax
+        ckptr.save((p / "tree").absolute(), tree, force=True)
+    else:
+        import numpy as np
 
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    p.mkdir(parents=True, exist_ok=True)
-    np.savez(p / "leaves.npz", **{str(i): np.asarray(x) for i, x in enumerate(leaves)})
-    (p / "treedef.json").write_text(json.dumps({"n": len(leaves)}))
-    return "npz"
+        np.savez(p / "leaves.npz", **{str(i): np.asarray(x) for i, x in enumerate(leaves)})
+    # Manifest last and atomically: its presence marks a complete checkpoint.
+    import os
+
+    tmp = p / (_MANIFEST + ".tmp")
+    tmp.write_text(json.dumps(
+        {"backend": backend, "n": len(leaves), "leaves": _leaf_specs(leaves)}
+    ))
+    os.replace(tmp, p / _MANIFEST)
+    return backend
+
+
+def _validate(manifest: dict, leaves, path: Path) -> None:
+    import numpy as np
+
+    specs = manifest.get("leaves")
+    if manifest.get("n") != len(leaves):
+        raise ValueError(
+            f"checkpoint {path}: structure mismatch -- holds "
+            f"{manifest.get('n')} leaves, 'like' tree has {len(leaves)}"
+        )
+    if not specs:
+        return  # older manifest without per-leaf specs
+    for i, (spec, leaf) in enumerate(zip(specs, leaves)):
+        want = tuple(spec["shape"])
+        got = tuple(np.shape(leaf))
+        if want != got:
+            raise ValueError(
+                f"checkpoint {path}: leaf {i} shape mismatch -- "
+                f"checkpoint has {want}, 'like' tree has {got}"
+            )
 
 
 def restore_pytree(path: str, like: Any) -> Any:
-    """Restore a pytree saved by :func:`save_pytree`, shaped like ``like``."""
+    """Restore a pytree saved by :func:`save_pytree`, shaped like ``like``.
+
+    Validates leaf count and shapes against the manifest; dtypes are cast
+    to the ``like`` tree's dtypes (the documented way to restore e.g. a
+    bf16 training checkpoint into f32 eval params).
+    """
+    import jax
+
     p = Path(path)
-    if _have_orbax() and not (p / "leaves.npz").exists():
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    mf_path = p / _MANIFEST
+    if mf_path.exists():
+        manifest = json.loads(mf_path.read_text())
+        _validate(manifest, leaves, p)
+        backend = manifest["backend"]
+    else:
+        # Pre-manifest layout (round-1 checkpoints): npz marker file or a
+        # bare orbax directory.
+        backend = "npz" if (p / "leaves.npz").exists() else "orbax"
+    if backend == "orbax":
+        if not _have_orbax():
+            raise RuntimeError(
+                f"checkpoint {p} was written by orbax, which is not importable here"
+            )
         import orbax.checkpoint as ocp
 
         ckptr = ocp.PyTreeCheckpointer()
-        return ckptr.restore(p.absolute(), item=like)
+        target = p / "tree" if (p / "tree").exists() else p
+        out = ckptr.restore(target.absolute(), item=like)
+        # Orbax returns the checkpoint's saved dtypes; cast to the ``like``
+        # tree's dtypes so both backends honour the documented contract.
+        return jax.tree_util.tree_map(
+            lambda x, l: x.astype(l.dtype) if hasattr(l, "dtype") else x,
+            out, like)
     import numpy as np
-    import jax
     import jax.numpy as jnp
 
-    leaves, treedef = jax.tree_util.tree_flatten(like)
     data = np.load(p / "leaves.npz")
+    if len(data.files) != len(leaves):
+        raise ValueError(
+            f"checkpoint {p}: holds {len(data.files)} leaves, "
+            f"'like' tree has {len(leaves)}"
+        )
     restored = [
         jnp.asarray(data[str(i)]).astype(leaf.dtype)
         for i, leaf in enumerate(leaves)

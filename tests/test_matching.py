@@ -126,3 +126,24 @@ def test_cancel_all_fails_everything():
     run(m.cancel_all())
     assert len(got) == 1 and "cancel" in got[0].lower()
     assert not m.posted and not m.unexpected and not m.inflight
+
+
+def test_probe_tag_discarded():
+    """Messages on the reserved PROBE_TAG never enter the unexpected queue
+    and never match a receive -- even a wildcard posted first."""
+    from starway_tpu.core.matching import PROBE_TAG
+
+    m = TagMatcher()
+    buf = np.zeros(16, dtype=np.uint8)
+    got = []
+    run(m.post_recv(memoryview(buf), 0, 0, lambda t, n: got.append((t, n)),
+                    lambda e: got.append(e)))  # wildcard
+    msg, fires = m.on_message_start(PROBE_TAG, 8)
+    run(fires)
+    assert msg.discard and not m.unexpected and not got
+    run(m.on_message_complete(msg))
+    assert not got and len(m.posted) == 1  # wildcard still armed
+
+    # The inproc fast path drops probes too.
+    run(m.deliver(PROBE_TAG, memoryview(b"\x00" * 8)))
+    assert not got and not m.unexpected

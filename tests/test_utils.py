@@ -26,6 +26,29 @@ def test_checkpoint_roundtrip(tmp_path):
                                       np.asarray(b, dtype=np.float32))
 
 
+def test_checkpoint_validates_structure(tmp_path):
+    import json
+
+    import pytest
+
+    tree = {"w": jnp.ones((4, 6), jnp.float32), "b": jnp.zeros((6,), jnp.float32)}
+    save_pytree(str(tmp_path / "ckpt"), tree)
+
+    # Manifest records the backend + leaf specs (no file-existence guessing).
+    manifest = json.loads((tmp_path / "ckpt" / "manifest.json").read_text())
+    assert manifest["backend"] in ("orbax", "npz")
+    assert manifest["n"] == 2
+
+    # Shape mismatch fails loudly instead of restoring garbage.
+    bad_shape = {"w": jnp.ones((4, 7), jnp.float32), "b": jnp.zeros((6,), jnp.float32)}
+    with pytest.raises(ValueError, match="shape mismatch"):
+        restore_pytree(str(tmp_path / "ckpt"), like=bad_shape)
+
+    # Structure (leaf count) mismatch too.
+    with pytest.raises(ValueError, match="structure mismatch"):
+        restore_pytree(str(tmp_path / "ckpt"), like={"w": tree["w"]})
+
+
 def test_perf_estimate_positive_and_monotone():
     for t in ("inproc", "tcp", "ici", "dcn", "unknown"):
         small = perf.estimate(t, 1)
