@@ -149,5 +149,24 @@ def main() -> None:
     )
 
 
+def main_kernels(argv: list) -> None:
+    """``bench.py --kernels [names] [flags...]``: tunnel-immune on-chip
+    compute rows (matmul ceiling, flash fwd/bwd vs stock, decode us/token,
+    train MFU, 'check' numerics) -- delegates to scripts/kernel_bench.py,
+    forwarding any further flags (e.g. --iters)."""
+    import runpy
+
+    which = argv[0] if argv and not argv[0].startswith("-") else "all"
+    rest = argv[1:] if argv and not argv[0].startswith("-") else argv
+    sys.argv = ["kernel_bench.py", "--which", which, *rest]
+    runpy.run_path(
+        __file__.rsplit("/", 1)[0] + "/scripts/kernel_bench.py",
+        run_name="__main__",
+    )
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--kernels":
+        main_kernels(sys.argv[2:])
+    else:
+        main()

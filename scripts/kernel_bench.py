@@ -172,22 +172,170 @@ def bench_flash_bwd(b=1, hq=8, hkv=2, s=8192, d=128, causal=True, iters: int = 4
                       f"bf16, {dt*1e3:.2f} ms/iter (fwd+bwd)"}
 
 
+def bench_decode(b=1, hq=8, hkv=2, t=8192, d=128, iters: int = 64, impl="ours"):
+    """Cached single-token decode attention: us/token + effective HBM GB/s
+    (decode is bandwidth-bound: the kernel's job is streaming the grouped
+    cache exactly once)."""
+    from starway_tpu.models.generate import _attend_cached
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, hq, 1, d), jnp.bfloat16)
+    kc = jax.random.normal(kk, (b, hkv, t, d), jnp.bfloat16)
+    vc = jax.random.normal(kv, (b, hkv, t, d), jnp.bfloat16)
+    pos = jnp.asarray(t - 1, jnp.int32)
+
+    use_pallas = impl == "ours"
+
+    def kern(q, kc, vc):
+        return _attend_cached(q, kc, vc, pos, hq // hkv, use_pallas=use_pallas)
+
+    dt = _timeit(lambda q, kc, vc, iters: _chain(kern, q, kc, vc, iters=iters),
+                 q, kc, vc, iters=iters)
+    cache_bytes = 2 * b * hkv * t * d * 2  # k + v, bf16
+    return {"metric": f"decode_{impl}_us_per_token", "value": round(dt * 1e6, 2),
+            "unit": "us",
+            "detail": f"B={b} Hq={hq} Hkv={hkv} T={t} D={d} bf16, grouped "
+                      f"cache {cache_bytes / 1e6:.1f} MB -> "
+                      f"{cache_bytes / dt / 1e9:.0f} GB/s effective"}
+
+
+def bench_train_mfu(iters: int = 4):
+    """Tiny-Llama train-step MFU on one chip: model flops from config, time
+    from an on-device fori_loop of full optimizer steps."""
+    import numpy as np
+    import optax
+
+    from starway_tpu.models import LlamaConfig, init_params, make_train_step
+
+    cfg = LlamaConfig.preset(
+        "debug", d_model=512, n_layers=4, n_heads=8, n_kv_heads=8, d_ff=1536,
+        vocab_size=8192, dtype="bfloat16")
+    B, S = 8, 1024
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tx = optax.adamw(1e-3)
+    opt = tx.init(params)
+    step = make_train_step(cfg, tx)
+    batch = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (B, S + 1), dtype=np.int32))
+
+    def loop(params, opt, batch, iters):
+        def body(_, carry):
+            p, o = carry
+            p, o, loss = step(p, o, batch)
+            return (p, o)
+
+        p, o = lax.fori_loop(0, iters, body, (params, opt))
+        return jax.tree_util.tree_leaves(p)[0][(0, 0)].astype(jnp.float32)
+
+    dt = _timeit(loop, params, opt, batch, iters=iters)
+
+    # 6ND counts matmul flops only: the embedding table is a gather/scatter,
+    # not a matmul, so it is excluded (lm_head is a real matmul and stays).
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+    n_matmul = n_params - params["embed"].size
+    tokens = B * S
+    # 6ND for fwd+bwd matmul flops + attention term (12 * L * H * S^2 * Dh,
+    # halved for causality).
+    attn = 6 * cfg.n_layers * cfg.n_heads * S * S * cfg.head_dim * B
+    flops = 6 * n_matmul * tokens + attn
+    tflops = flops / dt / 1e12
+    peak = 197e12  # v5e bf16 peak
+    return {"metric": "train_step_mfu", "value": round(tflops / (peak / 1e12), 4),
+            "unit": "frac_of_197T",
+            "detail": f"{tflops:.1f} TFLOP/s, {n_params/1e6:.1f}M params "
+                      f"({n_matmul/1e6:.1f}M matmul), "
+                      f"B={B} S={S}, {dt*1e3:.1f} ms/step"}
+
+
+def check_numerics():
+    """On-chip numerics: pin the pallas kernels against the lax oracles on
+    the REAL backend (the pytest suite pins them in CPU interpret mode; this
+    is the hardware half of that contract -- VERDICT r1 #8)."""
+    from starway_tpu.models.generate import _attend_cached
+    from starway_tpu.ops.attention import attention_reference, repeat_kv
+    from starway_tpu.ops.pallas_attention import flash_attention
+
+    b, hq, hkv, s, d = 1, 8, 2, 512, 128
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(kq, (b, hq, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, hkv, s, d), jnp.bfloat16)
+    rows = []
+
+    def rel_err(a, r):
+        a = a.astype(jnp.float32)
+        r = r.astype(jnp.float32)
+        return float(jnp.max(jnp.abs(a - r)) / (jnp.max(jnp.abs(r)) + 1e-9))
+
+    ref = attention_reference(q.astype(jnp.float32),
+                              repeat_kv(k, hq // hkv).astype(jnp.float32),
+                              repeat_kv(v, hq // hkv).astype(jnp.float32),
+                              causal=True)
+    err = rel_err(flash_attention(q, k, v, causal=True), ref)
+    rows.append({"metric": "check_flash_fwd_onchip", "value": err,
+                 "unit": "max_rel_err", "ok": bool(err < 2e-2)})
+
+    def loss(fn):
+        return lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum()
+
+    g_ours = jax.grad(loss(functools.partial(flash_attention, causal=True)),
+                      argnums=(0, 1, 2))(q, k, v)
+    oracle = lambda q, k, v: attention_reference(
+        q, repeat_kv(k, hq // hkv), repeat_kv(v, hq // hkv), causal=True)
+    g_ref = jax.grad(loss(oracle), argnums=(0, 1, 2))(q, k, v)
+    # Relative: dk/dv accumulate over S rows, so bf16 noise scales with the
+    # magnitude (measured ~0.8% at S=1024 on-chip).
+    gerr = max(rel_err(a, r) for a, r in zip(g_ours, g_ref))
+    rows.append({"metric": "check_flash_bwd_onchip", "value": gerr,
+                 "unit": "max_rel_err", "ok": bool(gerr < 2e-2)})
+
+    t = 1024
+    qd = jax.random.normal(kq, (b, hq, 1, d), jnp.bfloat16)
+    kc = jax.random.normal(kk, (b, hkv, t, d), jnp.bfloat16)
+    vc = jax.random.normal(kv, (b, hkv, t, d), jnp.bfloat16)
+    pos = jnp.asarray(t // 2, jnp.int32)
+    dk = _attend_cached(qd, kc, vc, pos, hq // hkv, use_pallas=True)
+    dr = _attend_cached(qd, kc, vc, pos, hq // hkv, use_pallas=False)
+    derr = float(jnp.max(jnp.abs(dk.astype(jnp.float32) - dr.astype(jnp.float32))))
+    rows.append({"metric": "check_decode_onchip", "value": derr,
+                 "unit": "max_abs_err", "ok": bool(derr < 2e-2)})
+    return rows
+
+
 BENCHES = {
     "matmul": bench_matmul,
     "flash": bench_flash_fwd,
     "flash_stock": functools.partial(bench_flash_fwd, impl="stock"),
     "flash_bwd": bench_flash_bwd,
     "flash_bwd_stock": functools.partial(bench_flash_bwd, impl="stock"),
+    "decode": bench_decode,
+    "decode_lax": functools.partial(bench_decode, impl="lax"),
+    "train_mfu": bench_train_mfu,
 }
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--which", default="all")
+    ap.add_argument("--which", default="all",
+                    help="comma list of benches, 'all', or 'check' "
+                         "(on-chip numerics vs the lax oracles)")
     ap.add_argument("--iters", type=int, default=None)
     args = ap.parse_args()
+    if args.which == "check":
+        ok = True
+        for row in check_numerics():
+            ok = ok and row["ok"]
+            print(json.dumps(row), flush=True)
+        raise SystemExit(0 if ok else 1)
     names = list(BENCHES) if args.which == "all" else args.which.split(",")
+    exit_code = 0
     for name in names:
+        if name == "check":
+            for row in check_numerics():
+                if not row["ok"]:
+                    exit_code = 1
+                print(json.dumps(row), flush=True)
+            continue
         fn = BENCHES[name]
         kw = {"iters": args.iters} if args.iters else {}
         try:
@@ -195,6 +343,7 @@ def main():
         except Exception as e:  # keep going; report the failure as a row
             row = {"metric": name, "error": f"{type(e).__name__}: {e}"[:300]}
         print(json.dumps(row), flush=True)
+    raise SystemExit(exit_code)
 
 
 if __name__ == "__main__":

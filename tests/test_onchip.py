@@ -1,0 +1,31 @@
+"""On-chip kernel numerics, gated behind STARWAY_ONCHIP=1.
+
+The regular suite pins kernel numerics in CPU interpret mode
+(tests/test_pallas.py); this marker runs the hardware half of that
+contract -- scripts/kernel_bench.py --which check in a clean subprocess
+(the suite's conftest pins this process to the CPU platform, so the chip
+is only reachable from a child with an untouched environment).
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+
+@pytest.mark.skipif(os.environ.get("STARWAY_ONCHIP") != "1",
+                    reason="on-chip numerics need a real TPU; enable with STARWAY_ONCHIP=1")
+def test_onchip_kernel_numerics():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    out = subprocess.run(
+        [sys.executable, str(Path(__file__).parent.parent / "scripts" / "kernel_bench.py"),
+         "--which", "check"],
+        capture_output=True, text=True, timeout=840, env=env,
+    )
+    rows = [json.loads(l) for l in out.stdout.splitlines() if l.startswith("{")]
+    assert out.returncode == 0, f"on-chip checks failed:\n{out.stdout}\n{out.stderr}"
+    assert len(rows) == 3 and all(r["ok"] for r in rows), rows
