@@ -511,6 +511,8 @@ struct Conn {
   std::string ctl_body;
   size_t ctl_need = 0;
   InboundMsg* rx_msg = nullptr;
+  // rx_msg is a probe record the matcher does not own (see T_DATA dispatch).
+  bool rx_msg_unowned = false;
   std::vector<uint8_t> scratch;
   // flush accounting
   uint64_t flush_seq = 0, flush_acked = 0, data_counter = 0;
@@ -992,6 +994,7 @@ struct Worker {
             matcher.on_complete(m, fires);
           }
           c->rx_msg = nullptr;
+          c->rx_msg_unowned = false;
         }
         continue;
       }
@@ -1028,6 +1031,9 @@ struct Worker {
             matcher.on_complete(m, fires);
           } else {
             c->rx_msg = m;
+            // Probe records live in no matcher queue: this conn owns them
+            // (close must free them without touching freed matcher state).
+            c->rx_msg_unowned = (a == Matcher::kProbeTag);
           }
           break;
         }
@@ -1146,6 +1152,7 @@ struct Worker {
       std::lock_guard<std::mutex> g(mu);
       matcher.purge_inflight(c->rx_msg);
       c->rx_msg = nullptr;
+      c->rx_msg_unowned = false;
     }
     close(c->fd);
     c->fd = -1;
@@ -1170,10 +1177,11 @@ struct Worker {
     c->alive = false;
     ep_del(c->fd);
     if (c->rx_msg) {
-      // Mirror conn_broken: a message mid-drain (e.g. a discarded probe,
-      // which sits in no matcher queue) must be purged or it leaks.
-      std::lock_guard<std::mutex> g(mu);
-      matcher.purge_inflight(c->rx_msg);
+      // cancel_all already ran (do_close order) and freed every record the
+      // matcher owns -- dereferencing those here would be use-after-free.
+      // The one record it cannot own is a probe mid-drain (never queued
+      // anywhere; flagged at header time): free it or it leaks.
+      if (c->rx_msg_unowned) delete c->rx_msg;
       c->rx_msg = nullptr;
     }
     if (abort) {

@@ -319,6 +319,13 @@ def main(argv: Sequence[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.tls:
         os.environ["STARWAY_TLS"] = args.tls
+    if getattr(args, "payload", None) == "device":
+        # devpull is only advertised in the handshake once the jax backend
+        # is up (the handshake never initialises one); device-payload runs
+        # should measure the pull path, so bring it up before connecting.
+        import jax
+
+        jax.devices()
 
     if args.role == "server":
         asyncio.run(run_server(args))

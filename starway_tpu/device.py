@@ -268,19 +268,35 @@ class DeviceRecvSink:
 
 
 def devpull_supported() -> bool:
-    """Capability probe (no server started): jax present + API available +
-    a backend the transfer server is known-good on.  Experimental backends
-    (e.g. this sandbox's tunneled 'axon' platform) wedge inside
-    start_transfer_server, and a hang is worse than the staging fallback."""
+    """Capability probe (no server started): jax live + API available + a
+    backend the transfer server is known-good on.
+
+    MUST NOT initialise a backend: this runs during the TCP handshake, and
+    backend bring-up can block for seconds (or forever, behind a dead
+    accelerator tunnel).  A process whose jax backend is not up yet simply
+    negotiates no devpull -- device payloads fall back to staging for that
+    connection, which is always correct."""
+    import sys
+
     from . import config
 
     if not config.devpull_enabled():
         return False
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
     try:
-        import jax
+        from jax._src import xla_bridge
         from jax._src.lib import xla_client as xc
 
         if not hasattr(xc._xla, "start_transfer_server"):
+            return False
+        # _default_backend is assigned only when backend bring-up has fully
+        # completed (checking the _backends dict instead would race: it is
+        # populated entry-by-entry while another thread still holds the
+        # init lock, and the default_backend() call below would then block
+        # on that lock -- the handshake hang this guard exists to prevent).
+        if getattr(xla_bridge, "_default_backend", None) is None:
             return False
         if jax.default_backend() not in ("cpu", "tpu", "gpu", "cuda", "rocm"):
             return False

@@ -42,6 +42,9 @@ def _force_tcp(monkeypatch):
     # devpull is negotiated by the Python engine (the C++ engine cannot run
     # JAX pulls; negotiation makes mixed pairings fall back safely).
     monkeypatch.setenv("STARWAY_NATIVE", "0")
+    # The capability is only advertised once the jax backend is up (the
+    # handshake never initialises a backend) -- make sure it is.
+    jax.devices()
 
 
 async def _pair(port):
@@ -177,6 +180,8 @@ def _child_send_device(port, flush_then_close):
     import jax.numpy as jnp
 
     from starway_tpu import Client
+
+    jax.devices()  # devpull is only advertised once the backend is up
 
     async def run():
         client = Client()
