@@ -77,6 +77,7 @@ constexpr uint8_t T_HELLO_ACK = 2;
 constexpr uint8_t T_DATA = 3;
 constexpr uint8_t T_FLUSH = 4;
 constexpr uint8_t T_FLUSH_ACK = 5;
+constexpr uint8_t T_DEVPULL = 6;  // negotiated PJRT-pull descriptor (frames.py)
 constexpr size_t HEADER_SIZE = 17;
 
 constexpr int ST_VOID = 0, ST_INIT = 1, ST_RUNNING = 2, ST_CLOSING = 3, ST_CLOSED = 4;
@@ -513,6 +514,13 @@ struct Conn {
   InboundMsg* rx_msg = nullptr;
   // rx_msg is a probe record the matcher does not own (see T_DATA dispatch).
   bool rx_msg_unowned = false;
+  // devpull extension (sw_engine.h): negotiated in the handshake; pending =
+  // surfaced descriptors not yet resolved by the embedder; deferred acks
+  // hold (flush seq, snapshot of pending at barrier arrival).
+  bool devpull_ok = false;
+  uint64_t ctl_a = 0;  // header `a` of the ctl frame being accumulated
+  std::unordered_set<uint64_t> devpull_pending;
+  std::vector<std::pair<uint64_t, std::unordered_set<uint64_t>>> devpull_deferred;
   std::vector<uint8_t> scratch;
   // flush accounting
   uint64_t flush_seq = 0, flush_acked = 0, data_counter = 0;
@@ -573,7 +581,7 @@ struct FlushRec {
 // ------------------------------------------------------------------ ops
 
 struct Op {
-  enum Kind { SEND, FLUSH } kind;
+  enum Kind { SEND, FLUSH, SEND_DEVPULL, DEVPULL_RESOLVED } kind;
   uint64_t conn_id = 0;       // SEND target; FLUSH: 0 = all conns
   bool conn_scoped = false;   // FLUSH limited to conn_id
   const uint8_t* buf = nullptr;
@@ -584,6 +592,8 @@ struct Op {
   void* ctx = nullptr;
   sw_done_cb release = nullptr;
   void* release_ctx = nullptr;
+  std::string body;     // SEND_DEVPULL descriptor JSON
+  uint64_t msg_id = 0;  // DEVPULL_RESOLVED
 };
 
 // --------------------------------------------------------------- worker
@@ -608,6 +618,11 @@ struct Worker {
   sw_accept_cb accept_cb = nullptr;
   void* accept_ctx = nullptr;
   std::unordered_set<Conn*> half_open;
+  // devpull extension (sw_engine.h)
+  bool devpull_advertise = false;
+  sw_devpull_cb devpull_cb = nullptr;
+  void* devpull_cb_ctx = nullptr;
+  uint64_t next_devpull_msg = 1;
   // client bits
   std::string c_host, c_mode;
   int c_port = 0;
@@ -693,6 +708,60 @@ struct Worker {
     item.switch_after = switch_after;
     c->tx.push_back(std::move(item));
     kick_tx(c, fires);
+  }
+
+  void conn_send_devpull(Conn* c, const Op& op, FireList& fires) {
+    if (!c->alive) {
+      auto fail = op.fail; auto ctx = op.ctx;
+      if (fail) fires.push_back([fail, ctx] { fail(ctx, "Endpoint is not connected (connection reset)"); });
+      return;
+    }
+    // Counts as tagged data: the sender's flush barrier must cover the
+    // pulled payload (the receiver defers the ACK until pulls resolve).
+    c->dirty = true;
+    c->data_counter++;
+    TxItem item;
+    item.header.resize(HEADER_SIZE + op.body.size());
+    pack_header(item.header.data(), T_DEVPULL, op.tag, op.body.size());
+    memcpy(item.header.data() + HEADER_SIZE, op.body.data(), op.body.size());
+    item.is_data = true;  // local completion at full write; flush-counted
+    item.done = op.done;
+    item.fail = op.fail;
+    item.ctx = op.ctx;
+    c->tx.push_back(std::move(item));
+    kick_tx(c, fires);
+  }
+
+  // A surfaced descriptor resolved (embedder's pull landed or failed):
+  // release flush barriers whose snapshot it was the last member of.
+  void devpull_resolve(Conn* c, uint64_t msg_id, FireList& fires) {
+    c->devpull_pending.erase(msg_id);
+    std::vector<uint64_t> ready;
+    auto& def = c->devpull_deferred;
+    for (auto it = def.begin(); it != def.end();) {
+      it->second.erase(msg_id);
+      if (it->second.empty()) {
+        ready.push_back(it->first);
+        it = def.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    for (uint64_t seq : ready)
+      if (c->alive) conn_send_ctl(c, T_FLUSH_ACK, seq, 0, "", fires);
+  }
+
+  void on_devpull(Conn* c, uint64_t tag, const std::string& body, FireList& fires) {
+    if (!devpull_cb || !c->devpull_ok) return;  // never negotiated: drop
+    uint64_t msg_id = next_devpull_msg++;
+    c->devpull_pending.insert(msg_id);
+    auto cb = devpull_cb; auto ctx = devpull_cb_ctx;
+    uint64_t cid = c->id;
+    // Copy the body into the fire (the ctl buffer is reused immediately).
+    auto shared = std::make_shared<std::string>(body);
+    fires.push_back([cb, ctx, cid, tag, shared, msg_id] {
+      cb(ctx, cid, tag, shared->c_str(), shared->size(), msg_id);
+    });
   }
 
   // Write to the active transport: >0 bytes taken, 0 = blocked, -1 = dead.
@@ -1007,11 +1076,14 @@ struct Worker {
         c->ctl_body.append((char*)tmp, (size_t)r);
         if (c->ctl_body.size() < c->ctl_need) continue;
         int t = c->ctl_type;
+        uint64_t ctl_a = c->ctl_a;
         std::string body = std::move(c->ctl_body);
         c->ctl_body.clear();
         c->ctl_need = 0;
         c->ctl_type = 0;
+        c->ctl_a = 0;
         if (t == T_HELLO) on_hello(c, body, fires);
+        else if (t == T_DEVPULL) on_devpull(c, ctl_a, body, fires);
         // T_HELLO_ACK handled synchronously during client connect
         continue;
       }
@@ -1038,15 +1110,24 @@ struct Worker {
           break;
         }
         case T_FLUSH:
-          conn_send_ctl(c, T_FLUSH_ACK, a, 0, "", fires);
+          if (!c->devpull_pending.empty()) {
+            // Descriptors preceding this barrier are unresolved: withhold
+            // the ACK until their pulls land (snapshot, so descriptors
+            // arriving after the barrier cannot extend the wait).
+            c->devpull_deferred.emplace_back(a, c->devpull_pending);
+          } else {
+            conn_send_ctl(c, T_FLUSH_ACK, a, 0, "", fires);
+          }
           break;
         case T_FLUSH_ACK:
           on_flush_ack(c, a, fires);
           break;
         case T_HELLO:
         case T_HELLO_ACK:
+        case T_DEVPULL:
           c->ctl_type = type;
           c->ctl_need = (size_t)b;
+          c->ctl_a = a;
           break;
         default:
           conn_broken(c, fires);
@@ -1222,8 +1303,11 @@ struct Worker {
       std::lock_guard<std::mutex> g(mu);
       conns[c->id] = c;
     }
+    if (devpull_advertise && json_field(body, "devpull") == "ok")
+      c->devpull_ok = true;
     std::string ack = std::string("{\"worker_id\": \"") + worker_id + "\"" +
-                      (seg ? ", \"sm\": \"ok\"" : "") + "}";
+                      (seg ? ", \"sm\": \"ok\"" : "") +
+                      (c->devpull_ok ? ", \"devpull\": \"ok\"" : "") + "}";
     // The ACK is the transport switch point (see TxItem::switch_after).
     conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires,
                   /*switch_after=*/seg != nullptr);
@@ -1243,17 +1327,22 @@ struct Worker {
         op = ops.front();
         ops.pop_front();
       }
-      if (op.kind == Op::SEND) {
+      if (op.kind == Op::SEND || op.kind == Op::SEND_DEVPULL ||
+          op.kind == Op::DEVPULL_RESOLVED) {
         Conn* c = nullptr;
         {
           std::lock_guard<std::mutex> g(mu);
           auto it = conns.find(op.conn_id);
           if (it != conns.end()) c = it->second;
         }
-        if (!c || !c->alive) {
+        if (op.kind == Op::DEVPULL_RESOLVED) {
+          if (c) devpull_resolve(c, op.msg_id, fires);
+        } else if (!c || !c->alive) {
           auto fail = op.fail; auto ctx = op.ctx;
           if (fail) fires.push_back([fail, ctx] { fail(ctx, kNotConnected); });
           fire_op_release(op, fires);
+        } else if (op.kind == Op::SEND_DEVPULL) {
+          conn_send_devpull(c, op, fires);
         } else {
           conn_send_data(c, op, fires);
         }
@@ -1451,6 +1540,7 @@ struct ClientWorker : Worker {
       hello += std::string(", \"sm_key\": \"") + sm_offer->key + "\", \"sm_nonce\": \"" +
                nonce_hex + "\", \"sm_ring\": \"" + std::to_string(sm_offer->ring_size) + "\"";
     }
+    if (devpull_advertise) hello += ", \"devpull\": \"ok\"";
     hello += "}";
     std::vector<uint8_t> frame(HEADER_SIZE + hello.size());
     pack_header(frame.data(), T_HELLO, 0, hello.size());
@@ -1500,6 +1590,7 @@ struct ClientWorker : Worker {
     c->mode = c_mode;
     std::string ack_body((char*)body.data(), body.size());
     c->peer_name = json_field(ack_body, "worker_id");
+    c->devpull_ok = devpull_advertise && json_field(ack_body, "devpull") == "ok";
     if (sm_offer) {
       if (json_field(ack_body, "sm") == "ok") {
         c->adopt_sm(sm_offer, /*creator=*/true, /*defer_tx=*/false);
@@ -1661,6 +1752,70 @@ int sw_send(void* h, uint64_t conn_id, const void* buf, uint64_t len, uint64_t t
   return 0;
 }
 
+void sw_set_devpull(void* h, int advertise, sw_devpull_cb cb, void* ctx) {
+  Worker* w = W(h);
+  std::lock_guard<std::mutex> g(w->mu);
+  w->devpull_advertise = advertise != 0;
+  w->devpull_cb = cb;
+  w->devpull_cb_ctx = ctx;
+}
+
+int sw_devpull_match(void* h, uint64_t tag, uint64_t nbytes, uint64_t* out_ctx) {
+  // Atomically claims a posted receive the way Matcher::on_start would;
+  // the embedder completes it after pulling.  Thread-safe (any thread).
+  // Truncation (-1) also removes the receive and hands back its ctx: the
+  // EMBEDDER fires the failure, outside whatever locks it holds -- this
+  // function never invokes user callbacks.
+  Worker* w = W(h);
+  std::lock_guard<std::mutex> g(w->mu);
+  auto& posted = w->matcher.posted;
+  for (auto it = posted.begin(); it != posted.end(); ++it) {
+    if (it->claimed || !tags_match(tag, it->tag, it->mask)) continue;
+    *out_ctx = (uint64_t)(uintptr_t)it->ctx;
+    int rc = nbytes > it->cap ? -1 : 1;
+    posted.erase(it);
+    return rc;
+  }
+  return 0;
+}
+
+void sw_devpull_resolved(void* h, uint64_t conn_id, uint64_t msg_id) {
+  // Callable from any thread (the embedder's pull-completion thread):
+  // conn state is engine territory, so hop via the op queue.
+  Worker* w = W(h);
+  {
+    std::lock_guard<std::mutex> g(w->mu);
+    if (w->status.load() != ST_RUNNING) return;
+    Op op;
+    op.kind = Op::DEVPULL_RESOLVED;
+    op.conn_id = conn_id;
+    op.msg_id = msg_id;
+    w->ops.push_back(op);
+  }
+  w->wake();
+}
+
+int sw_send_devpull(void* h, uint64_t conn_id, uint64_t tag,
+                    const char* body, uint64_t len,
+                    sw_done_cb done, sw_fail_cb fail, void* ctx) {
+  Worker* w = W(h);
+  {
+    std::lock_guard<std::mutex> g(w->mu);
+    if (w->status.load() != ST_RUNNING) return -1;
+    Op op;
+    op.kind = Op::SEND_DEVPULL;
+    op.conn_id = conn_id ? conn_id : w->primary_conn;
+    op.tag = tag;
+    op.body.assign(body, (size_t)len);
+    op.done = done;
+    op.fail = fail;
+    op.ctx = ctx;
+    w->ops.push_back(op);
+  }
+  w->wake();
+  return 0;
+}
+
 int sw_recv(void* h, void* buf, uint64_t cap, uint64_t tag, uint64_t mask,
             sw_recv_cb done, sw_fail_cb fail, void* ctx) {
   Worker* w = W(h);
@@ -1743,11 +1898,11 @@ int sw_conn_info(void* h, uint64_t conn_id, char* out, int cap) {
                    "{\"name\": \"%s\", \"mode\": \"%s\", \"alive\": %d, "
                    "\"local_addr\": \"%s\", \"local_port\": %d, "
                    "\"remote_addr\": \"%s\", \"remote_port\": %d, "
-                   "\"transport\": \"%s\"}",
+                   "\"transport\": \"%s\", \"devpull\": %d}",
                    c->peer_name.c_str(), c->mode.c_str(), c->alive ? 1 : 0,
                    c->local_addr.c_str(), c->local_port,
                    c->remote_addr.c_str(), c->remote_port,
-                   c->sm_negotiated ? "sm" : "tcp");
+                   c->sm_negotiated ? "sm" : "tcp", c->devpull_ok ? 1 : 0);
   if (n < 0 || n >= cap) return -1;
   memcpy(out, buf, (size_t)n + 1);
   return n;

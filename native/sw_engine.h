@@ -140,6 +140,44 @@ int sw_list_conns(void* h, uint64_t* out, int cap);
  * Returns the body length, or -1 if unknown/too small. */
 int sw_conn_info(void* h, uint64_t conn_id, char* out, int cap);
 
+/* ------------------------------------------------------------- devpull
+ *
+ * PJRT transfer-server pull extension (wire: T_DEVPULL, see
+ * core/frames.py).  The engine owns the wire + matching; the embedder
+ * (core/native.py) owns the pulls, since they need a live JAX runtime.
+ *
+ * Setup: call sw_set_devpull BEFORE listen/connect.  When `advertise` is
+ * non-zero the handshake offers/accepts "devpull"; `cb` fires on the
+ * engine thread for every descriptor received, with the raw JSON body and
+ * an engine-assigned msg_id.  The embedder then:
+ *   1. calls sw_devpull_match to atomically claim a posted receive
+ *      (returns 1 and the recv's ctx — removed from the matcher, the
+ *      embedder completes it after pulling; 0 = no match, embedder queues
+ *      the descriptor; -1 = matched-but-truncated, engine already failed
+ *      the receive);
+ *   2. pulls the payload (eagerly, whatever the match outcome — the
+ *      sender's buffer must be released and flush must be able to
+ *      complete);
+ *   3. calls sw_devpull_resolved(conn_id, msg_id) when the pull lands or
+ *      fails.  FLUSH_ACKs for barriers that arrived after the descriptor
+ *      are withheld until every such descriptor resolves (the sender's
+ *      flush means "payload resident at the receiver"). */
+typedef void (*sw_devpull_cb)(void* ctx, uint64_t conn_id, uint64_t tag,
+                              const char* body, uint64_t len,
+                              uint64_t msg_id);
+void sw_set_devpull(void* h, int advertise, sw_devpull_cb cb, void* ctx);
+
+int sw_devpull_match(void* h, uint64_t tag, uint64_t nbytes, uint64_t* out_ctx);
+
+void sw_devpull_resolved(void* h, uint64_t conn_id, uint64_t msg_id);
+
+/* Queue a DEVPULL descriptor send (counts as tagged data for flush/dirty
+ * accounting; `done` fires at local completion = descriptor handed to the
+ * transport).  Returns 0, or nonzero when the worker is not running. */
+int sw_send_devpull(void* h, uint64_t conn_id, uint64_t tag,
+                    const char* body, uint64_t len,
+                    sw_done_cb done, sw_fail_cb fail, void* ctx);
+
 /* Destructor path: never blocks, never fails.  Signals close if RUNNING
  * and drops the caller's reference; the engine thread frees the worker
  * when it finishes (reference analogue: destructor-without-close must not

@@ -31,11 +31,13 @@ payload's bytes, the sender registers the array with its PJRT transfer
 server (``jax.experimental.transfer``) and sends this small descriptor
 ``{"u": uuid, "a": server_address, "n": nbytes, "s": shape, "d": dtype}``;
 the receiver pulls the buffer device-to-device over the PJRT socket --
-no host staging in the framework.  An engine that cannot pull (the C++
-engine, or a jax-less process) simply never negotiates the capability and
-peers fall back to staged DATA frames, so all engine pairings interoperate
-(see device.py TransferManager; the flush barrier covers pulls because the
-receiver defers FLUSH_ACK until descriptors received before the FLUSH have
+no host staging in the framework.  Both engines speak it: the Python
+engine natively, the C++ engine by surfacing descriptors to its wrapper
+(sw_engine.h "devpull").  A process that cannot pull (no jax, or backend
+not up at handshake time) never negotiates the capability and peers fall
+back to staged DATA frames, so all pairings interoperate (see device.py
+TransferManager; the flush barrier covers pulls because the receiver
+defers FLUSH_ACK until descriptors received before the FLUSH have
 resolved).
 
 HELLO is sent by the connector and carries ``{"worker_id", "mode", "name"}``

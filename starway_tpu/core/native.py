@@ -13,9 +13,14 @@ introspection, so the api layer swaps engines transparently.  The native
 engine covers the host paths -- TCP and the negotiated same-host
 shared-memory rings (``sm``, core/shmring.py) -- speaking the same wire
 protocol as the Python engine, so mixed-engine processes interoperate over
-either.  The in-process fast path and device plane stay in Python, which
-is why native selection requires inproc-free mode (``STARWAY_TLS=tcp`` or
-``tcp,sm``, plus ``STARWAY_NATIVE=1``).
+either.  The in-process fast path stays in Python, which is why native
+selection requires inproc-free mode (``STARWAY_TLS=tcp`` or ``tcp,sm``,
+plus ``STARWAY_NATIVE=1``).  Cross-process device payloads ride the
+negotiated PJRT pull extension: the engine surfaces T_DEVPULL descriptors
+through ``sw_set_devpull`` and this wrapper runs the pulls (the engine
+cannot -- they need a live JAX runtime), claiming posted receives via
+``sw_devpull_match`` and releasing deferred flush barriers via
+``sw_devpull_resolved`` (see sw_engine.h "devpull" and DESIGN.md §7).
 
 Lifetime/GIL notes: callbacks cross from the engine thread through ctypes
 trampolines, which acquire the GIL.  Each pending op holds its Python buffer
@@ -46,6 +51,9 @@ _FAIL_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_char_p)
 _RECV_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64)
 _ACCEPT_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64)
 _STATUS_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_char_p)
+_DEVPULL_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64,
+                               ctypes.c_uint64, ctypes.POINTER(ctypes.c_char),
+                               ctypes.c_uint64, ctypes.c_uint64)
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -93,6 +101,20 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int
         ]
         lib.sw_free.argtypes = [ctypes.c_void_p]
+        lib.sw_set_devpull.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, _DEVPULL_CB, ctypes.c_void_p
+        ]
+        lib.sw_devpull_match.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.sw_devpull_resolved.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64
+        ]
+        lib.sw_send_devpull.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64, _DONE_CB, _FAIL_CB, ctypes.c_void_p,
+        ]
         _lib = lib
     except Exception as e:  # toolchain/build failure => Python engine
         _lib_err = str(e)
@@ -187,6 +209,21 @@ def _on_accept(ctx, conn_id):
             logger.exception("starway native accept callback raised")
 
 
+@_DEVPULL_CB
+def _on_devpull(ctx, conn_id, tag, body, length, msg_id):
+    rec = _peek(ctx)  # persistent registration: not popped
+    if rec and rec[0] is not None:
+        try:
+            rec[0](int(conn_id), int(tag),
+                   ctypes.string_at(body, int(length)), int(msg_id))
+        except Exception:
+            logger.exception("starway native devpull callback raised")
+
+
+def _is_device_sink(obj) -> bool:
+    return obj is not None and hasattr(obj, "devbuf") and hasattr(obj, "accept_device")
+
+
 # ------------------------------------------------------------- endpoints
 
 
@@ -200,6 +237,7 @@ class NativeConn:
         self.worker = worker
         self.conn_id = conn_id
         self._transports: Optional[list[tuple[str, str]]] = None
+        self._devpull: Optional[bool] = None
 
     def _info(self) -> dict:
         lib = load()
@@ -248,8 +286,39 @@ class NativeConn:
                 self._transports = [(dev, "tcp+native")]
         return self._transports
 
+    @property
+    def devpull_ok(self) -> bool:
+        # Handshake-fixed, like the transport: memoize the FFI round-trip.
+        if self._devpull is None:
+            self._devpull = bool(self._info().get("devpull", 0))
+        return self._devpull
+
 
 # --------------------------------------------------------------- workers
+
+
+class _PendingPull:
+    """Receiver-side record for one surfaced DEVPULL descriptor (native
+    engine analogue of the Python engine's matcher-held remote msgs)."""
+
+    __slots__ = ("desc", "conn_id", "msg_id", "tag", "nbytes", "claimed",
+                 "array", "failed", "discard", "resolved")
+
+    def __init__(self, desc: dict, conn_id: int, msg_id: int, tag: int):
+        self.desc = desc
+        self.conn_id = conn_id
+        self.msg_id = msg_id
+        self.tag = tag
+        self.nbytes = int(desc["n"])
+        self.claimed = None  # (user_done, fail, mv_or_None, sink_or_None)
+        self.array = None    # pulled payload (complete, unclaimed)
+        self.failed = False
+        self.discard = False
+        # The claimed receive's terminal outcome fired (done at pull
+        # completion, or cancel at close) -- whoever sets it first wins,
+        # under _devpull_lock, so a pull landing during close cannot
+        # double-resolve the future.
+        self.resolved = False
 
 
 class NativeWorkerBase:
@@ -264,6 +333,13 @@ class NativeWorkerBase:
         self._h = None
         self._address_blob: Optional[bytes] = None
         self._conn_cache: dict[int, NativeConn] = {}
+        # devpull extension state (sw_engine.h "devpull"): the engine owns
+        # the wire + matching; this wrapper owns the pulls.
+        self._devpull_key: Optional[int] = None
+        self._xfer_mgr = None
+        self._devpull_pending: list[_PendingPull] = []
+        self._devpull_claimed: list[_PendingPull] = []
+        self._devpull_lock = threading.Lock()
 
     @property
     def status(self) -> int:
@@ -297,6 +373,282 @@ class NativeWorkerBase:
         keep = (ctypes.c_char * len(mv)).from_buffer_copy(mv)
         return ctypes.addressof(keep), keep
 
+    # ---------------------------------------------------------- devpull
+    def _install_devpull(self) -> None:
+        """Register the descriptor callback + advertise capability; called
+        before listen/connect (the handshake carries the negotiation).
+        Advertised only when the jax backend is already up -- same
+        semantics as the Python engine's handshake probe."""
+        from .. import device as _device
+
+        if not _device.devpull_supported():
+            return
+        wself = weakref.ref(self)
+
+        def dispatch(conn_id, tag, body, msg_id):
+            s = wself()
+            if s is not None:
+                s._on_devpull_native(conn_id, tag, body, msg_id)
+
+        self._devpull_key = _register(dispatch, None)
+        self._lib.sw_set_devpull(self._h, 1, _on_devpull, self._devpull_key)
+
+    def transfer_manager(self):
+        from .. import device as _device
+
+        with self._devpull_lock:
+            if self._xfer_mgr is None:
+                if not _device.devpull_supported():
+                    return None
+                self._xfer_mgr = _device.TransferManager(config.advertised_host())
+            return self._xfer_mgr
+
+    def _match_native(self, tag: int, nbytes: int):
+        """One sw_devpull_match attempt.  Returns (rc, rec): rc 1 = claimed
+        (rec is the removed receive's registry record), -1 = matched but
+        truncated (rec removed; CALLER fires the truncation failure,
+        outside any locks), 0 = no match."""
+        out = ctypes.c_uint64()
+        rc = self._lib.sw_devpull_match(self._h, tag, nbytes, ctypes.byref(out))
+        if rc == 0:
+            return 0, None
+        return rc, _take(int(out.value))
+
+    @staticmethod
+    def _claim_from_rec(entry: _PendingPull, rec) -> None:
+        # rec = (done_wrapped, fail, mv, owner, keep, user_done, repost)
+        user_done = rec[5] if len(rec) > 5 else rec[0]
+        owner = rec[3]
+        sink = owner if _is_device_sink(owner) else None
+        entry.claimed = (user_done, rec[1], None if sink else rec[2], sink)
+
+    def _on_devpull_native(self, conn_id: int, tag: int, body: bytes,
+                           msg_id: int) -> None:
+        """Engine-thread callback: a descriptor arrived.  Claim a posted
+        receive if one matches, then pull EAGERLY whatever the outcome --
+        the sender's buffer must be released and a flush barrier behind the
+        descriptor must be able to complete (the engine withholds the
+        FLUSH_ACK until sw_devpull_resolved).
+
+        Two-phase match closes the race against a concurrently posted
+        receive: match, publish to the pending list, match AGAIN (a receive
+        that slipped in between is caught by phase 2; one posted after
+        phase 2 finds the entry via post_recv's own retry).  If phase 2
+        steals a receive but the front door claimed the entry meanwhile,
+        the stolen receive is re-posted."""
+        fail_trunc = None
+        try:
+            desc = json.loads(body.decode())
+            entry = _PendingPull(desc, conn_id, msg_id, tag)
+            rc, rec = self._match_native(tag, entry.nbytes)
+            if rc == 1 and rec is not None:
+                with self._devpull_lock:
+                    self._claim_from_rec(entry, rec)
+                    self._devpull_claimed.append(entry)
+            elif rc == -1:
+                entry.discard = True
+                fail_trunc = rec[1] if rec is not None else None
+            else:
+                repost = None
+                with self._devpull_lock:
+                    self._devpull_pending.append(entry)
+                rc2, rec2 = self._match_native(tag, entry.nbytes)
+                if rc2 != 0 and rec2 is not None:
+                    with self._devpull_lock:
+                        if entry in self._devpull_pending:
+                            self._devpull_pending.remove(entry)
+                            if rc2 == 1:
+                                self._claim_from_rec(entry, rec2)
+                                self._devpull_claimed.append(entry)
+                            else:
+                                entry.discard = True
+                                fail_trunc = rec2[1]
+                        else:
+                            repost = rec2  # front door won; give it back
+                if repost is not None:
+                    self._repost_recv(repost)
+        except Exception:
+            logger.exception("starway devpull descriptor handling failed")
+            self._lib.sw_devpull_resolved(self._h, conn_id, msg_id)
+            return
+        if fail_trunc is not None:
+            from ..errors import REASON_TRUNCATED
+
+            try:
+                fail_trunc(REASON_TRUNCATED)
+            except Exception:
+                logger.exception("starway devpull truncation callback raised")
+        self._start_pull(entry)
+
+    def _repost_recv(self, rec) -> None:
+        """Return a receive stolen by a second-chance match that lost the
+        entry to the front door (rare race): re-post it via the normal
+        path.  It rejoins the matcher at the back -- an acceptable FIFO
+        perturbation for a window this narrow."""
+        try:
+            tag, mask, buf = rec[6]
+            self.post_recv(buf, tag, mask, rec[5], rec[1], owner=rec[3])
+        except Exception:
+            logger.exception("starway devpull recv re-post failed")
+
+    def _retry_pending_matches(self) -> None:
+        """post_recv epilogue: a descriptor may have been surfaced between
+        the front-door check and sw_recv.  Claim any unclaimed pending
+        entry a native-posted receive now matches."""
+        from ..errors import REASON_TRUNCATED
+
+        while True:
+            target = None
+            with self._devpull_lock:
+                for e in self._devpull_pending:
+                    if e.claimed is None and not e.discard and not e.failed:
+                        target = e
+                        break
+            if target is None:
+                return
+            rc, rec = self._match_native(target.tag, target.nbytes)
+            if rc == 0:
+                return
+            complete_now = None
+            fail_trunc = None
+            with self._devpull_lock:
+                if target not in self._devpull_pending:
+                    # Lost a race; the stolen receive must be returned.
+                    if rec is not None and rc == 1:
+                        self._repost_recv(rec)
+                    continue
+                self._devpull_pending.remove(target)
+                if rc == -1:
+                    target.discard = True
+                    fail_trunc = rec[1] if rec is not None else None
+                else:
+                    self._claim_from_rec(target, rec)
+                    self._devpull_claimed.append(target)
+                    complete_now = target.array
+            if fail_trunc is not None:
+                try:
+                    fail_trunc(REASON_TRUNCATED)
+                except Exception:
+                    logger.exception("starway devpull truncation callback raised")
+            if complete_now is not None:
+                self._finish_entry(target, complete_now)
+
+    def _start_pull(self, entry: _PendingPull) -> None:
+        mgr = self.transfer_manager()
+        if mgr is None:
+            self._pull_failed(entry, "transfer server unavailable")
+            return
+        device = None
+        if entry.claimed is not None and entry.claimed[3] is not None:
+            device = entry.claimed[3].devbuf.device
+        mgr.pull(entry.desc, device,
+                 lambda arr, e=entry: self._pull_done(e, arr),
+                 lambda err, e=entry: self._pull_failed(e, err))
+
+    def _pull_done(self, entry: _PendingPull, arr) -> None:
+        try:
+            with self._devpull_lock:
+                entry.array = arr
+                deliver = entry.claimed is not None and not entry.resolved \
+                    and not entry.discard
+                if deliver:
+                    entry.resolved = True
+            if deliver:
+                self._finish_entry(entry, arr)
+            # Unclaimed entries keep the array; a later post_recv delivers.
+        finally:
+            self._lib.sw_devpull_resolved(self._h, entry.conn_id, entry.msg_id)
+
+    def _finish_entry(self, entry: _PendingPull, arr) -> None:
+        """Deliver a pulled payload into its claimed receive.  Never called
+        under _devpull_lock (user callbacks re-enter the API)."""
+        import numpy as np
+
+        try:
+            user_done, _fail, mv, sink = entry.claimed
+            if sink is not None:
+                sink.accept_device(arr)
+            elif mv is not None:
+                host = np.asarray(arr).view(np.uint8).reshape(-1)
+                mv[: entry.nbytes] = memoryview(host)[: entry.nbytes]
+            with self._devpull_lock:
+                if entry in self._devpull_claimed:
+                    self._devpull_claimed.remove(entry)
+            if user_done is not None:
+                user_done(entry.tag, entry.nbytes)
+        except Exception:
+            logger.exception("starway devpull completion failed")
+
+    def _pull_failed(self, entry: _PendingPull, err: str) -> None:
+        logger.warning("starway devpull pull failed: %s", err)
+        entry.failed = True
+        with self._devpull_lock:
+            if entry in self._devpull_pending:
+                self._devpull_pending.remove(entry)
+        # A claimed receive stays pending (peer-death semantics) until the
+        # close sweep cancels it (_drop_devpull).
+        self._lib.sw_devpull_resolved(self._h, entry.conn_id, entry.msg_id)
+
+    def submit_devpull(self, conn, desc: dict, tag: int, done, fail,
+                       owner=None) -> None:
+        self._require_running()
+        conn_id = conn.conn_id if isinstance(conn, NativeConn) else 0
+        body = json.dumps(desc, separators=(",", ":")).encode()
+        key = _register(done, fail, owner)
+        rc = self._lib.sw_send_devpull(self._h, conn_id, tag, body, len(body),
+                                       _on_done, _on_fail, key)
+        if rc != 0:
+            _take(key)
+            raise StarwayStateError("starway native send rejected (not running)")
+
+    def _match_pending_pull(self, buf, tag: int, mask: int, done, fail,
+                            owner) -> bool:
+        """post_recv front-door: claim a surfaced-but-unmatched descriptor
+        (FIFO) before the receive reaches the native matcher.  Returns True
+        when the receive was consumed here.
+
+        Ordering caveat (native engine only): a pending pull descriptor is
+        matched ahead of any older staged DATA message with the same tag
+        still in the C++ matcher's unexpected queue -- mixed-transport
+        sends on one tag can complete out of arrival order.  The Python
+        engine keeps one arrival-ordered queue and does not have this."""
+        from .matching import tags_match
+
+        cap = len(buf) if isinstance(buf, memoryview) else int(buf.nbytes)
+        arr = None
+        truncated = False
+        with self._devpull_lock:
+            entry = None
+            for e in self._devpull_pending:
+                if e.claimed is None and not e.discard and not e.failed \
+                        and tags_match(e.tag, tag, mask):
+                    entry = e
+                    break
+            if entry is None:
+                return False
+            self._devpull_pending.remove(entry)
+            if entry.nbytes > cap:
+                entry.discard = True  # drain pull already running/ran
+                truncated = True
+            else:
+                sink = buf if not isinstance(buf, memoryview) else None
+                entry.claimed = (done, fail,
+                                 buf if sink is None else None, sink)
+                arr = entry.array
+                if arr is not None:
+                    entry.resolved = True
+                else:
+                    self._devpull_claimed.append(entry)
+        if truncated:
+            from ..errors import REASON_TRUNCATED
+
+            if fail is not None:
+                fail(REASON_TRUNCATED)
+            return True
+        if arr is not None:
+            self._finish_entry(entry, arr)
+        return True
+
     def submit_send(self, conn, view, tag: int, done, fail, owner=None) -> None:
         self._require_running()
         conn_id = conn.conn_id if isinstance(conn, NativeConn) else 0
@@ -316,6 +668,13 @@ class NativeWorkerBase:
 
     def post_recv(self, buf, tag: int, mask: int, done, fail, owner=None) -> None:
         self._require_running()
+        # Surfaced-but-unmatched pull descriptors match first (before the
+        # native matcher sees the receive, and before any staging buffer is
+        # allocated -- a pulled payload never touches host staging).
+        if self._devpull_pending and self._match_pending_pull(
+                buf, tag, mask, done, fail, owner):
+            return
+        user_done = done
         if isinstance(buf, memoryview):
             mv = buf
         else:
@@ -329,11 +688,19 @@ class NativeWorkerBase:
         if mv.readonly:
             raise TypeError("receive buffer must be writable")
         addr, keep = self._mv_pointer(mv)
-        key = _register(done, fail, mv, owner, keep)
+        # Slot 5 (user_done) lets a devpull steal complete the receive via
+        # the device path instead of the staging-wrapped `done`; slot 6
+        # lets a steal that lost its entry to the front door re-post.
+        key = _register(done, fail, mv, owner, keep, user_done,
+                        (tag, mask, buf))
         rc = self._lib.sw_recv(self._h, addr, len(mv), tag, mask, _on_recv, _on_fail, key)
         if rc != 0:
             _take(key)
             raise StarwayStateError("starway native recv rejected (not running)")
+        # A descriptor surfaced between the front-door check and sw_recv
+        # would match neither side: reconcile.
+        if self._devpull_pending:
+            self._retry_pending_matches()
 
     def submit_flush(self, done, fail, conns=None) -> None:
         self._require_running()
@@ -349,13 +716,48 @@ class NativeWorkerBase:
 
     def close(self, cb) -> None:
         self._require_running()
-        key = _register(cb, None)
+
+        def cb_devpull_cleanup(_cb=cb):
+            self._drop_devpull()
+            if _cb is not None:
+                _cb()
+
+        key = _register(cb_devpull_cleanup, None)
         rc = self._lib.sw_close(self._h, _on_done, key)
         if rc != 0:
             _take(key)
             raise StarwayStateError(
                 f"starway {self.kind} is not in a running state (native close rejected)"
             )
+
+    def _drop_devpull(self) -> None:
+        if self._devpull_key is not None:
+            _take(self._devpull_key)
+            self._devpull_key = None
+        with self._devpull_lock:
+            mgr, self._xfer_mgr = self._xfer_mgr, None
+            self._devpull_pending.clear()
+            cancelled = [e for e in self._devpull_claimed if not e.resolved]
+            for e in cancelled:
+                e.resolved = True
+            self._devpull_claimed.clear()
+        # Claimed receives whose pull never landed get the standard close
+        # cancel (they were removed from the C++ matcher, so its own
+        # cancel sweep cannot reach them).
+        if cancelled:
+            from ..errors import REASON_CANCELLED
+
+            for e in cancelled:
+                fail = e.claimed[1]
+                if fail is not None:
+                    try:
+                        fail(REASON_CANCELLED)
+                    except Exception:
+                        logger.exception("starway devpull cancel callback raised")
+        if mgr is not None:
+            # Dropping the transfer server cancels unpulled offers (the
+            # close-cancels-in-flight contract for device sends).
+            mgr.close()
 
     def force_close(self) -> None:
         pass  # sw_free in __del__ handles signalling
@@ -378,6 +780,10 @@ class NativeWorkerBase:
         return perf.estimate(transport, msg_size)
 
     def __del__(self):
+        try:
+            self._drop_devpull()
+        except Exception:
+            pass
         try:
             if self._h is not None:
                 self._lib.sw_free(self._h)
@@ -405,6 +811,7 @@ class NativeClientWorker(NativeWorkerBase):
                 "starway client supports a single connect "
                 f"(status={state.NAMES.get(self.status, self.status)})"
             )
+        self._install_devpull()
         key = _register(cb, None)
         rc = self._lib.sw_client_connect(
             self._h, host.encode(), port, mode.encode(), _on_status, key
@@ -485,6 +892,7 @@ class NativeServerWorker(NativeWorkerBase):
         if self.status != state.VOID:
             raise StarwayStateError("starway server already listening or closed")
         self._install_accept()
+        self._install_devpull()
         rc = int(self._lib.sw_server_listen(self._h, addr.encode(), port))
         if rc <= 0:
             raise OSError(-rc, f"native listen failed on {addr}:{port}")
@@ -498,6 +906,7 @@ class NativeServerWorker(NativeWorkerBase):
         if self.status != state.VOID:
             raise StarwayStateError("starway server already listening or closed")
         self._install_accept()
+        self._install_devpull()
         rc = int(self._lib.sw_server_listen(self._h, b"0.0.0.0", 0))
         if rc <= 0:
             raise OSError(-rc, "native listen_address failed")

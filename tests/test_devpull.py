@@ -140,6 +140,54 @@ async def test_devpull_host_buffer_recv(port):
         await server.aclose()
 
 
+@pytest.mark.parametrize(
+    "server_native,client_native",
+    [(True, True), (True, False), (False, True)],
+    ids=["native/native", "native-server/py-client", "py-server/native-client"],
+)
+async def test_devpull_engine_matrix(port, monkeypatch, server_native, client_native):
+    """devpull is one wire contract across BOTH engines: every pairing
+    negotiates it and the payload arrives via the pull path (the native
+    engine surfaces descriptors to its wrapper, which owns the pulls)."""
+    from starway_tpu.core import native
+
+    if not native.available():
+        pytest.skip("native engine unavailable")
+
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if server_native else "0")
+    server = Server()
+    server.listen("127.0.0.1", port)
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if client_native else "0")
+    client = Client()
+    await client.aconnect("127.0.0.1", port)
+    try:
+        src = jax.device_put(jnp.arange(N, dtype=jnp.uint8))
+        sink = DeviceBuffer((N,), jnp.uint8)
+        recv_fut = server.arecv(sink, 0x66, MASK)
+        await asyncio.sleep(0.05)
+        await client.asend(src, 0x66)
+        tag, length = await asyncio.wait_for(recv_fut, 15)
+        assert (tag, length) == (0x66, N)
+        assert sink.last_transport == "device", (
+            f"expected PJRT pull, got {sink.last_transport}")
+        np.testing.assert_array_equal(
+            np.asarray(sink.array), np.arange(N, dtype=np.uint8))
+
+        # Unexpected-then-post through the pending-pull front door, then a
+        # flush barrier that must wait for the eager pull.
+        src2 = jax.device_put(jnp.full(N, 9, dtype=jnp.uint8))
+        await client.asend(src2, 0x67)
+        await client.aflush()
+        sink2 = DeviceBuffer((N,), jnp.uint8)
+        tag, length = await asyncio.wait_for(server.arecv(sink2, 0x67, MASK), 15)
+        assert (tag, length) == (0x67, N)
+        np.testing.assert_array_equal(
+            np.asarray(sink2.array), np.full(N, 9, dtype=np.uint8))
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
 async def test_devpull_flush_not_blocked_by_later_send(port):
     """The FLUSH barrier waits only for descriptors that preceded it: a
     devpull sent after the flush (for a tag nobody receives) must not hold
