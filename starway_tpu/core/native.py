@@ -511,20 +511,30 @@ class NativeWorkerBase:
                 return
             complete_now = None
             fail_trunc = None
+            repost = None
             with self._devpull_lock:
                 if target not in self._devpull_pending:
-                    # Lost a race; the stolen receive must be returned.
+                    # Lost a race; the stolen receive must be returned --
+                    # outside the lock (post_recv re-enters it).
                     if rec is not None and rc == 1:
-                        self._repost_recv(rec)
-                    continue
-                self._devpull_pending.remove(target)
-                if rc == -1:
-                    target.discard = True
-                    fail_trunc = rec[1] if rec is not None else None
+                        repost = rec
                 else:
-                    self._claim_from_rec(target, rec)
-                    self._devpull_claimed.append(target)
-                    complete_now = target.array
+                    self._devpull_pending.remove(target)
+                    if rc == -1:
+                        target.discard = True
+                        fail_trunc = rec[1] if rec is not None else None
+                    else:
+                        self._claim_from_rec(target, rec)
+                        complete_now = target.array
+                        if complete_now is not None:
+                            # Terminal outcome decided here: the close sweep
+                            # must not also cancel it.
+                            target.resolved = True
+                        else:
+                            self._devpull_claimed.append(target)
+            if repost is not None:
+                self._repost_recv(repost)
+                continue
             if fail_trunc is not None:
                 try:
                     fail_trunc(REASON_TRUNCATED)

@@ -73,3 +73,41 @@ def test_op_timer_summary():
             pass
     s = t.summary()["op"]
     assert s["count"] == 10 and s["p50_us"] >= 0
+
+
+def test_probe_tag_dropped_on_wire_both_engines(monkeypatch):
+    """The reserved probe tag is consumed by BOTH engines' matchers over a
+    real socket: autocalibrate against each engine, then a wildcard recv
+    sees only real traffic."""
+    import asyncio
+
+    import numpy as np
+
+    from starway_tpu import Client, Server
+    from starway_tpu.core import native
+
+    engines = ["0"] + (["1"] if native.available() else [])
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+
+    async def drive():
+        for native_flag in engines:
+            monkeypatch.setenv("STARWAY_NATIVE", native_flag)
+            s = Server()
+            s.listen("127.0.0.1", 0)
+            import json
+
+            port = json.loads(s.get_worker_address())["port"]
+            c = Client()
+            await c.aconnect("127.0.0.1", port)
+            await perf.autocalibrate(c, "tcp", sizes=(1 << 10, 1 << 14))
+            buf = np.zeros(8, dtype=np.uint8)
+            fut = s.arecv(buf, 0, 0)  # wildcard
+            await asyncio.sleep(0.05)
+            await c.asend(np.arange(8, dtype=np.uint8), 99)
+            tag, n = await asyncio.wait_for(fut, 10)
+            assert (tag, n) == (99, 8), f"engine={native_flag}: probe leaked"
+            np.testing.assert_array_equal(buf, np.arange(8, dtype=np.uint8))
+            await c.aclose()
+            await s.aclose()
+
+    asyncio.run(drive())
