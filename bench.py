@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import statistics
 import sys
 import time
@@ -165,8 +166,43 @@ def main_kernels(argv: list) -> None:
     )
 
 
+def main_watchdog() -> None:
+    """Run the measurement in a deadline-bounded child so a wedged device
+    backend (observed: the tunneled TPU can hang every op, including jax
+    init) still yields one parseable JSON line instead of hanging the
+    caller."""
+    import subprocess
+
+    env = dict(os.environ, STARWAY_BENCH_CHILD="1")
+    try:
+        out = subprocess.run([sys.executable, __file__], env=env,
+                             capture_output=True, text=True, timeout=480)
+        sys.stdout.write(out.stdout)
+        sys.stderr.write(out.stderr)
+        raise SystemExit(out.returncode)
+    except subprocess.TimeoutExpired as exc:
+        # A child that printed its result and then wedged in teardown still
+        # measured successfully: forward the line instead of a failure row.
+        partial = (exc.stdout or b"")
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        for line in partial.splitlines():
+            if line.startswith("{") and '"metric"' in line:
+                print(line)
+                return
+        print(json.dumps({
+            "metric": "1MiB jax.Array pingpong bandwidth via asend/arecv "
+                      "(FAILED: device backend unresponsive for 480s)",
+            "value": 0.0,
+            "unit": "GB/s",
+            "vs_baseline": 0.0,
+        }))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--kernels":
         main_kernels(sys.argv[2:])
-    else:
+    elif os.environ.get("STARWAY_BENCH_CHILD") == "1":
         main()
+    else:
+        main_watchdog()

@@ -515,8 +515,12 @@ class NativeWorkerBase:
             with self._devpull_lock:
                 if target not in self._devpull_pending:
                     # Lost a race; the stolen receive must be returned --
-                    # outside the lock (post_recv re-enters it).
-                    if rec is not None and rc == 1:
+                    # outside the lock (post_recv re-enters it).  Also for
+                    # a truncation match (rc == -1): the receive was too
+                    # small for THIS descriptor, which someone else now
+                    # owns; back in the matcher it can match other traffic
+                    # and stays reachable by the close cancel sweep.
+                    if rec is not None:
                         repost = rec
                 else:
                     self._devpull_pending.remove(target)
