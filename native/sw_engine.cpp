@@ -301,6 +301,19 @@ std::string json_field(const std::string& body, const std::string& key) {
   return body.substr(p + 1, q - p - 1);
 }
 
+// Numeric variant (JSON numbers are unquoted; json_field above only reads
+// quoted strings).
+uint64_t json_num_field(const std::string& body, const std::string& key) {
+  std::string pat = "\"" + key + "\"";
+  size_t p = body.find(pat);
+  if (p == std::string::npos) return 0;
+  p = body.find(':', p + pat.size());
+  if (p == std::string::npos) return 0;
+  p = body.find_first_not_of(" \t", p + 1);
+  if (p == std::string::npos) return 0;
+  return strtoull(body.c_str() + p, nullptr, 10);
+}
+
 using Fire = std::function<void()>;
 using FireList = std::vector<Fire>;
 
@@ -328,21 +341,56 @@ struct InboundMsg {
   bool has_pr = false;
   bool complete = false;
   bool discard = false;
+  // devpull descriptor record: the payload lives on the sender's transfer
+  // server; the embedder pulls it.  Queued in `unexpected` so matching
+  // stays FIFO with staged DATA on the same tag (one queue, one contract
+  // with core/matching.py).
+  bool remote = false;
+  uint64_t remote_id = 0, remote_conn = 0;
 };
 
 struct Matcher {
   std::deque<PostedRecv> posted;
   std::deque<InboundMsg*> unexpected;
   std::unordered_set<InboundMsg*> inflight;
+  // devpull claim outcome of a post_recv: reported to the caller (sw_recv
+  // marshals it through the engine op queue so a claim can never be
+  // observed by the embedder before the descriptor that created the
+  // record -- descriptor fires run on the engine thread).
+  struct RemoteClaim {
+    bool has = false;
+    uint64_t rid = 0, rctx = 0;
+    int flags = 0;  // 0 claimed, 1 truncated
+  };
 
   ~Matcher() {
     for (auto* m : unexpected) delete m;
   }
 
-  void post_recv(const PostedRecv& pr_in, FireList& fires) {
+  void post_recv(const PostedRecv& pr_in, FireList& fires,
+                 RemoteClaim* claim = nullptr) {
     for (auto it = unexpected.begin(); it != unexpected.end(); ++it) {
       InboundMsg* m = *it;
       if (!m->has_pr && !m->discard && tags_match(m->tag, pr_in.tag, pr_in.mask)) {
+        if (m->remote) {
+          // Descriptor record: consume it and report the claim to the
+          // caller (which marshals it to the embedder).  Too-small
+          // receives fail here exactly like an oversized staged message.
+          bool trunc = m->length > pr_in.cap;
+          if (claim) {
+            claim->has = true;
+            claim->rid = m->remote_id;
+            claim->rctx = trunc ? 0 : (uint64_t)(uintptr_t)pr_in.ctx;
+            claim->flags = trunc ? 1 : 0;
+          }
+          unexpected.erase(it);
+          delete m;
+          if (trunc) {
+            auto fail = pr_in.fail; auto ctx = pr_in.ctx;
+            fires.push_back([fail, ctx] { fail(ctx, kTruncated); });
+          }
+          return;
+        }
         if (m->length > pr_in.cap) {
           unexpected.erase(it);
           if (!m->complete) { m->discard = true; } else { delete m; }
@@ -372,6 +420,42 @@ struct Matcher {
   // queued, never matched -- live link probing (perf.autocalibrate) cannot
   // pollute matching state.  Contract shared with core/matching.py.
   static constexpr uint64_t kProbeTag = 0x53575F50524F4245ull;
+
+  // A devpull descriptor arrived: match like on_start would, or queue a
+  // remote record in the (FIFO) unexpected stream.  Returns 1 claimed
+  // (*out_ctx = the removed receive's ctx), -1 matched-but-truncated
+  // (*out_ctx set; the CALLER fires the failure, outside locks), 0 queued.
+  int on_remote(uint64_t tag, uint64_t nbytes, uint64_t remote_id,
+                uint64_t conn_id, uint64_t* out_ctx) {
+    for (auto it = posted.begin(); it != posted.end(); ++it) {
+      if (it->claimed || !tags_match(tag, it->tag, it->mask)) continue;
+      *out_ctx = (uint64_t)(uintptr_t)it->ctx;
+      int rc = nbytes > it->cap ? -1 : 1;
+      posted.erase(it);
+      return rc;
+    }
+    auto* m = new InboundMsg();
+    m->tag = tag;
+    m->length = nbytes;
+    m->remote = true;
+    m->remote_id = remote_id;
+    m->remote_conn = conn_id;
+    unexpected.push_back(m);
+    return 0;
+  }
+
+  // The conn a remote record came from died: its payload can never be
+  // pulled, so the record must not eat future receives on that tag.
+  void purge_remote_conn(uint64_t conn_id) {
+    for (auto it = unexpected.begin(); it != unexpected.end();) {
+      if ((*it)->remote && (*it)->remote_conn == conn_id) {
+        delete *it;
+        it = unexpected.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
 
   // Header of a streamed message arrived; returns the record.
   InboundMsg* on_start(uint64_t tag, uint64_t length, FireList& fires) {
@@ -581,7 +665,8 @@ struct FlushRec {
 // ------------------------------------------------------------------ ops
 
 struct Op {
-  enum Kind { SEND, FLUSH, SEND_DEVPULL, DEVPULL_RESOLVED } kind;
+  enum Kind { SEND, FLUSH, SEND_DEVPULL, DEVPULL_RESOLVED,
+              DEVPULL_CLAIM, DEVPULL_PURGE } kind;
   uint64_t conn_id = 0;       // SEND target; FLUSH: 0 = all conns
   bool conn_scoped = false;   // FLUSH limited to conn_id
   const uint8_t* buf = nullptr;
@@ -593,7 +678,9 @@ struct Op {
   sw_done_cb release = nullptr;
   void* release_ctx = nullptr;
   std::string body;     // SEND_DEVPULL descriptor JSON
-  uint64_t msg_id = 0;  // DEVPULL_RESOLVED
+  uint64_t msg_id = 0;  // DEVPULL_RESOLVED / _CLAIM / _PURGE: remote id
+  uint64_t rctx = 0;    // DEVPULL_CLAIM: claimed receive's registry ctx
+  int flags = 0;        // DEVPULL_CLAIM: 0 claimed, 1 truncated
 };
 
 // --------------------------------------------------------------- worker
@@ -621,6 +708,7 @@ struct Worker {
   // devpull extension (sw_engine.h)
   bool devpull_advertise = false;
   sw_devpull_cb devpull_cb = nullptr;
+  sw_devpull_claim_cb devpull_claim_cb = nullptr;
   void* devpull_cb_ctx = nullptr;
   uint64_t next_devpull_msg = 1;
   // client bits
@@ -755,12 +843,19 @@ struct Worker {
     if (!devpull_cb || !c->devpull_ok) return;  // never negotiated: drop
     uint64_t msg_id = next_devpull_msg++;
     c->devpull_pending.insert(msg_id);
+    uint64_t nbytes = json_num_field(body, "n");
+    int rc;
+    uint64_t rctx = 0;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      rc = matcher.on_remote(tag, nbytes, msg_id, c->id, &rctx);
+    }
     auto cb = devpull_cb; auto ctx = devpull_cb_ctx;
     uint64_t cid = c->id;
     // Copy the body into the fire (the ctl buffer is reused immediately).
     auto shared = std::make_shared<std::string>(body);
-    fires.push_back([cb, ctx, cid, tag, shared, msg_id] {
-      cb(ctx, cid, tag, shared->c_str(), shared->size(), msg_id);
+    fires.push_back([cb, ctx, cid, tag, shared, msg_id, rc, rctx] {
+      cb(ctx, cid, tag, shared->c_str(), shared->size(), msg_id, rc, rctx);
     });
   }
 
@@ -1238,6 +1333,10 @@ struct Worker {
     close(c->fd);
     c->fd = -1;
     c->drop_sm();
+    {
+      std::lock_guard<std::mutex> g(mu);
+      matcher.purge_remote_conn(c->id);
+    }
     bool was_half_open = half_open.erase(c) > 0;
     auto snapshot = flushes;
     for (auto* rec : snapshot) try_complete_flush(rec, fires);
@@ -1326,6 +1425,26 @@ struct Worker {
         if (ops.empty() || status.load() != ST_RUNNING) return;
         op = ops.front();
         ops.pop_front();
+      }
+      if (op.kind == Op::DEVPULL_CLAIM) {
+        if (devpull_claim_cb) {
+          auto cb = devpull_claim_cb; auto ctx = devpull_cb_ctx;
+          uint64_t rid = op.msg_id, rctx = op.rctx;
+          int flags = op.flags;
+          fires.push_back([cb, ctx, rid, rctx, flags] { cb(ctx, rid, rctx, flags); });
+        }
+        continue;
+      }
+      if (op.kind == Op::DEVPULL_PURGE) {
+        std::lock_guard<std::mutex> g(mu);
+        for (auto it = matcher.unexpected.begin(); it != matcher.unexpected.end(); ++it) {
+          if ((*it)->remote && (*it)->remote_id == op.msg_id) {
+            delete *it;
+            matcher.unexpected.erase(it);
+            break;
+          }
+        }
+        continue;
       }
       if (op.kind == Op::SEND || op.kind == Op::SEND_DEVPULL ||
           op.kind == Op::DEVPULL_RESOLVED) {
@@ -1752,31 +1871,14 @@ int sw_send(void* h, uint64_t conn_id, const void* buf, uint64_t len, uint64_t t
   return 0;
 }
 
-void sw_set_devpull(void* h, int advertise, sw_devpull_cb cb, void* ctx) {
+void sw_set_devpull(void* h, int advertise, sw_devpull_cb cb,
+                    sw_devpull_claim_cb claim_cb, void* ctx) {
   Worker* w = W(h);
   std::lock_guard<std::mutex> g(w->mu);
   w->devpull_advertise = advertise != 0;
   w->devpull_cb = cb;
+  w->devpull_claim_cb = claim_cb;
   w->devpull_cb_ctx = ctx;
-}
-
-int sw_devpull_match(void* h, uint64_t tag, uint64_t nbytes, uint64_t* out_ctx) {
-  // Atomically claims a posted receive the way Matcher::on_start would;
-  // the embedder completes it after pulling.  Thread-safe (any thread).
-  // Truncation (-1) also removes the receive and hands back its ctx: the
-  // EMBEDDER fires the failure, outside whatever locks it holds -- this
-  // function never invokes user callbacks.
-  Worker* w = W(h);
-  std::lock_guard<std::mutex> g(w->mu);
-  auto& posted = w->matcher.posted;
-  for (auto it = posted.begin(); it != posted.end(); ++it) {
-    if (it->claimed || !tags_match(tag, it->tag, it->mask)) continue;
-    *out_ctx = (uint64_t)(uintptr_t)it->ctx;
-    int rc = nbytes > it->cap ? -1 : 1;
-    posted.erase(it);
-    return rc;
-  }
-  return 0;
 }
 
 void sw_devpull_resolved(void* h, uint64_t conn_id, uint64_t msg_id) {
@@ -1790,6 +1892,21 @@ void sw_devpull_resolved(void* h, uint64_t conn_id, uint64_t msg_id) {
     op.kind = Op::DEVPULL_RESOLVED;
     op.conn_id = conn_id;
     op.msg_id = msg_id;
+    w->ops.push_back(op);
+  }
+  w->wake();
+}
+
+void sw_devpull_purge(void* h, uint64_t remote_id) {
+  // A pull failed on a live conn: remove the matcher's record so it cannot
+  // eat future receives (thread-safe; marshals to the engine thread).
+  Worker* w = W(h);
+  {
+    std::lock_guard<std::mutex> g(w->mu);
+    if (w->status.load() != ST_RUNNING) return;
+    Op op;
+    op.kind = Op::DEVPULL_PURGE;
+    op.msg_id = remote_id;
     w->ops.push_back(op);
   }
   w->wake();
@@ -1831,8 +1948,21 @@ int sw_recv(void* h, void* buf, uint64_t cap, uint64_t tag, uint64_t mask,
     pr.done = done;
     pr.fail = fail;
     pr.ctx = ctx;
-    w->matcher.post_recv(pr, fires);
+    Matcher::RemoteClaim claim;
+    w->matcher.post_recv(pr, fires, &claim);
+    if (claim.has) {
+      // Deliver via the engine op queue: descriptor fires run on the
+      // engine thread, so the embedder can never observe a claim before
+      // the descriptor that created the record.
+      Op op;
+      op.kind = Op::DEVPULL_CLAIM;
+      op.msg_id = claim.rid;
+      op.rctx = claim.rctx;
+      op.flags = claim.flags;
+      w->ops.push_back(op);
+    }
   }
+  w->wake();
   for (auto& f : fires) f();
   return 0;
 }

@@ -147,29 +147,44 @@ int sw_conn_info(void* h, uint64_t conn_id, char* out, int cap);
  * (core/native.py) owns the pulls, since they need a live JAX runtime.
  *
  * Setup: call sw_set_devpull BEFORE listen/connect.  When `advertise` is
- * non-zero the handshake offers/accepts "devpull"; `cb` fires on the
- * engine thread for every descriptor received, with the raw JSON body and
- * an engine-assigned msg_id.  The embedder then:
- *   1. calls sw_devpull_match to atomically claim a posted receive
- *      (returns 1 and the recv's ctx — removed from the matcher, the
- *      embedder completes it after pulling; 0 = no match, embedder queues
- *      the descriptor; -1 = matched-but-truncated, engine already failed
- *      the receive);
- *   2. pulls the payload (eagerly, whatever the match outcome — the
- *      sender's buffer must be released and flush must be able to
- *      complete);
- *   3. calls sw_devpull_resolved(conn_id, msg_id) when the pull lands or
- *      fails.  FLUSH_ACKs for barriers that arrived after the descriptor
- *      are withheld until every such descriptor resolves (the sender's
- *      flush means "payload resident at the receiver"). */
+ * non-zero the handshake offers/accepts "devpull".  ALL matching lives in
+ * the engine's matcher (descriptor records share the one FIFO unexpected
+ * stream with staged DATA, so same-tag ordering is identical to the
+ * Python engine's):
+ *
+ *   - `cb` fires on the engine thread for every descriptor received, with
+ *     the raw JSON body, an engine-assigned msg_id, and the match result:
+ *     rc 1 = a posted receive was claimed (recv_ctx = its ctx, removed
+ *     from the matcher; the embedder completes it after pulling), rc -1 =
+ *     matched but the receive was too small (recv_ctx set; the EMBEDDER
+ *     fires the truncation failure), rc 0 = queued in the unexpected
+ *     stream.
+ *   - `claim_cb` fires when a LATER sw_recv claims a queued descriptor:
+ *     flags 0 = claimed (recv_ctx = the receive's ctx, not posted to the
+ *     matcher), flags 1 = the receive was too small (engine already fired
+ *     its failure; recv_ctx is 0; the record is consumed).
+ *
+ * The embedder pulls the payload eagerly whatever the match outcome (the
+ * sender's buffer must be released and flush must be able to complete)
+ * and calls sw_devpull_resolved(conn_id, msg_id) when the pull lands or
+ * fails.  FLUSH_ACKs for barriers that arrived after the descriptor are
+ * withheld until every such descriptor resolves (the sender's flush means
+ * "payload resident at the receiver"). */
 typedef void (*sw_devpull_cb)(void* ctx, uint64_t conn_id, uint64_t tag,
                               const char* body, uint64_t len,
-                              uint64_t msg_id);
-void sw_set_devpull(void* h, int advertise, sw_devpull_cb cb, void* ctx);
-
-int sw_devpull_match(void* h, uint64_t tag, uint64_t nbytes, uint64_t* out_ctx);
+                              uint64_t msg_id, int rc, uint64_t recv_ctx);
+typedef void (*sw_devpull_claim_cb)(void* ctx, uint64_t remote_id,
+                                    uint64_t recv_ctx, int flags);
+void sw_set_devpull(void* h, int advertise, sw_devpull_cb cb,
+                    sw_devpull_claim_cb claim_cb, void* ctx);
 
 void sw_devpull_resolved(void* h, uint64_t conn_id, uint64_t msg_id);
+
+/* A pull failed while its conn is still alive: remove the matcher's queued
+ * descriptor record so it cannot consume future receives (records of a
+ * dead conn are purged automatically).  Thread-safe; applied on the engine
+ * thread. */
+void sw_devpull_purge(void* h, uint64_t remote_id);
 
 /* Queue a DEVPULL descriptor send (counts as tagged data for flush/dirty
  * accounting; `done` fires at local completion = descriptor handed to the

@@ -150,6 +150,12 @@ class TagMatcher:
                 if msg.length > size:
                     self.unexpected.remove(msg)
                     fires.append(lambda fail=fail: fail(REASON_TRUNCATED))
+                    if msg.remote is not None and not msg.complete:
+                        # Unpulled remote payload: drain-pull it so the
+                        # sender's buffer is released and flush barriers
+                        # waiting on the descriptor can resolve.
+                        msg.discard = True
+                        fires.append(lambda m=msg: m.remote.start(m))
                     return fires
                 pr = PostedRecv(buf, tag, mask, done, fail, owner)
                 if msg.remote is not None and not msg.complete:

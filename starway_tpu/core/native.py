@@ -16,10 +16,12 @@ protocol as the Python engine, so mixed-engine processes interoperate over
 either.  The in-process fast path stays in Python, which is why native
 selection requires inproc-free mode (``STARWAY_TLS=tcp`` or ``tcp,sm``,
 plus ``STARWAY_NATIVE=1``).  Cross-process device payloads ride the
-negotiated PJRT pull extension: the engine surfaces T_DEVPULL descriptors
-through ``sw_set_devpull`` and this wrapper runs the pulls (the engine
-cannot -- they need a live JAX runtime), claiming posted receives via
-``sw_devpull_match`` and releasing deferred flush barriers via
+negotiated PJRT pull extension: ALL matching lives in the engine
+(descriptor records share its FIFO unexpected stream with staged DATA, so
+same-tag ordering matches the Python engine); the engine surfaces
+descriptors and claim events through ``sw_set_devpull``'s two callbacks
+and this wrapper runs the pulls (the engine cannot -- they need a live
+JAX runtime), releasing deferred flush barriers via
 ``sw_devpull_resolved`` (see sw_engine.h "devpull" and DESIGN.md §7).
 
 Lifetime/GIL notes: callbacks cross from the engine thread through ctypes
@@ -53,7 +55,10 @@ _ACCEPT_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64)
 _STATUS_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_char_p)
 _DEVPULL_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64,
                                ctypes.c_uint64, ctypes.POINTER(ctypes.c_char),
-                               ctypes.c_uint64, ctypes.c_uint64)
+                               ctypes.c_uint64, ctypes.c_uint64,
+                               ctypes.c_int, ctypes.c_uint64)
+_DEVPULL_CLAIM_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64,
+                                     ctypes.c_uint64, ctypes.c_int)
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -102,15 +107,13 @@ def load() -> Optional[ctypes.CDLL]:
         ]
         lib.sw_free.argtypes = [ctypes.c_void_p]
         lib.sw_set_devpull.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, _DEVPULL_CB, ctypes.c_void_p
-        ]
-        lib.sw_devpull_match.argtypes = [
-            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_void_p, ctypes.c_int, _DEVPULL_CB, _DEVPULL_CLAIM_CB,
+            ctypes.c_void_p,
         ]
         lib.sw_devpull_resolved.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64
         ]
+        lib.sw_devpull_purge.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.sw_send_devpull.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p,
             ctypes.c_uint64, _DONE_CB, _FAIL_CB, ctypes.c_void_p,
@@ -210,14 +213,25 @@ def _on_accept(ctx, conn_id):
 
 
 @_DEVPULL_CB
-def _on_devpull(ctx, conn_id, tag, body, length, msg_id):
+def _on_devpull(ctx, conn_id, tag, body, length, msg_id, rc, recv_ctx):
     rec = _peek(ctx)  # persistent registration: not popped
     if rec and rec[0] is not None:
         try:
             rec[0](int(conn_id), int(tag),
-                   ctypes.string_at(body, int(length)), int(msg_id))
+                   ctypes.string_at(body, int(length)), int(msg_id),
+                   int(rc), int(recv_ctx))
         except Exception:
             logger.exception("starway native devpull callback raised")
+
+
+@_DEVPULL_CLAIM_CB
+def _on_devpull_claim(ctx, remote_id, recv_ctx, flags):
+    rec = _peek(ctx)  # persistent registration: not popped
+    if rec and rec[1] is not None:
+        try:
+            rec[1](int(remote_id), int(recv_ctx), int(flags))
+        except Exception:
+            logger.exception("starway native devpull claim callback raised")
 
 
 def _is_device_sink(obj) -> bool:
@@ -337,7 +351,10 @@ class NativeWorkerBase:
         # the wire + matching; this wrapper owns the pulls.
         self._devpull_key: Optional[int] = None
         self._xfer_mgr = None
-        self._devpull_pending: list[_PendingPull] = []
+        # msg_id -> entry for every surfaced descriptor.  Matching lives in
+        # the ENGINE (descriptor records share its FIFO unexpected stream);
+        # this wrapper only runs pulls and completes claimed receives.
+        self._devpull_entries: dict[int, _PendingPull] = {}
         self._devpull_claimed: list[_PendingPull] = []
         self._devpull_lock = threading.Lock()
 
@@ -385,13 +402,19 @@ class NativeWorkerBase:
             return
         wself = weakref.ref(self)
 
-        def dispatch(conn_id, tag, body, msg_id):
+        def dispatch(conn_id, tag, body, msg_id, rc, recv_ctx):
             s = wself()
             if s is not None:
-                s._on_devpull_native(conn_id, tag, body, msg_id)
+                s._on_devpull_native(conn_id, tag, body, msg_id, rc, recv_ctx)
 
-        self._devpull_key = _register(dispatch, None)
-        self._lib.sw_set_devpull(self._h, 1, _on_devpull, self._devpull_key)
+        def dispatch_claim(remote_id, recv_ctx, flags):
+            s = wself()
+            if s is not None:
+                s._on_devpull_claim_native(remote_id, recv_ctx, flags)
+
+        self._devpull_key = _register(dispatch, dispatch_claim)
+        self._lib.sw_set_devpull(self._h, 1, _on_devpull, _on_devpull_claim,
+                                 self._devpull_key)
 
     def transfer_manager(self):
         from .. import device as _device
@@ -403,70 +426,37 @@ class NativeWorkerBase:
                 self._xfer_mgr = _device.TransferManager(config.advertised_host())
             return self._xfer_mgr
 
-    def _match_native(self, tag: int, nbytes: int):
-        """One sw_devpull_match attempt.  Returns (rc, rec): rc 1 = claimed
-        (rec is the removed receive's registry record), -1 = matched but
-        truncated (rec removed; CALLER fires the truncation failure,
-        outside any locks), 0 = no match."""
-        out = ctypes.c_uint64()
-        rc = self._lib.sw_devpull_match(self._h, tag, nbytes, ctypes.byref(out))
-        if rc == 0:
-            return 0, None
-        return rc, _take(int(out.value))
-
     @staticmethod
     def _claim_from_rec(entry: _PendingPull, rec) -> None:
-        # rec = (done_wrapped, fail, mv, owner, keep, user_done, repost)
+        # rec = (done_wrapped, fail, mv, owner, keep, user_done)
         user_done = rec[5] if len(rec) > 5 else rec[0]
         owner = rec[3]
         sink = owner if _is_device_sink(owner) else None
         entry.claimed = (user_done, rec[1], None if sink else rec[2], sink)
 
     def _on_devpull_native(self, conn_id: int, tag: int, body: bytes,
-                           msg_id: int) -> None:
-        """Engine-thread callback: a descriptor arrived.  Claim a posted
-        receive if one matches, then pull EAGERLY whatever the outcome --
-        the sender's buffer must be released and a flush barrier behind the
+                           msg_id: int, rc: int, recv_ctx: int) -> None:
+        """Engine-thread callback: a descriptor arrived and the ENGINE
+        already matched it (rc 1 claimed / -1 truncated / 0 queued in its
+        FIFO unexpected stream).  Pull EAGERLY whatever the outcome -- the
+        sender's buffer must be released and a flush barrier behind the
         descriptor must be able to complete (the engine withholds the
-        FLUSH_ACK until sw_devpull_resolved).
-
-        Two-phase match closes the race against a concurrently posted
-        receive: match, publish to the pending list, match AGAIN (a receive
-        that slipped in between is caught by phase 2; one posted after
-        phase 2 finds the entry via post_recv's own retry).  If phase 2
-        steals a receive but the front door claimed the entry meanwhile,
-        the stolen receive is re-posted."""
+        FLUSH_ACK until sw_devpull_resolved)."""
         fail_trunc = None
         try:
             desc = json.loads(body.decode())
             entry = _PendingPull(desc, conn_id, msg_id, tag)
-            rc, rec = self._match_native(tag, entry.nbytes)
-            if rc == 1 and rec is not None:
-                with self._devpull_lock:
-                    self._claim_from_rec(entry, rec)
-                    self._devpull_claimed.append(entry)
-            elif rc == -1:
-                entry.discard = True
-                fail_trunc = rec[1] if rec is not None else None
-            else:
-                repost = None
-                with self._devpull_lock:
-                    self._devpull_pending.append(entry)
-                rc2, rec2 = self._match_native(tag, entry.nbytes)
-                if rc2 != 0 and rec2 is not None:
+            with self._devpull_lock:
+                self._devpull_entries[msg_id] = entry
+            if rc != 0:
+                rec = _take(recv_ctx)
+                if rc == -1:
+                    entry.discard = True  # drain pull releases the sender
+                    fail_trunc = rec[1] if rec is not None else None
+                elif rec is not None:
                     with self._devpull_lock:
-                        if entry in self._devpull_pending:
-                            self._devpull_pending.remove(entry)
-                            if rc2 == 1:
-                                self._claim_from_rec(entry, rec2)
-                                self._devpull_claimed.append(entry)
-                            else:
-                                entry.discard = True
-                                fail_trunc = rec2[1]
-                        else:
-                            repost = rec2  # front door won; give it back
-                if repost is not None:
-                    self._repost_recv(repost)
+                        self._claim_from_rec(entry, rec)
+                        self._devpull_claimed.append(entry)
         except Exception:
             logger.exception("starway devpull descriptor handling failed")
             self._lib.sw_devpull_resolved(self._h, conn_id, msg_id)
@@ -480,72 +470,39 @@ class NativeWorkerBase:
                 logger.exception("starway devpull truncation callback raised")
         self._start_pull(entry)
 
-    def _repost_recv(self, rec) -> None:
-        """Return a receive stolen by a second-chance match that lost the
-        entry to the front door (rare race): re-post it via the normal
-        path.  It rejoins the matcher at the back -- an acceptable FIFO
-        perturbation for a window this narrow."""
-        try:
-            tag, mask, buf = rec[6]
-            self.post_recv(buf, tag, mask, rec[5], rec[1], owner=rec[3])
-        except Exception:
-            logger.exception("starway devpull recv re-post failed")
-
-    def _retry_pending_matches(self) -> None:
-        """post_recv epilogue: a descriptor may have been surfaced between
-        the front-door check and sw_recv.  Claim any unclaimed pending
-        entry a native-posted receive now matches."""
-        from ..errors import REASON_TRUNCATED
-
-        while True:
-            target = None
+    def _on_devpull_claim_native(self, remote_id: int, recv_ctx: int,
+                                 flags: int) -> None:
+        """A later receive claimed a queued descriptor record inside the
+        engine's matcher (or was failed there for truncation, flags=1)."""
+        complete_now = None
+        with self._devpull_lock:
+            entry = self._devpull_entries.get(remote_id)
+        if entry is None:
+            if recv_ctx:
+                _take(recv_ctx)  # stale claim; drop the registry record
+            return
+        if flags == 1:
+            # Engine fired the receive's truncation failure and consumed
+            # the record; no claim will ever arrive for this entry.
             with self._devpull_lock:
-                for e in self._devpull_pending:
-                    if e.claimed is None and not e.discard and not e.failed:
-                        target = e
-                        break
-            if target is None:
-                return
-            rc, rec = self._match_native(target.tag, target.nbytes)
-            if rc == 0:
-                return
-            complete_now = None
-            fail_trunc = None
-            repost = None
-            with self._devpull_lock:
-                if target not in self._devpull_pending:
-                    # Lost a race; the stolen receive must be returned --
-                    # outside the lock (post_recv re-enters it).  Also for
-                    # a truncation match (rc == -1): the receive was too
-                    # small for THIS descriptor, which someone else now
-                    # owns; back in the matcher it can match other traffic
-                    # and stays reachable by the close cancel sweep.
-                    if rec is not None:
-                        repost = rec
-                else:
-                    self._devpull_pending.remove(target)
-                    if rc == -1:
-                        target.discard = True
-                        fail_trunc = rec[1] if rec is not None else None
-                    else:
-                        self._claim_from_rec(target, rec)
-                        complete_now = target.array
-                        if complete_now is not None:
-                            # Terminal outcome decided here: the close sweep
-                            # must not also cancel it.
-                            target.resolved = True
-                        else:
-                            self._devpull_claimed.append(target)
-            if repost is not None:
-                self._repost_recv(repost)
-                continue
-            if fail_trunc is not None:
-                try:
-                    fail_trunc(REASON_TRUNCATED)
-                except Exception:
-                    logger.exception("starway devpull truncation callback raised")
-            if complete_now is not None:
-                self._finish_entry(target, complete_now)
+                entry.discard = True
+                self._devpull_entries.pop(entry.msg_id, None)
+            return
+        rec = _take(recv_ctx)
+        if rec is None:
+            return
+        with self._devpull_lock:
+            self._claim_from_rec(entry, rec)
+            if entry.array is not None and not entry.resolved:
+                entry.resolved = True
+                complete_now = entry.array
+            else:
+                # Pull outstanding -- or failed, in which case the receive
+                # stays pending (peer-death semantics) until the close
+                # sweep cancels it.
+                self._devpull_claimed.append(entry)
+        if complete_now is not None:
+            self._finish_entry(entry, complete_now)
 
     def _start_pull(self, entry: _PendingPull) -> None:
         mgr = self.transfer_manager()
@@ -567,9 +524,12 @@ class NativeWorkerBase:
                     and not entry.discard
                 if deliver:
                     entry.resolved = True
+                if entry.discard:
+                    self._devpull_entries.pop(entry.msg_id, None)
             if deliver:
                 self._finish_entry(entry, arr)
-            # Unclaimed entries keep the array; a later post_recv delivers.
+            # Unclaimed entries keep the array; the engine's matcher still
+            # holds the record and a later receive claims it.
         finally:
             self._lib.sw_devpull_resolved(self._h, entry.conn_id, entry.msg_id)
 
@@ -588,6 +548,7 @@ class NativeWorkerBase:
             with self._devpull_lock:
                 if entry in self._devpull_claimed:
                     self._devpull_claimed.remove(entry)
+                self._devpull_entries.pop(entry.msg_id, None)
             if user_done is not None:
                 user_done(entry.tag, entry.nbytes)
         except Exception:
@@ -595,10 +556,17 @@ class NativeWorkerBase:
 
     def _pull_failed(self, entry: _PendingPull, err: str) -> None:
         logger.warning("starway devpull pull failed: %s", err)
-        entry.failed = True
+        purge = False
         with self._devpull_lock:
-            if entry in self._devpull_pending:
-                self._devpull_pending.remove(entry)
+            entry.failed = True
+            purge = entry.claimed is None
+        if purge:
+            # Remove the engine matcher's queued record so it cannot eat
+            # future receives.  The wrapper entry stays in the dict: a
+            # claim racing the purge then finds a failed entry and its
+            # receive goes pending (peer-death semantics) instead of being
+            # silently dropped; the dict entry is reclaimed at close.
+            self._lib.sw_devpull_purge(self._h, entry.msg_id)
         # A claimed receive stays pending (peer-death semantics) until the
         # close sweep cancels it (_drop_devpull).
         self._lib.sw_devpull_resolved(self._h, entry.conn_id, entry.msg_id)
@@ -614,54 +582,6 @@ class NativeWorkerBase:
         if rc != 0:
             _take(key)
             raise StarwayStateError("starway native send rejected (not running)")
-
-    def _match_pending_pull(self, buf, tag: int, mask: int, done, fail,
-                            owner) -> bool:
-        """post_recv front-door: claim a surfaced-but-unmatched descriptor
-        (FIFO) before the receive reaches the native matcher.  Returns True
-        when the receive was consumed here.
-
-        Ordering caveat (native engine only): a pending pull descriptor is
-        matched ahead of any older staged DATA message with the same tag
-        still in the C++ matcher's unexpected queue -- mixed-transport
-        sends on one tag can complete out of arrival order.  The Python
-        engine keeps one arrival-ordered queue and does not have this."""
-        from .matching import tags_match
-
-        cap = len(buf) if isinstance(buf, memoryview) else int(buf.nbytes)
-        arr = None
-        truncated = False
-        with self._devpull_lock:
-            entry = None
-            for e in self._devpull_pending:
-                if e.claimed is None and not e.discard and not e.failed \
-                        and tags_match(e.tag, tag, mask):
-                    entry = e
-                    break
-            if entry is None:
-                return False
-            self._devpull_pending.remove(entry)
-            if entry.nbytes > cap:
-                entry.discard = True  # drain pull already running/ran
-                truncated = True
-            else:
-                sink = buf if not isinstance(buf, memoryview) else None
-                entry.claimed = (done, fail,
-                                 buf if sink is None else None, sink)
-                arr = entry.array
-                if arr is not None:
-                    entry.resolved = True
-                else:
-                    self._devpull_claimed.append(entry)
-        if truncated:
-            from ..errors import REASON_TRUNCATED
-
-            if fail is not None:
-                fail(REASON_TRUNCATED)
-            return True
-        if arr is not None:
-            self._finish_entry(entry, arr)
-        return True
 
     def submit_send(self, conn, view, tag: int, done, fail, owner=None) -> None:
         self._require_running()
@@ -682,12 +602,6 @@ class NativeWorkerBase:
 
     def post_recv(self, buf, tag: int, mask: int, done, fail, owner=None) -> None:
         self._require_running()
-        # Surfaced-but-unmatched pull descriptors match first (before the
-        # native matcher sees the receive, and before any staging buffer is
-        # allocated -- a pulled payload never touches host staging).
-        if self._devpull_pending and self._match_pending_pull(
-                buf, tag, mask, done, fail, owner):
-            return
         user_done = done
         if isinstance(buf, memoryview):
             mv = buf
@@ -702,19 +616,13 @@ class NativeWorkerBase:
         if mv.readonly:
             raise TypeError("receive buffer must be writable")
         addr, keep = self._mv_pointer(mv)
-        # Slot 5 (user_done) lets a devpull steal complete the receive via
-        # the device path instead of the staging-wrapped `done`; slot 6
-        # lets a steal that lost its entry to the front door re-post.
-        key = _register(done, fail, mv, owner, keep, user_done,
-                        (tag, mask, buf))
+        # Slot 5 (user_done) lets a devpull claim complete the receive via
+        # the device path instead of the staging-wrapped `done`.
+        key = _register(done, fail, mv, owner, keep, user_done)
         rc = self._lib.sw_recv(self._h, addr, len(mv), tag, mask, _on_recv, _on_fail, key)
         if rc != 0:
             _take(key)
             raise StarwayStateError("starway native recv rejected (not running)")
-        # A descriptor surfaced between the front-door check and sw_recv
-        # would match neither side: reconcile.
-        if self._devpull_pending:
-            self._retry_pending_matches()
 
     def submit_flush(self, done, fail, conns=None) -> None:
         self._require_running()
@@ -750,7 +658,7 @@ class NativeWorkerBase:
             self._devpull_key = None
         with self._devpull_lock:
             mgr, self._xfer_mgr = self._xfer_mgr, None
-            self._devpull_pending.clear()
+            self._devpull_entries.clear()
             cancelled = [e for e in self._devpull_claimed if not e.resolved]
             for e in cancelled:
                 e.resolved = True

@@ -188,6 +188,81 @@ async def test_devpull_engine_matrix(port, monkeypatch, server_native, client_na
         await server.aclose()
 
 
+@pytest.mark.parametrize("native", [False, True], ids=["py", "native"])
+async def test_devpull_same_tag_fifo_with_staged(port, monkeypatch, native):
+    """Mixed transports on ONE tag keep arrival order: a staged DATA
+    message sent before a devpull descriptor is received first.  Pins the
+    one-unexpected-stream contract on both engines (descriptor records sit
+    in the same FIFO as staged messages)."""
+    if native:
+        from starway_tpu.core import native as native_mod
+
+        if not native_mod.available():
+            pytest.skip("native engine unavailable")
+        monkeypatch.setenv("STARWAY_NATIVE", "1")
+
+    server, client = await _pair(port)
+    try:
+        small = np.full(1024, 3, dtype=np.uint8)  # below devpull threshold
+        big = jax.device_put(jnp.full(N, 4, dtype=jnp.uint8))
+        await client.asend(small, 0xD1)
+        await client.aflush()
+        await client.asend(big, 0xD1)
+        await client.aflush()
+
+        buf = np.zeros(N, dtype=np.uint8)
+        tag, n1 = await asyncio.wait_for(server.arecv(buf, 0xD1, MASK), 10)
+        assert (tag, n1) == (0xD1, 1024), "staged message must arrive first"
+        np.testing.assert_array_equal(buf[:1024], small)
+
+        sink = DeviceBuffer((N,), jnp.uint8)
+        tag, n2 = await asyncio.wait_for(server.arecv(sink, 0xD1, MASK), 10)
+        assert (tag, n2) == (0xD1, N)
+        np.testing.assert_array_equal(
+            np.asarray(sink.array), np.full(N, 4, dtype=np.uint8))
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+@pytest.mark.parametrize("native", [False, True], ids=["py", "native"])
+@pytest.mark.parametrize("recv_first", [True, False],
+                         ids=["recv-first", "descriptor-first"])
+async def test_devpull_truncation(port, monkeypatch, native, recv_first):
+    """A too-small receive matching a devpull payload fails with the
+    truncation error on both engines, whether it was posted before the
+    descriptor arrived or claims it from the unexpected stream."""
+    if native:
+        from starway_tpu.core import native as native_mod
+
+        if not native_mod.available():
+            pytest.skip("native engine unavailable")
+        monkeypatch.setenv("STARWAY_NATIVE", "1")
+
+    server, client = await _pair(port)
+    try:
+        small = np.zeros(1024, dtype=np.uint8)  # payload is N >> 1024
+        big = jax.device_put(jnp.full(N, 5, dtype=jnp.uint8))
+        if recv_first:
+            recv_fut = server.arecv(small, 0xE1, MASK)
+            await asyncio.sleep(0.05)
+            await client.asend(big, 0xE1)
+        else:
+            # NO flush before the receive: the truncation path itself must
+            # drain-pull the payload, or the barrier below hangs.
+            await client.asend(big, 0xE1)
+            await asyncio.sleep(0.2)  # descriptor lands unclaimed
+            recv_fut = server.arecv(small, 0xE1, MASK)
+        with pytest.raises(Exception, match="[Tt]runcat"):
+            await asyncio.wait_for(recv_fut, 10)
+        # The sender is not wedged: the flush barrier still completes
+        # (the payload is drain-pulled whatever happened to the receive).
+        await asyncio.wait_for(client.aflush(), 10)
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
 async def test_devpull_flush_not_blocked_by_later_send(port):
     """The FLUSH barrier waits only for descriptors that preceded it: a
     devpull sent after the flush (for a tag nobody receives) must not hold
