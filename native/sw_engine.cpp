@@ -344,8 +344,10 @@ struct InboundMsg {
   // devpull descriptor record: the payload lives on the sender's transfer
   // server; the embedder pulls it.  Queued in `unexpected` so matching
   // stays FIFO with staged DATA on the same tag (one queue, one contract
-  // with core/matching.py).
-  bool remote = false;
+  // with core/matching.py).  remote_ready = the embedder's eager pull
+  // landed (payload resident HERE): the record then survives the sender's
+  // death, exactly like a complete staged message would.
+  bool remote = false, remote_ready = false;
   uint64_t remote_id = 0, remote_conn = 0;
 };
 
@@ -444,17 +446,28 @@ struct Matcher {
     return 0;
   }
 
-  // The conn a remote record came from died: its payload can never be
-  // pulled, so the record must not eat future receives on that tag.
+  // The conn a remote record came from died: records whose payload has
+  // not landed can never be pulled and must not eat future receives.
+  // Ready records (payload already resident at the receiver) survive,
+  // like complete staged messages do -- one contract with the Python
+  // engine's peer-death sweep.
   void purge_remote_conn(uint64_t conn_id) {
     for (auto it = unexpected.begin(); it != unexpected.end();) {
-      if ((*it)->remote && (*it)->remote_conn == conn_id) {
+      if ((*it)->remote && (*it)->remote_conn == conn_id && !(*it)->remote_ready) {
         delete *it;
         it = unexpected.erase(it);
       } else {
         ++it;
       }
     }
+  }
+
+  void mark_remote_ready(uint64_t remote_id) {
+    for (auto* m : unexpected)
+      if (m->remote && m->remote_id == remote_id) {
+        m->remote_ready = true;
+        return;
+      }
   }
 
   // Header of a streamed message arrived; returns the record.
@@ -1455,6 +1468,10 @@ struct Worker {
           if (it != conns.end()) c = it->second;
         }
         if (op.kind == Op::DEVPULL_RESOLVED) {
+          if (op.flags) {  // pull landed: the record (if queued) is ready
+            std::lock_guard<std::mutex> g(mu);
+            matcher.mark_remote_ready(op.msg_id);
+          }
           if (c) devpull_resolve(c, op.msg_id, fires);
         } else if (!c || !c->alive) {
           auto fail = op.fail; auto ctx = op.ctx;
@@ -1476,6 +1493,14 @@ struct Worker {
       std::lock_guard<std::mutex> g(mu);
       while (!ops.empty()) {
         Op& op = ops.front();
+        if (op.kind == Op::DEVPULL_CLAIM && devpull_claim_cb) {
+          // Deliver the claim so the embedder's close sweep can cancel the
+          // receive (it left the matcher; nothing else can reach it).
+          auto cb = devpull_claim_cb; auto cctx = devpull_cb_ctx;
+          uint64_t rid = op.msg_id, rctx = op.rctx;
+          int flags = op.flags;
+          fires.push_back([cb, cctx, rid, rctx, flags] { cb(cctx, rid, rctx, flags); });
+        }
         auto fail = op.fail; auto ctx = op.ctx;
         if (fail) fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
         fire_op_release(op, fires);
@@ -1881,9 +1906,11 @@ void sw_set_devpull(void* h, int advertise, sw_devpull_cb cb,
   w->devpull_cb_ctx = ctx;
 }
 
-void sw_devpull_resolved(void* h, uint64_t conn_id, uint64_t msg_id) {
+void sw_devpull_resolved(void* h, uint64_t conn_id, uint64_t msg_id, int ok) {
   // Callable from any thread (the embedder's pull-completion thread):
-  // conn state is engine territory, so hop via the op queue.
+  // conn state is engine territory, so hop via the op queue.  `ok`
+  // nonzero = the pull landed (a still-queued record becomes `ready` and
+  // survives the sender's death, like a complete staged message).
   Worker* w = W(h);
   {
     std::lock_guard<std::mutex> g(w->mu);
@@ -1892,6 +1919,7 @@ void sw_devpull_resolved(void* h, uint64_t conn_id, uint64_t msg_id) {
     op.kind = Op::DEVPULL_RESOLVED;
     op.conn_id = conn_id;
     op.msg_id = msg_id;
+    op.flags = ok;
     w->ops.push_back(op);
   }
   w->wake();
@@ -1960,9 +1988,9 @@ int sw_recv(void* h, void* buf, uint64_t cap, uint64_t tag, uint64_t mask,
       op.rctx = claim.rctx;
       op.flags = claim.flags;
       w->ops.push_back(op);
+      w->wake();
     }
   }
-  w->wake();
   for (auto& f : fires) f();
   return 0;
 }

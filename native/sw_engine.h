@@ -178,7 +178,10 @@ typedef void (*sw_devpull_claim_cb)(void* ctx, uint64_t remote_id,
 void sw_set_devpull(void* h, int advertise, sw_devpull_cb cb,
                     sw_devpull_claim_cb claim_cb, void* ctx);
 
-void sw_devpull_resolved(void* h, uint64_t conn_id, uint64_t msg_id);
+/* `ok` nonzero = the pull landed: a still-queued descriptor record becomes
+ * `ready` and survives the sender's death, like a complete staged message
+ * (one peer-death contract with the Python engine). */
+void sw_devpull_resolved(void* h, uint64_t conn_id, uint64_t msg_id, int ok);
 
 /* A pull failed while its conn is still alive: remove the matcher's queued
  * descriptor record so it cannot consume future receives (records of a

@@ -111,7 +111,7 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p,
         ]
         lib.sw_devpull_resolved.argtypes = [
-            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int
         ]
         lib.sw_devpull_purge.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.sw_send_devpull.argtypes = [
@@ -459,7 +459,10 @@ class NativeWorkerBase:
                         self._devpull_claimed.append(entry)
         except Exception:
             logger.exception("starway devpull descriptor handling failed")
-            self._lib.sw_devpull_resolved(self._h, conn_id, msg_id)
+            # The engine may have queued a record for this descriptor; it
+            # has no wrapper entry, so it must not eat a future receive.
+            self._lib.sw_devpull_purge(self._h, msg_id)
+            self._lib.sw_devpull_resolved(self._h, conn_id, msg_id, 0)
             return
         if fail_trunc is not None:
             from ..errors import REASON_TRUNCATED
@@ -478,8 +481,17 @@ class NativeWorkerBase:
         with self._devpull_lock:
             entry = self._devpull_entries.get(remote_id)
         if entry is None:
-            if recv_ctx:
-                _take(recv_ctx)  # stale claim; drop the registry record
+            # Stale claim (record outlived its wrapper entry -- descriptor
+            # handling failed, or the worker is closing): cancel the
+            # receive rather than orphan it.
+            rec = _take(recv_ctx) if recv_ctx else None
+            if rec is not None and rec[1] is not None:
+                from ..errors import REASON_CANCELLED
+
+                try:
+                    rec[1](REASON_CANCELLED)
+                except Exception:
+                    logger.exception("starway devpull cancel callback raised")
             return
         if flags == 1:
             # Engine fired the receive's truncation failure and consumed
@@ -531,7 +543,8 @@ class NativeWorkerBase:
             # Unclaimed entries keep the array; the engine's matcher still
             # holds the record and a later receive claims it.
         finally:
-            self._lib.sw_devpull_resolved(self._h, entry.conn_id, entry.msg_id)
+            self._lib.sw_devpull_resolved(self._h, entry.conn_id,
+                                          entry.msg_id, 1)
 
     def _finish_entry(self, entry: _PendingPull, arr) -> None:
         """Deliver a pulled payload into its claimed receive.  Never called
@@ -569,7 +582,7 @@ class NativeWorkerBase:
             self._lib.sw_devpull_purge(self._h, entry.msg_id)
         # A claimed receive stays pending (peer-death semantics) until the
         # close sweep cancels it (_drop_devpull).
-        self._lib.sw_devpull_resolved(self._h, entry.conn_id, entry.msg_id)
+        self._lib.sw_devpull_resolved(self._h, entry.conn_id, entry.msg_id, 0)
 
     def submit_devpull(self, conn, desc: dict, tag: int, done, fail,
                        owner=None) -> None:

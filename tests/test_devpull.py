@@ -173,8 +173,8 @@ async def test_devpull_engine_matrix(port, monkeypatch, server_native, client_na
         np.testing.assert_array_equal(
             np.asarray(sink.array), np.arange(N, dtype=np.uint8))
 
-        # Unexpected-then-post through the pending-pull front door, then a
-        # flush barrier that must wait for the eager pull.
+        # Unexpected-then-post, with a flush barrier that must wait for the
+        # eager pull.
         src2 = jax.device_put(jnp.full(N, 9, dtype=jnp.uint8))
         await client.asend(src2, 0x67)
         await client.aflush()
@@ -183,8 +183,23 @@ async def test_devpull_engine_matrix(port, monkeypatch, server_native, client_na
         assert (tag, length) == (0x67, N)
         np.testing.assert_array_equal(
             np.asarray(sink2.array), np.full(N, 9, dtype=np.uint8))
-    finally:
+
+        # Flush means "payload resident at the receiver": it survives the
+        # sender's close even though no receive was posted yet.
+        src3 = jax.device_put(jnp.full(N, 11, dtype=jnp.uint8))
+        await client.asend(src3, 0x68)
+        await client.aflush()
         await client.aclose()
+        sink3 = DeviceBuffer((N,), jnp.uint8)
+        tag, length = await asyncio.wait_for(server.arecv(sink3, 0x68, MASK), 15)
+        assert (tag, length) == (0x68, N)
+        np.testing.assert_array_equal(
+            np.asarray(sink3.array), np.full(N, 11, dtype=np.uint8))
+    finally:
+        try:
+            await client.aclose()
+        except Exception:
+            pass  # already closed by the last phase
         await server.aclose()
 
 
