@@ -30,34 +30,69 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _timeit(fn, *args, iters: int, reps: int = 4):
+def _timeit(fn, *args, iters: int, reps: int = 5, target_s: float = 0.4):
     """Per-call seconds for `fn`'s kernel, tunnel-immune.
 
-    On this sandbox the device sits behind a tunnel with ~70-100 ms dispatch
-    RTT and `block_until_ready` returns before execution finishes, so we (a)
-    force a scalar device->host read to synchronize and (b) time the SAME
-    compiled loop at `iters` and at 1 iteration, using the difference to
-    cancel the constant tunnel/dispatch/readback cost.
+    On this sandbox the device sits behind a tunnel with ~70-200 ms dispatch
+    RTT *and tens-of-ms jitter between runs*, so (a) a scalar device->host
+    read forces synchronization, and (b) the SAME compiled loop is timed at
+    two counts and differenced to cancel the constant tunnel/readback cost.
+
+    The difference only means anything when it dwarfs the jitter: the gap
+    between the two loop counts is auto-scaled (from a pilot difference)
+    until the extra device time is >= `target_s`, and the two runs are timed
+    interleaved (hi, lo, hi, lo, ...) so slow drift in tunnel state hits both
+    minima equally.  `iters` seeds the pilot gap; the final count is chosen
+    here.
     """
 
-    def run(n):
+    def compile_n(n):
         c = jax.jit(functools.partial(fn, iters=n)).lower(*args).compile()
-        float(c(*args))  # warmup (compile transfer etc.)
+        float(c(*args))  # warmup (transfer caches, first dispatch)
+        return c
+
+    def time_min(c, n=2):
         best = float("inf")
-        for _ in range(reps):
+        for _ in range(n):
             t0 = time.perf_counter()
             float(c(*args))
             best = min(best, time.perf_counter() - t0)
         return best
 
-    # Difference two LONG runs: a tunnel hiccup in a short baseline run
-    # deflates the subtracted constant and wildly inflates the rate.  With
-    # both runs >> RTT the constant cancels and hiccups only shrink the
-    # reported rate slightly (best-of-reps already dampens them).
-    iters = max(iters, 2)  # the difference needs two distinct loop counts
-    mid = max(iters // 2, 1)
-    t_hi, t_mid = run(iters), run(mid)
-    return max(t_hi - t_mid, 1e-9) / (iters - mid)
+    n_lo = max(iters // 2, 1)
+    c_lo = compile_n(n_lo)
+    t_lo = time_min(c_lo)
+
+    # Grow the gap until the differenced device time clears target_s.  Each
+    # attempt extrapolates a per-iter estimate from the observed difference;
+    # a noise-negative difference just multiplies the gap by 8 and retries.
+    gap = max(iters - n_lo, 1)
+    c_hi = None
+    used_gap = gap  # the gap c_hi was actually compiled with
+    for _ in range(6):
+        used_gap = gap
+        c_hi = compile_n(n_lo + used_gap)
+        t_hi = time_min(c_hi)
+        diff = t_hi - t_lo
+        if diff >= target_s or used_gap >= (1 << 17):
+            break
+        per_iter = diff / used_gap if diff > 0 else 0.0
+        if per_iter > 0:
+            gap = min(max(int(target_s / per_iter * 1.3) + 1, used_gap * 2),
+                      1 << 17)
+        else:
+            gap = min(used_gap * 8, 1 << 17)
+
+    his, los = [], []
+    for _ in range(reps):
+        his.append(time_min(c_hi, n=1))
+        los.append(time_min(c_lo, n=1))
+    dt = (min(his) - min(los)) / used_gap
+    if dt <= 0:  # jitter still won; medians are the robust fallback
+        import statistics
+
+        dt = (statistics.median(his) - statistics.median(los)) / used_gap
+    return max(dt, 1e-9)
 
 
 def _chain(kernel, q, *rest, iters):

@@ -276,21 +276,48 @@ async def run_loopback(args: argparse.Namespace) -> list:
     address blob is wired to the client automatically."""
     addr_fut: asyncio.Future = asyncio.get_running_loop().create_future()
     server_task = asyncio.create_task(run_server(args, addr_fut))
+
+    # A server that dies before (or while) the client is running must fail
+    # the loopback, not hang it: before this guard, an exception raised in
+    # run_server prior to resolving addr_fut (e.g. an ImportError) left the
+    # `await addr_fut` below pending forever.
+    def _server_done(t: asyncio.Task) -> None:
+        if addr_fut.done() or t.cancelled():
+            return
+        exc = t.exception()
+        addr_fut.set_exception(
+            exc if exc is not None
+            else RuntimeError("bench server exited before listening"))
+
+    server_task.add_done_callback(_server_done)
+
+    client_task = None
     try:
         blob = await addr_fut
         if blob is not None:
             args.connect_mode = "worker"
             args.worker_address = blob.hex()
-        results = await run_client(args)
+        client_task = asyncio.create_task(run_client(args))
+        done, _ = await asyncio.wait(
+            {client_task, server_task}, return_when=asyncio.FIRST_COMPLETED)
+        if client_task not in done:
+            server_task.result()  # raises the server's error (it cannot
+            # have exited cleanly: a clean exit follows client completion)
+            raise RuntimeError("bench server exited while the client was running")
+        results = client_task.result()
+        await server_task  # clean shutdown; late server errors still surface
+        return results
     except BaseException:
-        server_task.cancel()
+        for t in (client_task, server_task):
+            if t is not None:
+                t.cancel()
+        for t in (client_task, server_task):
+            if t is not None:
+                try:
+                    await t
+                except BaseException:
+                    pass
         raise
-    finally:
-        try:
-            await server_task
-        except asyncio.CancelledError:
-            pass
-    return results
 
 
 def dump_results(results, args: argparse.Namespace) -> None:
