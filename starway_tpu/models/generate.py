@@ -13,13 +13,14 @@ updates).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .llama import LlamaConfig, apply_rope, rmsnorm, rope_tables
+from .llama import LlamaConfig, apply_rope, forward, rmsnorm, rope_tables
 from ..ops.attention import NEG_BIG, repeat_kv
 
 
@@ -91,7 +92,7 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
 
             y, _ = switch_moe(
                 x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"],
-                capacity_factor=cfg.moe_capacity_factor,
+                capacity_factor=cfg.moe_capacity_factor, k=cfg.moe_top_k,
             )
             h = h + y
         else:
@@ -107,12 +108,101 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
     return logits, {"k": k_new, "v": v_new}
 
 
+def prefill(params: dict, cfg: LlamaConfig, prompt,
+            max_len: Optional[int] = None, attn_fn=None):
+    """One parallel forward pass over the whole prompt -> the decode state.
+
+    Returns ``(last_logits [B, V], cache)`` where the cache holds the
+    post-RoPE grouped k/v of positions ``0..P-1`` (zero-padded to
+    ``max_len``).  This is the flash-attention path over the prompt — one
+    MXU-shaped dispatch instead of P bandwidth-bound cached decode steps,
+    and bit-identical to stepping the prompt through ``decode_step``
+    (pinned by tests/test_generate.py::test_prefill_matches_stepwise).
+    """
+    B, P = prompt.shape
+    if max_len is None:
+        max_len = P
+    elif max_len < P:
+        raise ValueError(f"max_len={max_len} is smaller than the prompt ({P})")
+    logits, _aux, (ks, vs) = forward(
+        params, prompt, cfg, attn_fn, return_aux=True, return_kv=True,
+        last_only=True,
+    )
+    pad = max_len - P
+    if pad:
+        ks = jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
+        vs = jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
+    return logits[:, 0], {"k": ks, "v": vs}
+
+
+def _sample(logits, key, temperature: float, top_k: Optional[int],
+            top_p: Optional[float]):
+    """One sampled token id per row of ``logits [B, V]``.  Static Python
+    ``temperature``/``top_k``/``top_p`` (baked into the compiled step):
+    temperature 0 = greedy; top-k keeps the k largest logits; top-p keeps
+    the smallest prefix of the sorted distribution with cumulative mass
+    >= top_p (the first token is always kept)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    l = logits / temperature
+    if top_k is not None and top_k < l.shape[-1]:
+        kth = lax.top_k(l, top_k)[0][..., -1:]
+        l = jnp.where(l < kth, NEG_BIG, l)
+    if top_p is not None and top_p < 1.0:
+        srt = jnp.sort(l, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p  # exclusive prefix mass; index 0 stays
+        thresh = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True)
+        l = jnp.where(l < thresh, NEG_BIG, l)
+    return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
+
+
+@functools.cache
+def _compiled_generate(cfg: LlamaConfig, B: int, P: int, max_new: int,
+                       max_len: int, temperature: float,
+                       top_k: Optional[int], top_p: Optional[float]):
+    """jit'd prefill + decode scan for one (shape, sampling) signature.
+
+    The whole generation is ONE dispatch: flash prefill, then a
+    ``lax.scan`` of sample->decode steps — no per-token host round trip
+    (the XLA-friendly decode loop; on this sandbox's tunneled device a
+    per-token dispatch costs ~100 ms against a ~30 µs decode step).
+    """
+    rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
+
+    def run(params, prompt, key):
+        logits, cache = prefill(params, cfg, prompt, max_len)
+
+        def step(carry, _):
+            cache, logits, key, pos = carry
+            key, sub = jax.random.split(key)
+            tok = _sample(logits, sub, temperature, top_k, top_p)
+            logits, cache = decode_step(params, cache, tok, pos, cfg, rope)
+            return (cache, logits, key, pos + 1), tok
+
+        # Scan max_new - 1 sample->decode pairs, then sample the final token
+        # outside the scan: its decode_step would compute logits nothing
+        # ever reads.
+        init = (cache, logits, key, jnp.asarray(P, jnp.int32))
+        (cache, logits, key, _), toks = lax.scan(
+            step, init, None, length=max_new - 1)
+        key, sub = jax.random.split(key)
+        last = _sample(logits, sub, temperature, top_k, top_p)
+        toks = jnp.concatenate([toks, last[None]], axis=0)
+        return toks.T  # [B, max_new]
+
+    return jax.jit(run)
+
+
 def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
              *, temperature: float = 0.0, key: Optional[jax.Array] = None,
-             max_len: Optional[int] = None):
+             max_len: Optional[int] = None, top_k: Optional[int] = None,
+             top_p: Optional[float] = None):
     """Autoregressive generation.  prompt: [B, P] int32.  Returns
     [B, P + max_new_tokens].  temperature=0 -> greedy; otherwise softmax
-    sampling with ``key``."""
+    sampling with ``key``, optionally truncated by ``top_k`` and/or nucleus
+    ``top_p``."""
     B, P = prompt.shape
     total = P + max_new_tokens
     if max_len is None:
@@ -123,32 +213,9 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
         raise ValueError(
             f"max_len={max_len} is smaller than prompt + max_new_tokens={total}"
         )
-    if temperature > 0 and key is None:
+    if key is None:
         key = jax.random.PRNGKey(0)
-    cache = init_cache(cfg, B, max_len)
-    rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
-
-    step = jax.jit(
-        lambda p, c, t, pos: decode_step(p, c, t, pos, cfg, rope),
-        donate_argnums=(1,),
-    )
-
-    # Prefill: run the prompt through the cached decode path one position at
-    # a time (single compiled step; prompt lengths are short in the demos).
-    logits = None
-    for i in range(P):
-        logits, cache = step(params, cache, prompt[:, i], i)
-
-    tokens = [prompt]
-    cur = None
-    for i in range(max_new_tokens):
-        if temperature > 0:
-            key, sub = jax.random.split(key)
-            cur = jax.random.categorical(sub, logits / temperature, axis=-1)
-        else:
-            cur = jnp.argmax(logits, axis=-1)
-        cur = cur.astype(jnp.int32)
-        tokens.append(cur[:, None])
-        if i + 1 < max_new_tokens:  # the final token needs no further logits
-            logits, cache = step(params, cache, cur, P + i)
-    return jnp.concatenate(tokens, axis=1)
+    run = _compiled_generate(cfg, B, P, max_new_tokens, max_len,
+                             float(temperature), top_k, top_p)
+    toks = run(params, prompt, key)
+    return jnp.concatenate([prompt, toks], axis=1)

@@ -195,8 +195,18 @@ def default_attn(q, k, v):
 
 def forward(params: dict, tokens, cfg: LlamaConfig,
             attn_fn: Optional[Callable] = None, *, return_aux: bool = False,
-            moe_fn: Optional[Callable] = None):
+            moe_fn: Optional[Callable] = None, return_kv: bool = False,
+            last_only: bool = False):
     """Next-token logits ``[B, S, V]`` for token ids ``[B, S]``.
+
+    ``return_kv`` additionally returns the post-RoPE grouped k/v of every
+    layer, scan-stacked ``[n_layers, B, Hkv, S, Dh]`` -- the KV-cache prefix
+    for :func:`~starway_tpu.models.generate.prefill` (one flash-attention
+    pass over the whole prompt instead of S cached decode steps).
+    ``last_only`` applies the final norm + lm_head to the last position only
+    (``[B, 1, V]``), skipping the ``[B, S, V]`` logit tensor a prefill never
+    reads.  Return value is ``logits``, extended to a tuple
+    ``(logits[, aux][, (k, v)])`` by ``return_aux`` / ``return_kv``.
 
     ``attn_fn(q, k, v) -> out`` takes q ``[B, Hq, S, Dh]`` and *grouped*
     kv ``[B, Hkv, S, Dh]`` (impls expand GQA heads internally); defaults to
@@ -249,15 +259,20 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
         else:
             gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
             h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
-        return (h, aux), None
+        return (h, aux), ((k, v) if return_kv else None)
 
     body = jax.checkpoint(layer) if cfg.remat else layer
-    (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)), params["layers"])
+    (h, aux), kv = lax.scan(body, (h, jnp.zeros((), jnp.float32)), params["layers"])
+    if last_only:
+        h = h[:, -1:]
     h = rmsnorm(h, params["final_norm"], cfg.norm_eps)
     logits = (h @ params["lm_head"]).astype(jnp.float32)
+    out = (logits,)
     if return_aux:
-        return logits, aux
-    return logits
+        out += (aux,)
+    if return_kv:
+        out += (kv,)
+    return out if len(out) > 1 else logits
 
 
 def loss_fn(params: dict, batch, cfg: LlamaConfig,

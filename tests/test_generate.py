@@ -55,6 +55,53 @@ def test_generate_sampling_runs(cfg, params):
     assert out.shape == (1, 6)
 
 
+def test_prefill_matches_stepwise(cfg, params):
+    """One-pass flash prefill == P cached decode steps: same last-position
+    logits, same cache contents."""
+    from starway_tpu.models.generate import prefill
+
+    B, P, max_len = 2, 9, 14
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, cfg.vocab_size, (B, P), dtype=np.int32)
+    )
+    logits_pre, cache_pre = prefill(params, cfg, tokens, max_len)
+
+    cache = init_cache(cfg, B, max_len)
+    rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
+    logits = None
+    for i in range(P):
+        logits, cache = decode_step(params, cache, tokens[:, i], i, cfg, rope)
+
+    np.testing.assert_allclose(np.asarray(logits_pre), np.asarray(logits),
+                               atol=2e-4, rtol=2e-4)
+    for name in ("k", "v"):
+        np.testing.assert_allclose(np.asarray(cache_pre[name]),
+                                   np.asarray(cache[name]),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_generate_topk1_equals_greedy(cfg, params):
+    """top_k=1 sampling collapses to greedy regardless of temperature/key."""
+    prompt = jnp.asarray([[1, 2, 3]], dtype=jnp.int32)
+    greedy = generate(params, cfg, prompt, max_new_tokens=5)
+    k1 = generate(params, cfg, prompt, max_new_tokens=5, temperature=1.3,
+                  top_k=1, key=jax.random.PRNGKey(9))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_generate_top_p(cfg, params):
+    """Nucleus sampling runs and tiny top_p collapses to greedy (the first
+    sorted token is always kept)."""
+    prompt = jnp.asarray([[4, 5]], dtype=jnp.int32)
+    out = generate(params, cfg, prompt, max_new_tokens=4, temperature=0.9,
+                   top_p=0.8, key=jax.random.PRNGKey(2))
+    assert out.shape == (1, 6)
+    greedy = generate(params, cfg, prompt, max_new_tokens=4)
+    tiny = generate(params, cfg, prompt, max_new_tokens=4, temperature=1.0,
+                    top_p=1e-9, key=jax.random.PRNGKey(5))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(tiny))
+
+
 def test_generate_moe():
     cfg = LlamaConfig.preset("debug", n_experts=4)
     params = init_params(jax.random.PRNGKey(2), cfg)
