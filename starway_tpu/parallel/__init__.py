@@ -11,8 +11,11 @@ those patterns are first-class, TPU-native:
 * :mod:`all_to_all` -- sharded KV-cache-style shuffles (BASELINE config 4).
 * :mod:`dp_exchange` -- pytree activation/grad transfer between hosts over
   the async P2P API (BASELINE config 5).
+* :mod:`fsdp` -- ZeRO-style fully-sharded params + optimizer state via
+  GSPMD annotations (all-gather per use, reduce-scatter per grad).
 """
 
+from .fsdp import fsdp_specs, make_fsdp_train_step, shard_tree
 from .sharding import make_mesh, mesh_sharding
 from .ring_attention import (
     make_ring_attention,
@@ -27,6 +30,9 @@ from .dp_exchange import ClientPort, ServerPort, recv_pytree, send_pytree
 __all__ = [
     "make_mesh",
     "mesh_sharding",
+    "fsdp_specs",
+    "make_fsdp_train_step",
+    "shard_tree",
     "ring_attention",
     "make_ring_attention",
     "make_shuffle",
