@@ -16,7 +16,7 @@ from typing import Any, Callable, Optional
 import jax
 
 from ..utils import OpTimer
-from .llama import LlamaConfig, apply_updates, loss_fn
+from .llama import LlamaConfig, apply_updates, loss_fn, make_train_step
 
 
 @dataclasses.dataclass
@@ -30,7 +30,8 @@ class Trainer:
     def __init__(self, cfg: LlamaConfig, tx, params,
                  attn_fn: Optional[Callable] = None,
                  donate: bool = True,
-                 dp_port=None, dp_base_tag: int = 0x6000):
+                 dp_port=None, dp_base_tag: int = 0x6000,
+                 mesh=None, fsdp_axis: Optional[str] = None):
         """``dp_port``: a ClientPort/ServerPort to a peer rank; when set,
         gradients are averaged with the peer every step before the update.
 
@@ -38,6 +39,12 @@ class Trainer:
         rolling window spans ``[dp_base_tag, dp_base_tag + 1024*256)`` —
         1024 in-flight steps x 256 leaves — so any *other* pytree exchange
         sharing this worker must use tags outside that 0x40000-wide range.
+
+        ``mesh`` + ``fsdp_axis``: ZeRO mode — params and optimizer state are
+        sharded 1/N over that mesh axis (parallel/fsdp.py) and ``step_sync``
+        runs the fused sharded train step (batch sharded over the same
+        axis).  Mutually exclusive with ``dp_port``: the P2P gradient
+        exchange assumes host-visible unsharded grads.
         """
         self.cfg = cfg
         self.tx = tx
@@ -45,6 +52,23 @@ class Trainer:
         self.timer = OpTimer()
         self.dp_port = dp_port
         self.dp_base_tag = dp_base_tag
+        self._fsdp_step = None
+        if (mesh is None) != (fsdp_axis is None):
+            raise ValueError("pass mesh and fsdp_axis together")
+        if mesh is not None:
+            if dp_port is not None:
+                raise ValueError("fsdp mode and dp_port are mutually exclusive")
+            from ..parallel.fsdp import (fsdp_specs, make_fsdp_train_step,
+                                         shard_tree)
+
+            pspecs = fsdp_specs(params, mesh, axis=fsdp_axis)
+            ospecs = fsdp_specs(jax.eval_shape(tx.init, params), mesh,
+                                axis=fsdp_axis)
+            self.state.params = shard_tree(self.state.params, mesh, pspecs)
+            self.state.opt_state = shard_tree(self.state.opt_state, mesh, ospecs)
+            self._fsdp_step = make_fsdp_train_step(
+                make_train_step(cfg, tx, attn_fn), mesh, pspecs, ospecs,
+                axis=fsdp_axis, donate=donate)
         if dp_port is not None:
             # step_dp gives each step a 256-tag window (base advances by 256
             # per step); more leaves than that would collide across steps.
@@ -65,6 +89,12 @@ class Trainer:
 
     def step_sync(self, batch) -> float:
         """One local step (no DP exchange)."""
+        if self._fsdp_step is not None:
+            with self.timer.span("fsdp_step"):
+                self.state.params, self.state.opt_state, loss = self._fsdp_step(
+                    self.state.params, self.state.opt_state, batch)
+            self.state.step += 1
+            return float(loss)
         with self.timer.span("grad"):
             loss, grads = self._grad_fn(self.state.params, batch)
         with self.timer.span("apply"):

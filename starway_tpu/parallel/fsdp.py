@@ -106,18 +106,22 @@ def shard_tree(tree, mesh: Mesh, specs):
 
 
 def make_fsdp_train_step(train_step, mesh: Mesh, param_specs, opt_specs,
-                         *, batch_spec: Optional[P] = None):
+                         *, axis: str = "fsdp",
+                         batch_spec: Optional[P] = None,
+                         donate: bool = True):
     """jit ``train_step(params, opt_state, batch)`` with ZeRO shardings.
 
     Params and optimizer state live sharded per ``param_specs``/``opt_specs``
-    and are donated (updated in place in HBM); the batch shards its leading
-    dim over the FSDP axis by default (FSDP is still data parallelism).
-    XLA's SPMD partitioner materialises each layer's weights via all-gather
+    and by default are donated (updated in place in HBM — the caller's input
+    arrays are consumed; pass ``donate=False`` to keep them alive at the
+    cost of a copy).  The batch shards its leading dim over ``axis`` unless
+    ``batch_spec`` overrides it (FSDP is still data parallelism).  XLA's
+    SPMD partitioner materialises each layer's weights via all-gather
     just-in-time inside the scan and reduce-scatters gradients straight
     into the sharded optimizer update.
     """
     if batch_spec is None:
-        batch_spec = P("fsdp")
+        batch_spec = P(axis)
 
     def sh(specs):
         return jax.tree_util.tree_map(
@@ -129,5 +133,5 @@ def make_fsdp_train_step(train_step, mesh: Mesh, param_specs, opt_specs,
         in_shardings=(sh(param_specs), sh(opt_specs),
                       NamedSharding(mesh, batch_spec)),
         out_shardings=(sh(param_specs), sh(opt_specs), None),
-        donate_argnums=(0, 1),
+        donate_argnums=(0, 1) if donate else (),
     )

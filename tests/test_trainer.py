@@ -39,6 +39,32 @@ def test_trainer_local_steps_and_ckpt(tmp_path):
     np.testing.assert_array_equal(np.asarray(a, np.float32), np.asarray(b, np.float32))
 
 
+def test_trainer_fsdp_mode_matches_local():
+    from starway_tpu.parallel import make_mesh
+
+    cfg = LlamaConfig.preset("debug", d_model=64, n_heads=4, n_kv_heads=4,
+                             d_ff=128, vocab_size=256)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh({"fsdp": 4})
+
+    base = Trainer(cfg, optax.adamw(3e-3), params, donate=False)
+    loss_ref = base.step_sync(_batch(cfg))
+
+    t = Trainer(cfg, optax.adamw(3e-3), params, mesh=mesh, fsdp_axis="fsdp")
+    loss = t.step_sync(_batch(cfg))
+    assert t.state.step == 1
+    np.testing.assert_allclose(loss, loss_ref, rtol=1e-5)
+    emb = t.state.params["embed"]
+    assert emb.addressable_shards[0].data.size == emb.size // 4
+    assert "fsdp_step" in t.telemetry()
+
+    with pytest.raises(ValueError):
+        Trainer(cfg, optax.adamw(3e-3), params, mesh=mesh)
+    with pytest.raises(ValueError):
+        Trainer(cfg, optax.adamw(3e-3), params, mesh=mesh, fsdp_axis="fsdp",
+                dp_port=object())
+
+
 async def test_trainer_dp_step_pair():
     from starway_tpu import Client, Server
     from starway_tpu.parallel import ClientPort, ServerPort
