@@ -14,6 +14,13 @@ from .llama import (
     make_train_step,
     param_specs,
 )
+from .pp_llama import (
+    make_pp_llama_train,
+    pp_merge_params,
+    pp_param_specs,
+    pp_split_params,
+    shard_pp_params,
+)
 
 __all__ = [
     "LlamaConfig",
@@ -22,4 +29,9 @@ __all__ = [
     "loss_fn",
     "make_train_step",
     "param_specs",
+    "make_pp_llama_train",
+    "pp_split_params",
+    "pp_merge_params",
+    "pp_param_specs",
+    "shard_pp_params",
 ]

@@ -180,6 +180,20 @@ def apply_rope(x, cos, sin):
     return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1).astype(x.dtype)
 
 
+def head_logits(h, final_norm_w, lm_head_w, eps: float):
+    """Model tail: final RMSNorm + lm_head, f32 logits.  Shared by the scan
+    forward and the pipeline last stage (models/pp_llama.py)."""
+    return (rmsnorm(h, final_norm_w, eps) @ lm_head_w).astype(jnp.float32)
+
+
+def token_ce(logits, targets):
+    """Mean next-token cross-entropy of ``logits [..., V]`` against int ids
+    ``targets [...]`` (same leading shape)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
 def default_attn(q, k, v):
     """Causal attention: the hand-tiled pallas kernel on TPU, the lax
     blockwise scan elsewhere (bit-compatible algebra, same GQA handling)."""
@@ -191,6 +205,48 @@ def default_attn(q, k, v):
 
 
 # ----------------------------------------------------------------- forward
+
+
+def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
+                  attn_fn: Callable, moe_fn: Optional[Callable] = None):
+    """One pre-norm decoder block on ``h [B, S, D]`` with layer params
+    ``lp`` (one slice of the stacked tree).  Returns
+    ``(h, aux, k, v)`` — aux is the MoE balance term (0 for dense), k/v the
+    post-RoPE grouped heads (the KV-cache prefix).  Shared by the scan
+    forward, and the pipeline-parallel stage body (models/pp_llama.py)."""
+    B, S, _ = h.shape
+    hd = cfg.head_dim
+    x = rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
+    q = (x @ lp["wq"]).reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+    k = (x @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    v = (x @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    # kv stays in grouped (narrow) form; attention impls expand it, so
+    # the ring rotates 1/n_rep of the bytes over ICI.
+    o = attn_fn(q, k, v)  # [B, H, S, Dh]
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_heads * hd)
+    h = h + o @ lp["wo"]
+
+    x = rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.n_experts > 0:
+        if moe_fn is not None:
+            y, aux = moe_fn(
+                x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"]
+            )
+        else:
+            from .moe import switch_moe
+
+            y, aux = switch_moe(
+                x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"],
+                capacity_factor=cfg.moe_capacity_factor, k=cfg.moe_top_k,
+            )
+        h = h + y
+    else:
+        gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+        h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
+        aux = jnp.zeros((), jnp.float32)
+    return h, aux, k, v
 
 
 def forward(params: dict, tokens, cfg: LlamaConfig,
@@ -222,51 +278,21 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
     if attn_fn is None:
         attn_fn = default_attn
     B, S = tokens.shape
-    hd = cfg.head_dim
-    cos, sin = rope_tables(S, hd, cfg.rope_theta)
+    cos, sin = rope_tables(S, cfg.head_dim, cfg.rope_theta)
 
     h = params["embed"][tokens]  # [B, S, D]
 
     def layer(carry, lp):
         h, aux = carry
-        x = rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
-        q = (x @ lp["wq"]).reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-        k = (x @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
-        v = (x @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        # kv stays in grouped (narrow) form; attention impls expand it, so
-        # the ring rotates 1/n_rep of the bytes over ICI.
-        o = attn_fn(q, k, v)  # [B, H, S, Dh]
-        o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_heads * hd)
-        h = h + o @ lp["wo"]
-
-        x = rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
-        if cfg.n_experts > 0:
-            if moe_fn is not None:
-                y, layer_aux = moe_fn(
-                    x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"]
-                )
-            else:
-                from .moe import switch_moe
-
-                y, layer_aux = switch_moe(
-                    x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"],
-                    capacity_factor=cfg.moe_capacity_factor, k=cfg.moe_top_k,
-                )
-            h = h + y
-            aux = aux + layer_aux
-        else:
-            gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-            h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
-        return (h, aux), ((k, v) if return_kv else None)
+        h, layer_aux, k, v = decoder_layer(lp, h, cfg, cos, sin, attn_fn,
+                                           moe_fn=moe_fn)
+        return (h, aux + layer_aux), ((k, v) if return_kv else None)
 
     body = jax.checkpoint(layer) if cfg.remat else layer
     (h, aux), kv = lax.scan(body, (h, jnp.zeros((), jnp.float32)), params["layers"])
     if last_only:
         h = h[:, -1:]
-    h = rmsnorm(h, params["final_norm"], cfg.norm_eps)
-    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    logits = head_logits(h, params["final_norm"], params["lm_head"], cfg.norm_eps)
     out = (logits,)
     if return_aux:
         out += (aux,)
@@ -283,9 +309,7 @@ def loss_fn(params: dict, batch, cfg: LlamaConfig,
     tokens, targets = batch[:, :-1], batch[:, 1:]
     logits, aux = forward(params, tokens, cfg, attn_fn, return_aux=True,
                           moe_fn=moe_fn)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = -jnp.mean(ll)
+    loss = token_ce(logits, targets)
     if cfg.n_experts > 0:
         loss = loss + cfg.moe_aux_coef * aux / cfg.n_layers
     return loss

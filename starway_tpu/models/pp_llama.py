@@ -1,0 +1,150 @@
+"""Pipeline-parallel Llama training: embed + staged decoder pipeline + head.
+
+End-to-end 1F1B over the ``pp`` mesh axis with ALL parameters receiving
+gradients: token embedding (outside the pipeline, chained through the
+input-cotangent the schedule emits), n_layers/n_stages decoder blocks per
+stage (parallel/pipeline.py's collective 1F1B), and the head (final norm +
+lm_head, differentiated inside the last stage's loss).  The decoder block
+is the same :func:`~starway_tpu.models.llama.decoder_layer` the scan
+forward uses — one source of truth for the math.
+
+Layout: parameters live PRE-SPLIT in pipeline form (``pp_split_params``):
+
+    {"embed": [V, D],                      # replicated
+     "stages": {name: [n_stages, L/S, ...]},  # leading dim sharded over pp
+     "head": {"final_norm": [D], "lm_head": [D, V]}}  # replicated
+
+so optimizer state shards the same way and no reshuffling happens per step.
+``pp_merge_params`` restores the flat layout (for generation/eval).
+
+Reference hook: the reference's nearest analogue is the streaming-duplex
+"model parallelism" traffic pattern (/root/reference/benchmark.md:91-99);
+the schedule itself is the TPU build's own.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .llama import (LlamaConfig, decoder_layer, default_attn, head_logits,
+                    rope_tables, token_ce)
+from ..parallel.pipeline import make_pipeline_train
+
+
+def pp_split_params(params: dict, n_stages: int) -> dict:
+    """Flat init_params tree -> pipeline layout (see module docstring)."""
+    layers = params["layers"]
+    lead = jax.tree_util.tree_leaves(layers)[0].shape[0]
+    if lead % n_stages:
+        raise ValueError(f"n_layers={lead} not divisible by {n_stages} stages")
+    stages = jax.tree_util.tree_map(
+        lambda a: a.reshape(n_stages, lead // n_stages, *a.shape[1:]), layers)
+    return {
+        "embed": params["embed"],
+        "stages": stages,
+        "head": {"final_norm": params["final_norm"],
+                 "lm_head": params["lm_head"]},
+    }
+
+
+def pp_merge_params(pp_params: dict) -> dict:
+    """Pipeline layout -> flat init_params tree."""
+    stages = pp_params["stages"]
+    lead = jax.tree_util.tree_leaves(stages)[0]
+    n_layers = lead.shape[0] * lead.shape[1]
+    return {
+        "embed": pp_params["embed"],
+        "layers": jax.tree_util.tree_map(
+            lambda a: a.reshape(n_layers, *a.shape[2:]), stages),
+        "final_norm": pp_params["head"]["final_norm"],
+        "lm_head": pp_params["head"]["lm_head"],
+    }
+
+
+def pp_param_specs(axis_name: str = "pp") -> dict:
+    """PartitionSpec tree for the pipeline layout: stages shard their
+    leading (stage) dim over ``axis_name``, embed/head replicate."""
+    return {
+        "embed": P(),
+        "stages": P(axis_name),  # prefix spec: applies to every stage leaf
+        "head": {"final_norm": P(), "lm_head": P()},
+    }
+
+
+def shard_pp_params(pp_params: dict, mesh, axis_name: str = "pp") -> dict:
+    sh = lambda spec: NamedSharding(mesh, spec)
+    return {
+        "embed": jax.device_put(pp_params["embed"], sh(P())),
+        "stages": jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sh(P(axis_name))), pp_params["stages"]),
+        "head": jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sh(P())), pp_params["head"]),
+    }
+
+
+def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
+                        n_micro: int, attn_fn: Optional[Callable] = None):
+    """Build ``step(pp_params, batch) -> (loss, grads)``, jit-compiled.
+
+    ``batch``: [B, S+1] token ids, B divisible by ``n_micro``.  ``grads``
+    has the pipeline layout of ``pp_params`` — feed it straight to optax.
+    Dense models only (MoE routing needs the global token view; use the
+    ep/GSPMD path for expert models).
+    """
+    n_stages = mesh.shape[axis_name]
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
+                         f"{n_stages} pipeline stages")
+    if cfg.n_experts > 0:
+        raise NotImplementedError("pp_llama supports dense models only")
+    attn = attn_fn if attn_fn is not None else default_attn
+
+    def stage_fn(stage_lp, h):
+        # Inside shard_map the stage tree keeps a leading local dim of 1
+        # ([1, L/S, ...]); peel it so the scan runs over this stage's L/S
+        # layers (vjp through the indexing restores the dim on gradients).
+        local = jax.tree_util.tree_map(lambda a: a[0], stage_lp)
+        cos, sin = rope_tables(h.shape[1], cfg.head_dim, cfg.rope_theta)
+
+        def body(hh, lp):
+            hh, _aux, _k, _v = decoder_layer(lp, hh, cfg, cos, sin, attn)
+            return hh, None
+
+        h, _ = lax.scan(body, h, local)
+        return h
+
+    def loss_fn(head, y, target):
+        logits = head_logits(y, head["final_norm"], head["lm_head"],
+                             cfg.norm_eps)
+        return token_ce(logits, target)
+
+    grad_step = make_pipeline_train(mesh, stage_fn, loss_fn, axis_name,
+                                    with_head=True, return_dx=True)
+
+    def step(pp_params, batch):
+        tokens, targets = batch[:, :-1], batch[:, 1:]
+        B, S = tokens.shape
+        if B % n_micro:
+            raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
+        mb = B // n_micro
+        D = pp_params["embed"].shape[1]
+
+        h0 = pp_params["embed"][tokens].reshape(n_micro, mb, S, D)
+        tgt = targets.reshape(n_micro, mb, S)
+        loss, dstages, dhead, dh0 = grad_step(
+            pp_params["stages"], pp_params["head"], h0, tgt)
+
+        # Chain the input cotangent into the embedding table: scatter-add
+        # d h0 over the token ids (B*S rows; reshape orders match h0's).
+        dembed = jnp.zeros(pp_params["embed"].shape, jnp.float32).at[
+            tokens.reshape(-1)].add(dh0.reshape(-1, D))
+
+        grads = {"embed": dembed, "stages": dstages, "head": dhead}
+        return loss, grads
+
+    return jax.jit(step)

@@ -34,9 +34,10 @@ the stage forward inside ``jax.vjp`` (activation-checkpoint trade).
 Design constraint (standard for collective SPMD pipelines): stages are
 homogeneous — every stage maps activations ``[mb, ...] -> [mb, ...]`` of
 one shape/dtype.  Token embedding runs outside the pipeline (inject
-embedded activations); the last-stage loss is parameter-free w.r.t. the
-pipeline (head params can be closed over but do not receive pipeline
-gradients in v1).
+embedded activations and chain its gradient through ``return_dx``); the
+head is differentiated inside the last stage's loss when ``head_params``
+is supplied (``with_head=True``) — models/pp_llama.py wires both for the
+Llama family.
 """
 
 from __future__ import annotations
@@ -138,16 +139,27 @@ def make_pipeline(mesh, stage_fn: Callable, axis_name: str = "pp"):
 
 
 def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
-                         inputs, targets, axis_name: str):
+                         inputs, targets, axis_name: str, head_params=None,
+                         return_dx: bool = False):
     """Per-device 1F1B body (call inside shard_map).
 
     ``inputs``: [M, mb, ...] activation microbatches (replicated; stage 0
     injects them).  ``targets``: [M, ...] per-microbatch targets consumed by
-    ``loss_fn(y, target) -> scalar`` at the last stage (mean over the M
-    microbatches is returned).  Returns ``(loss, dparams)`` where
-    ``dparams`` is THIS stage's parameter gradient (f32) — exactly the
-    sharded gradient the optimizer wants; only the scalar loss crosses
-    devices (psum), never activations-sized tensors.
+    the last stage's loss — ``loss_fn(y, target)``, or, with
+    ``head_params`` given, ``loss_fn(head_params, y, target)`` so the model
+    head (final norm / lm_head / ...) is differentiated too.  Returns
+    ``(loss, dparams[, dhead][, dinputs])``:
+
+    * ``dparams`` — THIS stage's parameter gradient (f32), exactly the
+      sharded gradient the optimizer wants;
+    * ``dhead`` (iff ``head_params``) — head gradient, psum-replicated;
+    * ``dinputs`` (iff ``return_dx``) — [M, mb, ...] cotangent of
+      ``inputs`` (stage 0's backward output, psum-replicated), which the
+      caller chains into whatever produced the activations (embedding).
+
+    Scalar loss aside, the psums of the optional outputs are the only
+    collectives beyond the activation/cotangent hops, and both are
+    gradient-sized, not per-tick.
     """
     n = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -164,7 +176,7 @@ def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
             lambda a: jnp.zeros(a.shape, jnp.float32), tree)
 
     def tick(carry, t):
-        fwd_in, bwd_in, stash, dparams, loss_acc = carry
+        fwd_in, bwd_in, stash, dparams, dhead, dx_buf, loss_acc = carry
 
         # ---- F slot: microbatch i = t - stage ----
         i = t - stage
@@ -190,54 +202,85 @@ def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
         def last_branch(_):
             # Backprop through loss o stage in one vjp; at the last stage
             # j == i, so x_saved is the activation stashed THIS tick.
-            def h(p, x):
-                return loss_fn(stage_fn(p, x), target)
+            if head_params is None:
+                def h(p, x):
+                    return loss_fn(stage_fn(p, x), target)
 
-            loss_j, grads = jax.value_and_grad(h, argnums=(0, 1))(
-                stage_params, x_saved)
-            dp, dx = grads
-            return (f32_tree(dp), dx.astype(jnp.float32),
+                loss_j, (dp, dx) = jax.value_and_grad(h, argnums=(0, 1))(
+                    stage_params, x_saved)
+                dh = dhead  # zeros-shaped placeholder, unused
+            else:
+                def h(p, x, hp):
+                    return loss_fn(hp, stage_fn(p, x), target)
+
+                loss_j, (dp, dx, dh) = jax.value_and_grad(
+                    h, argnums=(0, 1, 2))(stage_params, x_saved, head_params)
+                dh = f32_tree(dh)
+            return (f32_tree(dp), dx.astype(jnp.float32), dh,
                     jnp.asarray(loss_j, jnp.float32))
 
         def mid_branch(_):
             _, vjp_fn = jax.vjp(lambda p, x: stage_fn(p, x), stage_params,
                                 x_saved)
             dp, dx = vjp_fn(bwd_in.astype(y.dtype))
-            return f32_tree(dp), dx.astype(jnp.float32), jnp.float32(0)
+            return (f32_tree(dp), dx.astype(jnp.float32),
+                    f32_zeros_like(head_params), jnp.float32(0))
 
         def f32_tree(tree):
             return jax.tree_util.tree_map(
                 lambda a: a.astype(jnp.float32), tree)
 
-        dp, dx, loss_j = lax.cond(stage == n - 1, last_branch, mid_branch,
-                                  None)
+        dp, dx, dh, loss_j = lax.cond(stage == n - 1, last_branch, mid_branch,
+                                      None)
         mask = b_valid.astype(jnp.float32)
         dparams = jax.tree_util.tree_map(
             lambda acc, g: acc + mask * g, dparams, dp)
         loss_acc = loss_acc + mask * loss_j
+        if head_params is not None:
+            dhead = jax.tree_util.tree_map(
+                lambda acc, g: acc + mask * g, dhead, dh)
+        if return_dx:
+            # Stage 0's backward output is d(inputs[j]); other stages (and
+            # invalid ticks) write zeros, which never clobber a real value:
+            # stage 0's invalid ticks all precede its j=0 backward.
+            dx_local = jnp.where(stage == 0, dx * mask, jnp.zeros_like(dx))
+            dx_buf = lax.dynamic_update_index_in_dim(dx_buf, dx_local, jc,
+                                                     axis=0)
         bwd_out = lax.ppermute(dx * mask, axis_name, bwd_perm)
 
-        return (fwd_out, bwd_out, stash, dparams, loss_acc), None
+        return (fwd_out, bwd_out, stash, dparams, dhead, dx_buf, loss_acc), None
 
     init = (
         jnp.zeros(mb_shape, inputs.dtype),
         jnp.zeros(mb_shape, jnp.float32),
         jnp.zeros((depth + 1,) + mb_shape, inputs.dtype),
         f32_zeros_like(stage_params),
+        f32_zeros_like(head_params),
+        jnp.zeros((m,) + mb_shape, jnp.float32) if return_dx
+        else jnp.zeros((), jnp.float32),
         jnp.float32(0),
     )
-    (_, _, _, dparams, loss_acc), _ = lax.scan(tick, init, jnp.arange(ticks))
+    (_, _, _, dparams, dhead, dx_buf, loss_acc), _ = lax.scan(
+        tick, init, jnp.arange(ticks))
     # Only the last stage saw losses; the scalar psum is the single
-    # cross-stage collective outside the activation/cotangent hops.
+    # per-step cross-stage collective beyond the hops and optional outputs.
     loss = lax.psum(loss_acc, axis_name) / m
     # Cotangents were seeded per-microbatch with scale 1, so the stash is a
     # sum over microbatches; the returned gradient must match the MEAN loss.
     dparams = jax.tree_util.tree_map(lambda g: g / m, dparams)
-    return loss, dparams
+    out = (loss, dparams)
+    if head_params is not None:
+        dhead = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, axis_name) / m, dhead)
+        out += (dhead,)
+    if return_dx:
+        out += (lax.psum(dx_buf, axis_name) / m,)
+    return out
 
 
 def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
-                        axis_name: str = "pp"):
+                        axis_name: str = "pp", *, with_head: bool = False,
+                        return_dx: bool = False):
     """Jitted global-view 1F1B training step builder.
 
     Returns ``grad_step(stage_params, inputs, targets) -> (loss, grads)``
@@ -245,14 +288,31 @@ def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
     ``axis_name`` and ``inputs [M, mb, ...]``/``targets [M, ...]``
     replicated.  Feed ``grads`` straight to an optax update — they are
     already laid out like the params.
+
+    ``with_head``: the step takes an extra ``head_params`` pytree consumed
+    by ``loss_fn(head_params, y, target)`` and additionally returns its
+    (replicated) gradient.  ``return_dx``: additionally return the
+    [M, mb, ...] cotangent of ``inputs`` — chain it into the embedding (or
+    whatever produced the activations).  Extras are appended to the result
+    in that order.
     """
 
-    def local(stage_params, inputs, targets):
-        return pipeline_train_apply(stage_fn, loss_fn, stage_params, inputs,
-                                    targets, axis_name)
+    if with_head:
+        def local(stage_params, head_params, inputs, targets):
+            return pipeline_train_apply(
+                stage_fn, loss_fn, stage_params, inputs, targets, axis_name,
+                head_params=head_params, return_dx=return_dx)
 
-    return jax.jit(shard_map_fn(
-        mesh, local,
-        in_specs=(P(axis_name), P(), P()),
-        out_specs=(P(), P(axis_name)),
-    ))
+        in_specs = (P(axis_name), P(), P(), P())
+        out_specs = (P(), P(axis_name), P()) + ((P(),) if return_dx else ())
+    else:
+        def local(stage_params, inputs, targets):
+            return pipeline_train_apply(
+                stage_fn, loss_fn, stage_params, inputs, targets, axis_name,
+                return_dx=return_dx)
+
+        in_specs = (P(axis_name), P(), P())
+        out_specs = (P(), P(axis_name)) + ((P(),) if return_dx else ())
+
+    return jax.jit(shard_map_fn(mesh, local, in_specs=in_specs,
+                                out_specs=out_specs))
