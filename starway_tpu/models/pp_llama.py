@@ -29,7 +29,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from .llama import (LlamaConfig, decoder_layer, default_attn, head_logits,
                     rope_tables, token_ce)
@@ -66,25 +66,23 @@ def pp_merge_params(pp_params: dict) -> dict:
     }
 
 
-def pp_param_specs(axis_name: str = "pp") -> dict:
-    """PartitionSpec tree for the pipeline layout: stages shard their
-    leading (stage) dim over ``axis_name``, embed/head replicate."""
+def pp_param_specs(pp_params: dict, axis_name: str = "pp") -> dict:
+    """Per-leaf PartitionSpec tree for the pipeline layout (same shape as
+    ``pp_params``, consumable by :func:`~starway_tpu.parallel.shard_tree`):
+    stage leaves shard their leading (stage) dim over ``axis_name``,
+    embed/head replicate."""
     return {
         "embed": P(),
-        "stages": P(axis_name),  # prefix spec: applies to every stage leaf
-        "head": {"final_norm": P(), "lm_head": P()},
+        "stages": jax.tree_util.tree_map(lambda _a: P(axis_name),
+                                         pp_params["stages"]),
+        "head": jax.tree_util.tree_map(lambda _a: P(), pp_params["head"]),
     }
 
 
 def shard_pp_params(pp_params: dict, mesh, axis_name: str = "pp") -> dict:
-    sh = lambda spec: NamedSharding(mesh, spec)
-    return {
-        "embed": jax.device_put(pp_params["embed"], sh(P())),
-        "stages": jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, sh(P(axis_name))), pp_params["stages"]),
-        "head": jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, sh(P())), pp_params["head"]),
-    }
+    from ..parallel.fsdp import shard_tree
+
+    return shard_tree(pp_params, mesh, pp_param_specs(pp_params, axis_name))
 
 
 def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",

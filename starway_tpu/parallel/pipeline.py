@@ -153,13 +153,15 @@ def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
     * ``dparams`` — THIS stage's parameter gradient (f32), exactly the
       sharded gradient the optimizer wants;
     * ``dhead`` (iff ``head_params``) — head gradient, psum-replicated;
-    * ``dinputs`` (iff ``return_dx``) — [M, mb, ...] cotangent of
-      ``inputs`` (stage 0's backward output, psum-replicated), which the
-      caller chains into whatever produced the activations (embedding).
+    * ``dinputs`` (iff ``return_dx``) — [1, M, mb, ...] cotangent of
+      ``inputs``, valid on stage 0 ONLY (zeros elsewhere): emit it with
+      ``out_specs P(axis)`` and read the first shard, like
+      ``pipeline_apply``'s last-stage outputs — no activation-sized
+      collective.  The caller chains it into whatever produced the
+      activations (embedding).
 
-    Scalar loss aside, the psums of the optional outputs are the only
-    collectives beyond the activation/cotangent hops, and both are
-    gradient-sized, not per-tick.
+    Scalar loss aside, the head-grad psum is the only collective beyond
+    the activation/cotangent hops, and it is gradient-sized, not per-tick.
     """
     n = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -274,7 +276,7 @@ def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
             lambda g: lax.psum(g, axis_name) / m, dhead)
         out += (dhead,)
     if return_dx:
-        out += (lax.psum(dx_buf, axis_name) / m,)
+        out += (dx_buf[None] / m,)  # [1, M, mb, ...]: this stage's shard
     return out
 
 
@@ -293,8 +295,9 @@ def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
     by ``loss_fn(head_params, y, target)`` and additionally returns its
     (replicated) gradient.  ``return_dx``: additionally return the
     [M, mb, ...] cotangent of ``inputs`` — chain it into the embedding (or
-    whatever produced the activations).  Extras are appended to the result
-    in that order.
+    whatever produced the activations); it is emitted from stage 0's shard
+    only (sharded out_spec + index, no activation-sized collective).
+    Extras are appended to the result in that order.
     """
 
     if with_head:
@@ -304,7 +307,7 @@ def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
                 head_params=head_params, return_dx=return_dx)
 
         in_specs = (P(axis_name), P(), P(), P())
-        out_specs = (P(), P(axis_name), P()) + ((P(),) if return_dx else ())
+        out_specs = (P(), P(axis_name), P()) + ((P(axis_name),) if return_dx else ())
     else:
         def local(stage_params, inputs, targets):
             return pipeline_train_apply(
@@ -312,7 +315,14 @@ def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
                 return_dx=return_dx)
 
         in_specs = (P(axis_name), P(), P())
-        out_specs = (P(), P(axis_name)) + ((P(),) if return_dx else ())
+        out_specs = (P(), P(axis_name)) + ((P(axis_name),) if return_dx else ())
 
-    return jax.jit(shard_map_fn(mesh, local, in_specs=in_specs,
-                                out_specs=out_specs))
+    staged = shard_map_fn(mesh, local, in_specs=in_specs, out_specs=out_specs)
+    if not return_dx:
+        return jax.jit(staged)
+
+    def run(*args):
+        out = staged(*args)
+        return out[:-1] + (out[-1][0],)  # dinputs lives on stage 0's shard
+
+    return jax.jit(run)

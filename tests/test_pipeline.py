@@ -144,8 +144,9 @@ def test_pp_llama_grads_match_single_device():
     for a, b in zip(jax.tree_util.tree_leaves(merged),
                     jax.tree_util.tree_leaves(params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
-    specs = pp_param_specs()
-    assert tuple(specs["stages"]) == ("pp",)
+    specs = pp_param_specs(pp_split_params(params, 2))
+    assert tuple(specs["stages"]["wq"]) == ("pp",)
+    assert tuple(specs["embed"]) == ()
 
 
 def test_schedule_formulas():
