@@ -24,19 +24,22 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=8)
     ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--platform", choices=("cpu", "auto"), default="cpu",
+                    help="cpu (default): virtual host mesh, runs anywhere; "
+                         "auto: whatever backend jax picks (real chips)")
     args = ap.parse_args()
 
-    # Virtual device mesh when real devices are missing (must precede the
-    # first jax backend use; see tests/conftest.py for the same dance).
+    # Virtual device mesh when demoing on CPU (must precede the first jax
+    # backend use; see tests/conftest.py for the same dance).
     flags = os.environ.get("XLA_FLAGS", "")
-    if "host_platform_device_count" not in flags:
+    if args.platform == "cpu" and "host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count={args.devices}".strip())
     import jax
 
-    # Honor JAX_PLATFORMS=cpu even when an interpreter hook pre-selected a
-    # device backend (env alone is too late once jax is in sys.modules).
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
+    if args.platform == "cpu":
+        # Unconditional: an interpreter hook may have pre-selected a device
+        # backend, and the env var alone is too late once jax is imported.
         jax.config.update("jax_platforms", "cpu")
 
     if len(jax.devices()) < args.devices:

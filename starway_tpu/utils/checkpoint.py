@@ -99,7 +99,10 @@ def restore_pytree(path: str, like: Any) -> Any:
 
     Validates leaf count and shapes against the manifest; dtypes are cast
     to the ``like`` tree's dtypes (the documented way to restore e.g. a
-    bf16 training checkpoint into f32 eval params).
+    bf16 training checkpoint into f32 eval params).  Leaves whose ``like``
+    counterpart is a sharded ``jax.Array`` are placed onto that sharding —
+    a ZeRO/GSPMD training state resumes 1/N per device, not replicated on
+    the default device.
     """
     import jax
 
@@ -124,13 +127,8 @@ def restore_pytree(path: str, like: Any) -> Any:
         ckptr = ocp.PyTreeCheckpointer()
         target = p / "tree" if (p / "tree").exists() else p
         out = ckptr.restore(target.absolute(), item=like)
-        # Orbax returns the checkpoint's saved dtypes; cast to the ``like``
-        # tree's dtypes so both backends honour the documented contract.
-        return jax.tree_util.tree_map(
-            lambda x, l: x.astype(l.dtype) if hasattr(l, "dtype") else x,
-            out, like)
+        return jax.tree_util.tree_map(_placed_like, out, like)
     import numpy as np
-    import jax.numpy as jnp
 
     data = np.load(p / "leaves.npz")
     if len(data.files) != len(leaves):
@@ -138,8 +136,25 @@ def restore_pytree(path: str, like: Any) -> Any:
             f"checkpoint {p}: holds {len(data.files)} leaves, "
             f"'like' tree has {len(leaves)}"
         )
-    restored = [
-        jnp.asarray(data[str(i)]).astype(leaf.dtype)
-        for i, leaf in enumerate(leaves)
-    ]
+    restored = [_placed_like(data[str(i)], leaf) for i, leaf in enumerate(leaves)]
     return jax.tree_util.tree_unflatten(treedef, restored)
+
+
+def _placed_like(x, like_leaf):
+    """Cast to ``like_leaf``'s dtype and, when it is a sharded jax.Array,
+    place the restored value onto the same sharding (both backends honour
+    the documented dtype contract; orbax returns saved dtypes, npz returns
+    host arrays).  The cast happens on the HOST so a sharded leaf never
+    transits the default device whole — restoring a ZeRO state whose full
+    size exceeds one device's HBM must not allocate full-size scratch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if not hasattr(like_leaf, "dtype"):
+        return x
+    sharding = getattr(like_leaf, "sharding", None)
+    if sharding is not None:
+        host = np.asarray(x).astype(like_leaf.dtype)
+        return jax.device_put(host, sharding)
+    return jnp.asarray(x).astype(like_leaf.dtype)

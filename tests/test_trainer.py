@@ -65,6 +65,32 @@ def test_trainer_fsdp_mode_matches_local():
                 dp_port=object())
 
 
+def test_trainer_fsdp_ckpt_roundtrip(tmp_path):
+    """ZeRO training state round-trips through save/restore with its
+    sharding intact (restore places leaves onto the `like` shardings)."""
+    from starway_tpu.parallel import make_mesh
+
+    cfg = LlamaConfig.preset("debug", d_model=64, n_heads=4, n_kv_heads=4,
+                             d_ff=128, vocab_size=256)
+    mesh = make_mesh({"fsdp": 4})
+    t = Trainer(cfg, optax.adamw(3e-3), init_params(jax.random.PRNGKey(0), cfg),
+                mesh=mesh, fsdp_axis="fsdp")
+    t.step_sync(_batch(cfg))
+    t.save(str(tmp_path / "ck"))
+
+    t2 = Trainer(cfg, optax.adamw(3e-3), init_params(jax.random.PRNGKey(1), cfg),
+                 mesh=mesh, fsdp_axis="fsdp")
+    t2.restore(str(tmp_path / "ck"))
+    assert t2.state.step == 1
+    emb = t2.state.params["embed"]
+    assert "fsdp" in tuple(emb.sharding.spec)
+    assert emb.addressable_shards[0].data.size == emb.size // 4
+    for a, b in zip(jax.tree_util.tree_leaves(t.state.params),
+                    jax.tree_util.tree_leaves(t2.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.isfinite(t2.step_sync(_batch(cfg)))
+
+
 async def test_trainer_dp_step_pair():
     from starway_tpu import Client, Server
     from starway_tpu.parallel import ClientPort, ServerPort
