@@ -301,11 +301,13 @@ async def run_loopback(args: argparse.Namespace) -> list:
         done, _ = await asyncio.wait(
             {client_task, server_task}, return_when=asyncio.FIRST_COMPLETED)
         if client_task not in done:
-            server_task.result()  # raises the server's error (it cannot
-            # have exited cleanly: a clean exit follows client completion)
-            raise RuntimeError("bench server exited while the client was running")
-        results = client_task.result()
-        await server_task  # clean shutdown; late server errors still surface
+            # Surface a server FAILURE immediately (otherwise the client
+            # would hang on a dead peer).  A clean server exit is normal
+            # here: it means the client's __shutdown__ was processed and
+            # the client is wrapping up -- keep waiting for its results.
+            server_task.result()
+        results = await client_task
+        await server_task  # late server errors still surface
         return results
     except BaseException:
         for t in (client_task, server_task):
