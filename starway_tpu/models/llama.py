@@ -327,13 +327,53 @@ def apply_updates(tx, params, opt_state, grads):
 
 
 def make_train_step(cfg: LlamaConfig, tx, attn_fn: Optional[Callable] = None,
-                    moe_fn: Optional[Callable] = None):
+                    moe_fn: Optional[Callable] = None, *,
+                    accum_steps: int = 1):
     """One optimizer step, jit-ready (donate params+opt_state for in-place
-    HBM updates)."""
+    HBM updates).
+
+    ``accum_steps > 1`` splits the batch into that many equal microbatches
+    and accumulates gradients in f32 across a ``lax.scan`` before the
+    single optimizer update — activation memory scales with the microbatch
+    while the math matches the full-batch step for dense models
+    (equal-size chunks make the mean of means the global mean; pinned by
+    tests/test_model.py).  MoE models still train correctly but are not
+    bit-identical to the full-batch step: expert capacity is computed per
+    microbatch, so routing overflow can differ.
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     def train_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            params, batch, cfg, attn_fn, moe_fn)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, batch, cfg, attn_fn, moe_fn)
+        else:
+            B = batch.shape[0]
+            if B % accum_steps:
+                raise ValueError(
+                    f"batch {B} not divisible by accum_steps={accum_steps}")
+            chunks = batch.reshape(accum_steps, B // accum_steps,
+                                   *batch.shape[1:])
+
+            def acc(carry, chunk):
+                loss_sum, gacc = carry
+                l, g = jax.value_and_grad(loss_fn)(
+                    params, chunk, cfg, attn_fn, moe_fn)
+                gacc = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(jnp.float32), gacc, g)
+                return (loss_sum + l, gacc), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (loss_sum, grads), _ = lax.scan(
+                acc, (jnp.float32(0), zeros), chunks)
+            loss = loss_sum / accum_steps
+            # Back to param dtype: the optimizer must see the same grad
+            # dtype as the accum_steps=1 path, else bf16 adamw moments get
+            # promoted to f32 on step 1 (donation breaks + a recompile).
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / accum_steps).astype(p.dtype), grads, params)
         params, opt_state = apply_updates(tx, params, opt_state, grads)
         return params, opt_state, loss
 

@@ -1,5 +1,6 @@
-"""Utilities: tracing/telemetry helpers."""
+"""Utilities: tracing/telemetry helpers, checkpointing, data batching."""
 
+from .data import TokenBatcher, load_tokens
 from .trace import OpTimer, trace_span, profile_to
 
-__all__ = ["OpTimer", "trace_span", "profile_to"]
+__all__ = ["OpTimer", "trace_span", "profile_to", "TokenBatcher", "load_tokens"]
