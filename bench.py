@@ -126,6 +126,13 @@ async def _pingpong(devices) -> tuple[list[float], list[float]]:
 def main() -> None:
     import jax
 
+    cpu_fallback = os.environ.get("STARWAY_BENCH_CPU") == "1"
+    if cpu_fallback:
+        # The device backend was unresponsive (watchdog timed out); measure
+        # on the CPU backend instead.  vs_baseline stays meaningful: it is
+        # the framework-vs-raw ratio on the SAME devices either way.
+        jax.config.update("jax_platforms", "cpu")
+
     devices = jax.devices()
     fw, raw = asyncio.run(_pingpong(devices))
 
@@ -141,7 +148,8 @@ def main() -> None:
                 "metric": "1MiB jax.Array pingpong bandwidth via asend/arecv "
                 f"({'device-to-device' if len(devices) >= 2 else 'host-to-device'}, "
                 f"{len(devices)} dev, p50 of {len(fw)} interleaved iters; "
-                f"raw={raw_gbps:.2f}GB/s p50_rtt={fw_p50 * 1e6:.0f}us)",
+                f"raw={raw_gbps:.2f}GB/s p50_rtt={fw_p50 * 1e6:.0f}us"
+                f"{'; CPU FALLBACK: device backend unresponsive' if cpu_fallback else ''})",
                 "value": round(fw_gbps, 3),
                 "unit": "GB/s",
                 "vs_baseline": round(vs_baseline, 3),
@@ -174,29 +182,43 @@ def main_watchdog() -> None:
     import subprocess
 
     env = dict(os.environ, STARWAY_BENCH_CHILD="1")
-    try:
-        out = subprocess.run([sys.executable, __file__], env=env,
-                             capture_output=True, text=True, timeout=480)
-        sys.stdout.write(out.stdout)
-        sys.stderr.write(out.stderr)
-        raise SystemExit(out.returncode)
-    except subprocess.TimeoutExpired as exc:
-        # A child that printed its result and then wedged in teardown still
-        # measured successfully: forward the line instead of a failure row.
-        partial = (exc.stdout or b"")
-        if isinstance(partial, bytes):
-            partial = partial.decode(errors="replace")
-        for line in partial.splitlines():
-            if line.startswith("{") and '"metric"' in line:
-                print(line)
-                return
-        print(json.dumps({
-            "metric": "1MiB jax.Array pingpong bandwidth via asend/arecv "
-                      "(FAILED: device backend unresponsive for 480s)",
-            "value": 0.0,
-            "unit": "GB/s",
-            "vs_baseline": 0.0,
-        }))
+
+    def attempt(extra_env: dict, timeout: int):
+        try:
+            out = subprocess.run([sys.executable, __file__],
+                                 env=dict(env, **extra_env),
+                                 capture_output=True, text=True,
+                                 timeout=timeout)
+            sys.stdout.write(out.stdout)
+            sys.stderr.write(out.stderr)
+            return out.returncode
+        except subprocess.TimeoutExpired as exc:
+            # A child that printed its result and then wedged in teardown
+            # still measured successfully: forward the line.
+            partial = (exc.stdout or b"")
+            if isinstance(partial, bytes):
+                partial = partial.decode(errors="replace")
+            for line in partial.splitlines():
+                if line.startswith("{") and '"metric"' in line:
+                    print(line)
+                    return 0
+            return None  # timed out without a result
+
+    rc = attempt({}, 480)
+    if rc is not None:
+        raise SystemExit(rc)
+    # Device backend unresponsive: one retry on the CPU backend, which
+    # keeps the framework-vs-raw ratio measurable and says so in the row.
+    rc = attempt({"STARWAY_BENCH_CPU": "1"}, 240)
+    if rc is not None:
+        raise SystemExit(rc)
+    print(json.dumps({
+        "metric": "1MiB jax.Array pingpong bandwidth via asend/arecv "
+                  "(FAILED: device AND cpu backends unresponsive)",
+        "value": 0.0,
+        "unit": "GB/s",
+        "vs_baseline": 0.0,
+    }))
 
 
 if __name__ == "__main__":

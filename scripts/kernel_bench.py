@@ -207,17 +207,24 @@ def bench_flash_bwd(b=1, hq=8, hkv=2, s=8192, d=128, causal=True, iters: int = 4
                       f"bf16, {dt*1e3:.2f} ms/iter (fwd+bwd)"}
 
 
+def _decode_inputs(b, hq, hkv, t, d):
+    """Shared decode-bench workload: bf16 single query + grouped cache at
+    full position, plus the grouped-cache byte count (k + v)."""
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, hq, 1, d), jnp.bfloat16)
+    kc = jax.random.normal(kk, (b, hkv, t, d), jnp.bfloat16)
+    vc = jax.random.normal(kv, (b, hkv, t, d), jnp.bfloat16)
+    pos = jnp.asarray(t - 1, jnp.int32)
+    return q, kc, vc, pos, 2 * b * hkv * t * d * 2
+
+
 def bench_decode(b=1, hq=8, hkv=2, t=8192, d=128, iters: int = 64, impl="ours"):
     """Cached single-token decode attention: us/token + effective HBM GB/s
     (decode is bandwidth-bound: the kernel's job is streaming the grouped
     cache exactly once)."""
     from starway_tpu.models.generate import _attend_cached
 
-    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
-    q = jax.random.normal(kq, (b, hq, 1, d), jnp.bfloat16)
-    kc = jax.random.normal(kk, (b, hkv, t, d), jnp.bfloat16)
-    vc = jax.random.normal(kv, (b, hkv, t, d), jnp.bfloat16)
-    pos = jnp.asarray(t - 1, jnp.int32)
+    q, kc, vc, pos, cache_bytes = _decode_inputs(b, hq, hkv, t, d)
 
     use_pallas = impl == "ours"
 
@@ -226,7 +233,6 @@ def bench_decode(b=1, hq=8, hkv=2, t=8192, d=128, iters: int = 64, impl="ours"):
 
     dt = _timeit(lambda q, kc, vc, iters: _chain(kern, q, kc, vc, iters=iters),
                  q, kc, vc, iters=iters)
-    cache_bytes = 2 * b * hkv * t * d * 2  # k + v, bf16
     return {"metric": f"decode_{impl}_us_per_token", "value": round(dt * 1e6, 2),
             "unit": "us",
             "detail": f"B={b} Hq={hq} Hkv={hkv} T={t} D={d} bf16, grouped "
@@ -337,6 +343,40 @@ def check_numerics():
     return rows
 
 
+def bench_decode_tune(b=1, hq=8, hkv=2, t=8192, d=128, iters: int = 64):
+    """Sweep the decode kernel's block_k on-chip; emits one row per block
+    size plus a summary row with the winner.  The r2 re-measurement showed
+    the 128 default losing to the lax path (BASELINE.md) — per-grid-cell
+    overhead dominates at 64 cells of 32 KB; bigger blocks stream the same
+    cache in fewer, larger DMAs."""
+    from starway_tpu.ops.pallas_decode import decode_attention
+
+    q, kc, vc, pos, cache_bytes = _decode_inputs(b, hq, hkv, t, d)
+
+    candidates = [bk for bk in (128, 256, 512, 1024, 2048) if bk <= t]
+    if not candidates:
+        raise ValueError(f"t={t} is smaller than every candidate block size")
+    best = None
+    for bk in candidates:
+        kern = functools.partial(decode_attention, block_k=bk)
+
+        def run(q, kc, vc, iters, _kern=kern):
+            return _chain(lambda q, kc, vc: _kern(q, kc, vc, pos),
+                          q, kc, vc, iters=iters)
+
+        dt = _timeit(run, q, kc, vc, iters=iters)
+        print(json.dumps(
+            {"metric": f"decode_block{bk}_us", "value": round(dt * 1e6, 2),
+             "unit": "us",
+             "detail": f"{cache_bytes / dt / 1e9:.0f} GB/s effective"}),
+            flush=True)
+        if best is None or dt < best[1]:
+            best = (bk, dt)
+    return {"metric": "decode_best_block", "value": best[0], "unit": "block_k",
+            "detail": f"{best[1] * 1e6:.2f} us at block_k={best[0]} "
+                      f"({cache_bytes / best[1] / 1e9:.0f} GB/s)"}
+
+
 BENCHES = {
     "matmul": bench_matmul,
     "flash": bench_flash_fwd,
@@ -345,6 +385,7 @@ BENCHES = {
     "flash_bwd_stock": functools.partial(bench_flash_bwd, impl="stock"),
     "decode": bench_decode,
     "decode_lax": functools.partial(bench_decode, impl="lax"),
+    "decode_tune": bench_decode_tune,
     "train_mfu": bench_train_mfu,
 }
 
@@ -362,7 +403,10 @@ def main():
             ok = ok and row["ok"]
             print(json.dumps(row), flush=True)
         raise SystemExit(0 if ok else 1)
-    names = list(BENCHES) if args.which == "all" else args.which.split(",")
+    if args.which == "all":  # tune sweeps are opt-in, not part of the suite
+        names = [n for n in BENCHES if not n.endswith("_tune")]
+    else:
+        names = args.which.split(",")
     exit_code = 0
     for name in names:
         if name == "check":

@@ -71,6 +71,7 @@ class TokenBatcher:
                 f"{batch_size}")
         self._epoch = 0
         self._batch = 0
+        self._active = False
 
     # ------------------------------------------------------------ resume
     def state(self) -> dict:
@@ -96,16 +97,39 @@ class TokenBatcher:
         rng = np.random.default_rng((self.seed, epoch))
         return rng.permutation(self.n_windows)
 
+    def reset(self) -> None:
+        """Rewind to epoch 0 (re-iterating an epochs-bounded batcher)."""
+        self._epoch = 0
+        self._batch = 0
+
     def __iter__(self) -> Iterator[np.ndarray]:
-        w = self.seq_len + 1
-        while self.epochs is None or self._epoch < self.epochs:
-            order = self._order(self._epoch)
-            while self._batch < self.batches_per_epoch:
-                idx = order[self._batch * self.batch_size:
-                            (self._batch + 1) * self.batch_size]
-                batch = np.stack(
-                    [np.asarray(self.tokens[i * w:(i + 1) * w]) for i in idx])
-                self._batch += 1
-                yield batch.astype(np.int32)
-            self._batch = 0
-            self._epoch += 1
+        # The cursor is instance state (that is what makes state()/restore()
+        # resume work), so iteration is single-consumer: a second live
+        # iterator would silently interleave, and an exhausted bounded
+        # batcher would silently yield nothing — both fail loudly instead.
+        if self.epochs is not None and self._epoch >= self.epochs:
+            raise RuntimeError(
+                "TokenBatcher exhausted; call reset() to re-iterate")
+        if self._active:
+            raise RuntimeError(
+                "TokenBatcher supports one active iterator (the resume "
+                "cursor is shared instance state)")
+        return self._gen()
+
+    def _gen(self) -> Iterator[np.ndarray]:
+        self._active = True
+        try:
+            w = self.seq_len + 1
+            while self.epochs is None or self._epoch < self.epochs:
+                order = self._order(self._epoch)
+                while self._batch < self.batches_per_epoch:
+                    idx = order[self._batch * self.batch_size:
+                                (self._batch + 1) * self.batch_size]
+                    batch = np.stack(
+                        [np.asarray(self.tokens[i * w:(i + 1) * w]) for i in idx])
+                    self._batch += 1
+                    yield batch.astype(np.int32)
+                self._batch = 0
+                self._epoch += 1
+        finally:
+            self._active = False

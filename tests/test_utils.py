@@ -86,6 +86,24 @@ def test_token_batcher(tmp_path):
     b2.restore(state)
     np.testing.assert_array_equal(next(iter(b2)), want)
 
+    # Guards: exhausted bounded batcher fails loudly until reset; a second
+    # live iterator is rejected (the resume cursor is shared); a stale
+    # cursor from different geometry is rejected.
+    b3 = TokenBatcher(tokens, bsz, seq, seed=3, epochs=1)
+    assert len(list(b3)) == 25
+    with pytest.raises(RuntimeError, match="exhausted"):
+        iter(b3)
+    b3.reset()
+    assert next(iter(b3)) is not None
+    b4 = TokenBatcher(tokens, bsz, seq, seed=3)
+    i4 = iter(b4)
+    next(i4)
+    with pytest.raises(RuntimeError, match="one active iterator"):
+        iter(b4)
+    i4.close()
+    with pytest.raises(ValueError, match="state mismatch"):
+        TokenBatcher(tokens, bsz + 1, seq, seed=3).restore(b4.state())
+
     # Loaders: npy header dtype vs raw + explicit dtype.
     np.save(tmp_path / "t.npy", tokens)
     (tmp_path / "t.bin").write_bytes(tokens.tobytes())
