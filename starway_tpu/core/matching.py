@@ -19,7 +19,8 @@ ride the same matcher with no jax dependency here:
 
 * host target: writable ``memoryview``; host payload: ``memoryview``;
 * device target: ``DeviceRecvSink`` (``nbytes`` / ``host_staging()`` /
-  ``finalize_from_host()`` / ``accept_device()``, see device.py);
+  ``finalize_from_host()`` / ``accept_device()`` / optional
+  ``accept_host()`` for complete-bytes-in-hand delivery, see device.py);
 * device payload: ``DevicePayload`` (``nbytes`` / ``as_host_view()`` /
   ``.array``).
 
@@ -123,9 +124,17 @@ def _copy_complete(pr: PostedRecv, payload, length: int) -> None:
             pr.buf[:length] = payload.as_host_view()
     else:
         if _is_host(payload):
-            staging = pr.buf.host_staging()
-            staging[:length] = payload
-            pr.buf.finalize_from_host(length)
+            direct = getattr(pr.buf, "accept_host", None)
+            if direct is not None:
+                # Complete bytes in hand: the sink places them directly,
+                # skipping the staging bounce where the target platform
+                # allows (see DeviceRecvSink.accept_host).  Streamed
+                # arrivals still use host_staging.
+                direct(payload, length)
+            else:
+                staging = pr.buf.host_staging()
+                staging[:length] = payload
+                pr.buf.finalize_from_host(length)
         else:  # device -> device: direct HBM handoff / ICI copy
             pr.buf.accept_device(payload.array)
 

@@ -217,9 +217,44 @@ class DeviceRecvSink:
 
     def finalize_from_host(self, length: int) -> None:
         """Staged bytes fully arrived: view as dtype/shape, place on device."""
+        import numpy as np
+
+        self._place(np.asarray(self._staging[:length]), length)
+        self._staging = None
+        self._staging_view = None
+
+    def accept_host(self, view, length: int) -> None:
+        """Complete host bytes already in hand (in-process delivery, or an
+        owned unexpected-queue spill): device_put straight from the source
+        view, eliding the staging memcpy, where that is safe.
+
+        It is NOT safe on CPU targets: jax zero-copies aligned host numpy
+        buffers onto the CPU device, which would alias the SENDER's buffer
+        — and send completion explicitly licenses the sender to reuse it
+        (pinned by tests/test_device.py::test_host_to_device_inline_
+        snapshots, which fails loudly if a jax release changes either
+        behavior).  Accelerator targets always copy host->HBM, so the
+        elision stands there."""
+        import numpy as np
         import jax
 
-        raw = self._staging[:length]
+        raw = np.frombuffer(view, dtype=np.uint8, count=length)
+        dev = self.devbuf.device
+        platform = dev.platform if dev is not None else jax.local_devices()[0].platform
+        if platform == "cpu":
+            raw = raw.copy()  # private snapshot; aliasing it is then fine
+            self._place(raw, length)
+        else:
+            # H2D device_put is async: the DMA reads the source view after
+            # the call returns, and completion licenses the sender to reuse
+            # that buffer.  Block until the data is resident (the same
+            # recv-complete semantics accept_device enforces).
+            self._place(raw, length)
+            self.devbuf.array.block_until_ready()
+
+    def _place(self, raw, length: int) -> None:
+        import jax
+
         arr = raw.view(self.devbuf.dtype)
         if length == self.nbytes:
             arr = arr.reshape(self.devbuf.shape)
@@ -229,8 +264,6 @@ class DeviceRecvSink:
             else jax.device_put(arr)
         )
         self.devbuf.last_transport = "staged"
-        self._staging = None
-        self._staging_view = None
 
     def accept_device(self, array) -> None:
         """Direct device handoff (in-process path): HBM -> HBM over ICI when

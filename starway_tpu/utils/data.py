@@ -98,15 +98,20 @@ class TokenBatcher:
         return rng.permutation(self.n_windows)
 
     def reset(self) -> None:
-        """Rewind to epoch 0 (re-iterating an epochs-bounded batcher)."""
+        """Rewind to epoch 0 (re-iterating an epochs-bounded batcher);
+        also clears a stale active-iterator mark left by an abandoned,
+        never-advanced iterator."""
         self._epoch = 0
         self._batch = 0
+        self._active = False
 
     def __iter__(self) -> Iterator[np.ndarray]:
         # The cursor is instance state (that is what makes state()/restore()
         # resume work), so iteration is single-consumer: a second live
         # iterator would silently interleave, and an exhausted bounded
         # batcher would silently yield nothing — both fail loudly instead.
+        # The active mark is taken HERE, not at first next(), so two
+        # iterators created back-to-back cannot both slip past the check.
         if self.epochs is not None and self._epoch >= self.epochs:
             raise RuntimeError(
                 "TokenBatcher exhausted; call reset() to re-iterate")
@@ -114,10 +119,10 @@ class TokenBatcher:
             raise RuntimeError(
                 "TokenBatcher supports one active iterator (the resume "
                 "cursor is shared instance state)")
+        self._active = True
         return self._gen()
 
     def _gen(self) -> Iterator[np.ndarray]:
-        self._active = True
         try:
             w = self.seq_len + 1
             while self.epochs is None or self._epoch < self.epochs:

@@ -105,6 +105,29 @@ async def test_host_to_device_transfer(port, transport):
         await server.aclose()
 
 
+async def test_host_to_device_inline_snapshots(port):
+    """The staging-eliding accept_host path must SNAPSHOT: mutating the
+    sender's buffer after send completion must not change the delivered
+    array.  On CPU targets jax.device_put zero-copies aligned numpy
+    buffers (this test caught it doing exactly that), so accept_host makes
+    a private copy there; on accelerators H2D always copies.  Fails loudly
+    if either behavior shifts under a jax upgrade."""
+    server, client = await _pair(port)
+    try:
+        src = np.arange(1024, dtype=np.uint8) % 251
+        want = src.copy()
+        sink = DeviceBuffer((1024,), jnp.uint8)
+        recv_fut = server.arecv(sink, 12, MASK)
+        await asyncio.sleep(0.01)
+        await client.asend(src, 12)
+        await recv_fut
+        src[:] = 0  # sender reuses its buffer post-completion
+        np.testing.assert_array_equal(np.asarray(sink.array), want)
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
 async def test_device_unexpected_then_post(port):
     """Device message arriving before the recv is posted parks in the
     unexpected queue holding the array reference (no host copy)."""

@@ -96,11 +96,14 @@ def test_token_batcher(tmp_path):
     b3.reset()
     assert next(iter(b3)) is not None
     b4 = TokenBatcher(tokens, bsz, seq, seed=3)
-    i4 = iter(b4)
+    i4 = iter(b4)  # not yet advanced: the mark is taken at iter() time
+    with pytest.raises(RuntimeError, match="one active iterator"):
+        iter(b4)
     next(i4)
     with pytest.raises(RuntimeError, match="one active iterator"):
         iter(b4)
     i4.close()
+    assert next(iter(b4)) is not None  # close released the mark
     with pytest.raises(ValueError, match="state mismatch"):
         TokenBatcher(tokens, bsz + 1, seq, seed=3).restore(b4.state())
 
