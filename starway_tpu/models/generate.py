@@ -35,6 +35,7 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
 
 def _attend_cached(q, k_cache, v_cache, pos, n_rep, use_pallas=None):
     """q: [B, Hq, 1, D]; caches: [B, Hkv, T, D]; mask positions > pos.
+    ``pos`` is a scalar or a per-row [B] vector (ragged batches).
 
     On TPU the pallas decode kernel (ops/pallas_decode.py) streams the
     grouped cache once instead of materialising ``repeat_kv`` — an
@@ -51,23 +52,42 @@ def _attend_cached(q, k_cache, v_cache, pos, n_rep, use_pallas=None):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s / (q.shape[-1] ** 0.5)
     kv_pos = jnp.arange(k.shape[2])
-    s = jnp.where((kv_pos <= pos)[None, None, None, :], s, NEG_BIG)
+    pos_b = jnp.broadcast_to(jnp.asarray(pos).reshape(-1), (q.shape[0],))
+    s = jnp.where(kv_pos[None, None, None, :] <= pos_b[:, None, None, None],
+                  s, NEG_BIG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
 def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
                 rope=None):
-    """One token in, next-token logits out.  token: [B] int32; pos: scalar
-    position of ``token``.  Returns (logits [B, V], updated cache)."""
+    """One token in, next-token logits out.  token: [B] int32; pos: the
+    position of ``token`` — a scalar (aligned batch) or a per-row [B]
+    vector (ragged batch: every row sits at its own cursor).  Returns
+    (logits [B, V], updated cache)."""
     B = token.shape[0]
     hd = cfg.head_dim
     n_rep = cfg.n_heads // cfg.n_kv_heads
     if rope is None:
         rope = rope_tables(cache["k"].shape[3], hd, cfg.rope_theta)
     cos, sin = rope
-    cos_p = lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
-    sin_p = lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
+    pos = jnp.asarray(pos, jnp.int32)
+    per_row = pos.ndim == 1
+    if per_row:
+        # [B, 1, 1, hd/2]: one rotation angle per row, broadcast over heads.
+        cos_p = cos[pos][:, None, None, :]
+        sin_p = sin[pos][:, None, None, :]
+
+        def write(c, u):
+            return jax.vmap(
+                lambda cr, ur, p: lax.dynamic_update_slice_in_dim(
+                    cr, ur, p, axis=1))(c, u, pos)
+    else:
+        cos_p = lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
+        sin_p = lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
+
+        def write(c, u):
+            return lax.dynamic_update_slice_in_dim(c, u, pos, axis=2)
 
     h = params["embed"][token][:, None, :]  # [B, 1, D]
 
@@ -80,8 +100,8 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
         v = (x @ lp["wv"]).reshape(B, 1, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
         q = apply_rope(q, cos_p, sin_p)
         k = apply_rope(k, cos_p, sin_p)
-        kc = lax.dynamic_update_slice_in_dim(kc, k, pos, axis=2)
-        vc = lax.dynamic_update_slice_in_dim(vc, v, pos, axis=2)
+        kc = write(kc, k)
+        vc = write(vc, v)
         o = _attend_cached(q, kc, vc, pos, n_rep)
         o = o.transpose(0, 2, 1, 3).reshape(B, 1, cfg.n_heads * hd)
         h = h + o @ lp["wo"]
@@ -161,18 +181,42 @@ def _sample(logits, key, temperature: float, top_k: Optional[int],
 @functools.cache
 def _compiled_generate(cfg: LlamaConfig, B: int, P: int, max_new: int,
                        max_len: int, temperature: float,
-                       top_k: Optional[int], top_p: Optional[float]):
+                       top_k: Optional[int], top_p: Optional[float],
+                       ragged: bool = False):
     """jit'd prefill + decode scan for one (shape, sampling) signature.
 
     The whole generation is ONE dispatch: flash prefill, then a
     ``lax.scan`` of sample->decode steps — no per-token host round trip
     (the XLA-friendly decode loop; on this sandbox's tunneled device a
     per-token dispatch costs ~100 ms against a ~30 µs decode step).
+
+    ``ragged``: the compiled fn takes per-row prompt lengths; every row
+    decodes from its own cursor (see :func:`generate`'s contract).
     """
     rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
 
-    def run(params, prompt, key):
-        logits, cache = prefill(params, cfg, prompt, max_len)
+    def run(params, prompt, key, lengths):
+        if ragged:
+            # Right-padded prompts: causal attention already confines every
+            # real position to real prefixes (pad positions only corrupt
+            # their OWN states, which are never read — hence the dense-only
+            # restriction: MoE capacity is shared batch-wide), so one flash
+            # pass fills the cache; each row's next-token logits come from
+            # position length-1 (gathered BEFORE the head: no [B, P, V]
+            # tensor is built).
+            logits, _aux, (ks, vs) = forward(
+                params, prompt, cfg, return_aux=True, return_kv=True,
+                logit_positions=lengths - 1)
+            logits = logits[:, 0]
+            pad = max_len - P
+            if pad:
+                ks = jnp.pad(ks, ((0, 0),) * 3 + ((0, pad), (0, 0)))
+                vs = jnp.pad(vs, ((0, 0),) * 3 + ((0, pad), (0, 0)))
+            cache = {"k": ks, "v": vs}
+            pos0 = lengths
+        else:
+            logits, cache = prefill(params, cfg, prompt, max_len)
+            pos0 = jnp.asarray(P, jnp.int32)
 
         def step(carry, _):
             cache, logits, key, pos = carry
@@ -184,7 +228,7 @@ def _compiled_generate(cfg: LlamaConfig, B: int, P: int, max_new: int,
         # Scan max_new - 1 sample->decode pairs, then sample the final token
         # outside the scan: its decode_step would compute logits nothing
         # ever reads.
-        init = (cache, logits, key, jnp.asarray(P, jnp.int32))
+        init = (cache, logits, key, pos0)
         (cache, logits, key, _), toks = lax.scan(
             step, init, None, length=max_new - 1)
         key, sub = jax.random.split(key)
@@ -198,11 +242,20 @@ def _compiled_generate(cfg: LlamaConfig, B: int, P: int, max_new: int,
 def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
              *, temperature: float = 0.0, key: Optional[jax.Array] = None,
              max_len: Optional[int] = None, top_k: Optional[int] = None,
-             top_p: Optional[float] = None):
-    """Autoregressive generation.  prompt: [B, P] int32.  Returns
-    [B, P + max_new_tokens].  temperature=0 -> greedy; otherwise softmax
-    sampling with ``key``, optionally truncated by ``top_k`` and/or nucleus
-    ``top_p``."""
+             top_p: Optional[float] = None, prompt_lengths=None):
+    """Autoregressive generation.  prompt: [B, P] int32.
+
+    Aligned batch (default): returns ``[B, P + max_new_tokens]`` (prompt +
+    continuation).  temperature=0 -> greedy; otherwise softmax sampling
+    with ``key``, optionally truncated by ``top_k`` and/or nucleus
+    ``top_p``.
+
+    Ragged batch: pass ``prompt_lengths`` ([B] ints, RIGHT-padded prompt)
+    and every row decodes from its own length — one compiled scan serves
+    mixed prompt sizes.  Returns only the NEW tokens ``[B,
+    max_new_tokens]`` (row b's continuation of ``prompt[b, :lengths[b]]``;
+    the caller stitches ragged rows).
+    """
     B, P = prompt.shape
     total = P + max_new_tokens
     if max_len is None:
@@ -215,7 +268,23 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
         )
     if key is None:
         key = jax.random.PRNGKey(0)
+    ragged = prompt_lengths is not None
+    if ragged:
+        if cfg.n_experts > 0:
+            # Expert capacity is computed over the whole padded batch, so
+            # pad tokens would consume slots and perturb REAL rows' routing
+            # — the per-row-equivalence contract below cannot hold.
+            raise ValueError(
+                "ragged generation is dense-only: MoE expert capacity is "
+                "shared batch-wide, so pad tokens would alter real rows")
+        lengths = jnp.asarray(prompt_lengths, jnp.int32)
+        if lengths.shape != (B,):
+            raise ValueError(f"prompt_lengths must be [{B}], got {lengths.shape}")
+    else:
+        lengths = jnp.zeros((B,), jnp.int32)  # unused placeholder
     run = _compiled_generate(cfg, B, P, max_new_tokens, max_len,
-                             float(temperature), top_k, top_p)
-    toks = run(params, prompt, key)
+                             float(temperature), top_k, top_p, ragged)
+    toks = run(params, prompt, key, lengths)
+    if ragged:
+        return toks
     return jnp.concatenate([prompt, toks], axis=1)

@@ -171,12 +171,14 @@ def rope_tables(seq_len: int, head_dim: int, theta: float):
 
 def apply_rope(x, cos, sin):
     """x: [B, H, S, Dh]; split-half (NeoX) rotation convention: the two
-    rotated components are x[..., :Dh/2] and x[..., Dh/2:].  NOTE: Meta's
-    released Llama checkpoints use the interleaved-pair convention; loading
-    them requires permuting wq/wk columns accordingly."""
+    rotated components are x[..., :Dh/2] and x[..., Dh/2:].  ``cos``/``sin``
+    are [S, Dh/2] tables, or already-broadcastable 4-D (e.g. per-row
+    [B, 1, 1, Dh/2] angles for ragged decode).  NOTE: Meta's released Llama
+    checkpoints use the interleaved-pair convention; loading them requires
+    permuting wq/wk columns accordingly."""
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    c = cos[None, None, :, :]
-    s = sin[None, None, :, :]
+    c = cos[None, None, :, :] if cos.ndim == 2 else cos
+    s = sin[None, None, :, :] if sin.ndim == 2 else sin
     return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1).astype(x.dtype)
 
 
@@ -252,7 +254,7 @@ def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
 def forward(params: dict, tokens, cfg: LlamaConfig,
             attn_fn: Optional[Callable] = None, *, return_aux: bool = False,
             moe_fn: Optional[Callable] = None, return_kv: bool = False,
-            last_only: bool = False):
+            last_only: bool = False, logit_positions=None):
     """Next-token logits ``[B, S, V]`` for token ids ``[B, S]``.
 
     ``return_kv`` additionally returns the post-RoPE grouped k/v of every
@@ -261,8 +263,10 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
     pass over the whole prompt instead of S cached decode steps).
     ``last_only`` applies the final norm + lm_head to the last position only
     (``[B, 1, V]``), skipping the ``[B, S, V]`` logit tensor a prefill never
-    reads.  Return value is ``logits``, extended to a tuple
-    ``(logits[, aux][, (k, v)])`` by ``return_aux`` / ``return_kv``.
+    reads; ``logit_positions`` ([B] ints) is its ragged analog — logits for
+    one caller-chosen position per row.  Return value is ``logits``,
+    extended to a tuple ``(logits[, aux][, (k, v)])`` by ``return_aux`` /
+    ``return_kv``.
 
     ``attn_fn(q, k, v) -> out`` takes q ``[B, Hq, S, Dh]`` and *grouped*
     kv ``[B, Hkv, S, Dh]`` (impls expand GQA heads internally); defaults to
@@ -292,6 +296,8 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
     (h, aux), kv = lax.scan(body, (h, jnp.zeros((), jnp.float32)), params["layers"])
     if last_only:
         h = h[:, -1:]
+    elif logit_positions is not None:
+        h = jnp.take_along_axis(h, logit_positions[:, None, None], axis=1)
     logits = head_logits(h, params["final_norm"], params["lm_head"], cfg.norm_eps)
     out = (logits,)
     if return_aux:

@@ -29,7 +29,7 @@ from .pallas_attention import _round_up
 
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                   *, sm_scale: float, block_k: int):
+                   *, sm_scale: float, block_k: int, hkv: int):
     ki = pl.program_id(1)
     n_k = pl.num_programs(1)
 
@@ -39,7 +39,9 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    pos = pos_ref[0]
+    # Per-ROW positions (ragged batches): this grid cell serves batch row
+    # bh // hkv, whose own cursor bounds both masking and the DMA clamp.
+    pos = pos_ref[pl.program_id(0) // hkv]
     k_start = ki * block_k
 
     @pl.when(k_start <= pos)
@@ -74,9 +76,10 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
                      block_k: int = 128, interpret=None):
     """Cached single-query attention without expanding the grouped cache.
 
-    q: [B, Hq, 1, D]; k_cache/v_cache: [B, Hkv, T, D]; pos: scalar int —
-    positions > pos are masked.  Returns [B, Hq, 1, D].  Numerically matches
-    models/generate.py:_attend_cached (softmax in f32).
+    q: [B, Hq, 1, D]; k_cache/v_cache: [B, Hkv, T, D]; pos: scalar int or
+    per-row [B] int (ragged batches) — positions > pos[b] are masked for
+    row b, and row b's DMA stops at its own block.  Returns [B, Hq, 1, D].
+    Numerically matches models/generate.py:_attend_cached (softmax in f32).
     """
     b, hq, one, d = q.shape
     assert one == 1, "decode kernel takes a single query position"
@@ -104,19 +107,20 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
         kf = jnp.pad(kf, ((0, 0), (0, t_pad - t), (0, 0)))
         vf = jnp.pad(vf, ((0, 0), (0, t_pad - t), (0, 0)))
 
-    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
     grid = (b * hkv, t_pad // block_k)
 
     # Clamp the K/V block index at the last block containing <= pos: the
     # kernel body is skipped for blocks past pos (pl.when), and a repeated
     # block index makes the Pallas pipeline elide the HBM copy entirely --
-    # so a decode at pos streams only ceil((pos+1)/block_k) blocks, not the
-    # whole padded cache.  (pl.when alone skips compute, not DMA.)
+    # so a decode at pos streams only ceil((pos+1)/block_k) blocks per row,
+    # not the whole padded cache.  (pl.when alone skips compute, not DMA.)
     def _kv_index(bh, ki, pos_ref):
-        return (bh, jnp.minimum(ki, pos_ref[0] // block_k), 0)
+        return (bh, jnp.minimum(ki, pos_ref[bh // hkv] // block_k), 0)
 
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, sm_scale=sm_scale, block_k=block_k),
+        functools.partial(_decode_kernel, sm_scale=sm_scale, block_k=block_k,
+                          hkv=hkv),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,

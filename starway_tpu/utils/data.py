@@ -98,20 +98,24 @@ class TokenBatcher:
         return rng.permutation(self.n_windows)
 
     def reset(self) -> None:
-        """Rewind to epoch 0 (re-iterating an epochs-bounded batcher);
-        also clears a stale active-iterator mark left by an abandoned,
-        never-advanced iterator."""
+        """Rewind to epoch 0 (re-iterating an epochs-bounded batcher).
+        Refuses while an iterator is live — resetting the shared cursor
+        under a running loop would silently rewind it."""
+        if self._active:
+            raise RuntimeError(
+                "TokenBatcher.reset() with a live iterator; close it first")
         self._epoch = 0
         self._batch = 0
-        self._active = False
 
-    def __iter__(self) -> Iterator[np.ndarray]:
+    def __iter__(self) -> "_BatcherIter":
         # The cursor is instance state (that is what makes state()/restore()
         # resume work), so iteration is single-consumer: a second live
         # iterator would silently interleave, and an exhausted bounded
         # batcher would silently yield nothing — both fail loudly instead.
         # The active mark is taken HERE, not at first next(), so two
-        # iterators created back-to-back cannot both slip past the check.
+        # iterators created back-to-back cannot both slip past the check;
+        # the wrapper releases it on close/GC even if never advanced (a
+        # bare generator's finally would not run in that case).
         if self.epochs is not None and self._epoch >= self.epochs:
             raise RuntimeError(
                 "TokenBatcher exhausted; call reset() to re-iterate")
@@ -120,21 +124,51 @@ class TokenBatcher:
                 "TokenBatcher supports one active iterator (the resume "
                 "cursor is shared instance state)")
         self._active = True
-        return self._gen()
+        return _BatcherIter(self)
 
     def _gen(self) -> Iterator[np.ndarray]:
+        w = self.seq_len + 1
+        while self.epochs is None or self._epoch < self.epochs:
+            order = self._order(self._epoch)
+            while self._batch < self.batches_per_epoch:
+                idx = order[self._batch * self.batch_size:
+                            (self._batch + 1) * self.batch_size]
+                batch = np.stack(
+                    [np.asarray(self.tokens[i * w:(i + 1) * w]) for i in idx])
+                self._batch += 1
+                yield batch.astype(np.int32)
+            self._batch = 0
+            self._epoch += 1
+
+
+class _BatcherIter:
+    """Iterator handle owning the batcher's active mark: released on
+    exhaustion, close(), or garbage collection — including before the
+    first ``next()``."""
+
+    __slots__ = ("_owner", "_gen")
+
+    def __init__(self, owner: TokenBatcher):
+        self._owner = owner
+        self._gen = owner._gen()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
         try:
-            w = self.seq_len + 1
-            while self.epochs is None or self._epoch < self.epochs:
-                order = self._order(self._epoch)
-                while self._batch < self.batches_per_epoch:
-                    idx = order[self._batch * self.batch_size:
-                                (self._batch + 1) * self.batch_size]
-                    batch = np.stack(
-                        [np.asarray(self.tokens[i * w:(i + 1) * w]) for i in idx])
-                    self._batch += 1
-                    yield batch.astype(np.int32)
-                self._batch = 0
-                self._epoch += 1
-        finally:
-            self._active = False
+            return next(self._gen)
+        except BaseException:
+            self._release()
+            raise
+
+    def close(self) -> None:
+        self._gen.close()
+        self._release()
+
+    __del__ = close
+
+    def _release(self) -> None:
+        if self._owner is not None:
+            self._owner._active = False
+            self._owner = None

@@ -114,3 +114,28 @@ def test_decode_kernel_traced_pos_under_jit():
     ref = _attend_cached(q, k, v, 77, 1, use_pallas=False)
     out = step(q, k, v, jnp.int32(77))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_decode_kernel_per_row_pos():
+    """Ragged decode: a [B] position vector masks (and DMA-clamps) each
+    batch row at its own cursor; every row must match a standalone
+    scalar-pos call."""
+    from starway_tpu.models.generate import _attend_cached
+    from starway_tpu.ops.pallas_decode import decode_attention
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(4), 3)
+    B, Hq, Hkv, T, D = 3, 8, 2, 300, 64
+    q = jax.random.normal(k1, (B, Hq, 1, D), jnp.float32)
+    k = jax.random.normal(k2, (B, Hkv, T, D), jnp.float32)
+    v = jax.random.normal(k3, (B, Hkv, T, D), jnp.float32)
+    pos = jnp.asarray([7, 255, 130], jnp.int32)
+
+    out = decode_attention(q, k, v, pos, interpret=True)
+    lax_out = _attend_cached(q, k, v, pos, Hq // Hkv, use_pallas=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(lax_out),
+                               atol=2e-5, rtol=2e-5)
+    for b in range(B):
+        solo = decode_attention(q[b:b + 1], k[b:b + 1], v[b:b + 1],
+                                int(pos[b]), interpret=True)
+        np.testing.assert_allclose(np.asarray(out[b]), np.asarray(solo[0]),
+                                   atol=2e-5, rtol=2e-5, err_msg=f"row {b}")

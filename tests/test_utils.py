@@ -102,8 +102,13 @@ def test_token_batcher(tmp_path):
     next(i4)
     with pytest.raises(RuntimeError, match="one active iterator"):
         iter(b4)
+    with pytest.raises(RuntimeError, match="live iterator"):
+        b4.reset()  # resetting under a running loop would rewind it
     i4.close()
     assert next(iter(b4)) is not None  # close released the mark
+    i5 = iter(b4)  # abandoned before first next(): GC must release the mark
+    del i5
+    assert next(iter(b4)) is not None
     with pytest.raises(ValueError, match="state mismatch"):
         TokenBatcher(tokens, bsz + 1, seq, seed=3).restore(b4.state())
 
