@@ -14,6 +14,7 @@ from .llama import (
     make_train_step,
     param_specs,
 )
+from .hf_convert import config_from_hf, params_from_hf
 from .pp_llama import (
     make_pp_llama_train,
     pp_merge_params,
@@ -29,6 +30,8 @@ __all__ = [
     "loss_fn",
     "make_train_step",
     "param_specs",
+    "config_from_hf",
+    "params_from_hf",
     "make_pp_llama_train",
     "pp_split_params",
     "pp_merge_params",

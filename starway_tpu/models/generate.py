@@ -129,15 +129,20 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
 
 
 def prefill(params: dict, cfg: LlamaConfig, prompt,
-            max_len: Optional[int] = None, attn_fn=None):
+            max_len: Optional[int] = None, attn_fn=None,
+            logit_positions=None):
     """One parallel forward pass over the whole prompt -> the decode state.
 
-    Returns ``(last_logits [B, V], cache)`` where the cache holds the
+    Returns ``(next_logits [B, V], cache)`` where the cache holds the
     post-RoPE grouped k/v of positions ``0..P-1`` (zero-padded to
     ``max_len``).  This is the flash-attention path over the prompt — one
     MXU-shaped dispatch instead of P bandwidth-bound cached decode steps,
     and bit-identical to stepping the prompt through ``decode_step``
     (pinned by tests/test_generate.py::test_prefill_matches_stepwise).
+
+    ``logit_positions`` ([B] ints, ragged right-padded batches): the
+    returned logits come from each row's own position instead of the last
+    column (no [B, P, V] tensor is built either way).
     """
     B, P = prompt.shape
     if max_len is None:
@@ -146,7 +151,7 @@ def prefill(params: dict, cfg: LlamaConfig, prompt,
         raise ValueError(f"max_len={max_len} is smaller than the prompt ({P})")
     logits, _aux, (ks, vs) = forward(
         params, prompt, cfg, attn_fn, return_aux=True, return_kv=True,
-        last_only=True,
+        last_only=logit_positions is None, logit_positions=logit_positions,
     )
     pad = max_len - P
     if pad:
@@ -182,7 +187,7 @@ def _sample(logits, key, temperature: float, top_k: Optional[int],
 def _compiled_generate(cfg: LlamaConfig, B: int, P: int, max_new: int,
                        max_len: int, temperature: float,
                        top_k: Optional[int], top_p: Optional[float],
-                       ragged: bool = False):
+                       ragged: bool = False, eos_id: Optional[int] = None):
     """jit'd prefill + decode scan for one (shape, sampling) signature.
 
     The whole generation is ONE dispatch: flash prefill, then a
@@ -200,39 +205,41 @@ def _compiled_generate(cfg: LlamaConfig, B: int, P: int, max_new: int,
             # Right-padded prompts: causal attention already confines every
             # real position to real prefixes (pad positions only corrupt
             # their OWN states, which are never read — hence the dense-only
-            # restriction: MoE capacity is shared batch-wide), so one flash
-            # pass fills the cache; each row's next-token logits come from
-            # position length-1 (gathered BEFORE the head: no [B, P, V]
-            # tensor is built).
-            logits, _aux, (ks, vs) = forward(
-                params, prompt, cfg, return_aux=True, return_kv=True,
-                logit_positions=lengths - 1)
-            logits = logits[:, 0]
-            pad = max_len - P
-            if pad:
-                ks = jnp.pad(ks, ((0, 0),) * 3 + ((0, pad), (0, 0)))
-                vs = jnp.pad(vs, ((0, 0),) * 3 + ((0, pad), (0, 0)))
-            cache = {"k": ks, "v": vs}
+            # restriction: MoE capacity is shared batch-wide), so the same
+            # prefill fills the cache, gathering each row's next-token
+            # logits from its own length-1 position.
+            logits, cache = prefill(params, cfg, prompt, max_len,
+                                    logit_positions=lengths - 1)
             pos0 = lengths
         else:
             logits, cache = prefill(params, cfg, prompt, max_len)
             pos0 = jnp.asarray(P, jnp.int32)
 
-        def step(carry, _):
-            cache, logits, key, pos = carry
-            key, sub = jax.random.split(key)
+        done0 = jnp.zeros((B,), bool)
+
+        def emit(logits, sub, done):
+            """Sample one token per row; rows already done emit eos."""
             tok = _sample(logits, sub, temperature, top_k, top_p)
+            if eos_id is not None:
+                tok = jnp.where(done, jnp.int32(eos_id), tok)
+                done = done | (tok == eos_id)
+            return tok, done
+
+        def step(carry, _):
+            cache, logits, key, pos, done = carry
+            key, sub = jax.random.split(key)
+            tok, done = emit(logits, sub, done)
             logits, cache = decode_step(params, cache, tok, pos, cfg, rope)
-            return (cache, logits, key, pos + 1), tok
+            return (cache, logits, key, pos + 1, done), tok
 
         # Scan max_new - 1 sample->decode pairs, then sample the final token
         # outside the scan: its decode_step would compute logits nothing
         # ever reads.
-        init = (cache, logits, key, pos0)
-        (cache, logits, key, _), toks = lax.scan(
+        init = (cache, logits, key, pos0, done0)
+        (cache, logits, key, _, done), toks = lax.scan(
             step, init, None, length=max_new - 1)
         key, sub = jax.random.split(key)
-        last = _sample(logits, sub, temperature, top_k, top_p)
+        last, _ = emit(logits, sub, done)
         toks = jnp.concatenate([toks, last[None]], axis=0)
         return toks.T  # [B, max_new]
 
@@ -242,13 +249,16 @@ def _compiled_generate(cfg: LlamaConfig, B: int, P: int, max_new: int,
 def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
              *, temperature: float = 0.0, key: Optional[jax.Array] = None,
              max_len: Optional[int] = None, top_k: Optional[int] = None,
-             top_p: Optional[float] = None, prompt_lengths=None):
+             top_p: Optional[float] = None, prompt_lengths=None,
+             eos_id: Optional[int] = None):
     """Autoregressive generation.  prompt: [B, P] int32.
 
     Aligned batch (default): returns ``[B, P + max_new_tokens]`` (prompt +
     continuation).  temperature=0 -> greedy; otherwise softmax sampling
     with ``key``, optionally truncated by ``top_k`` and/or nucleus
-    ``top_p``.
+    ``top_p``.  ``eos_id``: rows that emit it keep emitting it for the
+    rest of the scan (the conventional eos-fill; the compiled step count
+    stays static).
 
     Ragged batch: pass ``prompt_lengths`` ([B] ints, RIGHT-padded prompt)
     and every row decodes from its own length — one compiled scan serves
@@ -280,10 +290,17 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
         lengths = jnp.asarray(prompt_lengths, jnp.int32)
         if lengths.shape != (B,):
             raise ValueError(f"prompt_lengths must be [{B}], got {lengths.shape}")
+        # Concrete here (lengths are a call-time array, not traced): reject
+        # out-of-range rows loudly — under jit the gathers would clamp and
+        # return wrong continuations silently.
+        if bool((lengths < 1).any()) or bool((lengths > P).any()):
+            raise ValueError(
+                f"prompt_lengths must be in [1, {P}]; got {lengths.tolist()}")
     else:
         lengths = jnp.zeros((B,), jnp.int32)  # unused placeholder
     run = _compiled_generate(cfg, B, P, max_new_tokens, max_len,
-                             float(temperature), top_k, top_p, ragged)
+                             float(temperature), top_k, top_p, ragged,
+                             None if eos_id is None else int(eos_id))
     toks = run(params, prompt, key, lengths)
     if ragged:
         return toks

@@ -1,0 +1,124 @@
+"""HuggingFace Llama checkpoint -> starway-tpu parameter tree.
+
+Bridges the ecosystem's weights into this framework: any
+``transformers.LlamaForCausalLM`` (or its state_dict) converts into the
+stacked-layer pytree ``models/llama.py`` trains and serves, and
+``config_from_hf`` derives the matching :class:`LlamaConfig`.
+
+Convention notes (why this is transpose-and-stack, not surgery):
+
+* HF's ``apply_rotary_pos_emb`` uses the rotate-half (NeoX / split-half)
+  convention — the same one ``llama.apply_rope`` implements — so q/k
+  projections carry over with NO column permutation.  (Meta's original
+  release uses interleaved pairs; HF already permuted at import, and
+  loading a Meta-native checkpoint still requires that permutation, as
+  documented on ``apply_rope``.)
+* HF ``nn.Linear`` stores ``[out, in]``; this tree stores ``[in, out]`` —
+  every projection transposes.
+* HF models may tie ``lm_head`` to the embedding; the converter follows
+  ``get_output_embeddings``/falls back to the tied table.
+
+Numerical parity with ``LlamaForCausalLM`` forward is pinned by
+tests/test_hf_convert.py on a tiny random model.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from .llama import LlamaConfig
+
+
+def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
+    """LlamaConfig from a ``transformers.LlamaConfig``-shaped object.
+
+    Refuses configs this model family cannot represent — silently dropping
+    them would produce a numerically wrong model (the failure mode this
+    module exists to prevent)."""
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        raise NotImplementedError(
+            f"rope_scaling={scaling!r} is not implemented here; converting "
+            "would silently change the rope frequencies vs transformers")
+    if getattr(hf_config, "attention_bias", False) or getattr(
+            hf_config, "mlp_bias", False):
+        raise NotImplementedError(
+            "projection biases are not represented in this parameter tree")
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise NotImplementedError(f"hidden_act={act!r}; this family is SwiGLU")
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads",
+                           hf_config.num_attention_heads),
+        d_ff=hf_config.intermediate_size,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
+    )
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def _t(w) -> np.ndarray:
+    """torch/np tensor -> f32 numpy, transposed ([out, in] -> [in, out])."""
+    return _np(w).T
+
+
+def _np(w) -> np.ndarray:
+    if hasattr(w, "detach"):
+        w = w.detach().cpu().float().numpy()
+    return np.asarray(w, dtype=np.float32)
+
+
+def params_from_hf(model_or_state: Any, cfg: LlamaConfig, dtype=None) -> dict:
+    """Convert a ``LlamaForCausalLM`` (or its ``state_dict()``) into this
+    framework's stacked-layer parameter pytree, cast to ``dtype`` (default:
+    ``cfg.compute_dtype``).
+
+    Each leaf is cast and committed to jax AS it is converted, so peak host
+    memory is the source checkpoint plus one stacked leaf's f32 scratch —
+    not a second full-tree copy."""
+    import jax.numpy as jnp
+
+    if hasattr(model_or_state, "state_dict"):
+        state = {k: v for k, v in model_or_state.state_dict().items()}
+    else:
+        state = dict(model_or_state)
+    # Accept both bare-LlamaModel ("model.layers...") and ForCausalLM keys.
+    prefix = "model." if any(k.startswith("model.") for k in state) else ""
+
+    dt = jnp.dtype(dtype) if dtype is not None else cfg.compute_dtype
+
+    def get(name):
+        return state[prefix + name]
+
+    L = cfg.n_layers
+    stack = lambda fn: jnp.asarray(np.stack([fn(i) for i in range(L)]), dt)
+    layers = {
+        "wq": stack(lambda i: _t(get(f"layers.{i}.self_attn.q_proj.weight"))),
+        "wk": stack(lambda i: _t(get(f"layers.{i}.self_attn.k_proj.weight"))),
+        "wv": stack(lambda i: _t(get(f"layers.{i}.self_attn.v_proj.weight"))),
+        "wo": stack(lambda i: _t(get(f"layers.{i}.self_attn.o_proj.weight"))),
+        "w_gate": stack(lambda i: _t(get(f"layers.{i}.mlp.gate_proj.weight"))),
+        "w_up": stack(lambda i: _t(get(f"layers.{i}.mlp.up_proj.weight"))),
+        "w_down": stack(lambda i: _t(get(f"layers.{i}.mlp.down_proj.weight"))),
+        "attn_norm": stack(lambda i: _np(get(f"layers.{i}.input_layernorm.weight"))),
+        "mlp_norm": stack(
+            lambda i: _np(get(f"layers.{i}.post_attention_layernorm.weight"))),
+    }
+    embed = jnp.asarray(_np(get("embed_tokens.weight")), dt)
+    if "lm_head.weight" in state:
+        lm_head = jnp.asarray(_t(state["lm_head.weight"]), dt)
+    else:  # tied embeddings
+        lm_head = embed.T
+    return {
+        "embed": embed,
+        "layers": layers,
+        "final_norm": jnp.asarray(_np(get("norm.weight")), dt),
+        "lm_head": lm_head,
+    }

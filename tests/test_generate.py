@@ -135,6 +135,31 @@ def test_generate_tp_sharded(cfg, params):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_generate_eos_fill(cfg, params):
+    """Once a row emits eos_id it keeps emitting it; other rows continue
+    unaffected (greedy tokens identical to the eos-free run up to the
+    first eos)."""
+    prompt = jnp.asarray([[1, 2, 3], [4, 5, 6]], dtype=jnp.int32)
+    free = generate(params, cfg, prompt, max_new_tokens=6)
+    # Use row 0's second greedy token as the eos: the run must match the
+    # free run through that token, then fill with it.
+    eos = int(free[0, prompt.shape[1] + 1])
+    out = generate(params, cfg, prompt, max_new_tokens=6, eos_id=eos)
+    new = np.asarray(out[:, prompt.shape[1]:])
+    ref = np.asarray(free[:, prompt.shape[1]:])
+    row0 = list(ref[0])
+    cut = row0.index(eos)
+    np.testing.assert_array_equal(new[0, :cut + 1], ref[0, :cut + 1])
+    assert (new[0, cut:] == eos).all()
+    # Row 1: identical until (if ever) it hits eos itself.
+    if eos in list(ref[1]):
+        c1 = list(ref[1]).index(eos)
+        np.testing.assert_array_equal(new[1, :c1 + 1], ref[1, :c1 + 1])
+        assert (new[1, c1:] == eos).all()
+    else:
+        np.testing.assert_array_equal(new[1], ref[1])
+
+
 def test_generate_ragged_matches_per_row(cfg, params):
     """Ragged batch (right-padded, per-row lengths) must produce, for every
     row, exactly the tokens of a standalone unpadded generation of that
@@ -157,6 +182,9 @@ def test_generate_ragged_matches_per_row(cfg, params):
 
     with pytest.raises(ValueError):
         generate(params, cfg, padded, max_new, prompt_lengths=lengths[:2])
+    with pytest.raises(ValueError, match=r"in \[1,"):
+        generate(params, cfg, padded, max_new,
+                 prompt_lengths=jnp.asarray([0, 2, P + 1], jnp.int32))
 
     # MoE is dense-only for ragged batches: shared expert capacity means
     # pad tokens would perturb real rows' routing.
