@@ -33,7 +33,6 @@ def main() -> None:
         # The demo model is tiny; run on CPU unless real weights are given.
         jax.config.update("jax_platforms", "cpu")
 
-    import numpy as np
     import jax.numpy as jnp
     import torch
     import transformers

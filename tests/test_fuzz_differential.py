@@ -1,0 +1,198 @@
+"""Seeded matcher fuzz: random op schedules, every data plane, one oracle.
+
+The matching contract (UCX rule ``(stag & rmask) == (rtag & rmask)``, FIFO
+posted + FIFO unexpected queues) is deterministic given two orders: recv
+posting order (program order) and per-connection arrival order (= send
+order on one connection).  The *pairing* is also invariant to the relative
+timing of the two streams — a message claimed from the unexpected queue
+pairs with the same recv it would have matched had it arrived later.  So a
+tiny reference matcher can predict the exact outcome of any schedule, and
+every transport must reproduce it: in-process fast path, Python TCP,
+shared-memory rings, and the C++ engine.
+
+Each seed draws a different interleaving of duplicate tags, wildcard vs
+exact masks, both directions, and unmatched stragglers — breadth the
+hand-written contract suite (test_basic.py) cannot enumerate.
+"""
+
+import asyncio
+import random
+
+import numpy as np
+import pytest
+
+from starway_tpu import Client, Server
+
+pytestmark = pytest.mark.asyncio
+
+ADDR = "127.0.0.1"
+MAX_SIZE = 1 << 16
+SIZES = [1, 7, 128, 1 << 12, MAX_SIZE]
+
+
+@pytest.fixture
+def port():
+    return random.randint(10000, 50000)
+
+
+@pytest.fixture(params=["inproc", "tcp", "sm", "native", "native-sm"])
+def transport(request, monkeypatch):
+    if request.param == "tcp":
+        monkeypatch.setenv("STARWAY_TLS", "tcp")
+        monkeypatch.setenv("STARWAY_NATIVE", "0")
+    elif request.param == "sm":
+        import platform
+
+        if platform.machine() not in ("x86_64", "AMD64"):
+            pytest.skip("python sm transport requires x86-64")
+        monkeypatch.setenv("STARWAY_TLS", "tcp,sm")
+        monkeypatch.setenv("STARWAY_NATIVE", "0")
+    elif request.param in ("native", "native-sm"):
+        from starway_tpu.core import native
+
+        if not native.available():
+            pytest.skip("native engine unavailable (no toolchain)")
+        monkeypatch.setenv(
+            "STARWAY_TLS", "tcp" if request.param == "native" else "tcp,sm")
+        monkeypatch.setenv("STARWAY_NATIVE", "1")
+    return request.param
+
+
+def _schedule(seed: int):
+    """Reproducible ops: per direction, n sends (pooled tags, mixed sizes)
+    and m recvs (wildcard or exact), randomly interleaved; directions
+    interleaved too but kept in relative order."""
+    rng = random.Random(seed)
+    ops = []
+    for direction in ("c2s", "s2c"):
+        n = rng.randint(5, 10)
+        pool = [rng.randint(0, 0xFFFF) for _ in range(3)]
+        sends = [("send", direction, rng.choice(pool), rng.choice(SIZES))
+                 for _ in range(n)]
+        recvs = []
+        for _ in range(rng.randint(max(1, n - 2), n + 2)):
+            if rng.random() < 0.5:
+                recvs.append(("recv", direction, 0, 0))
+            else:
+                recvs.append(("recv", direction, rng.choice(pool),
+                              (1 << 64) - 1))
+        merged = []
+        while sends or recvs:
+            src = sends if (sends and (not recvs or rng.random() < 0.5)) else recvs
+            merged.append(src.pop(0))
+        ops.append(merged)
+    a, b = ops
+    rng2 = random.Random(seed + 1)
+    out = []
+    while a or b:
+        src = a if (a and (not b or rng2.random() < 0.5)) else b
+        out.append(src.pop(0))
+    return out
+
+
+def _oracle(ops, payload_for):
+    """Reference matcher: returns per-recv (sender_tag, payload) or None
+    (pending), in recv posting order per direction."""
+    state = {d: {"posted": [], "unexpected": []} for d in ("c2s", "s2c")}
+    results = {}
+    si = 0
+    ri = 0
+    for op in ops:
+        if op[0] == "send":
+            _, d, stag, size = op
+            data = payload_for(si, size)
+            si += 1
+            for rec in state[d]["posted"]:
+                rid, rtag, rmask, taken = rec
+                if not taken and (stag & rmask) == (rtag & rmask):
+                    rec[3] = True
+                    results[rid] = (stag, data)
+                    break
+            else:
+                state[d]["unexpected"].append((stag, data))
+        else:
+            _, d, rtag, rmask = op
+            rid = ri
+            ri += 1
+            for i, (stag, data) in enumerate(state[d]["unexpected"]):
+                if (stag & rmask) == (rtag & rmask):
+                    del state[d]["unexpected"][i]
+                    results[rid] = (stag, data)
+                    break
+            else:
+                state[d]["posted"].append([rid, rtag, rmask, False])
+                results.setdefault(rid, None)
+    return results
+
+
+@pytest.mark.parametrize("seed", range(6))
+async def test_fuzz_matches_oracle(seed, port, transport):
+    ops = _schedule(seed)
+
+    payload_cache = {}
+
+    def payload_for(si, size):
+        if si not in payload_cache:
+            payload_cache[si] = np.random.default_rng(
+                (seed, si)).integers(0, 255, size, dtype=np.uint8)
+        return payload_cache[si]
+
+    expected = _oracle(ops, payload_for)
+
+    server = Server()
+    client = Client()
+    server.listen(ADDR, port)
+    await client.aconnect(ADDR, port)
+    for _ in range(400):
+        if server.list_clients():
+            break
+        await asyncio.sleep(0.005)
+    ep = server.list_clients().pop()
+
+    futs = {}
+    bufs = {}
+    try:
+        si = 0
+        ri = 0
+        for op in ops:
+            if op[0] == "send":
+                _, d, tag, size = op
+                data = payload_for(si, size)
+                si += 1
+                if d == "c2s":
+                    await client.asend(data, tag)
+                else:
+                    await server.asend(ep, data, tag)
+            else:
+                _, d, tag, mask = op
+                buf = np.zeros(MAX_SIZE, dtype=np.uint8)
+                bufs[ri] = buf
+                futs[ri] = (server.arecv(buf, tag, mask) if d == "c2s"
+                            else client.arecv(buf, tag, mask))
+                ri += 1
+
+        await client.aflush()
+        await server.aflush()
+        # Matched recvs resolve; predicted-pending ones must still be open.
+        for rid, want in expected.items():
+            if want is None:
+                continue
+            stag, data = want
+            sender_tag, length = await asyncio.wait_for(futs[rid], timeout=20)
+            assert (int(sender_tag), int(length)) == (stag, len(data)), (
+                f"seed={seed} recv {rid}: got tag={sender_tag} len={length}, "
+                f"oracle says tag={stag} len={len(data)}")
+            np.testing.assert_array_equal(bufs[rid][:len(data)], data,
+                                          err_msg=f"seed={seed} recv {rid}")
+        await asyncio.sleep(0.1)
+        for rid, want in expected.items():
+            if want is None:
+                assert not futs[rid].done(), (
+                    f"seed={seed} recv {rid}: oracle says pending, but it "
+                    f"resolved to {futs[rid].result()}")
+    finally:
+        await client.aclose()
+        await server.aclose()
+        # Close cancels the predicted-pending recvs; drain their failures
+        # so the loop shuts down clean.
+        await asyncio.gather(*futs.values(), return_exceptions=True)
