@@ -11,6 +11,12 @@ of the group against it as the rows of one MXU matmul.  Masking and the
 online-softmax accumulation are fused; fully-masked blocks (beyond the
 current position) are skipped via scalar-prefetched ``pos``.
 
+Block size: decode is bandwidth-bound with a ~0.4 µs fixed cost per grid
+cell, so small blocks drown in cell overhead (measured r2: block 128 at
+T=8192 = 128 cells ≈ 51 µs of overhead on a 60.8 µs total — slower than
+the lax path).  The 512 default quarters the cell count; re-tune on real
+hardware with ``bench.py --kernels decode_tune``.
+
 Same online-softmax algebra as ops/pallas_attention.py; layouts follow
 models/generate.py: ``q [B, Hq, 1, D]``, caches ``[B, Hkv, T, D]``.
 """
@@ -73,7 +79,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
 
 def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
-                     block_k: int = 128, interpret=None):
+                     block_k: int = 512, interpret=None):
     """Cached single-query attention without expanding the grouped cache.
 
     q: [B, Hq, 1, D]; k_cache/v_cache: [B, Hkv, T, D]; pos: scalar int or

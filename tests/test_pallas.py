@@ -87,7 +87,12 @@ def test_flash_grad_uneven_blocks():
 
 
 @pytest.mark.parametrize("pos", [0, 5, 127, 128, 299])
-def test_decode_kernel_matches_lax(pos):
+@pytest.mark.parametrize("block_k", [128, None])
+def test_decode_kernel_matches_lax(pos, block_k):
+    """block_k=128 forces a MULTI-block grid at T=300 (the cross-block
+    online-softmax rescale and the repeated-block DMA clamp never run
+    otherwise — the 512 default is single-block at test sizes); None
+    covers the default config."""
     from starway_tpu.models.generate import _attend_cached
     from starway_tpu.ops.pallas_decode import decode_attention
 
@@ -97,7 +102,8 @@ def test_decode_kernel_matches_lax(pos):
     k = jax.random.normal(k2, (B, Hkv, T, D), jnp.float32)
     v = jax.random.normal(k3, (B, Hkv, T, D), jnp.float32)
     ref = _attend_cached(q, k, v, pos, Hq // Hkv, use_pallas=False)
-    out = decode_attention(q, k, v, pos, interpret=True)
+    kw = {} if block_k is None else {"block_k": block_k}
+    out = decode_attention(q, k, v, pos, interpret=True, **kw)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
@@ -130,7 +136,9 @@ def test_decode_kernel_per_row_pos():
     v = jax.random.normal(k3, (B, Hkv, T, D), jnp.float32)
     pos = jnp.asarray([7, 255, 130], jnp.int32)
 
-    out = decode_attention(q, k, v, pos, interpret=True)
+    # block_k=128: multi-block grid, so each row's DMA clamp really stops
+    # at a different block index.
+    out = decode_attention(q, k, v, pos, interpret=True, block_k=128)
     lax_out = _attend_cached(q, k, v, pos, Hq // Hkv, use_pallas=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(lax_out),
                                atol=2e-5, rtol=2e-5)
