@@ -33,28 +33,34 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
     }
 
 
-def _attend_cached(q, k_cache, v_cache, pos, n_rep, use_pallas=None):
+def _attend_cached(q, k_cache, v_cache, pos, n_rep, use_pallas=None,
+                   window=None):
     """q: [B, Hq, 1, D]; caches: [B, Hkv, T, D]; mask positions > pos.
-    ``pos`` is a scalar or a per-row [B] vector (ragged batches).
+    ``pos`` is a scalar or a per-row [B] vector (ragged batches);
+    ``window`` restricts to the last ``window`` positions (sliding-window
+    models).
 
     On TPU the pallas decode kernel (ops/pallas_decode.py) streams the
     grouped cache once instead of materialising ``repeat_kv`` — an
-    ``n_rep``× HBM-bandwidth saving on the bandwidth-bound decode step.
+    ``n_rep``× HBM-bandwidth saving on the bandwidth-bound decode step
+    (and only ~window bytes of it under a sliding window).
     """
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
         from ..ops.pallas_decode import decode_attention
 
-        return decode_attention(q, k_cache, v_cache, pos)
+        return decode_attention(q, k_cache, v_cache, pos, window=window)
     k = repeat_kv(k_cache, n_rep)
     v = repeat_kv(v_cache, n_rep)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s / (q.shape[-1] ** 0.5)
-    kv_pos = jnp.arange(k.shape[2])
-    pos_b = jnp.broadcast_to(jnp.asarray(pos).reshape(-1), (q.shape[0],))
-    s = jnp.where(kv_pos[None, None, None, :] <= pos_b[:, None, None, None],
-                  s, NEG_BIG)
+    kv_pos = jnp.arange(k.shape[2])[None, None, None, :]
+    pos_b = jnp.asarray(pos).reshape(-1)[:, None, None, None]
+    keep = kv_pos <= pos_b
+    if window is not None:
+        keep = keep & (kv_pos > pos_b - window)
+    s = jnp.where(keep, s, NEG_BIG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
@@ -102,7 +108,7 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
         k = apply_rope(k, cos_p, sin_p)
         kc = write(kc, k)
         vc = write(vc, v)
-        o = _attend_cached(q, kc, vc, pos, n_rep)
+        o = _attend_cached(q, kc, vc, pos, n_rep, window=cfg.sliding_window)
         o = o.transpose(0, 2, 1, 3).reshape(B, 1, cfg.n_heads * hd)
         h = h + o @ lp["wo"]
 

@@ -1,9 +1,11 @@
-"""HuggingFace Llama checkpoint -> starway-tpu parameter tree.
+"""HuggingFace Llama/Mistral checkpoint -> starway-tpu parameter tree.
 
-Bridges the ecosystem's weights into this framework: any
-``transformers.LlamaForCausalLM`` (or its state_dict) converts into the
-stacked-layer pytree ``models/llama.py`` trains and serves, and
-``config_from_hf`` derives the matching :class:`LlamaConfig`.
+Bridges the ecosystem's weights into this framework:
+``transformers.LlamaForCausalLM`` and ``MistralForCausalLM`` (same
+architecture; Mistral adds sliding-window attention, which maps onto
+``LlamaConfig.sliding_window``) convert into the stacked-layer pytree
+``models/llama.py`` trains and serves, and ``config_from_hf`` derives the
+matching :class:`LlamaConfig`.
 
 Convention notes (why this is transpose-and-stack, not surgery):
 
@@ -59,6 +61,9 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
         d_ff=hf_config.intermediate_size,
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
         norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
+        # Mistral-family configs carry sliding_window; same architecture
+        # otherwise, so the converter serves both families.
+        sliding_window=getattr(hf_config, "sliding_window", None),
     )
     kw.update(overrides)
     return LlamaConfig(**kw)

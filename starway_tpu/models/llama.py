@@ -45,6 +45,9 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     remat: bool = False
+    # Sliding-window (Mistral-style) attention: each position attends to
+    # the last `sliding_window` tokens only.  None = full causal.
+    sliding_window: Optional[int] = None
     # Mixture-of-experts FFN (0 = dense SwiGLU).  Experts shard over the
     # mesh "ep" axis (models/moe.py).
     n_experts: int = 0
@@ -196,14 +199,18 @@ def token_ce(logits, targets):
     return -jnp.mean(ll)
 
 
-def default_attn(q, k, v):
+def default_attn(q, k, v, window: Optional[int] = None):
     """Causal attention: the hand-tiled pallas kernel on TPU, the lax
-    blockwise scan elsewhere (bit-compatible algebra, same GQA handling)."""
-    if jax.default_backend() == "tpu":
+    blockwise scan elsewhere (bit-compatible algebra, same GQA handling).
+    ``window``: sliding-window causal — currently served by the blockwise
+    path everywhere (the flash kernel is full-causal only); XLA still
+    fuses the lax chain, and the decode side has a true windowed kernel
+    (ops/pallas_decode.py)."""
+    if window is None and jax.default_backend() == "tpu":
         from ..ops.pallas_attention import flash_attention
 
         return flash_attention(q, k, v, causal=True, interpret=False)
-    return blockwise_attention(q, k, v, causal=True)
+    return blockwise_attention(q, k, v, causal=True, window=window)
 
 
 # ----------------------------------------------------------------- forward
@@ -280,7 +287,19 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
     expert all-to-all over the "ep" mesh axis explicitly.
     """
     if attn_fn is None:
-        attn_fn = default_attn
+        if cfg.sliding_window is not None:
+            attn_fn = partial(default_attn, window=cfg.sliding_window)
+        else:
+            attn_fn = default_attn
+    elif cfg.sliding_window is not None and not getattr(
+            attn_fn, "handles_window", False):
+        # Silently training/serving full-causal on a windowed config is a
+        # different model; the sharded attentions (ring/zigzag/Ulysses)
+        # don't implement windows.  An attn_fn that does can opt in by
+        # setting `attn_fn.handles_window = True`.
+        raise ValueError(
+            "cfg.sliding_window is set but the supplied attn_fn does not "
+            "declare window support (attn_fn.handles_window)")
     B, S = tokens.shape
     cos, sin = rope_tables(S, cfg.head_dim, cfg.rope_theta)
 

@@ -100,7 +100,20 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
                          f"{n_stages} pipeline stages")
     if cfg.n_experts > 0:
         raise NotImplementedError("pp_llama supports dense models only")
-    attn = attn_fn if attn_fn is not None else default_attn
+    if attn_fn is None:
+        if cfg.sliding_window is not None:
+            from functools import partial
+
+            attn = partial(default_attn, window=cfg.sliding_window)
+        else:
+            attn = default_attn
+    elif cfg.sliding_window is not None and not getattr(
+            attn_fn, "handles_window", False):
+        raise ValueError(
+            "cfg.sliding_window is set but the supplied attn_fn does not "
+            "declare window support (attn_fn.handles_window)")
+    else:
+        attn = attn_fn
 
     def stage_fn(stage_lp, h):
         # Inside shard_map the stage tree keeps a leading local dim of 1

@@ -44,6 +44,7 @@ def partial_attention(
     causal: bool = False,
     kv_limit: Optional[int] = None,
     sm_scale: Optional[float] = None,
+    window: Optional[int] = None,
 ):
     """Attention of ``q`` against one kv block, in mergeable partial form.
 
@@ -52,7 +53,9 @@ def partial_attention(
     global positions of the first query/key token -- the causal mask is
     computed in global coordinates so blocks can come from anywhere in the
     sequence (ring steps pass traced offsets).  ``kv_limit`` masks key
-    positions at or beyond that global index (padding).
+    positions at or beyond that global index (padding).  ``window``
+    (requires ``causal``) keeps only the last ``window`` keys per query:
+    ``kv_pos in (q_pos - window, q_pos]`` (Mistral-style sliding window).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -63,6 +66,10 @@ def partial_attention(
     if causal:
         q_pos = q_offset + jnp.arange(q.shape[2])
         mask = mask & (q_pos[:, None] >= kv_pos[None, :])
+        if window is not None:
+            mask = mask & (kv_pos[None, :] > q_pos[:, None] - window)
+    elif window is not None:
+        raise ValueError("window requires causal attention")
     if kv_limit is not None:
         mask = mask & (kv_pos < kv_limit)[None, :]
     s = jnp.where(mask[None, None, :, :], s, NEG_BIG)
@@ -113,10 +120,12 @@ def blockwise_attention(
     causal: bool = False,
     block_k: int = 512,
     sm_scale: Optional[float] = None,
+    window: Optional[int] = None,
 ):
     """Single-device flash-style attention: scan over kv blocks with the
     online-softmax merge, never materialising the full [Tq, Tkv] matrix.
-    Grouped-query kv (fewer kv heads than q heads) is expanded here."""
+    Grouped-query kv (fewer kv heads than q heads) is expanded here.
+    ``window``: sliding-window causal (see :func:`partial_attention`)."""
     if k.shape[1] != q.shape[1]:
         n_rep = q.shape[1] // k.shape[1]
         k = repeat_kv(k, n_rep)
@@ -139,6 +148,7 @@ def blockwise_attention(
             q, k_i, v_i,
             q_offset=0, kv_offset=off,
             causal=causal, kv_limit=tkv if pad else None, sm_scale=sm_scale,
+            window=window,
         )
         return merge_partials(carry, part), None
 
@@ -146,14 +156,22 @@ def blockwise_attention(
     return finalize_partial(o, m, l, out_dtype=q.dtype)
 
 
-def attention_reference(q, k, v, *, causal: bool = False, sm_scale: Optional[float] = None):
+def attention_reference(q, k, v, *, causal: bool = False,
+                        sm_scale: Optional[float] = None,
+                        window: Optional[int] = None):
     """Plain materialised-softmax attention (test oracle)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
     if causal:
         tq, tkv = q.shape[2], k.shape[2]
-        mask = jnp.arange(tq)[:, None] >= jnp.arange(tkv)[None, :]
+        qp = jnp.arange(tq)[:, None]
+        kp = jnp.arange(tkv)[None, :]
+        mask = qp >= kp
+        if window is not None:
+            mask = mask & (kp > qp - window)
         s = jnp.where(mask[None, None, :, :], s, NEG_BIG)
+    elif window is not None:
+        raise ValueError("window requires causal attention")
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)

@@ -35,7 +35,8 @@ from .pallas_attention import _round_up
 
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                   *, sm_scale: float, block_k: int, hkv: int):
+                   *, sm_scale: float, block_k: int, hkv: int,
+                   window: "int | None"):
     ki = pl.program_id(1)
     n_k = pl.num_programs(1)
 
@@ -50,7 +51,12 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
     pos = pos_ref[pl.program_id(0) // hkv]
     k_start = ki * block_k
 
-    @pl.when(k_start <= pos)
+    live = k_start <= pos
+    if window is not None:
+        # Sliding window: this block must overlap (pos - window, pos].
+        live = live & (k_start + block_k - 1 > pos - window)
+
+    @pl.when(live)
     def _body():
         q = q_ref[0]  # [rows, D] — the group's query heads (padded to tile)
         k = k_ref[0]  # [block_k, D]
@@ -59,7 +65,10 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [rows, block_k]
         kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(kv_pos <= pos, s, NEG_BIG)
+        keep = kv_pos <= pos
+        if window is not None:
+            keep = keep & (kv_pos > pos - window)
+        s = jnp.where(keep, s, NEG_BIG)
 
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -79,13 +88,17 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
 
 def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
-                     block_k: int = 512, interpret=None):
+                     block_k: int = 512, interpret=None, window=None):
     """Cached single-query attention without expanding the grouped cache.
 
     q: [B, Hq, 1, D]; k_cache/v_cache: [B, Hkv, T, D]; pos: scalar int or
     per-row [B] int (ragged batches) — positions > pos[b] are masked for
-    row b, and row b's DMA stops at its own block.  Returns [B, Hq, 1, D].
-    Numerically matches models/generate.py:_attend_cached (softmax in f32).
+    row b, and row b's DMA stops at its own block.  ``window`` (static):
+    sliding-window attention over the last ``window`` positions — blocks
+    entirely below the window are DMA-elided too, so a windowed decode
+    streams ~window bytes of cache regardless of T.  Returns
+    [B, Hq, 1, D].  Numerically matches
+    models/generate.py:_attend_cached (softmax in f32).
     """
     b, hq, one, d = q.shape
     assert one == 1, "decode kernel takes a single query position"
@@ -116,17 +129,22 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
     pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
     grid = (b * hkv, t_pad // block_k)
 
-    # Clamp the K/V block index at the last block containing <= pos: the
-    # kernel body is skipped for blocks past pos (pl.when), and a repeated
-    # block index makes the Pallas pipeline elide the HBM copy entirely --
-    # so a decode at pos streams only ceil((pos+1)/block_k) blocks per row,
-    # not the whole padded cache.  (pl.when alone skips compute, not DMA.)
+    # Clamp the K/V block index into the live range: the kernel body is
+    # skipped outside it (pl.when), and a repeated block index makes the
+    # Pallas pipeline elide the HBM copy entirely -- so a decode at pos
+    # streams only the blocks holding (pos - window, pos], not the whole
+    # padded cache.  (pl.when alone skips compute, not DMA.)
     def _kv_index(bh, ki, pos_ref):
-        return (bh, jnp.minimum(ki, pos_ref[bh // hkv] // block_k), 0)
+        p = pos_ref[bh // hkv]
+        hi = p // block_k
+        if window is None:
+            return (bh, jnp.minimum(ki, hi), 0)
+        lo = jnp.maximum(p - window + 1, 0) // block_k
+        return (bh, jnp.clip(ki, lo, hi), 0)
 
     out = pl.pallas_call(
         functools.partial(_decode_kernel, sm_scale=sm_scale, block_k=block_k,
-                          hkv=hkv),
+                          hkv=hkv, window=None if window is None else int(window)),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,

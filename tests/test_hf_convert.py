@@ -63,3 +63,36 @@ def test_tied_embeddings_fallback(hf_model):
     params = params_from_hf(state, cfg)
     emb = np.asarray(params["embed"])
     np.testing.assert_array_equal(np.asarray(params["lm_head"]), emb.T)
+
+
+def test_mistral_logits_and_generation_match_transformers():
+    """Mistral = Llama architecture + sliding window: the converter maps
+    sliding_window through and both logits and greedy generation match
+    transformers' MistralForCausalLM."""
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, sliding_window=6,
+        attn_implementation="eager")
+    torch.manual_seed(3)
+    hf = transformers.MistralForCausalLM(hf_cfg).eval()
+
+    cfg = config_from_hf(hf.config, dtype="float32")
+    assert cfg.sliding_window == 6
+    params = params_from_hf(hf, cfg)
+
+    tokens = np.random.default_rng(1).integers(0, 256, (2, 20), dtype=np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+    prompt = np.asarray([[9, 4, 2]], dtype=np.int64)
+    with torch.no_grad():
+        hf_gen = hf.generate(torch.from_numpy(prompt), max_new_tokens=10,
+                             do_sample=False, pad_token_id=0).numpy()
+    ours_gen = np.asarray(generate(params, cfg,
+                                   jnp.asarray(prompt, jnp.int32), 10))
+    # transformers may stop early at its default eos; tokens must agree on
+    # the prefix it produced.
+    np.testing.assert_array_equal(ours_gen[:, :hf_gen.shape[1]], hf_gen)

@@ -147,3 +147,45 @@ def test_decode_kernel_per_row_pos():
                                 int(pos[b]), interpret=True)
         np.testing.assert_allclose(np.asarray(out[b]), np.asarray(solo[0]),
                                    atol=2e-5, rtol=2e-5, err_msg=f"row {b}")
+
+
+def test_decode_kernel_sliding_window():
+    """Windowed decode: kernel == lax windowed oracle, multi-block, with
+    the window straddling block boundaries; scalar and per-row pos."""
+    from starway_tpu.models.generate import _attend_cached
+    from starway_tpu.ops.pallas_decode import decode_attention
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(6), 3)
+    B, Hq, Hkv, T, D, W = 2, 8, 2, 520, 64, 200
+    q = jax.random.normal(k1, (B, Hq, 1, D), jnp.float32)
+    k = jax.random.normal(k2, (B, Hkv, T, D), jnp.float32)
+    v = jax.random.normal(k3, (B, Hkv, T, D), jnp.float32)
+    for pos in (0, 150, 380, 519):
+        out = decode_attention(q, k, v, pos, interpret=True, block_k=128,
+                               window=W)
+        ref = _attend_cached(q, k, v, pos, Hq // Hkv, use_pallas=False,
+                             window=W)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5, err_msg=f"pos={pos}")
+    pos_v = jnp.asarray([519, 77], jnp.int32)
+    out = decode_attention(q, k, v, pos_v, interpret=True, block_k=128,
+                           window=W)
+    ref = _attend_cached(q, k, v, pos_v, Hq // Hkv, use_pallas=False, window=W)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_blockwise_window_matches_reference():
+    from starway_tpu.ops.attention import blockwise_attention
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
+    B, H, S, D, W = 1, 4, 300, 32, 90
+    q = jax.random.normal(k1, (B, H, S, D), jnp.float32)
+    k = jax.random.normal(k2, (B, H, S, D), jnp.float32)
+    v = jax.random.normal(k3, (B, H, S, D), jnp.float32)
+    ref = attention_reference(q, k, v, causal=True, window=W)
+    out = blockwise_attention(q, k, v, causal=True, block_k=64, window=W)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    with pytest.raises(ValueError):
+        attention_reference(q, k, v, causal=False, window=W)

@@ -149,6 +149,38 @@ def test_pp_llama_grads_match_single_device():
     assert tuple(specs["embed"]) == ()
 
 
+def test_pp_llama_sliding_window():
+    """A windowed config trains windowed under pp: loss + grads match the
+    flat single-device windowed loss, and a custom attn_fn without window
+    support is rejected."""
+    from starway_tpu.models import LlamaConfig, init_params
+    from starway_tpu.models.llama import loss_fn as flat_loss
+    from starway_tpu.models.pp_llama import (
+        make_pp_llama_train, pp_split_params, shard_pp_params)
+    from starway_tpu.parallel import make_mesh
+
+    cfg = LlamaConfig.preset("debug", n_layers=2, d_model=64, n_heads=4,
+                             n_kv_heads=2, d_ff=96, vocab_size=128,
+                             sliding_window=4)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh({"pp": 2})
+    batch = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (4, 13), dtype=np.int32))
+
+    pp = shard_pp_params(pp_split_params(params, 2), mesh)
+    step = make_pp_llama_train(mesh, cfg, n_micro=2)
+    loss_pp, grads_pp = step(pp, batch)
+    loss_ref, grads_ref = jax.value_and_grad(flat_loss)(params, batch, cfg)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grads_pp["embed"]), np.asarray(grads_ref["embed"]),
+        atol=2e-5, rtol=2e-4)
+
+    with pytest.raises(ValueError, match="handles_window"):
+        make_pp_llama_train(mesh, cfg, n_micro=2,
+                            attn_fn=lambda q, k, v: q)
+
+
 def test_schedule_formulas():
     """The 1F1B profile this module promises: M + 2(S-1) ticks, O(S) stash."""
     assert pipeline_ticks(8, 4) == 14
