@@ -55,6 +55,11 @@ class LlamaConfig:
     moe_aux_coef: float = 0.01
     moe_top_k: int = 1
 
+    def __post_init__(self):
+        if self.sliding_window is not None and self.sliding_window < 1:
+            raise ValueError(
+                f"sliding_window must be >= 1, got {self.sliding_window}")
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
@@ -202,15 +207,36 @@ def token_ce(logits, targets):
 def default_attn(q, k, v, window: Optional[int] = None):
     """Causal attention: the hand-tiled pallas kernel on TPU, the lax
     blockwise scan elsewhere (bit-compatible algebra, same GQA handling).
-    ``window``: sliding-window causal — currently served by the blockwise
-    path everywhere (the flash kernel is full-causal only); XLA still
-    fuses the lax chain, and the decode side has a true windowed kernel
-    (ops/pallas_decode.py)."""
-    if window is None and jax.default_backend() == "tpu":
+    ``window``: sliding-window causal — the flash kernel masks, skips, and
+    DMA-elides out-of-window blocks in forward AND backward."""
+    if jax.default_backend() == "tpu":
         from ..ops.pallas_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True, interpret=False)
+        return flash_attention(q, k, v, causal=True, interpret=False,
+                               window=window)
     return blockwise_attention(q, k, v, causal=True, window=window)
+
+
+def resolve_attn_fn(cfg: LlamaConfig, attn_fn: Optional[Callable]) -> Callable:
+    """The one place attn_fn defaults and the sliding-window guard live
+    (shared by the scan forward and models/pp_llama.py).
+
+    None -> :func:`default_attn`, window-bound when the config has one.  A
+    supplied attn_fn on a windowed config must declare
+    ``attn_fn.handles_window = True`` — silently training/serving
+    full-causal on a windowed config is a different model, and the sharded
+    attentions (ring/zigzag/Ulysses) don't implement windows.
+    """
+    if attn_fn is None:
+        if cfg.sliding_window is not None:
+            return partial(default_attn, window=cfg.sliding_window)
+        return default_attn
+    if cfg.sliding_window is not None and not getattr(
+            attn_fn, "handles_window", False):
+        raise ValueError(
+            "cfg.sliding_window is set but the supplied attn_fn does not "
+            "declare window support (attn_fn.handles_window)")
+    return attn_fn
 
 
 # ----------------------------------------------------------------- forward
@@ -286,20 +312,7 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
     :func:`~starway_tpu.models.moe.make_sharded_moe`'s result to pin the
     expert all-to-all over the "ep" mesh axis explicitly.
     """
-    if attn_fn is None:
-        if cfg.sliding_window is not None:
-            attn_fn = partial(default_attn, window=cfg.sliding_window)
-        else:
-            attn_fn = default_attn
-    elif cfg.sliding_window is not None and not getattr(
-            attn_fn, "handles_window", False):
-        # Silently training/serving full-causal on a windowed config is a
-        # different model; the sharded attentions (ring/zigzag/Ulysses)
-        # don't implement windows.  An attn_fn that does can opt in by
-        # setting `attn_fn.handles_window = True`.
-        raise ValueError(
-            "cfg.sliding_window is set but the supplied attn_fn does not "
-            "declare window support (attn_fn.handles_window)")
+    attn_fn = resolve_attn_fn(cfg, attn_fn)
     B, S = tokens.shape
     cos, sin = rope_tables(S, cfg.head_dim, cfg.rope_theta)
 

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from .llama import (LlamaConfig, decoder_layer, default_attn, head_logits,
+from .llama import (LlamaConfig, decoder_layer, head_logits, resolve_attn_fn,
                     rope_tables, token_ce)
 from ..parallel.pipeline import make_pipeline_train
 
@@ -100,20 +100,7 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
                          f"{n_stages} pipeline stages")
     if cfg.n_experts > 0:
         raise NotImplementedError("pp_llama supports dense models only")
-    if attn_fn is None:
-        if cfg.sliding_window is not None:
-            from functools import partial
-
-            attn = partial(default_attn, window=cfg.sliding_window)
-        else:
-            attn = default_attn
-    elif cfg.sliding_window is not None and not getattr(
-            attn_fn, "handles_window", False):
-        raise ValueError(
-            "cfg.sliding_window is set but the supplied attn_fn does not "
-            "declare window support (attn_fn.handles_window)")
-    else:
-        attn = attn_fn
+    attn = resolve_attn_fn(cfg, attn_fn)
 
     def stage_fn(stage_lp, h):
         # Inside shard_map the stage tree keeps a leading local dim of 1

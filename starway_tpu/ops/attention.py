@@ -63,6 +63,8 @@ def partial_attention(
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
     kv_pos = kv_offset + jnp.arange(k.shape[2])
     mask = jnp.ones((q.shape[2], k.shape[2]), dtype=bool)
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     if causal:
         q_pos = q_offset + jnp.arange(q.shape[2])
         mask = mask & (q_pos[:, None] >= kv_pos[None, :])
@@ -163,6 +165,8 @@ def attention_reference(q, k, v, *, causal: bool = False,
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     if causal:
         tq, tkv = q.shape[2], k.shape[2]
         qp = jnp.arange(tq)[:, None]

@@ -76,6 +76,7 @@ class _Cfg(NamedTuple):
     bwd_block_q: int
     bwd_block_k: int
     interpret: bool
+    window: Optional[int] = None  # sliding window (requires causal)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -88,13 +89,14 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _mask_scores(s, q_start, k_start, kv_len, kv_pad, causal,
-                 k_start_local=None):
-    """Apply causal/padding masking to a score block.
+                 k_start_local=None, window=None):
+    """Apply causal/window/padding masking to a score block.
 
     ``q_start``/``k_start`` are GLOBAL sequence coordinates (they differ
     from the in-array block position when a ring step supplies offsets);
     ``k_start_local`` is the in-array key position the padding compare
     needs — it defaults to ``k_start`` for the offset-free path.
+    ``window`` (with ``causal``) keeps ``k_pos in (q_pos - window, q_pos]``.
 
     The kv-padding compare is skipped at *trace* time when the sequence
     needs no padding (the common case); a scalar `lax.cond` around the
@@ -112,13 +114,15 @@ def _mask_scores(s, q_start, k_start, kv_len, kv_pad, causal,
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         c = q_pos >= k_pos
+        if window is not None:
+            c = c & (k_pos > q_pos - window)
         mask = c if mask is None else mask & c
     return s if mask is None else jnp.where(mask, s, NEG_BIG)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
                 sm_scale: float, block_q: int, block_k: int, kv_len: int,
-                kv_pad: int, save_lse: bool):
+                kv_pad: int, save_lse: bool, window: "int | None" = None):
     if save_lse:
         lse_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -143,16 +147,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [block_q, block_k]
-        s = _mask_scores(s, q_start, k_start, kv_len, kv_pad, causal)
+        s = _mask_scores(s, q_start, k_start, kv_len, kv_pad, causal,
+                         window=window)
 
         # Row stats live in (block_q, 128) lanes (TPU tile granularity);
         # column 0 is authoritative.  Masked entries hold NEG_BIG, so
-        # exp(s - m_new) underflows to exactly 0 — no select needed (every
-        # row sees at least key 0 on its first live kv block, so m_new is
-        # always finite).
+        # exp(s - m_new) underflows to exactly 0 — no select needed for
+        # full causal (every row sees at least key 0 on its first live kv
+        # block, so m_new is always finite).  With a WINDOW an entire row
+        # of a live block can be masked (its window starts in a later
+        # block); clamping only exp's argument keeps its p at exactly 0.
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        if window is not None:
+            p = jnp.exp(s - jnp.maximum(m_new, NEG_BIG / 2))
+        else:
+            p = jnp.exp(s - m_new)
         corr = jnp.exp(m_prev - m_new)
         l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
@@ -164,8 +174,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
 
     if causal:
         # Live iff the block's first key position can be visible to the
-        # block's last query position.
-        pl.when(k_start <= q_start + block_q - 1)(_body)
+        # block's last query position — and, with a window, its last key
+        # position can still be inside the block's first query's window.
+        live = k_start <= q_start + block_q - 1
+        if window is not None:
+            live = live & (k_start + block_k - 1 > q_start - window)
+        pl.when(live)(_body)
     else:
         _body()
 
@@ -206,8 +220,13 @@ def _fwd_impl(q, k, v, cfg: _Cfg, save_lse: bool):
         # see.  The kernel skips those blocks' compute (pl.when); repeating
         # the block index makes the pipeline elide their HBM copies too, so
         # the upper triangle costs no bandwidth (~2x saving at long S).
+        # A window adds the symmetric LOWER clamp: blocks entirely below
+        # every row's window are elided the same way.
         if cfg.causal:
             j = jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
+            if cfg.window is not None:
+                lo = jnp.maximum(i * block_q - (cfg.window - 1), 0) // block_k
+                j = jnp.maximum(j, lo)
         return (kv_head(bh), j, 0)
 
     grid = (b * hq, s_pad // block_q, kv_pad // block_k)
@@ -223,7 +242,7 @@ def _fwd_impl(q, k, v, cfg: _Cfg, save_lse: bool):
         functools.partial(
             _fwd_kernel, causal=cfg.causal, sm_scale=cfg.sm_scale,
             block_q=block_q, block_k=block_k, kv_len=kv_len, kv_pad=kv_pad,
-            save_lse=save_lse,
+            save_lse=save_lse, window=cfg.window,
         ),
         grid=grid,
         in_specs=[
@@ -268,13 +287,16 @@ def _fwd_impl(q, k, v, cfg: _Cfg, save_lse: bool):
 
 
 def _bwd_block(q, do, k, v, lse, delta, *, causal, sm_scale, q_glob, k_glob,
-               k_local, kv_len, kv_pad):
-    """Shared recompute: returns (p, ds), both [block_q, block_k] f32."""
+               k_local, kv_len, kv_pad, window=None):
+    """Shared recompute: returns (p, ds), both [block_q, block_k] f32.
+    Masked entries get p = exp(NEG_BIG - lse) = 0 (lse is finite for every
+    real row), so no all-masked-row handling is needed here even with a
+    window."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * sm_scale
     s = _mask_scores(s, q_glob, k_glob, kv_len, kv_pad, causal,
-                     k_start_local=k_local)
+                     k_start_local=k_local, window=window)
     p = jnp.exp(s - lse)  # normalised probs; masked entries -> 0
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -286,7 +308,8 @@ def _bwd_block(q, do, k, v, lse, delta, *, causal, sm_scale, q_glob, k_glob,
 def _bwd_dkv_kernel(offs_ref, q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
                     sm_scale: float, block_q: int, block_k: int,
-                    kv_len: int, kv_pad: int, n_q: int):
+                    kv_len: int, kv_pad: int, n_q: int,
+                    window: "int | None" = None):
     ki = pl.program_id(1)
     inner = pl.program_id(2)
     n_inner = pl.num_programs(2)
@@ -308,6 +331,7 @@ def _bwd_dkv_kernel(offs_ref, q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
             q, do, k_ref[0], v_ref[0], lse_ref[0][:, :1], delta_ref[0][:, :1],
             causal=causal, sm_scale=sm_scale, q_glob=q_glob,
             k_glob=k_glob, k_local=k_local, kv_len=kv_len, kv_pad=kv_pad,
+            window=window,
         )
         # P^T dO and dS^T Q: contract the shared block_q dim (dim 0 of both).
         dv_scr[:] += jax.lax.dot_general(
@@ -320,8 +344,13 @@ def _bwd_dkv_kernel(offs_ref, q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
         )
 
     if causal:
-        # Live iff this q block reaches at or below the kv block's first row.
-        pl.when(q_glob + block_q - 1 >= k_glob)(_body)
+        # Live iff this q block reaches at or below the kv block's first
+        # row — and, with a window, starts before the block's last key
+        # falls out of every query's window.
+        live = q_glob + block_q - 1 >= k_glob
+        if window is not None:
+            live = live & (k_glob + block_k - 1 > q_glob - window)
+        pl.when(live)(_body)
     else:
         _body()
 
@@ -333,7 +362,8 @@ def _bwd_dkv_kernel(offs_ref, q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(offs_ref, q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr, *, causal: bool, sm_scale: float,
-                   block_q: int, block_k: int, kv_len: int, kv_pad: int):
+                   block_q: int, block_k: int, kv_len: int, kv_pad: int,
+                   window: "int | None" = None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -352,7 +382,7 @@ def _bwd_dq_kernel(offs_ref, q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
             q_ref[0], do_ref[0], k, v_ref[0], lse_ref[0][:, :1],
             delta_ref[0][:, :1], causal=causal, sm_scale=sm_scale,
             q_glob=q_glob, k_glob=k_glob, k_local=k_local, kv_len=kv_len,
-            kv_pad=kv_pad,
+            kv_pad=kv_pad, window=window,
         )
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -360,7 +390,10 @@ def _bwd_dq_kernel(offs_ref, q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
         )
 
     if causal:
-        pl.when(k_glob <= q_glob + block_q - 1)(_body)
+        live = k_glob <= q_glob + block_q - 1
+        if window is not None:
+            live = live & (k_glob + block_k - 1 > q_glob - window)
+        pl.when(live)(_body)
     else:
         _body()
 
@@ -371,7 +404,7 @@ def _bwd_dq_kernel(offs_ref, q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
 
 def _run_bwd_passes(qf, dof, kf, vf, lse8, delta8, offs, *, b, hq, hkv,
                     s_pad, kv_pad, d, kv_len, block_q, block_k, causal,
-                    sm_scale, interpret, dq_dtype, dkv_dtype):
+                    sm_scale, interpret, dq_dtype, dkv_dtype, window=None):
     """Both backward passes over flattened [BH, S, D] operands.
 
     ``offs`` is the int32[2] global-offset vector (zeros for the plain
@@ -394,6 +427,11 @@ def _run_bwd_passes(qf, dof, kf, vf, lse8, delta8, offs, *, b, hq, hkv,
             # coords: first live q row is kv_off + ki*bk - q_off.
             first = (offs[1] + ki * block_k - offs[0]) // block_q
             qi = jnp.maximum(qi, jnp.clip(first, 0, n_q - 1))
+            if window is not None:
+                # Window: q blocks past every key's window are dead too.
+                last = (offs[1] + ki * block_k + block_k - 1 + window - 1
+                        - offs[0]) // block_q
+                qi = jnp.minimum(qi, jnp.clip(last, 0, n_q - 1))
         return qi
 
     qdo_spec = pl.BlockSpec(
@@ -421,7 +459,7 @@ def _run_bwd_passes(qf, dof, kf, vf, lse8, delta8, offs, *, b, hq, hkv,
         functools.partial(
             _bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, kv_len=kv_len, kv_pad=kv_pad,
-            n_q=n_q,
+            n_q=n_q, window=window,
         ),
         grid_spec=grid_a,
         out_shape=[
@@ -440,6 +478,10 @@ def _run_bwd_passes(qf, dof, kf, vf, lse8, delta8, offs, *, b, hq, hkv,
             # Last kv block any row of q block i can see, in global coords.
             last = (offs[0] + i * block_q + block_q - 1 - offs[1]) // block_k
             j = jnp.minimum(j, jnp.clip(last, 0, n_kv - 1))
+            if window is not None:
+                first = (offs[0] + i * block_q - (window - 1)
+                         - offs[1]) // block_k
+                j = jnp.maximum(j, jnp.clip(first, 0, n_kv - 1))
         return j
 
     qdo_spec_b = pl.BlockSpec(
@@ -463,6 +505,7 @@ def _run_bwd_passes(qf, dof, kf, vf, lse8, delta8, offs, *, b, hq, hkv,
         functools.partial(
             _bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, kv_len=kv_len, kv_pad=kv_pad,
+            window=window,
         ),
         grid_spec=grid_b,
         out_shape=jax.ShapeDtypeStruct((b * hq, s_pad, d), dq_dtype),
@@ -520,7 +563,7 @@ def _bwd_impl(q, k, v, o, lse, do, cfg: _Cfg):
         ops.pop("lse8"), ops.pop("delta8"), jnp.zeros((2,), jnp.int32),
         block_q=block_q, block_k=block_k, causal=cfg.causal,
         sm_scale=cfg.sm_scale, interpret=cfg.interpret,
-        dq_dtype=q.dtype, dkv_dtype=k.dtype, **ops)
+        dq_dtype=q.dtype, dkv_dtype=k.dtype, window=cfg.window, **ops)
 
     dq = dq.reshape(b, hq, -1, d)[:, :, :s, :]
     dk = dk.reshape(b, hkv, -1, d)[:, :, :kv_len, :]
@@ -764,18 +807,28 @@ def flash_attention(
     bwd_block_q: Optional[int] = None,
     bwd_block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ):
     """Flash attention, differentiable.  q: [B,Hq,S,D]; k/v: [B,Hkv,S,D]
     (grouped).
 
     Pads S to the block size internally; padded keys are masked, padded
-    query rows are sliced off the output.  Backward runs the hand-written
+    query rows are sliced off the output.  ``window`` (requires
+    ``causal``): sliding-window attention — kv blocks outside
+    ``(q - window, q]`` are masked, compute-skipped, AND DMA-elided in
+    both the forward and the two backward passes, so a windowed pass
+    streams O(S·window) bytes, not O(S²).  Backward runs the hand-written
     two-pass Pallas kernel (see module docstring).  Explicit forward blocks
     are inherited by the backward only up to the safe backward defaults —
     the backward holds more live intermediates per cell, and oversized
     blocks there hang the Mosaic compile (see DEFAULT_BWD_* above); pass
     ``bwd_block_q``/``bwd_block_k`` to override deliberately.
     """
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal attention")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
@@ -792,5 +845,6 @@ def flash_attention(
             int(block_k) if block_k else DEFAULT_BWD_BLOCK_K,
             DEFAULT_BWD_BLOCK_K),
         interpret=bool(interpret),
+        window=None if window is None else int(window),
     )
     return _flash(q, k, v, cfg)

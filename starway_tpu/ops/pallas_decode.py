@@ -100,6 +100,8 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
     [B, Hq, 1, D].  Numerically matches
     models/generate.py:_attend_cached (softmax in f32).
     """
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     b, hq, one, d = q.shape
     assert one == 1, "decode kernel takes a single query position"
     hkv, t = k_cache.shape[1], k_cache.shape[2]
