@@ -168,6 +168,27 @@ def bench_flash_fwd(b=1, hq=8, hkv=2, s=8192, d=128, causal=True, iters: int = 8
                       f"bf16, {dt*1e3:.2f} ms/iter"}
 
 
+def bench_flash_window(b=1, hq=8, hkv=2, s=8192, d=128, window=1024,
+                       iters: int = 8):
+    """Windowed flash fwd: the DMA band means compute AND bandwidth scale
+    with S*window, not S^2 — compare against the causal row to see it."""
+    from starway_tpu.ops.pallas_attention import flash_attention
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, hq, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, hkv, s, d), jnp.bfloat16)
+    kern = functools.partial(flash_attention, causal=True, window=window)
+    dt = _timeit(lambda q, k, v, iters: _chain(kern, q, k, v, iters=iters),
+                 q, k, v, iters=iters)
+    # Useful flops: ~4*b*hq*s*window*d (each query attends ~window keys).
+    flops = 4 * b * hq * s * min(window, s) * d
+    return {"metric": "flash_window_tflops", "value": round(flops / dt / 1e12, 2),
+            "unit": "TFLOP/s",
+            "detail": f"B={b} Hq={hq} Hkv={hkv} S={s} D={d} window={window} "
+                      f"bf16, {dt*1e3:.2f} ms/iter (banded-useful flops)"}
+
+
 def bench_flash_bwd(b=1, hq=8, hkv=2, s=8192, d=128, causal=True, iters: int = 4,
                     impl="ours"):
     from starway_tpu.ops.pallas_attention import flash_attention
@@ -381,6 +402,7 @@ BENCHES = {
     "matmul": bench_matmul,
     "flash": bench_flash_fwd,
     "flash_stock": functools.partial(bench_flash_fwd, impl="stock"),
+    "flash_window": bench_flash_window,
     "flash_bwd": bench_flash_bwd,
     "flash_bwd_stock": functools.partial(bench_flash_bwd, impl="stock"),
     "decode": bench_decode,
