@@ -1,0 +1,49 @@
+#!/bin/bash
+# One-shot on-chip measurement queue: run when TPU hardware is reachable.
+#
+# Refreshes every row in BASELINE.md's round-2 table, including the items
+# the chip outage left pending (decode @ the new block_k=512 default,
+# the decode_tune sweep behind it, and the windowed flash row).  Each
+# section prints JSON rows; paste the results into BASELINE.md.
+#
+# Usage:  bash scripts/onchip_refresh.sh [outfile]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/onchip_rows.json}"
+: > "$OUT"
+
+probe() {
+  timeout 90 python -c "import jax, jax.numpy as j; float((j.ones(4)+1).sum())" \
+    2>/dev/null || { echo "device backend unresponsive; aborting" >&2; exit 1; }
+}
+
+run() {  # run <which> [extra flags...]
+  local which="$1"; shift
+  echo "== $which" >&2
+  probe  # the tunnel can die mid-queue; fail fast, not per-row timeouts
+  local log tmp rc
+  log="$(mktemp)"; tmp="$(mktemp)"
+  timeout 1200 python bench.py --kernels "$which" "$@" >"$tmp" 2>"$log"
+  rc=$?
+  grep '"metric"' "$tmp" | tee -a "$OUT"
+  if [ $rc -ne 0 ] || ! grep -q '"metric"' "$tmp"; then
+    echo "{\"metric\": \"${which}\", \"error\": \"rc=$rc (124=timeout); see $log\"}" \
+      | tee -a "$OUT" >&2
+  else
+    rm -f "$log"
+  fi
+  rm -f "$tmp"
+}
+
+probe
+run matmul
+run flash
+run flash_window
+run flash_bwd
+run decode            # block_k=512 default: the row BASELINE.md flags as pending
+run decode_lax
+run decode_tune       # block_k sweep; update the default if 512 is not the winner
+run train_mfu
+echo "== check" >&2
+timeout 1200 python bench.py --kernels check 2>/dev/null | grep '"metric"' | tee -a "$OUT"
+echo "rows written to $OUT" >&2
