@@ -33,6 +33,15 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
     }
 
 
+def init_rolling_cache(cfg: LlamaConfig, batch: int) -> dict:
+    """O(window) cache for sliding-window models: ``sliding_window`` slots
+    per layer, written modulo the window (see ``decode_step(rolling=True)``).
+    Generation length no longer bounds cache memory."""
+    if cfg.sliding_window is None:
+        raise ValueError("rolling caches require cfg.sliding_window")
+    return init_cache(cfg, batch, cfg.sliding_window)
+
+
 def _attend_cached(q, k_cache, v_cache, pos, n_rep, use_pallas=None,
                    window=None):
     """q: [B, Hq, 1, D]; caches: [B, Hkv, T, D]; mask positions > pos.
@@ -66,19 +75,37 @@ def _attend_cached(q, k_cache, v_cache, pos, n_rep, use_pallas=None,
 
 
 def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
-                rope=None):
+                rope=None, rolling: bool = False):
     """One token in, next-token logits out.  token: [B] int32; pos: the
-    position of ``token`` — a scalar (aligned batch) or a per-row [B]
-    vector (ragged batch: every row sits at its own cursor).  Returns
-    (logits [B, V], updated cache)."""
+    ABSOLUTE position of ``token`` — a scalar (aligned batch) or a per-row
+    [B] vector (ragged batch: every row sits at its own cursor).  Returns
+    (logits [B, V], updated cache).
+
+    ``rolling``: the cache is a circular window of exactly
+    ``cfg.sliding_window`` slots (``init_rolling_cache``) — writes go to
+    ``pos % window``, and attention covers every warm slot with no window
+    re-mask (the residents ARE the window; keys carry their absolute RoPE,
+    and attention is permutation-invariant over keys, so slot order never
+    matters).  Cache memory is O(window) for any generation length."""
     B = token.shape[0]
     hd = cfg.head_dim
     n_rep = cfg.n_heads // cfg.n_kv_heads
+    T = cache["k"].shape[3]
+    if rolling:
+        if cfg.sliding_window is None or T != cfg.sliding_window:
+            raise ValueError(
+                f"rolling decode needs a cache of exactly sliding_window="
+                f"{cfg.sliding_window} slots, got {T}")
     if rope is None:
-        rope = rope_tables(cache["k"].shape[3], hd, cfg.rope_theta)
+        if rolling:
+            # Absolute positions exceed the cache size; the caller knows the
+            # true horizon, we don't.
+            raise ValueError("rolling decode requires explicit rope tables")
+        rope = rope_tables(T, hd, cfg.rope_theta)
     cos, sin = rope
     pos = jnp.asarray(pos, jnp.int32)
     per_row = pos.ndim == 1
+    slot = jax.lax.rem(pos, T) if rolling else pos
     if per_row:
         # [B, 1, 1, hd/2]: one rotation angle per row, broadcast over heads.
         cos_p = cos[pos][:, None, None, :]
@@ -87,13 +114,13 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
         def write(c, u):
             return jax.vmap(
                 lambda cr, ur, p: lax.dynamic_update_slice_in_dim(
-                    cr, ur, p, axis=1))(c, u, pos)
+                    cr, ur, p, axis=1))(c, u, slot)
     else:
         cos_p = lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
         sin_p = lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
 
         def write(c, u):
-            return lax.dynamic_update_slice_in_dim(c, u, pos, axis=2)
+            return lax.dynamic_update_slice_in_dim(c, u, slot, axis=2)
 
     h = params["embed"][token][:, None, :]  # [B, 1, D]
 
@@ -108,7 +135,14 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
         k = apply_rope(k, cos_p, sin_p)
         kc = write(kc, k)
         vc = write(vc, v)
-        o = _attend_cached(q, kc, vc, pos, n_rep, window=cfg.sliding_window)
+        if rolling:
+            # Warm slots are exactly the window (we just overwrote the
+            # oldest); cold-start slots (> pos) are masked by the clamped
+            # position.  No window re-mask: absolute order is irrelevant.
+            o = _attend_cached(q, kc, vc, jnp.minimum(pos, T - 1), n_rep)
+        else:
+            o = _attend_cached(q, kc, vc, pos, n_rep,
+                               window=cfg.sliding_window)
         o = o.transpose(0, 2, 1, 3).reshape(B, 1, cfg.n_heads * hd)
         h = h + o @ lp["wo"]
 
@@ -203,11 +237,31 @@ def _compiled_generate(cfg: LlamaConfig, B: int, P: int, max_new: int,
 
     ``ragged``: the compiled fn takes per-row prompt lengths; every row
     decodes from its own cursor (see :func:`generate`'s contract).
+
+    Sliding-window configs on the aligned path decode through a ROLLING
+    cache of ``sliding_window`` slots whenever that is smaller than
+    ``max_len`` — cache memory is O(window) however long the generation
+    runs, and the tokens are bit-identical to the full-cache path (pinned
+    by tests/test_generate.py).
     """
     rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
+    W = cfg.sliding_window
+    rolling = (not ragged) and W is not None and W < max_len
 
     def run(params, prompt, key, lengths):
-        if ragged:
+        if rolling:
+            if P <= W:
+                # prefill's own padding already yields the rolling layout
+                # (slot p % W == p while p < W).
+                logits, cache = prefill(params, cfg, prompt, W)
+            else:
+                logits, cache = prefill(params, cfg, prompt, P)  # unpadded
+                # Keep the last W positions, each at its slot p % W.
+                src = (P - W) + ((jnp.arange(W) - (P - W)) % W)
+                cache = {"k": jnp.take(cache["k"], src, axis=3),
+                         "v": jnp.take(cache["v"], src, axis=3)}
+            pos0 = jnp.asarray(P, jnp.int32)
+        elif ragged:
             # Right-padded prompts: causal attention already confines every
             # real position to real prefixes (pad positions only corrupt
             # their OWN states, which are never read — hence the dense-only
@@ -235,7 +289,8 @@ def _compiled_generate(cfg: LlamaConfig, B: int, P: int, max_new: int,
             cache, logits, key, pos, done = carry
             key, sub = jax.random.split(key)
             tok, done = emit(logits, sub, done)
-            logits, cache = decode_step(params, cache, tok, pos, cfg, rope)
+            logits, cache = decode_step(params, cache, tok, pos, cfg, rope,
+                                        rolling=rolling)
             return (cache, logits, key, pos + 1, done), tok
 
         # Scan max_new - 1 sample->decode pairs, then sample the final token

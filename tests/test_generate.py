@@ -228,3 +228,64 @@ def test_sliding_window_cached_decode_matches_forward():
     # (silent full-causal on a windowed config would be a different model).
     with pytest.raises(ValueError, match="handles_window"):
         forward(params, tokens, cfg, attn_fn=lambda q, k, v: q)
+
+
+def test_rolling_cache_matches_full_model():
+    """Rolling O(window) decode must reproduce the windowed model exactly:
+    greedy generation equals the full re-forward oracle at every step
+    (prompt longer AND shorter than the window), and rolling teacher
+    forcing matches forward logits past the wrap point."""
+    from starway_tpu.models.generate import init_rolling_cache
+
+    cfg = LlamaConfig.preset("debug", sliding_window=5)
+    params = init_params(jax.random.PRNGKey(6), cfg)
+
+    for P in (3, 9):  # straddles W=5
+        prompt = jnp.asarray(
+            np.random.default_rng(P).integers(0, cfg.vocab_size, (2, P),
+                                              dtype=np.int32))
+        max_new = 7
+        out = generate(params, cfg, prompt, max_new)  # rolling auto-engages
+        # Oracle: re-run the full windowed forward for every next token.
+        toks = prompt
+        for _ in range(max_new):
+            logits = forward(params, toks, cfg)[:, -1]
+            toks = jnp.concatenate(
+                [toks, jnp.argmax(logits, -1)[:, None].astype(jnp.int32)], 1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(toks),
+                                      err_msg=f"P={P}")
+
+    # Teacher forcing through the wrap: rolling decode logits == forward.
+    B, S = 2, 14
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (B, S), dtype=np.int32))
+    full = forward(params, tokens, cfg)
+    cache = init_rolling_cache(cfg, B)
+    rope = rope_tables(S, cfg.head_dim, cfg.rope_theta)
+    for i in range(S):
+        logits, cache = decode_step(params, cache, tokens[:, i], i, cfg,
+                                    rope, rolling=True)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, i, :]),
+                                   atol=2e-4, rtol=2e-4, err_msg=f"pos {i}")
+    assert cache["k"].shape[3] == 5  # O(window), not O(S)
+
+    # The COMPILED generate path must actually engage the rolling cache: its
+    # lowering carries the [L, B, Hkv, W, hd] = [2, 2, 4, 5, 16] cache and
+    # no full-length [.., 16, 16] cache (P=9 + max_new=7 -> max_len=16).
+    # Token equality alone cannot catch the gate silently regressing to the
+    # O(max_len) path.
+    from starway_tpu.models.generate import _compiled_generate
+
+    run = _compiled_generate(cfg, 2, 9, 7, 16, 0.0, None, None, False, None)
+    prompt = jnp.zeros((2, 9), jnp.int32)
+    txt = run.lower(params, prompt, jax.random.PRNGKey(0),
+                    jnp.zeros((2,), jnp.int32)).as_text()
+    assert "2x2x4x5x16" in txt, "rolling cache did not engage"
+    assert "2x2x4x16x16" not in txt, "full-length cache still materialised"
+
+    with pytest.raises(ValueError):
+        init_rolling_cache(LlamaConfig.preset("debug"), 1)
+    with pytest.raises(ValueError):
+        decode_step(params, init_cache(cfg, B, 9), tokens[:, 0], 0, cfg,
+                    rope, rolling=True)  # cache size != window
