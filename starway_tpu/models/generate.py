@@ -200,6 +200,94 @@ def prefill(params: dict, cfg: LlamaConfig, prompt,
     return logits[:, 0], {"k": ks, "v": vs}
 
 
+def prefill_rolling(params: dict, cfg: LlamaConfig, prompt, *,
+                    chunk: Optional[int] = None, attn_fn=None):
+    """Long-prompt prefill in O(window) memory: chunks of at most
+    ``sliding_window`` tokens stream through the transformer, each chunk
+    attending to the rolling cache (its own window's past) plus itself,
+    merged with the online-softmax partial algebra
+    (ops/attention.py::merge_partials).  Peak activation memory scales
+    with ``chunk + window``, never the prompt — the missing piece between
+    the O(window) decode cache and an O(S) full-prompt prefill.
+
+    Returns ``(last_logits [B, V], rolling_cache)``; continue with
+    ``decode_step(..., pos=P, rolling=True)`` (or hand both to a serving
+    loop).  Matches the one-pass windowed prefill bit-close (pinned by
+    tests/test_generate.py).  The chunk body is the same
+    :func:`~starway_tpu.models.llama.decoder_layer` every other path uses
+    (``attn_fn`` must be None: the chunk step owns its attention).
+    """
+    from ..ops.attention import (finalize_partial, merge_partials,
+                                 partial_attention)
+    from .llama import decoder_layer, head_logits
+
+    W = cfg.sliding_window
+    if W is None:
+        raise ValueError("prefill_rolling requires cfg.sliding_window")
+    if attn_fn is not None:
+        raise ValueError("prefill_rolling owns its attention; attn_fn must be None")
+    B, P = prompt.shape
+    C = min(chunk or W, W, P)
+    hd = cfg.head_dim
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    cos, sin = rope_tables(P, hd, cfg.rope_theta)
+    cache = init_rolling_cache(cfg, B)
+
+    def run_chunk(cache, tokens_c, c0, Cc):
+        """One chunk through every layer; returns (h, new cache)."""
+        cos_c, sin_c = cos[c0:c0 + Cc], sin[c0:c0 + Cc]
+        slots = (c0 + jnp.arange(Cc)) % W
+        # Reorder the cache by absolute position: slot s holds the latest
+        # p < c0 with p % W == s; gathering positions c0-W..c0-1 in order
+        # lets partial_attention mask in plain global coordinates.
+        order = (c0 - W + jnp.arange(W)) % W
+        h = params["embed"][tokens_c]  # [B, Cc, D]
+
+        def chunk_attn(kc, vc):
+            """attn_fn for decoder_layer: past (the rolling cache, in
+            position order) + present (the chunk itself, causal) as two
+            mergeable online-softmax partials."""
+            def attn(q, k, v):
+                kco = jnp.take(kc, order, axis=2)
+                vco = jnp.take(vc, order, axis=2)
+                past = partial_attention(
+                    q, repeat_kv(kco, n_rep), repeat_kv(vco, n_rep),
+                    q_offset=c0, kv_offset=c0 - W, causal=True, window=W,
+                    kv_min=0)
+                here = partial_attention(
+                    q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
+                    q_offset=c0, kv_offset=c0, causal=True, window=W)
+                return finalize_partial(*merge_partials(past, here),
+                                        out_dtype=q.dtype)
+
+            return attn
+
+        # Python loop over layers (stacked tree sliced per layer): the one
+        # decoder_layer body the scan forward uses, with a per-layer
+        # cache-aware attn_fn; the returned post-RoPE grouped k/v feed the
+        # circular slot write.
+        new_k = []
+        new_v = []
+        for li in range(cfg.n_layers):
+            lp = jax.tree_util.tree_map(lambda a: a[li], params["layers"])
+            kc, vc = cache["k"][li], cache["v"][li]
+            h, _aux, k, v = decoder_layer(lp, h, cfg, cos_c, sin_c,
+                                          chunk_attn(kc, vc))
+            new_k.append(kc.at[:, :, slots, :].set(k))
+            new_v.append(vc.at[:, :, slots, :].set(v))
+        return h, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+    h_last = None
+    c0 = 0
+    while c0 < P:
+        Cc = min(C, P - c0)
+        h_last, cache = run_chunk(cache, prompt[:, c0:c0 + Cc], c0, Cc)
+        c0 += Cc
+    logits = head_logits(h_last[:, -1:], params["final_norm"],
+                         params["lm_head"], cfg.norm_eps)
+    return logits[:, 0], cache
+
+
 def _sample(logits, key, temperature: float, top_k: Optional[int],
             top_p: Optional[float]):
     """One sampled token id per row of ``logits [B, V]``.  Static Python

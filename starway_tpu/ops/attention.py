@@ -45,6 +45,7 @@ def partial_attention(
     kv_limit: Optional[int] = None,
     sm_scale: Optional[float] = None,
     window: Optional[int] = None,
+    kv_min: Optional[int] = None,
 ):
     """Attention of ``q`` against one kv block, in mergeable partial form.
 
@@ -53,9 +54,11 @@ def partial_attention(
     global positions of the first query/key token -- the causal mask is
     computed in global coordinates so blocks can come from anywhere in the
     sequence (ring steps pass traced offsets).  ``kv_limit`` masks key
-    positions at or beyond that global index (padding).  ``window``
-    (requires ``causal``) keeps only the last ``window`` keys per query:
-    ``kv_pos in (q_pos - window, q_pos]`` (Mistral-style sliding window).
+    positions at or beyond that global index (padding); ``kv_min`` masks
+    positions below it (a cold rolling cache holds no keys before 0).
+    ``window`` (requires ``causal``) keeps only the last ``window`` keys
+    per query: ``kv_pos in (q_pos - window, q_pos]`` (Mistral-style
+    sliding window).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -74,6 +77,8 @@ def partial_attention(
         raise ValueError("window requires causal attention")
     if kv_limit is not None:
         mask = mask & (kv_pos < kv_limit)[None, :]
+    if kv_min is not None:
+        mask = mask & (kv_pos >= kv_min)[None, :]
     s = jnp.where(mask[None, None, :, :], s, NEG_BIG)
     m = jnp.max(s, axis=-1)
     # Rows with no visible keys: exp(s - m) would be exp(0)=1; zero them.

@@ -289,3 +289,57 @@ def test_rolling_cache_matches_full_model():
     with pytest.raises(ValueError):
         decode_step(params, init_cache(cfg, B, 9), tokens[:, 0], 0, cfg,
                     rope, rolling=True)  # cache size != window
+
+
+def test_prefill_rolling_matches_full():
+    """Chunked O(window) prefill == the one-pass windowed prefill: same
+    last-position logits, same rolling cache contents, and decoding onward
+    from it reproduces full generate()."""
+    from starway_tpu.models.generate import prefill, prefill_rolling
+
+    cfg = LlamaConfig.preset("debug", sliding_window=5)
+    params = init_params(jax.random.PRNGKey(8), cfg)
+    B, P, W = 2, 13, 5
+    prompt = jnp.asarray(np.random.default_rng(4).integers(
+        0, cfg.vocab_size, (B, P), dtype=np.int32))
+
+    logits_r, cache_r = prefill_rolling(params, cfg, prompt, chunk=4)
+    assert cache_r["k"].shape[3] == W
+
+    # Oracle cache: one-pass prefill gathered into rolling layout.
+    logits_f, cache_f = prefill(params, cfg, prompt, P)
+    src = (P - W) + ((jnp.arange(W) - (P - W)) % W)
+    np.testing.assert_allclose(np.asarray(logits_r), np.asarray(logits_f),
+                               atol=2e-4, rtol=2e-4)
+    for name in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(cache_r[name]),
+            np.asarray(jnp.take(cache_f[name], src, axis=3)),
+            atol=2e-5, rtol=2e-5, err_msg=name)
+
+    # Decode onward: same greedy continuation as full generate().
+    full = generate(params, cfg, prompt, max_new_tokens=4)
+    rope = rope_tables(P + 4, cfg.head_dim, cfg.rope_theta)
+    cache, logits = cache_r, logits_r
+    toks = []
+    for i in range(4):
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        toks.append(nxt)
+        logits, cache = decode_step(params, cache, nxt, P + i, cfg, rope,
+                                    rolling=True)
+    np.testing.assert_array_equal(
+        np.stack(toks, 1), np.asarray(full[:, P:]))
+
+    # Short prompt (single cold chunk) also agrees.
+    short = prompt[:, :3]
+    lr, cr = prefill_rolling(params, cfg, short)
+    lf, cf = prefill(params, cfg, short, W)
+    np.testing.assert_allclose(np.asarray(lr), np.asarray(lf),
+                               atol=2e-4, rtol=2e-4)
+    for name in ("k", "v"):
+        np.testing.assert_allclose(np.asarray(cr[name]),
+                                   np.asarray(cf[name]),
+                                   atol=2e-5, rtol=2e-5)
+
+    with pytest.raises(ValueError):
+        prefill_rolling(params, LlamaConfig.preset("debug"), prompt)
