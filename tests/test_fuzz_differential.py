@@ -125,7 +125,7 @@ def _oracle(ops, payload_for):
     return results
 
 
-@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("seed", range(10))
 async def test_fuzz_matches_oracle(seed, port, transport):
     ops = _schedule(seed)
 
