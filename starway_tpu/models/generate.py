@@ -217,9 +217,7 @@ def prefill_rolling(params: dict, cfg: LlamaConfig, prompt, *,
     :func:`~starway_tpu.models.llama.decoder_layer` every other path uses
     (``attn_fn`` must be None: the chunk step owns its attention).
     """
-    from ..ops.attention import (finalize_partial, merge_partials,
-                                 partial_attention)
-    from .llama import decoder_layer, head_logits
+    from .llama import head_logits
 
     W = cfg.sliding_window
     if W is None:
@@ -228,14 +226,48 @@ def prefill_rolling(params: dict, cfg: LlamaConfig, prompt, *,
         raise ValueError("prefill_rolling owns its attention; attn_fn must be None")
     B, P = prompt.shape
     C = min(chunk or W, W, P)
-    hd = cfg.head_dim
-    n_rep = cfg.n_heads // cfg.n_kv_heads
-    cos, sin = rope_tables(P, hd, cfg.rope_theta)
+    cos, sin = rope_tables(P, cfg.head_dim, cfg.rope_theta)
     cache = init_rolling_cache(cfg, B)
 
-    def run_chunk(cache, tokens_c, c0, Cc):
+    # Jitted chunk step (module-level compile cache keyed on cfg; jit's own
+    # cache keys the two shapes: the full chunk and the final partial one).
+    # Eager per-op dispatch here costs O(P/C * n_layers) round trips — fatal
+    # on a tunneled device at ~100 ms per dispatch.
+    run_chunk = _compiled_prefill_chunk(cfg)
+
+    h_last = None
+    c0 = 0
+    while c0 < P:
+        Cc = min(C, P - c0)
+        # Rope slices are cut on the host so the compiled signature sees
+        # [Cc, ...] — independent of P (a full-table argument would
+        # recompile the chunk program for every distinct prompt length).
+        h_last, cache = run_chunk(params, cache, prompt[:, c0:c0 + Cc],
+                                  jnp.asarray(c0, jnp.int32),
+                                  cos[c0:c0 + Cc], sin[c0:c0 + Cc])
+        c0 += Cc
+    logits = head_logits(h_last[:, -1:], params["final_norm"],
+                         params["lm_head"], cfg.norm_eps)
+    return logits[:, 0], cache
+
+
+@functools.cache
+def _compiled_prefill_chunk(cfg: LlamaConfig):
+    """jit'd single-chunk body of :func:`prefill_rolling` for one config.
+
+    ``c0`` (the chunk's global start) is traced, so every full-size chunk
+    reuses ONE compiled program; only the final partial chunk (different
+    width) triggers a second trace."""
+    from ..ops.attention import (finalize_partial, merge_partials,
+                                 partial_attention)
+    from .llama import decoder_layer
+
+    W = cfg.sliding_window
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+
+    def run_chunk(params, cache, tokens_c, c0, cos_c, sin_c):
         """One chunk through every layer; returns (h, new cache)."""
-        cos_c, sin_c = cos[c0:c0 + Cc], sin[c0:c0 + Cc]
+        Cc = tokens_c.shape[1]
         slots = (c0 + jnp.arange(Cc)) % W
         # Reorder the cache by absolute position: slot s holds the latest
         # p < c0 with p % W == s; gathering positions c0-W..c0-1 in order
@@ -277,15 +309,10 @@ def prefill_rolling(params: dict, cfg: LlamaConfig, prompt, *,
             new_v.append(vc.at[:, :, slots, :].set(v))
         return h, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
 
-    h_last = None
-    c0 = 0
-    while c0 < P:
-        Cc = min(C, P - c0)
-        h_last, cache = run_chunk(cache, prompt[:, c0:c0 + Cc], c0, Cc)
-        c0 += Cc
-    logits = head_logits(h_last[:, -1:], params["final_norm"],
-                         params["lm_head"], cfg.norm_eps)
-    return logits[:, 0], cache
+    # The caller rebinds its cache to the returned one each chunk, so the
+    # input cache can be donated: the update happens in place instead of
+    # holding two full O(window) caches live per dispatch.
+    return jax.jit(run_chunk, donate_argnums=(1,))
 
 
 def _sample(logits, key, temperature: float, top_k: Optional[int],
@@ -416,6 +443,10 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
     the caller stitches ragged rows).
     """
     B, P = prompt.shape
+    if max_new_tokens < 1:
+        # The compiled scan has length max_new_tokens - 1; a zero/negative
+        # count would die deep inside tracing after paying a full prefill.
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     total = P + max_new_tokens
     if max_len is None:
         max_len = total
@@ -439,6 +470,14 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
         lengths = jnp.asarray(prompt_lengths, jnp.int32)
         if lengths.shape != (B,):
             raise ValueError(f"prompt_lengths must be [{B}], got {lengths.shape}")
+        if isinstance(lengths, jax.core.Tracer):
+            # API contract: ragged generate() validates lengths on the host
+            # (under jit the gathers would clamp and return wrong
+            # continuations silently), so it cannot itself be traced.
+            raise ValueError(
+                "generate() with prompt_lengths must be called outside jit: "
+                "ragged length validation needs concrete values (generate "
+                "already compiles its own prefill+decode scan internally)")
         # Concrete here (lengths are a call-time array, not traced): reject
         # out-of-range rows loudly — under jit the gathers would clamp and
         # return wrong continuations silently.

@@ -51,6 +51,15 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
     act = getattr(hf_config, "hidden_act", "silu")
     if act not in ("silu", "swish"):
         raise NotImplementedError(f"hidden_act={act!r}; this family is SwiGLU")
+    # Newer HF configs may pin an explicit per-head dim decoupled from
+    # hidden_size // num_attention_heads; this tree derives head_dim, so a
+    # mismatch would mis-shape every projection reshape downstream.
+    explicit_hd = getattr(hf_config, "head_dim", None)
+    derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
+    if explicit_hd is not None and explicit_hd != derived_hd:
+        raise NotImplementedError(
+            f"head_dim={explicit_hd} != hidden_size//n_heads={derived_hd}; "
+            "decoupled head dims are not representable in this tree")
     kw = dict(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,

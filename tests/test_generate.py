@@ -193,6 +193,18 @@ def test_generate_ragged_matches_per_row(cfg, params):
         generate(init_params(jax.random.PRNGKey(1), moe_cfg), moe_cfg,
                  padded, max_new, prompt_lengths=lengths)
 
+    # Ragged generate validates lengths on the host; under jit that would
+    # silently clamp, so it must refuse traced lengths loudly.
+    with pytest.raises(ValueError, match="outside jit"):
+        jax.jit(lambda l: generate(params, cfg, padded, max_new,
+                                   prompt_lengths=l))(lengths)
+
+
+def test_generate_rejects_nonpositive_max_new(cfg, params):
+    prompt = jnp.asarray([[1, 2, 3]], dtype=jnp.int32)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(params, cfg, prompt, max_new_tokens=0)
+
 
 def test_generate_moe():
     cfg = LlamaConfig.preset("debug", n_experts=4)

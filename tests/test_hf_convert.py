@@ -65,6 +65,20 @@ def test_tied_embeddings_fallback(hf_model):
     np.testing.assert_array_equal(np.asarray(params["lm_head"]), emb.T)
 
 
+def test_decoupled_head_dim_refused(hf_model):
+    """Configs pinning head_dim != hidden_size//n_heads must fail at config
+    time with a clear error, not an opaque reshape failure mid-forward."""
+    import copy
+
+    hf_cfg = copy.deepcopy(hf_model.config)
+    hf_cfg.head_dim = 2 * (hf_cfg.hidden_size // hf_cfg.num_attention_heads)
+    with pytest.raises(NotImplementedError, match="head_dim"):
+        config_from_hf(hf_cfg)
+    # An explicit but CONSISTENT head_dim converts fine.
+    hf_cfg.head_dim = hf_cfg.hidden_size // hf_cfg.num_attention_heads
+    assert config_from_hf(hf_cfg).d_model == hf_cfg.hidden_size
+
+
 def test_mistral_logits_and_generation_match_transformers():
     """Mistral = Llama architecture + sliding window: the converter maps
     sliding_window through and both logits and greedy generation match
