@@ -303,8 +303,8 @@ def _compiled_prefill_chunk(cfg: LlamaConfig):
         for li in range(cfg.n_layers):
             lp = jax.tree_util.tree_map(lambda a: a[li], params["layers"])
             kc, vc = cache["k"][li], cache["v"][li]
-            h, _aux, k, v = decoder_layer(lp, h, cfg, cos_c, sin_c,
-                                          chunk_attn(kc, vc))
+            h, _aux, k, v, _stats = decoder_layer(lp, h, cfg, cos_c, sin_c,
+                                                  chunk_attn(kc, vc))
             new_k.append(kc.at[:, :, slots, :].set(k))
             new_v.append(vc.at[:, :, slots, :].set(v))
         return h, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}

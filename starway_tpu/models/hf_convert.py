@@ -55,6 +55,12 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
     # hidden_size // num_attention_heads; this tree derives head_dim, so a
     # mismatch would mis-shape every projection reshape downstream.
     explicit_hd = getattr(hf_config, "head_dim", None)
+    if hf_config.hidden_size % hf_config.num_attention_heads:
+        # Even an "equal" explicit head_dim is decoupled here: the floor
+        # division below would mask that n_heads * head_dim != hidden_size.
+        raise NotImplementedError(
+            f"hidden_size={hf_config.hidden_size} is not divisible by "
+            f"num_attention_heads={hf_config.num_attention_heads}")
     derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
     if explicit_hd is not None and explicit_hd != derived_hd:
         raise NotImplementedError(

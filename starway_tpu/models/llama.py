@@ -246,9 +246,11 @@ def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
                   attn_fn: Callable, moe_fn: Optional[Callable] = None):
     """One pre-norm decoder block on ``h [B, S, D]`` with layer params
     ``lp`` (one slice of the stacked tree).  Returns
-    ``(h, aux, k, v)`` — aux is the MoE balance term (0 for dense), k/v the
-    post-RoPE grouped heads (the KV-cache prefix).  Shared by the scan
-    forward, and the pipeline-parallel stage body (models/pp_llama.py)."""
+    ``(h, aux, k, v, stats)`` — aux is the MoE balance term (0 for dense),
+    k/v the post-RoPE grouped heads (the KV-cache prefix), stats the MoE
+    router-health dict when ``moe_fn`` returns one (``with_stats=True``
+    builders), else None.  Shared by the scan forward, and the
+    pipeline-parallel stage body (models/pp_llama.py)."""
     B, S, _ = h.shape
     hd = cfg.head_dim
     x = rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
@@ -264,11 +266,15 @@ def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
     h = h + o @ lp["wo"]
 
     x = rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
+    stats = None
     if cfg.n_experts > 0:
         if moe_fn is not None:
-            y, aux = moe_fn(
+            out = moe_fn(
                 x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"]
             )
+            y, aux = out[0], out[1]
+            if len(out) > 2:  # with_stats moe_fn: router-health metrics
+                stats = out[2]
         else:
             from .moe import switch_moe
 
@@ -281,13 +287,14 @@ def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
         gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
         h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
         aux = jnp.zeros((), jnp.float32)
-    return h, aux, k, v
+    return h, aux, k, v, stats
 
 
 def forward(params: dict, tokens, cfg: LlamaConfig,
             attn_fn: Optional[Callable] = None, *, return_aux: bool = False,
             moe_fn: Optional[Callable] = None, return_kv: bool = False,
-            last_only: bool = False, logit_positions=None):
+            last_only: bool = False, logit_positions=None,
+            return_moe_stats: bool = False):
     """Next-token logits ``[B, S, V]`` for token ids ``[B, S]``.
 
     ``return_kv`` additionally returns the post-RoPE grouped k/v of every
@@ -298,8 +305,8 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
     (``[B, 1, V]``), skipping the ``[B, S, V]`` logit tensor a prefill never
     reads; ``logit_positions`` ([B] ints) is its ragged analog — logits for
     one caller-chosen position per row.  Return value is ``logits``,
-    extended to a tuple ``(logits[, aux][, (k, v)])`` by ``return_aux`` /
-    ``return_kv``.
+    extended to a tuple ``(logits[, aux][, moe_stats][, (k, v)])`` by
+    ``return_aux`` / ``return_moe_stats`` / ``return_kv``.
 
     ``attn_fn(q, k, v) -> out`` takes q ``[B, Hq, S, Dh]`` and *grouped*
     kv ``[B, Hkv, S, Dh]`` (impls expand GQA heads internally); defaults to
@@ -310,9 +317,21 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
     when ``cfg.n_experts > 0``; defaults to the global-view
     :func:`~starway_tpu.models.moe.switch_moe` (GSPMD dispatch).  Pass
     :func:`~starway_tpu.models.moe.make_sharded_moe`'s result to pin the
-    expert all-to-all over the "ep" mesh axis explicitly.
+    expert all-to-all over the "ep" mesh axis explicitly — built with
+    ``with_stats=True`` plus ``return_moe_stats=True`` here, the
+    layer-stacked router-health dict (drop fraction, per-expert load; each
+    leaf gains a leading ``n_layers`` dim) is appended to the outputs.
     """
     attn_fn = resolve_attn_fn(cfg, attn_fn)
+    if return_moe_stats and cfg.n_experts == 0:
+        raise ValueError(
+            "return_moe_stats=True but cfg.n_experts == 0: a dense model "
+            "has no router to report on")
+    if return_moe_stats and moe_fn is None:
+        raise ValueError(
+            "return_moe_stats needs a stats-producing moe_fn (build one "
+            "with make_sharded_moe(..., with_stats=True) or wrap "
+            "switch_moe(..., with_stats=True))")
     B, S = tokens.shape
     cos, sin = rope_tables(S, cfg.head_dim, cfg.rope_theta)
 
@@ -320,12 +339,17 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
 
     def layer(carry, lp):
         h, aux = carry
-        h, layer_aux, k, v = decoder_layer(lp, h, cfg, cos, sin, attn_fn,
-                                           moe_fn=moe_fn)
-        return (h, aux + layer_aux), ((k, v) if return_kv else None)
+        h, layer_aux, k, v, stats = decoder_layer(lp, h, cfg, cos, sin,
+                                                  attn_fn, moe_fn=moe_fn)
+        if return_moe_stats and stats is None:
+            raise ValueError("return_moe_stats=True but moe_fn returned no "
+                             "stats (build it with with_stats=True)")
+        return (h, aux + layer_aux), ((k, v) if return_kv else None,
+                                      stats if return_moe_stats else None)
 
     body = jax.checkpoint(layer) if cfg.remat else layer
-    (h, aux), kv = lax.scan(body, (h, jnp.zeros((), jnp.float32)), params["layers"])
+    (h, aux), (kv, moe_stats) = lax.scan(
+        body, (h, jnp.zeros((), jnp.float32)), params["layers"])
     if last_only:
         h = h[:, -1:]
     elif logit_positions is not None:
@@ -334,6 +358,8 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
     out = (logits,)
     if return_aux:
         out += (aux,)
+    if return_moe_stats:
+        out += (moe_stats,)  # scan-stacked: leaves lead with n_layers
     if return_kv:
         out += (kv,)
     return out if len(out) > 1 else logits
@@ -341,16 +367,24 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
 
 def loss_fn(params: dict, batch, cfg: LlamaConfig,
             attn_fn: Optional[Callable] = None,
-            moe_fn: Optional[Callable] = None):
+            moe_fn: Optional[Callable] = None, *,
+            with_moe_stats: bool = False):
     """Causal LM loss: batch ``[B, S+1]`` token ids -> mean next-token
-    cross-entropy."""
+    cross-entropy.  ``with_moe_stats``: return ``(loss, stats)`` (for
+    ``jax.value_and_grad(..., has_aux=True)``) with the layer-stacked MoE
+    router-health dict — requires a ``with_stats=True`` moe_fn."""
     tokens, targets = batch[:, :-1], batch[:, 1:]
-    logits, aux = forward(params, tokens, cfg, attn_fn, return_aux=True,
-                          moe_fn=moe_fn)
+    if with_moe_stats:
+        logits, aux, stats = forward(params, tokens, cfg, attn_fn,
+                                     return_aux=True, moe_fn=moe_fn,
+                                     return_moe_stats=True)
+    else:
+        logits, aux = forward(params, tokens, cfg, attn_fn, return_aux=True,
+                              moe_fn=moe_fn)
     loss = token_ce(logits, targets)
     if cfg.n_experts > 0:
         loss = loss + cfg.moe_aux_coef * aux / cfg.n_layers
-    return loss
+    return (loss, stats) if with_moe_stats else loss
 
 
 def apply_updates(tx, params, opt_state, grads):
@@ -366,7 +400,7 @@ def apply_updates(tx, params, opt_state, grads):
 
 def make_train_step(cfg: LlamaConfig, tx, attn_fn: Optional[Callable] = None,
                     moe_fn: Optional[Callable] = None, *,
-                    accum_steps: int = 1):
+                    accum_steps: int = 1, with_moe_stats: bool = False):
     """One optimizer step, jit-ready (donate params+opt_state for in-place
     HBM updates).
 
@@ -378,14 +412,29 @@ def make_train_step(cfg: LlamaConfig, tx, attn_fn: Optional[Callable] = None,
     tests/test_model.py).  MoE models still train correctly but are not
     bit-identical to the full-batch step: expert capacity is computed per
     microbatch, so routing overflow can differ.
+
+    ``with_moe_stats`` (needs a ``with_stats=True`` moe_fn): the step
+    returns ``(params, opt_state, loss, stats)`` where stats is the
+    layer-stacked router-health dict (drop fraction + per-expert load,
+    leading ``n_layers`` dim; averaged over microbatches under accum) —
+    the training loop sees a collapsing router instead of silent drops.
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
-    def train_step(params, opt_state, batch):
-        if accum_steps == 1:
+    def value_and_grad(params, batch):
+        if with_moe_stats:
+            (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch, cfg, attn_fn, moe_fn, with_moe_stats=True)
+        else:
             loss, grads = jax.value_and_grad(loss_fn)(
                 params, batch, cfg, attn_fn, moe_fn)
+            stats = None
+        return loss, grads, stats
+
+    def train_step(params, opt_state, batch):
+        if accum_steps == 1:
+            loss, grads, stats = value_and_grad(params, batch)
         else:
             B = batch.shape[0]
             if B % accum_steps:
@@ -396,16 +445,18 @@ def make_train_step(cfg: LlamaConfig, tx, attn_fn: Optional[Callable] = None,
 
             def acc(carry, chunk):
                 loss_sum, gacc = carry
-                l, g = jax.value_and_grad(loss_fn)(
-                    params, chunk, cfg, attn_fn, moe_fn)
+                l, g, stats = value_and_grad(params, chunk)
                 gacc = jax.tree_util.tree_map(
                     lambda a, b: a + b.astype(jnp.float32), gacc, g)
-                return (loss_sum + l, gacc), None
+                return (loss_sum + l, gacc), stats
 
             zeros = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            (loss_sum, grads), _ = lax.scan(
+            (loss_sum, grads), stats = lax.scan(
                 acc, (jnp.float32(0), zeros), chunks)
+            if with_moe_stats:  # mean over the microbatch chunks
+                stats = jax.tree_util.tree_map(
+                    lambda s: jnp.mean(s, axis=0), stats)
             loss = loss_sum / accum_steps
             # Back to param dtype: the optimizer must see the same grad
             # dtype as the accum_steps=1 path, else bf16 adamw moments get
@@ -413,6 +464,8 @@ def make_train_step(cfg: LlamaConfig, tx, attn_fn: Optional[Callable] = None,
             grads = jax.tree_util.tree_map(
                 lambda g, p: (g / accum_steps).astype(p.dtype), grads, params)
         params, opt_state = apply_updates(tx, params, opt_state, grads)
+        if with_moe_stats:
+            return params, opt_state, loss, stats
         return params, opt_state, loss
 
     return train_step

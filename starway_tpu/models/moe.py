@@ -95,7 +95,10 @@ def _dispatch_slots(expert_flat, n_experts: int, capacity: int):
 
     ``position`` counts prior assignments to the same expert in flat order
     (choice-major -> first choices win capacity).  Overflow maps to the
-    trash slot ``E*C``.  Returns ``(slot [T*k] int32, keep [T*k] bool)``.
+    trash slot ``E*C``.  Returns ``(slot [T*k] int32, keep [T*k] bool,
+    counts [E] int32)`` — counts is each expert's routed-assignment total,
+    a byproduct of the capacity numbering that :func:`_routing_stats`
+    reuses for free.
     """
     # int32 counting stays exact however many tokens are routed (an f32
     # cumsum would misnumber positions past 2^24 assignments).
@@ -105,7 +108,7 @@ def _dispatch_slots(expert_flat, n_experts: int, capacity: int):
     pos = jnp.clip(pos, 0, capacity - 1)
     slot = jnp.where(keep, expert_flat * capacity + pos,
                      n_experts * capacity)
-    return slot.astype(jnp.int32), keep
+    return slot.astype(jnp.int32), keep, jnp.sum(onehot, axis=0)
 
 
 def _scatter_tokens(xt, slot, k: int, n_experts: int, capacity: int):
@@ -125,6 +128,19 @@ def _combine_tokens(y_buf, slot, keep, gate_flat, k: int, t: int):
     return jnp.sum((y * w[:, None]).reshape(k, t, -1), axis=0)
 
 
+def _routing_stats(expert_counts, keep):
+    """Router-health metrics from quantities the dispatch already computed
+    (``_dispatch_slots``' per-expert counts; no extra collective, no second
+    one-hot): ``drop_fraction`` -- share of routed (token, choice) pairs
+    that fell over capacity and were dropped to the residual path -- and
+    ``expert_load [E]`` -- each expert's share of routed assignments (1/E
+    everywhere = perfectly balanced; a collapsing router concentrates mass
+    on few experts and shows a rising drop_fraction)."""
+    load = expert_counts.astype(jnp.float32) / keep.shape[0]
+    drop = 1.0 - jnp.mean(keep.astype(jnp.float32))
+    return {"drop_fraction": drop, "expert_load": load}
+
+
 def _expert_ffn(expert_in, w_in, w_out):
     """``[E, C', D] -> [E, C', D]`` through each expert's gelu MLP."""
     cd = expert_in.dtype
@@ -135,12 +151,16 @@ def _expert_ffn(expert_in, w_in, w_out):
 
 
 def switch_moe(x, router_w, w_in, w_out, *, capacity_factor: float = 1.25,
-               k: int = 1):
+               k: int = 1, with_stats: bool = False):
     """x: [B, S, D] -> (y: [B, S, D], aux_loss: scalar f32).  Global view.
 
     Tokens over capacity are dropped (their residual path carries them).
     Under a GSPMD mesh with ``moe_specs`` the expert dimension of the
     ``[E, C, D]`` buffers shards over "ep" and XLA inserts the all-to-alls.
+
+    ``with_stats``: also return :func:`_routing_stats` (drop fraction +
+    per-expert load) as a third element, so a collapsing router is visible
+    from the training loop instead of silently dropping tokens.
     """
     b, s, d = x.shape
     e = router_w.shape[-1]
@@ -149,16 +169,20 @@ def switch_moe(x, router_w, w_in, w_out, *, capacity_factor: float = 1.25,
     capacity = moe_capacity(t * k, e, capacity_factor)
 
     expert_flat, gate_flat, aux = _route(xt, router_w, k)
-    slot, keep = _dispatch_slots(expert_flat, e, capacity)
+    slot, keep, counts = _dispatch_slots(expert_flat, e, capacity)
     expert_in = _scatter_tokens(xt, slot, k, e, capacity).reshape(e, capacity, d)
     expert_out = _expert_ffn(expert_in, w_in, w_out)
     y = _combine_tokens(expert_out.reshape(e * capacity, d), slot, keep,
                         gate_flat, k, t)
-    return y.reshape(b, s, d), aux
+    y = y.reshape(b, s, d)
+    if with_stats:
+        return y, aux, _routing_stats(counts, keep)
+    return y, aux
 
 
 def sharded_switch_moe(x, router_w, w_in, w_out, axis_name: str, *,
-                       capacity_factor: float = 1.25, k: int = 1):
+                       capacity_factor: float = 1.25, k: int = 1,
+                       with_stats: bool = False):
     """Local (shard_map) view with an explicit expert all-to-all.
 
     ``x [B_loc, S_loc, D]``: this shard's tokens.  ``w_in/w_out
@@ -170,6 +194,11 @@ def sharded_switch_moe(x, router_w, w_in, w_out, axis_name: str, *,
     statistically the global Switch aux (equal shard sizes) though not
     bit-identical to the global-view formula (mean of products vs product
     of means across shards).
+
+    ``with_stats``: also return drop fraction + per-expert load (see
+    :func:`_routing_stats`).  The stats ride the SAME pmean the aux loss
+    already pays (stacked into one small vector) -- no new collective in
+    the hot path.
     """
     ep = lax.axis_size(axis_name)
     b, s, d = x.shape
@@ -180,7 +209,7 @@ def sharded_switch_moe(x, router_w, w_in, w_out, axis_name: str, *,
     capacity = moe_capacity(t * k, e, capacity_factor)
 
     expert_flat, gate_flat, aux = _route(xt, router_w, k)
-    slot, keep = _dispatch_slots(expert_flat, e, capacity)
+    slot, keep, counts = _dispatch_slots(expert_flat, e, capacity)
     send = _scatter_tokens(xt, slot, k, e, capacity)  # [E*C, D]
 
     # [ep, E_loc, C, D] -> all-to-all -> leading axis becomes source shard.
@@ -196,37 +225,59 @@ def sharded_switch_moe(x, router_w, w_in, w_out, axis_name: str, *,
 
     y = _combine_tokens(got.reshape(e * capacity, d), slot, keep, gate_flat,
                         k, t)
-    return y.reshape(b, s, d), lax.pmean(aux, axis_name)
+    y = y.reshape(b, s, d)
+    if with_stats:
+        stats = _routing_stats(counts, keep)
+        packed = jnp.concatenate(
+            [jnp.stack([aux, stats["drop_fraction"]]), stats["expert_load"]])
+        packed = lax.pmean(packed, axis_name)
+        return y, packed[0], {"drop_fraction": packed[1],
+                              "expert_load": packed[2:]}
+    return y, lax.pmean(aux, axis_name)
 
 
 def make_sharded_moe(mesh, *, ep_axis: str = "ep", dp_axis: str = "dp",
-                     capacity_factor: float = 1.25, k: int = 1):
+                     capacity_factor: float = 1.25, k: int = 1,
+                     with_stats: bool = False):
     """Build a ``moe_fn(x, router_w, w_in, w_out) -> (y, aux)`` running
     :func:`sharded_switch_moe` under shard_map: tokens shard over
     (dp, ep) -- batch over dp, sequence over ep -- experts over ep, and the
     dispatch rides one explicit ``all_to_all`` pair over the ep axis.
 
     Plug into ``forward(..., moe_fn=...)`` /
-    ``make_train_step(..., moe_fn=...)``.
+    ``make_train_step(..., moe_fn=...)``.  ``with_stats``: the built fn
+    returns ``(y, aux, stats)`` with router-health metrics (drop fraction,
+    per-expert load) pmean'd over the mesh.
     """
     from ..parallel.sharding import shard_map_fn
 
     other_axes = tuple(a for a in mesh.axis_names if a != ep_axis)
 
     def local(x, router_w, w_in, w_out):
-        y, aux = sharded_switch_moe(
+        out = sharded_switch_moe(
             x, router_w, w_in, w_out, ep_axis,
-            capacity_factor=capacity_factor, k=k)
-        # aux is ep-uniform already; replicate across the remaining axes so
-        # the scalar can leave the shard_map with spec P().
+            capacity_factor=capacity_factor, k=k, with_stats=with_stats)
+        y, aux = out[0], out[1]
+        # aux/stats are ep-uniform already; replicate across the remaining
+        # axes so the scalars can leave the shard_map with spec P().
         if other_axes:
             aux = lax.pmean(aux, other_axes)
+        if with_stats:
+            stats = out[2]
+            if other_axes:
+                stats = jax.tree_util.tree_map(
+                    lambda v: lax.pmean(v, other_axes), stats)
+            return y, aux, stats
         return y, aux
 
     x_spec = P(dp_axis if dp_axis in mesh.shape else None, ep_axis, None)
+    out_specs = (x_spec, P())
+    if with_stats:
+        out_specs = (x_spec, P(),
+                     {"drop_fraction": P(), "expert_load": P(None)})
     return shard_map_fn(
         mesh, local,
         in_specs=(x_spec, P(None, None), P(ep_axis, None, None),
                   P(ep_axis, None, None)),
-        out_specs=(x_spec, P()),
+        out_specs=out_specs,
     )

@@ -110,7 +110,7 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
         cos, sin = rope_tables(h.shape[1], cfg.head_dim, cfg.rope_theta)
 
         def body(hh, lp):
-            hh, _aux, _k, _v = decoder_layer(lp, hh, cfg, cos, sin, attn)
+            hh, _aux, _k, _v, _stats = decoder_layer(lp, hh, cfg, cos, sin, attn)
             return hh, None
 
         h, _ = lax.scan(body, h, local)

@@ -282,7 +282,7 @@ def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
 
 def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
                         axis_name: str = "pp", *, with_head: bool = False,
-                        return_dx: bool = False):
+                        return_dx: bool = False, dp_axis: str | None = None):
     """Jitted global-view 1F1B training step builder.
 
     Returns ``grad_step(stage_params, inputs, targets) -> (loss, grads)``
@@ -298,24 +298,60 @@ def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
     whatever produced the activations); it is emitted from stage 0's shard
     only (sharded out_spec + index, no activation-sized collective).
     Extras are appended to the result in that order.
+
+    ``dp_axis``: compose the pipeline with data parallelism on a pp x dp
+    mesh — each dp group runs an independent 1F1B schedule over its slice
+    of every microbatch.  Dim 1 of inputs/targets (``mb``, the
+    within-microbatch batch size — NOT the microbatch count ``M``, which
+    stays whole on every group) shards over ``dp_axis`` and must divide
+    by it; loss / parameter grads / head grads are pmean'd over dp (one
+    gradient-sized collective per step, the standard DP all-reduce).  The returned ``dinputs`` cotangent stays per-shard —
+    it differentiates THIS shard's inputs against the dp-averaged loss
+    (the 1/ndp factor is applied), so chaining it into an embedding
+    yields grads on the same scale as ``dparams``.
     """
+    if dp_axis is not None and dp_axis not in mesh.shape:
+        raise ValueError(f"dp_axis={dp_axis!r} is not an axis of {mesh.shape}")
+    data_spec = P(None, dp_axis) if dp_axis else P()
+    dx_spec = P(axis_name, None, dp_axis) if dp_axis else P(axis_name)
+
+    def dp_reduce(out):
+        """Average loss/param-grad/head-grad over the dp groups."""
+        if dp_axis is None:
+            return out
+        loss = lax.pmean(out[0], dp_axis)
+        dparams = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, dp_axis), out[1])
+        rest = out[2:]
+        if with_head:
+            dhead = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, dp_axis), rest[0])
+            rest = (dhead,) + rest[1:]
+        if return_dx:
+            # dinputs differentiates THIS shard's inputs, but against the
+            # REPORTED (dp-averaged) loss: each shard's local cotangent
+            # carries a 1/ndp factor — without it the embedding grad a
+            # caller chains this into would be ndp x the stage grads' scale.
+            ndp = lax.axis_size(dp_axis)
+            rest = rest[:-1] + (rest[-1] / ndp,)
+        return (loss, dparams) + rest
 
     if with_head:
         def local(stage_params, head_params, inputs, targets):
-            return pipeline_train_apply(
+            return dp_reduce(pipeline_train_apply(
                 stage_fn, loss_fn, stage_params, inputs, targets, axis_name,
-                head_params=head_params, return_dx=return_dx)
+                head_params=head_params, return_dx=return_dx))
 
-        in_specs = (P(axis_name), P(), P(), P())
-        out_specs = (P(), P(axis_name), P()) + ((P(axis_name),) if return_dx else ())
+        in_specs = (P(axis_name), P(), data_spec, data_spec)
+        out_specs = (P(), P(axis_name), P()) + ((dx_spec,) if return_dx else ())
     else:
         def local(stage_params, inputs, targets):
-            return pipeline_train_apply(
+            return dp_reduce(pipeline_train_apply(
                 stage_fn, loss_fn, stage_params, inputs, targets, axis_name,
-                return_dx=return_dx)
+                return_dx=return_dx))
 
-        in_specs = (P(axis_name), P(), P())
-        out_specs = (P(), P(axis_name)) + ((P(axis_name),) if return_dx else ())
+        in_specs = (P(axis_name), data_spec, data_spec)
+        out_specs = (P(), P(axis_name)) + ((dx_spec,) if return_dx else ())
 
     staged = shard_map_fn(mesh, local, in_specs=in_specs, out_specs=out_specs)
     if not return_dx:
