@@ -336,7 +336,7 @@ def devpull_supported() -> bool:
         # Tunneled/proxied backends present as "tpu" but run the transfer
         # server against a remote PJRT endpoint where it wedges; the plugin
         # name only shows in platform_version.
-        version = getattr(jax.devices()[0].client, "platform_version", "")
+        version = getattr(jax.local_devices()[0].client, "platform_version", "")
         return "axon" not in version
     except Exception:
         return False
@@ -374,7 +374,10 @@ class TransferManager:
                     import jax
                     from jax.experimental import transfer
 
-                    client = jax.devices()[0].client
+                    # local_devices, not devices: under jax.distributed the
+                    # global list leads with process 0's devices, which are
+                    # non-addressable from other members.
+                    client = jax.local_devices()[0].client
                     # Explicit transport addresses: without them the
                     # same-host "local bulk transport" path aborts (probed
                     # on this jax version).
@@ -431,7 +434,11 @@ class TransferManager:
                 conn = srv.connect(desc["a"])
                 with self._lock:
                     conn = self._conns.setdefault(desc["a"], conn)
-            dev = device if device is not None else jax.devices()[0]
+            # Default to a LOCAL device: under jax.distributed, devices()[0]
+            # is global device 0 -- non-addressable on every other member,
+            # and a pull spec'd onto it yields an array whose value this
+            # process cannot even read.
+            dev = device if device is not None else jax.local_devices()[0]
             try:
                 dt = np.dtype(desc["d"])
             except TypeError:

@@ -305,10 +305,11 @@ def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
     within-microbatch batch size — NOT the microbatch count ``M``, which
     stays whole on every group) shards over ``dp_axis`` and must divide
     by it; loss / parameter grads / head grads are pmean'd over dp (one
-    gradient-sized collective per step, the standard DP all-reduce).  The returned ``dinputs`` cotangent stays per-shard —
-    it differentiates THIS shard's inputs against the dp-averaged loss
-    (the 1/ndp factor is applied), so chaining it into an embedding
-    yields grads on the same scale as ``dparams``.
+    gradient-sized collective per step, the standard DP all-reduce).  The
+    returned ``dinputs`` cotangent stays per-shard — it differentiates
+    THIS shard's inputs against the dp-averaged loss (the 1/ndp factor is
+    applied), so chaining it into an embedding yields grads on the same
+    scale as ``dparams``.
     """
     if dp_axis is not None and dp_axis not in mesh.shape:
         raise ValueError(f"dp_axis={dp_axis!r} is not an axis of {mesh.shape}")

@@ -364,6 +364,116 @@ async def test_devpull_cross_process(port):
         await server.aclose()
 
 
+def _distributed_member(role, coord_port, data_port, q):
+    """One jax.distributed member (the DCN-analogue topology of SURVEY
+    section 7 step 4): joins the 2-process coordination service, then
+    exchanges device payloads over devpull like any other peer."""
+    import os
+    import traceback
+
+    os.environ["STARWAY_TLS"] = "tcp"
+    os.environ["STARWAY_NATIVE"] = "0"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from starway_tpu.mesh import bootstrap_distributed
+
+        bootstrap_distributed(f"127.0.0.1:{coord_port}", 2,
+                              0 if role == "server" else 1)
+        assert jax.process_count() == 2
+        # The runtime spans both members (each contributes its local
+        # devices; the count per member depends on inherited XLA_FLAGS).
+        assert len(jax.devices()) == 2 * len(jax.local_devices())
+        jax.devices()  # devpull is only advertised once the backend is up
+
+        import asyncio
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from starway_tpu import Client, DeviceBuffer, Server
+
+        async def run():
+            if role == "server":
+                server = Server()
+                server.listen("127.0.0.1", data_port)
+                sink = DeviceBuffer((N,), jnp.uint8)
+                tag, length = await asyncio.wait_for(
+                    server.arecv(sink, 0xD0, MASK), 60)
+                assert (tag, length) == (0xD0, N)
+                assert sink.last_transport == "device", sink.last_transport
+                np.testing.assert_array_equal(
+                    np.asarray(sink.array), np.arange(N, dtype=np.uint8))
+                # Reply with a device payload the other way; flush makes it
+                # resident at the peer before this side tears down.
+                ep = server.list_clients().pop()
+                await server.asend(
+                    ep, jax.device_put(jnp.full(N, 9, dtype=jnp.uint8)), 0xD1)
+                await server.aflush()
+                await server.aclose()
+            else:
+                client = Client()
+                for _ in range(100):
+                    try:
+                        await client.aconnect("127.0.0.1", data_port)
+                        break
+                    except Exception:
+                        client = Client()
+                        await asyncio.sleep(0.1)
+                else:
+                    raise RuntimeError(
+                        f"could not connect to 127.0.0.1:{data_port}")
+                await client.asend(
+                    jax.device_put(jnp.arange(N, dtype=jnp.uint8)), 0xD0)
+                sink = DeviceBuffer((N,), jnp.uint8)
+                tag, length = await asyncio.wait_for(
+                    client.arecv(sink, 0xD1, MASK), 60)
+                assert (tag, length) == (0xD1, N)
+                np.testing.assert_array_equal(
+                    np.asarray(sink.array), np.full(N, 9, dtype=np.uint8))
+                await client.aclose()
+
+        asyncio.run(run())
+        q.put((role, "ok"))
+    except Exception:
+        q.put((role, traceback.format_exc()))
+
+
+async def test_devpull_between_jax_distributed_members(port):
+    """Two spawned processes, EACH a jax.distributed member (CPU backend),
+    exchange device payloads over devpull in both directions — the
+    cross-host DCN topology minus real DCN links (VERDICT r2 next #6; see
+    DESIGN.md section 7 for what real-DCN validation still needs)."""
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    coord_port = random.randint(10000, 50000)
+    while coord_port == port:
+        coord_port = random.randint(10000, 50000)
+    procs = [
+        ctx.Process(target=_distributed_member,
+                    args=(role, coord_port, port, q), daemon=True)
+        for role in ("server", "client")
+    ]
+    for p in procs:
+        p.start()
+    try:
+        results = {}
+        loop = asyncio.get_running_loop()
+        for _ in range(2):
+            role, status = await loop.run_in_executor(
+                None, lambda: q.get(timeout=180))
+            results[role] = status
+        assert results.get("server") == "ok", results.get("server")
+        assert results.get("client") == "ok", results.get("client")
+    finally:
+        for p in procs:
+            p.join(10)
+            if p.is_alive():
+                p.terminate()
+                p.join(5)
+
+
 async def test_devpull_cross_process_flush_close(port):
     """Sender flushes then closes before the receive is posted: the FLUSH
     barrier pulls the payload across, so it survives the sender's close."""

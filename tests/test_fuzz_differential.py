@@ -13,6 +13,14 @@ shared-memory rings, and the C++ engine.
 Each seed draws a different interleaving of duplicate tags, wildcard vs
 exact masks, both directions, and unmatched stragglers — breadth the
 hand-written contract suite (test_basic.py) cannot enumerate.
+
+The ``devpull`` plane fuzzes the device data plane (the newest, most
+complex one): sends are a seed-determined mix of host bytes and jax.Arrays
+(>= STARWAY_DEVPULL_MIN rides the PJRT pull path, below it the staged
+path), receives a mix of host buffers and DeviceBuffer sinks, all on the
+SAME connection — the matcher must keep one FIFO across transports
+(generalising tests/test_devpull.py's hand-written FIFO-with-staged and
+truncation cases).
 """
 
 import asyncio
@@ -21,7 +29,7 @@ import random
 import numpy as np
 import pytest
 
-from starway_tpu import Client, Server
+from starway_tpu import Client, DeviceBuffer, Server
 
 pytestmark = pytest.mark.asyncio
 
@@ -35,7 +43,8 @@ def port():
     return random.randint(10000, 50000)
 
 
-@pytest.fixture(params=["inproc", "tcp", "sm", "native", "native-sm"])
+@pytest.fixture(params=["inproc", "tcp", "sm", "native", "native-sm",
+                        "devpull"])
 def transport(request, monkeypatch):
     if request.param == "tcp":
         monkeypatch.setenv("STARWAY_TLS", "tcp")
@@ -55,6 +64,17 @@ def transport(request, monkeypatch):
         monkeypatch.setenv(
             "STARWAY_TLS", "tcp" if request.param == "native" else "tcp,sm")
         monkeypatch.setenv("STARWAY_NATIVE", "1")
+    elif request.param == "devpull":
+        import jax
+
+        monkeypatch.setenv("STARWAY_TLS", "tcp")
+        monkeypatch.setenv("STARWAY_NATIVE", "0")
+        # Pin the pull threshold below most SIZES: with the default
+        # (64 KiB == MAX_SIZE) only the single largest size would ride the
+        # pull path, and a future default bump would silently turn this
+        # plane staged-only.
+        monkeypatch.setenv("STARWAY_DEVPULL_MIN", "4096")
+        jax.devices()  # devpull is only advertised once the backend is up
     return request.param
 
 
@@ -149,6 +169,15 @@ async def test_fuzz_matches_oracle(seed, port, transport):
         await asyncio.sleep(0.005)
     ep = server.list_clients().pop()
 
+    # Device plane: a seed-determined mix of device/host payloads and sinks
+    # on the same connection (drawn from a separate stream so the schedule
+    # and oracle are identical to the other planes' for the same seed).
+    use_device = transport == "devpull"
+    dev_rng = random.Random(seed + 0xDE)
+    if use_device:
+        import jax
+        import jax.numpy as jnp
+
     futs = {}
     bufs = {}
     try:
@@ -159,13 +188,19 @@ async def test_fuzz_matches_oracle(seed, port, transport):
                 _, d, tag, size = op
                 data = payload_for(si, size)
                 si += 1
+                obj = data
+                if use_device and dev_rng.random() < 0.6:
+                    obj = jax.device_put(jnp.asarray(data))
                 if d == "c2s":
-                    await client.asend(data, tag)
+                    await client.asend(obj, tag)
                 else:
-                    await server.asend(ep, data, tag)
+                    await server.asend(ep, obj, tag)
             else:
                 _, d, tag, mask = op
-                buf = np.zeros(MAX_SIZE, dtype=np.uint8)
+                if use_device and dev_rng.random() < 0.5:
+                    buf = DeviceBuffer((MAX_SIZE,), np.uint8)
+                else:
+                    buf = np.zeros(MAX_SIZE, dtype=np.uint8)
                 bufs[ri] = buf
                 futs[ri] = (server.arecv(buf, tag, mask) if d == "c2s"
                             else client.arecv(buf, tag, mask))
@@ -182,7 +217,10 @@ async def test_fuzz_matches_oracle(seed, port, transport):
             assert (int(sender_tag), int(length)) == (stag, len(data)), (
                 f"seed={seed} recv {rid}: got tag={sender_tag} len={length}, "
                 f"oracle says tag={stag} len={len(data)}")
-            np.testing.assert_array_equal(bufs[rid][:len(data)], data,
+            got = bufs[rid]
+            if isinstance(got, DeviceBuffer):
+                got = np.asarray(got.array).view(np.uint8).ravel()
+            np.testing.assert_array_equal(got[:len(data)], data,
                                           err_msg=f"seed={seed} recv {rid}")
         await asyncio.sleep(0.1)
         for rid, want in expected.items():
