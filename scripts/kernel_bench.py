@@ -261,18 +261,18 @@ def bench_decode(b=1, hq=8, hkv=2, t=8192, d=128, iters: int = 64, impl="ours"):
                       f"{cache_bytes / dt / 1e9:.0f} GB/s effective"}
 
 
-def bench_train_mfu(iters: int = 4):
-    """Tiny-Llama train-step MFU on one chip: model flops from config, time
-    from an on-device fori_loop of full optimizer steps."""
+V5E_PEAK = 197e12  # v5e bf16 peak FLOP/s
+
+
+def _train_mfu_row(metric: str, cfg_kw: dict, B: int, S: int, iters: int):
+    """Train-step MFU on one chip: model flops from config, time from an
+    on-device fori_loop of full optimizer steps."""
     import numpy as np
     import optax
 
     from starway_tpu.models import LlamaConfig, init_params, make_train_step
 
-    cfg = LlamaConfig.preset(
-        "debug", d_model=512, n_layers=4, n_heads=8, n_kv_heads=8, d_ff=1536,
-        vocab_size=8192, dtype="bfloat16")
-    B, S = 8, 1024
+    cfg = LlamaConfig.preset("debug", **cfg_kw)
     params = init_params(jax.random.PRNGKey(0), cfg)
     tx = optax.adamw(1e-3)
     opt = tx.init(params)
@@ -301,12 +301,34 @@ def bench_train_mfu(iters: int = 4):
     attn = 6 * cfg.n_layers * cfg.n_heads * S * S * cfg.head_dim * B
     flops = 6 * n_matmul * tokens + attn
     tflops = flops / dt / 1e12
-    peak = 197e12  # v5e bf16 peak
-    return {"metric": "train_step_mfu", "value": round(tflops / (peak / 1e12), 4),
+    return {"metric": metric, "value": round(tflops / (V5E_PEAK / 1e12), 4),
             "unit": "frac_of_197T",
             "detail": f"{tflops:.1f} TFLOP/s, {n_params/1e6:.1f}M params "
                       f"({n_matmul/1e6:.1f}M matmul), "
-                      f"B={B} S={S}, {dt*1e3:.1f} ms/step"}
+                      f"B={B} S={S} remat={cfg.remat}, {dt*1e3:.1f} ms/step"}
+
+
+def bench_train_mfu(iters: int = 4):
+    """Tiny-Llama MFU (the r2 row; kept for continuity of the table)."""
+    return _train_mfu_row(
+        "train_step_mfu",
+        dict(d_model=512, n_layers=4, n_heads=8, n_kv_heads=8, d_ff=1536,
+             vocab_size=8192, dtype="bfloat16"),
+        B=8, S=1024, iters=iters)
+
+
+def bench_train_mfu_large(iters: int = 2):
+    """Model-scale MFU (VERDICT r2 next #3): a 672M-param GQA Llama at
+    S=8192 with remat + the pallas flash kernel, as large as one v5e-1
+    comfortably fits with the fori_loop's undonated params+opt carries
+    (~4 GB weights+moments live twice during timing, plus the [B, S, V]
+    f32 logits in the loss).  Target >= 0.40 of the 197T peak; the toy
+    train_step_mfu row stays for drift comparison."""
+    return _train_mfu_row(
+        "train_step_mfu_large",
+        dict(d_model=2048, n_layers=12, n_heads=16, n_kv_heads=4,
+             d_ff=5632, vocab_size=32000, dtype="bfloat16", remat=True),
+        B=1, S=8192, iters=iters)
 
 
 def check_numerics():
@@ -398,6 +420,98 @@ def bench_decode_tune(b=1, hq=8, hkv=2, t=8192, d=128, iters: int = 64):
                       f"({cache_bytes / best[1] / 1e9:.0f} GB/s)"}
 
 
+def bench_serve(batch=1, model="llama", ragged=False, prompt_len=512,
+                m_lo=32, m_hi=1056, reps=4, iters=None):
+    """End-to-end serving throughput: tokens/s for the REAL ``generate()``
+    surface (flash prefill + cached decode scan + top-k/top-p sampling; the
+    Mistral variant decodes through the O(window) rolling cache).
+
+    The whole generation is one dispatch, so timing the same workload at
+    two ``max_new`` counts and differencing cancels the tunnel RTT, the
+    prefill, and the host/dispatch overhead — the headline is pure
+    per-decode-token device time.  The lo-run wall clock is kept in the
+    detail so the overhead share (prefill + dispatch + host) stays visible
+    next to the kernel-level us/token rows (VERDICT r2 next #4; metric
+    discipline per /root/reference/benchmark.md:63-77).
+
+    ``iters`` is accepted for CLI uniformity and ignored (the decode scan
+    length IS the iteration count).
+    """
+    import numpy as np
+
+    from starway_tpu.models import LlamaConfig, init_params
+    from starway_tpu.models.generate import generate
+
+    kw = dict(d_model=1024, n_layers=8, n_heads=8, n_kv_heads=2, d_ff=2816,
+              vocab_size=32000, dtype="bfloat16")
+    if model == "mistral":
+        # Window < max_len: the aligned path decodes through the rolling
+        # O(window) cache (bit-identical to full-cache, pinned by tests).
+        kw["sliding_window"] = prompt_len
+    cfg = LlamaConfig.preset("debug", **kw)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(1, cfg.vocab_size, (batch, prompt_len), dtype=np.int32))
+    lengths = None
+    if ragged:
+        # Mixed prompt sizes in one right-padded batch: the ragged path's
+        # per-row cursors are the serving-realistic decode shape.
+        lengths = jnp.asarray(
+            rng.integers(prompt_len // 4, prompt_len + 1, batch,
+                         dtype=np.int32))
+    key = jax.random.PRNGKey(1)
+
+    def run(m, max_len):
+        out = generate(params, cfg, prompt, m, temperature=0.8, top_k=64,
+                       top_p=0.9, key=key, max_len=max_len,
+                       prompt_lengths=lengths)
+        jax.block_until_ready(out)
+
+    name = f"serve_{model}{'_ragged' if ragged else ''}_b{batch}"
+    # Jitter guard (same concern _timeit documents: tens-of-ms tunnel
+    # jitter): grow the hi/lo gap until the differenced time comfortably
+    # clears it, and REFUSE to report a number when it never does — a
+    # clamped near-zero difference would print an absurd tok/s headline
+    # that reads like a measurement.
+    gap = m_hi - m_lo
+    diff = float("-inf")
+    for _ in range(3):
+        m_hi_eff = m_lo + gap
+        max_len = prompt_len + m_hi_eff
+        run(m_lo, max_len)  # compile both signatures before timing
+        run(m_hi_eff, max_len)
+        t_lo = t_hi = float("inf")
+        for _ in range(reps):  # interleaved minima, like _timeit
+            t0 = time.perf_counter()
+            run(m_hi_eff, max_len)
+            t_hi = min(t_hi, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            run(m_lo, max_len)
+            t_lo = min(t_lo, time.perf_counter() - t0)
+        diff = t_hi - t_lo
+        if diff >= 0.2 or gap >= 4096:
+            break
+        gap = min(gap * 4, 4096)
+    if diff <= 0:
+        return {"metric": f"{name}_tokens_per_s",
+                "error": f"jitter swamped the differenced timing "
+                         f"(diff={diff * 1e3:.1f} ms at gap={gap} tokens); "
+                         f"rerun on a quieter link"}
+    dt_tok = diff / gap  # s per decode step
+    tok_s = batch / dt_tok
+    wall_tok_s = batch * m_lo / t_lo
+    overhead_ms = (t_lo - m_lo * dt_tok) * 1e3  # prefill + dispatch + host
+    return {"metric": f"{name}_tokens_per_s", "value": round(tok_s, 1),
+            "unit": "tok/s",
+            "detail": f"{dt_tok * 1e6 / batch:.1f} us/token device-only, "
+                      f"wall {wall_tok_s:.1f} tok/s at max_new={m_lo} "
+                      f"(P={prompt_len}, overhead {overhead_ms:.1f} ms/call "
+                      f"= prefill+dispatch+host), sampling top_k=64 "
+                      f"top_p=0.9, {cfg.n_layers}L d{cfg.d_model} GQA "
+                      f"{cfg.n_heads}/{cfg.n_kv_heads} bf16"}
+
+
 BENCHES = {
     "matmul": bench_matmul,
     "flash": bench_flash_fwd,
@@ -409,6 +523,11 @@ BENCHES = {
     "decode_lax": functools.partial(bench_decode, impl="lax"),
     "decode_tune": bench_decode_tune,
     "train_mfu": bench_train_mfu,
+    "train_mfu_large": bench_train_mfu_large,
+    "serve": bench_serve,
+    "serve_b8": functools.partial(bench_serve, batch=8),
+    "serve_ragged_b8": functools.partial(bench_serve, batch=8, ragged=True),
+    "serve_mistral": functools.partial(bench_serve, model="mistral"),
 }
 
 
@@ -425,8 +544,16 @@ def main():
             ok = ok and row["ok"]
             print(json.dumps(row), flush=True)
         raise SystemExit(0 if ok else 1)
-    if args.which == "all":  # tune sweeps are opt-in, not part of the suite
-        names = [n for n in BENCHES if not n.endswith("_tune")]
+    if args.which == "all":
+        # Tune sweeps, the end-to-end serve rows, and the model-scale MFU
+        # row are opt-in: each compiles big programs / runs long
+        # generations, which would grow the documented bare
+        # `bench.py --kernels` pass from minutes to an hour behind the
+        # tunnel.  onchip_refresh.sh runs them individually.
+        heavy = ("serve", "serve_b8", "serve_ragged_b8", "serve_mistral",
+                 "train_mfu_large")
+        names = [n for n in BENCHES
+                 if not n.endswith("_tune") and n not in heavy]
     else:
         names = args.which.split(",")
     exit_code = 0

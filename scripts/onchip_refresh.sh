@@ -44,6 +44,11 @@ run decode            # block_k=512 default: the row BASELINE.md flags as pendin
 run decode_lax
 run decode_tune       # block_k sweep; update the default if 512 is not the winner
 run train_mfu
+run train_mfu_large   # model-scale MFU: 672M GQA @ S=8192, remat (target >= 0.40)
+run serve             # end-to-end generate() tokens/s (VERDICT r3 #4) ...
+run serve_b8          # ... batch 8
+run serve_ragged_b8   # ... ragged (mixed prompt lengths)
+run serve_mistral     # ... rolling O(window) cache path
 echo "== check" >&2
 timeout 1200 python bench.py --kernels check 2>/dev/null | grep '"metric"' | tee -a "$OUT"
 echo "rows written to $OUT" >&2
