@@ -11,11 +11,22 @@ of the group against it as the rows of one MXU matmul.  Masking and the
 online-softmax accumulation are fused; fully-masked blocks (beyond the
 current position) are skipped via scalar-prefetched ``pos``.
 
-Block size: decode is bandwidth-bound with a ~0.4 µs fixed cost per grid
-cell, so small blocks drown in cell overhead (measured r2: block 128 at
-T=8192 = 128 cells ≈ 51 µs of overhead on a 60.8 µs total — slower than
-the lax path).  The 512 default quarters the cell count; re-tune on real
-hardware with ``bench.py --kernels decode_tune``.
+Two variants share the same online-softmax block body:
+
+* **stream** (default): one grid cell per (batch, kv head); the whole T
+  sweep is a ``fori_loop`` with double-buffered manual DMA
+  (``make_async_copy``) — compute on block i overlaps the HBM stream of
+  block i+1, and the per-cell pipeline cost is paid b*hkv times total,
+  independent of T.  Structural response to the r2 measurement below.
+* **grid** (``stream=False``): one grid cell per kv block, Pallas-pipelined.
+  Decode is bandwidth-bound with a ~0.4 µs fixed cost per grid cell, so
+  small blocks drown in cell overhead (measured r2: block 128 at T=8192 =
+  128 cells ≈ 51 µs of overhead on a 60.8 µs total — slower than the lax
+  path); block 512 quarters the cell count.
+
+``bench.py --kernels decode_tune`` sweeps both variants x block sizes on
+real hardware; the stream default is the structural bet until the chip
+confirms it.
 
 Same online-softmax algebra as ops/pallas_attention.py; layouts follow
 models/generate.py: ``q [B, Hq, 1, D]``, caches ``[B, Hkv, T, D]``.
@@ -32,6 +43,33 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .attention import NEG_BIG
 from .pallas_attention import _round_up
+
+
+def _softmax_block_update(q, k, v, k_start, pos, m_scr, l_scr, acc_scr, *,
+                          sm_scale: float, window: "int | None"):
+    """The one online-softmax block body both kernel variants share: score
+    the group's query rows against one [block_k, D] cache block, mask by
+    global position (and window), and fold into the m/l/acc scratches."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale  # [rows, block_k]
+    kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    keep = kv_pos <= pos
+    if window is not None:
+        keep = keep & (kv_pos > pos - window)
+    s = jnp.where(keep, s, NEG_BIG)
+
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.where(s > NEG_BIG / 2, jnp.exp(s - m_new), 0.0)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
@@ -58,37 +96,87 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(live)
     def _body():
-        q = q_ref[0]  # [rows, D] — the group's query heads (padded to tile)
-        k = k_ref[0]  # [block_k, D]
-        v = v_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # [rows, block_k]
-        kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        keep = kv_pos <= pos
-        if window is not None:
-            keep = keep & (kv_pos > pos - window)
-        s = jnp.where(keep, s, NEG_BIG)
-
-        m_prev = m_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.where(s > NEG_BIG / 2, jnp.exp(s - m_new), 0.0)
-        corr = jnp.exp(m_prev - m_new)
-        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        _softmax_block_update(
+            q_ref[0], k_ref[0], v_ref[0], k_start, pos, m_scr, l_scr,
+            acc_scr, sm_scale=sm_scale, window=window)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
         o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
 
 
+def _decode_stream_kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
+                          sems, m_scr, l_scr, acc_scr, *, sm_scale: float,
+                          block_k: int, hkv: int, window: "int | None",
+                          n_blocks: int):
+    """One grid cell per (batch, kv head): the WHOLE cache sweep runs in a
+    single cell as a fori_loop over kv blocks with double-buffered manual
+    DMA (compute on block i overlaps the HBM stream of block i+1).
+
+    Rationale: the grid kernel pays a fixed ~0.4 us pipeline cost per cell
+    (measured r2: 64 cells at block 128 ~= 51 us of a 60.8 us total — slower
+    than the lax path).  Here the cell count is b*hkv regardless of T, so
+    the overhead term is gone and the kernel's time is the max of the DMA
+    stream (~cache bytes / HBM bandwidth) and the (tiny) grouped-GQA
+    matmuls.
+    """
+    bh = pl.program_id(0)
+    pos = pos_ref[bh // hkv]
+    hi = pos // block_k  # last live block
+    if window is None:
+        lo = jnp.int32(0)
+    else:
+        lo = jnp.maximum(pos - window + 1, 0) // block_k
+
+    def kcp(i, slot):
+        return pltpu.make_async_copy(
+            k_hbm.at[bh, pl.ds(i * block_k, block_k)], k_buf.at[slot],
+            sems.at[slot, 0])
+
+    def vcp(i, slot):
+        return pltpu.make_async_copy(
+            v_hbm.at[bh, pl.ds(i * block_k, block_k)], v_buf.at[slot],
+            sems.at[slot, 1])
+
+    m_scr[:] = jnp.full_like(m_scr, NEG_BIG)
+    l_scr[:] = jnp.zeros_like(l_scr)
+    acc_scr[:] = jnp.zeros_like(acc_scr)
+    kcp(lo, 0).start()
+    vcp(lo, 0).start()
+    q = q_ref[0]  # [rows, D] — the group's query heads (padded to tile)
+
+    # STATIC trip count with liveness guards (not a dynamic-bound loop —
+    # simpler Mosaic lowering): dead iterations run a few scalar ops; DMA,
+    # waits, and compute all sit under pl.when, so only live blocks move
+    # bytes — a windowed decode still streams ~window bytes however big T.
+    def body(i, _):
+        live = (i >= lo) & (i <= hi)
+
+        @pl.when(live)
+        def _live():
+            slot = jax.lax.rem(i - lo, 2)
+
+            @pl.when(i + 1 <= hi)
+            def _prefetch():
+                ns = jax.lax.rem(i + 1 - lo, 2)
+                kcp(i + 1, ns).start()
+                vcp(i + 1, ns).start()
+
+            kcp(i, slot).wait()
+            vcp(i, slot).wait()
+            _softmax_block_update(
+                q, k_buf[slot], v_buf[slot], i * block_k, pos, m_scr, l_scr,
+                acc_scr, sm_scale=sm_scale, window=window)
+
+        return 0
+
+    jax.lax.fori_loop(0, n_blocks, body, 0)
+    o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
 def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
-                     block_k: int = 512, interpret=None, window=None):
+                     block_k: int = 512, interpret=None, window=None,
+                     stream: bool = True):
     """Cached single-query attention without expanding the grouped cache.
 
     q: [B, Hq, 1, D]; k_cache/v_cache: [B, Hkv, T, D]; pos: scalar int or
@@ -99,6 +187,12 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
     streams ~window bytes of cache regardless of T.  Returns
     [B, Hq, 1, D].  Numerically matches
     models/generate.py:_attend_cached (softmax in f32).
+
+    ``stream`` (default): the double-buffered single-cell kernel
+    (:func:`_decode_stream_kernel`) — b*hkv grid cells total, per-cell
+    pipeline overhead independent of T.  ``stream=False`` keeps the
+    grid-pipelined kernel (one cell per kv block); ``bench.py --kernels
+    decode_tune`` sweeps both on-chip.
     """
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
@@ -129,6 +223,38 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
         vf = jnp.pad(vf, ((0, 0), (0, t_pad - t), (0, 0)))
 
     pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
+
+    if stream:
+        out = pl.pallas_call(
+            functools.partial(
+                _decode_stream_kernel, sm_scale=sm_scale, block_k=block_k,
+                hkv=hkv, window=None if window is None else int(window),
+                n_blocks=t_pad // block_k),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(b * hkv,),
+                in_specs=[
+                    pl.BlockSpec((1, rows, d), lambda bh, pos_ref: (bh, 0, 0)),
+                    pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+                    pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+                ],
+                out_specs=pl.BlockSpec((1, rows, d),
+                                       lambda bh, pos_ref: (bh, 0, 0)),
+                scratch_shapes=[
+                    pltpu.VMEM((2, block_k, d), kf.dtype),
+                    pltpu.VMEM((2, block_k, d), vf.dtype),
+                    pltpu.SemaphoreType.DMA((2, 2)),
+                    pltpu.VMEM((rows, 128), jnp.float32),
+                    pltpu.VMEM((rows, 128), jnp.float32),
+                    pltpu.VMEM((rows, d), jnp.float32),
+                ],
+            ),
+            out_shape=jax.ShapeDtypeStruct((b * hkv, rows, d), q.dtype),
+            interpret=interpret,
+        )(pos_arr, qf, kf, vf)
+        return out.reshape(b, hkv, rows, d)[:, :, :n_rep, :].reshape(
+            b, hq, 1, d)
+
     grid = (b * hkv, t_pad // block_k)
 
     # Clamp the K/V block index into the live range: the kernel body is

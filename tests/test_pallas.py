@@ -86,13 +86,15 @@ def test_flash_grad_uneven_blocks():
                                    atol=5e-5, rtol=5e-4)
 
 
+@pytest.mark.parametrize("stream", [True, False], ids=["stream", "grid"])
 @pytest.mark.parametrize("pos", [0, 5, 127, 128, 299])
 @pytest.mark.parametrize("block_k", [128, None])
-def test_decode_kernel_matches_lax(pos, block_k):
-    """block_k=128 forces a MULTI-block grid at T=300 (the cross-block
-    online-softmax rescale and the repeated-block DMA clamp never run
-    otherwise — the 512 default is single-block at test sizes); None
-    covers the default config."""
+def test_decode_kernel_matches_lax(pos, block_k, stream):
+    """block_k=128 forces a MULTI-block sweep at T=300 (the cross-block
+    online-softmax rescale — and, for the grid kernel, the repeated-block
+    DMA clamp — never run otherwise; the 512 default is single-block at
+    test sizes); None covers the default config.  Both kernel variants
+    (double-buffered stream, grid pipeline) are pinned."""
     from starway_tpu.models.generate import _attend_cached
     from starway_tpu.ops.pallas_decode import decode_attention
 
@@ -103,7 +105,7 @@ def test_decode_kernel_matches_lax(pos, block_k):
     v = jax.random.normal(k3, (B, Hkv, T, D), jnp.float32)
     ref = _attend_cached(q, k, v, pos, Hq // Hkv, use_pallas=False)
     kw = {} if block_k is None else {"block_k": block_k}
-    out = decode_attention(q, k, v, pos, interpret=True, **kw)
+    out = decode_attention(q, k, v, pos, interpret=True, stream=stream, **kw)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
@@ -122,7 +124,8 @@ def test_decode_kernel_traced_pos_under_jit():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
-def test_decode_kernel_per_row_pos():
+@pytest.mark.parametrize("stream", [True, False], ids=["stream", "grid"])
+def test_decode_kernel_per_row_pos(stream):
     """Ragged decode: a [B] position vector masks (and DMA-clamps) each
     batch row at its own cursor; every row must match a standalone
     scalar-pos call."""
@@ -136,20 +139,22 @@ def test_decode_kernel_per_row_pos():
     v = jax.random.normal(k3, (B, Hkv, T, D), jnp.float32)
     pos = jnp.asarray([7, 255, 130], jnp.int32)
 
-    # block_k=128: multi-block grid, so each row's DMA clamp really stops
-    # at a different block index.
-    out = decode_attention(q, k, v, pos, interpret=True, block_k=128)
+    # block_k=128: multi-block sweep, so each row's DMA really stops at a
+    # different block index.
+    out = decode_attention(q, k, v, pos, interpret=True, block_k=128,
+                           stream=stream)
     lax_out = _attend_cached(q, k, v, pos, Hq // Hkv, use_pallas=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(lax_out),
                                atol=2e-5, rtol=2e-5)
     for b in range(B):
         solo = decode_attention(q[b:b + 1], k[b:b + 1], v[b:b + 1],
-                                int(pos[b]), interpret=True)
+                                int(pos[b]), interpret=True, stream=stream)
         np.testing.assert_allclose(np.asarray(out[b]), np.asarray(solo[0]),
                                    atol=2e-5, rtol=2e-5, err_msg=f"row {b}")
 
 
-def test_decode_kernel_sliding_window():
+@pytest.mark.parametrize("stream", [True, False], ids=["stream", "grid"])
+def test_decode_kernel_sliding_window(stream):
     """Windowed decode: kernel == lax windowed oracle, multi-block, with
     the window straddling block boundaries; scalar and per-row pos."""
     from starway_tpu.models.generate import _attend_cached
@@ -162,14 +167,14 @@ def test_decode_kernel_sliding_window():
     v = jax.random.normal(k3, (B, Hkv, T, D), jnp.float32)
     for pos in (0, 150, 380, 519):
         out = decode_attention(q, k, v, pos, interpret=True, block_k=128,
-                               window=W)
+                               window=W, stream=stream)
         ref = _attend_cached(q, k, v, pos, Hq // Hkv, use_pallas=False,
                              window=W)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5, err_msg=f"pos={pos}")
     pos_v = jnp.asarray([519, 77], jnp.int32)
     out = decode_attention(q, k, v, pos_v, interpret=True, block_k=128,
-                           window=W)
+                           window=W, stream=stream)
     ref = _attend_cached(q, k, v, pos_v, Hq // Hkv, use_pallas=False, window=W)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
