@@ -421,7 +421,7 @@ def bench_decode_tune(b=1, hq=8, hkv=2, t=8192, d=128, iters: int = 64):
             if best is None or dt < best[2]:
                 best = (variant, bk, dt)
     return {"metric": "decode_best_config", "value": best[1],
-            "unit": "block_k",
+            "unit": "block_k", "variant": best[0],
             "detail": f"{best[2] * 1e6:.2f} us with {best[0]} kernel at "
                       f"block_k={best[1]} "
                       f"({cache_bytes / best[2] / 1e9:.0f} GB/s)"}

@@ -13,9 +13,15 @@ those patterns are first-class, TPU-native:
   the async P2P API (BASELINE config 5).
 * :mod:`fsdp` -- ZeRO-style fully-sharded params + optimizer state via
   GSPMD annotations (all-gather per use, reduce-scatter per grad).
+* :mod:`pipeline` / :mod:`interleaved` -- collective 1F1B schedules over a
+  ``pp`` ring (plain, and Megatron-style virtual chunks).
 """
 
 from .fsdp import fsdp_specs, make_fsdp_train_step, shard_tree
+from .interleaved import (
+    build_interleaved_schedule,
+    make_interleaved_pipeline_train,
+)
 from .sharding import make_mesh, mesh_sharding
 from .ring_attention import (
     make_ring_attention,
@@ -35,6 +41,8 @@ __all__ = [
     "shard_tree",
     "ring_attention",
     "make_ring_attention",
+    "build_interleaved_schedule",
+    "make_interleaved_pipeline_train",
     "make_shuffle",
     "ClientPort",
     "ServerPort",

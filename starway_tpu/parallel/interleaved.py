@@ -1,0 +1,396 @@
+"""Interleaved 1F1B: virtual pipeline stages (Megatron-style chunks).
+
+The plain 1F1B schedule (parallel/pipeline.py) has bubble fraction
+``2(S-1) / (M + 2(S-1))`` — painful at small microbatch counts.  Splitting
+the model into ``V`` chunks per device (virtual stages) shortens the
+pipeline fill to one CHUNK's flight instead of one fused device-stage's.
+
+**Honest accounting for this executor.**  Both schedules here are
+masked-slot SPMD programs: every scan tick executes one F and one B slot
+on every device whether or not the slot is live, so an idle slot costs
+wall clock (unlike an eager executor, where Megatron's full ``V``× bubble
+shrink applies).  Under that model the greedy schedule below sits ON the
+critical-path lower bound (device-0 F throughput + the last microbatch's
+2VS-hop chain), and the win over plain 1F1B — same V*S-layer model, same
+devices, ticks normalised to chunk-passes — is ``(V-1)(S-2)`` ticks
+(for M >= S; below that both schedules tie at the shared critical path):
+``V(M + 2(S-1))`` plain vs ``VM + VS + S - 2`` interleaved.  ~7-10% at
+(V=2, S=4), ~20% at (V=4, S=8), nothing at S=2 — worth it exactly when
+stages are many and microbatches few.
+
+Design (TPU/SPMD-first, not a port of Megatron's executor):
+
+* **Placement**: virtual stage ``v`` of ``n_virtual = V*S`` lives on device
+  ``v % S`` as its chunk ``v // S``.  Consecutive virtual stages therefore
+  sit on consecutive devices — every activation hop is the SAME uniform
+  ring ``ppermute`` the non-interleaved pipeline uses; wraps (device S-1 →
+  device 0 forward, device 0 → device S-1 backward) carry the flow into
+  the next chunk.
+* **The schedule is two injection sequences.**  Within a chunk, a
+  microbatch moves one device per tick (no stalls), so every F slot is
+  determined by the tick its (chunk, microbatch) ENTERED device 0
+  (``entry0``), and every B slot by the tick its backward entered device
+  S-1 (``binj``).  Both sequences are built (and verified) on the HOST at
+  trace time; the device program is a ``lax.scan`` that executes
+  precomputed per-tick slot tables — no data-dependent control flow.
+* **Stash & inbox are table-indexed.**  Stage inputs stash per chunk for
+  the backward remat (free-list slots assigned host-side); backward
+  wrap cotangents queue in a per-chunk ring whose read/write positions
+  are also baked into the tables.  Forward wraps need NO queue: exact
+  ``S``-spacing of chunk entries makes every wrap consumed the tick it
+  arrives.
+
+Same homogeneous-stage constraint as the base schedule; embedding/head
+live outside (models/pp_llama.py shows the pattern for the base
+schedule).  Gradient parity vs the sequential VS-stage chain is pinned by
+tests/test_interleaved.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .sharding import shard_map_fn
+
+
+@dataclasses.dataclass(frozen=True)
+class InterleavedSchedule:
+    """Host-built slot program for one (M, S, V).  All arrays [ticks, S]
+    int32 unless noted; -1 = no slot this tick."""
+
+    n_micro: int
+    n_devices: int
+    n_chunks: int
+    ticks: int
+    stash_depth: int      # per-chunk stash slots
+    inbox_depth: int      # per-chunk backward wrap-queue slots
+    # F slot: chunk, microbatch, stash slot to write, inject? (device 0
+    # chunk 0 reads inputs[i]; every other F consumes the fwd ring carry).
+    f_chunk: np.ndarray
+    f_micro: np.ndarray
+    f_stash: np.ndarray
+    f_inject: np.ndarray  # bool [ticks, S]
+    # B slot: chunk, microbatch, stash slot to read, final? (loss vjp),
+    # wrap-inbox read position (-1 = take the bwd ring carry).
+    b_chunk: np.ndarray
+    b_micro: np.ndarray
+    b_stash: np.ndarray
+    b_final: np.ndarray   # bool
+    b_inbox_rd: np.ndarray
+    # Backward wrap WRITE: where tick t's incoming bwd ppermute lands
+    # (only ever valid on device S-1): chunk, ring position.
+    w_chunk: np.ndarray
+    w_pos: np.ndarray
+
+
+def build_interleaved_schedule(m: int, s: int, v: int) -> InterleavedSchedule:
+    """Build + verify the slot program (pure numpy, cache-friendly args)."""
+    if m < 1 or s < 1 or v < 1:
+        raise ValueError(f"need m,s,v >= 1, got {(m, s, v)}")
+
+    # ---- forward injections at device 0: groups of up to S microbatches,
+    # chunk-major inside a group, stride V*S per full group.  Spacing of a
+    # microbatch's chunk entries is EXACTLY S -> wraps consumed on arrival.
+    entry0 = np.zeros((v, m), np.int64)
+    base = 0
+    for g0 in range(0, m, s):
+        gsz = min(s, m - g0)
+        for c in range(v):
+            for i in range(gsz):
+                entry0[c, g0 + i] = base + c * s + i
+        base += v * s  # uniform stride, even for a partial last group
+
+    # ---- backward injections at device S-1: greedy, lowest chunk first
+    # (drain depth-first frees stash earliest).  Chunk V-1 of microbatch j
+    # becomes ready the tick its forward REACHES device S-1 (the loss-vjp
+    # slot recomputes from the stash written that same tick); chunk c < V-1
+    # becomes ready when chunk c+1's backward wrap ARRIVES
+    # (binj(c+1) + S-1 done at device 0, +1 for the hop).
+    f_done = entry0 + (s - 1)          # F tick at device S-1 per (c, j)
+    binj = -np.ones((v, m), np.int64)
+    ready = {(v - 1, j): int(f_done[v - 1, j]) for j in range(m)}
+    t = 0
+    remaining = v * m
+    horizon = (m + v * s + 2 * v * s * max(v, s) + 64) * 4
+    while remaining and t < horizon:
+        # one backward injection per tick max (device S-1's single B slot)
+        cand = [(c, j) for (c, j), rt in ready.items() if rt <= t]
+        if cand:
+            c, j = min(cand, key=lambda cj: (cj[0], cj[1]))
+            del ready[(c, j)]
+            binj[c, j] = t
+            remaining -= 1
+            if c > 0:
+                # wrap finishes the chunk at device 0 at t + S-1, arrives
+                # back at device S-1 next tick.
+                ready[(c - 1, j)] = t + s
+        t += 1
+    if remaining:
+        raise RuntimeError("interleaved schedule failed to converge "
+                           f"(m={m}, s={s}, v={v})")
+
+    ticks = int(max(binj.max() + s, entry0.max() + s))
+
+    # ---- per-device slot tables --------------------------------------
+    f_chunk = -np.ones((ticks, s), np.int32)
+    f_micro = -np.ones((ticks, s), np.int32)
+    f_inject = np.zeros((ticks, s), bool)
+    b_chunk = -np.ones((ticks, s), np.int32)
+    b_micro = -np.ones((ticks, s), np.int32)
+    b_final = np.zeros((ticks, s), bool)
+    for c in range(v):
+        for i in range(m):
+            for d in range(s):
+                tf = int(entry0[c, i]) + d
+                assert f_chunk[tf, d] == -1, "F slot collision"
+                f_chunk[tf, d] = c
+                f_micro[tf, d] = i
+                f_inject[tf, d] = (d == 0 and c == 0)
+                tb = int(binj[c, i]) + (s - 1 - d)
+                assert b_chunk[tb, d] == -1, "B slot collision"
+                b_chunk[tb, d] = c
+                b_micro[tb, d] = i
+                b_final[tb, d] = (d == s - 1 and c == v - 1)
+
+    # ---- stash slots: free-list per (device is uniform: F at device d is
+    # entry0+d, B at binj+(s-1-d); the in-flight WINDOW is widest at
+    # device 0 for F / also fine to compute per device and take the max).
+    stash_sl = -np.ones((ticks, s), np.int32)   # slot written by F
+    stash_rd = -np.ones((ticks, s), np.int32)   # slot read by B
+    depth = 0
+    for d in range(s):
+        slot_of = {}
+        free: list = []
+        next_new = 0
+        for t in range(ticks):
+            if f_chunk[t, d] >= 0:
+                key = (int(f_chunk[t, d]), int(f_micro[t, d]))
+                if free:
+                    sl = free.pop()
+                else:
+                    sl = next_new
+                    next_new += 1
+                slot_of[key] = sl
+                stash_sl[t, d] = sl
+            if b_chunk[t, d] >= 0:
+                key = (int(b_chunk[t, d]), int(b_micro[t, d]))
+                sl = slot_of.pop(key)
+                stash_rd[t, d] = sl
+                free.append(sl)
+        depth = max(depth, next_new)
+
+    # ---- backward wrap inbox (device S-1 only): a B of chunk c>=1 done
+    # at device 0 at tick t lands at device S-1 at t+1 for chunk c-1;
+    # consumed at binj[c-1, j].  FIFO ring per chunk, positions baked in.
+    w_chunk = -np.ones((ticks, s), np.int32)
+    w_pos = -np.ones((ticks, s), np.int32)
+    b_inbox_rd = -np.ones((ticks, s), np.int32)
+    inbox_depth = 1
+    wr = np.zeros(v, np.int64)
+    rd = np.zeros(v, np.int64)
+    pos_of = {}
+    for t in range(ticks):
+        # arrival first (ppermute from the previous tick's device-0 B)...
+        if t > 0 and b_chunk[t - 1, 0] >= 1:
+            c_arr = int(b_chunk[t - 1, 0]) - 1
+            w_chunk[t, s - 1] = c_arr
+            w_pos[t, s - 1] = wr[c_arr] % max(inbox_depth, 1)
+            pos_of[(c_arr, int(b_micro[t - 1, 0]))] = int(wr[c_arr])
+            wr[c_arr] += 1
+        # ...then consumption by this tick's B slot at device S-1.
+        if b_chunk[t, s - 1] >= 0 and not b_final[t, s - 1]:
+            c = int(b_chunk[t, s - 1])
+            j = int(b_micro[t, s - 1])
+            if c == v - 1:
+                raise AssertionError("non-final B at chunk V-1, device S-1")
+            abs_pos = pos_of.pop((c, j))
+            assert abs_pos == rd[c], "inbox consumed out of FIFO order"
+            b_inbox_rd[t, s - 1] = abs_pos  # ring-reduced after sizing
+            rd[c] += 1
+            inbox_depth = max(inbox_depth, int((wr - rd).max()) + 1)
+    # size the ring, then reduce positions modulo the final depth
+    w_pos = np.where(w_pos >= 0, 0, -1).astype(np.int32)
+    wr = np.zeros(v, np.int64)
+    for t in range(ticks):
+        if w_chunk[t, s - 1] >= 0:
+            w_pos[t, s - 1] = int(wr[w_chunk[t, s - 1]] % inbox_depth)
+            wr[w_chunk[t, s - 1]] += 1
+    rd = np.zeros(v, np.int64)
+    for t in range(ticks):
+        if b_inbox_rd[t, s - 1] >= 0:
+            c = int(b_chunk[t, s - 1])
+            b_inbox_rd[t, s - 1] = int(rd[c] % inbox_depth)
+            rd[c] += 1
+
+    return InterleavedSchedule(
+        n_micro=m, n_devices=s, n_chunks=v, ticks=ticks,
+        stash_depth=max(depth, 1), inbox_depth=inbox_depth,
+        f_chunk=f_chunk, f_micro=f_micro, f_stash=stash_sl,
+        f_inject=f_inject, b_chunk=b_chunk, b_micro=b_micro,
+        b_stash=stash_rd, b_final=b_final, b_inbox_rd=b_inbox_rd,
+        w_chunk=w_chunk, w_pos=w_pos,
+    )
+
+
+def interleaved_train_apply(stage_fn: Callable, loss_fn: Callable,
+                            stage_params, inputs, targets, axis_name: str,
+                            sched: InterleavedSchedule):
+    """Per-device body (call inside shard_map).
+
+    ``stage_params``: this device's chunks, leading dim V (chunk c =
+    virtual stage ``c*S + d``).  ``inputs [M, mb, ...]`` / ``targets
+    [M, ...]`` replicated.  Returns ``(loss, dparams [V, ...])`` laid out
+    like the params.
+    """
+    s = sched.n_devices
+    v = sched.n_chunks
+    m = sched.n_micro
+    d_idx = lax.axis_index(axis_name)
+    mb_shape = inputs.shape[1:]
+    fwd_perm = [(i, (i + 1) % s) for i in range(s)]
+    bwd_perm = [(i, (i - 1) % s) for i in range(s)]
+
+    tabs = {k: jnp.asarray(getattr(sched, k)) for k in (
+        "f_chunk", "f_micro", "f_stash", "f_inject", "b_chunk", "b_micro",
+        "b_stash", "b_final", "b_inbox_rd", "w_chunk", "w_pos")}
+
+    def pick(tab_row):
+        return tab_row[d_idx]
+
+    def chunk_params(c):
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, c, axis=0, keepdims=False),
+            stage_params)
+
+    def f32_zeros_like(tree):
+        return jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), tree)
+
+    def tick(carry, trow):
+        fwd_in, bwd_in, stash, inbox, dparams, loss_acc = carry
+        fc = pick(trow["f_chunk"])
+        fi = pick(trow["f_micro"])
+        fsl = pick(trow["f_stash"])
+        finj = pick(trow["f_inject"])
+        bc = pick(trow["b_chunk"])
+        bj = pick(trow["b_micro"])
+        bsl = pick(trow["b_stash"])
+        bfin = pick(trow["b_final"])
+        brd = pick(trow["b_inbox_rd"])
+        wc = pick(trow["w_chunk"])
+        wp = pick(trow["w_pos"])
+
+        # ---- backward wrap arrival (device S-1): file last tick's
+        # incoming cotangent into the per-chunk ring before any use.
+        wc_c = jnp.clip(wc, 0, v - 1)
+        wp_c = jnp.clip(wp, 0, sched.inbox_depth - 1)
+        upd = jnp.where(wc >= 0, bwd_in,
+                        inbox[wc_c, wp_c])  # no-op write when invalid
+        inbox = lax.dynamic_update_index_in_dim(
+            inbox, lax.dynamic_update_index_in_dim(
+                inbox[wc_c], upd, wp_c, axis=0), wc_c, axis=0)
+
+        # ---- F slot ----------------------------------------------------
+        f_valid = fc >= 0
+        fc_c = jnp.clip(fc, 0, v - 1)
+        x_inject = inputs[jnp.clip(fi, 0, m - 1)]
+        x = jnp.where(finj, x_inject, fwd_in)
+        y = stage_fn(chunk_params(fc_c), x)
+        sl = jnp.where(f_valid, jnp.clip(fsl, 0, sched.stash_depth - 1),
+                       sched.stash_depth)  # trash slot
+        stash = lax.dynamic_update_index_in_dim(stash, x, sl, axis=0)
+        fwd_out = lax.ppermute(y.astype(inputs.dtype), axis_name, fwd_perm)
+
+        # ---- B slot ----------------------------------------------------
+        b_valid = bc >= 0
+        bc_c = jnp.clip(bc, 0, v - 1)
+        bj_c = jnp.clip(bj, 0, m - 1)
+        x_saved = stash[jnp.clip(bsl, 0, sched.stash_depth - 1)]
+        target = targets[bj_c]
+        # Incoming cotangent: the ring carry (within-chunk hop) unless the
+        # tables point at an inbox position (device S-1 wrap consumption).
+        ct_in = jnp.where(brd >= 0,
+                          inbox[bc_c, jnp.clip(brd, 0, sched.inbox_depth - 1)],
+                          bwd_in)
+        p_c = chunk_params(bc_c)
+
+        def final_branch(_):
+            def h(p, x):
+                return loss_fn(stage_fn(p, x), target)
+
+            loss_j, (dp, dx) = jax.value_and_grad(h, argnums=(0, 1))(
+                p_c, x_saved)
+            return (f32_tree(dp), dx.astype(jnp.float32),
+                    jnp.asarray(loss_j, jnp.float32))
+
+        def mid_branch(_):
+            _, vjp_fn = jax.vjp(lambda p, x: stage_fn(p, x), p_c, x_saved)
+            dp, dx = vjp_fn(ct_in.astype(y.dtype))
+            return (f32_tree(dp), dx.astype(jnp.float32), jnp.float32(0))
+
+        def f32_tree(tree):
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32), tree)
+
+        dp, dx, loss_j = lax.cond(bfin, final_branch, mid_branch, None)
+        mask = b_valid.astype(jnp.float32)
+        dparams = jax.tree_util.tree_map(
+            lambda acc, g: acc.at[bc_c].add(mask * g), dparams, dp)
+        loss_acc = loss_acc + mask * loss_j
+        bwd_out = lax.ppermute(dx * mask, axis_name, bwd_perm)
+
+        return (fwd_out, bwd_out, stash, inbox, dparams, loss_acc), None
+
+    init = (
+        jnp.zeros(mb_shape, inputs.dtype),
+        jnp.zeros(mb_shape, jnp.float32),
+        jnp.zeros((sched.stash_depth + 1,) + mb_shape, inputs.dtype),
+        jnp.zeros((v, sched.inbox_depth) + mb_shape, jnp.float32),
+        f32_zeros_like(stage_params),
+        jnp.float32(0),
+    )
+    rows = {k: t for k, t in tabs.items()}
+    (_, _, _, _, dparams, loss_acc), _ = lax.scan(tick, init, rows)
+    loss = lax.psum(loss_acc, axis_name) / m
+    dparams = jax.tree_util.tree_map(lambda g: g / m, dparams)
+    return loss, dparams
+
+
+def make_interleaved_pipeline_train(mesh, stage_fn: Callable,
+                                    loss_fn: Callable,
+                                    axis_name: str = "pp", *,
+                                    n_chunks: int, n_micro: int):
+    """Jitted global-view interleaved-1F1B training step builder.
+
+    ``stage_params`` global view: ``[V, S, ...]`` — ``stage_params[c, d]``
+    is virtual stage ``c*S + d`` (device d's chunk c); dim 1 shards over
+    ``axis_name``.  Returns ``step(stage_params, inputs, targets) ->
+    (loss, grads)`` with grads laid out like the params.  ``n_micro`` is
+    static (the slot tables are built for it); inputs [M, mb, ...].
+    """
+    s = mesh.shape[axis_name]
+    sched = build_interleaved_schedule(n_micro, s, n_chunks)
+
+    def local(stage_params, inputs, targets):
+        # shard_map leaves a size-1 device dim at axis 1: [V, 1, ...] ->
+        # [V, ...]
+        sp = jax.tree_util.tree_map(lambda a: a[:, 0], stage_params)
+        loss, dp = interleaved_train_apply(
+            stage_fn, loss_fn, sp, inputs, targets, axis_name, sched)
+        dp = jax.tree_util.tree_map(lambda a: a[:, None], dp)
+        return loss, dp
+
+    staged = shard_map_fn(
+        mesh, local,
+        in_specs=(P(None, axis_name), P(), P()),
+        out_specs=(P(), P(None, axis_name)),
+    )
+    return jax.jit(staged)

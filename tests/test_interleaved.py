@@ -1,0 +1,160 @@
+"""Interleaved 1F1B (virtual pipeline stages): schedule validity, gradient
+parity vs the sequential V*S-stage chain, and the bubble win over the plain
+schedule at small M."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from starway_tpu.parallel import make_mesh
+from starway_tpu.parallel.interleaved import (
+    build_interleaved_schedule,
+    make_interleaved_pipeline_train,
+)
+from starway_tpu.parallel.pipeline import pipeline_ticks
+
+pytestmark = pytest.mark.asyncio
+
+D = 8
+
+
+def _stage_fn(w, x):
+    # w: [D, D] (one virtual stage's params), x: [mb, D]
+    return jnp.tanh(x @ w)
+
+
+def _loss_fn(y, target):
+    return jnp.mean((y - target) ** 2)
+
+
+def _sequential_reference(ws_flat, inputs, targets):
+    """ws_flat: [V*S, D, D] in virtual-stage order."""
+
+    def loss(ws):
+        def per_mb(x, t):
+            h = x
+            for s in range(ws.shape[0]):
+                h = jnp.tanh(h @ ws[s])
+            return _loss_fn(h, t)
+
+        return jnp.mean(jax.vmap(per_mb)(inputs, targets))
+
+    return jax.value_and_grad(loss)(ws_flat)
+
+
+@pytest.mark.parametrize("m,s,v", [(4, 2, 2), (8, 4, 2), (2, 2, 3),
+                                   (5, 2, 2), (3, 4, 2)])
+def test_schedule_is_valid(m, s, v):
+    """Every (chunk, microbatch) gets exactly one F and one B slot per
+    device, dependencies hold, and no per-tick slot collides (the builder
+    asserts collisions; here we pin coverage + ordering)."""
+    sched = build_interleaved_schedule(m, s, v)
+    for d in range(s):
+        f_seen = set()
+        b_seen = set()
+        f_tick = {}
+        b_tick = {}
+        for t in range(sched.ticks):
+            if sched.f_chunk[t, d] >= 0:
+                key = (int(sched.f_chunk[t, d]), int(sched.f_micro[t, d]))
+                assert key not in f_seen
+                f_seen.add(key)
+                f_tick[key] = t
+            if sched.b_chunk[t, d] >= 0:
+                key = (int(sched.b_chunk[t, d]), int(sched.b_micro[t, d]))
+                assert key not in b_seen
+                b_seen.add(key)
+                b_tick[key] = t
+        assert f_seen == {(c, i) for c in range(v) for i in range(m)}
+        assert b_seen == f_seen
+        for key, tf in f_tick.items():
+            assert b_tick[key] >= tf, "backward before forward"
+    # within-chunk flow: one device per tick, both directions
+    for c in range(v):
+        for i in range(m):
+            ticks_f = [next(t for t in range(sched.ticks)
+                            if sched.f_chunk[t, d] == c
+                            and sched.f_micro[t, d] == i)
+                       for d in range(s)]
+            assert ticks_f == list(range(ticks_f[0], ticks_f[0] + s))
+            ticks_b = [next(t for t in range(sched.ticks)
+                            if sched.b_chunk[t, d] == c
+                            and sched.b_micro[t, d] == i)
+                       for d in range(s)]
+            # device 0 backprops LAST within a chunk: ticks descend by
+            # device, ticks_b[d] = binj + (s-1-d).
+            assert ticks_b == list(range(ticks_b[0], ticks_b[0] - s, -1))
+
+
+@pytest.mark.parametrize("m,s,v", [(4, 2, 2), (8, 4, 2), (2, 2, 3),
+                                   (5, 2, 2)])
+def test_interleaved_matches_sequential(m, s, v):
+    """Loss AND gradients equal the flat V*S-stage chain — the oracle pin
+    (VERDICT r2 stretch #9), including M < S (mostly-bubble) and odd M."""
+    mesh = make_mesh({"pp": s})
+    rng = np.random.default_rng(0)
+    # ws[c, d] = virtual stage c*S + d
+    ws = jnp.asarray(rng.normal(size=(v, s, D, D)) * 0.5, jnp.float32)
+    inputs = jnp.asarray(rng.normal(size=(m, 4, D)), jnp.float32)
+    targets = jnp.asarray(rng.normal(size=(m, 4, D)), jnp.float32)
+
+    step = make_interleaved_pipeline_train(
+        mesh, _stage_fn, _loss_fn, "pp", n_chunks=v, n_micro=m)
+    loss, grads = step(ws, inputs, targets)
+
+    # flat [V*S] order: virtual stage v = c*S + d -> ws[c, d]
+    ws_flat = ws.reshape(v * s, D, D)
+    ref_loss, ref_grads = _sequential_reference(ws_flat, inputs, targets)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads.reshape(v * s, D, D)),
+                               np.asarray(ref_grads), atol=1e-5, rtol=1e-4)
+
+
+def test_interleaved_shrinks_the_bubble():
+    """For the same model (V*S layers) on the same S devices, interleaved
+    ticks (1 chunk-unit each) vs plain 1F1B ticks (V chunk-units each):
+    the win is (V-1)(S-2) units — the masked-slot executor bound the
+    module docstring derives (idle slots still execute here, so the full
+    Megatron V x bubble shrink does not apply).  S=2 and tiny M are ties
+    at the shared critical path; never worse."""
+    for m, s, v in [(4, 4, 2), (8, 4, 2), (16, 4, 2), (8, 8, 4)]:  # M >= S
+        sched = build_interleaved_schedule(m, s, v)
+        time_plain = v * pipeline_ticks(m, s, train=True)
+        win = (v - 1) * (s - 2)
+        assert sched.ticks <= time_plain - win, (
+            f"m={m} s={s} v={v}: interleaved {sched.ticks} chunk-units vs "
+            f"plain {time_plain} (expected win {win})")
+    # M < S and S=2 degenerate toward the shared critical path.  Plain can
+    # even be marginally better there: fusing chunks onto one device skips
+    # the V-1 inter-chunk wrap hops the virtual ring pays per microbatch
+    # chain — bounded by that slack, never more.
+    for m, s, v in [(2, 4, 2), (1, 2, 2), (2, 2, 3), (4, 8, 2), (2, 4, 4)]:
+        sched = build_interleaved_schedule(m, s, v)
+        assert sched.ticks <= v * pipeline_ticks(m, s, train=True) + (v - 1)
+
+
+def test_interleaved_trains_with_optax():
+    import optax
+
+    m, s, v = 4, 2, 2
+    mesh = make_mesh({"pp": s})
+    rng = np.random.default_rng(1)
+    ws = jnp.asarray(rng.normal(size=(v, s, D, D)) * 0.5, jnp.float32)
+    inputs = jnp.asarray(rng.normal(size=(m, 4, D)), jnp.float32)
+    targets = jnp.asarray(rng.normal(size=(m, 4, D)), jnp.float32)
+
+    step = make_interleaved_pipeline_train(
+        mesh, _stage_fn, _loss_fn, "pp", n_chunks=v, n_micro=m)
+    tx = optax.adam(1e-2)
+    opt = tx.init(ws)
+    losses = []
+    for _ in range(5):
+        loss, grads = step(ws, inputs, targets)
+        updates, opt = tx.update(grads, opt, ws)
+        ws = optax.apply_updates(ws, updates)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
