@@ -1792,6 +1792,18 @@ extern "C" {
 
 const char* sw_version() { return "starway-native-2"; }  // 2: sm transport
 
+// Portable cursor atomics for the Python engine's sm ring (sw_engine.h).
+// std::atomic_ref would be C++20-tidy but libstdc++'s needs alignment UB
+// care on char buffers; the builtin form compiles to ldar/stlr on ARM and
+// plain mov on x86, which is exactly the contract.
+uint64_t sw_atomic_load_u64(const void* p) {
+  return __atomic_load_n(static_cast<const uint64_t*>(p), __ATOMIC_ACQUIRE);
+}
+
+void sw_atomic_store_u64(void* p, uint64_t v) {
+  __atomic_store_n(static_cast<uint64_t*>(p), v, __ATOMIC_RELEASE);
+}
+
 // ----- client
 
 void* sw_client_new(const char* worker_id) {

@@ -202,6 +202,19 @@ int sw_send_devpull(void* h, uint64_t conn_id, uint64_t tag,
  * hang, tests/test_basic.py implicit-destruction test). */
 void sw_free(void* h);
 
+/* Portable shared-memory cursor atomics for the PYTHON engine's sm ring.
+ *
+ * The pure-Python ring (core/shmring.py) depends on x86-TSO store ordering
+ * for its data-before-tail publication; Python cannot emit fences, so on
+ * other architectures it routes every cursor access through these two
+ * functions instead (ctypes call per cursor op -- slower than a mmap read,
+ * far faster than losing sm to TCP).  `p` must be 8-byte aligned and point
+ * into the mapped segment.  Acquire load / release store, matching the
+ * C++ engine's own SmRing accessors -- one memory-ordering contract for
+ * both engines on the same segment layout. */
+uint64_t sw_atomic_load_u64(const void* p);
+void sw_atomic_store_u64(void* p, uint64_t v);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

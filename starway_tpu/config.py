@@ -78,19 +78,25 @@ def inproc_enabled() -> bool:
 def sm_enabled() -> bool:
     # The pure-Python ring relies on x86-TSO store ordering for its
     # data-before-tail publication (core/shmring.py); ARM permits
-    # store-store reordering and Python cannot fence, so the Python engine
-    # neither offers nor accepts sm elsewhere.  It also relies on CPython's
-    # aligned 8-byte memoryview stores being single machine stores in
-    # program order -- a JIT (PyPy, future CPython tiers) may reorder or
-    # tear them, so gate on the implementation too.  (The C++ engine uses
-    # real atomics and carries sm on any architecture/runtime.)
+    # store-store reordering and Python cannot fence.  Off x86 the ring
+    # routes every cursor access through the native lib's acquire/release
+    # atomics instead (shmring._use_portable_atomics) -- sm is only
+    # refused when that lib is unavailable too.  CPython is still required
+    # either way: the ring's data copies go through memoryview slices
+    # whose program-order guarantees a JIT (PyPy, future CPython tiers)
+    # may not preserve.  (The C++ engine uses real atomics throughout and
+    # carries sm on any architecture/runtime.)
     import platform
 
-    if platform.machine() not in ("x86_64", "AMD64"):
-        return False
     if platform.python_implementation() != "CPython":
         return False
-    return "sm" in transports_enabled()
+    if "sm" not in transports_enabled():
+        return False
+    if platform.machine() not in ("x86_64", "AMD64"):
+        from .core import native
+
+        return native.atomics() is not None
+    return True
 
 
 def advertised_host() -> str:

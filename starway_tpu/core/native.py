@@ -118,6 +118,13 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p,
             ctypes.c_uint64, _DONE_CB, _FAIL_CB, ctypes.c_void_p,
         ]
+        # Optional (older .so builds lack them): portable sm cursor atomics
+        # for the Python engine on non-TSO architectures (core/shmring.py).
+        if hasattr(lib, "sw_atomic_load_u64"):
+            lib.sw_atomic_load_u64.argtypes = [ctypes.c_void_p]
+            lib.sw_atomic_load_u64.restype = ctypes.c_uint64
+            lib.sw_atomic_store_u64.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_uint64]
         _lib = lib
     except Exception as e:  # toolchain/build failure => Python engine
         _lib_err = str(e)
@@ -127,6 +134,16 @@ def load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return load() is not None
+
+
+def atomics() -> Optional[tuple]:
+    """(load_acquire_u64, store_release_u64) ctypes fns, or None (no
+    native lib, or an old build without them).  Used by core/shmring.py to
+    carry sm on non-x86 hosts."""
+    lib = load()
+    if lib is None or not hasattr(lib, "sw_atomic_load_u64"):
+        return None
+    return lib.sw_atomic_load_u64, lib.sw_atomic_store_u64
 
 
 # ----------------------------------------------------------- op registry

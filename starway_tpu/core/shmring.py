@@ -29,14 +29,17 @@ Segment layout (all little-endian, offsets in bytes)::
 ``head``/``tail`` live on separate cache lines (the producer writes tail and
 reads head; the consumer the reverse).  Cursors are free-running u64s:
 ``avail = tail - head``, ``free = ring_size - avail``; data index is
-``cursor & (ring_size - 1)``.  The pure-Python implementation depends on
-x86-TSO: aligned 8-byte stores are atomic and store-store ordered, which is
-exactly the data-before-tail publication this protocol needs; Python cannot
-emit fences, so ``config.sm_enabled()`` gates the Python engine to x86-64.
-The C++ engine implements the same layout with real acquire/release
-atomics and carries sm on any architecture.  This layout is the
-cross-engine contract: any change here must land in both engines
-(CLAUDE.md "two engines, one contract").
+``cursor & (ring_size - 1)``.  On x86/CPython the pure-Python cursor ops
+lean on TSO: aligned 8-byte stores are atomic and store-store ordered,
+which is exactly the data-before-tail publication this protocol needs.  On
+other architectures Python cannot fence, so every cursor access routes
+through the native lib's ``sw_atomic_load_u64``/``sw_atomic_store_u64``
+(acquire/release; see :func:`_use_portable_atomics`) — ``config.
+sm_enabled()`` refuses sm only when that lib is unavailable too.  The C++
+engine implements the same layout with real atomics throughout and
+carries sm on any architecture.  This layout is the cross-engine
+contract: any change here must land in both engines (CLAUDE.md "two
+engines, one contract").
 
 Wakeup protocol: every cross-side wakeup rides the TCP socket, never shared
 memory.  A producer that advances ``tail`` sends a doorbell byte (DB_DATA);
@@ -53,6 +56,7 @@ wakeup a sleeping producer depends on is never dropped.
 
 from __future__ import annotations
 
+import ctypes
 import mmap
 import os
 import secrets
@@ -79,6 +83,18 @@ DEFAULT_RING = 1 << 20
 MAX_RING = 1 << 30
 
 
+def _use_portable_atomics() -> bool:
+    """Route cursor accesses through the native lib's acquire/release
+    atomics instead of raw mmap ops.  Needed off x86 (no TSO, Python can't
+    fence); forceable on x86 via STARWAY_SM_FORCE_ATOMICS=1 so the
+    portable path stays testable on this (x86) CI."""
+    if os.environ.get("STARWAY_SM_FORCE_ATOMICS") == "1":
+        return True
+    import platform
+
+    return platform.machine() not in ("x86_64", "AMD64")
+
+
 def default_ring_size() -> int:
     raw = os.environ.get("STARWAY_SM_RING", "")
     if not raw:
@@ -99,7 +115,8 @@ class Ring:
     calls :meth:`read_into` (the consumer); both may inspect cursors.
     """
 
-    __slots__ = ("_u64", "_data", "size", "_hdr_idx")
+    __slots__ = ("_u64", "_data", "size", "_hdr_idx", "_at", "_tail_addr",
+                 "_head_addr")
 
     def __init__(self, seg_mv: memoryview, hdr_off: int, data_off: int, size: int):
         # One u64 view over the whole segment: index = byte offset / 8.
@@ -107,22 +124,55 @@ class Ring:
         self._data = seg_mv[data_off : data_off + size]
         self.size = size
         self._hdr_idx = hdr_off // 8
+        self._at = None
+        self._tail_addr = self._head_addr = 0
+        if _use_portable_atomics():
+            from . import native
 
-    # cursor accessors (aligned 8-byte ops; atomic on the platforms we run on)
+            self._at = native.atomics()
+            if self._at is None:
+                # config.sm_enabled() refuses sm before it gets here; this
+                # guards direct Ring constructions (tests, future callers).
+                raise RuntimeError(
+                    "sm on a non-TSO host needs the native lib's cursor "
+                    "atomics (core/native.py:atomics)")
+            # Address only -- the from_buffer export is dropped immediately
+            # so it cannot pin the segment against close; the mapping (and
+            # thus the address) outlives this Ring by construction.
+            anchor = ctypes.c_char.from_buffer(seg_mv)
+            base = ctypes.addressof(anchor)
+            del anchor
+            self._tail_addr = base + hdr_off + OFF_TAIL
+            self._head_addr = base + hdr_off + OFF_HEAD
+
+    # cursor accessors: on x86/CPython these are single aligned 8-byte mmap
+    # ops (atomic + store-ordered under TSO); elsewhere they route through
+    # the native acquire/release atomics (one memory-ordering contract with
+    # the C++ engine's SmRing on the same segment).
     @property
     def tail(self) -> int:
+        if self._at is not None:
+            return self._at[0](self._tail_addr)
         return self._u64[self._hdr_idx + OFF_TAIL // 8]
 
     @tail.setter
     def tail(self, v: int) -> None:
+        if self._at is not None:
+            self._at[1](self._tail_addr, v)
+            return
         self._u64[self._hdr_idx + OFF_TAIL // 8] = v
 
     @property
     def head(self) -> int:
+        if self._at is not None:
+            return self._at[0](self._head_addr)
         return self._u64[self._hdr_idx + OFF_HEAD // 8]
 
     @head.setter
     def head(self, v: int) -> None:
+        if self._at is not None:
+            self._at[1](self._head_addr, v)
+            return
         self._u64[self._hdr_idx + OFF_HEAD // 8] = v
 
     def readable(self) -> int:
@@ -162,6 +212,11 @@ class Ring:
         return n
 
     def release(self) -> None:
+        # Null the atomics path too: a post-close cursor access must raise
+        # (like the mmap path's released-memoryview ValueError), not call
+        # sw_atomic_load_u64 on an unmapped page and segfault the process.
+        self._at = None
+        self._tail_addr = self._head_addr = 0
         self._data.release()
         self._u64.release()
 

@@ -201,8 +201,7 @@ def build_interleaved_schedule(m: int, s: int, v: int) -> InterleavedSchedule:
         # arrival first (ppermute from the previous tick's device-0 B)...
         if t > 0 and b_chunk[t - 1, 0] >= 1:
             c_arr = int(b_chunk[t - 1, 0]) - 1
-            w_chunk[t, s - 1] = c_arr
-            w_pos[t, s - 1] = wr[c_arr] % max(inbox_depth, 1)
+            w_chunk[t, s - 1] = c_arr  # position filled in the second pass
             pos_of[(c_arr, int(b_micro[t - 1, 0]))] = int(wr[c_arr])
             wr[c_arr] += 1
         # ...then consumption by this tick's B slot at device S-1.
@@ -216,8 +215,7 @@ def build_interleaved_schedule(m: int, s: int, v: int) -> InterleavedSchedule:
             b_inbox_rd[t, s - 1] = abs_pos  # ring-reduced after sizing
             rd[c] += 1
             inbox_depth = max(inbox_depth, int((wr - rd).max()) + 1)
-    # size the ring, then reduce positions modulo the final depth
-    w_pos = np.where(w_pos >= 0, 0, -1).astype(np.int32)
+    # size the ring, then assign positions modulo the final depth
     wr = np.zeros(v, np.int64)
     for t in range(ticks):
         if w_chunk[t, s - 1] >= 0:
@@ -357,8 +355,7 @@ def interleaved_train_apply(stage_fn: Callable, loss_fn: Callable,
         f32_zeros_like(stage_params),
         jnp.float32(0),
     )
-    rows = {k: t for k, t in tabs.items()}
-    (_, _, _, _, dparams, loss_acc), _ = lax.scan(tick, init, rows)
+    (_, _, _, _, dparams, loss_acc), _ = lax.scan(tick, init, tabs)
     loss = lax.psum(loss_acc, axis_name) / m
     dparams = jax.tree_util.tree_map(lambda g: g / m, dparams)
     return loss, dparams

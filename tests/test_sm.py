@@ -98,6 +98,70 @@ def test_ring_byte_stream_wrap_and_backpressure():
     assert seg.key not in _shm_segments()
 
 
+def test_ring_portable_atomics_path(monkeypatch):
+    """The non-TSO cursor path (native acquire/release atomics via ctypes,
+    forced here with STARWAY_SM_FORCE_ATOMICS) must carry the same byte
+    stream — including mixing with a plain-mmap peer on the SAME segment,
+    which is exactly the situation when only one side is off-x86."""
+    from starway_tpu.core import native
+
+    if native.atomics() is None:
+        pytest.skip("native lib (portable sm atomics) unavailable")
+
+    monkeypatch.setenv("STARWAY_SM_FORCE_ATOMICS", "1")
+    seg = shmring.ShmSegment.create("atomics", ring_size=4096)
+    try:
+        tx, rx = seg.tx_rx(creator=True)
+        assert tx._at is not None  # the forced path is actually in use
+        monkeypatch.delenv("STARWAY_SM_FORCE_ATOMICS")
+        # plain-mmap view of the same segment: the cross-convention pairing
+        plain = shmring.ShmSegment.attach(seg.key, seg.nonce, seg.ring_size)
+        peer_tx, peer_rx = plain.tx_rx(creator=False)
+        assert peer_rx._at is None
+
+        blob = bytes(range(256)) * 8  # 2048
+        assert tx.write(memoryview(blob)) == 2048
+        out = bytearray(2048)
+        assert peer_rx.read_into(memoryview(out)) == 2048
+        assert out == bytearray(blob)
+        # and the reverse direction, plain producer -> atomic consumer
+        assert peer_tx.write(memoryview(blob[:512])) == 512
+        out2 = bytearray(512)
+        assert rx.read_into(memoryview(out2)) == 512
+        assert out2 == bytearray(blob[:512])
+        assert tx.free() == 4096 and rx.readable() == 0
+        plain.close()
+    finally:
+        seg.unlink()
+        seg.close()
+    assert seg.key not in _shm_segments()
+
+
+async def test_sm_exchange_with_portable_atomics(port, sm_env, monkeypatch,
+                                                 shm_baseline):
+    """Full sm negotiation + a framed payload with every Python cursor op
+    routed through the native atomics (the off-x86 configuration, forced
+    on this x86 host)."""
+    from starway_tpu.core import native
+
+    if native.atomics() is None:
+        pytest.skip("native lib (portable sm atomics) unavailable")
+    monkeypatch.setenv("STARWAY_SM_FORCE_ATOMICS", "1")
+
+    async with _pair(port) as (server, client):
+        ep = server.list_clients().pop()
+        assert ep.view_transports() == [("shm", "sm")]
+        payload = np.random.default_rng(5).integers(
+            0, 255, 1 << 18, dtype=np.uint8)
+        buf = np.zeros(1 << 18, dtype=np.uint8)
+        fut = server.arecv(buf, 0x5A, (1 << 64) - 1)
+        await client.asend(payload, 0x5A)
+        tag, n = await asyncio.wait_for(fut, 15)
+        assert (tag, n) == (0x5A, len(payload))
+        np.testing.assert_array_equal(buf, payload)
+    assert not _shm_leftovers(shm_baseline)
+
+
 def test_segment_attach_validation():
     seg = shmring.ShmSegment.create("attach", ring_size=8192)
     try:
