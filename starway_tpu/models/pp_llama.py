@@ -85,28 +85,77 @@ def shard_pp_params(pp_params: dict, mesh, axis_name: str = "pp") -> dict:
     return shard_tree(pp_params, mesh, pp_param_specs(pp_params, axis_name))
 
 
+def ppv_split_params(params: dict, n_stages: int, n_chunks: int) -> dict:
+    """Flat init_params tree -> INTERLEAVED pipeline layout: stages get a
+    leading ``[V, S, L/(V*S), ...]`` shape where ``stages[c, d]`` holds
+    virtual stage ``c*S + d``'s layers (parallel/interleaved.py's
+    placement).  ``pp_split_params``'s [V*S]-leading layout reshapes
+    straight in (virtual stage v = flat index v)."""
+    flat = pp_split_params(params, n_stages * n_chunks)
+    return {
+        "embed": flat["embed"],
+        "stages": jax.tree_util.tree_map(
+            lambda a: a.reshape(n_chunks, n_stages, *a.shape[1:]),
+            flat["stages"]),
+        "head": flat["head"],
+    }
+
+
+def ppv_merge_params(ppv_params: dict) -> dict:
+    stages = ppv_params["stages"]
+    return pp_merge_params({
+        "embed": ppv_params["embed"],
+        "stages": jax.tree_util.tree_map(
+            lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:]),
+            stages),
+        "head": ppv_params["head"],
+    })
+
+
+def ppv_param_specs(ppv_params: dict, axis_name: str = "pp") -> dict:
+    """Specs for the interleaved layout: stage leaves shard dim 1 (the
+    device dim) over ``axis_name``; dim 0 (the chunk dim) is device-local
+    and stays unsharded; embed/head replicate."""
+    return {
+        "embed": P(),
+        "stages": jax.tree_util.tree_map(lambda _a: P(None, axis_name),
+                                         ppv_params["stages"]),
+        "head": jax.tree_util.tree_map(lambda _a: P(), ppv_params["head"]),
+    }
+
+
+def shard_ppv_params(ppv_params: dict, mesh, axis_name: str = "pp") -> dict:
+    from ..parallel.fsdp import shard_tree
+
+    return shard_tree(ppv_params, mesh, ppv_param_specs(ppv_params, axis_name))
+
+
 def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
-                        n_micro: int, attn_fn: Optional[Callable] = None):
+                        n_micro: int, attn_fn: Optional[Callable] = None,
+                        n_chunks: int = 1):
     """Build ``step(pp_params, batch) -> (loss, grads)``, jit-compiled.
 
     ``batch``: [B, S+1] token ids, B divisible by ``n_micro``.  ``grads``
     has the pipeline layout of ``pp_params`` — feed it straight to optax.
     Dense models only (MoE routing needs the global token view; use the
     ep/GSPMD path for expert models).
+
+    ``n_chunks > 1``: the INTERLEAVED 1F1B schedule
+    (parallel/interleaved.py) with that many virtual chunks per device;
+    ``pp_params`` must then be in ``ppv_split_params`` layout
+    (stages ``[V, S, L/(V*S), ...]``).  Worth it when stages are many and
+    microbatches few — see interleaved.py's fill-cost accounting.
     """
     n_stages = mesh.shape[axis_name]
-    if cfg.n_layers % n_stages:
+    if cfg.n_layers % (n_stages * n_chunks):
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
-                         f"{n_stages} pipeline stages")
+                         f"{n_stages} stages x {n_chunks} chunks")
     if cfg.n_experts > 0:
         raise NotImplementedError("pp_llama supports dense models only")
     attn = resolve_attn_fn(cfg, attn_fn)
 
-    def stage_fn(stage_lp, h):
-        # Inside shard_map the stage tree keeps a leading local dim of 1
-        # ([1, L/S, ...]); peel it so the scan runs over this stage's L/S
-        # layers (vjp through the indexing restores the dim on gradients).
-        local = jax.tree_util.tree_map(lambda a: a[0], stage_lp)
+    def run_layers(local, h):
+        """Scan ``h`` through a [L_local, ...] slice of the layer tree."""
         cos, sin = rope_tables(h.shape[1], cfg.head_dim, cfg.rope_theta)
 
         def body(hh, lp):
@@ -116,13 +165,31 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
         h, _ = lax.scan(body, h, local)
         return h
 
+    def stage_fn(stage_lp, h):
+        # Inside shard_map the stage tree keeps a leading local dim of 1
+        # ([1, L/S, ...]); peel it so the scan runs over this stage's L/S
+        # layers (vjp through the indexing restores the dim on gradients).
+        return run_layers(jax.tree_util.tree_map(lambda a: a[0], stage_lp), h)
+
+    def chunk_fn(chunk_lp, h):
+        # Interleaved path: the schedule's chunk_params already peeled the
+        # leading dims -- chunk_lp leaves are [L/(V*S), ...].
+        return run_layers(chunk_lp, h)
+
     def loss_fn(head, y, target):
         logits = head_logits(y, head["final_norm"], head["lm_head"],
                              cfg.norm_eps)
         return token_ce(logits, target)
 
-    grad_step = make_pipeline_train(mesh, stage_fn, loss_fn, axis_name,
-                                    with_head=True, return_dx=True)
+    if n_chunks > 1:
+        from ..parallel.interleaved import make_interleaved_pipeline_train
+
+        grad_step = make_interleaved_pipeline_train(
+            mesh, chunk_fn, loss_fn, axis_name, n_chunks=n_chunks,
+            n_micro=n_micro, with_head=True, return_dx=True)
+    else:
+        grad_step = make_pipeline_train(mesh, stage_fn, loss_fn, axis_name,
+                                        with_head=True, return_dx=True)
 
     def step(pp_params, batch):
         tokens, targets = batch[:, :-1], batch[:, 1:]

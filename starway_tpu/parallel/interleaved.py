@@ -240,13 +240,18 @@ def build_interleaved_schedule(m: int, s: int, v: int) -> InterleavedSchedule:
 
 def interleaved_train_apply(stage_fn: Callable, loss_fn: Callable,
                             stage_params, inputs, targets, axis_name: str,
-                            sched: InterleavedSchedule):
+                            sched: InterleavedSchedule, head_params=None,
+                            return_dx: bool = False):
     """Per-device body (call inside shard_map).
 
     ``stage_params``: this device's chunks, leading dim V (chunk c =
     virtual stage ``c*S + d``).  ``inputs [M, mb, ...]`` / ``targets
-    [M, ...]`` replicated.  Returns ``(loss, dparams [V, ...])`` laid out
-    like the params.
+    [M, ...]`` replicated.  Returns ``(loss, dparams [V, ...][, dhead]
+    [, dinputs])`` laid out like the params — the same contract as
+    ``pipeline_train_apply``: ``head_params`` makes the final slot's loss
+    ``loss_fn(head_params, y, target)`` (head gradient psum-replicated);
+    ``return_dx`` emits ``[1, M, mb, ...]`` input cotangents valid on
+    device 0's shard only (chunk-0 backwards).
     """
     s = sched.n_devices
     v = sched.n_chunks
@@ -273,7 +278,7 @@ def interleaved_train_apply(stage_fn: Callable, loss_fn: Callable,
             lambda a: jnp.zeros(a.shape, jnp.float32), tree)
 
     def tick(carry, trow):
-        fwd_in, bwd_in, stash, inbox, dparams, loss_acc = carry
+        fwd_in, bwd_in, stash, inbox, dparams, dhead, dx_buf, loss_acc = carry
         fc = pick(trow["f_chunk"])
         fi = pick(trow["f_micro"])
         fsl = pick(trow["f_stash"])
@@ -321,31 +326,54 @@ def interleaved_train_apply(stage_fn: Callable, loss_fn: Callable,
         p_c = chunk_params(bc_c)
 
         def final_branch(_):
-            def h(p, x):
-                return loss_fn(stage_fn(p, x), target)
+            if head_params is None:
+                def h(p, x):
+                    return loss_fn(stage_fn(p, x), target)
 
-            loss_j, (dp, dx) = jax.value_and_grad(h, argnums=(0, 1))(
-                p_c, x_saved)
-            return (f32_tree(dp), dx.astype(jnp.float32),
+                loss_j, (dp, dx) = jax.value_and_grad(h, argnums=(0, 1))(
+                    p_c, x_saved)
+                dh = dhead  # zeros-shaped placeholder, unused
+            else:
+                def h(p, x, hp):
+                    return loss_fn(hp, stage_fn(p, x), target)
+
+                loss_j, (dp, dx, dh) = jax.value_and_grad(
+                    h, argnums=(0, 1, 2))(p_c, x_saved, head_params)
+                dh = f32_tree(dh)
+            return (f32_tree(dp), dx.astype(jnp.float32), dh,
                     jnp.asarray(loss_j, jnp.float32))
 
         def mid_branch(_):
             _, vjp_fn = jax.vjp(lambda p, x: stage_fn(p, x), p_c, x_saved)
             dp, dx = vjp_fn(ct_in.astype(y.dtype))
-            return (f32_tree(dp), dx.astype(jnp.float32), jnp.float32(0))
+            return (f32_tree(dp), dx.astype(jnp.float32),
+                    f32_zeros_like(head_params), jnp.float32(0))
 
         def f32_tree(tree):
             return jax.tree_util.tree_map(
                 lambda a: a.astype(jnp.float32), tree)
 
-        dp, dx, loss_j = lax.cond(bfin, final_branch, mid_branch, None)
+        dp, dx, dh, loss_j = lax.cond(bfin, final_branch, mid_branch, None)
         mask = b_valid.astype(jnp.float32)
         dparams = jax.tree_util.tree_map(
             lambda acc, g: acc.at[bc_c].add(mask * g), dparams, dp)
+        if head_params is not None:
+            dhead = jax.tree_util.tree_map(
+                lambda acc, g: acc + mask * g, dhead, dh)
         loss_acc = loss_acc + mask * loss_j
+        if return_dx:
+            # Chunk-0 backwards on device 0 ARE d(inputs); everything else
+            # (other chunks, other devices, invalid slots) lands in the
+            # trash row m — interleaving means real writes and dead slots
+            # interleave in time, so masking by slot index (not a
+            # write-zeros policy) is what keeps earlier real values intact.
+            is_dx = (d_idx == 0) & (bc == 0) & b_valid
+            dx_buf = lax.dynamic_update_index_in_dim(
+                dx_buf, dx * mask, jnp.where(is_dx, bj_c, m), axis=0)
         bwd_out = lax.ppermute(dx * mask, axis_name, bwd_perm)
 
-        return (fwd_out, bwd_out, stash, inbox, dparams, loss_acc), None
+        return (fwd_out, bwd_out, stash, inbox, dparams, dhead, dx_buf,
+                loss_acc), None
 
     init = (
         jnp.zeros(mb_shape, inputs.dtype),
@@ -353,41 +381,82 @@ def interleaved_train_apply(stage_fn: Callable, loss_fn: Callable,
         jnp.zeros((sched.stash_depth + 1,) + mb_shape, inputs.dtype),
         jnp.zeros((v, sched.inbox_depth) + mb_shape, jnp.float32),
         f32_zeros_like(stage_params),
+        f32_zeros_like(head_params),
+        jnp.zeros((m + 1,) + mb_shape, jnp.float32) if return_dx
+        else jnp.zeros((), jnp.float32),
         jnp.float32(0),
     )
-    (_, _, _, _, dparams, loss_acc), _ = lax.scan(tick, init, tabs)
+    (_, _, _, _, dparams, dhead, dx_buf, loss_acc), _ = lax.scan(
+        tick, init, tabs)
     loss = lax.psum(loss_acc, axis_name) / m
     dparams = jax.tree_util.tree_map(lambda g: g / m, dparams)
-    return loss, dparams
+    out = (loss, dparams)
+    if head_params is not None:
+        dhead = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, axis_name) / m, dhead)
+        out += (dhead,)
+    if return_dx:
+        out += (dx_buf[None, :m] / m,)  # [1, M, mb, ...]: this device's shard
+    return out
 
 
 def make_interleaved_pipeline_train(mesh, stage_fn: Callable,
                                     loss_fn: Callable,
                                     axis_name: str = "pp", *,
-                                    n_chunks: int, n_micro: int):
+                                    n_chunks: int, n_micro: int,
+                                    with_head: bool = False,
+                                    return_dx: bool = False):
     """Jitted global-view interleaved-1F1B training step builder.
 
     ``stage_params`` global view: ``[V, S, ...]`` — ``stage_params[c, d]``
     is virtual stage ``c*S + d`` (device d's chunk c); dim 1 shards over
-    ``axis_name``.  Returns ``step(stage_params, inputs, targets) ->
-    (loss, grads)`` with grads laid out like the params.  ``n_micro`` is
-    static (the slot tables are built for it); inputs [M, mb, ...].
+    ``axis_name``.  Returns ``step(stage_params[, head_params], inputs,
+    targets) -> (loss, grads[, dhead][, dinputs])`` with grads laid out
+    like the params — ``with_head``/``return_dx`` follow
+    :func:`~starway_tpu.parallel.pipeline.make_pipeline_train`'s contract
+    (dinputs is emitted from device 0's shard).  ``n_micro`` is static
+    (the slot tables are built for it); inputs [M, mb, ...].
     """
     s = mesh.shape[axis_name]
     sched = build_interleaved_schedule(n_micro, s, n_chunks)
 
-    def local(stage_params, inputs, targets):
+    def peel(tree):
         # shard_map leaves a size-1 device dim at axis 1: [V, 1, ...] ->
         # [V, ...]
-        sp = jax.tree_util.tree_map(lambda a: a[:, 0], stage_params)
-        loss, dp = interleaved_train_apply(
-            stage_fn, loss_fn, sp, inputs, targets, axis_name, sched)
-        dp = jax.tree_util.tree_map(lambda a: a[:, None], dp)
-        return loss, dp
+        return jax.tree_util.tree_map(lambda a: a[:, 0], tree)
 
-    staged = shard_map_fn(
-        mesh, local,
-        in_specs=(P(None, axis_name), P(), P()),
-        out_specs=(P(), P(None, axis_name)),
-    )
-    return jax.jit(staged)
+    def unpeel(tree):
+        return jax.tree_util.tree_map(lambda a: a[:, None], tree)
+
+    if with_head:
+        def local(stage_params, head_params, inputs, targets):
+            out = interleaved_train_apply(
+                stage_fn, loss_fn, peel(stage_params), inputs, targets,
+                axis_name, sched, head_params=head_params,
+                return_dx=return_dx)
+            return (out[0], unpeel(out[1])) + out[2:]
+
+        in_specs = (P(None, axis_name), P(), P(), P())
+        out_specs = (P(), P(None, axis_name), P()) + (
+            (P(axis_name),) if return_dx else ())
+    else:
+        def local(stage_params, inputs, targets):
+            out = interleaved_train_apply(
+                stage_fn, loss_fn, peel(stage_params), inputs, targets,
+                axis_name, sched, return_dx=return_dx)
+            return (out[0], unpeel(out[1])) + out[2:]
+
+        in_specs = (P(None, axis_name), P(), P())
+        out_specs = (P(), P(None, axis_name)) + (
+            (P(axis_name),) if return_dx else ())
+
+    staged = shard_map_fn(mesh, local, in_specs=in_specs,
+                          out_specs=out_specs)
+    if not return_dx:
+        return jax.jit(staged)
+
+    def run(*args):
+        out = staged(*args)
+        return out[:-1] + (out[-1][0],)  # dinputs lives on device 0's shard
+
+    return jax.jit(run)
