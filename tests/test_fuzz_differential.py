@@ -44,7 +44,7 @@ def port():
 
 
 @pytest.fixture(params=["inproc", "tcp", "sm", "native", "native-sm",
-                        "devpull"])
+                        "devpull", "devpull-native"])
 def transport(request, monkeypatch):
     if request.param == "tcp":
         monkeypatch.setenv("STARWAY_TLS", "tcp")
@@ -64,11 +64,21 @@ def transport(request, monkeypatch):
         monkeypatch.setenv(
             "STARWAY_TLS", "tcp" if request.param == "native" else "tcp,sm")
         monkeypatch.setenv("STARWAY_NATIVE", "1")
-    elif request.param == "devpull":
+    elif request.param in ("devpull", "devpull-native"):
         import jax
 
+        if request.param == "devpull-native":
+            from starway_tpu.core import native
+
+            if not native.available():
+                pytest.skip("native engine unavailable (no toolchain)")
         monkeypatch.setenv("STARWAY_TLS", "tcp")
-        monkeypatch.setenv("STARWAY_NATIVE", "0")
+        # devpull-native: the C++ engine owns the wire and the matcher
+        # (descriptor records share its FIFO unexpected stream); its Python
+        # wrapper owns the pulls — the fuzz now covers that split too.
+        monkeypatch.setenv(
+            "STARWAY_NATIVE",
+            "1" if request.param == "devpull-native" else "0")
         # Pin the pull threshold below most SIZES: with the default
         # (64 KiB == MAX_SIZE) only the single largest size would ride the
         # pull path, and a future default bump would silently turn this
@@ -172,7 +182,7 @@ async def test_fuzz_matches_oracle(seed, port, transport):
     # Device plane: a seed-determined mix of device/host payloads and sinks
     # on the same connection (drawn from a separate stream so the schedule
     # and oracle are identical to the other planes' for the same seed).
-    use_device = transport == "devpull"
+    use_device = transport.startswith("devpull")
     dev_rng = random.Random(seed + 0xDE)
     if use_device:
         import jax
