@@ -31,9 +31,19 @@ class Trainer:
                  attn_fn: Optional[Callable] = None,
                  donate: bool = True,
                  dp_port=None, dp_base_tag: int = 0x6000,
-                 mesh=None, fsdp_axis: Optional[str] = None):
+                 mesh=None, fsdp_axis: Optional[str] = None,
+                 moe_fn: Optional[Callable] = None,
+                 with_moe_stats: bool = False):
         """``dp_port``: a ClientPort/ServerPort to a peer rank; when set,
         gradients are averaged with the peer every step before the update.
+
+        ``moe_fn``: MoE dispatch override for expert models (e.g.
+        :func:`~starway_tpu.models.moe.make_sharded_moe`'s result).
+        ``with_moe_stats`` (needs a ``with_stats=True`` moe_fn): every step
+        stashes the layer-stacked router-health dict (drop fraction,
+        per-expert load) on ``self.last_moe_stats`` — the training loop
+        watches a collapsing router without changing ``step_sync``'s
+        return type.
 
         ``dp_base_tag``: start of the tag range the exchange occupies.  The
         rolling window spans ``[dp_base_tag, dp_base_tag + 1024*256)`` —
@@ -52,7 +62,18 @@ class Trainer:
         self.timer = OpTimer()
         self.dp_port = dp_port
         self.dp_base_tag = dp_base_tag
+        self.with_moe_stats = with_moe_stats
+        self.last_moe_stats = None
         self._fsdp_step = None
+        if with_moe_stats and mesh is not None:
+            raise NotImplementedError(
+                "with_moe_stats is not wired through the fused fsdp step; "
+                "use the plain step or make_train_step(with_moe_stats=True)")
+        if with_moe_stats and (moe_fn is None or cfg.n_experts == 0):
+            # Fail at construction, not at the first step inside tracing.
+            raise ValueError(
+                "with_moe_stats needs an expert config and a stats-producing"
+                " moe_fn (make_sharded_moe(..., with_stats=True))")
         if (mesh is None) != (fsdp_axis is None):
             raise ValueError("pass mesh and fsdp_axis together")
         if mesh is not None:
@@ -67,8 +88,8 @@ class Trainer:
             self.state.params = shard_tree(self.state.params, mesh, pspecs)
             self.state.opt_state = shard_tree(self.state.opt_state, mesh, ospecs)
             self._fsdp_step = make_fsdp_train_step(
-                make_train_step(cfg, tx, attn_fn), mesh, pspecs, ospecs,
-                axis=fsdp_axis, donate=donate)
+                make_train_step(cfg, tx, attn_fn, moe_fn), mesh, pspecs,
+                ospecs, axis=fsdp_axis, donate=donate)
         if dp_port is not None:
             # step_dp gives each step a 256-tag window (base advances by 256
             # per step); more leaves than that would collide across steps.
@@ -80,8 +101,8 @@ class Trainer:
                     f"the tag window)"
                 )
         self._grad_fn = jax.jit(
-            lambda p, b: jax.value_and_grad(loss_fn)(p, b, cfg, attn_fn)
-        )
+            lambda p, b: jax.value_and_grad(loss_fn, has_aux=with_moe_stats)(
+                p, b, cfg, attn_fn, moe_fn, with_moe_stats=with_moe_stats))
         self._apply_fn = jax.jit(
             lambda p, o, g: apply_updates(tx, p, o, g),
             donate_argnums=(0, 1) if donate else (),
@@ -96,13 +117,22 @@ class Trainer:
             self.state.step += 1
             return float(loss)
         with self.timer.span("grad"):
-            loss, grads = self._grad_fn(self.state.params, batch)
+            loss, grads = self._unpack_grad(
+                self._grad_fn(self.state.params, batch))
         with self.timer.span("apply"):
             self.state.params, self.state.opt_state = self._apply_fn(
                 self.state.params, self.state.opt_state, grads
             )
         self.state.step += 1
         return float(loss)
+
+    def _unpack_grad(self, out):
+        """(loss[, stats]), grads -> (loss, grads); stats stashed."""
+        val, grads = out
+        if self.with_moe_stats:
+            loss, self.last_moe_stats = val
+            return loss, grads
+        return val, grads
 
     async def step_dp(self, batch) -> float:
         """One step with averaged gradient exchange across the DP port."""
@@ -111,7 +141,8 @@ class Trainer:
         from ..parallel.dp_exchange import recv_pytree, send_pytree
 
         with self.timer.span("grad"):
-            loss, grads = self._grad_fn(self.state.params, batch)
+            loss, grads = self._unpack_grad(
+                self._grad_fn(self.state.params, batch))
         with self.timer.span("dp_exchange"):
             base = self.dp_base_tag + (self.state.step % 1024) * 256
             send_task = asyncio.ensure_future(

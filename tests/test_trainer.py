@@ -39,6 +39,38 @@ def test_trainer_local_steps_and_ckpt(tmp_path):
     np.testing.assert_array_equal(np.asarray(a, np.float32), np.asarray(b, np.float32))
 
 
+def test_trainer_moe_stats():
+    """Trainer(with_moe_stats=True) stashes router health per step without
+    changing step_sync's float return; fsdp mode refuses the combination
+    loudly."""
+    from starway_tpu.models.moe import make_sharded_moe
+    from starway_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"dp": 2, "ep": 4, "tp": 1})
+    cfg = LlamaConfig.preset("debug", n_experts=4, moe_top_k=2)
+    moe_fn = make_sharded_moe(mesh, k=2, with_stats=True)
+    t = Trainer(cfg, optax.adamw(3e-3),
+                init_params(jax.random.PRNGKey(0), cfg), donate=False,
+                moe_fn=moe_fn, with_moe_stats=True)
+    assert t.last_moe_stats is None
+    loss = t.step_sync(_batch(cfg))
+    assert np.isfinite(loss)
+    stats = t.last_moe_stats
+    assert stats["drop_fraction"].shape == (cfg.n_layers,)
+    assert stats["expert_load"].shape == (cfg.n_layers, 4)
+
+    with pytest.raises(NotImplementedError, match="fsdp"):
+        Trainer(cfg, optax.adamw(3e-3),
+                init_params(jax.random.PRNGKey(0), cfg),
+                mesh=make_mesh({"fsdp": 2}), fsdp_axis="fsdp",
+                with_moe_stats=True)
+    # Misconfiguration fails at construction, not at the first traced step.
+    with pytest.raises(ValueError, match="stats-producing"):
+        Trainer(cfg, optax.adamw(3e-3),
+                init_params(jax.random.PRNGKey(0), cfg),
+                with_moe_stats=True)
+
+
 def test_trainer_fsdp_mode_matches_local():
     from starway_tpu.parallel import make_mesh
 
