@@ -20,8 +20,12 @@ from .pp_llama import (
     pp_merge_params,
     pp_param_specs,
     pp_split_params,
+    ppv_merge_params,
+    ppv_split_params,
     shard_pp_params,
+    shard_ppv_params,
 )
+from .serving import SlotServer
 
 __all__ = [
     "LlamaConfig",
@@ -37,4 +41,8 @@ __all__ = [
     "pp_merge_params",
     "pp_param_specs",
     "shard_pp_params",
+    "ppv_split_params",
+    "ppv_merge_params",
+    "shard_ppv_params",
+    "SlotServer",
 ]

@@ -1,0 +1,281 @@
+"""Continuous-batching serving: admit requests into a RUNNING batch.
+
+``generate()`` (models/generate.py) serves one static batch per dispatch —
+every row starts together and the dispatch lasts the full generation.  A
+real serving workload is a stream: requests arrive at any time, finish at
+different lengths, and a finished row's slot should start the next request
+immediately instead of idling until the batch drains (the continuous-
+batching idea of Orca/vLLM, built TPU-first here).
+
+Design for XLA's compilation model — everything the device runs is one of
+a FIXED, small set of compiled programs:
+
+* **Slots, not batches.**  The KV cache is ``[L, n_slots, Hkv, max_len,
+  Dh]``; every per-slot cursor (position, liveness, token budget) is a
+  ``[n_slots]`` vector.  Shapes never depend on which requests are in
+  flight.
+* **Admission = bucketed prefill.**  A new request's prompt is right-padded
+  to a power-of-two bucket and prefilled in its own dispatch (one compile
+  per bucket), then its kv rows are written into the slot with a dynamic
+  slice.  Pad/garbage columns are never read: attention masks by the
+  slot's cursor, and decode overwrites each position before the cursor
+  reaches it (write-then-attend).
+* **Decode runs in chunks.**  One compiled ``lax.scan`` advances ALL live
+  slots ``chunk`` tokens (dead slots are masked: frozen cursor, writes
+  land on a position that admission or the advancing cursor overwrites
+  before any read).  Per-token host round-trips — fatal on a tunneled
+  device — happen once per chunk, not once per token.
+* **Greedy continuous batching is BIT-IDENTICAL to standalone
+  ``generate()``** for every request, whatever the interleaving: same
+  prefill, same decode step, same masking — pinned by
+  tests/test_serving.py against the one-request oracle.
+
+Dense models only (MoE expert capacity is shared batch-wide, so slot
+cohabitation would perturb routing — same restriction as ragged
+``generate()``).
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .generate import _sample, decode_step, prefill, rope_tables
+from .llama import LlamaConfig
+
+
+def _bucket(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds the largest bucket "
+                     f"{buckets[-1]}")
+
+
+@functools.cache
+def _compiled_admit(cfg: LlamaConfig, p_bucket: int, temperature: float,
+                    top_k: Optional[int], top_p: Optional[float]):
+    """Prefill one request into one slot: returns the updated cache and the
+    request's FIRST generated token.  One compile per prompt bucket."""
+
+    def run(params, cache, prompt, length, slot, key):
+        # prompt [1, p_bucket] right-padded; ragged single-row prefill.
+        logits, small = prefill(params, cfg, prompt, p_bucket,
+                                logit_positions=length[None] - 1)
+        # Write the bucket's kv rows into the slot: [L, 1, Hkv, P, D] ->
+        # cache[:, slot, :, :P].  Columns >= length hold pad-garbage that
+        # is overwritten (position by position) before the cursor lets
+        # attention read it.
+        cache = {
+            "k": lax.dynamic_update_slice(
+                cache["k"], small["k"], (0, slot, 0, 0, 0)),
+            "v": lax.dynamic_update_slice(
+                cache["v"], small["v"], (0, slot, 0, 0, 0)),
+        }
+        tok = _sample(logits, key, temperature, top_k, top_p)[0]
+        return cache, tok
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+@functools.cache
+def _compiled_chunk(cfg: LlamaConfig, n_slots: int, max_len: int, chunk: int,
+                    temperature: float, top_k: Optional[int],
+                    top_p: Optional[float], eos_id: Optional[int]):
+    """Advance every live slot ``chunk`` tokens in ONE dispatch.
+
+    Per step: the pending token (at its slot's cursor) runs
+    ``decode_step`` with per-row positions, the next token is sampled,
+    budgets/eos update liveness.  Emits ``(tokens [chunk, B], mask
+    [chunk, B])`` — mask marks which emissions are real (slot was live
+    when its PENDING token was consumed, i.e. the sampled token continues
+    a real request).
+    """
+    rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
+
+    def run(params, cache, token, pos, live, remaining, key):
+        def step(carry, _):
+            cache, token, pos, live, remaining, key = carry
+            logits, cache = decode_step(params, cache, token, pos, cfg, rope)
+            key, sub = jax.random.split(key)
+            nxt = _sample(logits, sub, temperature, top_k, top_p)
+            emit_live = live & (remaining > 0)
+            if eos_id is not None:
+                newly_done = emit_live & (nxt == eos_id)
+            else:
+                newly_done = jnp.zeros_like(emit_live)
+            remaining = remaining - emit_live.astype(jnp.int32)
+            live = emit_live & ~newly_done & (remaining > 0) & (
+                pos + 2 < max_len)
+            # Dead slots freeze: cursor stays, pending token irrelevant
+            # (their cache writes land on a position admission or the
+            # cursor overwrites before any read).
+            pos = pos + emit_live.astype(jnp.int32)
+            token = jnp.where(emit_live, nxt, token)
+            return (cache, token, pos, live, remaining, key), (nxt, emit_live)
+
+        (cache, token, pos, live, remaining, key), (toks, mask) = lax.scan(
+            step, (cache, token, pos, live, remaining, key), None,
+            length=chunk)
+        return cache, token, pos, live, remaining, key, toks, mask
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+class SlotServer:
+    """Continuous-batching front end over the compiled admit/decode programs.
+
+    >>> srv = SlotServer(params, cfg, n_slots=4, max_len=512)
+    >>> rid = srv.submit([1, 2, 3], max_new_tokens=32)
+    >>> done = srv.run()          # {rid: np.ndarray of generated tokens}
+
+    ``submit`` queues; ``step()`` admits pending requests into free slots
+    and advances one decode chunk, returning newly finished requests;
+    ``run()`` loops until everything queued has finished.  Generated
+    tokens INCLUDE the terminating eos (when ``eos_id`` fires).
+    """
+
+    def __init__(self, params, cfg: LlamaConfig, *, n_slots: int = 4,
+                 max_len: int = 512, chunk: int = 8,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, eos_id: Optional[int] = None,
+                 prompt_buckets=None, seed: int = 0):
+        if cfg.n_experts > 0:
+            raise ValueError(
+                "continuous batching is dense-only: MoE expert capacity is "
+                "shared batch-wide, so cohabiting slots would perturb each "
+                "other's routing (same restriction as ragged generate())")
+        if cfg.sliding_window is not None:
+            raise NotImplementedError(
+                "SlotServer serves full-cache models; rolling-window serving "
+                "uses generate()'s aligned path")
+        if n_slots < 1 or chunk < 1:
+            # Zero slots/chunk would make run() spin forever, not error.
+            raise ValueError(f"need n_slots >= 1 and chunk >= 1, got "
+                             f"{n_slots}/{chunk}")
+        self.params = params
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.chunk = chunk
+        self.sampling = (float(temperature), top_k, top_p)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        if prompt_buckets is None:
+            b, buckets = 32, []
+            while b < max_len:
+                buckets.append(b)
+                b *= 2
+            # Always cover the full cache: a prompt up to max_len - 1 must
+            # have a bucket, or submit-accepted requests would die at
+            # admission time.
+            buckets.append(max_len)
+            prompt_buckets = tuple(buckets)
+        self.buckets = tuple(sorted(set(prompt_buckets)))
+        if self.buckets[-1] > max_len:
+            raise ValueError(f"bucket {self.buckets[-1]} exceeds "
+                             f"max_len={max_len}")
+        self.key = jax.random.PRNGKey(seed)
+
+        L, hkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        shape = (L, n_slots, hkv, max_len, hd)
+        self.cache = {"k": jnp.zeros(shape, cfg.compute_dtype),
+                      "v": jnp.zeros(shape, cfg.compute_dtype)}
+        self.token = jnp.zeros((n_slots,), jnp.int32)
+        self.pos = jnp.zeros((n_slots,), jnp.int32)
+        self.live = jnp.zeros((n_slots,), bool)
+        self.remaining = jnp.zeros((n_slots,), jnp.int32)
+
+        self._next_rid = 0
+        self._pending: deque = deque()
+        self._slot_rid: dict[int, int] = {}
+        self._collected: dict[int, list] = {}
+
+    # ------------------------------------------------------------ intake
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        """Queue one request; returns its id (resolved by step()/run())."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new ({max_new_tokens}) "
+                f"exceeds max_len={self.max_len}")
+        _bucket(len(prompt), self.buckets)  # reject un-bucketable NOW, not
+        # at admission time after the request has left the queue
+        rid = self._next_rid
+        self._next_rid += 1
+        self._pending.append((rid, prompt, int(max_new_tokens)))
+        return rid
+
+    # ------------------------------------------------------------- engine
+    def _admit(self, slot: int, rid: int, prompt: np.ndarray,
+               max_new: int) -> None:
+        pb = _bucket(len(prompt), self.buckets)
+        padded = np.zeros((1, pb), np.int32)
+        padded[0, :len(prompt)] = prompt
+        self.key, sub = jax.random.split(self.key)
+        admit = _compiled_admit(self.cfg, pb, *self.sampling)
+        self.cache, tok = admit(
+            self.params, self.cache, jnp.asarray(padded),
+            jnp.asarray(len(prompt), jnp.int32),
+            jnp.asarray(slot, jnp.int32), sub)
+        tok_host = int(tok)
+        self._slot_rid[slot] = rid
+        self._collected[rid] = [tok_host]
+        done = (max_new == 1 or
+                (self.eos_id is not None and tok_host == self.eos_id))
+        self.token = self.token.at[slot].set(tok_host)
+        self.pos = self.pos.at[slot].set(len(prompt))
+        self.live = self.live.at[slot].set(not done)
+        self.remaining = self.remaining.at[slot].set(max_new - 1)
+
+    def _harvest_dead(self, finished: dict) -> None:
+        live = np.asarray(self.live)
+        for slot, rid in list(self._slot_rid.items()):
+            if not live[slot]:
+                finished[rid] = np.asarray(self._collected.pop(rid),
+                                           np.int32)
+                del self._slot_rid[slot]
+
+    def step(self) -> dict:
+        """Admit what fits, decode one chunk; returns {rid: tokens} for
+        requests that finished during this step."""
+        finished: dict = {}
+        self._harvest_dead(finished)  # 1-token/instant-eos admissions
+        free = [s for s in range(self.n_slots) if s not in self._slot_rid]
+        while free and self._pending:
+            rid, prompt, max_new = self._pending.popleft()
+            self._admit(free.pop(0), rid, prompt, max_new)
+        self._harvest_dead(finished)
+        if not self._slot_rid:
+            return finished
+
+        run = _compiled_chunk(self.cfg, self.n_slots, self.max_len,
+                              self.chunk, *self.sampling, self.eos_id)
+        self.key, sub = jax.random.split(self.key)
+        (self.cache, self.token, self.pos, self.live, self.remaining,
+         _key, toks, mask) = run(self.params, self.cache, self.token,
+                                 self.pos, self.live, self.remaining, sub)
+        toks = np.asarray(toks)
+        mask = np.asarray(mask)
+        for slot, rid in self._slot_rid.items():
+            self._collected[rid].extend(
+                int(t) for t, m in zip(toks[:, slot], mask[:, slot]) if m)
+        self._harvest_dead(finished)
+        return finished
+
+    def run(self) -> dict:
+        """Drive step() until every submitted request has finished."""
+        finished: dict = {}
+        while self._pending or self._slot_rid:
+            finished.update(self.step())
+        return finished
