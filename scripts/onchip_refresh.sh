@@ -49,6 +49,7 @@ run serve             # end-to-end generate() tokens/s (VERDICT r3 #4) ...
 run serve_b8          # ... batch 8
 run serve_ragged_b8   # ... ragged (mixed prompt lengths)
 run serve_mistral     # ... rolling O(window) cache path
+run serve_continuous  # continuous batching: wall tok/s through slot reuse
 echo "== check" >&2
 timeout 1200 python bench.py --kernels check 2>/dev/null | grep '"metric"' | tee -a "$OUT"
 echo "rows written to $OUT" >&2

@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .generate import _sample, decode_step, prefill, rope_tables
+from .generate import _sample, decode_step, init_cache, prefill, rope_tables
 from .llama import LlamaConfig
 
 
@@ -183,10 +183,7 @@ class SlotServer:
                              f"max_len={max_len}")
         self.key = jax.random.PRNGKey(seed)
 
-        L, hkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-        shape = (L, n_slots, hkv, max_len, hd)
-        self.cache = {"k": jnp.zeros(shape, cfg.compute_dtype),
-                      "v": jnp.zeros(shape, cfg.compute_dtype)}
+        self.cache = init_cache(cfg, n_slots, max_len)
         self.token = jnp.zeros((n_slots,), jnp.int32)
         self.pos = jnp.zeros((n_slots,), jnp.int32)
         self.live = jnp.zeros((n_slots,), bool)

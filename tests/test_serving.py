@@ -11,8 +11,6 @@ import jax.numpy as jnp
 from starway_tpu.models import LlamaConfig, SlotServer, init_params
 from starway_tpu.models.generate import generate
 
-pytestmark = pytest.mark.asyncio
-
 
 @pytest.fixture(scope="module")
 def cfg():
