@@ -188,6 +188,13 @@ async def test_fuzz_matches_oracle(seed, port, transport):
         import jax
         import jax.numpy as jnp
 
+    # Mid-schedule flushes (separate stream, oracle untouched): a flush is
+    # a delivery barrier, NOT a matching event — injecting them at random
+    # points must leave every pairing identical.  Exercises the barrier
+    # machinery (incl. devpull force-starts) against half-built state on
+    # every plane.
+    flush_rng = random.Random(seed + 0xF1)
+
     futs = {}
     bufs = {}
     try:
@@ -215,6 +222,11 @@ async def test_fuzz_matches_oracle(seed, port, transport):
                 futs[ri] = (server.arecv(buf, tag, mask) if d == "c2s"
                             else client.arecv(buf, tag, mask))
                 ri += 1
+            r = flush_rng.random()
+            if r < 0.10:
+                await client.aflush()
+            elif r < 0.20:
+                await server.aflush()
 
         await client.aflush()
         await server.aflush()
