@@ -405,20 +405,27 @@ def make_interleaved_pipeline_train(mesh, stage_fn: Callable,
                                     axis_name: str = "pp", *,
                                     n_chunks: int, n_micro: int,
                                     with_head: bool = False,
-                                    return_dx: bool = False):
+                                    return_dx: bool = False,
+                                    dp_axis: str | None = None):
     """Jitted global-view interleaved-1F1B training step builder.
 
     ``stage_params`` global view: ``[V, S, ...]`` — ``stage_params[c, d]``
     is virtual stage ``c*S + d`` (device d's chunk c); dim 1 shards over
     ``axis_name``.  Returns ``step(stage_params[, head_params], inputs,
     targets) -> (loss, grads[, dhead][, dinputs])`` with grads laid out
-    like the params — ``with_head``/``return_dx`` follow
+    like the params — ``with_head``/``return_dx``/``dp_axis`` follow
     :func:`~starway_tpu.parallel.pipeline.make_pipeline_train`'s contract
-    (dinputs is emitted from device 0's shard).  ``n_micro`` is static
+    (dinputs from device 0's shard; under dp, the within-microbatch batch
+    dim of inputs/targets shards over ``dp_axis``, loss/grads ride one dp
+    pmean, and dinputs carry the 1/ndp factor).  ``n_micro`` is static
     (the slot tables are built for it); inputs [M, mb, ...].
     """
+    from .pipeline import dp_compose
+
     s = mesh.shape[axis_name]
     sched = build_interleaved_schedule(n_micro, s, n_chunks)
+    data_spec, dx_spec, dp_reduce = dp_compose(
+        mesh, dp_axis, axis_name, with_head=with_head, return_dx=return_dx)
 
     def peel(tree):
         # shard_map leaves a size-1 device dim at axis 1: [V, 1, ...] ->
@@ -434,21 +441,23 @@ def make_interleaved_pipeline_train(mesh, stage_fn: Callable,
                 stage_fn, loss_fn, peel(stage_params), inputs, targets,
                 axis_name, sched, head_params=head_params,
                 return_dx=return_dx)
+            out = dp_reduce(out)
             return (out[0], unpeel(out[1])) + out[2:]
 
-        in_specs = (P(None, axis_name), P(), P(), P())
+        in_specs = (P(None, axis_name), P(), data_spec, data_spec)
         out_specs = (P(), P(None, axis_name), P()) + (
-            (P(axis_name),) if return_dx else ())
+            (dx_spec,) if return_dx else ())
     else:
         def local(stage_params, inputs, targets):
             out = interleaved_train_apply(
                 stage_fn, loss_fn, peel(stage_params), inputs, targets,
                 axis_name, sched, return_dx=return_dx)
+            out = dp_reduce(out)
             return (out[0], unpeel(out[1])) + out[2:]
 
-        in_specs = (P(None, axis_name), P(), P())
+        in_specs = (P(None, axis_name), data_spec, data_spec)
         out_specs = (P(), P(None, axis_name)) + (
-            (P(axis_name),) if return_dx else ())
+            (dx_spec,) if return_dx else ())
 
     staged = shard_map_fn(mesh, local, in_specs=in_specs,
                           out_specs=out_specs)

@@ -280,6 +280,42 @@ def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
     return out
 
 
+def dp_compose(mesh, dp_axis: "str | None", axis_name: str, *,
+               with_head: bool, return_dx: bool):
+    """Shared dp-composition plumbing for BOTH 1F1B builders (plain and
+    interleaved): validates ``dp_axis``, builds the input/dx specs, and
+    returns the local-output reducer.
+
+    Returns ``(data_spec, dx_spec, dp_reduce)``: inputs/targets shard
+    their dim-1 (within-microbatch batch) over dp; the local dx buffer
+    ``[1, M, mb, ...]`` shards dim 2; ``dp_reduce`` pmean-averages loss /
+    param grads / head grads over dp and scales dinputs by 1/ndp (the
+    per-shard cotangent differentiates the dp-averaged loss — without the
+    factor an embedding chained into it would be ndp x the stage grads'
+    scale)."""
+    if dp_axis is not None and dp_axis not in mesh.shape:
+        raise ValueError(f"dp_axis={dp_axis!r} is not an axis of {mesh.shape}")
+    data_spec = P(None, dp_axis) if dp_axis else P()
+    dx_spec = P(axis_name, None, dp_axis) if dp_axis else P(axis_name)
+
+    def dp_reduce(out):
+        if dp_axis is None:
+            return out
+        loss = lax.pmean(out[0], dp_axis)
+        dparams = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, dp_axis), out[1])
+        rest = out[2:]
+        if with_head:
+            dhead = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, dp_axis), rest[0])
+            rest = (dhead,) + rest[1:]
+        if return_dx:
+            rest = rest[:-1] + (rest[-1] / lax.axis_size(dp_axis),)
+        return (loss, dparams) + rest
+
+    return data_spec, dx_spec, dp_reduce
+
+
 def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
                         axis_name: str = "pp", *, with_head: bool = False,
                         return_dx: bool = False, dp_axis: str | None = None):
@@ -311,31 +347,8 @@ def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
     applied), so chaining it into an embedding yields grads on the same
     scale as ``dparams``.
     """
-    if dp_axis is not None and dp_axis not in mesh.shape:
-        raise ValueError(f"dp_axis={dp_axis!r} is not an axis of {mesh.shape}")
-    data_spec = P(None, dp_axis) if dp_axis else P()
-    dx_spec = P(axis_name, None, dp_axis) if dp_axis else P(axis_name)
-
-    def dp_reduce(out):
-        """Average loss/param-grad/head-grad over the dp groups."""
-        if dp_axis is None:
-            return out
-        loss = lax.pmean(out[0], dp_axis)
-        dparams = jax.tree_util.tree_map(
-            lambda g: lax.pmean(g, dp_axis), out[1])
-        rest = out[2:]
-        if with_head:
-            dhead = jax.tree_util.tree_map(
-                lambda g: lax.pmean(g, dp_axis), rest[0])
-            rest = (dhead,) + rest[1:]
-        if return_dx:
-            # dinputs differentiates THIS shard's inputs, but against the
-            # REPORTED (dp-averaged) loss: each shard's local cotangent
-            # carries a 1/ndp factor — without it the embedding grad a
-            # caller chains this into would be ndp x the stage grads' scale.
-            ndp = lax.axis_size(dp_axis)
-            rest = rest[:-1] + (rest[-1] / ndp,)
-        return (loss, dparams) + rest
+    data_spec, dx_spec, dp_reduce = dp_compose(
+        mesh, dp_axis, axis_name, with_head=with_head, return_dx=return_dx)
 
     if with_head:
         def local(stage_params, head_params, inputs, targets):

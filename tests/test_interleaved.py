@@ -136,6 +136,48 @@ def test_interleaved_shrinks_the_bubble():
         assert sched.ticks <= v * pipeline_ticks(m, s, train=True) + (v - 1)
 
 
+def test_interleaved_dp_composition_matches_sequential():
+    """Interleaved schedule on a pp x dp mesh: per-microbatch batch dim
+    shards over dp, pmean'd loss/grads and 1/ndp-scaled input cotangents
+    must equal the sequential V*S-stage reference (mirrors the plain
+    schedule's pp x dp pin)."""
+    m, s, v = 4, 2, 2
+    mesh = make_mesh({"pp": s, "dp": 2})
+    rng = np.random.default_rng(7)
+    ws = jnp.asarray(rng.normal(size=(v, s, D, D)) * 0.5, jnp.float32)
+    inputs = jnp.asarray(rng.normal(size=(m, 4, D)), jnp.float32)
+    targets = jnp.asarray(rng.normal(size=(m, 4, D)), jnp.float32)
+
+    step = make_interleaved_pipeline_train(
+        mesh, _stage_fn, _loss_fn, "pp", n_chunks=v, n_micro=m,
+        return_dx=True, dp_axis="dp")
+    loss, grads, dx = step(ws, inputs, targets)
+
+    ws_flat = ws.reshape(v * s, D, D)
+    ref_loss, ref_grads = _sequential_reference(ws_flat, inputs, targets)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads.reshape(v * s, D, D)),
+                               np.asarray(ref_grads), atol=1e-5, rtol=1e-4)
+
+    def seq_loss(xs):
+        def per_mb(x, t):
+            h = x
+            for i in range(v * s):
+                h = jnp.tanh(h @ ws_flat[i])
+            return _loss_fn(h, t)
+
+        return jnp.mean(jax.vmap(per_mb)(xs, targets))
+
+    ref_dx = jax.grad(seq_loss)(inputs)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(ref_dx),
+                               atol=1e-5, rtol=1e-4)
+
+    with pytest.raises(ValueError, match="dp_axis"):
+        make_interleaved_pipeline_train(
+            mesh, _stage_fn, _loss_fn, "pp", n_chunks=v, n_micro=m,
+            dp_axis="nope")
+
+
 def test_interleaved_trains_with_optax():
     import optax
 
