@@ -132,7 +132,7 @@ def shard_ppv_params(ppv_params: dict, mesh, axis_name: str = "pp") -> dict:
 
 def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
                         n_micro: int, attn_fn: Optional[Callable] = None,
-                        n_chunks: int = 1):
+                        n_chunks: int = 1, dp_axis: Optional[str] = None):
     """Build ``step(pp_params, batch) -> (loss, grads)``, jit-compiled.
 
     ``batch``: [B, S+1] token ids, B divisible by ``n_micro``.  ``grads``
@@ -145,6 +145,12 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
     ``pp_params`` must then be in ``ppv_split_params`` layout
     (stages ``[V, S, L/(V*S), ...]``).  Worth it when stages are many and
     microbatches few — see interleaved.py's fill-cost accounting.
+
+    ``dp_axis``: compose either schedule with data parallelism on a
+    pp x dp mesh (parallel/pipeline.py:dp_compose): each microbatch's rows
+    shard over dp (``B / n_micro`` must divide by the dp size), grads ride
+    one dp pmean, and the embedding gradient chains from the 1/ndp-scaled
+    input cotangents — same training math, smaller per-device batch.
     """
     n_stages = mesh.shape[axis_name]
     if cfg.n_layers % (n_stages * n_chunks):
@@ -186,10 +192,12 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
 
         grad_step = make_interleaved_pipeline_train(
             mesh, chunk_fn, loss_fn, axis_name, n_chunks=n_chunks,
-            n_micro=n_micro, with_head=True, return_dx=True)
+            n_micro=n_micro, with_head=True, return_dx=True,
+            dp_axis=dp_axis)
     else:
         grad_step = make_pipeline_train(mesh, stage_fn, loss_fn, axis_name,
-                                        with_head=True, return_dx=True)
+                                        with_head=True, return_dx=True,
+                                        dp_axis=dp_axis)
 
     def step(pp_params, batch):
         tokens, targets = batch[:, :-1], batch[:, 1:]
@@ -197,6 +205,10 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
         if B % n_micro:
             raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
         mb = B // n_micro
+        if dp_axis is not None and mb % mesh.shape[dp_axis]:
+            raise ValueError(
+                f"microbatch rows ({mb} = {B}/{n_micro}) not divisible by "
+                f"the dp size {mesh.shape[dp_axis]}")
         D = pp_params["embed"].shape[1]
 
         h0 = pp_params["embed"][tokens].reshape(n_micro, mb, S, D)

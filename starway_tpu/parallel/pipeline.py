@@ -295,6 +295,12 @@ def dp_compose(mesh, dp_axis: "str | None", axis_name: str, *,
     scale)."""
     if dp_axis is not None and dp_axis not in mesh.shape:
         raise ValueError(f"dp_axis={dp_axis!r} is not an axis of {mesh.shape}")
+    if dp_axis == axis_name:
+        # Sharding the batch over the STAGE axis would run every schedule
+        # slot on a different batch slice and a different stage at once —
+        # plausible-looking garbage, not an error, on return_dx=False paths.
+        raise ValueError(f"dp_axis must differ from the pipeline axis "
+                         f"{axis_name!r}")
     data_spec = P(None, dp_axis) if dp_axis else P()
     dx_spec = P(axis_name, None, dp_axis) if dp_axis else P(axis_name)
 
