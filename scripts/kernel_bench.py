@@ -308,6 +308,34 @@ def _train_mfu_row(metric: str, cfg_kw: dict, B: int, S: int, iters: int):
                       f"B={B} S={S} remat={cfg.remat}, {dt*1e3:.1f} ms/step"}
 
 
+def bench_decode_shapes(iters: int = 64):
+    """Ours-vs-lax decode at the VERDICT r2 acceptance shapes: besides the
+    headline (B=1, Hkv=2, T=8192 — measured by the adjacent
+    ``decode``/``decode_lax`` rows, not repeated here), the kernel must
+    also beat the lax path at three more (B, Hkv, T) points.  Emits one
+    ours/lax pair per shape plus a summary row counting wins."""
+    shapes = [  # (B, Hq, Hkv, T)
+        (8, 8, 2, 4096),   # serving batch
+        (1, 32, 8, 8192),  # more kv heads (smaller GQA ratio)
+        (4, 8, 1, 16384),  # long cache, extreme grouping
+    ]
+    wins = 0
+    for b, hq, hkv, t in shapes:
+        pair = {}
+        for impl in ("ours", "lax"):
+            row = bench_decode(b=b, hq=hq, hkv=hkv, t=t, iters=iters,
+                               impl=impl)
+            row["metric"] = f"decode_{impl}_b{b}_hkv{hkv}_t{t}_us"
+            pair[impl] = row["value"]
+            print(json.dumps(row), flush=True)
+        if pair["ours"] < pair["lax"]:
+            wins += 1
+    return {"metric": "decode_shape_wins", "value": wins,
+            "unit": f"of_{len(shapes)}",
+            "detail": "shapes (B,Hq,Hkv,T): " + "; ".join(
+                f"({b},{hq},{hkv},{t})" for b, hq, hkv, t in shapes)}
+
+
 def bench_train_mfu(iters: int = 4):
     """Tiny-Llama MFU (the r2 row; kept for continuity of the table)."""
     return _train_mfu_row(
@@ -576,6 +604,7 @@ BENCHES = {
     "decode": bench_decode,
     "decode_lax": functools.partial(bench_decode, impl="lax"),
     "decode_tune": bench_decode_tune,
+    "decode_shapes": bench_decode_shapes,
     "train_mfu": bench_train_mfu,
     "train_mfu_large": bench_train_mfu_large,
     "serve": bench_serve,
@@ -606,7 +635,7 @@ def main():
         # `bench.py --kernels` pass from minutes to an hour behind the
         # tunnel.  onchip_refresh.sh runs them individually.
         heavy = ("serve", "serve_b8", "serve_ragged_b8", "serve_mistral",
-                 "serve_continuous", "train_mfu_large")
+                 "serve_continuous", "train_mfu_large", "decode_shapes")
         names = [n for n in BENCHES
                  if not n.endswith("_tune") and n not in heavy]
     else:

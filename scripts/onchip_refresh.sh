@@ -42,7 +42,8 @@ run flash_window
 run flash_bwd
 run decode            # block_k=512 default: the row BASELINE.md flags as pending
 run decode_lax
-run decode_tune       # block_k sweep; update the default if 512 is not the winner
+run decode_tune       # stream/grid variant x block sweep; retune the default
+run decode_shapes     # ours-vs-lax at the VERDICT r2 acceptance shapes
 run train_mfu
 run train_mfu_large   # model-scale MFU: 672M GQA @ S=8192, remat (target >= 0.40)
 run serve             # end-to-end generate() tokens/s (VERDICT r3 #4) ...
