@@ -30,9 +30,13 @@ a FIXED, small set of compiled programs:
   prefill, same decode step, same masking — pinned by
   tests/test_serving.py against the one-request oracle.
 
-Dense models only (MoE expert capacity is shared batch-wide, so slot
-cohabitation would perturb routing — same restriction as ragged
-``generate()``).
+Sliding-window (Mistral-family) models serve through per-slot ROLLING
+caches: O(window) memory per slot however long each generation runs,
+admission via the chunked ``prefill_rolling`` (no prompt bucketing — its
+compiled chunk body is length-independent), and ``max_len`` bounding only
+the rope horizon.  Dense models only (MoE expert capacity is shared
+batch-wide, so slot cohabitation would perturb routing — same restriction
+as ragged ``generate()``).
 """
 
 from __future__ import annotations
@@ -47,7 +51,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .generate import _sample, decode_step, init_cache, prefill, rope_tables
+from .generate import (_sample, decode_step, init_cache, init_rolling_cache,
+                       prefill, prefill_rolling, rope_tables)
 from .llama import LlamaConfig
 
 
@@ -59,6 +64,20 @@ def _bucket(n: int, buckets) -> int:
                      f"{buckets[-1]}")
 
 
+def _write_slot_and_sample(cache, small, logits, slot, key, temperature,
+                           top_k, top_p):
+    """Shared tail of BOTH admission paths: file one request's [L, 1, Hkv,
+    T', D] cache rows into the slot and sample its first token."""
+    cache = {
+        "k": lax.dynamic_update_slice(
+            cache["k"], small["k"], (0, slot, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(
+            cache["v"], small["v"], (0, slot, 0, 0, 0)),
+    }
+    tok = _sample(logits, key, temperature, top_k, top_p)[0]
+    return cache, tok
+
+
 @functools.cache
 def _compiled_admit(cfg: LlamaConfig, p_bucket: int, temperature: float,
                     top_k: Optional[int], top_p: Optional[float]):
@@ -67,28 +86,80 @@ def _compiled_admit(cfg: LlamaConfig, p_bucket: int, temperature: float,
 
     def run(params, cache, prompt, length, slot, key):
         # prompt [1, p_bucket] right-padded; ragged single-row prefill.
+        # Columns >= length hold pad-garbage that is overwritten (position
+        # by position) before the cursor lets attention read it.
         logits, small = prefill(params, cfg, prompt, p_bucket,
                                 logit_positions=length[None] - 1)
-        # Write the bucket's kv rows into the slot: [L, 1, Hkv, P, D] ->
-        # cache[:, slot, :, :P].  Columns >= length hold pad-garbage that
-        # is overwritten (position by position) before the cursor lets
-        # attention read it.
-        cache = {
-            "k": lax.dynamic_update_slice(
-                cache["k"], small["k"], (0, slot, 0, 0, 0)),
-            "v": lax.dynamic_update_slice(
-                cache["v"], small["v"], (0, slot, 0, 0, 0)),
-        }
-        tok = _sample(logits, key, temperature, top_k, top_p)[0]
-        return cache, tok
+        return _write_slot_and_sample(cache, small, logits, slot, key,
+                                      temperature, top_k, top_p)
 
     return jax.jit(run, donate_argnums=(1,))
 
 
 @functools.cache
+def _compiled_rolling_admit(cfg: LlamaConfig, temperature: float,
+                            top_k: Optional[int], top_p: Optional[float]):
+    """Rolling-cache admission, final part: write the request's [L, 1,
+    Hkv, W, D] rolling cache into the slot and sample the first token."""
+
+    def run(cache, small, logits, slot, key):
+        return _write_slot_and_sample(cache, small, logits, slot, key,
+                                      temperature, top_k, top_p)
+
+    return jax.jit(run, donate_argnums=(0,))
+
+
+@functools.cache
+def _compiled_rolling_token(cfg: LlamaConfig):
+    """One prompt token through the [1, ...] rolling cache (admission's
+    remainder stepper) — compiled ONCE per config, any prompt length."""
+
+    def run(params, cache, token, pos, rope):
+        return decode_step(params, cache, token, pos, cfg, rope,
+                           rolling=True)
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+# Full-chunk width for rolling admission.  The chunked prefill's compiled
+# body is keyed on the CHUNK width, so feeding it only whole multiples of
+# this (and stepping the remainder token-by-token through the compile-once
+# stepper) bounds admission to TWO programs per config — arbitrary prompt
+# lengths never trigger fresh XLA compiles mid-serve (the compile
+# explosion prompt bucketing prevents on the dense path).
+ROLLING_ADMIT_CHUNK = 64
+
+
+def _rolling_prefill_state(params, cfg: LlamaConfig, prompt: np.ndarray,
+                           horizon: int):
+    """(next_logits [1, V], rolling cache [L, 1, Hkv, W, D]) for one
+    prompt, using only length-independent compiled programs (see
+    ROLLING_ADMIT_CHUNK).  Shared by admission and the serving tests'
+    single-request oracle."""
+    c = min(ROLLING_ADMIT_CHUNK, cfg.sliding_window)
+    p = len(prompt)
+    full = p - (p % c)
+    if full:
+        logits, cache = prefill_rolling(
+            params, cfg, jnp.asarray(prompt[None, :full], jnp.int32),
+            chunk=c)
+    else:
+        cache = init_rolling_cache(cfg, 1)
+        logits = None
+    rope = rope_tables(horizon, cfg.head_dim, cfg.rope_theta)
+    stepper = _compiled_rolling_token(cfg)
+    for pos in range(full, p):
+        logits, cache = stepper(
+            params, cache, jnp.asarray([prompt[pos]], jnp.int32),
+            jnp.asarray([pos], jnp.int32), rope)
+    return logits, cache
+
+
+@functools.cache
 def _compiled_chunk(cfg: LlamaConfig, n_slots: int, max_len: int, chunk: int,
                     temperature: float, top_k: Optional[int],
-                    top_p: Optional[float], eos_id: Optional[int]):
+                    top_p: Optional[float], eos_id: Optional[int],
+                    rolling: bool = False):
     """Advance every live slot ``chunk`` tokens in ONE dispatch.
 
     Per step: the pending token (at its slot's cursor) runs
@@ -96,14 +167,16 @@ def _compiled_chunk(cfg: LlamaConfig, n_slots: int, max_len: int, chunk: int,
     budgets/eos update liveness.  Emits ``(tokens [chunk, B], mask
     [chunk, B])`` — mask marks which emissions are real (slot was live
     when its PENDING token was consumed, i.e. the sampled token continues
-    a real request).
+    a real request).  ``rolling``: the cache is circular per slot
+    (``max_len`` is the rope horizon, not the cache size).
     """
     rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
 
     def run(params, cache, token, pos, live, remaining, key):
         def step(carry, _):
             cache, token, pos, live, remaining, key = carry
-            logits, cache = decode_step(params, cache, token, pos, cfg, rope)
+            logits, cache = decode_step(params, cache, token, pos, cfg, rope,
+                                        rolling=rolling)
             key, sub = jax.random.split(key)
             nxt = _sample(logits, sub, temperature, top_k, top_p)
             emit_live = live & (remaining > 0)
@@ -152,10 +225,7 @@ class SlotServer:
                 "continuous batching is dense-only: MoE expert capacity is "
                 "shared batch-wide, so cohabiting slots would perturb each "
                 "other's routing (same restriction as ragged generate())")
-        if cfg.sliding_window is not None:
-            raise NotImplementedError(
-                "SlotServer serves full-cache models; rolling-window serving "
-                "uses generate()'s aligned path")
+        self.rolling = cfg.sliding_window is not None
         if n_slots < 1 or chunk < 1:
             # Zero slots/chunk would make run() spin forever, not error.
             raise ValueError(f"need n_slots >= 1 and chunk >= 1, got "
@@ -167,23 +237,30 @@ class SlotServer:
         self.chunk = chunk
         self.sampling = (float(temperature), top_k, top_p)
         self.eos_id = None if eos_id is None else int(eos_id)
-        if prompt_buckets is None:
-            b, buckets = 32, []
-            while b < max_len:
-                buckets.append(b)
-                b *= 2
-            # Always cover the full cache: a prompt up to max_len - 1 must
-            # have a bucket, or submit-accepted requests would die at
-            # admission time.
-            buckets.append(max_len)
-            prompt_buckets = tuple(buckets)
-        self.buckets = tuple(sorted(set(prompt_buckets)))
-        if self.buckets[-1] > max_len:
-            raise ValueError(f"bucket {self.buckets[-1]} exceeds "
-                             f"max_len={max_len}")
+        if self.rolling:
+            self.buckets = ()  # rolling admission never buckets prompts
+        else:
+            if prompt_buckets is None:
+                b, buckets = 32, []
+                while b < max_len:
+                    buckets.append(b)
+                    b *= 2
+                # Always cover the full cache: a prompt up to max_len - 1
+                # must have a bucket, or submit-accepted requests would
+                # die at admission time.
+                buckets.append(max_len)
+                prompt_buckets = tuple(buckets)
+            self.buckets = tuple(sorted(set(prompt_buckets)))
+            if self.buckets[-1] > max_len:
+                raise ValueError(f"bucket {self.buckets[-1]} exceeds "
+                                 f"max_len={max_len}")
         self.key = jax.random.PRNGKey(seed)
 
-        self.cache = init_cache(cfg, n_slots, max_len)
+        # Rolling (sliding-window) models keep an O(window) circular cache
+        # per slot; max_len then bounds the ROPE horizon (prompt + budget),
+        # not cache memory.
+        self.cache = (init_rolling_cache(cfg, n_slots) if self.rolling
+                      else init_cache(cfg, n_slots, max_len))
         self.token = jnp.zeros((n_slots,), jnp.int32)
         self.pos = jnp.zeros((n_slots,), jnp.int32)
         self.live = jnp.zeros((n_slots,), bool)
@@ -206,8 +283,9 @@ class SlotServer:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new ({max_new_tokens}) "
                 f"exceeds max_len={self.max_len}")
-        _bucket(len(prompt), self.buckets)  # reject un-bucketable NOW, not
-        # at admission time after the request has left the queue
+        if not self.rolling:
+            _bucket(len(prompt), self.buckets)  # reject un-bucketable NOW,
+            # not at admission time after the request has left the queue
         rid = self._next_rid
         self._next_rid += 1
         self._pending.append((rid, prompt, int(max_new_tokens)))
@@ -216,15 +294,25 @@ class SlotServer:
     # ------------------------------------------------------------- engine
     def _admit(self, slot: int, rid: int, prompt: np.ndarray,
                max_new: int) -> None:
-        pb = _bucket(len(prompt), self.buckets)
-        padded = np.zeros((1, pb), np.int32)
-        padded[0, :len(prompt)] = prompt
         self.key, sub = jax.random.split(self.key)
-        admit = _compiled_admit(self.cfg, pb, *self.sampling)
-        self.cache, tok = admit(
-            self.params, self.cache, jnp.asarray(padded),
-            jnp.asarray(len(prompt), jnp.int32),
-            jnp.asarray(slot, jnp.int32), sub)
+        if self.rolling:
+            # Chunked O(window) prefill over whole ROLLING_ADMIT_CHUNKs +
+            # a compile-once stepper for the remainder: two programs total,
+            # any prompt length.
+            logits, small = _rolling_prefill_state(
+                self.params, self.cfg, prompt, self.max_len)
+            admit = _compiled_rolling_admit(self.cfg, *self.sampling)
+            self.cache, tok = admit(self.cache, small, logits,
+                                    jnp.asarray(slot, jnp.int32), sub)
+        else:
+            pb = _bucket(len(prompt), self.buckets)
+            padded = np.zeros((1, pb), np.int32)
+            padded[0, :len(prompt)] = prompt
+            admit = _compiled_admit(self.cfg, pb, *self.sampling)
+            self.cache, tok = admit(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.asarray(len(prompt), jnp.int32),
+                jnp.asarray(slot, jnp.int32), sub)
         tok_host = int(tok)
         self._slot_rid[slot] = rid
         self._collected[rid] = [tok_host]
@@ -257,7 +345,8 @@ class SlotServer:
             return finished
 
         run = _compiled_chunk(self.cfg, self.n_slots, self.max_len,
-                              self.chunk, *self.sampling, self.eos_id)
+                              self.chunk, *self.sampling, self.eos_id,
+                              rolling=self.rolling)
         self.key, sub = jax.random.split(self.key)
         (self.cache, self.token, self.pos, self.live, self.remaining,
          _key, toks, mask) = run(self.params, self.cache, self.token,
