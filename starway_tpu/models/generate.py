@@ -201,7 +201,8 @@ def prefill(params: dict, cfg: LlamaConfig, prompt,
 
 
 def prefill_rolling(params: dict, cfg: LlamaConfig, prompt, *,
-                    chunk: Optional[int] = None, attn_fn=None):
+                    chunk: Optional[int] = None, attn_fn=None,
+                    widths=None):
     """Long-prompt prefill in O(window) memory: chunks of at most
     ``sliding_window`` tokens stream through the transformer, each chunk
     attending to the rolling cache (its own window's past) plus itself,
@@ -216,6 +217,14 @@ def prefill_rolling(params: dict, cfg: LlamaConfig, prompt, *,
     tests/test_generate.py).  The chunk body is the same
     :func:`~starway_tpu.models.llama.decoder_layer` every other path uses
     (``attn_fn`` must be None: the chunk step owns its attention).
+
+    ``widths`` (else ``chunk``): a DENOMINATION schedule, e.g. (64, 8, 1)
+    — the prompt is covered greedily by these chunk widths (each capped at
+    the window), so the set of compiled chunk programs is bounded by
+    ``len(widths)`` for ANY prompt length.  The default single-``chunk``
+    plan compiles one extra program per distinct final-partial width —
+    fine for batch jobs, a compile explosion for serving admission
+    (models/serving.py passes denominations).
     """
     from .llama import head_logits
 
@@ -225,20 +234,37 @@ def prefill_rolling(params: dict, cfg: LlamaConfig, prompt, *,
     if attn_fn is not None:
         raise ValueError("prefill_rolling owns its attention; attn_fn must be None")
     B, P = prompt.shape
-    C = min(chunk or W, W, P)
     cos, sin = rope_tables(P, cfg.head_dim, cfg.rope_theta)
     cache = init_rolling_cache(cfg, B)
 
+    # Host-side chunk plan.
+    plan = []
+    c0 = 0
+    if widths is None:
+        C = min(chunk or W, W, P)
+        while c0 < P:
+            plan.append(min(C, P - c0))
+            c0 += plan[-1]
+    else:
+        for width in widths:
+            width = min(int(width), W)
+            while P - c0 >= width:
+                plan.append(width)
+                c0 += width
+        if c0 != P:
+            raise ValueError(
+                f"widths={tuple(widths)} cannot cover prompt length {P} "
+                f"(include 1 as the smallest denomination)")
+
     # Jitted chunk step (module-level compile cache keyed on cfg; jit's own
-    # cache keys the two shapes: the full chunk and the final partial one).
-    # Eager per-op dispatch here costs O(P/C * n_layers) round trips — fatal
-    # on a tunneled device at ~100 ms per dispatch.
+    # cache keys one shape per distinct plan width).  Eager per-op dispatch
+    # here costs O(P/C * n_layers) round trips — fatal on a tunneled device
+    # at ~100 ms per dispatch.
     run_chunk = _compiled_prefill_chunk(cfg)
 
     h_last = None
     c0 = 0
-    while c0 < P:
-        Cc = min(C, P - c0)
+    for Cc in plan:
         # Rope slices are cut on the host so the compiled signature sees
         # [Cc, ...] — independent of P (a full-table argument would
         # recompile the chunk program for every distinct prompt length).

@@ -52,7 +52,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .generate import (_sample, decode_step, init_cache, init_rolling_cache,
-                       prefill, prefill_rolling, rope_tables)
+                       prefill, rope_tables)
 from .llama import LlamaConfig
 
 
@@ -109,50 +109,22 @@ def _compiled_rolling_admit(cfg: LlamaConfig, temperature: float,
     return jax.jit(run, donate_argnums=(0,))
 
 
-@functools.cache
-def _compiled_rolling_token(cfg: LlamaConfig):
-    """One prompt token through the [1, ...] rolling cache (admission's
-    remainder stepper) — compiled ONCE per config, any prompt length."""
-
-    def run(params, cache, token, pos, rope):
-        return decode_step(params, cache, token, pos, cfg, rope,
-                           rolling=True)
-
-    return jax.jit(run, donate_argnums=(1,))
+# Chunk-width denominations for rolling admission: covering the prompt
+# greedily with these bounds admission to <= 3 compiled chunk programs per
+# config and <= P/64 + 7 + 7 dispatches — arbitrary prompt lengths never
+# trigger fresh XLA compiles mid-serve (the compile explosion prompt
+# bucketing prevents on the dense path).
+ROLLING_ADMIT_WIDTHS = (64, 8, 1)
 
 
-# Full-chunk width for rolling admission.  The chunked prefill's compiled
-# body is keyed on the CHUNK width, so feeding it only whole multiples of
-# this (and stepping the remainder token-by-token through the compile-once
-# stepper) bounds admission to TWO programs per config — arbitrary prompt
-# lengths never trigger fresh XLA compiles mid-serve (the compile
-# explosion prompt bucketing prevents on the dense path).
-ROLLING_ADMIT_CHUNK = 64
-
-
-def _rolling_prefill_state(params, cfg: LlamaConfig, prompt: np.ndarray,
-                           horizon: int):
+def _rolling_prefill_state(params, cfg: LlamaConfig, prompt: np.ndarray):
     """(next_logits [1, V], rolling cache [L, 1, Hkv, W, D]) for one
-    prompt, using only length-independent compiled programs (see
-    ROLLING_ADMIT_CHUNK).  Shared by admission and the serving tests'
-    single-request oracle."""
-    c = min(ROLLING_ADMIT_CHUNK, cfg.sliding_window)
-    p = len(prompt)
-    full = p - (p % c)
-    if full:
-        logits, cache = prefill_rolling(
-            params, cfg, jnp.asarray(prompt[None, :full], jnp.int32),
-            chunk=c)
-    else:
-        cache = init_rolling_cache(cfg, 1)
-        logits = None
-    rope = rope_tables(horizon, cfg.head_dim, cfg.rope_theta)
-    stepper = _compiled_rolling_token(cfg)
-    for pos in range(full, p):
-        logits, cache = stepper(
-            params, cache, jnp.asarray([prompt[pos]], jnp.int32),
-            jnp.asarray([pos], jnp.int32), rope)
-    return logits, cache
+    prompt via denomination-scheduled ``prefill_rolling``.  Shared by
+    admission and the serving tests' single-request oracle."""
+    from .generate import prefill_rolling
+
+    return prefill_rolling(params, cfg, jnp.asarray(prompt[None], jnp.int32),
+                           widths=ROLLING_ADMIT_WIDTHS)
 
 
 @functools.cache
@@ -296,11 +268,11 @@ class SlotServer:
                max_new: int) -> None:
         self.key, sub = jax.random.split(self.key)
         if self.rolling:
-            # Chunked O(window) prefill over whole ROLLING_ADMIT_CHUNKs +
-            # a compile-once stepper for the remainder: two programs total,
-            # any prompt length.
+            # Chunked O(window) prefill with denomination widths: at most
+            # len(ROLLING_ADMIT_WIDTHS) compiled programs, any prompt
+            # length.
             logits, small = _rolling_prefill_state(
-                self.params, self.cfg, prompt, self.max_len)
+                self.params, self.cfg, prompt)
             admit = _compiled_rolling_admit(self.cfg, *self.sampling)
             self.cache, tok = admit(self.cache, small, logits,
                                     jnp.asarray(slot, jnp.int32), sub)

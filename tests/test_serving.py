@@ -140,7 +140,7 @@ def test_rolling_continuous_batching(cfg, params):
 
     def oracle(prompt, max_new, horizon):
         logits, cache = _rolling_prefill_state(
-            wparams, wcfg, np.asarray(prompt, np.int32), horizon)
+            wparams, wcfg, np.asarray(prompt, np.int32))
         rope = rope_tables(horizon, wcfg.head_dim, wcfg.rope_theta)
         toks = [int(_sample(logits, jax.random.PRNGKey(0), 0.0, None, None)[0])]
         pos = len(prompt)
@@ -159,7 +159,7 @@ def test_rolling_continuous_batching(cfg, params):
     from starway_tpu.models.generate import prefill_rolling
 
     probe = np.asarray([5, 1, 7, 2, 9, 4, 3, 8, 6], np.int32)
-    l_hybrid, _ = _rolling_prefill_state(wparams, wcfg, probe, 64)
+    l_hybrid, _ = _rolling_prefill_state(wparams, wcfg, probe)
     l_oneshot, _ = prefill_rolling(wparams, wcfg, jnp.asarray(probe[None]))
     np.testing.assert_allclose(np.asarray(l_hybrid), np.asarray(l_oneshot),
                                atol=1e-4, rtol=1e-3)
