@@ -24,6 +24,21 @@ if os.environ.get("STARWAY_TEST_REAL_TPU") != "1":
     jax.config.update("jax_platforms", "cpu")
 
 
+def free_port() -> int:
+    """An OS-assigned free TCP port (bind :0, read it back, release).
+
+    Tests previously drew random.randint(10000, 50000), which collides
+    when several pytest processes run concurrently on one host (observed:
+    OSError address-in-use flakes).  The tiny bind-then-close TOCTOU
+    window is far narrower than a 40000-value birthday problem."""
+    import socket
+
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 # Minimal asyncio test support (pytest-asyncio is not available in the image):
 # coroutine test functions run under asyncio.run, mirroring the reference's
 # module-wide `pytestmark = pytest.mark.asyncio` setup.

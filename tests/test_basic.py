@@ -32,7 +32,9 @@ INFLIGHT_BYTES = 1 << 30  # 1 GiB: must be big enough to be "on the flight"
 
 @pytest.fixture
 def port():
-    return random.randint(10000, 50000)
+    from conftest import free_port
+
+    return free_port()
 
 
 @pytest.fixture(params=["inproc", "tcp", "sm", "native", "native-sm"])

@@ -24,7 +24,9 @@ MASK = (1 << 64) - 1
 
 @pytest.fixture
 def port():
-    return random.randint(10000, 50000)
+    from conftest import free_port
+
+    return free_port()
 
 
 @pytest.fixture(params=["inproc", "tcp"])

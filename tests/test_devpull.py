@@ -14,7 +14,6 @@ hardware (jax.experimental.transfer is the DCN cross-slice machinery).
 import asyncio
 import gc
 import multiprocessing
-import random
 
 import numpy as np
 import pytest
@@ -32,7 +31,9 @@ N = 1 << 20  # 1 MiB: comfortably above STARWAY_DEVPULL_MIN
 
 @pytest.fixture
 def port():
-    return random.randint(10000, 50000)
+    from conftest import free_port
+
+    return free_port()
 
 
 @pytest.fixture(autouse=True)
@@ -445,11 +446,13 @@ async def test_devpull_between_jax_distributed_members(port):
     exchange device payloads over devpull in both directions — the
     cross-host DCN topology minus real DCN links (VERDICT r2 next #6; see
     DESIGN.md section 7 for what real-DCN validation still needs)."""
+    from conftest import free_port
+
     ctx = multiprocessing.get_context("spawn")
     q = ctx.Queue()
-    coord_port = random.randint(10000, 50000)
+    coord_port = free_port()
     while coord_port == port:
-        coord_port = random.randint(10000, 50000)
+        coord_port = free_port()
     procs = [
         ctx.Process(target=_distributed_member,
                     args=(role, coord_port, port, q), daemon=True)

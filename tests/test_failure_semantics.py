@@ -9,7 +9,6 @@ Regression coverage for review findings on the host runtime:
 """
 
 import asyncio
-import random
 import socket
 
 import numpy as np
@@ -25,7 +24,9 @@ SERVER_ADDR = "127.0.0.1"
 
 @pytest.fixture
 def port():
-    return random.randint(10000, 50000)
+    from conftest import free_port
+
+    return free_port()
 
 
 def test_purge_inflight_partial_message():

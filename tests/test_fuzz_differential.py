@@ -40,7 +40,9 @@ SIZES = [1, 7, 128, 1 << 12, MAX_SIZE]
 
 @pytest.fixture
 def port():
-    return random.randint(10000, 50000)
+    from conftest import free_port
+
+    return free_port()
 
 
 @pytest.fixture(params=["inproc", "tcp", "sm", "native", "native-sm",

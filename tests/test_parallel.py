@@ -2,7 +2,6 @@
 ring attention exactness, all-to-all shuffles, pytree DP exchange."""
 
 import asyncio
-import random
 
 import numpy as np
 import pytest
@@ -153,7 +152,9 @@ async def test_dp_exchange_pytree_roundtrip():
     from starway_tpu import Client, Server
     from starway_tpu.parallel import ClientPort, ServerPort, recv_pytree, send_pytree
 
-    port_num = random.randint(10000, 50000)
+    from conftest import free_port
+
+    port_num = free_port()
     server = Server()
     server.listen("127.0.0.1", port_num)
     client = Client()

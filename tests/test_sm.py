@@ -17,7 +17,6 @@ import asyncio
 import contextlib
 import multiprocessing as mp
 import os
-import random
 
 import numpy as np
 import pytest
@@ -51,7 +50,9 @@ def _shm_leftovers(baseline=frozenset()) -> set[str]:
 
 @pytest.fixture
 def port():
-    return random.randint(10000, 50000)
+    from conftest import free_port
+
+    return free_port()
 
 
 @pytest.fixture

@@ -1,6 +1,5 @@
 """Trainer harness: local steps, checkpoint resume, DP-exchange steps."""
 
-import random
 
 import numpy as np
 import pytest
@@ -127,7 +126,9 @@ async def test_trainer_dp_step_pair():
     from starway_tpu import Client, Server
     from starway_tpu.parallel import ClientPort, ServerPort
 
-    port_num = random.randint(10000, 50000)
+    from conftest import free_port
+
+    port_num = free_port()
     server = Server()
     server.listen("127.0.0.1", port_num)
     client = Client()
