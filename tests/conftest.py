@@ -39,6 +39,15 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+import pytest  # noqa: E402  (after the jax platform pinning above)
+
+
+@pytest.fixture
+def port() -> int:
+    """Shared across every socket-using suite; see free_port()."""
+    return free_port()
+
+
 # Minimal asyncio test support (pytest-asyncio is not available in the image):
 # coroutine test functions run under asyncio.run, mirroring the reference's
 # module-wide `pytestmark = pytest.mark.asyncio` setup.

@@ -30,12 +30,6 @@ SERVER_ADDR = "127.0.0.1"
 INFLIGHT_BYTES = 1 << 30  # 1 GiB: must be big enough to be "on the flight"
 
 
-@pytest.fixture
-def port():
-    from conftest import free_port
-
-    return free_port()
-
 
 @pytest.fixture(params=["inproc", "tcp", "sm", "native", "native-sm"])
 def transport(request, monkeypatch):

@@ -22,12 +22,6 @@ SERVER_ADDR = "127.0.0.1"
 MASK = (1 << 64) - 1
 
 
-@pytest.fixture
-def port():
-    from conftest import free_port
-
-    return free_port()
-
 
 @pytest.fixture(params=["inproc", "tcp"])
 def transport(request, monkeypatch):

@@ -29,12 +29,6 @@ MASK = (1 << 64) - 1
 N = 1 << 20  # 1 MiB: comfortably above STARWAY_DEVPULL_MIN
 
 
-@pytest.fixture
-def port():
-    from conftest import free_port
-
-    return free_port()
-
 
 @pytest.fixture(autouse=True)
 def _force_tcp(monkeypatch):

@@ -22,12 +22,6 @@ pytestmark = pytest.mark.asyncio
 SERVER_ADDR = "127.0.0.1"
 
 
-@pytest.fixture
-def port():
-    from conftest import free_port
-
-    return free_port()
-
 
 def test_purge_inflight_partial_message():
     m = TagMatcher()

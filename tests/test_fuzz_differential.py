@@ -38,12 +38,6 @@ MAX_SIZE = 1 << 16
 SIZES = [1, 7, 128, 1 << 12, MAX_SIZE]
 
 
-@pytest.fixture
-def port():
-    from conftest import free_port
-
-    return free_port()
-
 
 @pytest.fixture(params=["inproc", "tcp", "sm", "native", "native-sm",
                         "devpull", "devpull-native"])

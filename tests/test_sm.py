@@ -48,12 +48,6 @@ def _shm_leftovers(baseline=frozenset()) -> set[str]:
     return _shm_segments() - set(baseline)
 
 
-@pytest.fixture
-def port():
-    from conftest import free_port
-
-    return free_port()
-
 
 @pytest.fixture
 def sm_env(monkeypatch):
