@@ -36,7 +36,8 @@ def _kernel_bench(which: str, timeout: int = 840):
 def test_onchip_kernel_numerics():
     out, rows = _kernel_bench("check")
     assert out.returncode == 0, f"on-chip checks failed:\n{out.stdout}\n{out.stderr}"
-    assert len(rows) == 3 and all(r["ok"] for r in rows), rows
+    # 3 base rows + 3 windowed rows (flash window fwd/bwd, windowed decode).
+    assert len(rows) == 6 and all(r["ok"] for r in rows), rows
 
 
 @pytest.mark.skipif(os.environ.get("STARWAY_ONCHIP") != "1",
