@@ -44,6 +44,27 @@ def _sequential_reference(ws_flat, inputs, targets):
     return jax.value_and_grad(loss)(ws_flat)
 
 
+def test_schedule_valid_random_sweep():
+    """Builder validity over a broad random (M, S, V) sweep — host-side
+    only (numpy), so breadth is nearly free.  Every tuple must build,
+    cover each (chunk, microbatch) exactly once per device per direction,
+    and respect the within-chunk one-device-per-tick flow (the builder's
+    own asserts catch slot collisions)."""
+    rng = np.random.default_rng(0)
+    for _ in range(40):
+        m = int(rng.integers(1, 17))
+        s = int(rng.integers(1, 9))
+        v = int(rng.integers(1, 5))
+        sched = build_interleaved_schedule(m, s, v)
+        for d in range(s):
+            f = {(int(sched.f_chunk[t, d]), int(sched.f_micro[t, d]))
+                 for t in range(sched.ticks) if sched.f_chunk[t, d] >= 0}
+            b = {(int(sched.b_chunk[t, d]), int(sched.b_micro[t, d]))
+                 for t in range(sched.ticks) if sched.b_chunk[t, d] >= 0}
+            want = {(c, i) for c in range(v) for i in range(m)}
+            assert f == want and b == want, (m, s, v, d)
+
+
 @pytest.mark.parametrize("m,s,v", [(4, 2, 2), (8, 4, 2), (2, 2, 3),
                                    (5, 2, 2), (3, 4, 2)])
 def test_schedule_is_valid(m, s, v):
