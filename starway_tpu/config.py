@@ -95,7 +95,9 @@ def sm_enabled() -> bool:
     if platform.machine() not in ("x86_64", "AMD64"):
         from .core import native
 
-        return native.atomics() is not None
+        # build=False: this probe sits on the connection-setup path; a
+        # missing lib means "no sm this process", never a g++ build.
+        return native.atomics(build=False) is not None
     return True
 
 

@@ -136,10 +136,21 @@ def available() -> bool:
     return load() is not None
 
 
-def atomics() -> Optional[tuple]:
+def atomics(build: bool = True) -> Optional[tuple]:
     """(load_acquire_u64, store_release_u64) ctypes fns, or None (no
     native lib, or an old build without them).  Used by core/shmring.py to
-    carry sm on non-x86 hosts."""
+    carry sm on non-x86 hosts.
+
+    ``build=False``: only use an ALREADY-BUILT artifact — never compile.
+    The sm capability probe runs on the connection-setup path, where a
+    synchronous g++ build (or a slow failed one) would stall the first
+    connect of every fresh process."""
+    global _lib
+    if _lib is None and _lib_err is None and not build:
+        from .. import native_build
+
+        if native_build.prebuilt() is None:
+            return None
     lib = load()
     if lib is None or not hasattr(lib, "sw_atomic_load_u64"):
         return None

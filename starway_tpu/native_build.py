@@ -16,6 +16,17 @@ _HDR = _PKG_DIR.parent / "native" / "sw_engine.h"
 _OUT = _PKG_DIR / "_sw_native.so"
 
 
+def prebuilt() -> "Path | None":
+    """The existing artifact if present and fresh, else None — NEVER
+    compiles.  For callers on latency-sensitive paths (connection setup)
+    that want the lib only if it is already there."""
+    if (_SRC.exists() and _HDR.exists() and _OUT.exists()
+            and _OUT.stat().st_mtime >= max(_SRC.stat().st_mtime,
+                                            _HDR.stat().st_mtime)):
+        return _OUT
+    return None
+
+
 def ensure_built(force: bool = False) -> Path:
     """Compile native/sw_engine.cpp -> starway_tpu/_sw_native.so if stale.
 

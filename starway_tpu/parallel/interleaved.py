@@ -119,7 +119,10 @@ def build_interleaved_schedule(m: int, s: int, v: int) -> InterleavedSchedule:
     ready = {(v - 1, j): int(f_done[v - 1, j]) for j in range(m)}
     t = 0
     remaining = v * m
-    horizon = (m + v * s + 2 * v * s * max(v, s) + 64) * 4
+    # The loop injects at most one backward per tick, so it NEEDS ~v*m
+    # ticks; the horizon must scale with that (a bound in m alone
+    # spuriously failed valid v >= 5 configs at large m).
+    horizon = (v * m + v * s * max(v, s) + 64) * 4
     while remaining and t < horizon:
         # one backward injection per tick max (device S-1's single B slot)
         cand = [(c, j) for (c, j), rt in ready.items() if rt <= t]

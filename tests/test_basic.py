@@ -16,7 +16,6 @@ import contextlib
 import gc
 import multiprocessing as mp
 import os
-import random
 
 import numpy as np
 import pytest

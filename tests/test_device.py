@@ -6,7 +6,6 @@ real hardware) and host-staged delivery over real sockets.
 """
 
 import asyncio
-import random
 
 import numpy as np
 import pytest

@@ -44,6 +44,15 @@ def _sequential_reference(ws_flat, inputs, targets):
     return jax.value_and_grad(loss)(ws_flat)
 
 
+def test_schedule_builds_at_high_chunk_counts():
+    """Regression: the backward-injection loop runs ~V*M ticks, so the
+    convergence horizon must scale with V*M — a bound in M alone raised a
+    spurious 'failed to converge' for valid v >= 5 configs at large M."""
+    for m, s, v in [(800, 2, 5), (1000, 2, 8), (64, 8, 6)]:
+        sched = build_interleaved_schedule(m, s, v)
+        assert sched.ticks >= v * m  # work alone needs this many ticks
+
+
 def test_schedule_valid_random_sweep():
     """Builder validity over a broad random (M, S, V) sweep — host-side
     only (numpy), so breadth is nearly free.  Every tuple must build,
