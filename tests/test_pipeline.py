@@ -284,26 +284,31 @@ def test_pp_llama_dp_composition(n_chunks):
             atol=2e-5, rtol=2e-4, err_msg=name)
 
 
-def test_pp_llama_sliding_window():
-    """A windowed config trains windowed under pp: loss + grads match the
-    flat single-device windowed loss, and a custom attn_fn without window
-    support is rejected."""
+@pytest.mark.parametrize("n_chunks", [1, 2], ids=["plain", "interleaved"])
+def test_pp_llama_sliding_window(n_chunks):
+    """A windowed config trains windowed under pp — BOTH schedules: loss +
+    grads match the flat single-device windowed loss, and a custom attn_fn
+    without window support is rejected."""
     from starway_tpu.models import LlamaConfig, init_params
     from starway_tpu.models.llama import loss_fn as flat_loss
     from starway_tpu.models.pp_llama import (
-        make_pp_llama_train, pp_split_params, shard_pp_params)
+        make_pp_llama_train, pp_split_params, ppv_split_params,
+        shard_pp_params, shard_ppv_params)
     from starway_tpu.parallel import make_mesh
 
-    cfg = LlamaConfig.preset("debug", n_layers=2, d_model=64, n_heads=4,
-                             n_kv_heads=2, d_ff=96, vocab_size=128,
-                             sliding_window=4)
+    cfg = LlamaConfig.preset("debug", n_layers=2 * n_chunks, d_model=64,
+                             n_heads=4, n_kv_heads=2, d_ff=96,
+                             vocab_size=128, sliding_window=4)
     params = init_params(jax.random.PRNGKey(0), cfg)
     mesh = make_mesh({"pp": 2})
     batch = jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (4, 13), dtype=np.int32))
 
-    pp = shard_pp_params(pp_split_params(params, 2), mesh)
-    step = make_pp_llama_train(mesh, cfg, n_micro=2)
+    if n_chunks == 1:
+        pp = shard_pp_params(pp_split_params(params, 2), mesh)
+    else:
+        pp = shard_ppv_params(ppv_split_params(params, 2, 2), mesh)
+    step = make_pp_llama_train(mesh, cfg, n_micro=2, n_chunks=n_chunks)
     loss_pp, grads_pp = step(pp, batch)
     loss_ref, grads_ref = jax.value_and_grad(flat_loss)(params, batch, cfg)
     np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
@@ -312,7 +317,7 @@ def test_pp_llama_sliding_window():
         atol=2e-5, rtol=2e-4)
 
     with pytest.raises(ValueError, match="handles_window"):
-        make_pp_llama_train(mesh, cfg, n_micro=2,
+        make_pp_llama_train(mesh, cfg, n_micro=2, n_chunks=n_chunks,
                             attn_fn=lambda q, k, v: q)
 
 
