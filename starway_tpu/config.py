@@ -45,6 +45,17 @@ benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
     Minimum device payload size in bytes to use the pull path (default
     65536); smaller payloads ride the framed stream, where one small copy
     beats a pull round-trip.
+
+``STARWAY_DECODE_STREAM``
+    "1" (default) = the decode-attention kernel's streaming variant
+    (double-buffered manual DMA, ops/pallas_decode.py); "0" = the
+    grid-pipelined variant — the escape hatch if the manual-DMA lowering
+    misbehaves on a backend it has not been measured on.
+
+``STARWAY_SM_FORCE_ATOMICS``
+    "1" = route the Python sm ring's cursor ops through the native lib's
+    acquire/release atomics even on x86 (the off-x86 code path, made
+    testable on x86 CI; see core/shmring.py).
 """
 
 from __future__ import annotations

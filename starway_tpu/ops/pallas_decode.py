@@ -176,7 +176,7 @@ def _decode_stream_kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
 
 def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
                      block_k: int = 512, interpret=None, window=None,
-                     stream: bool = True):
+                     stream: "bool | None" = None):
     """Cached single-query attention without expanding the grouped cache.
 
     q: [B, Hq, 1, D]; k_cache/v_cache: [B, Hkv, T, D]; pos: scalar int or
@@ -188,12 +188,18 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
     [B, Hq, 1, D].  Numerically matches
     models/generate.py:_attend_cached (softmax in f32).
 
-    ``stream`` (default): the double-buffered single-cell kernel
+    ``stream`` (default True; ``STARWAY_DECODE_STREAM=0`` flips the
+    default — the manual-DMA lowering's escape hatch on hardware this
+    kernel has not run on yet): the double-buffered single-cell kernel
     (:func:`_decode_stream_kernel`) — b*hkv grid cells total, per-cell
     pipeline overhead independent of T.  ``stream=False`` keeps the
     grid-pipelined kernel (one cell per kv block); ``bench.py --kernels
     decode_tune`` sweeps both on-chip.
     """
+    if stream is None:
+        import os
+
+        stream = os.environ.get("STARWAY_DECODE_STREAM", "1") != "0"
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
     b, hq, one, d = q.shape
