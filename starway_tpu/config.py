@@ -70,6 +70,7 @@ __all__ = [
     "device_backend",
     "devpull_enabled",
     "devpull_threshold",
+    "decode_stream_enabled",
 ]
 
 
@@ -118,6 +119,10 @@ def advertised_host() -> str:
 
 def devpull_enabled() -> bool:
     return _env("STARWAY_DEVPULL", "1") != "0"
+
+
+def decode_stream_enabled() -> bool:
+    return _env("STARWAY_DECODE_STREAM", "1") != "0"
 
 
 def devpull_threshold() -> int:

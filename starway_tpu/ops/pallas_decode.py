@@ -197,9 +197,9 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
     decode_tune`` sweeps both on-chip.
     """
     if stream is None:
-        import os
+        from ..config import decode_stream_enabled
 
-        stream = os.environ.get("STARWAY_DECODE_STREAM", "1") != "0"
+        stream = decode_stream_enabled()
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
     b, hq, one, d = q.shape
