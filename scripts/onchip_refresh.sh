@@ -1,24 +1,34 @@
 #!/bin/bash
 # One-shot on-chip measurement queue: run when TPU hardware is reachable.
 #
-# Refreshes every row in BASELINE.md's round-2 table, including the items
-# the chip outage left pending (decode @ the new block_k=512 default,
-# the decode_tune sweep behind it, and the windowed flash row).  Each
-# section prints JSON rows; paste the results into BASELINE.md.
+# RESUMABLE: each section is skipped when $OUT already holds its success row
+# (an "error" row does not count), so after a mid-queue tunnel death the next
+# run goes straight to the still-pending rows.  The observed failure mode is
+# exactly that — the tunnel came back for ~25 min in round 3, measured six
+# rows, and died during decode_tune — so the queue is ordered fast/high-value
+# first (driver headline, numerics checks, MFU, serving) and leaves the
+# decode_tune sweep (pure retuning; the stream default already wins) for last.
 #
-# Usage:  bash scripts/onchip_refresh.sh [outfile]
+# Usage:  bash scripts/onchip_refresh.sh [outfile]     (default /tmp/onchip_rows.json)
+#         FORCE=1 re-measures everything regardless of existing rows.
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-/tmp/onchip_rows.json}"
-: > "$OUT"
+touch "$OUT"
 
 probe() {
   timeout 90 python -c "import jax, jax.numpy as j; float((j.ones(4)+1).sum())" \
     2>/dev/null || { echo "device backend unresponsive; aborting" >&2; exit 1; }
 }
 
-run() {  # [ROW_TIMEOUT=secs] run <which> [extra flags...]
-  local which="$1"; shift
+have() {  # have <metric>: a non-error row for <metric> is already recorded
+  [ "${FORCE:-0}" = "1" ] && return 1
+  grep "\"metric\": \"$1\"" "$OUT" | grep -qv '"error"'
+}
+
+run() {  # [ROW_TIMEOUT=secs] run <which> <done_metric> [extra flags...]
+  local which="$1" done_key="$2"; shift 2
+  if have "$done_key"; then echo "== $which (already measured; skip)" >&2; return; fi
   echo "== $which" >&2
   probe  # the tunnel can die mid-queue; fail fast, not per-row timeouts
   local log tmp rc t="${ROW_TIMEOUT:-1200}"
@@ -26,7 +36,9 @@ run() {  # [ROW_TIMEOUT=secs] run <which> [extra flags...]
   timeout "$t" python bench.py --kernels "$which" "$@" >"$tmp" 2>"$log"
   rc=$?
   grep '"metric"' "$tmp" | tee -a "$OUT"
-  if [ $rc -ne 0 ] || ! grep -q '"metric"' "$tmp"; then
+  # kernel_bench catches bench exceptions into {"error": ...} rows and exits
+  # 0 — an error row in the output is a failure too (keep the log).
+  if [ $rc -ne 0 ] || ! grep -q '"metric"' "$tmp" || grep -q '"error"' "$tmp"; then
     echo "{\"metric\": \"${which}\", \"error\": \"rc=$rc (124=timeout); see $log\"}" \
       | tee -a "$OUT" >&2
   else
@@ -36,23 +48,47 @@ run() {  # [ROW_TIMEOUT=secs] run <which> [extra flags...]
 }
 
 probe
-run matmul
-run flash
-run flash_window
-run flash_bwd
-run decode            # block_k=512 default: the row BASELINE.md flags as pending
-run decode_lax
-run decode_tune       # stream/grid variant x block sweep; retune the default
-run decode_shapes     # ours-vs-lax at the VERDICT r2 acceptance shapes
-run train_mfu
+
+# -- fast, high-value pending rows first ------------------------------------
+if have driver_headline; then
+  echo "== headline (already measured; skip)" >&2
+else
+  echo "== headline (driver bench.py)" >&2
+  tmp="$(mktemp)"
+  # bench.py's own watchdogs can burn 480s (device) + 240s (CPU retry);
+  # the outer timeout must sit above that sum or the fallback dies unreported.
+  timeout 780 python bench.py >"$tmp" 2>/dev/null
+  if grep -q vs_baseline "$tmp" && ! grep -q 'CPU FALLBACK\|FAILED' "$tmp"; then
+    tee -a "$OUT" < "$tmp"
+    # Marker row so resume can see the prose-named headline landed.
+    echo '{"metric": "driver_headline", "value": 1, "unit": "done"}' >> "$OUT"
+  else
+    cat "$tmp"; echo '{"metric": "driver_headline", "error": "fallback or no output"}' | tee -a "$OUT" >&2
+  fi
+  rm -f "$tmp"
+fi
+
+run check            check_flash_fwd_onchip             # 6 on-chip numerics rows
+run train_mfu        train_step_mfu
+run serve            serve_llama_b1_tokens_per_s        # end-to-end generate() tok/s (VERDICT r3 #4)
+run serve_b8         serve_llama_b8_tokens_per_s
+run serve_mistral    serve_mistral_b1_tokens_per_s      # rolling O(window) cache path
+run serve_ragged_b8  serve_llama_ragged_b8_tokens_per_s # mixed prompt lengths
+run serve_continuous serve_continuous_tokens_per_s      # wall-clock through slot reuse
 # 672M-param compiles x two differenced loop lengths can exceed the default
 # row timeout; give this one headroom.
-ROW_TIMEOUT=3000 run train_mfu_large  # model-scale MFU (target >= 0.40)
-run serve             # end-to-end generate() tokens/s (VERDICT r3 #4) ...
-run serve_b8          # ... batch 8
-run serve_ragged_b8   # ... ragged (mixed prompt lengths)
-run serve_mistral     # ... rolling O(window) cache path
-run serve_continuous  # continuous batching: wall tok/s through slot reuse
-echo "== check" >&2
-timeout 1200 python bench.py --kernels check 2>/dev/null | grep '"metric"' | tee -a "$OUT"
+ROW_TIMEOUT=3000 run train_mfu_large train_step_mfu_large  # model-scale MFU (target >= 0.40)
+run decode_shapes    decode_shape_wins                  # ours-vs-lax at the r2 acceptance shapes
+
+# -- re-confirmation rows (captured 2026-07-31; skipped unless FORCE=1) -----
+run matmul       matmul_ceiling_tflops
+run flash        flash_fwd_ours_tflops
+run flash_window flash_window_tflops
+run flash_bwd    flash_fwdbwd_ours_tflops
+run decode       decode_ours_us_per_token   # stream default: beats lax 2.30x
+run decode_lax   decode_lax_us_per_token
+
+# -- slow optimization sweep last (stream already wins at its default) ------
+ROW_TIMEOUT=2400 run decode_tune decode_best_config
+
 echo "rows written to $OUT" >&2
