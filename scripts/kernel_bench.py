@@ -242,22 +242,37 @@ def _decode_inputs(b, hq, hkv, t, d):
 def bench_decode(b=1, hq=8, hkv=2, t=8192, d=128, iters: int = 64, impl="ours"):
     """Cached single-token decode attention: us/token + effective HBM GB/s
     (decode is bandwidth-bound: the kernel's job is streaming the grouped
-    cache exactly once)."""
+    cache exactly once).  ``impl="int8"``: the quantized-cache path — half
+    the bytes stream, dequant folded into the kernel (ops/quantize.py)."""
     from starway_tpu.models.generate import _attend_cached
 
     q, kc, vc, pos, cache_bytes = _decode_inputs(b, hq, hkv, t, d)
 
-    use_pallas = impl == "ours"
+    if impl == "int8":
+        from starway_tpu.ops.pallas_decode import decode_attention
+        from starway_tpu.ops.quantize import quantize_kv
 
-    def kern(q, kc, vc):
-        return _attend_cached(q, kc, vc, pos, hq // hkv, use_pallas=use_pallas)
+        kc, ks = quantize_kv(kc)
+        vc, vs = quantize_kv(vc)
+        # int8 cache + f32 scales: (1 + 4/D) bytes per former bf16 2 bytes.
+        cache_bytes = cache_bytes // 2 + 2 * b * hkv * t * 4
+
+        def kern(q, kc, vc):
+            return decode_attention(q, kc, vc, pos, k_scale=ks, v_scale=vs)
+    else:
+        use_pallas = impl == "ours"
+
+        def kern(q, kc, vc):
+            return _attend_cached(q, kc, vc, pos, hq // hkv,
+                                  use_pallas=use_pallas)
 
     dt = _timeit(lambda q, kc, vc, iters: _chain(kern, q, kc, vc, iters=iters),
                  q, kc, vc, iters=iters)
     return {"metric": f"decode_{impl}_us_per_token", "value": round(dt * 1e6, 2),
             "unit": "us",
-            "detail": f"B={b} Hq={hq} Hkv={hkv} T={t} D={d} bf16, grouped "
-                      f"cache {cache_bytes / 1e6:.1f} MB -> "
+            "detail": f"B={b} Hq={hq} Hkv={hkv} T={t} D={d} "
+                      f"{'int8 cache' if impl == 'int8' else 'bf16'}, "
+                      f"streamed bytes {cache_bytes / 1e6:.1f} MB -> "
                       f"{cache_bytes / dt / 1e9:.0f} GB/s effective"}
 
 
@@ -488,7 +503,7 @@ def bench_decode_tune(b=1, hq=8, hkv=2, t=8192, d=128, iters: int = 64):
 
 
 def bench_serve(batch=1, model="llama", ragged=False, prompt_len=512,
-                m_lo=32, m_hi=1056, reps=4, iters=None):
+                m_lo=32, m_hi=1056, reps=4, iters=None, kv_quant="none"):
     """End-to-end serving throughput: tokens/s for the REAL ``generate()``
     surface (flash prefill + cached decode scan + top-k/top-p sampling; the
     Mistral variant decodes through the O(window) rolling cache).
@@ -510,7 +525,7 @@ def bench_serve(batch=1, model="llama", ragged=False, prompt_len=512,
     from starway_tpu.models.generate import generate
 
     kw = dict(d_model=1024, n_layers=8, n_heads=8, n_kv_heads=2, d_ff=2816,
-              vocab_size=32000, dtype="bfloat16")
+              vocab_size=32000, dtype="bfloat16", kv_quant=kv_quant)
     if model == "mistral":
         # Window < max_len: the aligned path decodes through the rolling
         # O(window) cache (bit-identical to full-cache, pinned by tests).
@@ -535,7 +550,8 @@ def bench_serve(batch=1, model="llama", ragged=False, prompt_len=512,
                        prompt_lengths=lengths)
         jax.block_until_ready(out)
 
-    name = f"serve_{model}{'_ragged' if ragged else ''}_b{batch}"
+    name = (f"serve_{model}{'_ragged' if ragged else ''}"
+            f"{'_int8' if kv_quant == 'int8' else ''}_b{batch}")
     # Jitter guard (same concern _timeit documents: tens-of-ms tunnel
     # jitter): grow the hi/lo gap until the differenced time comfortably
     # clears it, and REFUSE to report a number when it never does — a
@@ -635,12 +651,15 @@ BENCHES = {
     "flash_bwd_stock": functools.partial(bench_flash_bwd, impl="stock"),
     "decode": bench_decode,
     "decode_lax": functools.partial(bench_decode, impl="lax"),
+    "decode_int8": functools.partial(bench_decode, impl="int8"),
     "decode_tune": bench_decode_tune,
     "decode_shapes": bench_decode_shapes,
     "train_mfu": bench_train_mfu,
     "train_mfu_large": bench_train_mfu_large,
     "serve": bench_serve,
     "serve_b8": functools.partial(bench_serve, batch=8),
+    "serve_int8_b8": functools.partial(bench_serve, batch=8,
+                                       kv_quant="int8"),
     "serve_ragged_b8": functools.partial(bench_serve, batch=8, ragged=True),
     "serve_mistral": functools.partial(bench_serve, model="mistral"),
     "serve_continuous": bench_serve_continuous,
@@ -667,7 +686,8 @@ def main():
         # `bench.py --kernels` pass from minutes to an hour behind the
         # tunnel.  onchip_refresh.sh runs them individually.
         heavy = ("serve", "serve_b8", "serve_ragged_b8", "serve_mistral",
-                 "serve_continuous", "train_mfu_large", "decode_shapes")
+                 "serve_int8_b8", "serve_continuous", "train_mfu_large",
+                 "decode_shapes")
         names = [n for n in BENCHES
                  if not n.endswith("_tune") and n not in heavy]
     else:

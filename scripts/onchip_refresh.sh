@@ -14,7 +14,11 @@
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-/tmp/onchip_rows.json}"
-touch "$OUT"
+if [ "${FORCE:-0}" = "1" ]; then
+  : > "$OUT"  # a re-measure must not leave two conflicting rows per metric
+else
+  touch "$OUT"
+fi
 
 probe() {
   timeout 90 python -c "import jax, jax.numpy as j; float((j.ones(4)+1).sum())" \
@@ -75,6 +79,8 @@ run serve_b8         serve_llama_b8_tokens_per_s
 run serve_mistral    serve_mistral_b1_tokens_per_s      # rolling O(window) cache path
 run serve_ragged_b8  serve_llama_ragged_b8_tokens_per_s # mixed prompt lengths
 run serve_continuous serve_continuous_tokens_per_s      # wall-clock through slot reuse
+run decode_int8      decode_int8_us_per_token           # half-width int8 cache stream
+run serve_int8_b8    serve_llama_int8_b8_tokens_per_s   # int8 cache end to end
 # 672M-param compiles x two differenced loop lengths can exceed the default
 # row timeout; give this one headroom.
 ROW_TIMEOUT=3000 run train_mfu_large train_step_mfu_large  # model-scale MFU (target >= 0.40)

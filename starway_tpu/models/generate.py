@@ -25,8 +25,22 @@ from ..ops.attention import NEG_BIG, repeat_kv
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
+    """Decode cache: ``k/v [n_layers, B, Hkv, max_len, head_dim]``.
+
+    ``cfg.kv_quant == "int8"`` stores k/v as int8 plus per-token f32 scales
+    ``k_scale/v_scale [n_layers, B, Hkv, max_len]`` (ops/quantize.py) —
+    half the HBM bytes on the bandwidth-bound decode stream.  The scale
+    keys' presence IS the format marker every consumer dispatches on.
+    """
     hd = cfg.head_dim
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, hd)
+    if cfg.kv_quant == "int8":
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1], jnp.float32),
+        }
     return {
         "k": jnp.zeros(shape, cfg.compute_dtype),
         "v": jnp.zeros(shape, cfg.compute_dtype),
@@ -43,11 +57,13 @@ def init_rolling_cache(cfg: LlamaConfig, batch: int) -> dict:
 
 
 def _attend_cached(q, k_cache, v_cache, pos, n_rep, use_pallas=None,
-                   window=None):
+                   window=None, k_scale=None, v_scale=None):
     """q: [B, Hq, 1, D]; caches: [B, Hkv, T, D]; mask positions > pos.
     ``pos`` is a scalar or a per-row [B] vector (ragged batches);
     ``window`` restricts to the last ``window`` positions (sliding-window
-    models).
+    models).  ``k_scale``/``v_scale`` ([B, Hkv, T] f32): the caches are
+    int8-quantized (ops/quantize.py) — the kernel streams them at half
+    width; the lax path dequantizes up front.
 
     On TPU the pallas decode kernel (ops/pallas_decode.py) streams the
     grouped cache once instead of materialising ``repeat_kv`` — an
@@ -59,7 +75,13 @@ def _attend_cached(q, k_cache, v_cache, pos, n_rep, use_pallas=None,
     if use_pallas:
         from ..ops.pallas_decode import decode_attention
 
-        return decode_attention(q, k_cache, v_cache, pos, window=window)
+        return decode_attention(q, k_cache, v_cache, pos, window=window,
+                                k_scale=k_scale, v_scale=v_scale)
+    if k_scale is not None:
+        from ..ops.quantize import dequantize_kv
+
+        k_cache = dequantize_kv(k_cache, k_scale, q.dtype)
+        v_cache = dequantize_kv(v_cache, v_scale, q.dtype)
     k = repeat_kv(k_cache, n_rep)
     v = repeat_kv(v_cache, n_rep)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
@@ -123,26 +145,43 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
             return lax.dynamic_update_slice_in_dim(c, u, slot, axis=2)
 
     h = params["embed"][token][:, None, :]  # [B, 1, D]
+    quant = "k_scale" in cache  # int8 cache (init_cache's format marker)
 
-    def layer(carry, lp_and_cache):
+    def layer(carry, xs):
         h, = carry
-        lp, kc, vc = lp_and_cache
+        if quant:
+            lp, kc, vc, ksc, vsc = xs
+        else:
+            lp, kc, vc = xs
+            ksc = vsc = None
         x = rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
         q = (x @ lp["wq"]).reshape(B, 1, cfg.n_heads, hd).transpose(0, 2, 1, 3)
         k = (x @ lp["wk"]).reshape(B, 1, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
         v = (x @ lp["wv"]).reshape(B, 1, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
         q = apply_rope(q, cos_p, sin_p)
         k = apply_rope(k, cos_p, sin_p)
+        if quant:
+            from ..ops.quantize import quantize_kv
+
+            # Quantize-on-write: the cache never holds a wide entry.  The
+            # scale caches share write() — the T axis sits at the same
+            # index once the trailing D dim is dropped.
+            k, k_s = quantize_kv(k)
+            v, v_s = quantize_kv(v)
+            ksc = write(ksc, k_s)
+            vsc = write(vsc, v_s)
         kc = write(kc, k)
         vc = write(vc, v)
         if rolling:
             # Warm slots are exactly the window (we just overwrote the
             # oldest); cold-start slots (> pos) are masked by the clamped
             # position.  No window re-mask: absolute order is irrelevant.
-            o = _attend_cached(q, kc, vc, jnp.minimum(pos, T - 1), n_rep)
+            o = _attend_cached(q, kc, vc, jnp.minimum(pos, T - 1), n_rep,
+                               k_scale=ksc, v_scale=vsc)
         else:
             o = _attend_cached(q, kc, vc, pos, n_rep,
-                               window=cfg.sliding_window)
+                               window=cfg.sliding_window,
+                               k_scale=ksc, v_scale=vsc)
         o = o.transpose(0, 2, 1, 3).reshape(B, 1, cfg.n_heads * hd)
         h = h + o @ lp["wo"]
 
@@ -158,14 +197,18 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
         else:
             gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
             h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
-        return (h,), (kc, vc)
+        return (h,), (kc, vc) + ((ksc, vsc) if quant else ())
 
-    (h,), (k_new, v_new) = lax.scan(
-        layer, (h,), (params["layers"], cache["k"], cache["v"])
-    )
+    xs = (params["layers"], cache["k"], cache["v"])
+    if quant:
+        xs += (cache["k_scale"], cache["v_scale"])
+    (h,), new = lax.scan(layer, (h,), xs)
     h = rmsnorm(h, params["final_norm"], cfg.norm_eps)
     logits = (h[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": k_new, "v": v_new}
+    out = {"k": new[0], "v": new[1]}
+    if quant:
+        out["k_scale"], out["v_scale"] = new[2], new[3]
+    return logits, out
 
 
 def prefill(params: dict, cfg: LlamaConfig, prompt,
@@ -193,11 +236,21 @@ def prefill(params: dict, cfg: LlamaConfig, prompt,
         params, prompt, cfg, attn_fn, return_aux=True, return_kv=True,
         last_only=logit_positions is None, logit_positions=logit_positions,
     )
+    cache = {"k": ks, "v": vs}
+    if cfg.kv_quant == "int8":
+        from ..ops.quantize import quantize_kv
+
+        cache["k"], cache["k_scale"] = quantize_kv(ks)
+        cache["v"], cache["v_scale"] = quantize_kv(vs)
     pad = max_len - P
     if pad:
-        ks = jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
-        vs = jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
-    return logits[:, 0], {"k": ks, "v": vs}
+        # Every leaf's T axis sits at index 3 (the scale arrays only drop
+        # the trailing D dim) — same invariant the rolling gather relies on.
+        cache = jax.tree_util.tree_map(
+            lambda a: jnp.pad(
+                a, ((0, 0),) * 3 + ((0, pad),) + ((0, 0),) * (a.ndim - 4)),
+            cache)
+    return logits[:, 0], cache
 
 
 def prefill_rolling(params: dict, cfg: LlamaConfig, prompt, *,
@@ -233,6 +286,14 @@ def prefill_rolling(params: dict, cfg: LlamaConfig, prompt, *,
         raise ValueError("prefill_rolling requires cfg.sliding_window")
     if attn_fn is not None:
         raise ValueError("prefill_rolling owns its attention; attn_fn must be None")
+    if cfg.kv_quant != "none":
+        # The chunk step's circular writes and cache-aware attention read
+        # wide k/v; quantized chunked prefill needs its own dequant-merge
+        # pass and is not wired yet.
+        raise NotImplementedError(
+            "prefill_rolling does not support kv_quant yet; use the "
+            "aligned generate() path (full or rolling decode both handle "
+            "int8 caches)")
     B, P = prompt.shape
     cos, sin = rope_tables(P, cfg.head_dim, cfg.rope_theta)
     cache = init_rolling_cache(cfg, B)
@@ -397,10 +458,12 @@ def _compiled_generate(cfg: LlamaConfig, B: int, P: int, max_new: int,
                 logits, cache = prefill(params, cfg, prompt, W)
             else:
                 logits, cache = prefill(params, cfg, prompt, P)  # unpadded
-                # Keep the last W positions, each at its slot p % W.
+                # Keep the last W positions, each at its slot p % W.  The T
+                # axis sits at index 3 for every cache leaf (k/v AND the
+                # int8 format's scale arrays, which only drop trailing D).
                 src = (P - W) + ((jnp.arange(W) - (P - W)) % W)
-                cache = {"k": jnp.take(cache["k"], src, axis=3),
-                         "v": jnp.take(cache["v"], src, axis=3)}
+                cache = jax.tree_util.tree_map(
+                    lambda a: jnp.take(a, src, axis=3), cache)
             pos0 = jnp.asarray(P, jnp.int32)
         elif ragged:
             # Right-padded prompts: causal attention already confines every

@@ -54,11 +54,18 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
     moe_top_k: int = 1
+    # KV-cache storage: "none" keeps compute_dtype; "int8" stores the cache
+    # int8 with per-token scales (ops/quantize.py) — half the HBM bytes on
+    # the bandwidth-bound decode stream, double the servable context.
+    kv_quant: str = "none"
 
     def __post_init__(self):
         if self.sliding_window is not None and self.sliding_window < 1:
             raise ValueError(
                 f"sliding_window must be >= 1, got {self.sliding_window}")
+        if self.kv_quant not in ("none", "int8"):
+            raise ValueError(
+                f"kv_quant must be 'none' or 'int8', got {self.kv_quant!r}")
 
     @property
     def head_dim(self) -> int:

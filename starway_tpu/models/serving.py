@@ -67,12 +67,13 @@ def _bucket(n: int, buckets) -> int:
 def _write_slot_and_sample(cache, small, logits, slot, key, temperature,
                            top_k, top_p):
     """Shared tail of BOTH admission paths: file one request's [L, 1, Hkv,
-    T', D] cache rows into the slot and sample its first token."""
+    T', D] cache rows into the slot and sample its first token.  Writes
+    every cache leaf — the int8 format's [L, 1, Hkv, T'] scale arrays ride
+    along (the slot axis sits at index 1 in all of them)."""
     cache = {
-        "k": lax.dynamic_update_slice(
-            cache["k"], small["k"], (0, slot, 0, 0, 0)),
-        "v": lax.dynamic_update_slice(
-            cache["v"], small["v"], (0, slot, 0, 0, 0)),
+        name: lax.dynamic_update_slice(
+            cache[name], small[name], (0, slot) + (0,) * (cache[name].ndim - 2))
+        for name in cache
     }
     tok = _sample(logits, key, temperature, top_k, top_p)[0]
     return cache, tok
@@ -198,6 +199,14 @@ class SlotServer:
                 "shared batch-wide, so cohabiting slots would perturb each "
                 "other's routing (same restriction as ragged generate())")
         self.rolling = cfg.sliding_window is not None
+        if self.rolling and cfg.kv_quant != "none":
+            # Fail at construction, not at first admission: rolling
+            # admission runs through prefill_rolling, which has no
+            # quantized chunk step yet.
+            raise NotImplementedError(
+                "rolling (sliding-window) continuous batching does not "
+                "support kv_quant yet; serve the windowed model with "
+                "kv_quant='none' or drop sliding_window")
         if n_slots < 1 or chunk < 1:
             # Zero slots/chunk would make run() spin forever, not error.
             raise ValueError(f"need n_slots >= 1 and chunk >= 1, got "

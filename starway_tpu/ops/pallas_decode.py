@@ -46,13 +46,25 @@ from .pallas_attention import _round_up
 
 
 def _softmax_block_update(q, k, v, k_start, pos, m_scr, l_scr, acc_scr, *,
-                          sm_scale: float, window: "int | None"):
+                          sm_scale: float, window: "int | None",
+                          k_scale=None, v_scale=None):
     """The one online-softmax block body both kernel variants share: score
     the group's query rows against one [block_k, D] cache block, mask by
-    global position (and window), and fold into the m/l/acc scratches."""
+    global position (and window), and fold into the m/l/acc scratches.
+
+    ``k_scale``/``v_scale`` ([block_k] f32, int8 cache): dequantization is
+    folded into the existing algebra instead of widening the operands —
+    k's scale multiplies the score COLUMNS (``(q . k_int8[c]) * s_k[c]``)
+    and v's scale folds into the softmax weights before the ``p @ v``
+    matmul, so no dequantized [block_k, D] tile is ever materialised."""
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * sm_scale  # [rows, block_k]
+        q, k.astype(q.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [rows, block_k]
+    if k_scale is not None:
+        s = s * (k_scale[None, :] * sm_scale)
+    else:
+        s = s * sm_scale
     kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     keep = kv_pos <= pos
     if window is not None:
@@ -64,17 +76,25 @@ def _softmax_block_update(q, k, v, k_start, pos, m_scr, l_scr, acc_scr, *,
     p = jnp.where(s > NEG_BIG / 2, jnp.exp(s - m_new), 0.0)
     corr = jnp.exp(m_prev - m_new)
     l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+    pv_dtype = q.dtype
+    if v_scale is not None:
+        p = p * v_scale[None, :]
     acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        p.astype(pv_dtype), v.astype(pv_dtype), (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
     l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                   *, sm_scale: float, block_k: int, hkv: int,
-                   window: "int | None"):
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *refs, sm_scale: float,
+                   block_k: int, hkv: int, window: "int | None",
+                   quant: bool = False):
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = refs
     ki = pl.program_id(1)
     n_k = pl.num_programs(1)
 
@@ -98,17 +118,19 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
     def _body():
         _softmax_block_update(
             q_ref[0], k_ref[0], v_ref[0], k_start, pos, m_scr, l_scr,
-            acc_scr, sm_scale=sm_scale, window=window)
+            acc_scr, sm_scale=sm_scale, window=window,
+            k_scale=None if ks_ref is None else ks_ref[0],
+            v_scale=None if vs_ref is None else vs_ref[0])
 
     @pl.when(ki == n_k - 1)
     def _finalize():
         o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
 
 
-def _decode_stream_kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
-                          sems, m_scr, l_scr, acc_scr, *, sm_scale: float,
-                          block_k: int, hkv: int, window: "int | None",
-                          n_blocks: int):
+def _decode_stream_kernel(pos_ref, q_ref, k_hbm, v_hbm, *refs,
+                          sm_scale: float, block_k: int, hkv: int,
+                          window: "int | None", n_blocks: int,
+                          quant: bool = False):
     """One grid cell per (batch, kv head): the WHOLE cache sweep runs in a
     single cell as a fori_loop over kv blocks with double-buffered manual
     DMA (compute on block i overlaps the HBM stream of block i+1).
@@ -119,7 +141,17 @@ def _decode_stream_kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
     the overhead term is gone and the kernel's time is the max of the DMA
     stream (~cache bytes / HBM bandwidth) and the (tiny) grouped-GQA
     matmuls.
+
+    ``quant``: two extra HBM inputs (per-token f32 scales) and two extra
+    scratch buffers ride the same double-buffered pipeline; the int8 cache
+    blocks halve the DMA bytes (the scales add 1/(2*D) back).
     """
+    if quant:
+        (ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf, sems, m_scr,
+         l_scr, acc_scr) = refs
+    else:
+        ks_hbm = vs_hbm = ks_buf = vs_buf = None
+        o_ref, k_buf, v_buf, sems, m_scr, l_scr, acc_scr = refs
     bh = pl.program_id(0)
     pos = pos_ref[bh // hkv]
     hi = pos // block_k  # last live block
@@ -128,21 +160,29 @@ def _decode_stream_kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
     else:
         lo = jnp.maximum(pos - window + 1, 0) // block_k
 
-    def kcp(i, slot):
-        return pltpu.make_async_copy(
-            k_hbm.at[bh, pl.ds(i * block_k, block_k)], k_buf.at[slot],
-            sems.at[slot, 0])
-
-    def vcp(i, slot):
-        return pltpu.make_async_copy(
-            v_hbm.at[bh, pl.ds(i * block_k, block_k)], v_buf.at[slot],
-            sems.at[slot, 1])
+    def copies(i, slot):
+        cps = [
+            pltpu.make_async_copy(
+                k_hbm.at[bh, pl.ds(i * block_k, block_k)], k_buf.at[slot],
+                sems.at[slot, 0]),
+            pltpu.make_async_copy(
+                v_hbm.at[bh, pl.ds(i * block_k, block_k)], v_buf.at[slot],
+                sems.at[slot, 1]),
+        ]
+        if quant:
+            cps.append(pltpu.make_async_copy(
+                ks_hbm.at[bh, pl.ds(i * block_k, block_k)], ks_buf.at[slot],
+                sems.at[slot, 2]))
+            cps.append(pltpu.make_async_copy(
+                vs_hbm.at[bh, pl.ds(i * block_k, block_k)], vs_buf.at[slot],
+                sems.at[slot, 3]))
+        return cps
 
     m_scr[:] = jnp.full_like(m_scr, NEG_BIG)
     l_scr[:] = jnp.zeros_like(l_scr)
     acc_scr[:] = jnp.zeros_like(acc_scr)
-    kcp(lo, 0).start()
-    vcp(lo, 0).start()
+    for cp in copies(lo, 0):
+        cp.start()
     q = q_ref[0]  # [rows, D] — the group's query heads (padded to tile)
 
     # STATIC trip count with liveness guards (not a dynamic-bound loop —
@@ -159,14 +199,16 @@ def _decode_stream_kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
             @pl.when(i + 1 <= hi)
             def _prefetch():
                 ns = jax.lax.rem(i + 1 - lo, 2)
-                kcp(i + 1, ns).start()
-                vcp(i + 1, ns).start()
+                for cp in copies(i + 1, ns):
+                    cp.start()
 
-            kcp(i, slot).wait()
-            vcp(i, slot).wait()
+            for cp in copies(i, slot):
+                cp.wait()
             _softmax_block_update(
                 q, k_buf[slot], v_buf[slot], i * block_k, pos, m_scr, l_scr,
-                acc_scr, sm_scale=sm_scale, window=window)
+                acc_scr, sm_scale=sm_scale, window=window,
+                k_scale=None if not quant else ks_buf[slot],
+                v_scale=None if not quant else vs_buf[slot])
 
         return 0
 
@@ -176,7 +218,8 @@ def _decode_stream_kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
 
 def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
                      block_k: int = 512, interpret=None, window=None,
-                     stream: "bool | None" = None):
+                     stream: "bool | None" = None, k_scale=None,
+                     v_scale=None):
     """Cached single-query attention without expanding the grouped cache.
 
     q: [B, Hq, 1, D]; k_cache/v_cache: [B, Hkv, T, D]; pos: scalar int or
@@ -187,6 +230,11 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
     streams ~window bytes of cache regardless of T.  Returns
     [B, Hq, 1, D].  Numerically matches
     models/generate.py:_attend_cached (softmax in f32).
+
+    ``k_scale``/``v_scale`` ([B, Hkv, T] f32): int8-quantized caches
+    (ops/quantize.py) — the kernel streams the int8 blocks (half the HBM
+    bytes of bf16) and folds dequantization into the score/weight algebra;
+    both or neither must be given, matching the caches' int8 dtype.
 
     ``stream`` (default True; ``STARWAY_DECODE_STREAM=0`` flips the
     default — the manual-DMA lowering's escape hatch on hardware this
@@ -202,6 +250,15 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
         stream = decode_stream_enabled()
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
+    quant = k_scale is not None or v_scale is not None
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError("int8 caches need BOTH k_scale and v_scale")
+    for name, c in (("k_cache", k_cache), ("v_cache", v_cache)):
+        if quant != (c.dtype == jnp.int8):
+            raise ValueError(
+                f"{name} dtype {c.dtype} inconsistent with "
+                f"{'present' if quant else 'absent'} scales (int8 caches "
+                f"carry per-token scales; see ops/quantize.py)")
     b, hq, one, d = q.shape
     assert one == 1, "decode kernel takes a single query position"
     hkv, t = k_cache.shape[1], k_cache.shape[2]
@@ -227,29 +284,42 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
     if t_pad != t:
         kf = jnp.pad(kf, ((0, 0), (0, t_pad - t), (0, 0)))
         vf = jnp.pad(vf, ((0, 0), (0, t_pad - t), (0, 0)))
+    scales = []
+    if quant:
+        for s in (k_scale, v_scale):
+            sf = s.astype(jnp.float32).reshape(b * hkv, t)
+            if t_pad != t:
+                sf = jnp.pad(sf, ((0, 0), (0, t_pad - t)))
+            scales.append(sf)
 
     pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
 
     if stream:
+        any_spec = pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY)
+        quant_scratch = [
+            pltpu.VMEM((2, block_k), jnp.float32),
+            pltpu.VMEM((2, block_k), jnp.float32),
+        ] if quant else []
         out = pl.pallas_call(
             functools.partial(
                 _decode_stream_kernel, sm_scale=sm_scale, block_k=block_k,
                 hkv=hkv, window=None if window is None else int(window),
-                n_blocks=t_pad // block_k),
+                n_blocks=t_pad // block_k, quant=quant),
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1,
                 grid=(b * hkv,),
                 in_specs=[
                     pl.BlockSpec((1, rows, d), lambda bh, pos_ref: (bh, 0, 0)),
-                    pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-                    pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-                ],
+                    any_spec,
+                    any_spec,
+                ] + [any_spec] * (2 * quant),
                 out_specs=pl.BlockSpec((1, rows, d),
                                        lambda bh, pos_ref: (bh, 0, 0)),
                 scratch_shapes=[
                     pltpu.VMEM((2, block_k, d), kf.dtype),
                     pltpu.VMEM((2, block_k, d), vf.dtype),
-                    pltpu.SemaphoreType.DMA((2, 2)),
+                ] + quant_scratch + [
+                    pltpu.SemaphoreType.DMA((2, 4 if quant else 2)),
                     pltpu.VMEM((rows, 128), jnp.float32),
                     pltpu.VMEM((rows, 128), jnp.float32),
                     pltpu.VMEM((rows, d), jnp.float32),
@@ -257,7 +327,7 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
             ),
             out_shape=jax.ShapeDtypeStruct((b * hkv, rows, d), q.dtype),
             interpret=interpret,
-        )(pos_arr, qf, kf, vf)
+        )(pos_arr, qf, kf, vf, *scales)
         return out.reshape(b, hkv, rows, d)[:, :, :n_rep, :].reshape(
             b, hq, 1, d)
 
@@ -276,9 +346,14 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
         lo = jnp.maximum(p - window + 1, 0) // block_k
         return (bh, jnp.clip(ki, lo, hi), 0)
 
+    def _scale_index(bh, ki, pos_ref):
+        bh_, ki_, _ = _kv_index(bh, ki, pos_ref)
+        return (bh_, ki_)
+
     out = pl.pallas_call(
         functools.partial(_decode_kernel, sm_scale=sm_scale, block_k=block_k,
-                          hkv=hkv, window=None if window is None else int(window)),
+                          hkv=hkv, window=None if window is None else int(window),
+                          quant=quant),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
@@ -286,7 +361,7 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
                 pl.BlockSpec((1, rows, d), lambda bh, ki, pos_ref: (bh, 0, 0)),
                 pl.BlockSpec((1, block_k, d), _kv_index),
                 pl.BlockSpec((1, block_k, d), _kv_index),
-            ],
+            ] + [pl.BlockSpec((1, block_k), _scale_index)] * (2 * quant),
             out_specs=pl.BlockSpec((1, rows, d), lambda bh, ki, pos_ref: (bh, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((rows, 128), jnp.float32),
@@ -296,5 +371,5 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
         ),
         out_shape=jax.ShapeDtypeStruct((b * hkv, rows, d), q.dtype),
         interpret=interpret,
-    )(pos_arr, qf, kf, vf)
+    )(pos_arr, qf, kf, vf, *scales)
     return out.reshape(b, hkv, rows, d)[:, :, :n_rep, :].reshape(b, hq, 1, d)

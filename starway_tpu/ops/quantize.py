@@ -1,0 +1,52 @@
+"""Symmetric int8 quantization for the KV cache.
+
+Single-token decode streams the whole KV cache through the core once per
+generated token — it is HBM-bandwidth-bound (BASELINE.md: the bf16 decode
+kernel runs at ~390 GB/s effective), so halving the cache's bytes is worth
+~2x on the decode step and doubles the context a chip can serve.  The
+scheme is the standard serving-stack one (per-token, per-head symmetric
+int8): each cached [head_dim] vector x is stored as
+
+    q = round(x / s),  s = max(|x|) / 127        (s in f32, q in int8)
+
+Dequantization never materialises a wide cache in HBM or VMEM: the decode
+kernel streams int8 blocks, folds ``k``'s scale into the score columns
+(``(q . k_int8) * s_k``) and ``v``'s scale into the softmax weights before
+the ``p @ v`` matmul (ops/pallas_decode.py) — the operands widen to the
+compute dtype only inside the matmul itself, so the bandwidth-bound part
+(the HBM/VMEM stream) stays at half width.  Accuracy: worst-case
+per-element error is ``s/2 = amax/254`` (~0.4% of the vector's max); the
+f32 softmax chain is unchanged.
+
+No reference counterpart (/root/reference is a transport library); this is
+the TPU build's own serving-stack extension, following the public KV-cache
+quantization recipe used by mainstream inference engines.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+INT8_MAX = 127.0
+
+
+def quantize_kv(x):
+    """Quantize along the last axis: ``x [..., D]`` -> ``(q int8 [..., D],
+    scale f32 [...])`` with ``x ~= q * scale[..., None]``.
+
+    All-zero vectors (e.g. the cache's zero-initialised / padded slots) get
+    scale 0 and quantize to zeros — dequantization returns exact zeros, so
+    padding stays inert.
+    """
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = amax / INT8_MAX
+    # Avoid 0/0 on all-zero vectors; where scale == 0 the numerator is 0 too.
+    div = jnp.where(scale > 0.0, scale, 1.0)[..., None]
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / div), -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(q, scale, dtype=jnp.bfloat16):
+    """Inverse of :func:`quantize_kv` (up to rounding): ``q int8 [..., D]``
+    times ``scale [...]`` broadcast over the last axis."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
