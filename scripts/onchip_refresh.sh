@@ -14,11 +14,7 @@
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-/tmp/onchip_rows.json}"
-if [ "${FORCE:-0}" = "1" ]; then
-  : > "$OUT"  # a re-measure must not leave two conflicting rows per metric
-else
-  touch "$OUT"
-fi
+touch "$OUT"
 
 probe() {
   timeout 90 python -c "import jax, jax.numpy as j; float((j.ones(4)+1).sum())" \
@@ -52,6 +48,12 @@ run() {  # [ROW_TIMEOUT=secs] run <which> <done_metric> [extra flags...]
 }
 
 probe
+if [ "${FORCE:-0}" = "1" ]; then
+  # A re-measure must not leave two conflicting rows per metric — but only
+  # drop the old rows once the device has answered a probe, so a dead
+  # tunnel cannot destroy measured results while measuring nothing.
+  : > "$OUT"
+fi
 
 # -- fast, high-value pending rows first ------------------------------------
 if have driver_headline; then

@@ -286,14 +286,6 @@ def prefill_rolling(params: dict, cfg: LlamaConfig, prompt, *,
         raise ValueError("prefill_rolling requires cfg.sliding_window")
     if attn_fn is not None:
         raise ValueError("prefill_rolling owns its attention; attn_fn must be None")
-    if cfg.kv_quant != "none":
-        # The chunk step's circular writes and cache-aware attention read
-        # wide k/v; quantized chunked prefill needs its own dequant-merge
-        # pass and is not wired yet.
-        raise NotImplementedError(
-            "prefill_rolling does not support kv_quant yet; use the "
-            "aligned generate() path (full or rolling decode both handle "
-            "int8 caches)")
     B, P = prompt.shape
     cos, sin = rope_tables(P, cfg.head_dim, cfg.rope_theta)
     cache = init_rolling_cache(cfg, B)
@@ -352,6 +344,8 @@ def _compiled_prefill_chunk(cfg: LlamaConfig):
     W = cfg.sliding_window
     n_rep = cfg.n_heads // cfg.n_kv_heads
 
+    quant = cfg.kv_quant == "int8"
+
     def run_chunk(params, cache, tokens_c, c0, cos_c, sin_c):
         """One chunk through every layer; returns (h, new cache)."""
         Cc = tokens_c.shape[1]
@@ -362,13 +356,22 @@ def _compiled_prefill_chunk(cfg: LlamaConfig):
         order = (c0 - W + jnp.arange(W)) % W
         h = params["embed"][tokens_c]  # [B, Cc, D]
 
-        def chunk_attn(kc, vc):
+        def chunk_attn(kc, vc, ksc, vsc):
             """attn_fn for decoder_layer: past (the rolling cache, in
             position order) + present (the chunk itself, causal) as two
-            mergeable online-softmax partials."""
+            mergeable online-softmax partials.  int8 caches dequantize the
+            gathered window up front — an O(window) transient per layer,
+            matching the path's O(chunk + window) memory contract."""
             def attn(q, k, v):
                 kco = jnp.take(kc, order, axis=2)
                 vco = jnp.take(vc, order, axis=2)
+                if quant:
+                    from ..ops.quantize import dequantize_kv
+
+                    kco = dequantize_kv(kco, jnp.take(ksc, order, axis=2),
+                                        q.dtype)
+                    vco = dequantize_kv(vco, jnp.take(vsc, order, axis=2),
+                                        q.dtype)
                 past = partial_attention(
                     q, repeat_kv(kco, n_rep), repeat_kv(vco, n_rep),
                     q_offset=c0, kv_offset=c0 - W, causal=True, window=W,
@@ -385,16 +388,25 @@ def _compiled_prefill_chunk(cfg: LlamaConfig):
         # decoder_layer body the scan forward uses, with a per-layer
         # cache-aware attn_fn; the returned post-RoPE grouped k/v feed the
         # circular slot write.
-        new_k = []
-        new_v = []
+        new = {name: [] for name in cache}
         for li in range(cfg.n_layers):
             lp = jax.tree_util.tree_map(lambda a: a[li], params["layers"])
             kc, vc = cache["k"][li], cache["v"][li]
+            ksc = cache["k_scale"][li] if quant else None
+            vsc = cache["v_scale"][li] if quant else None
             h, _aux, k, v, _stats = decoder_layer(lp, h, cfg, cos_c, sin_c,
-                                                  chunk_attn(kc, vc))
-            new_k.append(kc.at[:, :, slots, :].set(k))
-            new_v.append(vc.at[:, :, slots, :].set(v))
-        return h, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+                                                  chunk_attn(kc, vc, ksc,
+                                                             vsc))
+            if quant:
+                from ..ops.quantize import quantize_kv
+
+                k, k_s = quantize_kv(k)
+                v, v_s = quantize_kv(v)
+                new["k_scale"].append(ksc.at[:, :, slots].set(k_s))
+                new["v_scale"].append(vsc.at[:, :, slots].set(v_s))
+            new["k"].append(kc.at[:, :, slots, :].set(k))
+            new["v"].append(vc.at[:, :, slots, :].set(v))
+        return h, {name: jnp.stack(v) for name, v in new.items()}
 
     # The caller rebinds its cache to the returned one each chunk, so the
     # input cache can be donated: the update happens in place instead of

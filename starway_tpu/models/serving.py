@@ -199,14 +199,6 @@ class SlotServer:
                 "shared batch-wide, so cohabiting slots would perturb each "
                 "other's routing (same restriction as ragged generate())")
         self.rolling = cfg.sliding_window is not None
-        if self.rolling and cfg.kv_quant != "none":
-            # Fail at construction, not at first admission: rolling
-            # admission runs through prefill_rolling, which has no
-            # quantized chunk step yet.
-            raise NotImplementedError(
-                "rolling (sliding-window) continuous batching does not "
-                "support kv_quant yet; serve the windowed model with "
-                "kv_quant='none' or drop sliding_window")
         if n_slots < 1 or chunk < 1:
             # Zero slots/chunk would make run() spin forever, not error.
             raise ValueError(f"need n_slots >= 1 and chunk >= 1, got "

@@ -170,16 +170,71 @@ def test_generate_int8_rolling(params):
     assert out.shape == (B, 28)
 
 
-def test_prefill_rolling_int8_raises(params):
-    from starway_tpu.models.generate import prefill_rolling
+def test_prefill_rolling_int8_tracks_stepwise(params):
+    """Quantized chunked prefill: the O(chunk + window) streaming path on
+    an int8 rolling cache lands within one quantization bucket of the
+    stepwise int8 decode (in-chunk attention is wide in the chunked path
+    — the same choice the aligned prefill makes — so exact equality is
+    not the contract; a <= 2-ulp int8 cache and close logits are)."""
+    from starway_tpu.models.generate import (decode_step, init_rolling_cache,
+                                             prefill_rolling)
+    from starway_tpu.models.llama import rope_tables
+
+    W, P = 6, 17
+    cfg = LlamaConfig.preset("debug", kv_quant="int8", sliding_window=W)
+    prompt = jnp.asarray(np.random.default_rng(4).integers(
+        1, cfg.vocab_size, (2, P), dtype=np.int32))
+    logits_c, cache_c = prefill_rolling(params, cfg, prompt, chunk=5)
+    assert cache_c["k"].dtype == jnp.int8
+    assert cache_c["k_scale"].shape == (cfg.n_layers, 2, cfg.n_kv_heads, W)
+
+    cache_s = init_rolling_cache(cfg, 2)
+    rope = rope_tables(P, cfg.head_dim, cfg.rope_theta)
+    for i in range(P):
+        logits_s, cache_s = decode_step(params, cache_s, prompt[:, i], i,
+                                        cfg, rope, rolling=True)
+    np.testing.assert_allclose(np.asarray(logits_c), np.asarray(logits_s),
+                               atol=0.1, rtol=0.1)
+    assert int(jnp.max(jnp.abs(
+        cache_c["k"].astype(jnp.int32) - cache_s["k"].astype(jnp.int32)))) <= 2
+
+
+def test_rolling_slotserver_int8_matches_primitive_oracle(params):
+    """Sliding-window continuous batching on an int8 cache: every request
+    matches a single-request loop over the SAME primitives
+    (prefill_rolling + rolling decode_step + greedy sample) bit-exactly —
+    the same oracle discipline as the fp rolling serving test."""
+    from starway_tpu.models.generate import _sample, decode_step
+    from starway_tpu.models.llama import rope_tables
+    from starway_tpu.models.serving import _rolling_prefill_state
 
     cfg = LlamaConfig.preset("debug", kv_quant="int8", sliding_window=8)
-    with pytest.raises(NotImplementedError, match="kv_quant"):
-        prefill_rolling(params, cfg, jnp.ones((1, 16), jnp.int32))
-    # SlotServer must reject the same combination at CONSTRUCTION, not at
-    # first admission (when requests are already queued).
-    with pytest.raises(NotImplementedError, match="kv_quant"):
-        SlotServer(params, cfg, n_slots=1, max_len=32)
+
+    def oracle(prompt, max_new, horizon):
+        logits, cache = _rolling_prefill_state(
+            params, cfg, np.asarray(prompt, np.int32))
+        rope = rope_tables(horizon, cfg.head_dim, cfg.rope_theta)
+        toks = [int(_sample(logits, jax.random.PRNGKey(0), 0.0, None,
+                            None)[0])]
+        pos = len(prompt)
+        while len(toks) < max_new:
+            logits, cache = decode_step(
+                params, cache, jnp.asarray([toks[-1]], jnp.int32),
+                jnp.asarray([pos], jnp.int32), cfg, rope, rolling=True)
+            toks.append(int(_sample(logits, jax.random.PRNGKey(0), 0.0,
+                                    None, None)[0]))
+            pos += 1
+        return np.asarray(toks, np.int32)
+
+    reqs = [([5, 1, 7, 2, 9, 4, 3, 8, 6, 2, 7], 6), ([3, 8], 9),
+            ([1, 2, 3, 4, 5, 6, 7, 8, 9, 1, 2, 3], 4)]
+    srv = SlotServer(params, cfg, n_slots=2, max_len=48, chunk=4)
+    rids = [srv.submit(p, m) for p, m in reqs]
+    done = srv.run()
+    for rid, (prompt, max_new) in zip(rids, reqs):
+        np.testing.assert_array_equal(
+            done[rid], oracle(prompt, max_new, 48),
+            err_msg=f"request {rid} (P={len(prompt)})")
 
 
 def test_slotserver_int8_matches_generate(params):
