@@ -598,6 +598,69 @@ def bench_serve(batch=1, model="llama", ragged=False, prompt_len=512,
                       f"{cfg.n_heads}/{cfg.n_kv_heads} bf16"}
 
 
+def bench_spec_verify(gamma=8, t=4096, iters: int = 16):
+    """The mechanical core of speculative decoding's speedup: one
+    ``gamma``-wide chunk verify (models/speculative.py:chunk_decode_step)
+    vs ``gamma`` sequential decode steps on the same serve-shaped model.
+    Both stream the same cache bytes; the chunk does it ONCE — the row's
+    ratio is the per-macro-step amortisation an accepting draft realises
+    (end-to-end speedup = this ratio discounted by the acceptance rate
+    and the draft's own cost, which are model-quality-dependent and so
+    not benchmarkable with random weights)."""
+    import numpy as np
+
+    from starway_tpu.models import LlamaConfig, chunk_decode_step, init_params
+    from starway_tpu.models.generate import decode_step, init_cache
+    from starway_tpu.models.llama import rope_tables
+
+    cfg = LlamaConfig.preset(
+        "debug", d_model=1024, n_layers=8, n_heads=8, n_kv_heads=2,
+        d_ff=2816, vocab_size=32000, dtype="bfloat16")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    cache = init_cache(cfg, 1, t)
+    rope = rope_tables(t, cfg.head_dim, cfg.rope_theta)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(1, cfg.vocab_size, (1, gamma),
+                                    dtype=np.int32))
+    pos = jnp.asarray(t - gamma - 1, jnp.int32)
+
+    # _chain's carry-epsilon trick is float-only (an int epsilon is 0 and
+    # XLA would hoist the loop body); chain through the TOKENS instead —
+    # each iteration's argmax feeds the next iteration's input.  params and
+    # cache are jit ARGUMENTS (a closure would embed ~200 MB of constants
+    # into the program).
+    def chunk_loop(params, cache, toks, iters):
+        def body(_, tk):
+            logits, _cache = chunk_decode_step(params, cache, tk, pos, cfg,
+                                               rope)
+            return jnp.argmax(logits, -1).astype(jnp.int32)  # [1, gamma]
+
+        out = lax.fori_loop(0, iters, body, toks)
+        return out[0, 0].astype(jnp.float32)
+
+    def steps_loop(params, cache, toks, iters):
+        def body(_, tk):
+            def inner(j, carry):
+                tok, c = carry
+                logits, c = decode_step(params, c, tok, pos + j, cfg, rope)
+                return jnp.argmax(logits, -1).astype(jnp.int32), c
+
+            tok, _c = lax.fori_loop(0, gamma, inner, (tk[:, 0], cache))
+            return jnp.tile(tok[:, None], (1, gamma))
+
+        out = lax.fori_loop(0, iters, body, toks)
+        return out[0, 0].astype(jnp.float32)
+
+    dt_c = _timeit(chunk_loop, params, cache, toks, iters=iters)
+    dt_s = _timeit(steps_loop, params, cache, toks, iters=iters)
+    return {"metric": "spec_verify_amortisation", "value": round(dt_s / dt_c, 2),
+            "unit": f"x_per_{gamma}tok",
+            "detail": f"chunk verify {dt_c * 1e6:.0f} us vs {gamma} decode "
+                      f"steps {dt_s * 1e6:.0f} us (T={t}, 8L d1024 GQA 8/2 "
+                      f"bf16); end-to-end speedup = this x acceptance rate "
+                      f"- draft cost"}
+
+
 def bench_serve_continuous(n_slots=8, chunk=16, n_requests=32,
                            prompt_len=192, max_new=96, iters=None):
     """Aggregate tokens/s of the continuous-batching SlotServer under a
@@ -663,6 +726,7 @@ BENCHES = {
     "serve_ragged_b8": functools.partial(bench_serve, batch=8, ragged=True),
     "serve_mistral": functools.partial(bench_serve, model="mistral"),
     "serve_continuous": bench_serve_continuous,
+    "spec_verify": bench_spec_verify,
 }
 
 
@@ -687,7 +751,7 @@ def main():
         # tunnel.  onchip_refresh.sh runs them individually.
         heavy = ("serve", "serve_b8", "serve_ragged_b8", "serve_mistral",
                  "serve_int8_b8", "serve_continuous", "train_mfu_large",
-                 "decode_shapes")
+                 "decode_shapes", "spec_verify")
         names = [n for n in BENCHES
                  if not n.endswith("_tune") and n not in heavy]
     else:

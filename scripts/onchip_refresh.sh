@@ -83,6 +83,7 @@ run serve_ragged_b8  serve_llama_ragged_b8_tokens_per_s # mixed prompt lengths
 run serve_continuous serve_continuous_tokens_per_s      # wall-clock through slot reuse
 run decode_int8      decode_int8_us_per_token           # half-width int8 cache stream
 run serve_int8_b8    serve_llama_int8_b8_tokens_per_s   # int8 cache end to end
+run spec_verify      spec_verify_amortisation           # chunk verify vs gamma decode steps
 # 672M-param compiles x two differenced loop lengths can exceed the default
 # row timeout; give this one headroom.
 ROW_TIMEOUT=3000 run train_mfu_large train_step_mfu_large  # model-scale MFU (target >= 0.40)

@@ -26,6 +26,7 @@ from .pp_llama import (
     shard_ppv_params,
 )
 from .serving import SlotServer
+from .speculative import chunk_decode_step, generate_speculative
 
 __all__ = [
     "LlamaConfig",
@@ -45,4 +46,6 @@ __all__ = [
     "ppv_merge_params",
     "shard_ppv_params",
     "SlotServer",
+    "chunk_decode_step",
+    "generate_speculative",
 ]

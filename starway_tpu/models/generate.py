@@ -145,6 +145,44 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
             return lax.dynamic_update_slice_in_dim(c, u, slot, axis=2)
 
     h = params["embed"][token][:, None, :]  # [B, 1, D]
+
+    def attend(q, lc):
+        ksc, vsc = lc.get("k_scale"), lc.get("v_scale")
+        if rolling:
+            # Warm slots are exactly the window (we just overwrote the
+            # oldest); cold-start slots (> pos) are masked by the clamped
+            # position.  No window re-mask: absolute order is irrelevant.
+            return _attend_cached(q, lc["k"], lc["v"],
+                                  jnp.minimum(pos, T - 1), n_rep,
+                                  k_scale=ksc, v_scale=vsc)
+        return _attend_cached(q, lc["k"], lc["v"], pos, n_rep,
+                              window=cfg.sliding_window,
+                              k_scale=ksc, v_scale=vsc)
+
+    h, out = cached_layer_scan(params, cache, h, cos_p, sin_p, cfg, write,
+                               attend)
+    h = rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    logits = (h[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
+    return logits, out
+
+
+def cached_layer_scan(params, cache, h, cos_p, sin_p, cfg: LlamaConfig,
+                      write, attend):
+    """The ONE per-layer body of every cached decode path — decode_step's
+    C=1 and the speculative chunk verify's C>1
+    (models/speculative.py:chunk_decode_step) run exactly this: qkv
+    projection, RoPE, quantize-on-write when the cache is int8, ``write``
+    at the caller's cursor(s), ``attend(q, layer_cache)``, FFN (dense or
+    MoE).  Sharing it is what keeps the pinned chunk==stepwise parity a
+    tautology instead of a maintenance contract.
+
+    h: [B, C, D] embedded inputs; ``write(c, u)`` places a [B, Hkv, C(,D)]
+    update (values and, int8, scales — the T axis sits at the same index
+    once the trailing D dim is dropped); ``attend`` returns [B, Hq, C, hd].
+    Returns ``(h [B, C, D], new cache dict)``.
+    """
+    B, C = h.shape[0], h.shape[1]
+    hd = cfg.head_dim
     quant = "k_scale" in cache  # int8 cache (init_cache's format marker)
 
     def layer(carry, xs):
@@ -155,34 +193,26 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
             lp, kc, vc = xs
             ksc = vsc = None
         x = rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
-        q = (x @ lp["wq"]).reshape(B, 1, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-        k = (x @ lp["wk"]).reshape(B, 1, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
-        v = (x @ lp["wv"]).reshape(B, 1, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+        q = (x @ lp["wq"]).reshape(B, C, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        k = (x @ lp["wk"]).reshape(B, C, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+        v = (x @ lp["wv"]).reshape(B, C, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
         q = apply_rope(q, cos_p, sin_p)
         k = apply_rope(k, cos_p, sin_p)
         if quant:
             from ..ops.quantize import quantize_kv
 
-            # Quantize-on-write: the cache never holds a wide entry.  The
-            # scale caches share write() — the T axis sits at the same
-            # index once the trailing D dim is dropped.
+            # Quantize-on-write: the cache never holds a wide entry.
             k, k_s = quantize_kv(k)
             v, v_s = quantize_kv(v)
             ksc = write(ksc, k_s)
             vsc = write(vsc, v_s)
         kc = write(kc, k)
         vc = write(vc, v)
-        if rolling:
-            # Warm slots are exactly the window (we just overwrote the
-            # oldest); cold-start slots (> pos) are masked by the clamped
-            # position.  No window re-mask: absolute order is irrelevant.
-            o = _attend_cached(q, kc, vc, jnp.minimum(pos, T - 1), n_rep,
-                               k_scale=ksc, v_scale=vsc)
-        else:
-            o = _attend_cached(q, kc, vc, pos, n_rep,
-                               window=cfg.sliding_window,
-                               k_scale=ksc, v_scale=vsc)
-        o = o.transpose(0, 2, 1, 3).reshape(B, 1, cfg.n_heads * hd)
+        layer_cache = {"k": kc, "v": vc}
+        if quant:
+            layer_cache["k_scale"], layer_cache["v_scale"] = ksc, vsc
+        o = attend(q, layer_cache)
+        o = o.transpose(0, 2, 1, 3).reshape(B, C, cfg.n_heads * hd)
         h = h + o @ lp["wo"]
 
         x = rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
@@ -203,12 +233,10 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
     if quant:
         xs += (cache["k_scale"], cache["v_scale"])
     (h,), new = lax.scan(layer, (h,), xs)
-    h = rmsnorm(h, params["final_norm"], cfg.norm_eps)
-    logits = (h[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
     out = {"k": new[0], "v": new[1]}
     if quant:
         out["k_scale"], out["v_scale"] = new[2], new[3]
-    return logits, out
+    return h, out
 
 
 def prefill(params: dict, cfg: LlamaConfig, prompt,
@@ -414,15 +442,13 @@ def _compiled_prefill_chunk(cfg: LlamaConfig):
     return jax.jit(run_chunk, donate_argnums=(1,))
 
 
-def _sample(logits, key, temperature: float, top_k: Optional[int],
-            top_p: Optional[float]):
-    """One sampled token id per row of ``logits [B, V]``.  Static Python
-    ``temperature``/``top_k``/``top_p`` (baked into the compiled step):
-    temperature 0 = greedy; top-k keeps the k largest logits; top-p keeps
-    the smallest prefix of the sorted distribution with cumulative mass
-    >= top_p (the first token is always kept)."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def _filter_logits(logits, temperature: float, top_k: Optional[int],
+                   top_p: Optional[float]):
+    """The sampling distribution's logits: temperature-scaled, then top-k /
+    nucleus masked (NEG_BIG outside the kept set).  ``softmax`` of the
+    result IS the distribution :func:`_sample` draws from — speculative
+    decoding's acceptance rule needs exactly it (models/speculative.py).
+    Only meaningful for ``temperature > 0``."""
     l = logits / temperature
     if top_k is not None and top_k < l.shape[-1]:
         kth = lax.top_k(l, top_k)[0][..., -1:]
@@ -434,6 +460,19 @@ def _sample(logits, key, temperature: float, top_k: Optional[int],
         keep = (cum - probs) < top_p  # exclusive prefix mass; index 0 stays
         thresh = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True)
         l = jnp.where(l < thresh, NEG_BIG, l)
+    return l
+
+
+def _sample(logits, key, temperature: float, top_k: Optional[int],
+            top_p: Optional[float]):
+    """One sampled token id per row of ``logits [B, V]``.  Static Python
+    ``temperature``/``top_k``/``top_p`` (baked into the compiled step):
+    temperature 0 = greedy; top-k keeps the k largest logits; top-p keeps
+    the smallest prefix of the sorted distribution with cumulative mass
+    >= top_p (the first token is always kept)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    l = _filter_logits(logits, temperature, top_k, top_p)
     return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
 
 

@@ -1,0 +1,367 @@
+"""Speculative decoding: draft-model proposal + single-dispatch chunk verify.
+
+Decode is HBM-bandwidth-bound — every generated token streams the whole KV
+cache once (BASELINE.md decode rows).  Speculative decoding (Leviathan et
+al. 2023 / Chen et al. 2023, public algorithm) breaks the one-token-per-
+stream limit: a cheap DRAFT model proposes ``gamma - 1`` tokens
+autoregressively, then the TARGET model scores the whole proposed chunk in
+ONE forward pass — the target's cache streams once per ``a + 1`` accepted
+tokens instead of once per token, and the rejection rule keeps the output
+distribution EXACTLY the target model's (greedy case: bit-identical tokens,
+pinned by tests/test_speculative.py).
+
+TPU-first construction, mirroring models/generate.py's discipline:
+
+* the whole generation is one ``lax.while_loop`` dispatch — draft scan,
+  chunk verify, acceptance, and output writes are all on-device (a host
+  round trip per macro step would cost ~100 ms behind this sandbox's
+  tunnel against a few-ms verify);
+* static shapes throughout: every macro step drafts exactly ``gamma - 1``
+  tokens and verifies a ``gamma`` chunk; per-row cursors absorb the
+  variable acceptance length (rows advance 1..gamma tokens per step);
+* cache rollback is FREE: rejected positions sit beyond the row's cursor,
+  where position masking hides them and later writes overwrite them — no
+  copy, no checkpoint (the same invariant ragged decode relies on).
+
+No reference counterpart (/root/reference is a transport library); this is
+the TPU build's serving-stack extension implementing the public algorithm.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import NEG_BIG, repeat_kv
+from .generate import _filter_logits, _sample, cached_layer_scan, prefill
+from .llama import LlamaConfig, rmsnorm, rope_tables
+
+
+def _attend_cached_chunk(q, cache, pos_bc, n_rep, window=None):
+    """Cached attention for a CHUNK of queries with per-row cursors.
+
+    q: [B, Hq, C, D]; cache k/v: [B, Hkv, T, D] (int8 + scales supported);
+    pos_bc: [B, C] absolute positions of the chunk's tokens (the chunk's
+    own k/v are already written at those positions — write-then-attend,
+    like decode_step, so in-chunk causality is just the global mask).
+
+    Dense masked einsum, not the pallas decode kernel: C is small (the
+    speculation depth) and the cache stream is the same bytes either way —
+    the win over C single decode steps is streaming those bytes ONCE.
+    """
+    k_cache, v_cache = cache["k"], cache["v"]
+    if "k_scale" in cache:
+        from ..ops.quantize import dequantize_kv
+
+        k_cache = dequantize_kv(k_cache, cache["k_scale"], q.dtype)
+        v_cache = dequantize_kv(v_cache, cache["v_scale"], q.dtype)
+    k = repeat_kv(k_cache, n_rep)
+    v = repeat_kv(v_cache, n_rep)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32)
+    s = s / (q.shape[-1] ** 0.5)
+    kv_pos = jnp.arange(k.shape[2])[None, None, None, :]
+    qp = pos_bc[:, None, :, None]
+    keep = kv_pos <= qp
+    if window is not None:
+        keep = keep & (kv_pos > qp - window)
+    s = jnp.where(keep, s, NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def chunk_decode_step(params, cache, tokens, pos, cfg: LlamaConfig, rope):
+    """``C`` tokens in, ``C`` next-token logits out — the multi-token
+    generalisation of :func:`~starway_tpu.models.generate.decode_step`
+    (C=1 reduces to it, pinned by tests).
+
+    tokens: [B, C] int32 at ABSOLUTE positions ``pos .. pos + C - 1``
+    (``pos`` scalar or per-row [B]).  Returns ``(logits [B, C, V] f32,
+    updated cache)``.  Write-then-attend: the chunk's k/v (quantized when
+    the cache is int8) land in the cache first, then the chunk attends
+    through it with per-row global-position masks — in-chunk causality
+    falls out of the positions.  This is the speculative VERIFY step, and
+    generally useful for multi-token ingestion (teacher forcing, cache
+    warm-up) at decode-path semantics.  Dense FFN and MoE follow
+    decode_step; rolling caches are not supported (speculative decoding
+    targets the full-cache path).
+    """
+    B, C = tokens.shape
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    cos, sin = rope
+    pos = jnp.asarray(pos, jnp.int32)
+    pos_b = pos if pos.ndim == 1 else jnp.broadcast_to(pos, (B,))
+    pos_bc = pos_b[:, None] + jnp.arange(C)[None, :]  # [B, C]
+    cos_p = cos[pos_bc][:, None]  # [B, 1, C, hd/2]
+    sin_p = sin[pos_bc][:, None]
+
+    def write(c, u):
+        """C contiguous entries at each row's cursor; same per-leaf axis
+        invariant as decode_step (T axis at index 1 per row)."""
+        return jax.vmap(
+            lambda cr, ur, p: lax.dynamic_update_slice_in_dim(
+                cr, ur, p, axis=1))(c, u, pos_b)
+
+    def attend(q, layer_cache):
+        return _attend_cached_chunk(q, layer_cache, pos_bc, n_rep,
+                                    window=cfg.sliding_window)
+
+    h = params["embed"][tokens]  # [B, C, D]
+    h, out = cached_layer_scan(params, cache, h, cos_p, sin_p, cfg, write,
+                               attend)
+    h = rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)  # [B, C, V]
+    return logits, out
+
+
+# ------------------------------------------------------------- the driver
+
+
+@functools.cache
+def _compiled_speculative(cfg: LlamaConfig, draft_cfg: LlamaConfig, B: int,
+                          P: int, max_new: int, max_len: int, gamma: int,
+                          temperature: float, top_k: Optional[int],
+                          top_p: Optional[float]):
+    """jit'd speculative generation for one (shape, sampling) signature.
+
+    One dispatch: target+draft prefill, then a ``lax.while_loop`` of macro
+    steps — draft scan (``gamma - 1`` proposals), one ``gamma``-wide
+    target chunk verify, the acceptance rule, per-row output writes.
+    Rows advance 1..gamma tokens per macro step behind per-row cursors;
+    the loop runs until every row has ``max_new`` tokens (bounded by
+    ``max_new`` iterations: every step advances every row by >= 1).
+    """
+    from .generate import decode_step
+
+    rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
+    greedy = temperature == 0.0
+    G = gamma
+
+    def probs_of(logits):
+        """The SAME distribution _sample draws from, as probabilities."""
+        return jax.nn.softmax(_filter_logits(logits, temperature, top_k,
+                                             top_p), axis=-1)
+
+    def run(params, draft_params, prompt, key):
+        t_logits, t_cache = prefill(params, cfg, prompt, max_len)
+        _, d_cache = prefill(draft_params, draft_cfg, prompt, max_len)
+
+        key, sub = jax.random.split(key)
+        t0 = _sample(t_logits, sub, temperature, top_k, top_p)  # [B]
+
+        out = jnp.zeros((B, max_new + G), jnp.int32)
+        out = out.at[:, 0].set(t0)
+        n_out = jnp.ones((B,), jnp.int32)
+        pos0 = jnp.full((B,), P, jnp.int32)
+        stats0 = jnp.zeros((B, 2), jnp.int32)  # [macro steps, accepted]
+
+        def macro(carry):
+            t_cache, d_cache, out, n_out, t_pend, pos, key, stats = carry
+
+            # --- draft phase: G-1 proposals from the draft's own cache.
+            # The scan feeds ALL G chunk tokens (t, d_1 .. d_{G-1}) — the
+            # last step produces no proposal, it only writes d_{G-1}'s kv,
+            # so after a FULL acceptance the draft cache has no hole at
+            # pos+G-1 when the next macro step decodes past it (a zero
+            # entry there would poison every later proposal).
+            def draft_step(dcache, tok, p, k):
+                logits, dcache = decode_step(draft_params, dcache, tok, p,
+                                             draft_cfg, rope)
+                if greedy:
+                    nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                    pd = jax.nn.one_hot(nxt, logits.shape[-1],
+                                        dtype=jnp.float32)
+                else:
+                    nxt = _sample(logits, k, temperature, top_k, top_p)
+                    pd = probs_of(logits)
+                return dcache, nxt, pd
+
+            def draft_scan(dcache, t_pend, pos, key):
+                toks, pds = [], []
+                tok = t_pend
+                for i in range(G - 1):
+                    key, sub = jax.random.split(key)
+                    dcache, tok, pd = draft_step(dcache, tok, pos + i, sub)
+                    toks.append(tok)
+                    pds.append(pd)
+                # Cache-write-only step for the last proposal's kv.
+                _, dcache = decode_step(draft_params, dcache, tok,
+                                        pos + G - 1, draft_cfg, rope)
+                return dcache, jnp.stack(toks, 1), jnp.stack(pds, 1)
+
+            key, dkey = jax.random.split(key)
+            d_cache, drafts, pd = draft_scan(d_cache, t_pend, pos, dkey)
+            # drafts: [B, G-1] proposals d_1..d_{G-1}; pd their proposal
+            # distributions [B, G-1, V].
+
+            # --- verify: ONE target forward over [t, d_1..d_{G-1}].
+            chunk = jnp.concatenate([t_pend[:, None], drafts], axis=1)
+            t_logits, t_cache = chunk_decode_step(params, t_cache, chunk,
+                                                  pos, cfg, rope)
+            # t_logits[:, i] = p_T(x at pos+i+1 | ..., chunk[:i+1]).
+
+            # --- acceptance rule (per row, vectorized).
+            idx = jnp.arange(G - 1)[None, :]
+            if greedy:
+                tgt = jnp.argmax(t_logits[:, :-1], -1)  # [B, G-1]
+                ok = drafts == tgt
+            else:
+                qt = probs_of(t_logits[:, :-1])  # [B, G-1, V]
+                key, akey = jax.random.split(key)
+                u = jax.random.uniform(akey, drafts.shape)
+                take = jnp.take_along_axis
+                qt_d = take(qt, drafts[..., None], -1)[..., 0]
+                pd_d = take(pd, drafts[..., None], -1)[..., 0]
+                # STRICT inequality: u == 0 with qt_d == 0 (draft proposed
+                # outside the target's top-k/top-p support) must reject —
+                # plain generate() can never emit that token.
+                ok = u * pd_d < qt_d
+            # a = leading-accept count in [0, G-1].
+            a = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+
+            # --- the correction/bonus token at pos + a + 1.
+            la = jnp.take_along_axis(
+                t_logits, a[:, None, None], axis=1)[:, 0]  # [B, V]
+            key, ckey = jax.random.split(key)
+            if greedy:
+                # Rejected d was != argmax, so the correction IS argmax;
+                # full acceptance's bonus is argmax of the last logits.
+                c = jnp.argmax(la, -1).astype(jnp.int32)
+            else:
+                qa = probs_of(la)
+                # Residual only where a rejection happened (a < G-1);
+                # full acceptance samples the bonus from q_T directly.
+                pa = jnp.take_along_axis(
+                    jnp.pad(pd, ((0, 0), (0, 1), (0, 0))),
+                    a[:, None, None], axis=1)[:, 0]
+                res = jnp.maximum(qa - pa, 0.0)
+                res_sum = jnp.sum(res, -1, keepdims=True)
+                # Degenerate residual (q_T <= p_D everywhere it was
+                # sampled-able can leave ~0 mass after float error): fall
+                # back to q_T.
+                use_res = (a[:, None] < G - 1) & (res_sum > 1e-9)
+                dist = jnp.where(use_res, res / jnp.maximum(res_sum, 1e-30),
+                                 qa)
+                c = jax.random.categorical(
+                    ckey, jnp.log(jnp.maximum(dist, 1e-30)), axis=-1
+                ).astype(jnp.int32)
+
+            # --- emit d_1..d_a then c: a+1 tokens at each row's cursor.
+            emit = jnp.where(idx < a[:, None], drafts, 0)
+            emit = jnp.concatenate([emit, jnp.zeros((B, 1), jnp.int32)], 1)
+            emit = emit.at[jnp.arange(B), a].set(c)  # [B, G]
+            out = jax.vmap(
+                lambda row, w, s: lax.dynamic_update_slice(row, w, (s,))
+            )(out, emit, n_out)
+            # Finished rows freeze (cursor, position, pending token): they
+            # keep re-running the same macro step while slower rows catch
+            # up.  The advance is CLAMPED to the remaining budget so the
+            # invariant pos == P + n_out - 1 holds exactly — pos never
+            # exceeds P + max_new - 1, keeping every rope gather and cache
+            # write (<= pos + G - 1) inside max_len even on the finishing
+            # step; a clamped row keeps its stale pending token, which is
+            # never read into the returned slice.
+            done = n_out >= max_new
+            adv = jnp.where(done, 0, jnp.minimum(a + 1, max_new - n_out))
+            n_out = n_out + adv
+            live = (~done).astype(jnp.int32)
+            stats = stats + jnp.stack([live, live * a], axis=1)
+            return (t_cache, d_cache, out, n_out,
+                    jnp.where(adv == a + 1, c, t_pend), pos + adv, key,
+                    stats)
+
+        def cond(carry):
+            return jnp.any(carry[3] < max_new)
+
+        carry = (t_cache, d_cache, out, n_out, t0, pos0, key, stats0)
+        _, _, out, _, _, _, _, stats = lax.while_loop(cond, macro, carry)
+        return out[:, :max_new], stats
+
+    return jax.jit(run)
+
+
+def generate_speculative(params: dict, cfg: LlamaConfig, draft_params: dict,
+                         draft_cfg: LlamaConfig, prompt,
+                         max_new_tokens: int, *, gamma: int = 4,
+                         temperature: float = 0.0,
+                         key: Optional[jax.Array] = None,
+                         top_k: Optional[int] = None,
+                         top_p: Optional[float] = None,
+                         eos_id: Optional[int] = None,
+                         return_stats: bool = False):
+    """Speculative generation: the TARGET model's output at a fraction of
+    its decode steps.  prompt: [B, P] int32; returns ``[B, P +
+    max_new_tokens]`` (prompt + continuation), the aligned
+    :func:`~starway_tpu.models.generate.generate` contract.
+
+    ``gamma``: macro-step width — the draft proposes ``gamma - 1`` tokens
+    and the target verifies them (plus samples one more) in ONE forward.
+    Per macro step a row advances ``a + 1`` tokens where ``a`` is its
+    leading-accept count, so the target streams its cache once per
+    ``a + 1`` tokens instead of once per token — the speedup is the
+    draft's acceptance rate times that amortisation, minus the draft's
+    own cost.
+
+    Greedy (``temperature == 0``) output is BIT-IDENTICAL to
+    ``generate(params, cfg, ...)`` — the draft only changes how fast
+    tokens appear, never which tokens (pinned by
+    tests/test_speculative.py).  Sampling uses the standard speculative
+    rejection rule against exactly the filtered distribution ``generate``
+    samples from, so the per-token output distribution is the target
+    model's (statistically pinned).  ``eos_id``: conventional eos-fill,
+    applied to the finished buffer.
+
+    ``return_stats``: additionally return an acceptance-health dict (the
+    serving analogue of the MoE router stats): per-row ``macro_steps``
+    and ``accepted`` counts — their ratio is the realised mean accept
+    length ``a``, making the amortisation ``a + 1`` visible so a cold
+    draft is distinguishable from a working one without timings.
+
+    Requirements: same vocab on both models; dense-only (MoE capacity is
+    computed per forward, so a chunk verify would route differently than
+    stepwise decode); full caches (no sliding-window rolling).
+    """
+    B, P = prompt.shape
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if gamma < 2:
+        raise ValueError(f"gamma must be >= 2 (got {gamma}); gamma=1 is "
+                         f"plain decode — use generate()")
+    if cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError(
+            f"target and draft must share a vocab: {cfg.vocab_size} != "
+            f"{draft_cfg.vocab_size}")
+    for c, who in ((cfg, "target"), (draft_cfg, "draft")):
+        if c.n_experts > 0:
+            raise ValueError(
+                f"speculative decoding is dense-only ({who} has MoE): "
+                f"expert capacity is computed per forward, so the chunk "
+                f"verify would route differently than stepwise decode")
+        if c.sliding_window is not None:
+            raise ValueError(
+                f"speculative decoding needs full caches ({who} has a "
+                f"sliding window); rolling-cache support is not wired")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    # Cache headroom: a macro step may write up to gamma - 1 positions
+    # past the last kept token before the row's budget check stops it.
+    max_len = P + max_new_tokens + gamma
+    run = _compiled_speculative(cfg, draft_cfg, B, P, max_new_tokens,
+                                max_len, int(gamma), float(temperature),
+                                top_k, top_p)
+    toks, stats = run(params, draft_params, prompt, key)
+    if eos_id is not None:
+        # Conventional eos-fill on the finished buffer: everything after a
+        # row's first eos becomes eos.
+        seen = jnp.cumsum((toks == eos_id).astype(jnp.int32), axis=1)
+        fill = (seen - (toks == eos_id).astype(jnp.int32)) > 0
+        toks = jnp.where(fill, jnp.int32(eos_id), toks)
+    out = jnp.concatenate([prompt, toks], axis=1)
+    if return_stats:
+        return out, {"macro_steps": stats[:, 0], "accepted": stats[:, 1]}
+    return out
