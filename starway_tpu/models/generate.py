@@ -58,17 +58,21 @@ def init_rolling_cache(cfg: LlamaConfig, batch: int) -> dict:
 
 def _attend_cached(q, k_cache, v_cache, pos, n_rep, use_pallas=None,
                    window=None, k_scale=None, v_scale=None):
-    """q: [B, Hq, 1, D]; caches: [B, Hkv, T, D]; mask positions > pos.
-    ``pos`` is a scalar or a per-row [B] vector (ragged batches);
-    ``window`` restricts to the last ``window`` positions (sliding-window
-    models).  ``k_scale``/``v_scale`` ([B, Hkv, T] f32): the caches are
+    """q: [B, Hq, C, D] — C consecutive query positions per row (C=1 is
+    single-token decode; C>1 the speculative chunk verify, whose entries
+    are already written: write-then-attend).  caches: [B, Hkv, T, D];
+    row b's queries sit at ``pos[b] .. pos[b] + C - 1`` (``pos`` scalar
+    or per-row [B]) and mask key positions above themselves; ``window``
+    restricts to the last ``window`` positions (sliding-window models).
+    ``k_scale``/``v_scale`` ([B, Hkv, T] f32): the caches are
     int8-quantized (ops/quantize.py) — the kernel streams them at half
     width; the lax path dequantizes up front.
 
     On TPU the pallas decode kernel (ops/pallas_decode.py) streams the
     grouped cache once instead of materialising ``repeat_kv`` — an
     ``n_rep``× HBM-bandwidth saving on the bandwidth-bound decode step
-    (and only ~window bytes of it under a sliding window).
+    (and only ~window bytes of it under a sliding window); C>1 just adds
+    matmul rows to the same stream.
     """
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
@@ -87,10 +91,11 @@ def _attend_cached(q, k_cache, v_cache, pos, n_rep, use_pallas=None,
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s / (q.shape[-1] ** 0.5)
     kv_pos = jnp.arange(k.shape[2])[None, None, None, :]
-    pos_b = jnp.asarray(pos).reshape(-1)[:, None, None, None]
-    keep = kv_pos <= pos_b
+    qp = (jnp.asarray(pos).reshape(-1)[:, None, None, None]
+          + jnp.arange(q.shape[2])[None, None, :, None])
+    keep = kv_pos <= qp
     if window is not None:
-        keep = keep & (kv_pos > pos_b - window)
+        keep = keep & (kv_pos > qp - window)
     s = jnp.where(keep, s, NEG_BIG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)

@@ -41,39 +41,6 @@ from .generate import _filter_logits, _sample, cached_layer_scan, prefill
 from .llama import LlamaConfig, rmsnorm, rope_tables
 
 
-def _attend_cached_chunk(q, cache, pos_bc, n_rep, window=None):
-    """Cached attention for a CHUNK of queries with per-row cursors.
-
-    q: [B, Hq, C, D]; cache k/v: [B, Hkv, T, D] (int8 + scales supported);
-    pos_bc: [B, C] absolute positions of the chunk's tokens (the chunk's
-    own k/v are already written at those positions — write-then-attend,
-    like decode_step, so in-chunk causality is just the global mask).
-
-    Dense masked einsum, not the pallas decode kernel: C is small (the
-    speculation depth) and the cache stream is the same bytes either way —
-    the win over C single decode steps is streaming those bytes ONCE.
-    """
-    k_cache, v_cache = cache["k"], cache["v"]
-    if "k_scale" in cache:
-        from ..ops.quantize import dequantize_kv
-
-        k_cache = dequantize_kv(k_cache, cache["k_scale"], q.dtype)
-        v_cache = dequantize_kv(v_cache, cache["v_scale"], q.dtype)
-    k = repeat_kv(k_cache, n_rep)
-    v = repeat_kv(v_cache, n_rep)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32)
-    s = s / (q.shape[-1] ** 0.5)
-    kv_pos = jnp.arange(k.shape[2])[None, None, None, :]
-    qp = pos_bc[:, None, :, None]
-    keep = kv_pos <= qp
-    if window is not None:
-        keep = keep & (kv_pos > qp - window)
-    s = jnp.where(keep, s, NEG_BIG)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
-
-
 def chunk_decode_step(params, cache, tokens, pos, cfg: LlamaConfig, rope):
     """``C`` tokens in, ``C`` next-token logits out — the multi-token
     generalisation of :func:`~starway_tpu.models.generate.decode_step`
@@ -107,8 +74,16 @@ def chunk_decode_step(params, cache, tokens, pos, cfg: LlamaConfig, rope):
                 cr, ur, p, axis=1))(c, u, pos_b)
 
     def attend(q, layer_cache):
-        return _attend_cached_chunk(q, layer_cache, pos_bc, n_rep,
-                                    window=cfg.sliding_window)
+        # The SAME grouped-stream attention decode_step uses, at C query
+        # positions: on TPU the pallas kernel packs C x n_rep rows into
+        # one per-(batch, kv head) matmul over the narrow (int8-capable)
+        # cache stream — the verify costs one decode step's bytes.
+        from .generate import _attend_cached
+
+        return _attend_cached(q, layer_cache["k"], layer_cache["v"], pos_b,
+                              n_rep, window=cfg.sliding_window,
+                              k_scale=layer_cache.get("k_scale"),
+                              v_scale=layer_cache.get("v_scale"))
 
     h = params["embed"][tokens]  # [B, C, D]
     h, out = cached_layer_scan(params, cache, h, cos_p, sin_p, cfg, write,

@@ -47,10 +47,15 @@ from .pallas_attention import _round_up
 
 def _softmax_block_update(q, k, v, k_start, pos, m_scr, l_scr, acc_scr, *,
                           sm_scale: float, window: "int | None",
-                          k_scale=None, v_scale=None):
+                          k_scale=None, v_scale=None, row_off=None):
     """The one online-softmax block body both kernel variants share: score
     the group's query rows against one [block_k, D] cache block, mask by
     global position (and window), and fold into the m/l/acc scratches.
+
+    ``row_off`` ([rows] int32, multi-query decode): row r's query sits at
+    global position ``pos + row_off[r]`` — the speculative chunk verify
+    packs C chunk positions x n_rep query heads as the matmul rows, so
+    each row masks by its own cursor.  ``None`` = all rows at ``pos``.
 
     ``k_scale``/``v_scale`` ([block_k] f32, int8 cache): dequantization is
     folded into the existing algebra instead of widening the operands —
@@ -66,9 +71,10 @@ def _softmax_block_update(q, k, v, k_start, pos, m_scr, l_scr, acc_scr, *,
     else:
         s = s * sm_scale
     kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    keep = kv_pos <= pos
+    q_pos = pos if row_off is None else pos + row_off[:, None]
+    keep = kv_pos <= q_pos
     if window is not None:
-        keep = keep & (kv_pos > pos - window)
+        keep = keep & (kv_pos > q_pos - window)
     s = jnp.where(keep, s, NEG_BIG)
 
     m_prev = m_scr[:, :1]
@@ -87,9 +93,18 @@ def _softmax_block_update(q, k, v, k_start, pos, m_scr, l_scr, acc_scr, *,
     l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
 
+def _row_offsets(rows: int, n_q: int):
+    """Row r's query-position offset in the packed [n_rep, C] row layout
+    (r = rep * C + ci -> offset ci); None when single-position."""
+    if n_q == 1:
+        return None
+    return jax.lax.rem(
+        jax.lax.broadcasted_iota(jnp.int32, (rows,), 0), n_q)
+
+
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *refs, sm_scale: float,
                    block_k: int, hkv: int, window: "int | None",
-                   quant: bool = False):
+                   quant: bool = False, n_q: int = 1):
     if quant:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
     else:
@@ -106,12 +121,14 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *refs, sm_scale: float,
 
     # Per-ROW positions (ragged batches): this grid cell serves batch row
     # bh // hkv, whose own cursor bounds both masking and the DMA clamp.
+    # Multi-query (n_q > 1): queries span pos .. pos + n_q - 1.
     pos = pos_ref[pl.program_id(0) // hkv]
     k_start = ki * block_k
 
-    live = k_start <= pos
+    live = k_start <= pos + (n_q - 1)
     if window is not None:
-        # Sliding window: this block must overlap (pos - window, pos].
+        # Sliding window: this block must overlap (pos - window,
+        # pos + n_q - 1] (the union of every query's band).
         live = live & (k_start + block_k - 1 > pos - window)
 
     @pl.when(live)
@@ -120,7 +137,8 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *refs, sm_scale: float,
             q_ref[0], k_ref[0], v_ref[0], k_start, pos, m_scr, l_scr,
             acc_scr, sm_scale=sm_scale, window=window,
             k_scale=None if ks_ref is None else ks_ref[0],
-            v_scale=None if vs_ref is None else vs_ref[0])
+            v_scale=None if vs_ref is None else vs_ref[0],
+            row_off=_row_offsets(q_ref.shape[1], n_q))
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -130,7 +148,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *refs, sm_scale: float,
 def _decode_stream_kernel(pos_ref, q_ref, k_hbm, v_hbm, *refs,
                           sm_scale: float, block_k: int, hkv: int,
                           window: "int | None", n_blocks: int,
-                          quant: bool = False):
+                          quant: bool = False, n_q: int = 1):
     """One grid cell per (batch, kv head): the WHOLE cache sweep runs in a
     single cell as a fori_loop over kv blocks with double-buffered manual
     DMA (compute on block i overlaps the HBM stream of block i+1).
@@ -154,7 +172,7 @@ def _decode_stream_kernel(pos_ref, q_ref, k_hbm, v_hbm, *refs,
         o_ref, k_buf, v_buf, sems, m_scr, l_scr, acc_scr = refs
     bh = pl.program_id(0)
     pos = pos_ref[bh // hkv]
-    hi = pos // block_k  # last live block
+    hi = (pos + n_q - 1) // block_k  # last live block (queries span n_q)
     if window is None:
         lo = jnp.int32(0)
     else:
@@ -208,7 +226,8 @@ def _decode_stream_kernel(pos_ref, q_ref, k_hbm, v_hbm, *refs,
                 q, k_buf[slot], v_buf[slot], i * block_k, pos, m_scr, l_scr,
                 acc_scr, sm_scale=sm_scale, window=window,
                 k_scale=None if not quant else ks_buf[slot],
-                v_scale=None if not quant else vs_buf[slot])
+                v_scale=None if not quant else vs_buf[slot],
+                row_off=_row_offsets(q.shape[0], n_q))
 
         return 0
 
@@ -222,14 +241,20 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
                      v_scale=None):
     """Cached single-query attention without expanding the grouped cache.
 
-    q: [B, Hq, 1, D]; k_cache/v_cache: [B, Hkv, T, D]; pos: scalar int or
-    per-row [B] int (ragged batches) — positions > pos[b] are masked for
-    row b, and row b's DMA stops at its own block.  ``window`` (static):
-    sliding-window attention over the last ``window`` positions — blocks
-    entirely below the window are DMA-elided too, so a windowed decode
-    streams ~window bytes of cache regardless of T.  Returns
-    [B, Hq, 1, D].  Numerically matches
-    models/generate.py:_attend_cached (softmax in f32).
+    q: [B, Hq, C, D] — C consecutive query positions per row (C=1 is
+    plain single-token decode; C>1 is the speculative chunk verify:
+    models/speculative.py packs C positions x n_rep grouped heads as the
+    rows of the SAME per-(batch, kv head) matmul, so the cache still
+    streams exactly once, narrow and int8-capable).  k_cache/v_cache:
+    [B, Hkv, T, D]; pos: scalar int or per-row [B] int (ragged batches)
+    — row b's queries sit at ``pos[b] .. pos[b] + C - 1``, key positions
+    above each query are masked, and row b's DMA stops at its last
+    query's block.  Write-then-attend callers must have the C entries in
+    the cache already.  ``window`` (static): sliding-window attention
+    over the last ``window`` positions — blocks entirely below the
+    window are DMA-elided too, so a windowed decode streams ~window
+    bytes of cache regardless of T.  Returns [B, Hq, C, D].  Numerically
+    matches models/generate.py:_attend_cached (softmax in f32).
 
     ``k_scale``/``v_scale`` ([B, Hkv, T] f32): int8-quantized caches
     (ops/quantize.py) — the kernel streams the int8 blocks (half the HBM
@@ -259,8 +284,7 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
                 f"{name} dtype {c.dtype} inconsistent with "
                 f"{'present' if quant else 'absent'} scales (int8 caches "
                 f"carry per-token scales; see ops/quantize.py)")
-    b, hq, one, d = q.shape
-    assert one == 1, "decode kernel takes a single query position"
+    b, hq, n_q, d = q.shape
     hkv, t = k_cache.shape[1], k_cache.shape[2]
     n_rep = hq // hkv
     if sm_scale is None:
@@ -268,13 +292,15 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    # Group query heads by their kv head: rows of the per-group matmul.
-    # repeat_kv maps q head h -> kv head h // n_rep, so this reshape groups
-    # correctly (ops/attention.py:repeat_kv).
-    rows = _round_up(max(n_rep, 8), 8)  # TPU sublane tile
-    qg = q.reshape(b, hkv, n_rep, d)
-    if rows != n_rep:
-        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - n_rep), (0, 0)))
+    # Group query heads by their kv head: rows of the per-group matmul,
+    # packed [n_rep, C] (row r = rep * C + ci — _row_offsets relies on
+    # this layout).  repeat_kv maps q head h -> kv head h // n_rep, so the
+    # reshape groups correctly (ops/attention.py:repeat_kv).
+    n_rows = n_rep * n_q
+    rows = _round_up(max(n_rows, 8), 8)  # TPU sublane tile
+    qg = q.reshape(b, hkv, n_rows, d)
+    if rows != n_rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - n_rows), (0, 0)))
     qf = qg.reshape(b * hkv, rows, d)
 
     block_k = min(block_k, _round_up(t, 128))
@@ -304,7 +330,7 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
             functools.partial(
                 _decode_stream_kernel, sm_scale=sm_scale, block_k=block_k,
                 hkv=hkv, window=None if window is None else int(window),
-                n_blocks=t_pad // block_k, quant=quant),
+                n_blocks=t_pad // block_k, quant=quant, n_q=n_q),
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1,
                 grid=(b * hkv,),
@@ -328,19 +354,19 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
             out_shape=jax.ShapeDtypeStruct((b * hkv, rows, d), q.dtype),
             interpret=interpret,
         )(pos_arr, qf, kf, vf, *scales)
-        return out.reshape(b, hkv, rows, d)[:, :, :n_rep, :].reshape(
-            b, hq, 1, d)
+        return out.reshape(b, hkv, rows, d)[:, :, :n_rows, :].reshape(
+            b, hq, n_q, d)
 
     grid = (b * hkv, t_pad // block_k)
 
     # Clamp the K/V block index into the live range: the kernel body is
     # skipped outside it (pl.when), and a repeated block index makes the
     # Pallas pipeline elide the HBM copy entirely -- so a decode at pos
-    # streams only the blocks holding (pos - window, pos], not the whole
-    # padded cache.  (pl.when alone skips compute, not DMA.)
+    # streams only the blocks holding (pos - window, pos + n_q - 1], not
+    # the whole padded cache.  (pl.when alone skips compute, not DMA.)
     def _kv_index(bh, ki, pos_ref):
         p = pos_ref[bh // hkv]
-        hi = p // block_k
+        hi = (p + n_q - 1) // block_k
         if window is None:
             return (bh, jnp.minimum(ki, hi), 0)
         lo = jnp.maximum(p - window + 1, 0) // block_k
@@ -353,7 +379,7 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
     out = pl.pallas_call(
         functools.partial(_decode_kernel, sm_scale=sm_scale, block_k=block_k,
                           hkv=hkv, window=None if window is None else int(window),
-                          quant=quant),
+                          quant=quant, n_q=n_q),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
@@ -372,4 +398,5 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
         out_shape=jax.ShapeDtypeStruct((b * hkv, rows, d), q.dtype),
         interpret=interpret,
     )(pos_arr, qf, kf, vf, *scales)
-    return out.reshape(b, hkv, rows, d)[:, :, :n_rep, :].reshape(b, hq, 1, d)
+    return out.reshape(b, hkv, rows, d)[:, :, :n_rows, :].reshape(
+        b, hq, n_q, d)

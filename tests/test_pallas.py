@@ -109,6 +109,41 @@ def test_decode_kernel_matches_lax(pos, block_k, stream):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("stream", [True, False], ids=["stream", "grid"])
+@pytest.mark.parametrize("window", [None, 96])
+def test_decode_kernel_multi_query(stream, window):
+    """C>1 query positions (the speculative chunk verify): C x n_rep rows
+    share one narrow cache stream, each row masked by its own cursor —
+    pinned against the generalized lax oracle at ragged per-row bases,
+    multi-block, fp and int8, crossing a block boundary mid-chunk."""
+    from starway_tpu.models.generate import _attend_cached
+    from starway_tpu.ops.pallas_decode import decode_attention
+    from starway_tpu.ops.quantize import quantize_kv
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(5), 3)
+    B, Hq, Hkv, T, D, C = 2, 8, 2, 300, 64, 5
+    q = jax.random.normal(k1, (B, Hq, C, D), jnp.float32)
+    k = jax.random.normal(k2, (B, Hkv, T, D), jnp.float32)
+    v = jax.random.normal(k3, (B, Hkv, T, D), jnp.float32)
+    pos = jnp.asarray([125, 290], jnp.int32)  # chunk straddles block 128
+    ref = _attend_cached(q, k, v, pos, Hq // Hkv, use_pallas=False,
+                         window=window)
+    out = decode_attention(q, k, v, pos, interpret=True, stream=stream,
+                           block_k=128, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+    k8, ks = quantize_kv(k)
+    v8, vs = quantize_kv(v)
+    refq = _attend_cached(q, k8, v8, pos, Hq // Hkv, use_pallas=False,
+                          window=window, k_scale=ks, v_scale=vs)
+    outq = decode_attention(q, k8, v8, pos, interpret=True, stream=stream,
+                            block_k=128, window=window, k_scale=ks,
+                            v_scale=vs)
+    np.testing.assert_allclose(np.asarray(outq), np.asarray(refq),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_decode_kernel_traced_pos_under_jit():
     from starway_tpu.models.generate import _attend_cached
     from starway_tpu.ops.pallas_decode import decode_attention

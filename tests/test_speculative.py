@@ -147,6 +147,53 @@ def test_speculative_validation(params, draft):
                              dparams, dcfg, prompt, 4)
 
 
+def test_speculative_tp_sharded(params, draft):
+    """Tensor-parallel speculative decoding is pure GSPMD: both models'
+    params shard over tp and the same compiled while_loop produces the
+    unsharded greedy tokens (XLA inserts the head-dim collectives into
+    the draft scan AND the chunk verify).  Deterministic CPU mesh, so
+    exact equality holds (the logit-noise caveat of
+    test_generate.py::test_generate_tp_sharded applies on hardware)."""
+    from jax.sharding import NamedSharding
+
+    from starway_tpu.models import param_specs
+    from starway_tpu.parallel import make_mesh
+
+    dcfg, dparams = draft
+    cfg = LlamaConfig.preset("debug")
+    prompt = jnp.asarray([[3, 1, 4, 1, 5]], dtype=jnp.int32)
+    ref = generate_speculative(params, cfg, dparams, dcfg, prompt, 9,
+                               gamma=3)
+
+    mesh = make_mesh({"tp": 2})
+
+    def shard(p, c):
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            p, param_specs(c))
+
+    out = generate_speculative(shard(params, cfg), cfg,
+                               shard(dparams, dcfg), dcfg, prompt, 9,
+                               gamma=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_int8_cache(params, draft):
+    """Speculative over int8 caches (target and draft both quantized):
+    greedy output is bit-identical to the plain int8 generate — the
+    verify writes and reads the same quantized entries stepwise decode
+    would."""
+    dcfg, dparams = draft
+    cfg = LlamaConfig.preset("debug", kv_quant="int8")
+    dcfg_q = LlamaConfig.preset("debug", n_layers=1, kv_quant="int8")
+    prompt = jnp.asarray(np.random.default_rng(5).integers(
+        1, cfg.vocab_size, (2, 7), dtype=np.int32))
+    ref = generate(params, cfg, prompt, 9)
+    spec = generate_speculative(params, cfg, dparams, dcfg_q, prompt, 9,
+                                gamma=4)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
+
+
 def test_sampled_speculative_preserves_target_distribution():
     """The rejection rule must yield the TARGET model's distribution, not
     the draft's.  Tiny 1-layer models, V=32, temperature 1: the position-
