@@ -55,11 +55,9 @@ def main() -> None:
 
     ref = generate(params, cfg, prompt, args.max_new)
 
-    for name, dp, dc in (("shallow draft (1L, random)", dparams, dcfg),
-                         ("self-draft (acceptance ~1)", params, cfg)):
-        out, stats = generate_speculative(
-            params, cfg, dp, dc, prompt, args.max_new, gamma=args.gamma,
-            return_stats=True)
+    from starway_tpu.models.speculative import generate_lookup
+
+    def report(name, out, stats):
         same = bool((out == ref).all())
         steps = np.asarray(stats["macro_steps"], np.float64)
         acc = np.asarray(stats["accepted"], np.float64)
@@ -69,6 +67,19 @@ def main() -> None:
               f"acceptance {rate:.0%}, {amort:.2f} tokens/target-pass "
               f"(gamma={args.gamma})")
         assert same, "greedy speculative output diverged from generate()"
+
+    for name, dp, dc in (("shallow draft (1L, random)", dparams, dcfg),
+                         ("self-draft (acceptance ~1)", params, cfg)):
+        out, stats = generate_speculative(
+            params, cfg, dp, dc, prompt, args.max_new, gamma=args.gamma,
+            return_stats=True)
+        report(name, out, stats)
+    # Prompt-lookup: no draft model at all — proposals copy the latest
+    # matching n-gram continuation from the sequence's own history.
+    out, stats = generate_lookup(params, cfg, prompt, args.max_new,
+                                 gamma=args.gamma, ngram=2,
+                                 return_stats=True)
+    report("prompt-lookup (ngram=2, draft-free)", out, stats)
     print("ok")
 
 

@@ -26,7 +26,8 @@ from .pp_llama import (
     shard_ppv_params,
 )
 from .serving import SlotServer
-from .speculative import chunk_decode_step, generate_speculative
+from .speculative import (chunk_decode_step, generate_lookup,
+                          generate_speculative)
 
 __all__ = [
     "LlamaConfig",
@@ -47,5 +48,6 @@ __all__ = [
     "shard_ppv_params",
     "SlotServer",
     "chunk_decode_step",
+    "generate_lookup",
     "generate_speculative",
 ]

@@ -36,7 +36,6 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import NEG_BIG, repeat_kv
 from .generate import _filter_logits, _sample, cached_layer_scan, prefill
 from .llama import LlamaConfig, rmsnorm, rope_tables
 
@@ -94,6 +93,187 @@ def chunk_decode_step(params, cache, tokens, pos, cfg: LlamaConfig, rope):
 
 
 # ------------------------------------------------------------- the driver
+
+
+def _accept_emit(drafts, pd, t_logits, key, out, n_out, t_pend, pos, stats,
+                 *, greedy: bool, G: int, B: int, max_new: int, probs_of):
+    """The acceptance rule + output bookkeeping every speculative driver
+    shares (model-draft and prompt-lookup): leading-accept count, the
+    correction/bonus token, per-row emit at the cursor, and the
+    freeze/clamp logic that keeps every position inside max_len.
+
+    drafts [B, G-1], pd [B, G-1, V] (the PROPOSAL distributions — one-hot
+    for deterministic drafters), t_logits [B, G, V] from the chunk
+    verify.  Returns ``(out, n_out, t_pend, pos, key, stats, emit)``;
+    ``emit [B, G]`` is the written token vector ([d_1..d_a, c, junk]) so
+    a caller maintaining its own sequence buffer can mirror the write.
+    """
+    idx = jnp.arange(G - 1)[None, :]
+    if greedy:
+        tgt = jnp.argmax(t_logits[:, :-1], -1)  # [B, G-1]
+        ok = drafts == tgt
+    else:
+        qt = probs_of(t_logits[:, :-1])  # [B, G-1, V]
+        key, akey = jax.random.split(key)
+        u = jax.random.uniform(akey, drafts.shape)
+        take = jnp.take_along_axis
+        qt_d = take(qt, drafts[..., None], -1)[..., 0]
+        pd_d = take(pd, drafts[..., None], -1)[..., 0]
+        # STRICT inequality: u == 0 with qt_d == 0 (draft proposed
+        # outside the target's top-k/top-p support) must reject —
+        # plain generate() can never emit that token.
+        ok = u * pd_d < qt_d
+    # a = leading-accept count in [0, G-1].
+    a = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+
+    # The correction/bonus token at pos + a + 1.
+    la = jnp.take_along_axis(t_logits, a[:, None, None], axis=1)[:, 0]
+    key, ckey = jax.random.split(key)
+    if greedy:
+        # Rejected d was != argmax, so the correction IS argmax; full
+        # acceptance's bonus is argmax of the last logits.
+        c = jnp.argmax(la, -1).astype(jnp.int32)
+    else:
+        qa = probs_of(la)
+        # Residual only where a rejection happened (a < G-1); full
+        # acceptance samples the bonus from q_T directly.
+        pa = jnp.take_along_axis(
+            jnp.pad(pd, ((0, 0), (0, 1), (0, 0))),
+            a[:, None, None], axis=1)[:, 0]
+        res = jnp.maximum(qa - pa, 0.0)
+        res_sum = jnp.sum(res, -1, keepdims=True)
+        # Degenerate residual (q_T <= p_D everywhere it was sampled-able
+        # can leave ~0 mass after float error): fall back to q_T.
+        use_res = (a[:, None] < G - 1) & (res_sum > 1e-9)
+        dist = jnp.where(use_res, res / jnp.maximum(res_sum, 1e-30), qa)
+        c = jax.random.categorical(
+            ckey, jnp.log(jnp.maximum(dist, 1e-30)), axis=-1
+        ).astype(jnp.int32)
+
+    # Emit d_1..d_a then c: a+1 tokens at each row's cursor.
+    emit = jnp.where(idx < a[:, None], drafts, 0)
+    emit = jnp.concatenate([emit, jnp.zeros((B, 1), jnp.int32)], 1)
+    emit = emit.at[jnp.arange(B), a].set(c)  # [B, G]
+    out = jax.vmap(
+        lambda row, w, s: lax.dynamic_update_slice(row, w, (s,))
+    )(out, emit, n_out)
+    # Finished rows freeze (cursor, position, pending token): they keep
+    # re-running the same macro step while slower rows catch up.  The
+    # advance is CLAMPED to the remaining budget so the invariant
+    # pos == P + n_out - 1 holds exactly — pos never exceeds
+    # P + max_new - 1, keeping every rope gather and cache write
+    # (<= pos + G - 1) inside max_len even on the finishing step; a
+    # clamped row keeps its stale pending token, which is never read
+    # into the returned slice.
+    done = n_out >= max_new
+    adv = jnp.where(done, 0, jnp.minimum(a + 1, max_new - n_out))
+    n_out = n_out + adv
+    live = (~done).astype(jnp.int32)
+    stats = stats + jnp.stack([live, live * a], axis=1)
+    return (out, n_out, jnp.where(adv == a + 1, c, t_pend), pos + adv, key,
+            stats, emit)
+
+
+def _lookup_propose(seq, pos, *, ngram: int, gamma: int):
+    """Prompt-lookup proposal: continue the most recent earlier occurrence
+    of the sequence's current ``ngram``-gram.
+
+    seq: [B, L] token buffer, valid through index ``pos`` (per-row [B]);
+    the current n-gram is ``seq[pos-ngram+1 .. pos]``.  Finds the largest
+    j < pos with ``seq[j-ngram+1 .. j]`` equal to it and proposes
+    ``seq[j+1 .. j+gamma-1]``.  No match: j falls back to ``ngram - 1``
+    (a harmless in-bounds span — the verify rejects bad proposals, it
+    never needs them to be good).  Returns ``[B, gamma-1]`` int32.
+
+    Pure gather/compare ops — no model, no host: the drafter is free, so
+    any acceptance at all is profit (repetitive text — code, extraction,
+    summarisation — accepts a lot; the public "prompt lookup decoding"
+    trick used by mainstream serving engines).
+    """
+    B, L = seq.shape
+    idx = jnp.arange(L)[None, :]
+    match = jnp.ones((B, L), bool)
+    for k in range(ngram):
+        # seq[j - k] == seq[pos - k], masked where j - k < 0.  The key
+        # gather clamps at 0: when pos < ngram the n-gram does not exist
+        # and any (verified-anyway) proposal is acceptable.
+        shifted = jnp.pad(seq, ((0, 0), (k, 0)))[:, :L]
+        want = jnp.take_along_axis(
+            seq, jnp.maximum(pos[:, None] - k, 0), axis=1)
+        match = match & (shifted == want) & (idx >= k)
+    match = match & (idx < pos[:, None]) & (idx >= ngram - 1)
+    j = jnp.max(jnp.where(match, idx, ngram - 1), axis=1)  # [B]
+    return jax.vmap(
+        lambda row, s: lax.dynamic_slice(row, (s + 1,), (gamma - 1,))
+    )(seq, j)
+
+
+@functools.cache
+def _compiled_lookup(cfg: LlamaConfig, B: int, P: int, max_new: int,
+                     max_len: int, gamma: int, ngram: int,
+                     temperature: float, top_k: Optional[int],
+                     top_p: Optional[float]):
+    """jit'd prompt-lookup speculative generation: the model-draft driver
+    with the draft scan replaced by :func:`_lookup_propose` over a
+    sequence buffer — ONE model (the target) runs at all, so every
+    accepted token saves a whole decode step."""
+    rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
+    greedy = temperature == 0.0
+    G = gamma
+
+    def probs_of(logits):
+        return jax.nn.softmax(_filter_logits(logits, temperature, top_k,
+                                             top_p), axis=-1)
+
+    def run(params, prompt, key):
+        t_logits, t_cache = prefill(params, cfg, prompt, max_len)
+        key, sub = jax.random.split(key)
+        t0 = _sample(t_logits, sub, temperature, top_k, top_p)
+
+        # Sequence buffer: prompt, then every emitted token at its
+        # absolute position (the lookup corpus grows as generation runs).
+        seq = jnp.zeros((B, max_len), jnp.int32)
+        seq = lax.dynamic_update_slice(seq, prompt, (0, 0))
+        seq = seq.at[:, P].set(t0)
+
+        out = jnp.zeros((B, max_new + G), jnp.int32)
+        out = out.at[:, 0].set(t0)
+        n_out = jnp.ones((B,), jnp.int32)
+        pos0 = jnp.full((B,), P, jnp.int32)
+        stats0 = jnp.zeros((B, 2), jnp.int32)
+
+        def macro(carry):
+            t_cache, seq, out, n_out, t_pend, pos, key, stats = carry
+            old_pos = pos
+
+            drafts = _lookup_propose(seq, pos, ngram=ngram, gamma=G)
+            pd = jax.nn.one_hot(drafts, cfg.vocab_size, dtype=jnp.float32)
+
+            chunk = jnp.concatenate([t_pend[:, None], drafts], axis=1)
+            t_logits, t_cache = chunk_decode_step(params, t_cache, chunk,
+                                                  pos, cfg, rope)
+
+            out, n_out, t_pend, pos, key, stats, emit = _accept_emit(
+                drafts, pd, t_logits, key, out, n_out, t_pend, pos, stats,
+                greedy=greedy, G=G, B=B, max_new=max_new,
+                probs_of=probs_of)
+            # Mirror the emit into the lookup corpus at the PRE-advance
+            # position + 1 (emit holds [d_1..d_a, c, junk]; junk gets
+            # overwritten by the next mirror — the same covering argument
+            # as the caches).
+            seq = jax.vmap(
+                lambda row, w, s: lax.dynamic_update_slice(row, w, (s,))
+            )(seq, emit, old_pos + 1)
+            return (t_cache, seq, out, n_out, t_pend, pos, key, stats)
+
+        def cond(carry):
+            return jnp.any(carry[3] < max_new)
+
+        carry = (t_cache, seq, out, n_out, t0, pos0, key, stats0)
+        _, _, out, _, _, _, _, stats = lax.while_loop(cond, macro, carry)
+        return out[:, :max_new], stats
+
+    return jax.jit(run)
 
 
 @functools.cache
@@ -179,75 +359,11 @@ def _compiled_speculative(cfg: LlamaConfig, draft_cfg: LlamaConfig, B: int,
                                                   pos, cfg, rope)
             # t_logits[:, i] = p_T(x at pos+i+1 | ..., chunk[:i+1]).
 
-            # --- acceptance rule (per row, vectorized).
-            idx = jnp.arange(G - 1)[None, :]
-            if greedy:
-                tgt = jnp.argmax(t_logits[:, :-1], -1)  # [B, G-1]
-                ok = drafts == tgt
-            else:
-                qt = probs_of(t_logits[:, :-1])  # [B, G-1, V]
-                key, akey = jax.random.split(key)
-                u = jax.random.uniform(akey, drafts.shape)
-                take = jnp.take_along_axis
-                qt_d = take(qt, drafts[..., None], -1)[..., 0]
-                pd_d = take(pd, drafts[..., None], -1)[..., 0]
-                # STRICT inequality: u == 0 with qt_d == 0 (draft proposed
-                # outside the target's top-k/top-p support) must reject —
-                # plain generate() can never emit that token.
-                ok = u * pd_d < qt_d
-            # a = leading-accept count in [0, G-1].
-            a = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
-
-            # --- the correction/bonus token at pos + a + 1.
-            la = jnp.take_along_axis(
-                t_logits, a[:, None, None], axis=1)[:, 0]  # [B, V]
-            key, ckey = jax.random.split(key)
-            if greedy:
-                # Rejected d was != argmax, so the correction IS argmax;
-                # full acceptance's bonus is argmax of the last logits.
-                c = jnp.argmax(la, -1).astype(jnp.int32)
-            else:
-                qa = probs_of(la)
-                # Residual only where a rejection happened (a < G-1);
-                # full acceptance samples the bonus from q_T directly.
-                pa = jnp.take_along_axis(
-                    jnp.pad(pd, ((0, 0), (0, 1), (0, 0))),
-                    a[:, None, None], axis=1)[:, 0]
-                res = jnp.maximum(qa - pa, 0.0)
-                res_sum = jnp.sum(res, -1, keepdims=True)
-                # Degenerate residual (q_T <= p_D everywhere it was
-                # sampled-able can leave ~0 mass after float error): fall
-                # back to q_T.
-                use_res = (a[:, None] < G - 1) & (res_sum > 1e-9)
-                dist = jnp.where(use_res, res / jnp.maximum(res_sum, 1e-30),
-                                 qa)
-                c = jax.random.categorical(
-                    ckey, jnp.log(jnp.maximum(dist, 1e-30)), axis=-1
-                ).astype(jnp.int32)
-
-            # --- emit d_1..d_a then c: a+1 tokens at each row's cursor.
-            emit = jnp.where(idx < a[:, None], drafts, 0)
-            emit = jnp.concatenate([emit, jnp.zeros((B, 1), jnp.int32)], 1)
-            emit = emit.at[jnp.arange(B), a].set(c)  # [B, G]
-            out = jax.vmap(
-                lambda row, w, s: lax.dynamic_update_slice(row, w, (s,))
-            )(out, emit, n_out)
-            # Finished rows freeze (cursor, position, pending token): they
-            # keep re-running the same macro step while slower rows catch
-            # up.  The advance is CLAMPED to the remaining budget so the
-            # invariant pos == P + n_out - 1 holds exactly — pos never
-            # exceeds P + max_new - 1, keeping every rope gather and cache
-            # write (<= pos + G - 1) inside max_len even on the finishing
-            # step; a clamped row keeps its stale pending token, which is
-            # never read into the returned slice.
-            done = n_out >= max_new
-            adv = jnp.where(done, 0, jnp.minimum(a + 1, max_new - n_out))
-            n_out = n_out + adv
-            live = (~done).astype(jnp.int32)
-            stats = stats + jnp.stack([live, live * a], axis=1)
-            return (t_cache, d_cache, out, n_out,
-                    jnp.where(adv == a + 1, c, t_pend), pos + adv, key,
-                    stats)
+            out, n_out, t_pend, pos, key, stats, _emit = _accept_emit(
+                drafts, pd, t_logits, key, out, n_out, t_pend, pos, stats,
+                greedy=greedy, G=G, B=B, max_new=max_new,
+                probs_of=probs_of)
+            return (t_cache, d_cache, out, n_out, t_pend, pos, key, stats)
 
         def cond(carry):
             return jnp.any(carry[3] < max_new)
@@ -301,26 +417,12 @@ def generate_speculative(params: dict, cfg: LlamaConfig, draft_params: dict,
     stepwise decode); full caches (no sliding-window rolling).
     """
     B, P = prompt.shape
-    if max_new_tokens < 1:
-        raise ValueError(
-            f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    if gamma < 2:
-        raise ValueError(f"gamma must be >= 2 (got {gamma}); gamma=1 is "
-                         f"plain decode — use generate()")
+    _validate_spec_args(max_new_tokens, gamma, (cfg, "target"),
+                        (draft_cfg, "draft"))
     if cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError(
             f"target and draft must share a vocab: {cfg.vocab_size} != "
             f"{draft_cfg.vocab_size}")
-    for c, who in ((cfg, "target"), (draft_cfg, "draft")):
-        if c.n_experts > 0:
-            raise ValueError(
-                f"speculative decoding is dense-only ({who} has MoE): "
-                f"expert capacity is computed per forward, so the chunk "
-                f"verify would route differently than stepwise decode")
-        if c.sliding_window is not None:
-            raise ValueError(
-                f"speculative decoding needs full caches ({who} has a "
-                f"sliding window); rolling-cache support is not wired")
     if key is None:
         key = jax.random.PRNGKey(0)
     # Cache headroom: a macro step may write up to gamma - 1 positions
@@ -330,9 +432,34 @@ def generate_speculative(params: dict, cfg: LlamaConfig, draft_params: dict,
                                 max_len, int(gamma), float(temperature),
                                 top_k, top_p)
     toks, stats = run(params, draft_params, prompt, key)
+    return _finish_spec(prompt, toks, stats, eos_id, return_stats)
+
+
+def _validate_spec_args(max_new_tokens: int, gamma: int, *cfgs):
+    """The restrictions both speculative entry points share."""
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if gamma < 2:
+        raise ValueError(f"gamma must be >= 2 (got {gamma}); gamma=1 is "
+                         f"plain decode — use generate()")
+    for c, who in cfgs:
+        if c.n_experts > 0:
+            raise ValueError(
+                f"speculative decoding is dense-only ({who} has MoE): "
+                f"expert capacity is computed per forward, so the chunk "
+                f"verify would route differently than stepwise decode")
+        if c.sliding_window is not None:
+            raise ValueError(
+                f"speculative decoding needs full caches ({who} has a "
+                f"sliding window); rolling-cache support is not wired")
+
+
+def _finish_spec(prompt, toks, stats, eos_id, return_stats):
+    """Shared tail: conventional eos-fill on the finished buffer, prompt
+    concat, optional acceptance-stats dict."""
     if eos_id is not None:
-        # Conventional eos-fill on the finished buffer: everything after a
-        # row's first eos becomes eos.
+        # Everything after a row's first eos becomes eos.
         seen = jnp.cumsum((toks == eos_id).astype(jnp.int32), axis=1)
         fill = (seen - (toks == eos_id).astype(jnp.int32)) > 0
         toks = jnp.where(fill, jnp.int32(eos_id), toks)
@@ -340,3 +467,37 @@ def generate_speculative(params: dict, cfg: LlamaConfig, draft_params: dict,
     if return_stats:
         return out, {"macro_steps": stats[:, 0], "accepted": stats[:, 1]}
     return out
+
+
+def generate_lookup(params: dict, cfg: LlamaConfig, prompt,
+                    max_new_tokens: int, *, gamma: int = 4, ngram: int = 2,
+                    temperature: float = 0.0,
+                    key: Optional[jax.Array] = None,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None,
+                    eos_id: Optional[int] = None,
+                    return_stats: bool = False):
+    """Prompt-lookup speculative generation: no draft model — proposals
+    are copied from the sequence's own history (continue the latest
+    earlier occurrence of the current ``ngram``-gram,
+    :func:`_lookup_propose`) and verified by the target's chunk forward.
+    The drafter costs a few gathers, so ANY acceptance is pure profit;
+    repetitive workloads (code, extraction, quoting) accept a lot.  Same
+    guarantees as :func:`generate_speculative`: greedy output is
+    bit-identical to ``generate()``; sampling preserves the target
+    distribution (deterministic proposals are the ``p_D = one-hot``
+    special case of the same rejection rule).  Same contract and
+    restrictions otherwise (aligned [B, P] prompt, dense-only, full
+    caches).
+    """
+    B, P = prompt.shape
+    _validate_spec_args(max_new_tokens, gamma, (cfg, "target"))
+    if ngram < 1:
+        raise ValueError(f"ngram must be >= 1, got {ngram}")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    max_len = P + max_new_tokens + gamma
+    run = _compiled_lookup(cfg, B, P, max_new_tokens, max_len, int(gamma),
+                           int(ngram), float(temperature), top_k, top_p)
+    toks, stats = run(params, prompt, key)
+    return _finish_spec(prompt, toks, stats, eos_id, return_stats)

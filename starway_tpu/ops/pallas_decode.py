@@ -239,7 +239,8 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None,
                      block_k: int = 512, interpret=None, window=None,
                      stream: "bool | None" = None, k_scale=None,
                      v_scale=None):
-    """Cached single-query attention without expanding the grouped cache.
+    """Cached decode attention (1..C query positions) without expanding
+    the grouped cache.
 
     q: [B, Hq, C, D] — C consecutive query positions per row (C=1 is
     plain single-token decode; C>1 is the speculative chunk verify:
