@@ -447,6 +447,27 @@ def _compiled_prefill_chunk(cfg: LlamaConfig):
     return jax.jit(run_chunk, donate_argnums=(1,))
 
 
+def validate_prompt_lengths(prompt_lengths, B: int, P: int):
+    """The ragged-batch lengths contract shared by every generation entry
+    point (generate, generate_speculative, generate_lookup): concrete
+    [B] int values in [1, P].  Under jit the downstream gathers would
+    clamp and return wrong continuations silently, so tracers are
+    rejected — ragged generation must be called outside jit (the entry
+    points compile their own prefill+decode programs internally).
+    Returns the [B] int32 lengths."""
+    lengths = jnp.asarray(prompt_lengths, jnp.int32)
+    if lengths.shape != (B,):
+        raise ValueError(f"prompt_lengths must be [{B}], got {lengths.shape}")
+    if isinstance(lengths, jax.core.Tracer):
+        raise ValueError(
+            "ragged generation (prompt_lengths) must be called outside "
+            "jit: length validation needs concrete values")
+    if bool((lengths < 1).any()) or bool((lengths > P).any()):
+        raise ValueError(
+            f"prompt_lengths must be in [1, {P}]; got {lengths.tolist()}")
+    return lengths
+
+
 def _filter_logits(logits, temperature: float, top_k: Optional[int],
                    top_p: Optional[float]):
     """The sampling distribution's logits: temperature-scaled, then top-k /
@@ -612,23 +633,7 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
             raise ValueError(
                 "ragged generation is dense-only: MoE expert capacity is "
                 "shared batch-wide, so pad tokens would alter real rows")
-        lengths = jnp.asarray(prompt_lengths, jnp.int32)
-        if lengths.shape != (B,):
-            raise ValueError(f"prompt_lengths must be [{B}], got {lengths.shape}")
-        if isinstance(lengths, jax.core.Tracer):
-            # API contract: ragged generate() validates lengths on the host
-            # (under jit the gathers would clamp and return wrong
-            # continuations silently), so it cannot itself be traced.
-            raise ValueError(
-                "generate() with prompt_lengths must be called outside jit: "
-                "ragged length validation needs concrete values (generate "
-                "already compiles its own prefill+decode scan internally)")
-        # Concrete here (lengths are a call-time array, not traced): reject
-        # out-of-range rows loudly — under jit the gathers would clamp and
-        # return wrong continuations silently.
-        if bool((lengths < 1).any()) or bool((lengths > P).any()):
-            raise ValueError(
-                f"prompt_lengths must be in [1, {P}]; got {lengths.tolist()}")
+        lengths = validate_prompt_lengths(prompt_lengths, B, P)
     else:
         lengths = jnp.zeros((B,), jnp.int32)  # unused placeholder
     run = _compiled_generate(cfg, B, P, max_new_tokens, max_len,

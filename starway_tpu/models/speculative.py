@@ -212,7 +212,7 @@ def _lookup_propose(seq, pos, *, ngram: int, gamma: int):
 def _compiled_lookup(cfg: LlamaConfig, B: int, P: int, max_new: int,
                      max_len: int, gamma: int, ngram: int,
                      temperature: float, top_k: Optional[int],
-                     top_p: Optional[float]):
+                     top_p: Optional[float], ragged: bool = False):
     """jit'd prompt-lookup speculative generation: the model-draft driver
     with the draft scan replaced by :func:`_lookup_propose` over a
     sequence buffer — ONE model (the target) runs at all, so every
@@ -225,21 +225,27 @@ def _compiled_lookup(cfg: LlamaConfig, B: int, P: int, max_new: int,
         return jax.nn.softmax(_filter_logits(logits, temperature, top_k,
                                              top_p), axis=-1)
 
-    def run(params, prompt, key):
-        t_logits, t_cache = prefill(params, cfg, prompt, max_len)
+    def run(params, prompt, key, lengths):
+        lp = (lengths - 1) if ragged else None
+        t_logits, t_cache = prefill(params, cfg, prompt, max_len,
+                                    logit_positions=lp)
         key, sub = jax.random.split(key)
         t0 = _sample(t_logits, sub, temperature, top_k, top_p)
+        pos0 = lengths if ragged else jnp.full((B,), P, jnp.int32)
 
         # Sequence buffer: prompt, then every emitted token at its
         # absolute position (the lookup corpus grows as generation runs).
+        # Ragged rows carry right-pad junk at lengths..P-1, but matching
+        # only scans j < pos and emits overwrite from lengths upward, so
+        # junk is never a lookup key or a copied span before it is
+        # replaced.
         seq = jnp.zeros((B, max_len), jnp.int32)
         seq = lax.dynamic_update_slice(seq, prompt, (0, 0))
-        seq = seq.at[:, P].set(t0)
+        seq = seq.at[jnp.arange(B), pos0].set(t0)
 
         out = jnp.zeros((B, max_new + G), jnp.int32)
         out = out.at[:, 0].set(t0)
         n_out = jnp.ones((B,), jnp.int32)
-        pos0 = jnp.full((B,), P, jnp.int32)
         stats0 = jnp.zeros((B, 2), jnp.int32)
 
         def macro(carry):
@@ -280,7 +286,7 @@ def _compiled_lookup(cfg: LlamaConfig, B: int, P: int, max_new: int,
 def _compiled_speculative(cfg: LlamaConfig, draft_cfg: LlamaConfig, B: int,
                           P: int, max_new: int, max_len: int, gamma: int,
                           temperature: float, top_k: Optional[int],
-                          top_p: Optional[float]):
+                          top_p: Optional[float], ragged: bool = False):
     """jit'd speculative generation for one (shape, sampling) signature.
 
     One dispatch: target+draft prefill, then a ``lax.while_loop`` of macro
@@ -301,9 +307,15 @@ def _compiled_speculative(cfg: LlamaConfig, draft_cfg: LlamaConfig, B: int,
         return jax.nn.softmax(_filter_logits(logits, temperature, top_k,
                                              top_p), axis=-1)
 
-    def run(params, draft_params, prompt, key):
-        t_logits, t_cache = prefill(params, cfg, prompt, max_len)
-        _, d_cache = prefill(draft_params, draft_cfg, prompt, max_len)
+    def run(params, draft_params, prompt, key, lengths):
+        # Ragged: right-padded prompts, per-row cursors from the start
+        # (the per-row position plumbing is the same machinery the
+        # variable-acceptance advance uses anyway).
+        lp = (lengths - 1) if ragged else None
+        t_logits, t_cache = prefill(params, cfg, prompt, max_len,
+                                    logit_positions=lp)
+        _, d_cache = prefill(draft_params, draft_cfg, prompt, max_len,
+                             logit_positions=lp)
 
         key, sub = jax.random.split(key)
         t0 = _sample(t_logits, sub, temperature, top_k, top_p)  # [B]
@@ -311,7 +323,7 @@ def _compiled_speculative(cfg: LlamaConfig, draft_cfg: LlamaConfig, B: int,
         out = jnp.zeros((B, max_new + G), jnp.int32)
         out = out.at[:, 0].set(t0)
         n_out = jnp.ones((B,), jnp.int32)
-        pos0 = jnp.full((B,), P, jnp.int32)
+        pos0 = lengths if ragged else jnp.full((B,), P, jnp.int32)
         stats0 = jnp.zeros((B, 2), jnp.int32)  # [macro steps, accepted]
 
         def macro(carry):
@@ -383,6 +395,7 @@ def generate_speculative(params: dict, cfg: LlamaConfig, draft_params: dict,
                          top_k: Optional[int] = None,
                          top_p: Optional[float] = None,
                          eos_id: Optional[int] = None,
+                         prompt_lengths=None,
                          return_stats: bool = False):
     """Speculative generation: the TARGET model's output at a fraction of
     its decode steps.  prompt: [B, P] int32; returns ``[B, P +
@@ -412,6 +425,10 @@ def generate_speculative(params: dict, cfg: LlamaConfig, draft_params: dict,
     length ``a``, making the amortisation ``a + 1`` visible so a cold
     draft is distinguishable from a working one without timings.
 
+    ``prompt_lengths`` ([B] ints, RIGHT-padded prompt): ragged batches —
+    every row speculates from its own cursor; returns only the NEW
+    tokens ``[B, max_new_tokens]`` (the ragged ``generate`` contract).
+
     Requirements: same vocab on both models; dense-only (MoE capacity is
     computed per forward, so a chunk verify would route differently than
     stepwise decode); full caches (no sliding-window rolling).
@@ -423,6 +440,7 @@ def generate_speculative(params: dict, cfg: LlamaConfig, draft_params: dict,
         raise ValueError(
             f"target and draft must share a vocab: {cfg.vocab_size} != "
             f"{draft_cfg.vocab_size}")
+    lengths = _validate_lengths(prompt_lengths, B, P)
     if key is None:
         key = jax.random.PRNGKey(0)
     # Cache headroom: a macro step may write up to gamma - 1 positions
@@ -430,9 +448,11 @@ def generate_speculative(params: dict, cfg: LlamaConfig, draft_params: dict,
     max_len = P + max_new_tokens + gamma
     run = _compiled_speculative(cfg, draft_cfg, B, P, max_new_tokens,
                                 max_len, int(gamma), float(temperature),
-                                top_k, top_p)
-    toks, stats = run(params, draft_params, prompt, key)
-    return _finish_spec(prompt, toks, stats, eos_id, return_stats)
+                                top_k, top_p,
+                                ragged=prompt_lengths is not None)
+    toks, stats = run(params, draft_params, prompt, key, lengths)
+    return _finish_spec(prompt, toks, stats, eos_id, return_stats,
+                        ragged=prompt_lengths is not None)
 
 
 def _validate_spec_args(max_new_tokens: int, gamma: int, *cfgs):
@@ -455,15 +475,27 @@ def _validate_spec_args(max_new_tokens: int, gamma: int, *cfgs):
                 f"sliding window); rolling-cache support is not wired")
 
 
-def _finish_spec(prompt, toks, stats, eos_id, return_stats):
+def _validate_lengths(prompt_lengths, B: int, P: int):
+    """generate()'s ragged-lengths contract (one shared implementation:
+    generate.py:validate_prompt_lengths), with a zero placeholder for
+    aligned batches so the compiled signature is uniform."""
+    if prompt_lengths is None:
+        return jnp.zeros((B,), jnp.int32)
+    from .generate import validate_prompt_lengths
+
+    return validate_prompt_lengths(prompt_lengths, B, P)
+
+
+def _finish_spec(prompt, toks, stats, eos_id, return_stats, ragged=False):
     """Shared tail: conventional eos-fill on the finished buffer, prompt
-    concat, optional acceptance-stats dict."""
+    concat (aligned batches; ragged returns only the new tokens, the
+    generate() contract), optional acceptance-stats dict."""
     if eos_id is not None:
         # Everything after a row's first eos becomes eos.
         seen = jnp.cumsum((toks == eos_id).astype(jnp.int32), axis=1)
         fill = (seen - (toks == eos_id).astype(jnp.int32)) > 0
         toks = jnp.where(fill, jnp.int32(eos_id), toks)
-    out = jnp.concatenate([prompt, toks], axis=1)
+    out = toks if ragged else jnp.concatenate([prompt, toks], axis=1)
     if return_stats:
         return out, {"macro_steps": stats[:, 0], "accepted": stats[:, 1]}
     return out
@@ -476,6 +508,7 @@ def generate_lookup(params: dict, cfg: LlamaConfig, prompt,
                     top_k: Optional[int] = None,
                     top_p: Optional[float] = None,
                     eos_id: Optional[int] = None,
+                    prompt_lengths=None,
                     return_stats: bool = False):
     """Prompt-lookup speculative generation: no draft model — proposals
     are copied from the sequence's own history (continue the latest
@@ -487,17 +520,20 @@ def generate_lookup(params: dict, cfg: LlamaConfig, prompt,
     bit-identical to ``generate()``; sampling preserves the target
     distribution (deterministic proposals are the ``p_D = one-hot``
     special case of the same rejection rule).  Same contract and
-    restrictions otherwise (aligned [B, P] prompt, dense-only, full
-    caches).
+    restrictions otherwise (aligned or ragged ``prompt_lengths``
+    batches, dense-only, full caches).
     """
     B, P = prompt.shape
     _validate_spec_args(max_new_tokens, gamma, (cfg, "target"))
     if ngram < 1:
         raise ValueError(f"ngram must be >= 1, got {ngram}")
+    lengths = _validate_lengths(prompt_lengths, B, P)
     if key is None:
         key = jax.random.PRNGKey(0)
     max_len = P + max_new_tokens + gamma
     run = _compiled_lookup(cfg, B, P, max_new_tokens, max_len, int(gamma),
-                           int(ngram), float(temperature), top_k, top_p)
-    toks, stats = run(params, prompt, key)
-    return _finish_spec(prompt, toks, stats, eos_id, return_stats)
+                           int(ngram), float(temperature), top_k, top_p,
+                           ragged=prompt_lengths is not None)
+    toks, stats = run(params, prompt, key, lengths)
+    return _finish_spec(prompt, toks, stats, eos_id, return_stats,
+                        ragged=prompt_lengths is not None)

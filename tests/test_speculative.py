@@ -242,6 +242,36 @@ def test_lookup_exploits_repetition(params):
     assert int(np.asarray(stats["accepted"]).sum()) > 0
 
 
+def test_ragged_speculative_matches_solo_rows(params, draft):
+    """Ragged speculative decoding (both drafters): each row's greedy
+    continuation equals its own solo aligned run over the unpadded
+    prompt — the generate() row-equivalence contract."""
+    from starway_tpu.models.speculative import generate_lookup
+
+    dcfg, dparams = draft
+    cfg = LlamaConfig.preset("debug")
+    rng = np.random.default_rng(6)
+    P, lengths = 12, [5, 12]
+    prompt = np.zeros((2, P), np.int32)
+    for i, n in enumerate(lengths):
+        prompt[i, :n] = rng.integers(1, cfg.vocab_size, n)
+    prompt = jnp.asarray(prompt)
+    lv = jnp.asarray(lengths, jnp.int32)
+
+    spec = generate_speculative(params, cfg, dparams, dcfg, prompt, 7,
+                                gamma=3, prompt_lengths=lv)
+    look = generate_lookup(params, cfg, prompt, 7, gamma=3, ngram=2,
+                           prompt_lengths=lv)
+    for i, n in enumerate(lengths):
+        solo = generate(params, cfg, prompt[i:i + 1, :n], 7)
+        np.testing.assert_array_equal(np.asarray(spec[i]),
+                                      np.asarray(solo[0, n:]),
+                                      err_msg=f"model-draft row {i}")
+        np.testing.assert_array_equal(np.asarray(look[i]),
+                                      np.asarray(solo[0, n:]),
+                                      err_msg=f"lookup row {i}")
+
+
 def test_sampled_speculative_preserves_target_distribution():
     """The rejection rule must yield the TARGET model's distribution, not
     the draft's.  Tiny 1-layer models, V=32, temperature 1: the position-
