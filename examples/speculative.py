@@ -55,7 +55,8 @@ def main() -> None:
 
     ref = generate(params, cfg, prompt, args.max_new)
 
-    from starway_tpu.models.speculative import generate_lookup
+    from starway_tpu.models.speculative import (draft_from_truncation,
+                                                generate_lookup)
 
     def report(name, out, stats):
         same = bool((out == ref).all())
@@ -68,7 +69,10 @@ def main() -> None:
               f"(gamma={args.gamma})")
         assert same, "greedy speculative output diverged from generate()"
 
+    # A FREE draft: the target's own first layer (no second checkpoint).
+    tparams, tcfg = draft_from_truncation(params, cfg, 1)
     for name, dp, dc in (("shallow draft (1L, random)", dparams, dcfg),
+                         ("truncation draft (target[:1])", tparams, tcfg),
                          ("self-draft (acceptance ~1)", params, cfg)):
         out, stats = generate_speculative(
             params, cfg, dp, dc, prompt, args.max_new, gamma=args.gamma,

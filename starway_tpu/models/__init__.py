@@ -26,8 +26,8 @@ from .pp_llama import (
     shard_ppv_params,
 )
 from .serving import SlotServer
-from .speculative import (chunk_decode_step, generate_lookup,
-                          generate_speculative)
+from .speculative import (chunk_decode_step, draft_from_truncation,
+                          generate_lookup, generate_speculative)
 
 __all__ = [
     "LlamaConfig",
@@ -48,6 +48,7 @@ __all__ = [
     "shard_ppv_params",
     "SlotServer",
     "chunk_decode_step",
+    "draft_from_truncation",
     "generate_lookup",
     "generate_speculative",
 ]

@@ -29,6 +29,7 @@ the TPU build's serving-stack extension implementing the public algorithm.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Optional
 
@@ -172,6 +173,30 @@ def _accept_emit(drafts, pd, t_logits, key, out, n_out, t_pend, pos, stats,
     stats = stats + jnp.stack([live, live * a], axis=1)
     return (out, n_out, jnp.where(adv == a + 1, c, t_pend), pos + adv, key,
             stats, emit)
+
+
+def draft_from_truncation(params: dict, cfg: LlamaConfig, n_layers: int):
+    """A FREE draft model: the target's first ``n_layers`` decoder layers
+    with the same embedding, final norm, and head — no second checkpoint,
+    no training.  The stacked-layer parameter tree makes this a slice:
+    every ``layers`` leaf leads with the layer axis.
+
+    Truncated ("early-exit") drafts are a standard speculative-decoding
+    baseline: early layers already predict easy tokens, and easy tokens
+    are where acceptance pays.  Returns ``(draft_params, draft_cfg)``
+    ready for :func:`generate_speculative`.  Memory: the non-layer leaves
+    (embed, final norm, head) are SHARED with the target; the sliced
+    ``layers`` leaves are materialised by jax at call time (~n_layers /
+    cfg.n_layers of the stacked weights) — budget for that extra HBM on
+    a tightly packed chip.
+    """
+    if not 1 <= n_layers < cfg.n_layers:
+        raise ValueError(
+            f"n_layers must be in [1, {cfg.n_layers - 1}], got {n_layers}")
+    draft_params = dict(params)
+    draft_params["layers"] = jax.tree_util.tree_map(
+        lambda a: a[:n_layers], params["layers"])
+    return draft_params, dataclasses.replace(cfg, n_layers=n_layers)
 
 
 def _lookup_propose(seq, pos, *, ngram: int, gamma: int):

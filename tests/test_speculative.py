@@ -194,6 +194,34 @@ def test_speculative_int8_cache(params, draft):
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
 
 
+def test_truncation_draft(params):
+    """draft_from_truncation slices the stacked-layer tree: the draft is
+    the target's first k layers + shared embed/head, its config agrees,
+    greedy speculative output with it stays bit-identical, and invalid
+    depths are rejected."""
+    from starway_tpu.models.speculative import draft_from_truncation
+
+    cfg = LlamaConfig.preset("debug")  # 2 layers
+    dparams, dcfg = draft_from_truncation(params, cfg, 1)
+    assert dcfg.n_layers == 1
+    np.testing.assert_array_equal(
+        np.asarray(dparams["layers"]["wq"]),
+        np.asarray(params["layers"]["wq"][:1]))
+    assert dparams["embed"] is params["embed"]
+
+    prompt = jnp.asarray(np.random.default_rng(7).integers(
+        1, cfg.vocab_size, (2, 8), dtype=np.int32))
+    ref = generate(params, cfg, prompt, 10)
+    out = generate_speculative(params, cfg, dparams, dcfg, prompt, 10,
+                               gamma=3)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+    with pytest.raises(ValueError, match="n_layers"):
+        draft_from_truncation(params, cfg, 2)
+    with pytest.raises(ValueError, match="n_layers"):
+        draft_from_truncation(params, cfg, 0)
+
+
 def test_lookup_propose_copies_latest_match():
     """The n-gram drafter proposes the continuation of the MOST RECENT
     earlier occurrence of the current n-gram, per row."""
