@@ -300,6 +300,44 @@ def test_ragged_speculative_matches_solo_rows(params, draft):
                                       err_msg=f"lookup row {i}")
 
 
+def test_sampled_speculative_respects_target_support(params, draft):
+    """With top-k filtering, every sampled-speculative token must lie in
+    the TARGET's top-k set at its own position (teacher-forced check) —
+    plain generate() can never leave that support, so neither may the
+    rejection rule (the strict-inequality contract, checked extensionally
+    across many emitted tokens and both drafters)."""
+    from starway_tpu.models.generate import _filter_logits
+    from starway_tpu.models.llama import forward
+    from starway_tpu.models.speculative import generate_lookup
+
+    dcfg, dparams = draft
+    cfg = LlamaConfig.preset("debug")
+    TOP_K = 4
+    prompt = jnp.asarray(np.random.default_rng(8).integers(
+        1, cfg.vocab_size, (2, 6), dtype=np.int32))
+
+    outs = [
+        generate_speculative(params, cfg, dparams, dcfg, prompt, 10,
+                             gamma=3, temperature=1.0, top_k=TOP_K,
+                             key=jax.random.PRNGKey(11)),
+        generate_lookup(params, cfg, prompt, 10, gamma=3, ngram=2,
+                        temperature=1.0, top_k=TOP_K,
+                        key=jax.random.PRNGKey(12)),
+    ]
+    for out in outs:
+        # Teacher-force the full output; token at column j+1 must be in
+        # the filtered support of the logits at column j.
+        logits = forward(params, out[:, :-1], cfg)
+        filt = _filter_logits(logits, 1.0, TOP_K, None)
+        P = prompt.shape[1]
+        for b in range(out.shape[0]):
+            for j in range(P - 1, out.shape[1] - 1):
+                tok = int(out[b, j + 1])
+                assert float(filt[b, j, tok]) > -1e29, (
+                    f"row {b} col {j + 1}: token {tok} outside the "
+                    f"target's top-{TOP_K} support")
+
+
 def test_sampled_speculative_preserves_target_distribution():
     """The rejection rule must yield the TARGET model's distribution, not
     the draft's.  Tiny 1-layer models, V=32, temperature 1: the position-
