@@ -305,9 +305,10 @@ def test_sampled_speculative_respects_target_support(params, draft):
     the TARGET's top-k set at its own position (teacher-forced check) —
     plain generate() can never leave that support, so neither may the
     rejection rule (the strict-inequality contract, checked extensionally
-    across many emitted tokens and both drafters)."""
-    from starway_tpu.models.generate import _filter_logits
-    from starway_tpu.models.llama import forward
+    across many emitted tokens and both drafters).  A small epsilon on
+    the kth-logit threshold absorbs float reassociation between the
+    cached decode path (which picked the token) and the teacher-forced
+    forward (which judges it here)."""
     from starway_tpu.models.speculative import generate_lookup
 
     dcfg, dparams = draft
@@ -324,18 +325,19 @@ def test_sampled_speculative_respects_target_support(params, draft):
                         temperature=1.0, top_k=TOP_K,
                         key=jax.random.PRNGKey(12)),
     ]
+    P = prompt.shape[1]
     for out in outs:
-        # Teacher-force the full output; token at column j+1 must be in
-        # the filtered support of the logits at column j.
-        logits = forward(params, out[:, :-1], cfg)
-        filt = _filter_logits(logits, 1.0, TOP_K, None)
-        P = prompt.shape[1]
-        for b in range(out.shape[0]):
-            for j in range(P - 1, out.shape[1] - 1):
-                tok = int(out[b, j + 1])
-                assert float(filt[b, j, tok]) > -1e29, (
-                    f"row {b} col {j + 1}: token {tok} outside the "
-                    f"target's top-{TOP_K} support")
+        # Teacher-force the full output; the token at column j+1 must
+        # reach the kth-largest logit at column j (up to tie epsilon).
+        logits = np.asarray(forward(params, out[:, :-1], cfg))
+        out_np = np.asarray(out)
+        gen = logits[:, P - 1:, :]  # positions emitting generated tokens
+        kth = np.sort(gen, axis=-1)[:, :, -TOP_K]
+        tok_logit = np.take_along_axis(
+            gen, out_np[:, P:, None], axis=-1)[..., 0]
+        assert bool((tok_logit >= kth - 1e-3).all()), (
+            f"tokens outside the target's top-{TOP_K} support at "
+            f"{np.argwhere(tok_logit < kth - 1e-3).tolist()}")
 
 
 def test_sampled_speculative_preserves_target_distribution():
