@@ -71,7 +71,7 @@ def _softmax_block_update(q, k, v, k_start, pos, m_scr, l_scr, acc_scr, *,
     else:
         s = s * sm_scale
     kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    q_pos = pos if row_off is None else pos + row_off[:, None]
+    q_pos = pos if row_off is None else pos + row_off  # [rows, 1]
     keep = kv_pos <= q_pos
     if window is not None:
         keep = keep & (kv_pos > q_pos - window)
@@ -95,11 +95,12 @@ def _softmax_block_update(q, k, v, k_start, pos, m_scr, l_scr, acc_scr, *,
 
 def _row_offsets(rows: int, n_q: int):
     """Row r's query-position offset in the packed [n_rep, C] row layout
-    (r = rep * C + ci -> offset ci); None when single-position."""
+    (r = rep * C + ci -> offset ci), shaped [rows, 1] (rank-2: Mosaic
+    rejects rank-1 iota); None when single-position."""
     if n_q == 1:
         return None
     return jax.lax.rem(
-        jax.lax.broadcasted_iota(jnp.int32, (rows,), 0), n_q)
+        jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0), n_q)
 
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *refs, sm_scale: float,
