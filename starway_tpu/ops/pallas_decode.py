@@ -52,10 +52,11 @@ def _softmax_block_update(q, k, v, k_start, pos, m_scr, l_scr, acc_scr, *,
     the group's query rows against one [block_k, D] cache block, mask by
     global position (and window), and fold into the m/l/acc scratches.
 
-    ``row_off`` ([rows] int32, multi-query decode): row r's query sits at
-    global position ``pos + row_off[r]`` — the speculative chunk verify
-    packs C chunk positions x n_rep query heads as the matmul rows, so
-    each row masks by its own cursor.  ``None`` = all rows at ``pos``.
+    ``row_off`` ([rows, 1] int32 — rank-2, Mosaic rejects rank-1 iota;
+    multi-query decode): row r's query sits at global position
+    ``pos + row_off[r, 0]`` — the speculative chunk verify packs C chunk
+    positions x n_rep query heads as the matmul rows, so each row masks
+    by its own cursor.  ``None`` = all rows at ``pos``.
 
     ``k_scale``/``v_scale`` ([block_k] f32, int8 cache): dequantization is
     folded into the existing algebra instead of widening the operands —
