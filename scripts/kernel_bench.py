@@ -458,6 +458,31 @@ def check_numerics():
                                   - dwr.astype(jnp.float32))))
     rows.append({"metric": "check_decode_window_onchip", "value": dwerr,
                  "unit": "max_abs_err", "ok": bool(dwerr < 2e-2)})
+
+    # Round-3 kernel paths: int8 cache (dequant folded into the stream)
+    # and multi-query decode (the speculative chunk verify).
+    from starway_tpu.ops.quantize import quantize_kv
+
+    kc8, ks = quantize_kv(kc)
+    vc8, vs = quantize_kv(vc)
+    q8k = _attend_cached(qd, kc8, vc8, pos, hq // hkv, use_pallas=True,
+                         k_scale=ks, v_scale=vs)
+    q8r = _attend_cached(qd, kc8, vc8, pos, hq // hkv, use_pallas=False,
+                         k_scale=ks, v_scale=vs)
+    q8err = float(jnp.max(jnp.abs(q8k.astype(jnp.float32)
+                                  - q8r.astype(jnp.float32))))
+    rows.append({"metric": "check_decode_int8_onchip", "value": q8err,
+                 "unit": "max_abs_err", "ok": bool(q8err < 2e-2)})
+
+    C = 5
+    qc = jax.random.normal(kq, (b, hq, C, d), jnp.bfloat16)
+    posv = jnp.asarray([t // 2 - 3], jnp.int32)  # chunk straddles blocks
+    mqk = _attend_cached(qc, kc, vc, posv, hq // hkv, use_pallas=True)
+    mqr = _attend_cached(qc, kc, vc, posv, hq // hkv, use_pallas=False)
+    mqerr = float(jnp.max(jnp.abs(mqk.astype(jnp.float32)
+                                  - mqr.astype(jnp.float32))))
+    rows.append({"metric": "check_decode_multiquery_onchip", "value": mqerr,
+                 "unit": "max_abs_err", "ok": bool(mqerr < 2e-2)})
     return rows
 
 
