@@ -528,7 +528,8 @@ def bench_decode_tune(b=1, hq=8, hkv=2, t=8192, d=128, iters: int = 64):
 
 
 def bench_serve(batch=1, model="llama", ragged=False, prompt_len=512,
-                m_lo=32, m_hi=1056, reps=4, iters=None, kv_quant="none"):
+                m_lo=32, m_hi=1056, reps=4, iters=None, kv_quant="none",
+                weights="none"):
     """End-to-end serving throughput: tokens/s for the REAL ``generate()``
     surface (flash prefill + cached decode scan + top-k/top-p sampling; the
     Mistral variant decodes through the O(window) rolling cache).
@@ -557,6 +558,10 @@ def bench_serve(batch=1, model="llama", ragged=False, prompt_len=512,
         kw["sliding_window"] = prompt_len
     cfg = LlamaConfig.preset("debug", **kw)
     params = init_params(jax.random.PRNGKey(0), cfg)
+    if weights == "int8":
+        from starway_tpu.ops.quantize import quantize_params
+
+        params = quantize_params(params)
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(
         rng.integers(1, cfg.vocab_size, (batch, prompt_len), dtype=np.int32))
@@ -576,7 +581,8 @@ def bench_serve(batch=1, model="llama", ragged=False, prompt_len=512,
         jax.block_until_ready(out)
 
     name = (f"serve_{model}{'_ragged' if ragged else ''}"
-            f"{'_int8' if kv_quant == 'int8' else ''}_b{batch}")
+            f"{'_int8' if kv_quant == 'int8' else ''}"
+            f"{'_w8' if weights == 'int8' else ''}_b{batch}")
     # Jitter guard (same concern _timeit documents: tens-of-ms tunnel
     # jitter): grow the hi/lo gap until the differenced time comfortably
     # clears it, and REFUSE to report a number when it never does — a
@@ -620,7 +626,43 @@ def bench_serve(batch=1, model="llama", ragged=False, prompt_len=512,
                       f"(P={prompt_len}, overhead {overhead_ms:.1f} ms/call "
                       f"= prefill+dispatch+host), sampling top_k=64 "
                       f"top_p=0.9, {cfg.n_layers}L d{cfg.d_model} GQA "
-                      f"{cfg.n_heads}/{cfg.n_kv_heads} bf16"}
+                      f"{cfg.n_heads}/{cfg.n_kv_heads} "
+                      f"{'W8' if weights == 'int8' else 'bf16'}"
+                      f"{'+KV8' if kv_quant == 'int8' else ''}"}
+
+
+def bench_gemv_int8(m=1, d=4096, f=14336, iters: int = 32):
+    """W8A16 weight-stream bandwidth: x [m, d] @ int8 W [d, f] (pallas
+    gemv, scale folded post-matmul) vs the same matmul on bf16 weights —
+    small-batch decode is weight-bound, so the int8 stream's ceiling is
+    ~2x.  Shape defaults to a Llama-8B MLP projection."""
+    from starway_tpu.ops.pallas_gemv import int8_matmul
+    from starway_tpu.ops.quantize import quantize_weight
+
+    kx, kw = jax.random.split(jax.random.PRNGKey(0), 2)
+    x = jax.random.normal(kx, (m, d), jnp.bfloat16)
+    w = jax.random.normal(kw, (d, f), jnp.bfloat16)
+    qw = quantize_weight(w)
+    wq, s = qw["q"], qw["s"]
+
+    def k_int8(x, wq, s):
+        return int8_matmul(x, wq, s)
+
+    def k_bf16(x, w):
+        return jnp.dot(x, w, preferred_element_type=jnp.float32).astype(
+            jnp.bfloat16)
+
+    dt_q = _timeit(lambda x, wq, s, iters: _chain(k_int8, x, wq, s,
+                                                  iters=iters),
+                   x, wq, s, iters=iters)
+    dt_b = _timeit(lambda x, w, iters: _chain(k_bf16, x, w, iters=iters),
+                   x, w, iters=iters)
+    by_q, by_b = d * f, 2 * d * f
+    return {"metric": "gemv_int8_speedup", "value": round(dt_b / dt_q, 2),
+            "unit": "x_vs_bf16",
+            "detail": f"m={m} d={d} f={f}: int8 {dt_q * 1e6:.1f} us "
+                      f"({by_q / dt_q / 1e9:.0f} GB/s) vs bf16 "
+                      f"{dt_b * 1e6:.1f} us ({by_b / dt_b / 1e9:.0f} GB/s)"}
 
 
 def bench_spec_verify(gamma=8, t=4096, iters: int = 16):
@@ -748,6 +790,9 @@ BENCHES = {
     "serve_b8": functools.partial(bench_serve, batch=8),
     "serve_int8_b8": functools.partial(bench_serve, batch=8,
                                        kv_quant="int8"),
+    "serve_w8_b1": functools.partial(bench_serve, kv_quant="int8",
+                                     weights="int8"),
+    "gemv_int8": bench_gemv_int8,
     "serve_ragged_b8": functools.partial(bench_serve, batch=8, ragged=True),
     "serve_mistral": functools.partial(bench_serve, model="mistral"),
     "serve_continuous": bench_serve_continuous,
@@ -775,8 +820,9 @@ def main():
         # `bench.py --kernels` pass from minutes to an hour behind the
         # tunnel.  onchip_refresh.sh runs them individually.
         heavy = ("serve", "serve_b8", "serve_ragged_b8", "serve_mistral",
-                 "serve_int8_b8", "serve_continuous", "train_mfu_large",
-                 "decode_shapes", "spec_verify")
+                 "serve_int8_b8", "serve_w8_b1", "serve_continuous",
+                 "train_mfu_large", "decode_shapes", "spec_verify",
+                 "gemv_int8")
         names = [n for n in BENCHES
                  if not n.endswith("_tune") and n not in heavy]
     else:

@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .llama import LlamaConfig, apply_rope, forward, rmsnorm, rope_tables
+from .llama import (LlamaConfig, apply_rope, forward, matmul_w, rmsnorm,
+                    rope_tables)
 from ..ops.attention import NEG_BIG, repeat_kv
 
 
@@ -167,7 +168,7 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
     h, out = cached_layer_scan(params, cache, h, cos_p, sin_p, cfg, write,
                                attend)
     h = rmsnorm(h, params["final_norm"], cfg.norm_eps)
-    logits = (h[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
+    logits = matmul_w(h[:, 0, :], params["lm_head"]).astype(jnp.float32)
     return logits, out
 
 
@@ -198,9 +199,9 @@ def cached_layer_scan(params, cache, h, cos_p, sin_p, cfg: LlamaConfig,
             lp, kc, vc = xs
             ksc = vsc = None
         x = rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
-        q = (x @ lp["wq"]).reshape(B, C, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-        k = (x @ lp["wk"]).reshape(B, C, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
-        v = (x @ lp["wv"]).reshape(B, C, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+        q = matmul_w(x, lp["wq"]).reshape(B, C, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        k = matmul_w(x, lp["wk"]).reshape(B, C, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+        v = matmul_w(x, lp["wv"]).reshape(B, C, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
         q = apply_rope(q, cos_p, sin_p)
         k = apply_rope(k, cos_p, sin_p)
         if quant:
@@ -218,7 +219,7 @@ def cached_layer_scan(params, cache, h, cos_p, sin_p, cfg: LlamaConfig,
             layer_cache["k_scale"], layer_cache["v_scale"] = ksc, vsc
         o = attend(q, layer_cache)
         o = o.transpose(0, 2, 1, 3).reshape(B, C, cfg.n_heads * hd)
-        h = h + o @ lp["wo"]
+        h = h + matmul_w(o, lp["wo"])
 
         x = rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
         if cfg.n_experts > 0:
@@ -230,8 +231,8 @@ def cached_layer_scan(params, cache, h, cos_p, sin_p, cfg: LlamaConfig,
             )
             h = h + y
         else:
-            gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-            h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
+            gate = jax.nn.silu(matmul_w(x, lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+            h = h + matmul_w(gate * matmul_w(x, lp["w_up"]), lp["w_down"])
         return (h,), (kc, vc) + ((ksc, vsc) if quant else ())
 
     xs = (params["layers"], cache["k"], cache["v"])

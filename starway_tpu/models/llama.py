@@ -170,6 +170,31 @@ def param_specs(cfg: LlamaConfig) -> dict:
 # ----------------------------------------------------------------- kernels
 
 
+def matmul_w(x, w):
+    """``x @ w`` where ``w`` is a raw array or a weight-quantized
+    ``{"q": int8, "s": f32}`` pair (ops/quantize.py:quantize_params —
+    the W8A16 serving tree).  Quantized weights stream at half width on
+    TPU through the pallas gemv kernel (ops/pallas_gemv.py) with the
+    per-output-channel scale folded into the product; elsewhere they
+    dequantize-then-matmul.  Every matmul consumer of the parameter tree
+    (decoder_layer, head_logits, the cached decode layer scan) routes
+    through here, so ONE quantized tree serves
+    forward/prefill/decode/serving/speculative alike."""
+    if not (isinstance(w, dict) and "q" in w):
+        return x @ w
+    wq, s = w["q"], w["s"]
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, x.shape[-1])
+    if jax.default_backend() == "tpu":
+        from ..ops.pallas_gemv import int8_matmul
+
+        out = int8_matmul(x2, wq, s)
+    else:
+        out = (x2.astype(jnp.float32)
+               @ (wq.astype(jnp.float32) * s[None, :])).astype(x.dtype)
+    return out.reshape(*lead, wq.shape[-1])
+
+
 def rmsnorm(x, w, eps: float):
     xf = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
@@ -200,7 +225,7 @@ def apply_rope(x, cos, sin):
 def head_logits(h, final_norm_w, lm_head_w, eps: float):
     """Model tail: final RMSNorm + lm_head, f32 logits.  Shared by the scan
     forward and the pipeline last stage (models/pp_llama.py)."""
-    return (rmsnorm(h, final_norm_w, eps) @ lm_head_w).astype(jnp.float32)
+    return matmul_w(rmsnorm(h, final_norm_w, eps), lm_head_w).astype(jnp.float32)
 
 
 def token_ce(logits, targets):
@@ -261,16 +286,16 @@ def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
     B, S, _ = h.shape
     hd = cfg.head_dim
     x = rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
-    q = (x @ lp["wq"]).reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-    k = (x @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
-    v = (x @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    q = matmul_w(x, lp["wq"]).reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+    k = matmul_w(x, lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    v = matmul_w(x, lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     # kv stays in grouped (narrow) form; attention impls expand it, so
     # the ring rotates 1/n_rep of the bytes over ICI.
     o = attn_fn(q, k, v)  # [B, H, S, Dh]
     o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_heads * hd)
-    h = h + o @ lp["wo"]
+    h = h + matmul_w(o, lp["wo"])
 
     x = rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
     stats = None
@@ -291,8 +316,8 @@ def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
             )
         h = h + y
     else:
-        gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-        h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
+        gate = jax.nn.silu(matmul_w(x, lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+        h = h + matmul_w(gate * matmul_w(x, lp["w_up"]), lp["w_down"])
         aux = jnp.zeros((), jnp.float32)
     return h, aux, k, v, stats
 

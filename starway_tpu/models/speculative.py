@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .generate import _filter_logits, _sample, cached_layer_scan, prefill
-from .llama import LlamaConfig, rmsnorm, rope_tables
+from .llama import LlamaConfig, matmul_w, rmsnorm, rope_tables
 
 
 def chunk_decode_step(params, cache, tokens, pos, cfg: LlamaConfig, rope):
@@ -89,7 +89,7 @@ def chunk_decode_step(params, cache, tokens, pos, cfg: LlamaConfig, rope):
     h, out = cached_layer_scan(params, cache, h, cos_p, sin_p, cfg, write,
                                attend)
     h = rmsnorm(h, params["final_norm"], cfg.norm_eps)
-    logits = (h @ params["lm_head"]).astype(jnp.float32)  # [B, C, V]
+    logits = matmul_w(h, params["lm_head"]).astype(jnp.float32)  # [B, C, V]
     return logits, out
 
 

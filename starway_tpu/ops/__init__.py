@@ -16,5 +16,7 @@ from .collectives import (
     reduce_scatter,
     ring_shift,
 )
+from .quantize import quantize_params
 
-__all__ = ["ring_shift", "all_to_all", "all_gather", "psum", "reduce_scatter"]
+__all__ = ["ring_shift", "all_to_all", "all_gather", "psum",
+           "reduce_scatter", "quantize_params"]

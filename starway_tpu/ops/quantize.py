@@ -50,3 +50,50 @@ def dequantize_kv(q, scale, dtype=jnp.bfloat16):
     """Inverse of :func:`quantize_kv` (up to rounding): ``q int8 [..., D]``
     times ``scale [...]`` broadcast over the last axis."""
     return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def quantize_weight(w):
+    """Weight-only int8 (W8A16), symmetric per-OUTPUT-channel: ``w [...,
+    D, F]`` -> ``{"q": int8 same shape, "s": f32 [..., F]}`` with
+    ``w ~= q * s`` broadcast over rows.  Per-out-channel scales commute
+    with the matmul (``(x @ q) * s == x @ (q * s)``), so dequantization
+    folds into the PRODUCT — the weight stream stays int8 end to end
+    (ops/pallas_gemv.py).  Leading axes (the stacked-layer dim) are
+    batch dims of the scheme."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
+    scale = amax / INT8_MAX
+    div = jnp.where(scale > 0.0, scale, 1.0)[..., None, :]
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / div), -INT8_MAX, INT8_MAX)
+    return {"q": q.astype(jnp.int8), "s": scale}
+
+
+# The matmul weights of the Llama tree (models/llama.py:init_params):
+# everything consumed as ``x @ w``.  embed stays wide (it is a GATHER,
+# not a matmul — rows leave one at a time); norms are vectors.
+_MATMUL_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_params(params: dict):
+    """Weight-only int8 serving tree: every matmul weight of a (dense)
+    Llama parameter tree becomes a ``{"q", "s"}`` pair; embed, norms,
+    and anything unrecognised stay untouched.  At batch-1 decode the
+    weight stream is the dominant HBM bill (~2 bytes/param/token in
+    bf16), so int8 weights are worth ~2x on the MLP-dominated share and
+    halve weight memory.  The returned tree is INFERENCE-ONLY — it flows
+    through forward/prefill/decode/serving/speculative via
+    models/llama.py:matmul_w, but optimizers and the training step
+    expect raw arrays.  MoE trees are refused (expert weights route
+    through their own dispatch; not wired)."""
+    layers = params["layers"]
+    if "moe" in layers:
+        raise NotImplementedError(
+            "quantize_params covers dense models; MoE expert weights are "
+            "not wired for weight-only int8 yet")
+    new_layers = dict(layers)
+    for name in _MATMUL_LEAVES:
+        if name in new_layers:
+            new_layers[name] = quantize_weight(new_layers[name])
+    out = dict(params)
+    out["layers"] = new_layers
+    out["lm_head"] = quantize_weight(params["lm_head"])
+    return out
