@@ -95,16 +95,25 @@ def _np(w) -> np.ndarray:
     return np.asarray(w, dtype=np.float32)
 
 
-def params_from_hf(model_or_state: Any, cfg: LlamaConfig, dtype=None) -> dict:
+def params_from_hf(model_or_state: Any, cfg: LlamaConfig, dtype=None, *,
+                   quantize: str = "none") -> dict:
     """Convert a ``LlamaForCausalLM`` (or its ``state_dict()``) into this
     framework's stacked-layer parameter pytree, cast to ``dtype`` (default:
     ``cfg.compute_dtype``).
 
     Each leaf is cast and committed to jax AS it is converted, so peak host
     memory is the source checkpoint plus one stacked leaf's f32 scratch —
-    not a second full-tree copy."""
+    not a second full-tree copy.
+
+    ``quantize="int8"``: return the W8A16 serving tree
+    (ops/quantize.py:quantize_params applied after conversion) — every
+    matmul weight as per-output-channel int8 + scales, half the weight
+    HBM, inference-only (see models/llama.py:matmul_w)."""
     import jax.numpy as jnp
 
+    if quantize not in ("none", "int8"):
+        # Before the conversion work, not after.
+        raise ValueError(f"quantize must be 'none' or 'int8', got {quantize!r}")
     if hasattr(model_or_state, "state_dict"):
         state = {k: v for k, v in model_or_state.state_dict().items()}
     else:
@@ -136,9 +145,14 @@ def params_from_hf(model_or_state: Any, cfg: LlamaConfig, dtype=None) -> dict:
         lm_head = jnp.asarray(_t(state["lm_head.weight"]), dt)
     else:  # tied embeddings
         lm_head = embed.T
-    return {
+    params = {
         "embed": embed,
         "layers": layers,
         "final_norm": jnp.asarray(_np(get("norm.weight")), dt),
         "lm_head": lm_head,
     }
+    if quantize == "int8":
+        from ..ops.quantize import quantize_params
+
+        return quantize_params(params)
+    return params

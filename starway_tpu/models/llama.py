@@ -167,6 +167,29 @@ def param_specs(cfg: LlamaConfig) -> dict:
     }
 
 
+def quantized_param_specs(cfg: LlamaConfig) -> dict:
+    """GSPMD PartitionSpec tree for a W8A16 tree
+    (ops/quantize.py:quantize_params): each matmul leaf's raw spec
+    applies to its ``q``, and its ``s`` (which drops the contracted
+    axis, -2) keeps only the leading/output dims of that spec — so tp
+    still shards the output channels and the scales follow them."""
+    specs = param_specs(cfg)
+
+    def split(spec):
+        return {"q": spec, "s": P(*spec[:-2], spec[-1])}
+
+    from ..ops.quantize import _MATMUL_LEAVES
+
+    layers = dict(specs["layers"])
+    for name in _MATMUL_LEAVES:
+        if name in layers:
+            layers[name] = split(layers[name])
+    out = dict(specs)
+    out["layers"] = layers
+    out["lm_head"] = split(specs["lm_head"])
+    return out
+
+
 # ----------------------------------------------------------------- kernels
 
 

@@ -119,6 +119,65 @@ def test_w8_generate_quality(params, qparams):
     assert float((out_f == out_q).mean()) >= 0.6
 
 
+def test_w8_hf_convert_quantize(params):
+    """params_from_hf(quantize='int8') returns the W8 tree directly from
+    a checkpoint (round-tripped through a state-dict here)."""
+    pytest.importorskip("torch")
+    import torch
+
+    from starway_tpu.models import params_from_hf
+
+    cfg = LlamaConfig.preset("debug")
+    # Build a state dict shaped like HF's from our own tree.
+    state = {}
+    for i in range(cfg.n_layers):
+        lp = {k: np.asarray(v[i], np.float32)
+              for k, v in params["layers"].items()}
+        state[f"model.layers.{i}.self_attn.q_proj.weight"] = torch.tensor(lp["wq"].T)
+        state[f"model.layers.{i}.self_attn.k_proj.weight"] = torch.tensor(lp["wk"].T)
+        state[f"model.layers.{i}.self_attn.v_proj.weight"] = torch.tensor(lp["wv"].T)
+        state[f"model.layers.{i}.self_attn.o_proj.weight"] = torch.tensor(lp["wo"].T)
+        state[f"model.layers.{i}.mlp.gate_proj.weight"] = torch.tensor(lp["w_gate"].T)
+        state[f"model.layers.{i}.mlp.up_proj.weight"] = torch.tensor(lp["w_up"].T)
+        state[f"model.layers.{i}.mlp.down_proj.weight"] = torch.tensor(lp["w_down"].T)
+        state[f"model.layers.{i}.input_layernorm.weight"] = torch.tensor(lp["attn_norm"])
+        state[f"model.layers.{i}.post_attention_layernorm.weight"] = torch.tensor(lp["mlp_norm"])
+    state["model.embed_tokens.weight"] = torch.tensor(np.asarray(params["embed"], np.float32))
+    state["model.norm.weight"] = torch.tensor(np.asarray(params["final_norm"], np.float32))
+    state["lm_head.weight"] = torch.tensor(np.asarray(params["lm_head"], np.float32).T)
+
+    qp = params_from_hf(state, cfg, quantize="int8")
+    assert qp["layers"]["wq"]["q"].dtype == jnp.int8
+    ref = quantize_params(params)
+    np.testing.assert_allclose(np.asarray(qp["layers"]["wq"]["q"], np.int32),
+                               np.asarray(ref["layers"]["wq"]["q"], np.int32),
+                               atol=1)  # f32<->torch round-trip ulp
+    with pytest.raises(ValueError, match="quantize"):
+        params_from_hf(state, cfg, quantize="fp4")
+
+
+def test_w8_tp_sharded(params, qparams):
+    """Tensor-parallel W8 serving on the virtual mesh: the quantized tree
+    shards via quantized_param_specs (q under the raw spec, scales on the
+    surviving output dims) and reproduces the unsharded W8 greedy
+    output."""
+    from jax.sharding import NamedSharding
+
+    from starway_tpu.models.llama import quantized_param_specs
+    from starway_tpu.parallel import make_mesh
+
+    cfg = LlamaConfig.preset("debug")
+    prompt = jnp.asarray([[3, 1, 4, 1, 5]], dtype=jnp.int32)
+    ref = generate(qparams, cfg, prompt, 6)
+
+    mesh = make_mesh({"tp": 2})
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        qparams, quantized_param_specs(cfg))
+    out = generate(sharded, cfg, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 def test_w8_serving_paths(params, qparams):
     """One quantized tree through every serving surface: ragged generate,
     int8-KV combination, SlotServer, and speculative (the W8 model is its
