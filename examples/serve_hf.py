@@ -25,6 +25,9 @@ def main() -> None:
                     help="local HF checkpoint dir (default: tiny random model)")
     ap.add_argument("--max-new", type=int, default=12)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--quantize", choices=["none", "int8"], default="none",
+                    help="int8 = W8A16 weight-only serving tree "
+                         "(half the weight HBM; see ops/quantize.py)")
     args = ap.parse_args()
 
     import jax
@@ -52,9 +55,10 @@ def main() -> None:
 
     cfg = config_from_hf(hf.config, dtype="float32" if args.model is None
                          else "bfloat16")
-    params = params_from_hf(hf, cfg)
+    params = params_from_hf(hf, cfg, quantize=args.quantize)
     print(f"converted: {cfg.n_layers}L d={cfg.d_model} "
-          f"Hq={cfg.n_heads}/Hkv={cfg.n_kv_heads} V={cfg.vocab_size}")
+          f"Hq={cfg.n_heads}/Hkv={cfg.n_kv_heads} V={cfg.vocab_size}"
+          f"{' (W8A16 int8 weights)' if args.quantize == 'int8' else ''}")
 
     # A ragged batch: three "requests" of different lengths, one dispatch.
     rows = [[11, 3, 9, 1, 4, 2, 8], [7, 5], [2, 6, 1, 9]]
@@ -77,10 +81,12 @@ def main() -> None:
     print(f"with eos_id={eos}: request 0 -> {list(map(int, filled[0]))}")
 
     # Token-exact cross-check only in the controlled configuration: greedy
-    # + the f32 demo model.  (A real --model runs bf16 here vs f32 in
-    # transformers, and transformers may stop early at its eos — tokens
-    # can legitimately differ.)
-    if args.temperature == 0.0 and args.model is None:
+    # + the f32 demo model + full-precision weights.  (A real --model runs
+    # bf16 here vs f32 in transformers, quantized weights are a slightly
+    # different model by design, and transformers may stop early at its
+    # eos — tokens can legitimately differ.)
+    if (args.temperature == 0.0 and args.model is None
+            and args.quantize == "none"):
         with torch.no_grad():
             ref = hf.generate(torch.tensor([rows[0]]), max_new_tokens=args.max_new,
                               do_sample=False, pad_token_id=0).numpy()

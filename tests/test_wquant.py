@@ -178,6 +178,42 @@ def test_w8_tp_sharded(params, qparams):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_w8_rolling_window(params, qparams):
+    """Sliding-window models serve with W8 weights too: matmul_w sits
+    under the rolling chunk step and rolling decode alike, so the W8
+    tree's rolling SlotServer requests match its own primitive oracle
+    (the same discipline as the fp/int8-KV rolling pins)."""
+    from starway_tpu.models.generate import _sample, decode_step
+    from starway_tpu.models.llama import rope_tables
+    from starway_tpu.models.serving import _rolling_prefill_state
+
+    cfg = LlamaConfig.preset("debug", sliding_window=6)
+
+    def oracle(prompt, max_new, horizon):
+        logits, cache = _rolling_prefill_state(
+            qparams, cfg, np.asarray(prompt, np.int32))
+        rope = rope_tables(horizon, cfg.head_dim, cfg.rope_theta)
+        toks = [int(_sample(logits, jax.random.PRNGKey(0), 0.0, None,
+                            None)[0])]
+        pos = len(prompt)
+        while len(toks) < max_new:
+            logits, cache = decode_step(
+                qparams, cache, jnp.asarray([toks[-1]], jnp.int32),
+                jnp.asarray([pos], jnp.int32), cfg, rope, rolling=True)
+            toks.append(int(_sample(logits, jax.random.PRNGKey(0), 0.0,
+                                    None, None)[0]))
+            pos += 1
+        return np.asarray(toks, np.int32)
+
+    srv = SlotServer(qparams, cfg, n_slots=2, max_len=40, chunk=4)
+    reqs = [([5, 1, 7, 2, 9, 4, 3, 8], 5), ([3, 8], 6)]
+    rids = [srv.submit(p, m) for p, m in reqs]
+    done = srv.run()
+    for rid, (prompt, max_new) in zip(rids, reqs):
+        np.testing.assert_array_equal(done[rid], oracle(prompt, max_new, 40),
+                                      err_msg=f"request {rid}")
+
+
 def test_w8_serving_paths(params, qparams):
     """One quantized tree through every serving surface: ragged generate,
     int8-KV combination, SlotServer, and speculative (the W8 model is its
