@@ -63,3 +63,37 @@ def pytest_pyfunc_call(pyfuncitem):
         kwargs = {n: pyfuncitem.funcargs[n] for n in pyfuncitem._fixtureinfo.argnames}
         asyncio.run(fn(**kwargs))
         return True
+
+
+def rolling_primitive_oracle(params, cfg):
+    """Single-request greedy oracle over the SAME primitives rolling
+    SlotServer admission uses (prefill_rolling chunks + rolling
+    decode_step + greedy sample) — the bit-exact reference the rolling
+    continuous-batching tests pin against (fp, int8-KV, and W8 variants
+    all share this one loop)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from starway_tpu.models.generate import _sample, decode_step
+    from starway_tpu.models.llama import rope_tables
+    from starway_tpu.models.serving import _rolling_prefill_state
+
+    def oracle(prompt, max_new, horizon):
+        logits, cache = _rolling_prefill_state(
+            params, cfg, np.asarray(prompt, np.int32))
+        rope = rope_tables(horizon, cfg.head_dim, cfg.rope_theta)
+        toks = [int(_sample(logits, jax.random.PRNGKey(0), 0.0, None,
+                            None)[0])]
+        pos = len(prompt)
+        while len(toks) < max_new:
+            logits, cache = decode_step(
+                params, cache, jnp.asarray([toks[-1]], jnp.int32),
+                jnp.asarray([pos], jnp.int32), cfg, rope, rolling=True)
+            toks.append(int(_sample(logits, jax.random.PRNGKey(0), 0.0,
+                                    None, None)[0]))
+            pos += 1
+        return np.asarray(toks, np.int32)
+
+    return oracle

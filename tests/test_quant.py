@@ -204,28 +204,10 @@ def test_rolling_slotserver_int8_matches_primitive_oracle(params):
     matches a single-request loop over the SAME primitives
     (prefill_rolling + rolling decode_step + greedy sample) bit-exactly —
     the same oracle discipline as the fp rolling serving test."""
-    from starway_tpu.models.generate import _sample, decode_step
-    from starway_tpu.models.llama import rope_tables
-    from starway_tpu.models.serving import _rolling_prefill_state
+    from conftest import rolling_primitive_oracle
 
     cfg = LlamaConfig.preset("debug", kv_quant="int8", sliding_window=8)
-
-    def oracle(prompt, max_new, horizon):
-        logits, cache = _rolling_prefill_state(
-            params, cfg, np.asarray(prompt, np.int32))
-        rope = rope_tables(horizon, cfg.head_dim, cfg.rope_theta)
-        toks = [int(_sample(logits, jax.random.PRNGKey(0), 0.0, None,
-                            None)[0])]
-        pos = len(prompt)
-        while len(toks) < max_new:
-            logits, cache = decode_step(
-                params, cache, jnp.asarray([toks[-1]], jnp.int32),
-                jnp.asarray([pos], jnp.int32), cfg, rope, rolling=True)
-            toks.append(int(_sample(logits, jax.random.PRNGKey(0), 0.0,
-                                    None, None)[0]))
-            pos += 1
-        return np.asarray(toks, np.int32)
-
+    oracle = rolling_primitive_oracle(params, cfg)
     reqs = [([5, 1, 7, 2, 9, 4, 3, 8, 6, 2, 7], 6), ([3, 8], 9),
             ([1, 2, 3, 4, 5, 6, 7, 8, 9, 1, 2, 3], 4)]
     srv = SlotServer(params, cfg, n_slots=2, max_len=48, chunk=4)

@@ -132,26 +132,11 @@ def test_rolling_continuous_batching(cfg, params):
     bit-exact, so any cross-slot leak or cursor slip shows.  A second
     sanity bound: outputs match generate()'s aligned rolling path up to
     its (documented) bit-close-not-bit-equal chunked-prefill algebra."""
-    from starway_tpu.models.generate import _sample, decode_step, rope_tables
-    from starway_tpu.models.serving import _rolling_prefill_state
+    from conftest import rolling_primitive_oracle
 
     wcfg = LlamaConfig.preset("debug", sliding_window=8)
     wparams = init_params(jax.random.PRNGKey(2), wcfg)
-
-    def oracle(prompt, max_new, horizon):
-        logits, cache = _rolling_prefill_state(
-            wparams, wcfg, np.asarray(prompt, np.int32))
-        rope = rope_tables(horizon, wcfg.head_dim, wcfg.rope_theta)
-        toks = [int(_sample(logits, jax.random.PRNGKey(0), 0.0, None, None)[0])]
-        pos = len(prompt)
-        while len(toks) < max_new:
-            logits, cache = decode_step(
-                wparams, cache, jnp.asarray([toks[-1]], jnp.int32),
-                jnp.asarray([pos], jnp.int32), wcfg, rope, rolling=True)
-            toks.append(int(_sample(logits, jax.random.PRNGKey(0),
-                                    0.0, None, None)[0]))
-            pos += 1
-        return np.asarray(toks, np.int32)
+    oracle = rolling_primitive_oracle(wparams, wcfg)
 
     # Admission math sanity: the chunk+stepper state builder agrees with
     # one-shot prefill_rolling (bit-close; their partial-merge orders

@@ -183,28 +183,10 @@ def test_w8_rolling_window(params, qparams):
     under the rolling chunk step and rolling decode alike, so the W8
     tree's rolling SlotServer requests match its own primitive oracle
     (the same discipline as the fp/int8-KV rolling pins)."""
-    from starway_tpu.models.generate import _sample, decode_step
-    from starway_tpu.models.llama import rope_tables
-    from starway_tpu.models.serving import _rolling_prefill_state
+    from conftest import rolling_primitive_oracle
 
     cfg = LlamaConfig.preset("debug", sliding_window=6)
-
-    def oracle(prompt, max_new, horizon):
-        logits, cache = _rolling_prefill_state(
-            qparams, cfg, np.asarray(prompt, np.int32))
-        rope = rope_tables(horizon, cfg.head_dim, cfg.rope_theta)
-        toks = [int(_sample(logits, jax.random.PRNGKey(0), 0.0, None,
-                            None)[0])]
-        pos = len(prompt)
-        while len(toks) < max_new:
-            logits, cache = decode_step(
-                qparams, cache, jnp.asarray([toks[-1]], jnp.int32),
-                jnp.asarray([pos], jnp.int32), cfg, rope, rolling=True)
-            toks.append(int(_sample(logits, jax.random.PRNGKey(0), 0.0,
-                                    None, None)[0]))
-            pos += 1
-        return np.asarray(toks, np.int32)
-
+    oracle = rolling_primitive_oracle(qparams, cfg)
     srv = SlotServer(qparams, cfg, n_slots=2, max_len=40, chunk=4)
     reqs = [([5, 1, 7, 2, 9, 4, 3, 8], 5), ([3, 8], 6)]
     rids = [srv.submit(p, m) for p, m in reqs]
