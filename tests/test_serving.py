@@ -142,6 +142,7 @@ def test_rolling_continuous_batching(cfg, params):
     # one-shot prefill_rolling (bit-close; their partial-merge orders
     # differ) on next-token logits.
     from starway_tpu.models.generate import prefill_rolling
+    from starway_tpu.models.serving import _rolling_prefill_state
 
     probe = np.asarray([5, 1, 7, 2, 9, 4, 3, 8, 6], np.int32)
     l_hybrid, _ = _rolling_prefill_state(wparams, wcfg, probe)
