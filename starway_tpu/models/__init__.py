@@ -25,6 +25,7 @@ from .pp_llama import (
     shard_pp_params,
     shard_ppv_params,
 )
+from .beam import generate_beam
 from .serving import SlotServer
 from .speculative import (chunk_decode_step, draft_from_truncation,
                           generate_lookup, generate_speculative)
@@ -49,6 +50,7 @@ __all__ = [
     "SlotServer",
     "chunk_decode_step",
     "draft_from_truncation",
+    "generate_beam",
     "generate_lookup",
     "generate_speculative",
 ]
