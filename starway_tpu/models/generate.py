@@ -507,7 +507,8 @@ def _sample(logits, key, temperature: float, top_k: Optional[int],
 def _compiled_generate(cfg: LlamaConfig, B: int, P: int, max_new: int,
                        max_len: int, temperature: float,
                        top_k: Optional[int], top_p: Optional[float],
-                       ragged: bool = False, eos_id: Optional[int] = None):
+                       ragged: bool = False, eos_id: Optional[int] = None,
+                       want_logprobs: bool = False):
     """jit'd prefill + decode scan for one (shape, sampling) signature.
 
     The whole generation is ONE dispatch: flash prefill, then a
@@ -560,31 +561,42 @@ def _compiled_generate(cfg: LlamaConfig, B: int, P: int, max_new: int,
         done0 = jnp.zeros((B,), bool)
 
         def emit(logits, sub, done):
-            """Sample one token per row; rows already done emit eos."""
+            """Sample one token per row (+, when asked, its UNFILTERED
+            model logprob — the serving-API convention); rows already
+            done emit eos at logprob 0 (the fill is mechanical, not a
+            model event).  ``want_logprobs`` is in the compile key, so
+            the default path keeps its logprob-free graph."""
             tok = _sample(logits, sub, temperature, top_k, top_p)
+            if want_logprobs:
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits, -1), tok[:, None], -1)[:, 0]
+            else:
+                lp = jnp.zeros((B,), jnp.float32)
             if eos_id is not None:
                 tok = jnp.where(done, jnp.int32(eos_id), tok)
+                lp = jnp.where(done, 0.0, lp)
                 done = done | (tok == eos_id)
-            return tok, done
+            return tok, lp, done
 
         def step(carry, _):
             cache, logits, key, pos, done = carry
             key, sub = jax.random.split(key)
-            tok, done = emit(logits, sub, done)
+            tok, lp, done = emit(logits, sub, done)
             logits, cache = decode_step(params, cache, tok, pos, cfg, rope,
                                         rolling=rolling)
-            return (cache, logits, key, pos + 1, done), tok
+            return (cache, logits, key, pos + 1, done), (tok, lp)
 
         # Scan max_new - 1 sample->decode pairs, then sample the final token
         # outside the scan: its decode_step would compute logits nothing
         # ever reads.
         init = (cache, logits, key, pos0, done0)
-        (cache, logits, key, _, done), toks = lax.scan(
+        (cache, logits, key, _, done), (toks, lps) = lax.scan(
             step, init, None, length=max_new - 1)
         key, sub = jax.random.split(key)
-        last, _ = emit(logits, sub, done)
+        last, last_lp, _ = emit(logits, sub, done)
         toks = jnp.concatenate([toks, last[None]], axis=0)
-        return toks.T  # [B, max_new]
+        lps = jnp.concatenate([lps, last_lp[None]], axis=0)
+        return toks.T, lps.T  # [B, max_new] each
 
     return jax.jit(run)
 
@@ -593,7 +605,7 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
              *, temperature: float = 0.0, key: Optional[jax.Array] = None,
              max_len: Optional[int] = None, top_k: Optional[int] = None,
              top_p: Optional[float] = None, prompt_lengths=None,
-             eos_id: Optional[int] = None):
+             eos_id: Optional[int] = None, return_logprobs: bool = False):
     """Autoregressive generation.  prompt: [B, P] int32.
 
     Aligned batch (default): returns ``[B, P + max_new_tokens]`` (prompt +
@@ -608,6 +620,13 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
     mixed prompt sizes.  Returns only the NEW tokens ``[B,
     max_new_tokens]`` (row b's continuation of ``prompt[b, :lengths[b]]``;
     the caller stitches ragged rows).
+
+    ``return_logprobs``: additionally return ``[B, max_new_tokens]`` f32 —
+    each emitted token's UNFILTERED model logprob (log-softmax of the raw
+    logits at its position, the serving-API convention, regardless of
+    temperature/top-k/top-p), with eos-fill positions at 0.0 (the fill is
+    mechanical, not a model event).  Pinned against teacher-forced
+    recomputation by tests/test_generate.py.
     """
     B, P = prompt.shape
     if max_new_tokens < 1:
@@ -639,8 +658,10 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
         lengths = jnp.zeros((B,), jnp.int32)  # unused placeholder
     run = _compiled_generate(cfg, B, P, max_new_tokens, max_len,
                              float(temperature), top_k, top_p, ragged,
-                             None if eos_id is None else int(eos_id))
-    toks = run(params, prompt, key, lengths)
-    if ragged:
-        return toks
-    return jnp.concatenate([prompt, toks], axis=1)
+                             None if eos_id is None else int(eos_id),
+                             want_logprobs=bool(return_logprobs))
+    toks, lps = run(params, prompt, key, lengths)
+    out = toks if ragged else jnp.concatenate([prompt, toks], axis=1)
+    if return_logprobs:
+        return out, lps
+    return out

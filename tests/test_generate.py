@@ -135,6 +135,35 @@ def test_generate_tp_sharded(cfg, params):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_generate_logprobs(cfg, params):
+    """return_logprobs: each emitted token's logprob equals the
+    teacher-forced log-softmax at its position (unfiltered, regardless
+    of sampling settings); eos-fill positions report 0.0."""
+    prompt = jnp.asarray(np.random.default_rng(3).integers(
+        1, cfg.vocab_size, (2, 6), dtype=np.int32))
+    P = prompt.shape[1]
+    for kw in ({}, {"temperature": 0.9, "top_k": 8,
+                    "key": jax.random.PRNGKey(4)}):
+        out, lps = generate(params, cfg, prompt, 7, return_logprobs=True,
+                            **kw)
+        assert lps.shape == (2, 7)
+        lp_ref = jax.nn.log_softmax(forward(params, out[:, :-1], cfg), -1)
+        want = jnp.take_along_axis(
+            lp_ref[:, P - 1:], out[:, P:, None], -1)[..., 0]
+        np.testing.assert_allclose(np.asarray(lps), np.asarray(want),
+                                   atol=2e-4, rtol=2e-4)
+
+    # eos-fill rows report 0.0 after their first eos.
+    free = generate(params, cfg, prompt, 7)
+    eos = int(free[0, P + 1])
+    out, lps = generate(params, cfg, prompt, 7, eos_id=eos,
+                        return_logprobs=True)
+    row = list(np.asarray(out[0, P:]))
+    i = row.index(eos)
+    assert bool((np.asarray(lps[0, i + 1:]) == 0.0).all())
+    assert float(lps[0, i]) != 0.0  # the sampled eos itself is a model event
+
+
 def test_generate_eos_fill(cfg, params):
     """Once a row emits eos_id it keeps emitting it; other rows continue
     unaffected (greedy tokens identical to the eos-free run up to the
