@@ -126,6 +126,26 @@ def test_speculative_stats(params):
     assert float(acc.sum() / (steps.sum() * 3)) >= 0.9  # near-total accept
 
 
+def test_decoders_max_new_one(params, draft):
+    """max_new_tokens=1: the speculative while-loops never run (the
+    seeded token satisfies the budget) and beam's scan has length 0 —
+    every decoder still returns exactly the one greedy token."""
+    from starway_tpu.models.beam import generate_beam
+    from starway_tpu.models.speculative import generate_lookup
+
+    dcfg, dparams = draft
+    cfg = LlamaConfig.preset("debug")
+    prompt = jnp.asarray(np.random.default_rng(9).integers(
+        1, cfg.vocab_size, (2, 5), dtype=np.int32))
+    ref = generate(params, cfg, prompt, 1)
+    for out in (
+        generate_speculative(params, cfg, dparams, dcfg, prompt, 1, gamma=3),
+        generate_lookup(params, cfg, prompt, 1, gamma=3),
+        generate_beam(params, cfg, prompt, 1, beams=3),
+    ):
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
 def test_speculative_validation(params, draft):
     dcfg, dparams = draft
     cfg = LlamaConfig.preset("debug")
