@@ -4,7 +4,13 @@ Analogue of the reference's pingpong.py (reference: pingpong.py:11-47):
 sweeps message sizes 1 B .. 1 GiB over a loopback Server/Client pair,
 printing the link-model estimate next to the measured number.
 
-Run:  python examples/pingpong.py [--tls tcp] [--max-size 1g]
+Run:  python examples/pingpong.py [--tls tcp] [--max-size 1g] [--uvloop]
+
+``--uvloop`` swaps in uvloop's event loop when the package is available
+(the reference's perf script runs under uvloop, reference pingpong.py:6,47
+— the asyncio scheduling overhead it removes is exactly the remaining gap
+BASELINE.md names on the pingpong headline).  Falls back to stock asyncio
+with a warning when uvloop isn't installed (it is not in this sandbox).
 """
 
 import argparse
@@ -56,9 +62,18 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--tls", help="STARWAY_TLS override (e.g. tcp)")
     ap.add_argument("--max-size", default="1g")
+    ap.add_argument("--uvloop", action="store_true",
+                    help="run under uvloop (reference pingpong.py parity)")
     args = ap.parse_args()
     if args.tls:
         os.environ["STARWAY_TLS"] = args.tls
+    if args.uvloop:
+        try:
+            import uvloop
+            asyncio.set_event_loop_policy(uvloop.EventLoopPolicy())
+        except ImportError:
+            print("uvloop not installed; running under stock asyncio",
+                  file=sys.stderr)
     from starway_tpu.bench import parse_size
 
     asyncio.run(main(parse_size(args.max_size)))

@@ -4,8 +4,9 @@ A cheap draft model proposes ``gamma - 1`` tokens; the target model checks
 the whole chunk in ONE forward and keeps the accepted prefix (plus one
 corrected/bonus token) — the target's KV cache streams once per accepted
 run instead of once per token, which is the whole speedup on a
-bandwidth-bound decode.  Greedy output is bit-identical to plain
-``generate()``: the draft changes how fast tokens appear, never which.
+bandwidth-bound decode.  Greedy output matches plain ``generate()``
+token for token (up to bf16 argmax near-ties between the chunk and
+stepwise forwards): the draft changes how fast tokens appear.
 
 Uses the tiny debug model so it runs anywhere (CPU included).  With
 random weights a shallow draft rarely agrees with the target, so the demo

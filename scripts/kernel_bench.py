@@ -483,6 +483,44 @@ def check_numerics():
                                   - mqr.astype(jnp.float32))))
     rows.append({"metric": "check_decode_multiquery_onchip", "value": mqerr,
                  "unit": "max_abs_err", "ok": bool(mqerr < 2e-2)})
+
+    # Speculative chunk verify vs stepwise decode ON HARDWARE (ADVICE r3):
+    # the two compute the same logits through different summation orders,
+    # which is exactly what lets bf16 argmax near-ties diverge.  Pin the
+    # LOGITS teacher-forced (same token sequence through both paths) — an
+    # end-to-end greedy-output comparison would cascade from a single
+    # benign near-tie and flap; the logit gap is the claim itself.
+    from starway_tpu.models import LlamaConfig, init_params
+    from starway_tpu.models.generate import decode_step, init_cache
+    from starway_tpu.models.llama import rope_tables
+    from starway_tpu.models.speculative import chunk_decode_step
+
+    # bfloat16 override: the debug preset is f32 (where summation order is
+    # invisible at 1e-7); the claim under test is about the bf16 decode
+    # dtype real configs run in.
+    cfg = LlamaConfig.preset("debug", dtype="bfloat16")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    B, warm, C, T = 4, 8, 6, 32
+    toks = jax.random.randint(jax.random.PRNGKey(3), (B, warm + C), 1,
+                              cfg.vocab_size, jnp.int32)
+    rope = rope_tables(T, cfg.head_dim, cfg.rope_theta)
+    c_step = init_cache(cfg, B, T)
+    c_chunk = c_step
+    step_logits = []
+    for i in range(warm + C):
+        l, c_step = decode_step(p, c_step, toks[:, i], i, cfg, rope)
+        if i >= warm:
+            step_logits.append(l)
+        if i == warm - 1:
+            # Warm the chunk path's cache identically through the prefix
+            # (jax arrays are immutable; later steps rebind, not mutate).
+            c_chunk = c_step
+    chunk_logits, _ = chunk_decode_step(
+        p, c_chunk, toks[:, warm:], jnp.full((B,), warm, jnp.int32), cfg,
+        rope)
+    serr = rel_err(chunk_logits, jnp.stack(step_logits, axis=1))
+    rows.append({"metric": "check_spec_chunk_onchip", "value": serr,
+                 "unit": "max_rel_err", "ok": bool(serr < 2e-2)})
     return rows
 
 

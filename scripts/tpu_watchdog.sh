@@ -1,0 +1,34 @@
+#!/bin/bash
+# Probe the tunneled TPU every ~90 s; the moment it answers, run the
+# resumable on-chip refresh queue (scripts/onchip_refresh.sh).  Repeats
+# forever: after a queue run (complete or tunnel-death abort) it goes back
+# to probing, so later windows pick up still-pending rows.
+#
+# Markers (for a human/driver polling progress):
+#   /tmp/tpu_alive      — touched each time a probe succeeds
+#   /tmp/tpu_refresh_running — exists while onchip_refresh.sh is running
+#   /tmp/onchip_rows.json    — the accumulated measured rows
+# Log: /tmp/tpu_watchdog.log
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_watchdog.log
+# The running-marker must not outlive the process (a stale marker reads as
+# "refresh in flight" forever to anything polling it).
+trap 'rm -f /tmp/tpu_refresh_running' EXIT
+while true; do
+  if timeout 60 python -c "import jax, jax.numpy as j; float((j.ones(4)+1).sum())" \
+      >/dev/null 2>&1; then
+    date "+%F %T tunnel ALIVE — starting refresh queue" >> "$LOG"
+    touch /tmp/tpu_alive /tmp/tpu_refresh_running
+    bash scripts/onchip_refresh.sh >> "$LOG" 2>&1
+    rm -f /tmp/tpu_refresh_running
+    date "+%F %T refresh queue exited" >> "$LOG"
+    # If every row is in, stop probing (grep finds no pending sections by
+    # re-running in check mode is overkill — just keep looping; the queue
+    # skips measured rows in seconds when complete).
+    sleep 300
+  else
+    date "+%F %T tunnel dead" >> "$LOG"
+    sleep 90
+  fi
+done

@@ -7,8 +7,12 @@ stream limit: a cheap DRAFT model proposes ``gamma - 1`` tokens
 autoregressively, then the TARGET model scores the whole proposed chunk in
 ONE forward pass — the target's cache streams once per ``a + 1`` accepted
 tokens instead of once per token, and the rejection rule keeps the output
-distribution EXACTLY the target model's (greedy case: bit-identical tokens,
-pinned by tests/test_speculative.py).
+distribution EXACTLY the target model's (greedy case: identical tokens up
+to bf16 argmax near-ties between the chunk and stepwise forwards — the two
+compute the same logits through different summation orders; pinned exactly
+on the CPU mesh by tests/test_speculative.py, and the chunk-vs-stepwise
+logit gap is pinned on hardware by ``kernel_bench --kernels check``'s
+``check_spec_chunk_onchip`` row).
 
 TPU-first construction, mirroring models/generate.py's discipline:
 
@@ -55,9 +59,29 @@ def chunk_decode_step(params, cache, tokens, pos, cfg: LlamaConfig, rope):
     generally useful for multi-token ingestion (teacher forcing, cache
     warm-up) at decode-path semantics.  Dense FFN and MoE follow
     decode_step; rolling caches are not supported (speculative decoding
-    targets the full-cache path).
+    targets the full-cache path) — a window-sized cache raises rather
+    than silently writing absolute positions into a modular window.  The
+    check is a shape heuristic (rolling and full caches share a layout),
+    so a FULL cache allocated with max_len exactly == sliding_window is
+    rejected too; allocate max_len = window + C for ingestion — positions
+    past the window are masked out of attention anyway, so the extra
+    slots change nothing.
     """
     B, C = tokens.shape
+    T_cache = cache["k"].shape[3]
+    if cfg.sliding_window is not None and T_cache == cfg.sliding_window:
+        # Mirrors decode_step's rolling-cache shape check, inverted: a
+        # cache of exactly sliding_window slots is a rolling cache
+        # (init_rolling_cache), whose modular slots this absolute-position
+        # write-then-attend cannot address — dynamic_update_slice would
+        # clamp the write and the masks would lie.
+        raise ValueError(
+            f"chunk_decode_step does not support rolling caches: got a "
+            f"{T_cache}-slot cache == cfg.sliding_window, which is "
+            f"init_rolling_cache's layout; allocate a full cache "
+            f"(init_cache with max_len != sliding_window — positions past "
+            f"the window are masked anyway, so max_len = window + C costs "
+            f"nothing) for chunk verify / multi-token ingestion")
     n_rep = cfg.n_heads // cfg.n_kv_heads
     cos, sin = rope
     pos = jnp.asarray(pos, jnp.int32)
@@ -170,7 +194,11 @@ def _accept_emit(drafts, pd, t_logits, key, out, n_out, t_pend, pos, stats,
     adv = jnp.where(done, 0, jnp.minimum(a + 1, max_new - n_out))
     n_out = n_out + adv
     live = (~done).astype(jnp.int32)
-    stats = stats + jnp.stack([live, live * a], axis=1)
+    # ``accepted`` counts accepted draft tokens actually EMITTED: normally
+    # ``a`` (adv = a + 1), but a finishing row clamps its advance, and the
+    # budget-truncated write is all drafts (the correction never lands) —
+    # min(a, adv) — so accepted + macro_steps never exceeds emitted tokens.
+    stats = stats + jnp.stack([live, live * jnp.minimum(a, adv)], axis=1)
     return (out, n_out, jnp.where(adv == a + 1, c, t_pend), pos + adv, key,
             stats, emit)
 
@@ -435,10 +463,15 @@ def generate_speculative(params: dict, cfg: LlamaConfig, draft_params: dict,
     draft's acceptance rate times that amortisation, minus the draft's
     own cost.
 
-    Greedy (``temperature == 0``) output is BIT-IDENTICAL to
-    ``generate(params, cfg, ...)`` — the draft only changes how fast
-    tokens appear, never which tokens (pinned by
-    tests/test_speculative.py).  Sampling uses the standard speculative
+    Greedy (``temperature == 0``) output matches
+    ``generate(params, cfg, ...)`` token for token up to bf16 argmax
+    near-ties: the chunk verify and the stepwise decode compute the same
+    logits through different summation orders, so a near-tied argmax can
+    resolve differently in low precision (exact-match pinned on the CPU
+    mesh by tests/test_speculative.py; the chunk-vs-stepwise logit gap
+    on-chip by kernel_bench's ``check_spec_chunk_onchip``).  The draft
+    only changes how fast
+    tokens appear.  Sampling uses the standard speculative
     rejection rule against exactly the filtered distribution ``generate``
     samples from, so the per-token output distribution is the target
     model's (statistically pinned).  ``eos_id``: conventional eos-fill,
@@ -541,8 +574,9 @@ def generate_lookup(params: dict, cfg: LlamaConfig, prompt,
     :func:`_lookup_propose`) and verified by the target's chunk forward.
     The drafter costs a few gathers, so ANY acceptance is pure profit;
     repetitive workloads (code, extraction, quoting) accept a lot.  Same
-    guarantees as :func:`generate_speculative`: greedy output is
-    bit-identical to ``generate()``; sampling preserves the target
+    guarantees as :func:`generate_speculative`: greedy output matches
+    ``generate()`` up to bf16 argmax near-ties between the chunk and
+    stepwise forwards; sampling preserves the target
     distribution (deterministic proposals are the ``p_D = one-hot``
     special case of the same rejection rule).  Same contract and
     restrictions otherwise (aligned or ragged ``prompt_lengths``

@@ -167,6 +167,21 @@ def test_speculative_validation(params, draft):
                              dparams, dcfg, prompt, 4)
 
 
+def test_chunk_decode_rejects_rolling_cache(params):
+    """The PUBLIC chunk_decode_step entry raises on a rolling (window-
+    sized) cache instead of silently clamping absolute-position writes
+    into the modular window (ADVICE r3)."""
+    from starway_tpu.models.generate import init_rolling_cache
+
+    cfg = LlamaConfig.preset("debug", sliding_window=8)
+    cache = init_rolling_cache(cfg, 1)
+    rope = rope_tables(32, cfg.head_dim, cfg.rope_theta)
+    toks = jnp.ones((1, 3), jnp.int32)
+    with pytest.raises(ValueError, match="rolling"):
+        chunk_decode_step(params, cache, toks, jnp.zeros((1,), jnp.int32),
+                          cfg, rope)
+
+
 def test_speculative_tp_sharded(params, draft):
     """Tensor-parallel speculative decoding is pure GSPMD: both models'
     params shard over tp and the same compiled while_loop produces the
