@@ -66,23 +66,62 @@ def pp_merge_params(pp_params: dict) -> dict:
     }
 
 
-def pp_param_specs(pp_params: dict, axis_name: str = "pp") -> dict:
+def _moe_stage_template() -> dict:
+    """Shape-only skeleton of one MoE stage tree (keys mirror
+    llama.py:init_params' layer dict; leaf values are placeholders) —
+    enough structure for :func:`_expert_leaf_spec` / :func:`pp_stage_specs`
+    to build spec trees before any real params exist."""
+    return {
+        "wq": 0, "wk": 0, "wv": 0, "wo": 0,
+        "attn_norm": 0, "mlp_norm": 0,
+        "moe": {"router": 0, "w_in": 0, "w_out": 0},
+    }
+
+
+def _expert_leaf_spec(stages: dict):
+    """Bool pytree matching ``stages``: True on the expert-table leaves
+    (``moe/w_in``, ``moe/w_out``) whose rows are per-expert, False on
+    everything else (including the replicated-per-device router)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _a: any(
+            getattr(k, "key", None) == "moe" for k in path) and any(
+            getattr(k, "key", None) in ("w_in", "w_out") for k in path),
+        stages)
+
+
+def pp_stage_specs(stages: dict, axis_name: str = "pp",
+                   ep_axis: Optional[str] = None):
+    """PartitionSpecs for the ``stages`` subtree: every leaf shards its
+    leading (stage) dim over ``axis_name``; with ``ep_axis``, the expert
+    tables ``[S, L/S, E, ...]`` additionally shard their expert dim."""
+    if ep_axis is None:
+        return jax.tree_util.tree_map(lambda _a: P(axis_name), stages)
+    return jax.tree_util.tree_map(
+        lambda is_exp: P(axis_name, None, ep_axis) if is_exp
+        else P(axis_name),
+        _expert_leaf_spec(stages))
+
+
+def pp_param_specs(pp_params: dict, axis_name: str = "pp",
+                   ep_axis: Optional[str] = None) -> dict:
     """Per-leaf PartitionSpec tree for the pipeline layout (same shape as
     ``pp_params``, consumable by :func:`~starway_tpu.parallel.shard_tree`):
-    stage leaves shard their leading (stage) dim over ``axis_name``,
+    stage leaves shard their leading (stage) dim over ``axis_name``
+    (expert tables additionally over ``ep_axis`` when given),
     embed/head replicate."""
     return {
         "embed": P(),
-        "stages": jax.tree_util.tree_map(lambda _a: P(axis_name),
-                                         pp_params["stages"]),
+        "stages": pp_stage_specs(pp_params["stages"], axis_name, ep_axis),
         "head": jax.tree_util.tree_map(lambda _a: P(), pp_params["head"]),
     }
 
 
-def shard_pp_params(pp_params: dict, mesh, axis_name: str = "pp") -> dict:
+def shard_pp_params(pp_params: dict, mesh, axis_name: str = "pp",
+                    ep_axis: Optional[str] = None) -> dict:
     from ..parallel.fsdp import shard_tree
 
-    return shard_tree(pp_params, mesh, pp_param_specs(pp_params, axis_name))
+    return shard_tree(pp_params, mesh,
+                      pp_param_specs(pp_params, axis_name, ep_axis))
 
 
 def ppv_split_params(params: dict, n_stages: int, n_chunks: int) -> dict:
@@ -132,13 +171,28 @@ def shard_ppv_params(ppv_params: dict, mesh, axis_name: str = "pp") -> dict:
 
 def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
                         n_micro: int, attn_fn: Optional[Callable] = None,
-                        n_chunks: int = 1, dp_axis: Optional[str] = None):
+                        n_chunks: int = 1, dp_axis: Optional[str] = None,
+                        ep_axis: Optional[str] = None):
     """Build ``step(pp_params, batch) -> (loss, grads)``, jit-compiled.
 
     ``batch``: [B, S+1] token ids, B divisible by ``n_micro``.  ``grads``
     has the pipeline layout of ``pp_params`` — feed it straight to optax.
-    Dense models only (MoE routing needs the global token view; use the
-    ep/GSPMD path for expert models).
+
+    MoE configs (``cfg.n_experts > 0``) pipeline too: each stage owns its
+    layers' expert tables and routes per microbatch (capacity from the
+    microbatch's token count), the per-stage balance aux chains through
+    the schedule exactly like the main loss (pipeline.py ``with_aux``),
+    and the step's loss matches the sequential
+    ``mean_microbatch(CE + coef * aux / n_layers)`` semantics of
+    llama.py's ``loss_fn``.  Without ``ep_axis`` the experts are
+    stage-LOCAL (wholly resident on the stage's device — fine until the
+    expert tables outgrow one chip).  With ``ep_axis`` (a pp x ep mesh),
+    each stage's expert tables shard over the ep sub-axis, tokens shard
+    over ep like a second dp axis, and the dispatch rides
+    :func:`~starway_tpu.models.moe.sharded_switch_moe`'s explicit
+    ``all_to_all`` — expert-table gradients get expert-aware reduction
+    (no pmean across ep; the all-to-all transpose already summed).
+    Interleaved MoE (``n_chunks > 1``) is not wired.
 
     ``n_chunks > 1``: the INTERLEAVED 1F1B schedule
     (parallel/interleaved.py) with that many virtual chunks per device;
@@ -156,19 +210,43 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
     if cfg.n_layers % (n_stages * n_chunks):
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
                          f"{n_stages} stages x {n_chunks} chunks")
-    if cfg.n_experts > 0:
-        raise NotImplementedError("pp_llama supports dense models only")
+    moe = cfg.n_experts > 0
+    if moe and n_chunks > 1:
+        raise NotImplementedError(
+            "interleaved (n_chunks > 1) MoE pipelining is not wired; use "
+            "the plain 1F1B schedule for expert models")
+    if ep_axis is not None and not moe:
+        raise ValueError("ep_axis given but cfg.n_experts == 0")
     attn = resolve_attn_fn(cfg, attn_fn)
 
+    if moe and ep_axis is not None:
+        from .moe import sharded_switch_moe
+
+        def moe_fn(x, router_w, w_in, w_out):
+            # Already inside the pipeline's shard_map: the ep axis is
+            # live, w_in/w_out leaves are the local [E/ep, D, F] shard.
+            return sharded_switch_moe(
+                x, router_w, w_in, w_out, ep_axis,
+                capacity_factor=cfg.moe_capacity_factor, k=cfg.moe_top_k)
+    else:
+        moe_fn = None  # decoder_layer defaults to stage-local switch_moe
+
     def run_layers(local, h):
-        """Scan ``h`` through a [L_local, ...] slice of the layer tree."""
+        """Scan ``h`` through a [L_local, ...] slice of the layer tree.
+        MoE: also return the slice's balance aux, scaled to llama.py
+        loss_fn's semantics (coef * sum / n_layers) so stage aux terms
+        sum to the sequential loss's term."""
         cos, sin = rope_tables(h.shape[1], cfg.head_dim, cfg.rope_theta)
 
-        def body(hh, lp):
-            hh, _aux, _k, _v, _stats = decoder_layer(lp, hh, cfg, cos, sin, attn)
-            return hh, None
+        def body(carry, lp):
+            hh, aux = carry
+            hh, a, _k, _v, _stats = decoder_layer(lp, hh, cfg, cos, sin,
+                                                  attn, moe_fn=moe_fn)
+            return (hh, aux + a), None
 
-        h, _ = lax.scan(body, h, local)
+        (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)), local)
+        if moe:
+            return h, aux * (cfg.moe_aux_coef / cfg.n_layers)
         return h
 
     def stage_fn(stage_lp, h):
@@ -195,9 +273,23 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
             n_micro=n_micro, with_head=True, return_dx=True,
             dp_axis=dp_axis)
     else:
+        if moe:
+            # Specs for leaves sharded beyond the stage dim (expert
+            # tables over ep) ride through to shard_map; the expert mask
+            # drives the ep-aware gradient reduction.  Built from a
+            # shape-only template tree (leaf VALUES are ignored).
+            template = _moe_stage_template()
+            kw = {"with_aux": True}
+            if ep_axis is not None:
+                kw.update(
+                    ep_axis=ep_axis,
+                    expert_spec=_expert_leaf_spec(template),
+                    param_specs=pp_stage_specs(template, axis_name, ep_axis))
+        else:
+            kw = {}
         grad_step = make_pipeline_train(mesh, stage_fn, loss_fn, axis_name,
                                         with_head=True, return_dx=True,
-                                        dp_axis=dp_axis)
+                                        dp_axis=dp_axis, **kw)
 
     def step(pp_params, batch):
         tokens, targets = batch[:, :-1], batch[:, 1:]
@@ -205,10 +297,14 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
         if B % n_micro:
             raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
         mb = B // n_micro
-        if dp_axis is not None and mb % mesh.shape[dp_axis]:
+        n_data = 1
+        for a in (dp_axis, ep_axis):
+            if a is not None:
+                n_data *= mesh.shape[a]
+        if mb % n_data:
             raise ValueError(
                 f"microbatch rows ({mb} = {B}/{n_micro}) not divisible by "
-                f"the dp size {mesh.shape[dp_axis]}")
+                f"the data-sharding size {n_data} (dp x ep)")
         D = pp_params["embed"].shape[1]
 
         h0 = pp_params["embed"][tokens].reshape(n_micro, mb, S, D)

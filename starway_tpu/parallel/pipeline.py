@@ -140,7 +140,7 @@ def make_pipeline(mesh, stage_fn: Callable, axis_name: str = "pp"):
 
 def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
                          inputs, targets, axis_name: str, head_params=None,
-                         return_dx: bool = False):
+                         return_dx: bool = False, with_aux: bool = False):
     """Per-device 1F1B body (call inside shard_map).
 
     ``inputs``: [M, mb, ...] activation microbatches (replicated; stage 0
@@ -162,6 +162,15 @@ def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
 
     Scalar loss aside, the head-grad psum is the only collective beyond
     the activation/cotangent hops, and it is gradient-sized, not per-tick.
+
+    ``with_aux``: ``stage_fn`` returns ``(y, aux)`` where ``aux`` is a
+    scalar loss contribution (f32, already coefficient-scaled — e.g. the
+    MoE balance term of this stage's layers).  Every stage's aux joins
+    the reported loss, and its gradient chains exactly like the main
+    loss: the last stage adds its aux inside the loss closure, mid
+    stages seed the aux output with cotangent 1 in the backward vjp —
+    so ``d aux_s / d x`` rides the same backward hops and reaches every
+    upstream stage's parameters.
     """
     n = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -177,6 +186,12 @@ def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
         return jax.tree_util.tree_map(
             lambda a: jnp.zeros(a.shape, jnp.float32), tree)
 
+    def apply_stage(p, x):
+        """Uniform (y, aux) view: dense stages get a constant-zero aux
+        (no gradient path, so the vjp cotangent on it is free)."""
+        out = stage_fn(p, x)
+        return out if with_aux else (out, jnp.float32(0))
+
     def tick(carry, t):
         fwd_in, bwd_in, stash, dparams, dhead, dx_buf, loss_acc = carry
 
@@ -184,7 +199,13 @@ def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
         i = t - stage
         f_valid = (i >= 0) & (i < m)
         x = jnp.where(stage == 0, inputs[jnp.clip(i, 0, m - 1)], fwd_in)
-        y = stage_fn(stage_params, x)
+        y, aux_f = apply_stage(stage_params, x)
+        # Aux VALUE accounting happens here in the F slot (the last stage
+        # is excluded: its aux joins loss_j inside the backward's loss
+        # closure, which would double-count it).  Aux GRADIENTS come from
+        # the backward slots below.
+        loss_acc = loss_acc + jnp.where(f_valid & (stage != n - 1),
+                                        aux_f, 0.0)
         # Stash the stage INPUT for the backward remat; invalid ticks write
         # to the dedicated trash slot `depth`.
         slot = jnp.where(f_valid, jax.lax.rem(jnp.clip(i, 0, m - 1), depth),
@@ -204,16 +225,20 @@ def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
         def last_branch(_):
             # Backprop through loss o stage in one vjp; at the last stage
             # j == i, so x_saved is the activation stashed THIS tick.
+            # The stage's own aux joins the loss closure, so loss_j and
+            # the grads both carry it.
             if head_params is None:
                 def h(p, x):
-                    return loss_fn(stage_fn(p, x), target)
+                    yy, aa = apply_stage(p, x)
+                    return loss_fn(yy, target) + aa
 
                 loss_j, (dp, dx) = jax.value_and_grad(h, argnums=(0, 1))(
                     stage_params, x_saved)
                 dh = dhead  # zeros-shaped placeholder, unused
             else:
                 def h(p, x, hp):
-                    return loss_fn(hp, stage_fn(p, x), target)
+                    yy, aa = apply_stage(p, x)
+                    return loss_fn(hp, yy, target) + aa
 
                 loss_j, (dp, dx, dh) = jax.value_and_grad(
                     h, argnums=(0, 1, 2))(stage_params, x_saved, head_params)
@@ -222,9 +247,11 @@ def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
                     jnp.asarray(loss_j, jnp.float32))
 
         def mid_branch(_):
-            _, vjp_fn = jax.vjp(lambda p, x: stage_fn(p, x), stage_params,
-                                x_saved)
-            dp, dx = vjp_fn(bwd_in.astype(y.dtype))
+            (yy, aa), vjp_fn = jax.vjp(apply_stage, stage_params, x_saved)
+            # Cotangent 1 on the aux output: this stage's balance term
+            # differentiates into (dp, dx) alongside the downstream loss.
+            dp, dx = vjp_fn((bwd_in.astype(yy.dtype),
+                             jnp.ones((), aa.dtype)))
             return (f32_tree(dp), dx.astype(jnp.float32),
                     f32_zeros_like(head_params), jnp.float32(0))
 
@@ -281,7 +308,8 @@ def pipeline_train_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
 
 
 def dp_compose(mesh, dp_axis: "str | None", axis_name: str, *,
-               with_head: bool, return_dx: bool):
+               with_head: bool, return_dx: bool,
+               ep_axis: "str | None" = None, expert_spec=None):
     """Shared dp-composition plumbing for BOTH 1F1B builders (plain and
     interleaved): validates ``dp_axis``, builds the input/dx specs, and
     returns the local-output reducer.
@@ -292,7 +320,17 @@ def dp_compose(mesh, dp_axis: "str | None", axis_name: str, *,
     param grads / head grads over dp and scales dinputs by 1/ndp (the
     per-shard cotangent differentiates the dp-averaged loss — without the
     factor an embedding chained into it would be ndp x the stage grads'
-    scale)."""
+    scale).
+
+    ``ep_axis``: an EXPERT-parallel data axis — tokens shard over it like
+    dp (dim 1 of inputs/targets), but stage-parameter leaves marked True
+    in ``expert_spec`` (a bool pytree matching the stage params) hold
+    DIFFERENT experts on each ep rank, so their gradients must not be
+    averaged across ep.  The expert all-to-all's backward transpose has
+    already summed every rank's cotangent contribution into the owning
+    rank's expert grad, so the mean-loss scale is ``grad / ep`` (dense
+    leaves: the usual pmean over both axes).
+    """
     if dp_axis is not None and dp_axis not in mesh.shape:
         raise ValueError(f"dp_axis={dp_axis!r} is not an axis of {mesh.shape}")
     if dp_axis == axis_name:
@@ -301,22 +339,48 @@ def dp_compose(mesh, dp_axis: "str | None", axis_name: str, *,
         # plausible-looking garbage, not an error, on return_dx=False paths.
         raise ValueError(f"dp_axis must differ from the pipeline axis "
                          f"{axis_name!r}")
-    data_spec = P(None, dp_axis) if dp_axis else P()
-    dx_spec = P(axis_name, None, dp_axis) if dp_axis else P(axis_name)
+    if (ep_axis is None) != (expert_spec is None):
+        # ep without the mask would pmean DIFFERENT experts' grads across
+        # ep ranks (plausible-looking, wrong); the mask without ep has no
+        # axis to reduce over.  Fail loudly instead.
+        raise ValueError("ep_axis and expert_spec must be given together")
+    if ep_axis is not None:
+        if ep_axis not in mesh.shape:
+            raise ValueError(
+                f"ep_axis={ep_axis!r} is not an axis of {mesh.shape}")
+        if ep_axis in (axis_name, dp_axis):
+            raise ValueError(f"ep_axis must differ from the pipeline and dp "
+                             f"axes, got {ep_axis!r}")
+    axes = tuple(a for a in (dp_axis, ep_axis) if a is not None)
+    data_spec = P(None, axes) if axes else P()
+    dx_spec = P(axis_name, None, axes) if axes else P(axis_name)
+
+    def grad_reduce(g, is_expert):
+        if is_expert:
+            g = g / lax.axis_size(ep_axis)
+            return lax.pmean(g, dp_axis) if dp_axis is not None else g
+        return lax.pmean(g, axes)
 
     def dp_reduce(out):
-        if dp_axis is None:
+        if not axes:
             return out
-        loss = lax.pmean(out[0], dp_axis)
-        dparams = jax.tree_util.tree_map(
-            lambda g: lax.pmean(g, dp_axis), out[1])
+        loss = lax.pmean(out[0], axes)
+        if expert_spec is None:
+            dparams = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, axes), out[1])
+        else:
+            dparams = jax.tree_util.tree_map(grad_reduce, out[1],
+                                             expert_spec)
         rest = out[2:]
         if with_head:
             dhead = jax.tree_util.tree_map(
-                lambda g: lax.pmean(g, dp_axis), rest[0])
+                lambda g: lax.pmean(g, axes), rest[0])
             rest = (dhead,) + rest[1:]
         if return_dx:
-            rest = rest[:-1] + (rest[-1] / lax.axis_size(dp_axis),)
+            scale = 1
+            for a in axes:
+                scale = scale * lax.axis_size(a)
+            rest = rest[:-1] + (rest[-1] / scale,)
         return (loss, dparams) + rest
 
     return data_spec, dx_spec, dp_reduce
@@ -324,7 +388,9 @@ def dp_compose(mesh, dp_axis: "str | None", axis_name: str, *,
 
 def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
                         axis_name: str = "pp", *, with_head: bool = False,
-                        return_dx: bool = False, dp_axis: str | None = None):
+                        return_dx: bool = False, dp_axis: str | None = None,
+                        with_aux: bool = False, ep_axis: str | None = None,
+                        param_specs=None, expert_spec=None):
     """Jitted global-view 1F1B training step builder.
 
     Returns ``grad_step(stage_params, inputs, targets) -> (loss, grads)``
@@ -352,26 +418,39 @@ def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
     THIS shard's inputs against the dp-averaged loss (the 1/ndp factor is
     applied), so chaining it into an embedding yields grads on the same
     scale as ``dparams``.
+
+    ``with_aux``: ``stage_fn`` returns ``(y, aux)`` and every stage's aux
+    scalar joins the loss and the gradients (see
+    :func:`pipeline_train_apply`).  ``ep_axis`` + ``expert_spec``: tokens
+    additionally shard over an expert-parallel axis whose expert-table
+    gradient leaves get expert-aware reduction (see :func:`dp_compose`).
+    ``param_specs``: a PartitionSpec pytree matching ``stage_params`` for
+    when leaves shard beyond the leading stage dim (expert tables over
+    ep); defaults to ``P(axis_name)`` on every leaf.  Gradients come back
+    sharded exactly like the params.
     """
     data_spec, dx_spec, dp_reduce = dp_compose(
-        mesh, dp_axis, axis_name, with_head=with_head, return_dx=return_dx)
+        mesh, dp_axis, axis_name, with_head=with_head, return_dx=return_dx,
+        ep_axis=ep_axis, expert_spec=expert_spec)
+    p_spec = P(axis_name) if param_specs is None else param_specs
 
     if with_head:
         def local(stage_params, head_params, inputs, targets):
             return dp_reduce(pipeline_train_apply(
                 stage_fn, loss_fn, stage_params, inputs, targets, axis_name,
-                head_params=head_params, return_dx=return_dx))
+                head_params=head_params, return_dx=return_dx,
+                with_aux=with_aux))
 
-        in_specs = (P(axis_name), P(), data_spec, data_spec)
-        out_specs = (P(), P(axis_name), P()) + ((dx_spec,) if return_dx else ())
+        in_specs = (p_spec, P(), data_spec, data_spec)
+        out_specs = (P(), p_spec, P()) + ((dx_spec,) if return_dx else ())
     else:
         def local(stage_params, inputs, targets):
             return dp_reduce(pipeline_train_apply(
                 stage_fn, loss_fn, stage_params, inputs, targets, axis_name,
-                return_dx=return_dx))
+                return_dx=return_dx, with_aux=with_aux))
 
-        in_specs = (P(axis_name), data_spec, data_spec)
-        out_specs = (P(), P(axis_name)) + ((dx_spec,) if return_dx else ())
+        in_specs = (p_spec, data_spec, data_spec)
+        out_specs = (P(), p_spec) + ((dx_spec,) if return_dx else ())
 
     staged = shard_map_fn(mesh, local, in_specs=in_specs, out_specs=out_specs)
     if not return_dx:

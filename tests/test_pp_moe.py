@@ -1,0 +1,148 @@
+"""MoE through the pipeline (VERDICT r3 #4: lift pp_llama's dense-only
+guard).
+
+Contracts:
+* pp MoE grad parity: 1F1B over a pp mesh with stage-local experts
+  matches the MICROBATCHED sequential oracle — mean over microbatches of
+  llama.py's loss_fn (CE + coef * aux / n_layers), gradients included;
+  the oracle is per-microbatch because routing capacity derives from the
+  token count a forward sees, which under pipelining is the microbatch.
+* pp x ep grad parity: expert tables shard over the ep sub-axis, tokens
+  shard over ep, dispatch rides sharded_switch_moe's all_to_all; with
+  ample capacity (no drops) and aux_coef=0 the math is shard-invariant,
+  so loss and every gradient must match the same oracle exactly.
+* aux chaining: with aux_coef > 0 the balance term reaches EVERY stage's
+  parameters (including stage 0, whose aux gradient only exists if the
+  pipeline seeds aux cotangents in the backward slots).
+* validation: interleaved MoE raises; ep_axis on a dense config raises.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from starway_tpu.models import LlamaConfig, init_params
+from starway_tpu.models.llama import loss_fn as flat_loss
+from starway_tpu.models.pp_llama import (make_pp_llama_train,
+                                         pp_merge_params, pp_param_specs,
+                                         pp_split_params, shard_pp_params)
+from starway_tpu.parallel import make_mesh
+
+
+def _microbatched_oracle(params, batch, cfg, n_micro):
+    """mean_j [CE(mb_j) + coef * aux(mb_j) / n_layers] and its grads —
+    the sequential semantics the pipeline schedule must reproduce."""
+    def total(p):
+        losses = [flat_loss(p, mb, cfg)
+                  for mb in jnp.split(batch, n_micro, axis=0)]
+        return sum(losses) / n_micro
+
+    return jax.value_and_grad(total)(params)
+
+
+def _assert_tree_close(flat, ref, atol=3e-5, rtol=3e-4):
+    for name in ref["layers"]:
+        sub_f, sub_r = flat["layers"][name], ref["layers"][name]
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=atol, rtol=rtol,
+                err_msg=name),
+            sub_f, sub_r)
+    for name in ("embed", "final_norm", "lm_head"):
+        np.testing.assert_allclose(np.asarray(flat[name]),
+                                   np.asarray(ref[name]),
+                                   atol=atol, rtol=rtol, err_msg=name)
+
+
+def test_pp_moe_grads_match_microbatched_oracle():
+    """Stage-local experts over a pp-only mesh, top-2 routing, nonzero
+    aux coefficient: loss and every grad vs the sequential oracle."""
+    cfg = LlamaConfig.preset("debug", n_layers=4, d_model=32, n_heads=4,
+                             n_kv_heads=2, d_ff=48, vocab_size=64,
+                             n_experts=4, moe_top_k=2, moe_aux_coef=0.02)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh({"pp": 2})
+    batch = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (8, 9), dtype=np.int32))
+    n_micro = 4
+
+    pp = shard_pp_params(pp_split_params(params, 2), mesh)
+    step = make_pp_llama_train(mesh, cfg, n_micro=n_micro)
+    loss_pp, grads_pp = step(pp, batch)
+
+    loss_ref, grads_ref = _microbatched_oracle(params, batch, cfg, n_micro)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+    _assert_tree_close(pp_merge_params(grads_pp), grads_ref)
+
+    # The aux term must reach stage 0's router: re-run with coef=0 and
+    # check the router grad actually changes (the chained-aux signal).
+    cfg0 = LlamaConfig.preset("debug", n_layers=4, d_model=32, n_heads=4,
+                              n_kv_heads=2, d_ff=48, vocab_size=64,
+                              n_experts=4, moe_top_k=2, moe_aux_coef=0.0)
+    step0 = make_pp_llama_train(mesh, cfg0, n_micro=n_micro)
+    _, grads0 = step0(pp, batch)
+    r_with = np.asarray(grads_pp["stages"]["moe"]["router"])[0]
+    r_without = np.asarray(grads0["stages"]["moe"]["router"])[0]
+    assert np.abs(r_with - r_without).max() > 0
+
+
+def test_pp_ep_moe_grads_match_oracle():
+    """pp x ep: experts shard over ep inside each stage, tokens shard
+    over ep, no drops (ample capacity) + aux_coef=0 make the math
+    shard-invariant — exact parity against the same oracle."""
+    cfg = LlamaConfig.preset("debug", n_layers=4, d_model=32, n_heads=4,
+                             n_kv_heads=2, d_ff=48, vocab_size=64,
+                             n_experts=4, moe_top_k=1, moe_aux_coef=0.0,
+                             moe_capacity_factor=4.0)
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    mesh = make_mesh({"pp": 2, "ep": 2})
+    batch = jnp.asarray(np.random.default_rng(1).integers(
+        0, cfg.vocab_size, (8, 9), dtype=np.int32))
+    n_micro = 2
+
+    pp = shard_pp_params(pp_split_params(params, 2), mesh, ep_axis="ep")
+    step = make_pp_llama_train(mesh, cfg, n_micro=n_micro, ep_axis="ep")
+    loss_pp, grads_pp = step(pp, batch)
+
+    loss_ref, grads_ref = _microbatched_oracle(params, batch, cfg, n_micro)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+    _assert_tree_close(pp_merge_params(grads_pp), grads_ref)
+
+    # Spec plumbing: expert tables shard (pp, -, ep); router pp-only.
+    specs = pp_param_specs(pp_split_params(params, 2), ep_axis="ep")
+    assert tuple(specs["stages"]["moe"]["w_in"]) == ("pp", None, "ep")
+    assert tuple(specs["stages"]["moe"]["router"]) == ("pp",)
+
+
+def test_pp_ep_dp_moe_runs():
+    """pp x dp x ep composes: one step on an 8-device mesh stays finite
+    and produces grads in the params' layout."""
+    cfg = LlamaConfig.preset("debug", n_layers=2, d_model=32, n_heads=4,
+                             n_kv_heads=2, d_ff=48, vocab_size=64,
+                             n_experts=2, moe_top_k=1, moe_aux_coef=0.01)
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    mesh = make_mesh({"pp": 2, "dp": 2, "ep": 2})
+    batch = jnp.asarray(np.random.default_rng(2).integers(
+        0, cfg.vocab_size, (8, 9), dtype=np.int32))
+
+    pp = shard_pp_params(pp_split_params(params, 2), mesh, ep_axis="ep")
+    step = make_pp_llama_train(mesh, cfg, n_micro=2, dp_axis="dp",
+                               ep_axis="ep")
+    loss, grads = step(pp, batch)
+    assert np.isfinite(float(loss))
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert bool(jnp.isfinite(leaf).all())
+    assert grads["stages"]["moe"]["w_in"].shape == \
+        pp["stages"]["moe"]["w_in"].shape
+
+
+def test_pp_moe_validation():
+    cfg = LlamaConfig.preset("debug", n_layers=4, n_experts=4)
+    mesh = make_mesh({"pp": 2})
+    with pytest.raises(NotImplementedError, match="interleaved"):
+        make_pp_llama_train(mesh, cfg, n_micro=2, n_chunks=2)
+    dense = LlamaConfig.preset("debug", n_layers=4)
+    with pytest.raises(ValueError, match="ep_axis"):
+        make_pp_llama_train(mesh, dense, n_micro=2, ep_axis="ep")
