@@ -28,13 +28,13 @@ import jax.numpy as jnp
 from jax import lax
 
 from .generate import NEG_BIG, decode_step, prefill
-from .llama import LlamaConfig, rope_tables
+from .llama import LlamaConfig, cfg_rope_tables
 
 
 @functools.cache
 def _compiled_beam(cfg: LlamaConfig, B: int, K: int, P: int, max_new: int,
                    max_len: int, eos_id: Optional[int]):
-    rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
+    rope = cfg_rope_tables(cfg, max_len)
 
     def run(params, prompt):
         logits, cache = prefill(params, cfg, prompt, max_len)  # rows = B

@@ -20,8 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .llama import (LlamaConfig, apply_rope, forward, matmul_w, rmsnorm,
-                    rope_tables)
+from .llama import (LlamaConfig, apply_rope, cfg_rope_tables, forward,
+                    matmul_w, rmsnorm)
 from ..ops.attention import NEG_BIG, repeat_kv
 
 
@@ -129,7 +129,7 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
             # Absolute positions exceed the cache size; the caller knows the
             # true horizon, we don't.
             raise ValueError("rolling decode requires explicit rope tables")
-        rope = rope_tables(T, hd, cfg.rope_theta)
+        rope = cfg_rope_tables(cfg, T)
     cos, sin = rope
     pos = jnp.asarray(pos, jnp.int32)
     per_row = pos.ndim == 1
@@ -321,7 +321,7 @@ def prefill_rolling(params: dict, cfg: LlamaConfig, prompt, *,
     if attn_fn is not None:
         raise ValueError("prefill_rolling owns its attention; attn_fn must be None")
     B, P = prompt.shape
-    cos, sin = rope_tables(P, cfg.head_dim, cfg.rope_theta)
+    cos, sin = cfg_rope_tables(cfg, P)
     cache = init_rolling_cache(cfg, B)
 
     # Host-side chunk plan.
@@ -525,7 +525,7 @@ def _compiled_generate(cfg: LlamaConfig, B: int, P: int, max_new: int,
     runs, and the tokens are bit-identical to the full-cache path (pinned
     by tests/test_generate.py).
     """
-    rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
+    rope = cfg_rope_tables(cfg, max_len)
     W = cfg.sliding_window
     rolling = (not ragged) and W is not None and W < max_len
 

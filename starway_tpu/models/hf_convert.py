@@ -39,11 +39,6 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
     Refuses configs this model family cannot represent — silently dropping
     them would produce a numerically wrong model (the failure mode this
     module exists to prevent)."""
-    scaling = getattr(hf_config, "rope_scaling", None)
-    if scaling:
-        raise NotImplementedError(
-            f"rope_scaling={scaling!r} is not implemented here; converting "
-            "would silently change the rope frequencies vs transformers")
     if getattr(hf_config, "attention_bias", False) or getattr(
             hf_config, "mlp_bias", False):
         raise NotImplementedError(
@@ -52,20 +47,17 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
     if act not in ("silu", "swish"):
         raise NotImplementedError(f"hidden_act={act!r}; this family is SwiGLU")
     # Newer HF configs may pin an explicit per-head dim decoupled from
-    # hidden_size // num_attention_heads; this tree derives head_dim, so a
-    # mismatch would mis-shape every projection reshape downstream.
+    # hidden_size // num_attention_heads; llama.py keys every
+    # projection/reshape off cfg.head_dim, so the override carries it.
     explicit_hd = getattr(hf_config, "head_dim", None)
-    if hf_config.hidden_size % hf_config.num_attention_heads:
-        # Even an "equal" explicit head_dim is decoupled here: the floor
-        # division below would mask that n_heads * head_dim != hidden_size.
+    derived_hd = (hf_config.hidden_size // hf_config.num_attention_heads
+                  if hf_config.hidden_size % hf_config.num_attention_heads == 0
+                  else None)
+    if explicit_hd is None and derived_hd is None:
         raise NotImplementedError(
             f"hidden_size={hf_config.hidden_size} is not divisible by "
-            f"num_attention_heads={hf_config.num_attention_heads}")
-    derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
-    if explicit_hd is not None and explicit_hd != derived_hd:
-        raise NotImplementedError(
-            f"head_dim={explicit_hd} != hidden_size//n_heads={derived_hd}; "
-            "decoupled head dims are not representable in this tree")
+            f"num_attention_heads={hf_config.num_attention_heads} and the "
+            "config pins no explicit head_dim")
     kw = dict(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -79,9 +71,41 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
         # Mistral-family configs carry sliding_window; same architecture
         # otherwise, so the converter serves both families.
         sliding_window=getattr(hf_config, "sliding_window", None),
+        head_dim_override=(explicit_hd if explicit_hd is not None
+                           and explicit_hd != derived_hd else None),
+        rope_scaling=_rope_scaling_from_hf(
+            getattr(hf_config, "rope_scaling", None)),
     )
     kw.update(overrides)
     return LlamaConfig(**kw)
+
+
+def _rope_scaling_from_hf(scaling) -> "tuple | None":
+    """HF ``rope_scaling`` dict -> LlamaConfig's hashable tuple.
+
+    Implemented kinds: ``linear`` (position interpolation) and ``llama3``
+    (the Llama-3.1 banded scheme; see llama.py:rope_tables).  Anything
+    else (yarn, dynamic, longrope, ...) still refuses — silently dropping
+    a scaling scheme would change the rope frequencies vs transformers,
+    the exact failure mode this module exists to prevent."""
+    if not scaling:
+        return None
+    kind = scaling.get("rope_type", scaling.get("type"))
+    if kind == "linear":
+        return ("linear", float(scaling["factor"]))
+    if kind == "llama3":
+        return ("llama3", float(scaling["factor"]),
+                float(scaling["low_freq_factor"]),
+                float(scaling["high_freq_factor"]),
+                float(scaling["original_max_position_embeddings"]))
+    if kind == "default":
+        # transformers normalises "no scaling" configs to
+        # {"rope_type": "default"} in some versions.
+        return None
+    raise NotImplementedError(
+        f"rope_scaling={scaling!r} is not implemented here (linear and "
+        "llama3 are); converting would silently change the rope "
+        "frequencies vs transformers")
 
 
 def _t(w) -> np.ndarray:

@@ -58,6 +58,19 @@ class LlamaConfig:
     # int8 with per-token scales (ops/quantize.py) — half the HBM bytes on
     # the bandwidth-bound decode stream, double the servable context.
     kv_quant: str = "none"
+    # Per-head dim override.  None derives d_model // n_heads (the classic
+    # tie, recomputed on every access so dataclasses.replace(n_heads=...)
+    # can never carry a stale value); modern HF checkpoints may pin it
+    # independently (q/k/v project to n_heads * head_dim != d_model) —
+    # every projection/reshape in this module keys off cfg.head_dim.
+    head_dim_override: Optional[int] = None
+    # RoPE frequency scaling, as a hashable tuple (configs key jit caches):
+    #   ("linear", factor)  — all frequencies divided by factor;
+    #   ("llama3", factor, low_freq_factor, high_freq_factor,
+    #    original_max_position_embeddings) — Llama-3.1's banded scheme
+    #    (long wavelengths scaled, short kept, smooth band between).
+    # None = unscaled.  Applied inside rope_tables via cfg_rope_tables.
+    rope_scaling: Optional[tuple] = None
 
     def __post_init__(self):
         if self.sliding_window is not None and self.sliding_window < 1:
@@ -66,9 +79,30 @@ class LlamaConfig:
         if self.kv_quant not in ("none", "int8"):
             raise ValueError(
                 f"kv_quant must be 'none' or 'int8', got {self.kv_quant!r}")
+        if self.head_dim_override is None:
+            if self.d_model % self.n_heads:
+                raise ValueError(
+                    f"d_model={self.d_model} not divisible by "
+                    f"n_heads={self.n_heads}; pass head_dim_override")
+        elif self.head_dim_override < 2 or self.head_dim_override % 2:
+            raise ValueError(f"head_dim_override must be an even int >= 2, "
+                             f"got {self.head_dim_override}")
+        if self.rope_scaling is not None:
+            s = tuple(self.rope_scaling)
+            if not s or s[0] not in ("linear", "llama3") or (
+                    s[0] == "linear" and len(s) != 2) or (
+                    s[0] == "llama3" and len(s) != 5):
+                raise ValueError(
+                    f"rope_scaling must be ('linear', factor) or ('llama3', "
+                    f"factor, low_freq_factor, high_freq_factor, "
+                    f"original_max_position_embeddings), got "
+                    f"{self.rope_scaling!r}")
+            object.__setattr__(self, "rope_scaling", s)
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.d_model // self.n_heads
 
     @property
@@ -224,12 +258,44 @@ def rmsnorm(x, w, eps: float):
     return (xf * scale).astype(x.dtype) * w
 
 
-def rope_tables(seq_len: int, head_dim: int, theta: float):
-    """[S, Dh/2] cos/sin tables in f32."""
+def rope_tables(seq_len: int, head_dim: int, theta: float, scaling=None):
+    """[S, Dh/2] cos/sin tables in f32.
+
+    ``scaling``: LlamaConfig.rope_scaling tuple — ``("linear", factor)``
+    divides every frequency by ``factor`` (position interpolation);
+    ``("llama3", factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings)`` is Llama-3.1's banded scheme
+    (public formula, as shipped in the checkpoints' reference code): long
+    wavelengths (beyond ``orig/low``) scale by ``1/factor``, short ones
+    (inside ``orig/high``) stay, and the band between interpolates
+    smoothly in ``orig/wavelength``.
+    """
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling is not None:
+        kind = scaling[0]
+        if kind == "linear":
+            inv_freq = inv_freq / scaling[1]
+        elif kind == "llama3":
+            factor, low, high, orig = scaling[1:]
+            wavelen = 2.0 * jnp.pi / inv_freq
+            smooth = (orig / wavelen - low) / (high - low)
+            mid = ((1.0 - smooth) / factor + smooth) * inv_freq
+            inv_freq = jnp.where(
+                wavelen > orig / low, inv_freq / factor,
+                jnp.where(wavelen < orig / high, inv_freq, mid))
+        else:  # LlamaConfig.__post_init__ already validated
+            raise ValueError(f"unknown rope scaling kind {kind!r}")
     pos = jnp.arange(seq_len, dtype=jnp.float32)
     ang = pos[:, None] * inv_freq[None, :]
     return jnp.cos(ang), jnp.sin(ang)
+
+
+def cfg_rope_tables(cfg: "LlamaConfig", seq_len: int):
+    """:func:`rope_tables` keyed entirely off a config — THE way model
+    code builds tables (forgetting ``cfg.rope_scaling`` at one of the
+    many call sites would silently mis-rotate positions)."""
+    return rope_tables(seq_len, cfg.head_dim, cfg.rope_theta,
+                       cfg.rope_scaling)
 
 
 def apply_rope(x, cos, sin):
@@ -388,7 +454,7 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
             "with make_sharded_moe(..., with_stats=True) or wrap "
             "switch_moe(..., with_stats=True))")
     B, S = tokens.shape
-    cos, sin = rope_tables(S, cfg.head_dim, cfg.rope_theta)
+    cos, sin = cfg_rope_tables(cfg, S)
 
     h = params["embed"][tokens]  # [B, S, D]
 

@@ -31,8 +31,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from .llama import (LlamaConfig, decoder_layer, head_logits, resolve_attn_fn,
-                    rope_tables, token_ce)
+from .llama import (LlamaConfig, cfg_rope_tables, decoder_layer,
+                    head_logits, resolve_attn_fn, token_ce)
 from ..parallel.pipeline import make_pipeline_train
 
 
@@ -236,7 +236,7 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
         MoE: also return the slice's balance aux, scaled to llama.py
         loss_fn's semantics (coef * sum / n_layers) so stage aux terms
         sum to the sequential loss's term."""
-        cos, sin = rope_tables(h.shape[1], cfg.head_dim, cfg.rope_theta)
+        cos, sin = cfg_rope_tables(cfg, h.shape[1])
 
         def body(carry, lp):
             hh, aux = carry

@@ -52,8 +52,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .generate import (_sample, decode_step, init_cache, init_rolling_cache,
-                       prefill, rope_tables)
-from .llama import LlamaConfig
+                       prefill)
+from .llama import LlamaConfig, cfg_rope_tables
 
 
 def _bucket(n: int, buckets) -> int:
@@ -143,7 +143,7 @@ def _compiled_chunk(cfg: LlamaConfig, n_slots: int, max_len: int, chunk: int,
     a real request).  ``rolling``: the cache is circular per slot
     (``max_len`` is the rope horizon, not the cache size).
     """
-    rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
+    rope = cfg_rope_tables(cfg, max_len)
 
     def run(params, cache, token, pos, live, remaining, key):
         def step(carry, _):

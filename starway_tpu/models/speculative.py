@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .generate import _filter_logits, _sample, cached_layer_scan, prefill
-from .llama import LlamaConfig, matmul_w, rmsnorm, rope_tables
+from .llama import LlamaConfig, cfg_rope_tables, matmul_w, rmsnorm
 
 
 def chunk_decode_step(params, cache, tokens, pos, cfg: LlamaConfig, rope):
@@ -270,7 +270,7 @@ def _compiled_lookup(cfg: LlamaConfig, B: int, P: int, max_new: int,
     with the draft scan replaced by :func:`_lookup_propose` over a
     sequence buffer — ONE model (the target) runs at all, so every
     accepted token saves a whole decode step."""
-    rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
+    rope = cfg_rope_tables(cfg, max_len)
     greedy = temperature == 0.0
     G = gamma
 
@@ -351,7 +351,7 @@ def _compiled_speculative(cfg: LlamaConfig, draft_cfg: LlamaConfig, B: int,
     """
     from .generate import decode_step
 
-    rope = rope_tables(max_len, cfg.head_dim, cfg.rope_theta)
+    rope = cfg_rope_tables(cfg, max_len)
     greedy = temperature == 0.0
     G = gamma
 
