@@ -274,7 +274,9 @@ class Worker:
                 transport = "sm"
             else:
                 transport = conn.kind
-        return perf.estimate(transport, msg_size)
+        # Per-endpoint first (live-calibrated, perf.autocalibrate[_ep]),
+        # transport-class model otherwise.
+        return perf.conn_estimate(conn, transport, msg_size)
 
     # --------------------------------------------------------- engine side
     def _wake(self) -> None:

@@ -740,7 +740,9 @@ class NativeWorkerBase:
         transport = "tcp"
         if isinstance(conn, NativeConn) and conn.transports() == [("shm", "sm")]:
             transport = "sm"
-        return perf.estimate(transport, msg_size)
+        # Per-endpoint first (live-calibrated, perf.autocalibrate[_ep]),
+        # transport-class model otherwise.
+        return perf.conn_estimate(conn, transport, msg_size)
 
     def __del__(self):
         try:

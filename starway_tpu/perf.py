@@ -12,6 +12,15 @@ model")::
 Default betas reflect TPU v5e-class hardware (ICI ~45 GB/s per link
 direction, DCN ~12.5 GB/s per host NIC) and measured host-loopback numbers;
 calibrate with :func:`calibrate` from observed samples.
+
+Estimates are PER-ENDPOINT when live calibration has run (the reference's
+``ucp_ep_evaluate_perf`` queries the endpoint, not a transport class:
+two peers with different link quality report differently):
+:func:`autocalibrate` (client side) and :func:`autocalibrate_ep` (server
+side, probing one accepted endpoint) attach the fitted (alpha, beta) to
+the CONNECTION, and both engines' ``evaluate_perf`` prefer that over the
+class table.  Probes ride the reserved PROBE_TAG both directions — the
+peer's matcher consumes and drops them (core/matching.py, sw_engine.cpp).
 """
 
 from __future__ import annotations
@@ -26,13 +35,50 @@ LINK_MODELS: dict[str, tuple[float, float]] = {
 }
 
 
+def _apply(model: tuple[float, float], msg_size: int) -> float:
+    """t(bytes) = alpha + bytes / beta — the one place the model runs."""
+    alpha, beta = model
+    return alpha + max(0, int(msg_size)) / beta
+
+
 def estimate(transport: str, msg_size: int) -> float:
     """Estimated seconds to transfer ``msg_size`` bytes over ``transport``.
 
     Always > 0, matching the reference contract (tests/test_basic.py:445-457).
     """
-    alpha, beta = LINK_MODELS.get(transport, LINK_MODELS["tcp"])
-    return alpha + max(0, int(msg_size)) / beta
+    return _apply(LINK_MODELS.get(transport, LINK_MODELS["tcp"]), msg_size)
+
+
+def conn_estimate(conn, transport: str, msg_size: int) -> float:
+    """Per-endpoint estimate: a live-calibrated model attached to the
+    connection (``conn.perf_model``, set by :func:`autocalibrate` /
+    :func:`autocalibrate_ep`) wins over the transport-class table —
+    both engines' ``evaluate_perf`` route through here."""
+    model = getattr(conn, "perf_model", None)
+    if model is not None:
+        return _apply(model, msg_size)
+    return estimate(transport, msg_size)
+
+
+async def _probe_samples(send, flush, sizes):
+    """(bytes, seconds) enqueue-to-flush samples over PROBE_TAG probes."""
+    import time
+
+    import numpy as np
+
+    from .core.matching import PROBE_TAG
+
+    samples = []
+    for size in sizes:
+        buf = np.zeros(size, dtype=np.uint8)
+        # warmup
+        await send(buf, PROBE_TAG)
+        await flush()
+        t0 = time.perf_counter()
+        await send(buf, PROBE_TAG)
+        await flush()
+        samples.append((size, time.perf_counter() - t0))
+    return samples
 
 
 async def autocalibrate(client, transport: str = "inproc",
@@ -45,29 +91,37 @@ async def autocalibrate(client, transport: str = "inproc",
     matchers consume and drop on arrival (core/matching.py) -- probing a
     live connection cannot pollute the peer's matching state or be claimed
     by wildcard receives.
+
+    The fit lands twice: on ``transport``'s class-table entry (the
+    fallback every uncalibrated estimate uses) and on THIS client's
+    connection, so ``client.evaluate_perf`` reports the endpoint's own
+    measured link from then on.
     """
-    import time
-
-    import numpy as np
-
-    from .core.matching import PROBE_TAG
-
-    samples = []
-    for size in sizes:
-        buf = np.zeros(size, dtype=np.uint8)
-        # warmup
-        await client.asend(buf, PROBE_TAG)
-        await client.aflush()
-        t0 = time.perf_counter()
-        await client.asend(buf, PROBE_TAG)
-        await client.aflush()
-        samples.append((size, time.perf_counter() - t0))
-    return calibrate(transport, samples)
+    samples = await _probe_samples(client.asend, client.aflush, sizes)
+    model = calibrate(transport, samples)
+    conn = getattr(client, "_client", client).primary_conn
+    if conn is not None:
+        conn.perf_model = model
+    return model
 
 
-def calibrate(transport: str, samples: list[tuple[int, float]]) -> tuple[float, float]:
-    """Least-squares fit of (alpha, beta) from (bytes, seconds) samples and
-    update the model in place.  Returns the fitted (alpha, beta)."""
+async def autocalibrate_ep(server, client_ep,
+                           sizes=(1 << 10, 1 << 16, 1 << 20, 1 << 24)) -> tuple[float, float]:
+    """Server-side per-endpoint calibration: probe ONE accepted endpoint
+    (``server.asend(ep, ...)`` + ``aflush_ep``) and attach the fitted
+    (alpha, beta) to that endpoint's connection only — the class table is
+    untouched, so two peers on different links report different estimates
+    from their own live probes (``server.evaluate_perf(ep, n)``)."""
+    samples = await _probe_samples(
+        lambda buf, tag: server.asend(client_ep, buf, tag),
+        lambda: server.aflush_ep(client_ep), sizes)
+    model = fit_alpha_beta(samples)
+    client_ep._conn.perf_model = model
+    return model
+
+
+def fit_alpha_beta(samples: list[tuple[float, float]]) -> tuple[float, float]:
+    """Least-squares (alpha, beta) from (bytes, seconds) samples."""
     if len(samples) < 2:
         raise ValueError("need at least two (bytes, seconds) samples")
     n = len(samples)
@@ -80,7 +134,12 @@ def calibrate(transport: str, samples: list[tuple[int, float]]) -> tuple[float, 
         raise ValueError("degenerate samples")
     inv_beta = (n * sxy - sx * sy) / denom
     alpha = (sy - inv_beta * sx) / n
-    alpha = max(alpha, 1e-9)
-    beta = 1.0 / max(inv_beta, 1e-15)
-    LINK_MODELS[transport] = (alpha, beta)
-    return alpha, beta
+    return max(alpha, 1e-9), 1.0 / max(inv_beta, 1e-15)
+
+
+def calibrate(transport: str, samples: list[tuple[float, float]]) -> tuple[float, float]:
+    """:func:`fit_alpha_beta`, committed to ``transport``'s class-table
+    entry (the fallback for uncalibrated endpoints).  Returns the fit."""
+    model = fit_alpha_beta(samples)
+    LINK_MODELS[transport] = model
+    return model
