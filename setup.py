@@ -1,0 +1,24 @@
+"""Wheel tagging shim.  All metadata lives in pyproject.toml.
+
+The native engine is a ctypes-loaded shared object, not a CPython
+extension module, so setuptools would tag the wheel py3-none-any even
+when ``starway_tpu/_sw_native.so`` is bundled — and auditwheel refuses to
+repair/verify a pure wheel.  Declaring binary content when the artifact
+is present makes cibuildwheel's builds come out platform-tagged (then
+manylinux-tagged by auditwheel), while a source build without the engine
+still produces the honest pure-Python wheel.
+"""
+
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.dist import Distribution
+
+
+class _MaybeBinaryDistribution(Distribution):
+    def has_ext_modules(self):
+        return (Path(__file__).parent / "starway_tpu"
+                / "_sw_native.so").exists()
+
+
+setup(distclass=_MaybeBinaryDistribution)

@@ -20,7 +20,11 @@ def prebuilt() -> "Path | None":
     """The existing artifact if present and fresh, else None — NEVER
     compiles.  For callers on latency-sensitive paths (connection setup)
     that want the lib only if it is already there."""
-    if (_SRC.exists() and _HDR.exists() and _OUT.exists()
+    if not _SRC.exists() or not _HDR.exists():
+        # Installed wheel: no native/ sources ship, but the built engine
+        # does (pyproject package-data).  The bundled artifact IS current.
+        return _OUT if _OUT.exists() else None
+    if (_OUT.exists()
             and _OUT.stat().st_mtime >= max(_SRC.stat().st_mtime,
                                             _HDR.stat().st_mtime)):
         return _OUT
@@ -36,6 +40,9 @@ def ensure_built(force: bool = False) -> Path:
     import os
 
     if not _SRC.exists() or not _HDR.exists():
+        if _OUT.exists():
+            # Installed wheel: sources absent, bundled artifact present.
+            return _OUT
         missing = _SRC if not _SRC.exists() else _HDR
         raise FileNotFoundError(f"native source missing: {missing}")
     src_mtime = max(_SRC.stat().st_mtime, _HDR.stat().st_mtime)
