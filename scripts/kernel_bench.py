@@ -370,7 +370,11 @@ def bench_train_mfu_large(iters: int = 2):
     return _train_mfu_row(
         "train_step_mfu_large",
         dict(d_model=2048, n_layers=12, n_heads=16, n_kv_heads=4,
-             d_ff=5632, vocab_size=32000, dtype="bfloat16", remat=True),
+             d_ff=5632, vocab_size=32000, dtype="bfloat16", remat=True,
+             # "dots" saves matmul + flash outputs: the backward replays
+             # only the elementwise chain, so the 6ND MFU isn't capped at
+             # ~0.75x by a full forward recompute (llama.py:_remat_wrap).
+             remat_policy="dots"),
         B=1, S=8192, iters=iters)
 
 

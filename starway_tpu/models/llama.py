@@ -28,6 +28,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import blockwise_attention
@@ -64,6 +65,16 @@ class LlamaConfig:
     # independently (q/k/v project to n_heads * head_dim != d_model) —
     # every projection/reshape in this module keys off cfg.head_dim.
     head_dim_override: Optional[int] = None
+    # Rematerialisation policy when ``remat`` is on.  None = full-layer
+    # recompute (lowest memory, ~1 extra forward of flops in the backward
+    # — an MFU ceiling of ~0.75x hardware efficiency against the 6ND
+    # count).  "dots" = save every no-batch-dim matmul output AND the
+    # attention kernel's output (tagged "attn_out" in decoder_layer), so
+    # the backward re-runs only the cheap elementwise chain (norms, rope,
+    # silu) — the remat knob for MFU-bound training (BASELINE.md's
+    # train_step_mfu >= 0.40 target) at O(S * D) extra saved bytes per
+    # layer.
+    remat_policy: Optional[str] = None
     # RoPE frequency scaling, as a hashable tuple (configs key jit caches):
     #   ("linear", factor)  — all frequencies divided by factor;
     #   ("llama3", factor, low_freq_factor, high_freq_factor,
@@ -87,6 +98,14 @@ class LlamaConfig:
         elif self.head_dim_override < 2 or self.head_dim_override % 2:
             raise ValueError(f"head_dim_override must be an even int >= 2, "
                              f"got {self.head_dim_override}")
+        if self.remat_policy not in (None, "dots"):
+            raise ValueError(
+                f"remat_policy must be None or 'dots', got "
+                f"{self.remat_policy!r}")
+        if self.remat_policy is not None and not self.remat:
+            raise ValueError(
+                "remat_policy is set but remat is False — the policy "
+                "would be silently ignored; set remat=True")
         if self.rope_scaling is not None:
             s = tuple(self.rope_scaling)
             if not s or s[0] not in ("linear", "llama3") or (
@@ -319,10 +338,32 @@ def head_logits(h, final_norm_w, lm_head_w, eps: float):
 
 def token_ce(logits, targets):
     """Mean next-token cross-entropy of ``logits [..., V]`` against int ids
-    ``targets [...]`` (same leading shape)."""
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    ``targets [...]`` (same leading shape).
+
+    Written as ``logsumexp - target_logit`` rather than gathering from a
+    materialised ``log_softmax`` tensor: the ``[B, S, V]`` f32 logits are
+    the biggest activation in a train step (1 GB at S=8192 V=32000), and
+    the logp variant writes + re-reads a second one; here the reductions
+    fuse into the logits' producer and only ``[B, S]`` scalars survive.
+    Same math, same gradient (softmax - one_hot)."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tl = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tl)
+
+
+def _remat_wrap(layer, cfg: "LlamaConfig"):
+    """The one remat site: full-layer checkpoint, or the "dots" policy —
+    save no-batch-dim matmul outputs plus the attention output (tagged
+    ``attn_out``), so the backward replays only the elementwise chain
+    instead of re-running every matmul and the flash kernel forward."""
+    if not cfg.remat:
+        return layer
+    if cfg.remat_policy == "dots":
+        policy = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"))
+        return jax.checkpoint(layer, policy=policy)
+    return jax.checkpoint(layer)
 
 
 def default_attn(q, k, v, window: Optional[int] = None):
@@ -383,6 +424,9 @@ def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
     # kv stays in grouped (narrow) form; attention impls expand it, so
     # the ring rotates 1/n_rep of the bytes over ICI.
     o = attn_fn(q, k, v)  # [B, H, S, Dh]
+    # Tag for the "dots" remat policy: saving the kernel output means the
+    # backward never re-runs the flash forward (see _remat_wrap).
+    o = checkpoint_name(o, "attn_out")
     o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_heads * hd)
     h = h + matmul_w(o, lp["wo"])
 
@@ -468,7 +512,7 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
         return (h, aux + layer_aux), ((k, v) if return_kv else None,
                                       stats if return_moe_stats else None)
 
-    body = jax.checkpoint(layer) if cfg.remat else layer
+    body = _remat_wrap(layer, cfg)
     (h, aux), (kv, moe_stats) = lax.scan(
         body, (h, jnp.zeros((), jnp.float32)), params["layers"])
     if last_only:

@@ -20,11 +20,13 @@ def prebuilt() -> "Path | None":
     """The existing artifact if present and fresh, else None — NEVER
     compiles.  For callers on latency-sensitive paths (connection setup)
     that want the lib only if it is already there."""
-    if not _SRC.exists() or not _HDR.exists():
-        # Installed wheel: no native/ sources ship, but the built engine
+    if not _SRC.exists() and not _HDR.exists():
+        # Installed wheel: NO native/ sources ship, but the built engine
         # does (pyproject package-data).  The bundled artifact IS current.
+        # (Exactly one source missing is a broken checkout, not a wheel —
+        # fall through so staleness/raise behaviour applies.)
         return _OUT if _OUT.exists() else None
-    if (_OUT.exists()
+    if (_SRC.exists() and _HDR.exists() and _OUT.exists()
             and _OUT.stat().st_mtime >= max(_SRC.stat().st_mtime,
                                             _HDR.stat().st_mtime)):
         return _OUT
@@ -40,8 +42,10 @@ def ensure_built(force: bool = False) -> Path:
     import os
 
     if not _SRC.exists() or not _HDR.exists():
-        if _OUT.exists():
+        if not _SRC.exists() and not _HDR.exists() and _OUT.exists():
             # Installed wheel: sources absent, bundled artifact present.
+            # One source missing is a broken checkout — raise below, and
+            # never serve a stale artifact against new-protocol peers.
             return _OUT
         missing = _SRC if not _SRC.exists() else _HDR
         raise FileNotFoundError(f"native source missing: {missing}")

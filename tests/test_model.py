@@ -80,6 +80,32 @@ def test_remat_matches(cfg, params):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
 
 
+def test_remat_policy_dots_grads_match(cfg, params):
+    """remat_policy='dots' (save matmul + attn outputs, replay only the
+    elementwise chain in backward — the MFU remat knob) must reproduce the
+    no-remat loss AND gradients."""
+    import dataclasses
+
+    from starway_tpu.models.llama import loss_fn
+
+    batch = jnp.asarray(np.random.default_rng(11).integers(
+        0, cfg.vocab_size, (2, 17), dtype=np.int32))
+    ref_l, ref_g = jax.jit(jax.value_and_grad(
+        lambda p: loss_fn(p, batch, cfg)))(params)
+    cfg_d = dataclasses.replace(cfg, remat=True, remat_policy="dots")
+    out_l, out_g = jax.jit(jax.value_and_grad(
+        lambda p: loss_fn(p, batch, cfg_d)))(params)
+    np.testing.assert_allclose(float(out_l), float(ref_l), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4),
+        out_g, ref_g)
+    import pytest
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        dataclasses.replace(cfg, remat_policy="everything")
+
+
 def test_grad_accumulation_matches_full_batch(cfg, params):
     """accum_steps=2 reproduces the full-batch optimizer step (dense model,
     f32 debug preset -> tight tolerance)."""
