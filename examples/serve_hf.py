@@ -32,9 +32,11 @@ def main() -> None:
     ap.add_argument("--quantize", choices=["none", "int8"], default="none",
                     help="int8 = W8A16 weight-only serving tree "
                          "(half the weight HBM; see ops/quantize.py)")
-    ap.add_argument("--arch", choices=["llama", "llama31"], default="llama",
+    ap.add_argument("--arch", choices=["llama", "llama31", "qwen2"],
+                    default="llama",
                     help="demo-model flavour: llama31 = decoupled head_dim "
-                         "+ llama3 rope scaling (modern checkpoints)")
+                         "+ llama3 rope scaling; qwen2 = q/k/v projection "
+                         "biases (third served family)")
     args = ap.parse_args()
 
     import jax
@@ -51,22 +53,30 @@ def main() -> None:
     from starway_tpu.models.generate import generate
 
     if args.model:
-        hf = transformers.LlamaForCausalLM.from_pretrained(args.model)
+        # Auto class: real checkpoints of every served family (Llama,
+        # Mistral, Qwen2) load through their own architecture.
+        hf = transformers.AutoModelForCausalLM.from_pretrained(args.model)
     else:
         torch.manual_seed(0)
-        extra = {}
-        if args.arch == "llama31":
-            # Llama-3.1-style: head_dim pinned independently of
-            # hidden_size // n_heads, banded llama3 rope scaling.
-            extra = dict(head_dim=32, rope_scaling={
-                "rope_type": "llama3", "factor": 4.0,
-                "low_freq_factor": 1.0, "high_freq_factor": 2.0,
-                "original_max_position_embeddings": 64})
-        hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+        dims = dict(
             vocab_size=512, hidden_size=128, intermediate_size=256,
             num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=4,
-            max_position_embeddings=256, attn_implementation="eager",
-            **extra))
+            max_position_embeddings=256, attn_implementation="eager")
+        if args.arch == "qwen2":
+            # Qwen2-style: q/k/v projection biases.
+            hf = transformers.Qwen2ForCausalLM(
+                transformers.Qwen2Config(**dims))
+        else:
+            extra = {}
+            if args.arch == "llama31":
+                # Llama-3.1-style: head_dim pinned independently of
+                # hidden_size // n_heads, banded llama3 rope scaling.
+                extra = dict(head_dim=32, rope_scaling={
+                    "rope_type": "llama3", "factor": 4.0,
+                    "low_freq_factor": 1.0, "high_freq_factor": 2.0,
+                    "original_max_position_embeddings": 64})
+            hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+                **dims, **extra))
     hf.eval()
 
     cfg = config_from_hf(hf.config, dtype="float32" if args.model is None

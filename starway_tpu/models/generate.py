@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .llama import (LlamaConfig, apply_rope, cfg_rope_tables, forward,
-                    matmul_w, rmsnorm)
+                    matmul_w, qkv_proj, rmsnorm)
 from ..ops.attention import NEG_BIG, repeat_kv
 
 
@@ -199,9 +199,7 @@ def cached_layer_scan(params, cache, h, cos_p, sin_p, cfg: LlamaConfig,
             lp, kc, vc = xs
             ksc = vsc = None
         x = rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
-        q = matmul_w(x, lp["wq"]).reshape(B, C, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-        k = matmul_w(x, lp["wk"]).reshape(B, C, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
-        v = matmul_w(x, lp["wv"]).reshape(B, C, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+        q, k, v = qkv_proj(x, lp, cfg)
         q = apply_rope(q, cos_p, sin_p)
         k = apply_rope(k, cos_p, sin_p)
         if quant:

@@ -1,11 +1,14 @@
-"""HuggingFace Llama/Mistral checkpoint -> starway-tpu parameter tree.
+"""HuggingFace Llama/Mistral/Qwen2 checkpoint -> starway-tpu parameter tree.
 
 Bridges the ecosystem's weights into this framework:
-``transformers.LlamaForCausalLM`` and ``MistralForCausalLM`` (same
+``transformers.LlamaForCausalLM``, ``MistralForCausalLM`` (same
 architecture; Mistral adds sliding-window attention, which maps onto
-``LlamaConfig.sliding_window``) convert into the stacked-layer pytree
-``models/llama.py`` trains and serves, and ``config_from_hf`` derives the
-matching :class:`LlamaConfig`.
+``LlamaConfig.sliding_window``) and ``Qwen2ForCausalLM`` (adds q/k/v
+projection biases -> ``cfg.attn_bias``/``bq``/``bk``/``bv`` leaves)
+convert into the stacked-layer pytree ``models/llama.py`` trains and
+serves, and ``config_from_hf`` derives the matching :class:`LlamaConfig`
+— including modern variants with decoupled ``head_dim`` and
+linear/llama3 ``rope_scaling``.
 
 Convention notes (why this is transpose-and-stack, not surgery):
 
@@ -39,13 +42,40 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
     Refuses configs this model family cannot represent — silently dropping
     them would produce a numerically wrong model (the failure mode this
     module exists to prevent)."""
-    if getattr(hf_config, "attention_bias", False) or getattr(
-            hf_config, "mlp_bias", False):
+    if getattr(hf_config, "mlp_bias", False):
         raise NotImplementedError(
-            "projection biases are not represented in this parameter tree")
+            "MLP biases are not represented in this parameter tree")
     act = getattr(hf_config, "hidden_act", "silu")
     if act not in ("silu", "swish"):
         raise NotImplementedError(f"hidden_act={act!r}; this family is SwiGLU")
+    # Qwen2-family checkpoints attach q/k/v biases (cfg.attn_bias ->
+    # bq/bk/bv leaves; Qwen2's o_proj carries NO bias, so the tree is
+    # complete).  A generic attention_bias=True config is a DIFFERENT
+    # shape: HF Llama then puts a bias on o_proj too, which this tree
+    # does not represent — refuse rather than silently drop it.
+    model_type = getattr(hf_config, "model_type", "")
+    attn_bias = model_type == "qwen2"
+    if getattr(hf_config, "attention_bias", False) and not attn_bias:
+        raise NotImplementedError(
+            "attention_bias=True on a non-Qwen2 config also biases o_proj, "
+            "which this parameter tree does not represent; converting "
+            "would silently drop it")
+    # Qwen2 gates its sliding_window on use_sliding_window (default
+    # False), and even then windows only the layers PAST
+    # max_window_layers — a mixed pattern cfg.sliding_window (global)
+    # cannot express.  Honour the gate; refuse the mixed case.
+    sliding = getattr(hf_config, "sliding_window", None)
+    if sliding is not None and hasattr(hf_config, "use_sliding_window"):
+        mwl = getattr(hf_config, "max_window_layers", 0) or 0
+        if not hf_config.use_sliding_window:
+            sliding = None
+        elif mwl >= hf_config.num_hidden_layers:
+            sliding = None  # "first mwl layers full" covers every layer
+        elif mwl > 0:
+            raise NotImplementedError(
+                f"use_sliding_window with max_window_layers={mwl} windows "
+                f"only layers past it; this config represents a single "
+                "global sliding_window")
     # Newer HF configs may pin an explicit per-head dim decoupled from
     # hidden_size // num_attention_heads; llama.py keys every
     # projection/reshape off cfg.head_dim, so the override carries it.
@@ -70,7 +100,8 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
         norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
         # Mistral-family configs carry sliding_window; same architecture
         # otherwise, so the converter serves both families.
-        sliding_window=getattr(hf_config, "sliding_window", None),
+        sliding_window=sliding,
+        attn_bias=attn_bias,
         head_dim_override=(explicit_hd if explicit_hd is not None
                            and explicit_hd != derived_hd else None),
         rope_scaling=_rope_scaling_from_hf(
@@ -164,6 +195,20 @@ def params_from_hf(model_or_state: Any, cfg: LlamaConfig, dtype=None, *,
         "mlp_norm": stack(
             lambda i: _np(get(f"layers.{i}.post_attention_layernorm.weight"))),
     }
+    if prefix + "layers.0.self_attn.o_proj.bias" in state:
+        # config_from_hf refuses these configs; a raw state dict can still
+        # reach here — same refusal, same reason.
+        raise NotImplementedError(
+            "o_proj carries a bias, which this parameter tree does not "
+            "represent; converting would silently drop it")
+    if prefix + "layers.0.self_attn.q_proj.bias" in state:
+        # Qwen2 family: per-head projection biases (qkv_proj keys off the
+        # leaves' presence; HF bias vectors are [out] — no transpose).
+        layers.update(
+            bq=stack(lambda i: _np(get(f"layers.{i}.self_attn.q_proj.bias"))),
+            bk=stack(lambda i: _np(get(f"layers.{i}.self_attn.k_proj.bias"))),
+            bv=stack(lambda i: _np(get(f"layers.{i}.self_attn.v_proj.bias"))),
+        )
     embed = jnp.asarray(_np(get("embed_tokens.weight")), dt)
     if "lm_head.weight" in state:
         lm_head = jnp.asarray(_t(state["lm_head.weight"]), dt)

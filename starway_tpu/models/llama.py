@@ -65,6 +65,11 @@ class LlamaConfig:
     # independently (q/k/v project to n_heads * head_dim != d_model) —
     # every projection/reshape in this module keys off cfg.head_dim.
     head_dim_override: Optional[int] = None
+    # Per-head q/k/v projection biases (Qwen2-family checkpoints; Llama/
+    # Mistral have none).  Adds bq/bk/bv [L, H*hd] leaves to the layer
+    # tree; consumers key off the LEAVES' presence (qkv_proj), so a
+    # converted tree works even where the config doesn't travel.
+    attn_bias: bool = False
     # Rematerialisation policy when ``remat`` is on.  None = full-layer
     # recompute (lowest memory, ~1 extra forward of flops in the backward
     # — an MFU ceiling of ~0.75x hardware efficiency against the 6ND
@@ -169,6 +174,10 @@ def init_params(key, cfg: LlamaConfig) -> dict:
         "attn_norm": jnp.ones((L, D), dt),
         "mlp_norm": jnp.ones((L, D), dt),
     }
+    if cfg.attn_bias:
+        layers.update(bq=jnp.zeros((L, Hq * hd), dt),
+                      bk=jnp.zeros((L, Hkv * hd), dt),
+                      bv=jnp.zeros((L, Hkv * hd), dt))
     if cfg.n_experts > 0:
         from .moe import init_moe_params
 
@@ -202,6 +211,9 @@ def param_specs(cfg: LlamaConfig) -> dict:
         "attn_norm": P(None, None),
         "mlp_norm": P(None, None),
     }
+    if cfg.attn_bias:
+        # Biases live on the projection OUT dim: shard with their weight.
+        layers.update(bq=P(None, "tp"), bk=P(None, "tp"), bv=P(None, "tp"))
     if cfg.n_experts > 0:
         from .moe import moe_specs
 
@@ -404,6 +416,27 @@ def resolve_attn_fn(cfg: LlamaConfig, attn_fn: Optional[Callable]) -> Callable:
 # ----------------------------------------------------------------- forward
 
 
+def qkv_proj(x, lp, cfg: "LlamaConfig"):
+    """q/k/v projections on ``x [B, S, D]`` -> ``[B, H, S, hd]`` heads,
+    pre-RoPE.  Optional per-head biases (Qwen2 family) apply when the
+    layer tree carries ``bq``/``bk``/``bv`` — leaf presence is the
+    marker, so converted trees work wherever the config doesn't travel.
+    The ONE projection site shared by the scan forward (decoder_layer)
+    and the cached decode layer scan (generate.py)."""
+    B, S = x.shape[0], x.shape[1]
+    hd = cfg.head_dim
+    q = matmul_w(x, lp["wq"])
+    k = matmul_w(x, lp["wk"])
+    v = matmul_w(x, lp["wv"])
+    if "bq" in lp:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    return (q.reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3),
+            k.reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3),
+            v.reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3))
+
+
 def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
                   attn_fn: Callable, moe_fn: Optional[Callable] = None):
     """One pre-norm decoder block on ``h [B, S, D]`` with layer params
@@ -416,9 +449,7 @@ def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
     B, S, _ = h.shape
     hd = cfg.head_dim
     x = rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
-    q = matmul_w(x, lp["wq"]).reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-    k = matmul_w(x, lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
-    v = matmul_w(x, lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    q, k, v = qkv_proj(x, lp, cfg)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     # kv stays in grouped (narrow) form; attention impls expand it, so

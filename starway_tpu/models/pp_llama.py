@@ -66,16 +66,21 @@ def pp_merge_params(pp_params: dict) -> dict:
     }
 
 
-def _moe_stage_template() -> dict:
+def _moe_stage_template(cfg: LlamaConfig) -> dict:
     """Shape-only skeleton of one MoE stage tree (keys mirror
-    llama.py:init_params' layer dict; leaf values are placeholders) —
-    enough structure for :func:`_expert_leaf_spec` / :func:`pp_stage_specs`
-    to build spec trees before any real params exist."""
-    return {
+    llama.py:init_params' layer dict for ``cfg``; leaf values are
+    placeholders) — enough structure for :func:`_expert_leaf_spec` /
+    :func:`pp_stage_specs` to build spec trees before any real params
+    exist.  Must track init_params' key set exactly (tree_map over
+    mismatched structures raises inside shard_map otherwise)."""
+    t = {
         "wq": 0, "wk": 0, "wv": 0, "wo": 0,
         "attn_norm": 0, "mlp_norm": 0,
         "moe": {"router": 0, "w_in": 0, "w_out": 0},
     }
+    if cfg.attn_bias:
+        t.update(bq=0, bk=0, bv=0)
+    return t
 
 
 def _expert_leaf_spec(stages: dict):
@@ -278,7 +283,7 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
             # tables over ep) ride through to shard_map; the expert mask
             # drives the ep-aware gradient reduction.  Built from a
             # shape-only template tree (leaf VALUES are ignored).
-            template = _moe_stage_template()
+            template = _moe_stage_template(cfg)
             kw = {"with_aux": True}
             if ep_axis is not None:
                 kw.update(

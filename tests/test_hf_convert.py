@@ -145,6 +145,32 @@ def test_rope_scaling_matches_transformers(scaling):
     np.testing.assert_array_equal(ours_gen[:, :hf_gen.shape[1]], hf_gen)
 
 
+def test_bias_and_mixed_window_refusals(hf_model):
+    """Shapes the tree cannot represent still refuse loudly: a generic
+    attention_bias=True config biases o_proj too (Qwen2 doesn't), and
+    Qwen2's use_sliding_window with a partial max_window_layers windows
+    only some layers."""
+    import copy
+
+    hf_cfg = copy.deepcopy(hf_model.config)
+    hf_cfg.attention_bias = True
+    with pytest.raises(NotImplementedError, match="o_proj"):
+        config_from_hf(hf_cfg)
+
+    qcfg = transformers.Qwen2Config(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        use_sliding_window=True, sliding_window=8, max_window_layers=2)
+    with pytest.raises(NotImplementedError, match="max_window_layers"):
+        config_from_hf(qcfg)
+    # Every layer full-attention (mwl >= n_layers): converts, window off.
+    qcfg2 = transformers.Qwen2Config(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        use_sliding_window=True, sliding_window=8, max_window_layers=2)
+    assert config_from_hf(qcfg2).sliding_window is None
+
+
 def test_unknown_rope_scaling_refused(hf_model):
     """yarn/dynamic/... still refuse loudly — silently dropping a scaling
     scheme would change frequencies vs transformers."""
@@ -154,6 +180,48 @@ def test_unknown_rope_scaling_refused(hf_model):
     hf_cfg.rope_scaling = {"rope_type": "yarn", "factor": 2.0}
     with pytest.raises(NotImplementedError, match="rope_scaling"):
         config_from_hf(hf_cfg)
+
+
+def test_qwen2_logits_and_generation_match_transformers():
+    """Qwen2 = Llama architecture + q/k/v projection biases (a third
+    served family): the converter flips cfg.attn_bias, maps the bias
+    vectors, and both logits and greedy generation match transformers'
+    Qwen2ForCausalLM — through prefill + cached decode (the bias applies
+    at every projection site)."""
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=False, attn_implementation="eager")
+    torch.manual_seed(9)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    # transformers zero-inits biases; randomise them so a conversion that
+    # DROPPED the bias (or added it in the wrong place) cannot pass.
+    with torch.no_grad():
+        for layer in hf.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(0.0, 0.5)
+
+    cfg = config_from_hf(hf.config, dtype="float32")
+    assert cfg.attn_bias and cfg.sliding_window is None
+    params = params_from_hf(hf, cfg)
+    assert params["layers"]["bq"].shape == (2, 64)
+    assert float(abs(np.asarray(params["layers"]["bq"])).max()) > 0
+
+    tokens = np.random.default_rng(4).integers(0, 256, (2, 18), dtype=np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+    prompt = np.asarray([[3, 8, 5, 2]], dtype=np.int64)
+    with torch.no_grad():
+        hf_gen = hf.generate(torch.from_numpy(prompt), max_new_tokens=10,
+                             do_sample=False, pad_token_id=0).numpy()
+    ours_gen = np.asarray(generate(params, cfg,
+                                   jnp.asarray(prompt, jnp.int32), 10))
+    np.testing.assert_array_equal(ours_gen[:, :hf_gen.shape[1]], hf_gen)
 
 
 def test_mistral_logits_and_generation_match_transformers():

@@ -106,6 +106,44 @@ def test_remat_policy_dots_grads_match(cfg, params):
         dataclasses.replace(cfg, remat_policy="everything")
 
 
+def test_attn_bias_trains_and_shards(cfg):
+    """cfg.attn_bias: bq/bk/bv leaves exist, change the forward, receive
+    gradients through a train step, and carry tp specs on the head dim."""
+    import dataclasses
+
+    from starway_tpu.models import make_train_step
+    from starway_tpu.models.llama import loss_fn, param_specs
+
+    cfg_b = dataclasses.replace(cfg, attn_bias=True)
+    params_b = init_params(jax.random.PRNGKey(0), cfg_b)
+    assert params_b["layers"]["bq"].shape == (cfg.n_layers,
+                                              cfg.n_heads * cfg.head_dim)
+    batch = jnp.asarray(np.random.default_rng(7).integers(
+        0, cfg.vocab_size, (2, 17), dtype=np.int32))
+
+    # Zero-init biases leave the forward identical to the biasless tree...
+    base = {**params_b, "layers": {k: v for k, v in
+                                   params_b["layers"].items()
+                                   if k not in ("bq", "bk", "bv")}}
+    np.testing.assert_allclose(
+        np.asarray(forward(base, batch[:, :-1], cfg)),
+        np.asarray(forward(params_b, batch[:, :-1], cfg_b)), atol=1e-6)
+
+    # ...and receive nonzero gradients (the projection path is live).
+    grads = jax.grad(loss_fn)(params_b, batch, cfg_b)
+    assert float(jnp.abs(grads["layers"]["bq"]).max()) > 0
+    assert float(jnp.abs(grads["layers"]["bv"]).max()) > 0
+
+    tx = optax.adamw(1e-3)
+    step = make_train_step(cfg_b, tx)
+    p2, _, loss = jax.jit(step)(params_b, tx.init(params_b), batch)
+    assert np.isfinite(float(loss))
+    assert float(jnp.abs(p2["layers"]["bq"]).max()) > 0  # moved off zero
+
+    specs = param_specs(cfg_b)
+    assert tuple(specs["layers"]["bq"]) == (None, "tp")
+
+
 def test_grad_accumulation_matches_full_batch(cfg, params):
     """accum_steps=2 reproduces the full-batch optimizer step (dense model,
     f32 debug preset -> tight tolerance)."""
