@@ -32,11 +32,12 @@ def main() -> None:
     ap.add_argument("--quantize", choices=["none", "int8"], default="none",
                     help="int8 = W8A16 weight-only serving tree "
                          "(half the weight HBM; see ops/quantize.py)")
-    ap.add_argument("--arch", choices=["llama", "llama31", "qwen2"],
+    ap.add_argument("--arch",
+                    choices=["llama", "llama31", "qwen2", "mixtral"],
                     default="llama",
                     help="demo-model flavour: llama31 = decoupled head_dim "
                          "+ llama3 rope scaling; qwen2 = q/k/v projection "
-                         "biases (third served family)")
+                         "biases; mixtral = SwiGLU top-2 MoE experts")
     args = ap.parse_args()
 
     import jax
@@ -66,6 +67,10 @@ def main() -> None:
             # Qwen2-style: q/k/v projection biases.
             hf = transformers.Qwen2ForCausalLM(
                 transformers.Qwen2Config(**dims))
+        elif args.arch == "mixtral":
+            # Mixtral-style: SwiGLU top-2 MoE FFN (dropless conversion).
+            hf = transformers.MixtralForCausalLM(transformers.MixtralConfig(
+                **dims, num_local_experts=4, num_experts_per_tok=2))
         else:
             extra = {}
             if args.arch == "llama31":

@@ -226,6 +226,7 @@ def cached_layer_scan(params, cache, h, cos_p, sin_p, cfg: LlamaConfig,
             y, _ = switch_moe(
                 x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"],
                 capacity_factor=cfg.moe_capacity_factor, k=cfg.moe_top_k,
+                w_gate=lp["moe"].get("w_gate"),
             )
             h = h + y
         else:
@@ -644,13 +645,19 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
         key = jax.random.PRNGKey(0)
     ragged = prompt_lengths is not None
     if ragged:
-        if cfg.n_experts > 0:
+        if cfg.n_experts > 0 and cfg.moe_capacity_factor < cfg.n_experts:
             # Expert capacity is computed over the whole padded batch, so
             # pad tokens would consume slots and perturb REAL rows' routing
             # — the per-row-equivalence contract below cannot hold.
+            # Exception: capacity_factor >= n_experts makes capacity
+            # T * k, provably dropless for ANY routing, so pads can only
+            # occupy spare slots and real rows are untouched (the Mixtral
+            # conversion default, hf_convert.py).
             raise ValueError(
-                "ragged generation is dense-only: MoE expert capacity is "
-                "shared batch-wide, so pad tokens would alter real rows")
+                "ragged generation needs dense FFNs or provably-dropless "
+                "MoE: expert capacity is shared batch-wide, so pad tokens "
+                "would alter real rows; set moe_capacity_factor >= "
+                f"n_experts (= {cfg.n_experts}) to make drops impossible")
         lengths = validate_prompt_lengths(prompt_lengths, B, P)
     else:
         lengths = jnp.zeros((B,), jnp.int32)  # unused placeholder

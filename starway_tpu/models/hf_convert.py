@@ -107,6 +107,21 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
         rope_scaling=_rope_scaling_from_hf(
             getattr(hf_config, "rope_scaling", None)),
     )
+    if model_type == "mixtral":
+        # Mixtral: SwiGLU experts, top-k routing with softmax-then-topk
+        # renormalisation — exactly moe.py's _route.  HF routes dropless;
+        # capacity_factor = n_experts makes our static capacity provably
+        # dropless (capacity = T * k) so converted models match
+        # transformers token for token.  Lower it for capacity-bound
+        # training throughput at the cost of that guarantee.
+        kw.update(
+            n_experts=hf_config.num_local_experts,
+            moe_top_k=hf_config.num_experts_per_tok,
+            moe_swiglu=True,
+            moe_capacity_factor=float(hf_config.num_local_experts),
+            moe_aux_coef=float(getattr(hf_config, "router_aux_loss_coef",
+                                       0.001)),
+        )
     kw.update(overrides)
     return LlamaConfig(**kw)
 
@@ -188,13 +203,36 @@ def params_from_hf(model_or_state: Any, cfg: LlamaConfig, dtype=None, *,
         "wk": stack(lambda i: _t(get(f"layers.{i}.self_attn.k_proj.weight"))),
         "wv": stack(lambda i: _t(get(f"layers.{i}.self_attn.v_proj.weight"))),
         "wo": stack(lambda i: _t(get(f"layers.{i}.self_attn.o_proj.weight"))),
-        "w_gate": stack(lambda i: _t(get(f"layers.{i}.mlp.gate_proj.weight"))),
-        "w_up": stack(lambda i: _t(get(f"layers.{i}.mlp.up_proj.weight"))),
-        "w_down": stack(lambda i: _t(get(f"layers.{i}.mlp.down_proj.weight"))),
         "attn_norm": stack(lambda i: _np(get(f"layers.{i}.input_layernorm.weight"))),
         "mlp_norm": stack(
             lambda i: _np(get(f"layers.{i}.post_attention_layernorm.weight"))),
     }
+    if prefix + "layers.0.block_sparse_moe.gate.weight" in state:
+        # Mixtral: gate -> router [D, E]; per-expert SwiGLU maps
+        # w1 -> w_gate, w3 -> w_in, w2 -> w_out (all [out, in] -> [in, out]
+        # transposes), stacked to [L, E, ...].
+        E = cfg.n_experts
+
+        def estack(which):
+            return jnp.asarray(np.stack([
+                np.stack([_t(get(f"layers.{i}.block_sparse_moe.experts."
+                              f"{e}.{which}.weight")) for e in range(E)])
+                for i in range(L)]), dt)
+
+        layers["moe"] = {
+            "router": stack(
+                lambda i: _t(get(f"layers.{i}.block_sparse_moe.gate.weight"))),
+            "w_gate": estack("w1"),
+            "w_in": estack("w3"),
+            "w_out": estack("w2"),
+        }
+    else:
+        layers.update(
+            w_gate=stack(lambda i: _t(get(f"layers.{i}.mlp.gate_proj.weight"))),
+            w_up=stack(lambda i: _t(get(f"layers.{i}.mlp.up_proj.weight"))),
+            w_down=stack(
+                lambda i: _t(get(f"layers.{i}.mlp.down_proj.weight"))),
+        )
     if prefix + "layers.0.self_attn.o_proj.bias" in state:
         # config_from_hf refuses these configs; a raw state dict can still
         # reach here — same refusal, same reason.

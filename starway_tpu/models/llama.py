@@ -55,6 +55,9 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
     moe_top_k: int = 1
+    # SwiGLU experts (Mixtral family): adds a w_gate [L, E, D, F] leaf and
+    # switches _expert_ffn to silu(x@w_gate) * (x@w_in) @ w_out.
+    moe_swiglu: bool = False
     # KV-cache storage: "none" keeps compute_dtype; "int8" stores the cache
     # int8 with per-token scales (ops/quantize.py) — half the HBM bytes on
     # the bandwidth-bound decode stream, double the servable context.
@@ -181,7 +184,9 @@ def init_params(key, cfg: LlamaConfig) -> dict:
     if cfg.n_experts > 0:
         from .moe import init_moe_params
 
-        layers["moe"] = init_moe_params(jax.random.fold_in(key, 17), L, cfg.n_experts, D, F, dt)
+        layers["moe"] = init_moe_params(jax.random.fold_in(key, 17), L,
+                                        cfg.n_experts, D, F, dt,
+                                        swiglu=cfg.moe_swiglu)
     else:
         layers.update(
             w_gate=norm(keys[5], (L, D, F), D**-0.5),
@@ -217,7 +222,7 @@ def param_specs(cfg: LlamaConfig) -> dict:
     if cfg.n_experts > 0:
         from .moe import moe_specs
 
-        layers["moe"] = moe_specs()
+        layers["moe"] = moe_specs(swiglu=cfg.moe_swiglu)
     else:
         layers.update(
             w_gate=P(None, None, "tp"),
@@ -465,9 +470,13 @@ def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
     stats = None
     if cfg.n_experts > 0:
         if moe_fn is not None:
+            # SwiGLU expert trees carry w_gate; pass it only when present
+            # so 4-arg moe_fns (Switch-style) keep working unchanged.
+            kw = ({"w_gate": lp["moe"]["w_gate"]} if "w_gate" in lp["moe"]
+                  else {})
             out = moe_fn(
-                x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"]
-            )
+                x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"],
+                **kw)
             y, aux = out[0], out[1]
             if len(out) > 2:  # with_stats moe_fn: router-health metrics
                 stats = out[2]
@@ -477,6 +486,7 @@ def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
             y, aux = switch_moe(
                 x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"],
                 capacity_factor=cfg.moe_capacity_factor, k=cfg.moe_top_k,
+                w_gate=lp["moe"].get("w_gate"),
             )
         h = h + y
     else:

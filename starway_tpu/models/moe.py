@@ -33,34 +33,51 @@ from jax.sharding import PartitionSpec as P
 
 
 def init_moe_params(key, n_layers: int, n_experts: int, d_model: int,
-                    d_ff: int, dtype) -> dict:
-    k1, k2, k3 = jax.random.split(key, 3)
+                    d_ff: int, dtype, swiglu: bool = False) -> dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
 
     def norm(k, shape, scale):
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
 
-    return {
+    out = {
         "router": norm(k1, (n_layers, d_model, n_experts), d_model**-0.5),
         "w_in": norm(k2, (n_layers, n_experts, d_model, d_ff), d_model**-0.5),
         "w_out": norm(k3, (n_layers, n_experts, d_ff, d_model), d_ff**-0.5),
     }
+    if swiglu:
+        # Mixtral-style SwiGLU experts; _expert_ffn keys off the leaf.
+        out["w_gate"] = norm(k4, (n_layers, n_experts, d_model, d_ff),
+                             d_model**-0.5)
+    return out
 
 
-def moe_specs() -> dict:
+def moe_specs(swiglu: bool = False) -> dict:
     """PartitionSpecs: experts shard over the "ep" mesh axis."""
-    return {
+    out = {
         "router": P(None, None, None),
         "w_in": P(None, "ep", None, None),
         "w_out": P(None, "ep", None, None),
     }
+    if swiglu:
+        out["w_gate"] = P(None, "ep", None, None)
+    return out
 
 
 def moe_capacity(n_assignments: int, n_experts: int,
                  capacity_factor: float) -> int:
     """Static per-expert capacity for ``n_assignments`` routed (token,
     choice) pairs -- ``T * k``, not ``T`` (GShard scales capacity by k, or
-    top-2 would drop second choices even under a balanced router)."""
-    return max(1, int(n_assignments / n_experts * capacity_factor))
+    top-2 would drop second choices even under a balanced router).
+
+    Ceiling, not truncation: ``capacity_factor >= n_experts`` must yield
+    capacity ``>= n_assignments`` — PROVABLY dropless for any routing —
+    because ragged MoE generation's pad-safety argument
+    (models/generate.py) rests on exactly that guarantee; ``int()`` would
+    lose it off float division for non-power-of-two expert counts."""
+    import math
+
+    return max(1, math.ceil(n_assignments * capacity_factor / n_experts
+                            - 1e-9))
 
 
 def _route(xt, router_w, k: int):
@@ -141,17 +158,26 @@ def _routing_stats(expert_counts, keep):
     return {"drop_fraction": drop, "expert_load": load}
 
 
-def _expert_ffn(expert_in, w_in, w_out):
-    """``[E, C', D] -> [E, C', D]`` through each expert's gelu MLP."""
+def _expert_ffn(expert_in, w_in, w_out, w_gate=None):
+    """``[E, C', D] -> [E, C', D]`` through each expert's MLP: gelu
+    two-matrix (Switch-style) by default, or SwiGLU when ``w_gate``
+    [E, D, F] is given (Mixtral-style:
+    ``(silu(x @ w_gate) * (x @ w_in)) @ w_out``)."""
     cd = expert_in.dtype
-    h = jax.nn.gelu(
-        jnp.einsum("ecd,edf->ecf", expert_in, w_in).astype(jnp.float32)
-    ).astype(cd)
+    if w_gate is not None:
+        g = jax.nn.silu(
+            jnp.einsum("ecd,edf->ecf", expert_in, w_gate).astype(jnp.float32)
+        ).astype(cd)
+        h = g * jnp.einsum("ecd,edf->ecf", expert_in, w_in)
+    else:
+        h = jax.nn.gelu(
+            jnp.einsum("ecd,edf->ecf", expert_in, w_in).astype(jnp.float32)
+        ).astype(cd)
     return jnp.einsum("ecf,efd->ecd", h, w_out)
 
 
 def switch_moe(x, router_w, w_in, w_out, *, capacity_factor: float = 1.25,
-               k: int = 1, with_stats: bool = False):
+               k: int = 1, with_stats: bool = False, w_gate=None):
     """x: [B, S, D] -> (y: [B, S, D], aux_loss: scalar f32).  Global view.
 
     Tokens over capacity are dropped (their residual path carries them).
@@ -171,7 +197,7 @@ def switch_moe(x, router_w, w_in, w_out, *, capacity_factor: float = 1.25,
     expert_flat, gate_flat, aux = _route(xt, router_w, k)
     slot, keep, counts = _dispatch_slots(expert_flat, e, capacity)
     expert_in = _scatter_tokens(xt, slot, k, e, capacity).reshape(e, capacity, d)
-    expert_out = _expert_ffn(expert_in, w_in, w_out)
+    expert_out = _expert_ffn(expert_in, w_in, w_out, w_gate)
     y = _combine_tokens(expert_out.reshape(e * capacity, d), slot, keep,
                         gate_flat, k, t)
     y = y.reshape(b, s, d)
@@ -182,7 +208,7 @@ def switch_moe(x, router_w, w_in, w_out, *, capacity_factor: float = 1.25,
 
 def sharded_switch_moe(x, router_w, w_in, w_out, axis_name: str, *,
                        capacity_factor: float = 1.25, k: int = 1,
-                       with_stats: bool = False):
+                       with_stats: bool = False, w_gate=None):
     """Local (shard_map) view with an explicit expert all-to-all.
 
     ``x [B_loc, S_loc, D]``: this shard's tokens.  ``w_in/w_out
@@ -218,7 +244,7 @@ def sharded_switch_moe(x, router_w, w_in, w_out, axis_name: str, *,
                           tiled=False)
     # Each local expert sees the rows every shard bucketed for it.
     expert_in = recv.transpose(1, 0, 2, 3).reshape(e_loc, ep * capacity, d)
-    expert_out = _expert_ffn(expert_in, w_in, w_out)
+    expert_out = _expert_ffn(expert_in, w_in, w_out, w_gate)
     back = expert_out.reshape(e_loc, ep, capacity, d).transpose(1, 0, 2, 3)
     got = lax.all_to_all(back, axis_name, split_axis=0, concat_axis=0,
                          tiled=False)
@@ -238,24 +264,25 @@ def sharded_switch_moe(x, router_w, w_in, w_out, axis_name: str, *,
 
 def make_sharded_moe(mesh, *, ep_axis: str = "ep", dp_axis: str = "dp",
                      capacity_factor: float = 1.25, k: int = 1,
-                     with_stats: bool = False):
-    """Build a ``moe_fn(x, router_w, w_in, w_out) -> (y, aux)`` running
-    :func:`sharded_switch_moe` under shard_map: tokens shard over
+                     with_stats: bool = False, swiglu: bool = False):
+    """Build a ``moe_fn(x, router_w, w_in, w_out[, w_gate]) -> (y, aux)``
+    running :func:`sharded_switch_moe` under shard_map: tokens shard over
     (dp, ep) -- batch over dp, sequence over ep -- experts over ep, and the
     dispatch rides one explicit ``all_to_all`` pair over the ep axis.
 
     Plug into ``forward(..., moe_fn=...)`` /
     ``make_train_step(..., moe_fn=...)``.  ``with_stats``: the built fn
     returns ``(y, aux, stats)`` with router-health metrics (drop fraction,
-    per-expert load) pmean'd over the mesh.
+    per-expert load) pmean'd over the mesh.  ``swiglu``: the tree carries
+    Mixtral-style ``w_gate`` experts (decoder_layer passes it through).
     """
     from ..parallel.sharding import shard_map_fn
 
     other_axes = tuple(a for a in mesh.axis_names if a != ep_axis)
 
-    def local(x, router_w, w_in, w_out):
+    def local(x, router_w, w_in, w_out, w_gate=None):
         out = sharded_switch_moe(
-            x, router_w, w_in, w_out, ep_axis,
+            x, router_w, w_in, w_out, ep_axis, w_gate=w_gate,
             capacity_factor=capacity_factor, k=k, with_stats=with_stats)
         y, aux = out[0], out[1]
         # aux/stats are ep-uniform already; replicate across the remaining
@@ -275,9 +302,17 @@ def make_sharded_moe(mesh, *, ep_axis: str = "ep", dp_axis: str = "dp",
     if with_stats:
         out_specs = (x_spec, P(),
                      {"drop_fraction": P(), "expert_load": P(None)})
-    return shard_map_fn(
-        mesh, local,
-        in_specs=(x_spec, P(None, None), P(ep_axis, None, None),
-                  P(ep_axis, None, None)),
-        out_specs=out_specs,
-    )
+    e_spec = P(ep_axis, None, None)
+    in_specs = (x_spec, P(None, None), e_spec, e_spec) + (
+        (e_spec,) if swiglu else ())
+    mapped = shard_map_fn(mesh, local, in_specs=in_specs,
+                          out_specs=out_specs)
+    if not swiglu:
+        return mapped
+
+    def fn(x, router_w, w_in, w_out, w_gate=None):
+        # decoder_layer passes w_gate by KEYWORD; shard_map takes
+        # positional args only — adapt.
+        return mapped(x, router_w, w_in, w_out, w_gate)
+
+    return fn

@@ -78,6 +78,8 @@ def _moe_stage_template(cfg: LlamaConfig) -> dict:
         "attn_norm": 0, "mlp_norm": 0,
         "moe": {"router": 0, "w_in": 0, "w_out": 0},
     }
+    if cfg.moe_swiglu:
+        t["moe"]["w_gate"] = 0
     if cfg.attn_bias:
         t.update(bq=0, bk=0, bv=0)
     return t
@@ -85,12 +87,14 @@ def _moe_stage_template(cfg: LlamaConfig) -> dict:
 
 def _expert_leaf_spec(stages: dict):
     """Bool pytree matching ``stages``: True on the expert-table leaves
-    (``moe/w_in``, ``moe/w_out``) whose rows are per-expert, False on
-    everything else (including the replicated-per-device router)."""
+    (``moe/w_in``, ``moe/w_out``, swiglu ``moe/w_gate``) whose rows are
+    per-expert, False on everything else (including the
+    replicated-per-device router)."""
     return jax.tree_util.tree_map_with_path(
         lambda path, _a: any(
             getattr(k, "key", None) == "moe" for k in path) and any(
-            getattr(k, "key", None) in ("w_in", "w_out") for k in path),
+            getattr(k, "key", None) in ("w_in", "w_out", "w_gate")
+            for k in path),
         stages)
 
 
@@ -227,11 +231,12 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
     if moe and ep_axis is not None:
         from .moe import sharded_switch_moe
 
-        def moe_fn(x, router_w, w_in, w_out):
+        def moe_fn(x, router_w, w_in, w_out, w_gate=None):
             # Already inside the pipeline's shard_map: the ep axis is
-            # live, w_in/w_out leaves are the local [E/ep, D, F] shard.
+            # live, w_in/w_out (and swiglu w_gate) leaves are the local
+            # [E/ep, D, F] shard.
             return sharded_switch_moe(
-                x, router_w, w_in, w_out, ep_axis,
+                x, router_w, w_in, w_out, ep_axis, w_gate=w_gate,
                 capacity_factor=cfg.moe_capacity_factor, k=cfg.moe_top_k)
     else:
         moe_fn = None  # decoder_layer defaults to stage-local switch_moe

@@ -145,6 +145,59 @@ def test_rope_scaling_matches_transformers(scaling):
     np.testing.assert_array_equal(ours_gen[:, :hf_gen.shape[1]], hf_gen)
 
 
+def test_mixtral_logits_and_generation_match_transformers():
+    """Mixtral = Llama attention + SwiGLU top-2 MoE FFN (a fourth served
+    family): the converter maps gate->router and per-expert w1/w3/w2 ->
+    w_gate/w_in/w_out, sets capacity_factor = n_experts (provably
+    dropless, matching HF's dropless routing), and both logits and greedy
+    generation match transformers' MixtralForCausalLM — through prefill +
+    cached MoE decode."""
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=False, attn_implementation="eager")
+    torch.manual_seed(11)
+    hf = transformers.MixtralForCausalLM(hf_cfg).eval()
+
+    cfg = config_from_hf(hf.config, dtype="float32")
+    assert (cfg.n_experts, cfg.moe_top_k, cfg.moe_swiglu) == (4, 2, True)
+    assert cfg.moe_capacity_factor == 4.0  # dropless: capacity = T * k
+    params = params_from_hf(hf, cfg)
+    assert params["layers"]["moe"]["w_gate"].shape == (2, 4, 64, 112)
+
+    tokens = np.random.default_rng(5).integers(0, 256, (2, 16),
+                                               dtype=np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=2e-3)
+
+    prompt = np.asarray([[6, 2, 9]], dtype=np.int64)
+    with torch.no_grad():
+        hf_gen = hf.generate(torch.from_numpy(prompt), max_new_tokens=10,
+                             do_sample=False, pad_token_id=0).numpy()
+    ours_gen = np.asarray(generate(params, cfg,
+                                   jnp.asarray(prompt, jnp.int32), 10))
+    np.testing.assert_array_equal(ours_gen[:, :hf_gen.shape[1]], hf_gen)
+
+    # Ragged MoE: allowed because the conversion's capacity is provably
+    # dropless — pad tokens can only occupy spare slots.  Each ragged row
+    # must equal its solo-row generation.
+    rows = [[6, 2, 9, 4, 1], [7, 3]]
+    Pmax = max(map(len, rows))
+    padded = jnp.asarray([r + [0] * (Pmax - len(r)) for r in rows],
+                         jnp.int32)
+    lengths = jnp.asarray([len(r) for r in rows], jnp.int32)
+    ragged = np.asarray(generate(params, cfg, padded, 6,
+                                 prompt_lengths=lengths))
+    for b, r in enumerate(rows):
+        solo = np.asarray(generate(
+            params, cfg, jnp.asarray([r], jnp.int32), 6))[0, len(r):]
+        np.testing.assert_array_equal(ragged[b], solo)
+
+
 def test_bias_and_mixed_window_refusals(hf_model):
     """Shapes the tree cannot represent still refuse loudly: a generic
     attention_bias=True config biases o_proj too (Qwen2 doesn't), and
