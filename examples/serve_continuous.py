@@ -77,6 +77,26 @@ def main() -> None:
         assert (done[rid0] == want).all(), "continuous != standalone greedy!"
         print(f"  req {rid0} cross-checked against standalone generate(): OK")
 
+    # Prefix caching: a shared "system prompt" prefilled ONCE; requests
+    # submit only their suffix and still generate exactly what
+    # generate(prefix + suffix) would.
+    if args.temperature == 0.0:
+        system = list(rng.integers(1, cfg.vocab_size, 11))
+        pid = srv.register_prefix(system)
+        suffixes = [list(rng.integers(1, cfg.vocab_size, n))
+                    for n in (3, 5, 2)]
+        prids = [srv.submit(s, 6, prefix=pid) for s in suffixes]
+        pdone = srv.run()
+        for prid, suffix in zip(prids, suffixes):
+            solo = generate(
+                params, cfg,
+                jax.numpy.asarray([system + suffix], jax.numpy.int32), 6)
+            want = np.asarray(solo[0, len(system) + len(suffix):])
+            assert (pdone[prid] == want).all(), "prefix != full-prompt!"
+        print(f"  prefix caching: {len(prids)} suffix-only requests over "
+              f"one {len(system)}-token cached prefix, all match "
+              f"generate(prefix + suffix)")
+
 
 if __name__ == "__main__":
     main()

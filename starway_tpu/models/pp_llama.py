@@ -201,7 +201,9 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
     :func:`~starway_tpu.models.moe.sharded_switch_moe`'s explicit
     ``all_to_all`` — expert-table gradients get expert-aware reduction
     (no pmean across ep; the all-to-all transpose already summed).
-    Interleaved MoE (``n_chunks > 1``) is not wired.
+    Interleaved MoE (``n_chunks > 1``) runs with stage-LOCAL experts
+    (the virtual-chunk schedule chains aux the same way); ep sharding
+    composes with the plain schedule only.
 
     ``n_chunks > 1``: the INTERLEAVED 1F1B schedule
     (parallel/interleaved.py) with that many virtual chunks per device;
@@ -220,10 +222,10 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
                          f"{n_stages} stages x {n_chunks} chunks")
     moe = cfg.n_experts > 0
-    if moe and n_chunks > 1:
+    if moe and n_chunks > 1 and ep_axis is not None:
         raise NotImplementedError(
-            "interleaved (n_chunks > 1) MoE pipelining is not wired; use "
-            "the plain 1F1B schedule for expert models")
+            "interleaved (n_chunks > 1) MoE is stage-local only; ep "
+            "sharding composes with the plain 1F1B schedule")
     if ep_axis is not None and not moe:
         raise ValueError("ep_axis given but cfg.n_experts == 0")
     attn = resolve_attn_fn(cfg, attn_fn)
@@ -281,7 +283,7 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
         grad_step = make_interleaved_pipeline_train(
             mesh, chunk_fn, loss_fn, axis_name, n_chunks=n_chunks,
             n_micro=n_micro, with_head=True, return_dx=True,
-            dp_axis=dp_axis)
+            dp_axis=dp_axis, with_aux=moe)
     else:
         if moe:
             # Specs for leaves sharded beyond the stage dim (expert

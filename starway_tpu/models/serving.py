@@ -30,13 +30,25 @@ a FIXED, small set of compiled programs:
   prefill, same decode step, same masking — pinned by
   tests/test_serving.py against the one-request oracle.
 
+* **Prefix caching.**  ``register_prefix`` prefills a shared prefix once
+  into a standalone [L, 1, Hkv, bucket, Dh] cache; prefixed admission
+  copies those rows into the slot masked by position (< plen — bucket
+  junk above the prefix must not land where suffix positions would
+  attend it) and ingests the suffix through ONE
+  ``chunk_decode_step`` forward against the slot's own rows
+  (write-then-attend, the decode-path semantics) — so a prefixed request
+  generates exactly what ``generate(prefix + suffix)`` would, while
+  admission compute scales with the suffix.  One compile per
+  (prefix bucket, suffix bucket).
+
 Sliding-window (Mistral-family) models serve through per-slot ROLLING
 caches: O(window) memory per slot however long each generation runs,
 admission via the chunked ``prefill_rolling`` (no prompt bucketing — its
 compiled chunk body is length-independent), and ``max_len`` bounding only
-the rope horizon.  Dense models only (MoE expert capacity is shared
-batch-wide, so slot cohabitation would perturb routing — same restriction
-as ragged ``generate()``).
+the rope horizon.  MoE models serve when capacity is provably dropless
+(``moe_capacity_factor >= n_experts``): expert capacity is shared
+batch-wide, so slot cohabitation could otherwise perturb routing — the
+same rule as ragged ``generate()``.
 """
 
 from __future__ import annotations
@@ -92,6 +104,76 @@ def _compiled_admit(cfg: LlamaConfig, p_bucket: int, temperature: float,
         logits, small = prefill(params, cfg, prompt, p_bucket,
                                 logit_positions=length[None] - 1)
         return _write_slot_and_sample(cache, small, logits, slot, key,
+                                      temperature, top_k, top_p)
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+@functools.cache
+def _compiled_prefix_register(cfg: LlamaConfig, p_bucket: int):
+    """Prefill one PREFIX into a standalone [L, 1, Hkv, p_bucket, D] cache
+    (plus its next-token logits, so a zero-length suffix could continue).
+    One compile per prefix bucket."""
+
+    def run(params, prompt, length):
+        return prefill(params, cfg, prompt, p_bucket,
+                       logit_positions=length[None] - 1)
+
+    return jax.jit(run)
+
+
+@functools.cache
+def _compiled_prefix_admit(cfg: LlamaConfig, p_bucket: int, s_bucket: int,
+                           max_len: int, temperature: float,
+                           top_k: Optional[int], top_p: Optional[float]):
+    """Admit one request as (cached prefix, fresh suffix) into one slot:
+
+    1. file the prefix's cache rows into positions ``< plen`` of the
+       slot (masked by position — bucket junk above ``plen`` must NOT
+       land, suffix positions would attend it);
+    2. ingest the suffix through :func:`chunk_decode_step` at positions
+       ``plen ..`` — write-then-attend against the slot's own rows, the
+       decode-path semantics, so the result is exactly what a full
+       prefill of prefix+suffix would have produced;
+    3. sample the first token from the suffix's last real position.
+
+    One compile per (prefix bucket, suffix bucket).
+    """
+    from .speculative import chunk_decode_step
+
+    rope = cfg_rope_tables(cfg, max_len)
+
+    def run(params, cache, prefix_small, plen, suffix, s_len, slot, key):
+        # Slot rows out: [L, 1, Hkv, max_len, ...] per leaf.
+        rows = {
+            name: lax.dynamic_slice(
+                cache[name], (0, slot) + (0,) * (cache[name].ndim - 2),
+                (cache[name].shape[0], 1) + cache[name].shape[2:])
+            for name in cache
+        }
+
+        def merge(row, pre):
+            # Prefix rows land where position < plen; everything else
+            # keeps the slot's existing contents.  The T axis sits at
+            # index 3 in EVERY cache leaf (k/v and the int8 scales).
+            padded = lax.dynamic_update_slice(
+                jnp.zeros_like(row), pre, (0,) * row.ndim)
+            keep = (jnp.arange(row.shape[3]) < plen).reshape(
+                (1, 1, 1, -1) + (1,) * (row.ndim - 4))
+            return jnp.where(keep, padded, row)
+
+        rows = {name: merge(rows[name], prefix_small[name])
+                for name in rows}
+        # Suffix ingestion: columns >= s_len are junk at positions above
+        # the cursor — masked out of every real token's attention and
+        # overwritten by decode before the cursor reaches them (the
+        # standard covering argument).
+        logits, rows = chunk_decode_step(params, rows, suffix, plen[None],
+                                         cfg, rope)
+        last = jnp.take_along_axis(
+            logits, (s_len - 1)[None, None, None], axis=1)[:, 0]
+        # rows are full-T slot rows — _write_slot_and_sample's T' = T.
+        return _write_slot_and_sample(cache, rows, last, slot, key,
                                       temperature, top_k, top_p)
 
     return jax.jit(run, donate_argnums=(1,))
@@ -186,6 +268,17 @@ class SlotServer:
     and advances one decode chunk, returning newly finished requests;
     ``run()`` loops until everything queued has finished.  Generated
     tokens INCLUDE the terminating eos (when ``eos_id`` fires).
+
+    PREFIX CACHING: ``register_prefix(tokens)`` prefills a shared prefix
+    (system prompt, few-shot preamble) once; ``submit(suffix,
+    prefix=pid)`` requests then admit by copying the prefix's cache rows
+    into the slot (masked by position) and ingesting only the suffix
+    through one chunk forward — admission cost scales with the suffix,
+    not the full prompt, and the generated text is exactly
+    ``generate(prefix + suffix)``'s (tests/test_serving.py).  MoE models
+    serve when their capacity is provably dropless
+    (``moe_capacity_factor >= n_experts``, the Mixtral conversion
+    default).
     """
 
     def __init__(self, params, cfg: LlamaConfig, *, n_slots: int = 4,
@@ -193,11 +286,14 @@ class SlotServer:
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, eos_id: Optional[int] = None,
                  prompt_buckets=None, seed: int = 0):
-        if cfg.n_experts > 0:
+        if cfg.n_experts > 0 and cfg.moe_capacity_factor < cfg.n_experts:
             raise ValueError(
-                "continuous batching is dense-only: MoE expert capacity is "
-                "shared batch-wide, so cohabiting slots would perturb each "
-                "other's routing (same restriction as ragged generate())")
+                "continuous batching needs dense FFNs or provably-dropless "
+                "MoE: expert capacity is shared batch-wide, so cohabiting "
+                "slots would perturb each other's routing; set "
+                f"moe_capacity_factor >= n_experts (= {cfg.n_experts}) to "
+                "make drops impossible (the Mixtral conversion default — "
+                "same rule as ragged generate())")
         self.rolling = cfg.sliding_window is not None
         if n_slots < 1 or chunk < 1:
             # Zero slots/chunk would make run() spin forever, not error.
@@ -243,32 +339,115 @@ class SlotServer:
         self._pending: deque = deque()
         self._slot_rid: dict[int, int] = {}
         self._collected: dict[int, list] = {}
+        self._prefixes: dict[int, tuple] = {}  # pid -> (small, plen)
+        self._next_pid = 0
 
     # ------------------------------------------------------------ intake
-    def submit(self, prompt, max_new_tokens: int) -> int:
-        """Queue one request; returns its id (resolved by step()/run())."""
+    def register_prefix(self, tokens) -> int:
+        """Prefill a shared PREFIX (system prompt, few-shot preamble) once
+        and return its id; requests submitted with ``prefix=pid`` reuse
+        its cache rows instead of re-prefilling them — admission then
+        costs one suffix-bucket chunk ingest, not a full-prompt prefill.
+        The prefix cache lives in host-visible HBM ([L, 1, Hkv, bucket,
+        D] per prefix) until :meth:`drop_prefix`."""
+        if self.rolling:
+            raise ValueError("prefix caching needs the dense slot cache; "
+                             "rolling (sliding-window) slots rebuild their "
+                             "window per request anyway")
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if len(tokens) < 1:
+            raise ValueError("empty prefix")
+        if len(tokens) + self.buckets[0] + 1 > self.max_len:
+            # The suffix ingest writes bucket-wide, so a prefix must leave
+            # at least the SMALLEST bucket plus one generated token —
+            # checked here, before a full prefill is burned on a prefix no
+            # submit() could ever use.
+            raise ValueError(
+                f"prefix ({len(tokens)}) + smallest suffix bucket "
+                f"({self.buckets[0]}) + 1 exceeds max_len={self.max_len}")
+        pb = _bucket(len(tokens), self.buckets)
+        padded = np.zeros((1, pb), np.int32)
+        padded[0, :len(tokens)] = tokens
+        reg = _compiled_prefix_register(self.cfg, pb)
+        _logits, small = reg(self.params, jnp.asarray(padded),
+                             jnp.asarray(len(tokens), jnp.int32))
+        pid = self._next_pid
+        self._next_pid += 1
+        self._prefixes[pid] = (small, len(tokens))
+        return pid
+
+    def drop_prefix(self, pid: int) -> None:
+        """Free a registered prefix's cache rows.  Refuses while a QUEUED
+        request still references it — dropping under it would otherwise
+        blow up mid-step after the request left the queue, destroying
+        that step's already-harvested results (admitted requests no
+        longer need the prefix; only the queue is checked)."""
+        if any(p == pid for _rid, _pr, _mn, p in self._pending):
+            raise ValueError(
+                f"prefix {pid} is still referenced by queued requests; "
+                f"run()/step() them first")
+        del self._prefixes[pid]
+
+    def submit(self, prompt, max_new_tokens: int,
+               prefix: Optional[int] = None) -> int:
+        """Queue one request; returns its id (resolved by step()/run()).
+
+        ``prefix``: a :meth:`register_prefix` id — ``prompt`` is then the
+        SUFFIX continuing it (the generated text continues
+        ``prefix_tokens + prompt``)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if len(prompt) < 1:
             raise ValueError("empty prompt")
-        if len(prompt) + max_new_tokens > self.max_len:
+        plen = 0
+        if prefix is not None:
+            if prefix not in self._prefixes:
+                raise KeyError(f"unknown prefix id {prefix}")
+            plen = self._prefixes[prefix][1]
+        if plen + len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
-                f"prompt ({len(prompt)}) + max_new ({max_new_tokens}) "
-                f"exceeds max_len={self.max_len}")
-        if not self.rolling:
-            _bucket(len(prompt), self.buckets)  # reject un-bucketable NOW,
-            # not at admission time after the request has left the queue
+                f"prefix ({plen}) + prompt ({len(prompt)}) + max_new "
+                f"({max_new_tokens}) exceeds max_len={self.max_len}")
+        # Reject un-bucketable/un-placeable requests NOW, not at admission
+        # time after the request has left the queue.
+        if prefix is not None:
+            sb = _bucket(len(prompt), self.buckets)
+            if plen + sb > self.max_len:
+                raise ValueError(
+                    f"prefix ({plen}) + suffix bucket ({sb}, rounded up "
+                    f"from {len(prompt)}) exceeds max_len={self.max_len}: "
+                    f"the suffix ingest writes bucket-wide")
+        elif not self.rolling:
+            _bucket(len(prompt), self.buckets)
         rid = self._next_rid
         self._next_rid += 1
-        self._pending.append((rid, prompt, int(max_new_tokens)))
+        self._pending.append((rid, prompt, int(max_new_tokens), prefix))
         return rid
 
     # ------------------------------------------------------------- engine
     def _admit(self, slot: int, rid: int, prompt: np.ndarray,
-               max_new: int) -> None:
+               max_new: int, prefix: Optional[int] = None) -> None:
         self.key, sub = jax.random.split(self.key)
-        if self.rolling:
+        plen = 0
+        if prefix is not None:
+            if prefix not in self._prefixes:
+                raise KeyError(
+                    f"prefix {prefix} was dropped while request {rid} "
+                    f"waited in the queue")
+            small, plen = self._prefixes[prefix]
+            sb = _bucket(len(prompt), self.buckets)
+            padded = np.zeros((1, sb), np.int32)
+            padded[0, :len(prompt)] = prompt
+            admit = _compiled_prefix_admit(
+                self.cfg, small["k"].shape[3], sb, self.max_len,
+                *self.sampling)
+            self.cache, tok = admit(
+                self.params, self.cache, small,
+                jnp.asarray(plen, jnp.int32), jnp.asarray(padded),
+                jnp.asarray(len(prompt), jnp.int32),
+                jnp.asarray(slot, jnp.int32), sub)
+        elif self.rolling:
             # Chunked O(window) prefill with denomination widths: at most
             # len(ROLLING_ADMIT_WIDTHS) compiled programs, any prompt
             # length.
@@ -292,7 +471,7 @@ class SlotServer:
         done = (max_new == 1 or
                 (self.eos_id is not None and tok_host == self.eos_id))
         self.token = self.token.at[slot].set(tok_host)
-        self.pos = self.pos.at[slot].set(len(prompt))
+        self.pos = self.pos.at[slot].set(plen + len(prompt))
         self.live = self.live.at[slot].set(not done)
         self.remaining = self.remaining.at[slot].set(max_new - 1)
 
@@ -311,8 +490,8 @@ class SlotServer:
         self._harvest_dead(finished)  # 1-token/instant-eos admissions
         free = [s for s in range(self.n_slots) if s not in self._slot_rid]
         while free and self._pending:
-            rid, prompt, max_new = self._pending.popleft()
-            self._admit(free.pop(0), rid, prompt, max_new)
+            rid, prompt, max_new, prefix = self._pending.popleft()
+            self._admit(free.pop(0), rid, prompt, max_new, prefix)
         self._harvest_dead(finished)
         if not self._slot_rid:
             return finished

@@ -244,7 +244,7 @@ def build_interleaved_schedule(m: int, s: int, v: int) -> InterleavedSchedule:
 def interleaved_train_apply(stage_fn: Callable, loss_fn: Callable,
                             stage_params, inputs, targets, axis_name: str,
                             sched: InterleavedSchedule, head_params=None,
-                            return_dx: bool = False):
+                            return_dx: bool = False, with_aux: bool = False):
     """Per-device body (call inside shard_map).
 
     ``stage_params``: this device's chunks, leading dim V (chunk c =
@@ -254,7 +254,13 @@ def interleaved_train_apply(stage_fn: Callable, loss_fn: Callable,
     ``pipeline_train_apply``: ``head_params`` makes the final slot's loss
     ``loss_fn(head_params, y, target)`` (head gradient psum-replicated);
     ``return_dx`` emits ``[1, M, mb, ...]`` input cotangents valid on
-    device 0's shard only (chunk-0 backwards).
+    device 0's shard only (chunk-0 backwards).  ``with_aux``:
+    ``stage_fn`` returns ``(y, aux)`` and every virtual stage's scalar
+    aux joins loss and gradients exactly as in
+    :func:`~starway_tpu.parallel.pipeline.pipeline_train_apply` — F-slot
+    value accumulation (the LAST virtual stage excluded: its aux joins
+    the final slot's loss closure), cotangent-1 seeding in mid-chunk
+    backward vjps.
     """
     s = sched.n_devices
     v = sched.n_chunks
@@ -279,6 +285,10 @@ def interleaved_train_apply(stage_fn: Callable, loss_fn: Callable,
     def f32_zeros_like(tree):
         return jax.tree_util.tree_map(
             lambda a: jnp.zeros(a.shape, jnp.float32), tree)
+
+    def apply_stage(p, x):
+        out = stage_fn(p, x)
+        return out if with_aux else (out, jnp.float32(0))
 
     def tick(carry, trow):
         fwd_in, bwd_in, stash, inbox, dparams, dhead, dx_buf, loss_acc = carry
@@ -309,7 +319,12 @@ def interleaved_train_apply(stage_fn: Callable, loss_fn: Callable,
         fc_c = jnp.clip(fc, 0, v - 1)
         x_inject = inputs[jnp.clip(fi, 0, m - 1)]
         x = jnp.where(finj, x_inject, fwd_in)
-        y = stage_fn(chunk_params(fc_c), x)
+        y, aux_f = apply_stage(chunk_params(fc_c), x)
+        # Aux VALUE: once per (virtual stage, microbatch) in the F slot;
+        # the final virtual stage (this device's last chunk on the last
+        # device) is excluded — its aux joins the final slot's loss_j.
+        last_vstage = (d_idx == s - 1) & (fc_c == v - 1)
+        loss_acc = loss_acc + jnp.where(f_valid & ~last_vstage, aux_f, 0.0)
         sl = jnp.where(f_valid, jnp.clip(fsl, 0, sched.stash_depth - 1),
                        sched.stash_depth)  # trash slot
         stash = lax.dynamic_update_index_in_dim(stash, x, sl, axis=0)
@@ -331,14 +346,16 @@ def interleaved_train_apply(stage_fn: Callable, loss_fn: Callable,
         def final_branch(_):
             if head_params is None:
                 def h(p, x):
-                    return loss_fn(stage_fn(p, x), target)
+                    yy, aa = apply_stage(p, x)
+                    return loss_fn(yy, target) + aa
 
                 loss_j, (dp, dx) = jax.value_and_grad(h, argnums=(0, 1))(
                     p_c, x_saved)
                 dh = dhead  # zeros-shaped placeholder, unused
             else:
                 def h(p, x, hp):
-                    return loss_fn(hp, stage_fn(p, x), target)
+                    yy, aa = apply_stage(p, x)
+                    return loss_fn(hp, yy, target) + aa
 
                 loss_j, (dp, dx, dh) = jax.value_and_grad(
                     h, argnums=(0, 1, 2))(p_c, x_saved, head_params)
@@ -347,8 +364,9 @@ def interleaved_train_apply(stage_fn: Callable, loss_fn: Callable,
                     jnp.asarray(loss_j, jnp.float32))
 
         def mid_branch(_):
-            _, vjp_fn = jax.vjp(lambda p, x: stage_fn(p, x), p_c, x_saved)
-            dp, dx = vjp_fn(ct_in.astype(y.dtype))
+            (yy, aa), vjp_fn = jax.vjp(apply_stage, p_c, x_saved)
+            dp, dx = vjp_fn((ct_in.astype(yy.dtype),
+                             jnp.ones((), aa.dtype)))
             return (f32_tree(dp), dx.astype(jnp.float32),
                     f32_zeros_like(head_params), jnp.float32(0))
 
@@ -409,7 +427,8 @@ def make_interleaved_pipeline_train(mesh, stage_fn: Callable,
                                     n_chunks: int, n_micro: int,
                                     with_head: bool = False,
                                     return_dx: bool = False,
-                                    dp_axis: str | None = None):
+                                    dp_axis: str | None = None,
+                                    with_aux: bool = False):
     """Jitted global-view interleaved-1F1B training step builder.
 
     ``stage_params`` global view: ``[V, S, ...]`` — ``stage_params[c, d]``
@@ -443,7 +462,7 @@ def make_interleaved_pipeline_train(mesh, stage_fn: Callable,
             out = interleaved_train_apply(
                 stage_fn, loss_fn, peel(stage_params), inputs, targets,
                 axis_name, sched, head_params=head_params,
-                return_dx=return_dx)
+                return_dx=return_dx, with_aux=with_aux)
             out = dp_reduce(out)
             return (out[0], unpeel(out[1])) + out[2:]
 
@@ -454,7 +473,7 @@ def make_interleaved_pipeline_train(mesh, stage_fn: Callable,
         def local(stage_params, inputs, targets):
             out = interleaved_train_apply(
                 stage_fn, loss_fn, peel(stage_params), inputs, targets,
-                axis_name, sched, return_dx=return_dx)
+                axis_name, sched, return_dx=return_dx, with_aux=with_aux)
             out = dp_reduce(out)
             return (out[0], unpeel(out[1])) + out[2:]
 

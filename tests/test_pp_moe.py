@@ -138,11 +138,38 @@ def test_pp_ep_dp_moe_runs():
         pp["stages"]["moe"]["w_in"].shape
 
 
-def test_pp_moe_validation():
-    cfg = LlamaConfig.preset("debug", n_layers=4, n_experts=4)
+def test_interleaved_pp_moe_grads_match_oracle():
+    """INTERLEAVED 1F1B (2 virtual chunks/device) with stage-local MoE:
+    the virtual-chunk schedule chains every chunk's balance aux exactly
+    like the plain schedule — loss and every grad vs the microbatched
+    sequential oracle."""
+    from starway_tpu.models.pp_llama import (ppv_merge_params,
+                                             ppv_split_params,
+                                             shard_ppv_params)
+
+    cfg = LlamaConfig.preset("debug", n_layers=8, d_model=32, n_heads=4,
+                             n_kv_heads=2, d_ff=48, vocab_size=64,
+                             n_experts=4, moe_top_k=2, moe_aux_coef=0.02)
+    params = init_params(jax.random.PRNGKey(3), cfg)
     mesh = make_mesh({"pp": 2})
-    with pytest.raises(NotImplementedError, match="interleaved"):
-        make_pp_llama_train(mesh, cfg, n_micro=2, n_chunks=2)
+    batch = jnp.asarray(np.random.default_rng(3).integers(
+        0, cfg.vocab_size, (8, 9), dtype=np.int32))
+    n_micro = 4
+
+    ppv = shard_ppv_params(ppv_split_params(params, 2, 2), mesh)
+    step = make_pp_llama_train(mesh, cfg, n_micro=n_micro, n_chunks=2)
+    loss_pp, grads_pp = step(ppv, batch)
+
+    loss_ref, grads_ref = _microbatched_oracle(params, batch, cfg, n_micro)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+    _assert_tree_close(ppv_merge_params(grads_pp), grads_ref)
+
+
+def test_pp_moe_validation():
+    cfg = LlamaConfig.preset("debug", n_layers=8, n_experts=4)
+    mesh = make_mesh({"pp": 2, "ep": 2})
+    with pytest.raises(NotImplementedError, match="stage-local"):
+        make_pp_llama_train(mesh, cfg, n_micro=2, n_chunks=2, ep_axis="ep")
     dense = LlamaConfig.preset("debug", n_layers=4)
     with pytest.raises(ValueError, match="ep_axis"):
         make_pp_llama_train(mesh, dense, n_micro=2, ep_axis="ep")

@@ -120,8 +120,8 @@ def test_serving_validation(cfg, params):
         SlotServer(params, cfg, n_slots=0)
     with pytest.raises(ValueError, match="chunk"):
         SlotServer(params, cfg, chunk=0)
-    moe_cfg = LlamaConfig.preset("debug", n_experts=4)
-    with pytest.raises(ValueError, match="dense-only"):
+    moe_cfg = LlamaConfig.preset("debug", n_experts=4)  # default cf 1.25:
+    with pytest.raises(ValueError, match="dropless"):   # droppy -> refuse
         SlotServer(init_params(jax.random.PRNGKey(1), moe_cfg), moe_cfg)
 
 
@@ -160,3 +160,106 @@ def test_rolling_continuous_batching(cfg, params):
         np.testing.assert_array_equal(
             done[rid], oracle(prompt, max_new, 64),
             err_msg=f"request {rid} (P={len(prompt)})")
+
+
+def test_prefix_caching_matches_generate(cfg, params):
+    """Prefix caching: requests sharing a registered prefix must generate
+    exactly what standalone generate(prefix + suffix) produces — the
+    prefix rows are written once, suffixes ingest through the slot's own
+    cache at decode-path semantics, and cohabiting requests (with and
+    without prefixes, different prefixes) never leak."""
+    rng = np.random.default_rng(7)
+    pre_a = list(rng.integers(1, cfg.vocab_size, 9))
+    pre_b = list(rng.integers(1, cfg.vocab_size, 4))
+    reqs = [  # (suffix, max_new, which prefix)
+        (list(rng.integers(1, cfg.vocab_size, 3)), 6, "a"),
+        (list(rng.integers(1, cfg.vocab_size, 7)), 4, "a"),
+        (list(rng.integers(1, cfg.vocab_size, 2)), 8, "b"),
+        (list(rng.integers(1, cfg.vocab_size, 5)), 5, None),
+        (list(rng.integers(1, cfg.vocab_size, 1)), 7, "a"),
+    ]
+
+    srv = SlotServer(params, cfg, n_slots=2, max_len=64, chunk=4)
+    pids = {"a": srv.register_prefix(pre_a),
+            "b": srv.register_prefix(pre_b), None: None}
+    pres = {"a": pre_a, "b": pre_b, None: []}
+    rids = [srv.submit(s, m, prefix=pids[w]) for s, m, w in reqs]
+    done = srv.run()
+
+    assert sorted(done) == sorted(rids)
+    for rid, (suffix, max_new, which) in zip(rids, reqs):
+        want = _oracle(params, cfg, pres[which] + suffix, max_new)
+        np.testing.assert_array_equal(
+            done[rid], want,
+            err_msg=f"request {rid} (prefix={which}, S={len(suffix)})")
+
+
+def test_prefix_caching_int8_cache(params):
+    """Prefix rows, suffix ingest, and decode all ride the int8 cache
+    format (scale leaves share the T-axis-at-3 layout the masked prefix
+    write relies on)."""
+    cfg8 = LlamaConfig.preset("debug", kv_quant="int8")
+    rng = np.random.default_rng(8)
+    pre = list(rng.integers(1, cfg8.vocab_size, 6))
+    suf = list(rng.integers(1, cfg8.vocab_size, 3))
+
+    srv = SlotServer(params, cfg8, n_slots=2, max_len=64, chunk=4)
+    pid = srv.register_prefix(pre)
+    rid = srv.submit(suf, 6, prefix=pid)
+    done = srv.run()
+    want = _oracle(params, cfg8, pre + suf, 6)
+    np.testing.assert_array_equal(done[rid], want)
+
+
+def test_prefix_validation(cfg, params):
+    srv = SlotServer(params, cfg, n_slots=1, max_len=64, chunk=2)
+    with pytest.raises(KeyError):
+        srv.submit([1, 2], 4, prefix=99)
+    pid = srv.register_prefix([1, 2, 3])
+    with pytest.raises(ValueError, match="exceeds"):
+        srv.submit([1] * 40, 30, prefix=pid)  # prefix + suffix + new > 64
+    with pytest.raises(ValueError, match="smallest suffix bucket"):
+        # A prefix no submit() could ever use refuses at registration,
+        # before its prefill is burned.
+        srv.register_prefix([1] * 62)
+    # Dropping under a QUEUED request refuses (mid-step failure would
+    # destroy that step's harvested results); after it runs, drop works.
+    rid = srv.submit([4, 5], 3, prefix=pid)
+    with pytest.raises(ValueError, match="referenced"):
+        srv.drop_prefix(pid)
+    assert rid in srv.run()
+    srv.drop_prefix(pid)
+    with pytest.raises(KeyError):
+        srv.submit([1, 2], 4, prefix=pid)
+    rolling = SlotServer(params,
+                         LlamaConfig.preset("debug", sliding_window=8),
+                         n_slots=1, max_len=32, chunk=2)
+    with pytest.raises(ValueError, match="rolling"):
+        rolling.register_prefix([1, 2, 3])
+
+
+def test_moe_continuous_batching_dropless(cfg):
+    """Provably-dropless MoE (Mixtral-style) serves through continuous
+    batching: cohabiting slots cannot perturb each other's routing, so
+    every request matches its solo generate() oracle; a droppy capacity
+    still refuses."""
+    mcfg = LlamaConfig.preset("debug", n_experts=4, moe_top_k=2,
+                              moe_swiglu=True, moe_capacity_factor=4.0)
+    mparams = init_params(jax.random.PRNGKey(2), mcfg)
+    rng = np.random.default_rng(9)
+    reqs = [(list(rng.integers(1, mcfg.vocab_size, n)), m)
+            for n, m in [(3, 5), (6, 4), (2, 6)]]
+
+    srv = SlotServer(mparams, mcfg, n_slots=2, max_len=64, chunk=4)
+    rids = [srv.submit(p, m) for p, m in reqs]
+    done = srv.run()
+    for rid, (prompt, max_new) in zip(rids, reqs):
+        np.testing.assert_array_equal(
+            done[rid], _oracle(mparams, mcfg, prompt, max_new),
+            err_msg=f"request {rid}")
+
+    droppy = LlamaConfig.preset("debug", n_experts=4,
+                                moe_capacity_factor=1.25)
+    with pytest.raises(ValueError, match="dropless"):
+        SlotServer(init_params(jax.random.PRNGKey(3), droppy), droppy,
+                   n_slots=2, max_len=64)
