@@ -84,6 +84,7 @@ run serve_continuous serve_continuous_tokens_per_s      # wall-clock through slo
 run decode_int8      decode_int8_us_per_token           # half-width int8 cache stream
 run serve_int8_b8    serve_llama_int8_b8_tokens_per_s   # int8 cache end to end
 run spec_verify      spec_verify_amortisation           # chunk verify vs gamma decode steps
+run serve_prefix     serve_prefix_admit_speedup         # prefix-cached admission vs full prefill
 run gemv_int8        gemv_int8_speedup                  # W8A16 weight stream vs bf16
 run serve_w8_b1      serve_llama_int8_w8_b1_tokens_per_s # whole-model int8 serving (KV + weights)
 # 672M-param compiles x two differenced loop lengths can exceed the default

@@ -238,7 +238,7 @@ def test_prefix_validation(cfg, params):
         rolling.register_prefix([1, 2, 3])
 
 
-def test_moe_continuous_batching_dropless(cfg):
+def test_moe_continuous_batching_dropless():
     """Provably-dropless MoE (Mixtral-style) serves through continuous
     batching: cohabiting slots cannot perturb each other's routing, so
     every request matches its solo generate() oracle; a droppy capacity
