@@ -215,10 +215,12 @@ def test_generate_ragged_matches_per_row(cfg, params):
         generate(params, cfg, padded, max_new,
                  prompt_lengths=jnp.asarray([0, 2, P + 1], jnp.int32))
 
-    # MoE is dense-only for ragged batches: shared expert capacity means
-    # pad tokens would perturb real rows' routing.
+    # Droppy MoE refuses ragged batches: shared expert capacity means pad
+    # tokens could perturb real rows' routing (provably-dropless capacity,
+    # cf >= E, is the exception — tests/test_hf_convert.py's Mixtral
+    # ragged pin).
     moe_cfg = LlamaConfig.preset("debug", n_experts=4)
-    with pytest.raises(ValueError, match="dense-only"):
+    with pytest.raises(ValueError, match="dropless"):
         generate(init_params(jax.random.PRNGKey(1), moe_cfg), moe_cfg,
                  padded, max_new, prompt_lengths=lengths)
 
