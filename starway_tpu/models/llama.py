@@ -403,18 +403,27 @@ def resolve_attn_fn(cfg: LlamaConfig, attn_fn: Optional[Callable]) -> Callable:
     None -> :func:`default_attn`, window-bound when the config has one.  A
     supplied attn_fn on a windowed config must declare
     ``attn_fn.handles_window = True`` — silently training/serving
-    full-causal on a windowed config is a different model, and the sharded
-    attentions (ring/zigzag/Ulysses) don't implement windows.
+    full-causal on a windowed config is a different model.
+    :func:`make_sharded_attn` declares it when built with ``window=``
+    (plain ring layout; band-skipped steps); zigzag/Ulysses don't
+    implement windows.
     """
     if attn_fn is None:
         if cfg.sliding_window is not None:
             return partial(default_attn, window=cfg.sliding_window)
         return default_attn
-    if cfg.sliding_window is not None and not getattr(
-            attn_fn, "handles_window", False):
-        raise ValueError(
-            "cfg.sliding_window is set but the supplied attn_fn does not "
-            "declare window support (attn_fn.handles_window)")
+    if cfg.sliding_window is not None:
+        if not getattr(attn_fn, "handles_window", False):
+            raise ValueError(
+                "cfg.sliding_window is set but the supplied attn_fn does "
+                "not declare window support (attn_fn.handles_window)")
+        declared = getattr(attn_fn, "window", None)
+        if declared is not None and declared != cfg.sliding_window:
+            # A mismatched band is silently a different model — the exact
+            # failure this guard exists to prevent.
+            raise ValueError(
+                f"attn_fn was built with window={declared} but "
+                f"cfg.sliding_window={cfg.sliding_window}")
     return attn_fn
 
 
@@ -678,7 +687,8 @@ def make_train_step(cfg: LlamaConfig, tx, attn_fn: Optional[Callable] = None,
 
 
 def make_sharded_attn(mesh, *, seq_axis: str = "sp", dp_axis: str = "dp",
-                      tp_axis: str = "tp", layout: str = "ring"):
+                      tp_axis: str = "tp", layout: str = "ring",
+                      window: Optional[int] = None):
     """Sequence-parallel ring attention for use as ``attn_fn`` inside the
     GSPMD-jitted forward: q/k/v arrive [B, H, S, Dh] with batch sharded over
     dp, heads over tp, sequence over sp; the (grouped, narrow) kv shards
@@ -689,6 +699,11 @@ def make_sharded_attn(mesh, *, seq_axis: str = "sp", dp_axis: str = "dp",
     long S because no device spends ring steps on fully-masked blocks, at
     the cost of a sequence permutation (an sp-axis shuffle) per call --
     worth it when S is large enough that attention compute dominates.
+
+    ``window``: sliding-window band (match ``cfg.sliding_window``; the
+    returned fn declares ``handles_window`` so resolve_attn_fn admits it
+    on windowed configs).  Ring layout only — out-of-band ring steps
+    cond-skip their compute, so wall-clock scales with the band.
     """
     from ..parallel.ring_attention import (
         ring_attention,
@@ -699,6 +714,10 @@ def make_sharded_attn(mesh, *, seq_axis: str = "sp", dp_axis: str = "dp",
 
     if layout not in ("ring", "zigzag"):
         raise ValueError(f"unknown attention layout {layout!r}; expected 'ring' or 'zigzag'")
+    if window is not None and layout != "ring":
+        raise ValueError(
+            "window is supported on the plain ring layout only (zigzag's "
+            "interleaved shards break the contiguous band-skip argument)")
 
     spec = P(dp_axis, tp_axis, seq_axis, None)
 
@@ -710,6 +729,11 @@ def make_sharded_attn(mesh, *, seq_axis: str = "sp", dp_axis: str = "dp",
         return zigzag_wrap(inner, mesh.shape[seq_axis])
 
     def local(q, k, v):
-        return ring_attention(q, k, v, seq_axis, causal=True)
+        return ring_attention(q, k, v, seq_axis, causal=True, window=window)
 
-    return shard_map_fn(mesh, local, in_specs=(spec, spec, spec), out_specs=spec)
+    fn = shard_map_fn(mesh, local, in_specs=(spec, spec, spec),
+                      out_specs=spec)
+    if window is not None:
+        fn.handles_window = True
+        fn.window = window  # resolve_attn_fn cross-checks vs the config
+    return fn

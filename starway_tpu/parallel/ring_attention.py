@@ -58,9 +58,14 @@ def _use_kernel_default() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _step_fwd(q, k, v, q_off, kv_off, causal, sm_scale, use_kernel):
-    """One kv shard's unnormalised partial: (o f32, m f32, l f32)."""
-    if use_kernel:
+def _step_fwd(q, k, v, q_off, kv_off, causal, sm_scale, use_kernel,
+              window=None):
+    """One kv shard's unnormalised partial: (o f32, m f32, l f32).
+
+    ``window``: sliding-window band (requires causal) — routed through the
+    lax path (the flash_partial kernel carries no band support; windowed
+    rings skip most pairs outright anyway, see _ring_fwd_impl)."""
+    if use_kernel and window is None:
         from ..ops.pallas_attention import flash_partial
 
         return flash_partial(q, k, v, q_off, kv_off, causal=causal,
@@ -69,14 +74,15 @@ def _step_fwd(q, k, v, q_off, kv_off, causal, sm_scale, use_kernel):
     return partial_attention(
         q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
         q_offset=q_off, kv_offset=kv_off, causal=causal, sm_scale=sm_scale,
+        window=window,
     )
 
 
 def _step_bwd(q, do, k, v, lse, delta, q_off, kv_off, causal, sm_scale,
-              use_kernel):
+              use_kernel, window=None):
     """One kv shard's gradient contributions: (dq, dk, dv), f32, dk/dv
     grouped.  lse/delta are the globally merged statistics."""
-    if use_kernel:
+    if use_kernel and window is None:
         from ..ops.pallas_attention import flash_partial_bwd
 
         return flash_partial_bwd(q, do, k, v, lse, delta, q_off, kv_off,
@@ -92,8 +98,10 @@ def _step_bwd(q, do, k, v, lse, delta, q_off, kv_off, causal, sm_scale,
     if causal:
         q_pos = q_off + jnp.arange(tq)
         kv_pos = kv_off + jnp.arange(tk)
-        s = jnp.where((q_pos[:, None] >= kv_pos[None, :])[None, None], s,
-                      NEG_BIG)
+        keep = q_pos[:, None] >= kv_pos[None, :]
+        if window is not None:
+            keep = keep & (kv_pos[None, :] > q_pos[:, None] - window)
+        s = jnp.where(keep[None, None], s, NEG_BIG)
     p = jnp.exp(s - lse[..., None])  # normalised; masked entries -> 0
     dp = jnp.einsum("bhqd,bhkd->bhqk", dof, ve)
     ds = p * (dp - delta[..., None])
@@ -120,16 +128,53 @@ def _rotate(xs, axis_name):
 # ---------------------------------------------------------------------------
 
 
-def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, use_kernel):
+def _band_live(q_off, kv_off, tq, tk, causal, window):
+    """Does the (q block, kv block) pair contribute anything under the
+    causal/window band?  False -> the whole tile is masked and its ring
+    step can skip compute outright (the windowed-ring win: at
+    window << S only ~window/t_local + 1 of the n steps are live)."""
+    live = jnp.asarray(True)
+    if causal:
+        live = q_off + tq - 1 >= kv_off          # some key is in the past
+    if window is not None:
+        live = live & (q_off - (kv_off + tk - 1) < window)  # ...and close
+    return live
+
+
+def _ring_steps(n: int, t_local: int, window) -> int:
+    """How many ring steps can EVER be live under the band: device my
+    attends shard my - i only while i * t_local reaches back < window
+    (plus its own diagonal).  Static — window and shard sizes are
+    trace-time constants — so both loops AND rotations stop after the
+    band: communication scales with the window, not the sequence."""
+    if window is None:
+        return n
+    return min(n, (window - 2 + t_local) // t_local + 1)
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, use_kernel,
+                   window=None):
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     t_local = q.shape[2]
     q_off = my * t_local
+    steps = _ring_steps(n, t_local, window)
 
     def compute(i, acc, k_cur, v_cur):
         src = (my - i) % n  # owner of the kv shard currently resident here
-        part = _step_fwd(q, k_cur, v_cur, q_off, src * t_local, causal,
-                         sm_scale, use_kernel)
+        kv_off = src * t_local
+
+        def live_part(_):
+            return _step_fwd(q, k_cur, v_cur, q_off, kv_off, causal,
+                             sm_scale, use_kernel, window)
+
+        if window is None:
+            part = live_part(None)
+        else:
+            # merge with the identity partial (m=-inf, l=0) when skipped.
+            part = lax.cond(
+                _band_live(q_off, kv_off, t_local, t_local, causal, window),
+                live_part, lambda _: zero_partial(q), None)
         return merge_partials(acc, part)
 
     def body(i, carry):
@@ -140,26 +185,40 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, use_kernel):
         k_cur, v_cur = _rotate((k_cur, v_cur), axis_name)
         return acc, k_cur, v_cur
 
-    acc, k_last, v_last = lax.fori_loop(0, n - 1, body, (zero_partial(q), k, v))
-    acc = compute(n - 1, acc, k_last, v_last)
+    acc, k_last, v_last = lax.fori_loop(0, steps - 1, body,
+                                        (zero_partial(q), k, v))
+    acc = compute(steps - 1, acc, k_last, v_last)
     out = finalize_partial(*acc, out_dtype=q.dtype)
     return out, _lse_of(acc)
 
 
 def _ring_bwd_impl(q, k, v, out, lse, do, axis_name, causal, sm_scale,
-                   use_kernel):
+                   use_kernel, window=None):
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     t_local = q.shape[2]
     q_off = my * t_local
+    steps = _ring_steps(n, t_local, window)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
 
     def step(i, carry):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
         src = (my - i) % n
-        dq_c, dk_c, dv_c = _step_bwd(q, do, k_cur, v_cur, lse, delta,
-                                     q_off, src * t_local, causal, sm_scale,
-                                     use_kernel)
+        kv_off = src * t_local
+
+        def live_grads(_):
+            return _step_bwd(q, do, k_cur, v_cur, lse, delta, q_off,
+                             kv_off, causal, sm_scale, use_kernel, window)
+
+        if window is None:
+            dq_c, dk_c, dv_c = live_grads(None)
+        else:
+            dq_c, dk_c, dv_c = lax.cond(
+                _band_live(q_off, kv_off, t_local, t_local, causal, window),
+                live_grads,
+                lambda _: (jnp.zeros(q.shape, jnp.float32),
+                           jnp.zeros(k.shape, jnp.float32),
+                           jnp.zeros(v.shape, jnp.float32)), None)
         return dq + dq_c, k_cur, v_cur, dk_cur + dk_c, dv_cur + dv_c
 
     def body(i, carry):
@@ -173,30 +232,36 @@ def _ring_bwd_impl(q, k, v, out, lse, do, axis_name, causal, sm_scale,
 
     init = (jnp.zeros(q.shape, jnp.float32), k, v,
             jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32))
-    carry = lax.fori_loop(0, n - 1, body, init)
-    dq, _, _, dk, dv = step(n - 1, carry)
-    # One final rotation sends each kv shard's gradient home (shard s ends
-    # on device s); the kv tensors themselves are no longer needed.
-    dk, dv = _rotate((dk, dv), axis_name)
+    carry = lax.fori_loop(0, steps - 1, body, init)
+    dq, _, _, dk, dv = step(steps - 1, carry)
+    # Send each kv shard's gradient home: after steps-1 in-loop rotations
+    # a shard's grad sits steps-1 hops from its owner, so one ppermute of
+    # the REMAINING distance closes the ring (shift 1 in the full-ring
+    # case; identity skipped when the band never moved the shards).
+    home = (n - (steps - 1)) % n
+    if home:
+        dk = ring_shift(dk, axis_name, home)
+        dv = ring_shift(dv, axis_name, home)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring(q, k, v, axis_name, causal, sm_scale, use_kernel):
-    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, use_kernel)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring(q, k, v, axis_name, causal, sm_scale, use_kernel, window):
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, use_kernel,
+                            window)
     return out
 
 
-def _ring_vjp_fwd(q, k, v, axis_name, causal, sm_scale, use_kernel):
+def _ring_vjp_fwd(q, k, v, axis_name, causal, sm_scale, use_kernel, window):
     out, lse = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale,
-                              use_kernel)
+                              use_kernel, window)
     return out, (q, k, v, out, lse)
 
 
-def _ring_vjp_bwd(axis_name, causal, sm_scale, use_kernel, res, do):
+def _ring_vjp_bwd(axis_name, causal, sm_scale, use_kernel, window, res, do):
     q, k, v, out, lse = res
     return _ring_bwd_impl(q, k, v, out, lse, do, axis_name, causal, sm_scale,
-                          use_kernel)
+                          use_kernel, window)
 
 
 _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
@@ -204,7 +269,8 @@ _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
                    sm_scale: Optional[float] = None,
-                   use_kernel: Optional[bool] = None):
+                   use_kernel: Optional[bool] = None,
+                   window: Optional[int] = None):
     """Per-device body (call inside shard_map): q/k/v are local sequence
     shards ``[B, H, T_local, D]``; returns the local output shard.
 
@@ -217,13 +283,27 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
     compute step skips the rotation (n-1 ppermutes for n shards).
 
     Differentiable: gradients run the backward ring (module docstring).
+
+    ``window`` (requires ``causal``): Mistral-style sliding-window band.
+    Ring steps whose (q shard, kv shard) pair lies wholly outside the
+    band cond-skip their compute — at ``window << S`` only about
+    ``window / t_local + 1`` of the ``n`` steps are live, so wall-clock
+    scales with the band, not the sequence (the banded analogue of the
+    zigzag causal win).  In-band steps run the lax masked path (the
+    flash_partial kernel carries no band; the skipped steps dominate the
+    savings).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if use_kernel is None:
         use_kernel = _use_kernel_default()
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal attention")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     return _ring(q, k, v, axis_name, bool(causal), float(sm_scale),
-                 bool(use_kernel))
+                 bool(use_kernel), None if window is None else int(window))
 
 
 # ---------------------------------------------------------------------------
@@ -474,13 +554,16 @@ def make_zigzag_ring_attention(mesh, axis_name: str = "sp", *,
 
 def make_ring_attention(mesh, axis_name: str = "sp", *, causal: bool = True,
                         sm_scale: Optional[float] = None,
-                        use_kernel: Optional[bool] = None):
+                        use_kernel: Optional[bool] = None,
+                        window: Optional[int] = None):
     """Jitted global-view ring attention: q/k/v are global arrays sharded on
-    the sequence dimension over ``axis_name`` ([B, H, S, D], S sharded)."""
+    the sequence dimension over ``axis_name`` ([B, H, S, D], S sharded).
+    ``window``: sliding-window band (see :func:`ring_attention`)."""
     spec = P(None, None, axis_name, None)
 
     def local(q, k, v):
         return ring_attention(q, k, v, axis_name, causal=causal,
-                              sm_scale=sm_scale, use_kernel=use_kernel)
+                              sm_scale=sm_scale, use_kernel=use_kernel,
+                              window=window)
 
     return jax.jit(shard_map_fn(mesh, local, in_specs=(spec, spec, spec), out_specs=spec))
