@@ -404,9 +404,10 @@ def resolve_attn_fn(cfg: LlamaConfig, attn_fn: Optional[Callable]) -> Callable:
     supplied attn_fn on a windowed config must declare
     ``attn_fn.handles_window = True`` — silently training/serving
     full-causal on a windowed config is a different model.
-    :func:`make_sharded_attn` declares it when built with ``window=``
-    (plain ring layout; band-skipped steps); zigzag/Ulysses don't
-    implement windows.
+    :func:`make_sharded_attn` (plain ring layout; band-skipped steps)
+    and :func:`~starway_tpu.parallel.ulysses.make_ulysses_attention`
+    declare it when built with ``window=``; zigzag doesn't implement
+    windows.
     """
     if attn_fn is None:
         if cfg.sliding_window is not None:

@@ -29,10 +29,17 @@ from .sharding import shard_map_fn
 
 
 def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True,
-                      sm_scale: Optional[float] = None):
+                      sm_scale: Optional[float] = None,
+                      window: Optional[int] = None):
     """Per-device body (call inside shard_map): q/k/v are sequence shards
     ``[B, H, T_local, D]`` with the FULL head dimension; returns the local
-    sequence shard of the output."""
+    sequence shard of the output.
+
+    ``window``: sliding-window band — after the re-shard each device holds
+    the FULL sequence for its heads, so the band is just the local
+    blockwise mask (no cross-shard bookkeeping, unlike the ring)."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal attention")
     n = lax.axis_size(axis_name)
     n_rep = q.shape[1] // k.shape[1]
     if n_rep > 1 and k.shape[1] % n != 0:
@@ -49,20 +56,49 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True,
     q2 = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
     k2 = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
     v2 = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
-    if n_rep > 1:
-        k2 = repeat_kv(k2, n_rep)
-        v2 = repeat_kv(v2, n_rep)
-    o2 = blockwise_attention(q2, k2, v2, causal=causal, sm_scale=sm_scale)
+    if jax.default_backend() == "tpu":
+        # Same dispatch as models/llama.py:default_attn: the hand-tiled
+        # flash kernel takes GROUPED (narrow) kv and, with a window,
+        # DMA-elides out-of-band tiles — so windowed Ulysses wall-clock
+        # scales with the band, matching the ring path.
+        from ..ops.pallas_attention import flash_attention
+
+        o2 = flash_attention(q2, k2, v2, causal=causal, sm_scale=sm_scale,
+                             window=window)
+    else:
+        if n_rep > 1:
+            k2 = repeat_kv(k2, n_rep)
+            v2 = repeat_kv(v2, n_rep)
+        o2 = blockwise_attention(q2, k2, v2, causal=causal,
+                                 sm_scale=sm_scale, window=window)
     # Restore: [B, H/n, T, D] -> [B, H, T/n, D].
     return lax.all_to_all(o2, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
 
 def make_ulysses_attention(mesh, axis_name: str = "sp", *, causal: bool = True,
-                           sm_scale: Optional[float] = None):
-    """Jitted global-view Ulysses attention over sequence-sharded q/k/v."""
+                           sm_scale: Optional[float] = None,
+                           window: Optional[int] = None):
+    """Jitted global-view Ulysses attention over sequence-sharded q/k/v.
+    ``window``: sliding-window band (see :func:`ulysses_attention`)."""
+    if window is not None and not causal:
+        # Fail at build, not first-call trace (matches make_sharded_attn).
+        raise ValueError("window requires causal attention")
     spec = P(None, None, axis_name, None)
 
     def local(q, k, v):
-        return ulysses_attention(q, k, v, axis_name, causal=causal, sm_scale=sm_scale)
+        return ulysses_attention(q, k, v, axis_name, causal=causal,
+                                 sm_scale=sm_scale, window=window)
 
-    return jax.jit(shard_map_fn(mesh, local, in_specs=(spec, spec, spec), out_specs=spec))
+    jitted = jax.jit(shard_map_fn(mesh, local, in_specs=(spec, spec, spec),
+                                  out_specs=spec))
+    if window is None:
+        return jitted  # keep the PjitFunction surface (.lower, caching)
+
+    def fn(q, k, v):
+        return jitted(q, k, v)
+
+    # resolve_attn_fn's windowed-config contract (models/llama.py);
+    # attributes cannot be set on the jit object itself.
+    fn.handles_window = True
+    fn.window = window
+    return fn
