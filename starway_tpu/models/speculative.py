@@ -489,7 +489,9 @@ def generate_speculative(params: dict, cfg: LlamaConfig, draft_params: dict,
 
     Requirements: same vocab on both models; dense-only (MoE capacity is
     computed per forward, so a chunk verify would route differently than
-    stepwise decode); full caches (no sliding-window rolling).
+    stepwise decode).  Sliding-window models speculate through FULL
+    caches with window masking (the O(window) rolling layout is the one
+    thing not wired).
     """
     B, P = prompt.shape
     _validate_spec_args(max_new_tokens, gamma, (cfg, "target"),
@@ -504,6 +506,11 @@ def generate_speculative(params: dict, cfg: LlamaConfig, draft_params: dict,
     # Cache headroom: a macro step may write up to gamma - 1 positions
     # past the last kept token before the row's budget check stops it.
     max_len = P + max_new_tokens + gamma
+    if max_len == cfg.sliding_window:
+        # Dodge chunk_decode_step's rolling-cache shape heuristic (a FULL
+        # cache of exactly window slots is indistinguishable from the
+        # rolling layout); the extra slot is masked out of attention.
+        max_len += 1
     run = _compiled_speculative(cfg, draft_cfg, B, P, max_new_tokens,
                                 max_len, int(gamma), float(temperature),
                                 top_k, top_p,
@@ -527,10 +534,11 @@ def _validate_spec_args(max_new_tokens: int, gamma: int, *cfgs):
                 f"speculative decoding is dense-only ({who} has MoE): "
                 f"expert capacity is computed per forward, so the chunk "
                 f"verify would route differently than stepwise decode")
-        if c.sliding_window is not None:
-            raise ValueError(
-                f"speculative decoding needs full caches ({who} has a "
-                f"sliding window); rolling-cache support is not wired")
+        # Sliding-window configs run fine: the drivers allocate FULL
+        # caches (max_len = P + max_new + gamma) and both the draft's
+        # decode_step and the chunk verify mask by cfg.sliding_window —
+        # only the O(window) ROLLING cache layout is unsupported, and
+        # these entry points never allocate one.
 
 
 def _validate_lengths(prompt_lengths, B: int, P: int):
@@ -580,7 +588,8 @@ def generate_lookup(params: dict, cfg: LlamaConfig, prompt,
     distribution (deterministic proposals are the ``p_D = one-hot``
     special case of the same rejection rule).  Same contract and
     restrictions otherwise (aligned or ragged ``prompt_lengths``
-    batches, dense-only, full caches).
+    batches, dense-only; sliding-window models run through full
+    caches).
     """
     B, P = prompt.shape
     _validate_spec_args(max_new_tokens, gamma, (cfg, "target"))
@@ -590,6 +599,11 @@ def generate_lookup(params: dict, cfg: LlamaConfig, prompt,
     if key is None:
         key = jax.random.PRNGKey(0)
     max_len = P + max_new_tokens + gamma
+    if max_len == cfg.sliding_window:
+        # Dodge chunk_decode_step's rolling-cache shape heuristic (a FULL
+        # cache of exactly window slots is indistinguishable from the
+        # rolling layout); the extra slot is masked out of attention.
+        max_len += 1
     run = _compiled_lookup(cfg, B, P, max_new_tokens, max_len, int(gamma),
                            int(ngram), float(temperature), top_k, top_p,
                            ragged=prompt_lengths is not None)

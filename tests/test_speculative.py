@@ -161,10 +161,24 @@ def test_speculative_validation(params, draft):
     with pytest.raises(ValueError, match="dense-only"):
         generate_speculative(params, LlamaConfig.preset("debug", n_experts=4),
                              dparams, dcfg, prompt, 4)
-    with pytest.raises(ValueError, match="sliding window"):
-        generate_speculative(params,
-                             LlamaConfig.preset("debug", sliding_window=8),
-                             dparams, dcfg, prompt, 4)
+
+
+def test_windowed_speculative_matches_generate(params):
+    """Sliding-window models speculate through FULL caches with window
+    masking: greedy output (self-draft and prompt-lookup) is identical to
+    generate(), which itself decodes these configs through the rolling
+    O(window) cache — same math, different storage."""
+    from starway_tpu.models.speculative import generate_lookup
+
+    cfg = LlamaConfig.preset("debug", sliding_window=6)
+    prompt = jnp.asarray(np.random.default_rng(9).integers(
+        1, cfg.vocab_size, (2, 9), dtype=np.int32))
+    ref = generate(params, cfg, prompt, 12)
+    spec = generate_speculative(params, cfg, params, cfg, prompt, 12,
+                                gamma=4)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
+    look = generate_lookup(params, cfg, prompt, 12, gamma=4, ngram=2)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(look))
 
 
 def test_chunk_decode_rejects_rolling_cache(params):
