@@ -13,7 +13,7 @@ the demo model for a Llama-3.1-style config — decoupled ``head_dim`` and
 end to end (hf_convert.py; VERDICT r3 #6).
 
 Usage:  python examples/serve_hf.py [--model DIR] [--max-new 12]
-        [--arch llama\|llama31]
+        [--arch llama\|llama31\|qwen2\|mixtral\|gemma]
 """
 
 import argparse
@@ -33,11 +33,13 @@ def main() -> None:
                     help="int8 = W8A16 weight-only serving tree "
                          "(half the weight HBM; see ops/quantize.py)")
     ap.add_argument("--arch",
-                    choices=["llama", "llama31", "qwen2", "mixtral"],
+                    choices=["llama", "llama31", "qwen2", "mixtral",
+                             "gemma"],
                     default="llama",
                     help="demo-model flavour: llama31 = decoupled head_dim "
                          "+ llama3 rope scaling; qwen2 = q/k/v projection "
-                         "biases; mixtral = SwiGLU top-2 MoE experts")
+                         "biases; mixtral = SwiGLU top-2 MoE experts; "
+                         "gemma = GeGLU + (1+w) norms + scaled embeddings")
     args = ap.parse_args()
 
     import jax
@@ -55,7 +57,8 @@ def main() -> None:
 
     if args.model:
         # Auto class: real checkpoints of every served family (Llama,
-        # Mistral, Qwen2) load through their own architecture.
+        # Mistral, Qwen2, Mixtral, Gemma) load through their own
+        # architecture.
         hf = transformers.AutoModelForCausalLM.from_pretrained(args.model)
     else:
         torch.manual_seed(0)
@@ -71,6 +74,10 @@ def main() -> None:
             # Mixtral-style: SwiGLU top-2 MoE FFN (dropless conversion).
             hf = transformers.MixtralForCausalLM(transformers.MixtralConfig(
                 **dims, num_local_experts=4, num_experts_per_tok=2))
+        elif args.arch == "gemma":
+            # Gemma-style: GeGLU, (1+w) norms, sqrt(d)-scaled embeddings.
+            hf = transformers.GemmaForCausalLM(transformers.GemmaConfig(
+                **dims, head_dim=32))
         else:
             extra = {}
             if args.arch == "llama31":

@@ -20,8 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .llama import (LlamaConfig, apply_rope, cfg_rope_tables, forward,
-                    matmul_w, qkv_proj, rmsnorm)
+from .llama import (LlamaConfig, apply_rope, cfg_rope_tables, embed_tokens,
+                    forward, matmul_w, mlp_gate_act, qkv_proj, rmsnorm)
 from ..ops.attention import NEG_BIG, repeat_kv
 
 
@@ -150,7 +150,7 @@ def decode_step(params: dict, cache: dict, token, pos, cfg: LlamaConfig,
         def write(c, u):
             return lax.dynamic_update_slice_in_dim(c, u, slot, axis=2)
 
-    h = params["embed"][token][:, None, :]  # [B, 1, D]
+    h = embed_tokens(params, token, cfg)[:, None, :]  # [B, 1, D]
 
     def attend(q, lc):
         ksc, vsc = lc.get("k_scale"), lc.get("v_scale")
@@ -230,7 +230,7 @@ def cached_layer_scan(params, cache, h, cos_p, sin_p, cfg: LlamaConfig,
             )
             h = h + y
         else:
-            gate = jax.nn.silu(matmul_w(x, lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+            gate = mlp_gate_act(matmul_w(x, lp["w_gate"]), cfg).astype(x.dtype)
             h = h + matmul_w(gate * matmul_w(x, lp["w_up"]), lp["w_down"])
         return (h,), (kc, vc) + ((ksc, vsc) if quant else ())
 
@@ -387,7 +387,7 @@ def _compiled_prefill_chunk(cfg: LlamaConfig):
         # p < c0 with p % W == s; gathering positions c0-W..c0-1 in order
         # lets partial_attention mask in plain global coordinates.
         order = (c0 - W + jnp.arange(W)) % W
-        h = params["embed"][tokens_c]  # [B, Cc, D]
+        h = embed_tokens(params, tokens_c, cfg)  # [B, Cc, D]
 
         def chunk_attn(kc, vc, ksc, vsc):
             """attn_fn for decoder_layer: past (the rolling cache, in

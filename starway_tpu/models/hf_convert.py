@@ -1,14 +1,18 @@
-"""HuggingFace Llama/Mistral/Qwen2 checkpoint -> starway-tpu parameter tree.
+"""HuggingFace Llama-family checkpoint -> starway-tpu parameter tree.
 
-Bridges the ecosystem's weights into this framework:
-``transformers.LlamaForCausalLM``, ``MistralForCausalLM`` (same
-architecture; Mistral adds sliding-window attention, which maps onto
-``LlamaConfig.sliding_window``) and ``Qwen2ForCausalLM`` (adds q/k/v
-projection biases -> ``cfg.attn_bias``/``bq``/``bk``/``bv`` leaves)
-convert into the stacked-layer pytree ``models/llama.py`` trains and
-serves, and ``config_from_hf`` derives the matching :class:`LlamaConfig`
-— including modern variants with decoupled ``head_dim`` and
-linear/llama3 ``rope_scaling``.
+Bridges the ecosystem's weights into this framework — five served
+families: ``transformers.LlamaForCausalLM``, ``MistralForCausalLM``
+(sliding-window attention -> ``LlamaConfig.sliding_window``),
+``Qwen2ForCausalLM`` (q/k/v projection biases ->
+``cfg.attn_bias``/``bq``/``bk``/``bv`` leaves), ``MixtralForCausalLM``
+(SwiGLU top-2 MoE experts -> ``cfg.moe_swiglu``, dropless conversion
+capacity), and ``GemmaForCausalLM`` (GeGLU -> ``cfg.mlp_act``, the
+(1 + w) RMSNorm convention folded into the converted weights,
+sqrt(d_model)-scaled embeddings -> ``cfg.scaled_embed``) — all into the
+stacked-layer pytree ``models/llama.py`` trains and serves;
+``config_from_hf`` derives the matching :class:`LlamaConfig`, including
+modern variants with decoupled ``head_dim`` and linear/llama3
+``rope_scaling``.
 
 Convention notes (why this is transpose-and-stack, not surgery):
 
@@ -45,15 +49,29 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
     if getattr(hf_config, "mlp_bias", False):
         raise NotImplementedError(
             "MLP biases are not represented in this parameter tree")
-    act = getattr(hf_config, "hidden_act", "silu")
-    if act not in ("silu", "swish"):
-        raise NotImplementedError(f"hidden_act={act!r}; this family is SwiGLU")
+    model_type = getattr(hf_config, "model_type", "")
+    if model_type in ("gemma2", "gemma3", "gemma3_text"):
+        # Must precede the activation check, or these fall into the
+        # generic hidden_act error with a misleading message.
+        raise NotImplementedError(
+            f"{model_type} adds logit soft-capping and pre/post "
+            "feed-forward norms this tree does not represent; gemma (v1) "
+            "converts")
+    act = (getattr(hf_config, "hidden_activation", None)
+           or getattr(hf_config, "hidden_act", "silu"))
+    if act in ("silu", "swish"):
+        mlp_act = "silu"
+    elif act in ("gelu_pytorch_tanh", "gelu_tanh") and model_type == "gemma":
+        mlp_act = "gelu_tanh"  # Gemma's GeGLU
+    else:
+        raise NotImplementedError(
+            f"hidden_act={act!r} on model_type={model_type!r}; this family "
+            "is gated-MLP with silu (Llama) or gelu_tanh (Gemma)")
     # Qwen2-family checkpoints attach q/k/v biases (cfg.attn_bias ->
     # bq/bk/bv leaves; Qwen2's o_proj carries NO bias, so the tree is
     # complete).  A generic attention_bias=True config is a DIFFERENT
     # shape: HF Llama then puts a bias on o_proj too, which this tree
     # does not represent — refuse rather than silently drop it.
-    model_type = getattr(hf_config, "model_type", "")
     attn_bias = model_type == "qwen2"
     if getattr(hf_config, "attention_bias", False) and not attn_bias:
         raise NotImplementedError(
@@ -106,6 +124,11 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
                            and explicit_hd != derived_hd else None),
         rope_scaling=_rope_scaling_from_hf(
             getattr(hf_config, "rope_scaling", None)),
+        mlp_act=mlp_act,
+        # Gemma scales the embedding OUTPUT by sqrt(d_model); the tied
+        # lm_head reads the raw table, so it is a runtime flag, not a
+        # weight fold.
+        scaled_embed=model_type == "gemma",
     )
     if model_type == "mixtral":
         # Mixtral: SwiGLU experts, top-k routing with softmax-then-topk
@@ -154,6 +177,15 @@ def _rope_scaling_from_hf(scaling) -> "tuple | None":
         "frequencies vs transformers")
 
 
+def _norm_w(w, plus_one: bool) -> np.ndarray:
+    """RMSNorm weight, with Gemma's ``x̂ * (1 + w)`` convention folded to
+    ``w' = 1 + w`` so the framework's plain ``x̂ * w`` is exact (the
+    addition runs in f32 before the dtype cast, matching HF's f32 norm
+    math)."""
+    w = _np(w)
+    return w + 1.0 if plus_one else w
+
+
 def _t(w) -> np.ndarray:
     """torch/np tensor -> f32 numpy, transposed ([out, in] -> [in, out])."""
     return _np(w).T
@@ -166,7 +198,8 @@ def _np(w) -> np.ndarray:
 
 
 def params_from_hf(model_or_state: Any, cfg: LlamaConfig, dtype=None, *,
-                   quantize: str = "none") -> dict:
+                   quantize: str = "none",
+                   norm_plus_one: "bool | None" = None) -> dict:
     """Convert a ``LlamaForCausalLM`` (or its ``state_dict()``) into this
     framework's stacked-layer parameter pytree, cast to ``dtype`` (default:
     ``cfg.compute_dtype``).
@@ -178,12 +211,23 @@ def params_from_hf(model_or_state: Any, cfg: LlamaConfig, dtype=None, *,
     ``quantize="int8"``: return the W8A16 serving tree
     (ops/quantize.py:quantize_params applied after conversion) — every
     matmul weight as per-output-channel int8 + scales, half the weight
-    HBM, inference-only (see models/llama.py:matmul_w)."""
+    HBM, inference-only (see models/llama.py:matmul_w).
+
+    ``norm_plus_one``: Gemma computes RMSNorm as ``x̂ * (1 + w)`` with
+    zero-init weights; the fold ``w' = 1 + w`` at conversion makes the
+    framework's plain ``x̂ * w`` norm exact with NO runtime flag.
+    Defaults to ``cfg.scaled_embed`` (the Gemma marker config_from_hf
+    sets), so Gemma state DICTS fold correctly too."""
     import jax.numpy as jnp
 
     if quantize not in ("none", "int8"):
         # Before the conversion work, not after.
         raise ValueError(f"quantize must be 'none' or 'int8', got {quantize!r}")
+    if norm_plus_one is None:
+        # cfg.scaled_embed is set by config_from_hf exactly for Gemma —
+        # keying off the passed cfg (not model_or_state.config, absent on
+        # raw state dicts) keeps dict conversions correct by default.
+        norm_plus_one = cfg.scaled_embed
     if hasattr(model_or_state, "state_dict"):
         state = {k: v for k, v in model_or_state.state_dict().items()}
     else:
@@ -203,9 +247,11 @@ def params_from_hf(model_or_state: Any, cfg: LlamaConfig, dtype=None, *,
         "wk": stack(lambda i: _t(get(f"layers.{i}.self_attn.k_proj.weight"))),
         "wv": stack(lambda i: _t(get(f"layers.{i}.self_attn.v_proj.weight"))),
         "wo": stack(lambda i: _t(get(f"layers.{i}.self_attn.o_proj.weight"))),
-        "attn_norm": stack(lambda i: _np(get(f"layers.{i}.input_layernorm.weight"))),
-        "mlp_norm": stack(
-            lambda i: _np(get(f"layers.{i}.post_attention_layernorm.weight"))),
+        "attn_norm": stack(lambda i: _norm_w(
+            get(f"layers.{i}.input_layernorm.weight"), norm_plus_one)),
+        "mlp_norm": stack(lambda i: _norm_w(
+            get(f"layers.{i}.post_attention_layernorm.weight"),
+            norm_plus_one)),
     }
     if prefix + "layers.0.block_sparse_moe.gate.weight" in state:
         # Mixtral: gate -> router [D, E]; per-expert SwiGLU maps
@@ -255,7 +301,8 @@ def params_from_hf(model_or_state: Any, cfg: LlamaConfig, dtype=None, *,
     params = {
         "embed": embed,
         "layers": layers,
-        "final_norm": jnp.asarray(_np(get("norm.weight")), dt),
+        "final_norm": jnp.asarray(
+            _norm_w(get("norm.weight"), norm_plus_one), dt),
         "lm_head": lm_head,
     }
     if quantize == "int8":

@@ -58,6 +58,13 @@ class LlamaConfig:
     # SwiGLU experts (Mixtral family): adds a w_gate [L, E, D, F] leaf and
     # switches _expert_ffn to silu(x@w_gate) * (x@w_in) @ w_out.
     moe_swiglu: bool = False
+    # Gated-MLP activation: "silu" (Llama SwiGLU) or "gelu_tanh" (Gemma
+    # GeGLU, = HF's gelu_pytorch_tanh).
+    mlp_act: str = "silu"
+    # Gemma-style sqrt(d_model) scaling of the token embedding OUTPUT
+    # (the tied lm_head reads the UNSCALED table, so this cannot fold
+    # into the weights).
+    scaled_embed: bool = False
     # KV-cache storage: "none" keeps compute_dtype; "int8" stores the cache
     # int8 with per-token scales (ops/quantize.py) — half the HBM bytes on
     # the bandwidth-bound decode stream, double the servable context.
@@ -106,6 +113,10 @@ class LlamaConfig:
         elif self.head_dim_override < 2 or self.head_dim_override % 2:
             raise ValueError(f"head_dim_override must be an even int >= 2, "
                              f"got {self.head_dim_override}")
+        if self.mlp_act not in ("silu", "gelu_tanh"):
+            raise ValueError(
+                f"mlp_act must be 'silu' or 'gelu_tanh', got "
+                f"{self.mlp_act!r}")
         if self.remat_policy not in (None, "dots"):
             raise ValueError(
                 f"remat_policy must be None or 'dots', got "
@@ -431,6 +442,27 @@ def resolve_attn_fn(cfg: LlamaConfig, attn_fn: Optional[Callable]) -> Callable:
 # ----------------------------------------------------------------- forward
 
 
+def embed_tokens(params: dict, tokens, cfg: "LlamaConfig"):
+    """Token embedding gather, with Gemma's sqrt(d_model) output scaling
+    when ``cfg.scaled_embed`` — the ONE embed site every entry point
+    (forward/prefill, decode_step, chunk_decode_step, the pipeline step)
+    shares, so no path can forget the normalizer."""
+    h = params["embed"][tokens]
+    if cfg.scaled_embed:
+        # HF Gemma multiplies by a normalizer tensor cast to model dtype.
+        h = h * jnp.asarray(cfg.d_model ** 0.5, h.dtype)
+    return h
+
+
+def mlp_gate_act(x, cfg: "LlamaConfig"):
+    """The gated-MLP nonlinearity in f32 (MXU outputs accumulate f32):
+    SiLU (Llama) or tanh-approximated GeLU (Gemma's GeGLU)."""
+    xf = x.astype(jnp.float32)
+    if cfg.mlp_act == "gelu_tanh":
+        return jax.nn.gelu(xf, approximate=True)
+    return jax.nn.silu(xf)
+
+
 def qkv_proj(x, lp, cfg: "LlamaConfig"):
     """q/k/v projections on ``x [B, S, D]`` -> ``[B, H, S, hd]`` heads,
     pre-RoPE.  Optional per-head biases (Qwen2 family) apply when the
@@ -500,7 +532,7 @@ def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
             )
         h = h + y
     else:
-        gate = jax.nn.silu(matmul_w(x, lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+        gate = mlp_gate_act(matmul_w(x, lp["w_gate"]), cfg).astype(x.dtype)
         h = h + matmul_w(gate * matmul_w(x, lp["w_up"]), lp["w_down"])
         aux = jnp.zeros((), jnp.float32)
     return h, aux, k, v, stats
@@ -551,7 +583,7 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
     B, S = tokens.shape
     cos, sin = cfg_rope_tables(cfg, S)
 
-    h = params["embed"][tokens]  # [B, S, D]
+    h = embed_tokens(params, tokens, cfg)  # [B, S, D]
 
     def layer(carry, lp):
         h, aux = carry

@@ -32,7 +32,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .llama import (LlamaConfig, cfg_rope_tables, decoder_layer,
-                    head_logits, resolve_attn_fn, token_ce)
+                    embed_tokens, head_logits, resolve_attn_fn, token_ce)
 from ..parallel.pipeline import make_pipeline_train
 
 
@@ -319,15 +319,20 @@ def make_pp_llama_train(mesh, cfg: LlamaConfig, *, axis_name: str = "pp",
                 f"the data-sharding size {n_data} (dp x ep)")
         D = pp_params["embed"].shape[1]
 
-        h0 = pp_params["embed"][tokens].reshape(n_micro, mb, S, D)
+        h0 = embed_tokens(pp_params, tokens, cfg).reshape(n_micro, mb, S, D)
         tgt = targets.reshape(n_micro, mb, S)
         loss, dstages, dhead, dh0 = grad_step(
             pp_params["stages"], pp_params["head"], h0, tgt)
 
         # Chain the input cotangent into the embedding table: scatter-add
         # d h0 over the token ids (B*S rows; reshape orders match h0's).
+        # embed_tokens scales h0 by sqrt(D) on scaled_embed configs
+        # (Gemma), so the chain rule carries the same factor back.
+        dh0 = dh0.reshape(-1, D)
+        if cfg.scaled_embed:
+            dh0 = dh0 * (D ** 0.5)
         dembed = jnp.zeros(pp_params["embed"].shape, jnp.float32).at[
-            tokens.reshape(-1)].add(dh0.reshape(-1, D))
+            tokens.reshape(-1)].add(dh0)
 
         grads = {"embed": dembed, "stages": dstages, "head": dhead}
         return loss, grads

@@ -42,7 +42,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .generate import _filter_logits, _sample, cached_layer_scan, prefill
-from .llama import LlamaConfig, cfg_rope_tables, matmul_w, rmsnorm
+from .llama import (LlamaConfig, cfg_rope_tables, embed_tokens, matmul_w,
+                    rmsnorm)
 
 
 def chunk_decode_step(params, cache, tokens, pos, cfg: LlamaConfig, rope):
@@ -109,7 +110,7 @@ def chunk_decode_step(params, cache, tokens, pos, cfg: LlamaConfig, rope):
                               k_scale=layer_cache.get("k_scale"),
                               v_scale=layer_cache.get("v_scale"))
 
-    h = params["embed"][tokens]  # [B, C, D]
+    h = embed_tokens(params, tokens, cfg)  # [B, C, D]
     h, out = cached_layer_scan(params, cache, h, cos_p, sin_p, cfg, write,
                                attend)
     h = rmsnorm(h, params["final_norm"], cfg.norm_eps)

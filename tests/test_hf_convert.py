@@ -198,6 +198,60 @@ def test_mixtral_logits_and_generation_match_transformers():
         np.testing.assert_array_equal(ragged[b], solo)
 
 
+def test_gemma_logits_and_generation_match_transformers():
+    """Gemma (a fifth served family): GeGLU MLP (gelu_tanh gate),
+    RMSNorm's (1 + w) convention folded into the converted weights,
+    sqrt(d_model)-scaled embeddings with the TIED lm_head reading the raw
+    table, decoupled head_dim — logits and greedy generation match
+    transformers' GemmaForCausalLM through prefill + cached decode."""
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=128, rope_theta=10000.0,
+        attn_implementation="eager")
+    torch.manual_seed(13)
+    hf = transformers.GemmaForCausalLM(hf_cfg).eval()
+
+    cfg = config_from_hf(hf.config, dtype="float32")
+    assert cfg.mlp_act == "gelu_tanh" and cfg.scaled_embed
+    assert cfg.head_dim == 32
+    params = params_from_hf(hf, cfg)
+    # Zero-init Gemma norms fold to exactly 1.0 — a dropped fold would
+    # show as all-zeros.
+    assert float(np.asarray(params["layers"]["attn_norm"]).mean()) > 0.5
+    # Tied head: raw (unscaled) embedding transposed.
+    np.testing.assert_allclose(np.asarray(params["lm_head"]),
+                               np.asarray(params["embed"]).T)
+
+    tokens = np.random.default_rng(6).integers(0, 256, (2, 15),
+                                               dtype=np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=2e-3)
+
+    prompt = np.asarray([[7, 2, 9, 4]], dtype=np.int64)
+    with torch.no_grad():
+        hf_gen = hf.generate(torch.from_numpy(prompt), max_new_tokens=10,
+                             do_sample=False, pad_token_id=0).numpy()
+    ours_gen = np.asarray(generate(params, cfg,
+                                   jnp.asarray(prompt, jnp.int32), 10))
+    np.testing.assert_array_equal(ours_gen[:, :hf_gen.shape[1]], hf_gen)
+
+    # A raw STATE DICT (no .config to sniff) must fold the (1+w) norms
+    # too — the default keys off cfg, which already encodes Gemma.
+    params2 = params_from_hf(dict(hf.state_dict()), cfg)
+    np.testing.assert_array_equal(
+        np.asarray(params2["layers"]["attn_norm"]),
+        np.asarray(params["layers"]["attn_norm"]))
+
+    with pytest.raises(NotImplementedError, match="soft-capping"):
+        config_from_hf(transformers.Gemma2Config(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=1, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16))
+
+
 def test_bias_and_mixed_window_refusals(hf_model):
     """Shapes the tree cannot represent still refuse loudly: a generic
     attention_bias=True config biases o_proj too (Qwen2 doesn't), and

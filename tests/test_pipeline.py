@@ -193,6 +193,37 @@ def test_pp_llama_grads_match_single_device():
     assert tuple(specs["embed"]) == ()
 
 
+def test_pp_llama_scaled_embed_grads_match():
+    """Gemma-style scaled embeddings through the pipeline: embed_tokens
+    scales h0 by sqrt(D), so the hand-chained embedding cotangent must
+    carry the factor back — loss AND the embed grad vs jax.grad of the
+    flat loss (a dropped factor understates d embed by sqrt(D))."""
+    from starway_tpu.models import LlamaConfig, init_params
+    from starway_tpu.models.llama import loss_fn as flat_loss
+    from starway_tpu.models.pp_llama import (
+        make_pp_llama_train, pp_merge_params, pp_split_params,
+        shard_pp_params)
+    from starway_tpu.parallel import make_mesh
+
+    cfg = LlamaConfig.preset("debug", n_layers=4, d_model=32, n_heads=4,
+                             n_kv_heads=2, d_ff=48, vocab_size=64,
+                             scaled_embed=True, mlp_act="gelu_tanh")
+    params = init_params(jax.random.PRNGKey(4), cfg)
+    mesh = make_mesh({"pp": 2})
+    batch = jnp.asarray(np.random.default_rng(4).integers(
+        0, cfg.vocab_size, (4, 9), dtype=np.int32))
+
+    pp = shard_pp_params(pp_split_params(params, 2), mesh)
+    step = make_pp_llama_train(mesh, cfg, n_micro=2)
+    loss_pp, grads_pp = step(pp, batch)
+    loss_ref, grads_ref = jax.value_and_grad(flat_loss)(params, batch, cfg)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+    flat = pp_merge_params(grads_pp)
+    np.testing.assert_allclose(np.asarray(flat["embed"]),
+                               np.asarray(grads_ref["embed"]),
+                               atol=3e-5, rtol=3e-4)
+
+
 def test_pp_llama_interleaved_grads_match_single_device():
     """End-to-end pipeline Llama on the INTERLEAVED schedule (2 virtual
     chunks/device): loss and every gradient — embed, all layers across
