@@ -263,3 +263,28 @@ def test_moe_continuous_batching_dropless():
     with pytest.raises(ValueError, match="dropless"):
         SlotServer(init_params(jax.random.PRNGKey(3), droppy), droppy,
                    n_slots=2, max_len=64)
+
+
+@pytest.mark.parametrize("flavour", ["qwen2", "gemma"])
+def test_family_configs_serve_continuously(flavour):
+    """The family knobs (Qwen2 projection biases; Gemma GeGLU + scaled
+    embeddings) flow through the slot server's admit/decode programs:
+    every request matches its solo generate() oracle."""
+    kw = (dict(attn_bias=True) if flavour == "qwen2"
+          else dict(mlp_act="gelu_tanh", scaled_embed=True))
+    fcfg = LlamaConfig.preset("debug", **kw)
+    fparams = init_params(jax.random.PRNGKey(5), fcfg)
+    if flavour == "qwen2":
+        # Zero-init biases would make the flag a no-op; randomise.
+        fparams["layers"]["bq"] = 0.3 * jax.random.normal(
+            jax.random.PRNGKey(6), fparams["layers"]["bq"].shape)
+    rng = np.random.default_rng(10)
+    reqs = [(list(rng.integers(1, fcfg.vocab_size, n)), m)
+            for n, m in [(4, 5), (7, 3)]]
+    srv = SlotServer(fparams, fcfg, n_slots=2, max_len=64, chunk=4)
+    rids = [srv.submit(p, m) for p, m in reqs]
+    done = srv.run()
+    for rid, (prompt, max_new) in zip(rids, reqs):
+        np.testing.assert_array_equal(
+            done[rid], _oracle(fparams, fcfg, prompt, max_new),
+            err_msg=f"{flavour} request {rid}")
