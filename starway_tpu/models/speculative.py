@@ -488,11 +488,13 @@ def generate_speculative(params: dict, cfg: LlamaConfig, draft_params: dict,
     every row speculates from its own cursor; returns only the NEW
     tokens ``[B, max_new_tokens]`` (the ragged ``generate`` contract).
 
-    Requirements: same vocab on both models; dense-only (MoE capacity is
-    computed per forward, so a chunk verify would route differently than
-    stepwise decode).  Sliding-window models speculate through FULL
-    caches with window masking (the O(window) rolling layout is the one
-    thing not wired).
+    Requirements: same vocab on both models; dense FFNs or
+    provably-dropless MoE (``moe_capacity_factor >= n_experts``, the
+    Mixtral conversion default — shape-invariant routing makes the chunk
+    verify route exactly like stepwise decode; droppy capacities
+    refuse).  Sliding-window models speculate through FULL caches with
+    window masking (the O(window) rolling layout is the one thing not
+    wired).
     """
     B, P = prompt.shape
     _validate_spec_args(max_new_tokens, gamma, (cfg, "target"),
@@ -530,11 +532,19 @@ def _validate_spec_args(max_new_tokens: int, gamma: int, *cfgs):
         raise ValueError(f"gamma must be >= 2 (got {gamma}); gamma=1 is "
                          f"plain decode — use generate()")
     for c, who in cfgs:
-        if c.n_experts > 0:
+        if c.n_experts > 0 and c.moe_capacity_factor < c.n_experts:
+            # Capacity is computed PER FORWARD, so a droppy chunk verify
+            # could route differently than stepwise decode.  Provably
+            # dropless capacity (cf >= E -> capacity = T * k for any T,
+            # moe.py:moe_capacity) makes routing per-token and
+            # shape-invariant — the Mixtral conversion default — so those
+            # models speculate exactly.
             raise ValueError(
-                f"speculative decoding is dense-only ({who} has MoE): "
-                f"expert capacity is computed per forward, so the chunk "
-                f"verify would route differently than stepwise decode")
+                f"speculative decoding needs dense FFNs or provably-"
+                f"dropless MoE ({who}): expert capacity is computed per "
+                f"forward, so a droppy chunk verify could route "
+                f"differently than stepwise decode; set "
+                f"moe_capacity_factor >= n_experts (= {c.n_experts})")
         # Sliding-window configs run fine: the drivers allocate FULL
         # caches (max_len = P + max_new + gamma) and both the draft's
         # decode_step and the chunk verify mask by cfg.sliding_window —
@@ -589,8 +599,8 @@ def generate_lookup(params: dict, cfg: LlamaConfig, prompt,
     distribution (deterministic proposals are the ``p_D = one-hot``
     special case of the same rejection rule).  Same contract and
     restrictions otherwise (aligned or ragged ``prompt_lengths``
-    batches, dense-only; sliding-window models run through full
-    caches).
+    batches; dense or provably-dropless MoE; sliding-window models run
+    through full caches).
     """
     B, P = prompt.shape
     _validate_spec_args(max_new_tokens, gamma, (cfg, "target"))

@@ -158,7 +158,9 @@ def test_speculative_validation(params, draft):
         generate_speculative(params, cfg, dparams,
                              LlamaConfig.preset("debug", vocab_size=64),
                              prompt, 4)
-    with pytest.raises(ValueError, match="dense-only"):
+    with pytest.raises(ValueError, match="dropless"):
+        # default cf 1.25: droppy MoE refuses; dropless speculates (see
+        # test_moe_dropless_speculative_matches_generate).
         generate_speculative(params, LlamaConfig.preset("debug", n_experts=4),
                              dparams, dcfg, prompt, 4)
 
@@ -178,6 +180,25 @@ def test_windowed_speculative_matches_generate(params):
                                 gamma=4)
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
     look = generate_lookup(params, cfg, prompt, 12, gamma=4, ngram=2)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(look))
+
+
+def test_moe_dropless_speculative_matches_generate():
+    """Provably-dropless MoE (Mixtral-style) speculates: shape-invariant
+    routing makes the chunk verify route exactly like stepwise decode, so
+    greedy self-draft and prompt-lookup outputs are identical to
+    generate()."""
+    from starway_tpu.models.speculative import generate_lookup
+
+    cfg = LlamaConfig.preset("debug", n_experts=4, moe_top_k=2,
+                             moe_swiglu=True, moe_capacity_factor=4.0)
+    p = init_params(jax.random.PRNGKey(5), cfg)
+    prompt = jnp.asarray(np.random.default_rng(5).integers(
+        1, cfg.vocab_size, (2, 7), dtype=np.int32))
+    ref = generate(p, cfg, prompt, 10)
+    spec = generate_speculative(p, cfg, p, cfg, prompt, 10, gamma=4)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
+    look = generate_lookup(p, cfg, prompt, 10, gamma=4, ngram=2)
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(look))
 
 
