@@ -645,19 +645,12 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
         key = jax.random.PRNGKey(0)
     ragged = prompt_lengths is not None
     if ragged:
-        if cfg.n_experts > 0 and cfg.moe_capacity_factor < cfg.n_experts:
-            # Expert capacity is computed over the whole padded batch, so
-            # pad tokens would consume slots and perturb REAL rows' routing
-            # — the per-row-equivalence contract below cannot hold.
-            # Exception: capacity_factor >= n_experts makes capacity
-            # T * k, provably dropless for ANY routing, so pads can only
-            # occupy spare slots and real rows are untouched (the Mixtral
-            # conversion default, hf_convert.py).
-            raise ValueError(
-                "ragged generation needs dense FFNs or provably-dropless "
-                "MoE: expert capacity is shared batch-wide, so pad tokens "
-                "would alter real rows; set moe_capacity_factor >= "
-                f"n_experts (= {cfg.n_experts}) to make drops impossible")
+        from .moe import require_dropless
+
+        # Pad tokens share the batch-wide expert capacity; only provable
+        # droplessness keeps real rows untouched (moe.py, the single
+        # source of the rule).
+        require_dropless(cfg, "ragged generation")
         lengths = validate_prompt_lengths(prompt_lengths, B, P)
     else:
         lengths = jnp.zeros((B,), jnp.int32)  # unused placeholder

@@ -63,6 +63,23 @@ def moe_specs(swiglu: bool = False) -> dict:
     return out
 
 
+def require_dropless(cfg, context: str) -> None:
+    """Raise unless ``cfg`` is dense or PROVABLY dropless MoE
+    (``moe_capacity_factor >= n_experts`` -> capacity >= T * k for any
+    token count, :func:`moe_capacity`'s ceiling).  The single source of
+    the rule every shape-sensitive entry point shares: ragged
+    generation, continuous batching, and the speculative chunk verify
+    all rely on routing being shape-invariant, which only droplessness
+    guarantees."""
+    if cfg.n_experts > 0 and cfg.moe_capacity_factor < cfg.n_experts:
+        raise ValueError(
+            f"{context} needs dense FFNs or provably-dropless MoE: expert "
+            f"capacity is computed per forward, so routing could differ "
+            f"across forward shapes; set moe_capacity_factor >= n_experts "
+            f"(= {cfg.n_experts}) to make drops impossible (the Mixtral "
+            f"conversion default)")
+
+
 def moe_capacity(n_assignments: int, n_experts: int,
                  capacity_factor: float) -> int:
     """Static per-expert capacity for ``n_assignments`` routed (token,

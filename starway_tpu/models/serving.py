@@ -286,14 +286,12 @@ class SlotServer:
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, eos_id: Optional[int] = None,
                  prompt_buckets=None, seed: int = 0):
-        if cfg.n_experts > 0 and cfg.moe_capacity_factor < cfg.n_experts:
-            raise ValueError(
-                "continuous batching needs dense FFNs or provably-dropless "
-                "MoE: expert capacity is shared batch-wide, so cohabiting "
-                "slots would perturb each other's routing; set "
-                f"moe_capacity_factor >= n_experts (= {cfg.n_experts}) to "
-                "make drops impossible (the Mixtral conversion default — "
-                "same rule as ragged generate())")
+        from .moe import require_dropless
+
+        # Cohabiting slots share the batch-wide expert capacity; only
+        # provable droplessness keeps them independent (moe.py, the
+        # single source of the rule).
+        require_dropless(cfg, "continuous batching")
         self.rolling = cfg.sliding_window is not None
         if n_slots < 1 or chunk < 1:
             # Zero slots/chunk would make run() spin forever, not error.
