@@ -33,7 +33,8 @@ class Trainer:
                  dp_port=None, dp_base_tag: int = 0x6000,
                  mesh=None, fsdp_axis: Optional[str] = None,
                  moe_fn: Optional[Callable] = None,
-                 with_moe_stats: bool = False):
+                 with_moe_stats: bool = False,
+                 accum_steps: int = 1):
         """``dp_port``: a ClientPort/ServerPort to a peer rank; when set,
         gradients are averaged with the peer every step before the update.
 
@@ -55,6 +56,13 @@ class Trainer:
         runs the fused sharded train step (batch sharded over the same
         axis).  Mutually exclusive with ``dp_port``: the P2P gradient
         exchange assumes host-visible unsharded grads.
+
+        ``accum_steps``: gradient accumulation — the batch splits into
+        that many equal microbatches whose f32-accumulated grads feed ONE
+        optimizer update (make_train_step's semantics: activation memory
+        scales with the microbatch, the math matches the full-batch step
+        for dense models).  Local/fsdp step only; the dp_port exchange
+        path averages full-batch grads and stays accum_steps=1.
         """
         self.cfg = cfg
         self.tx = tx
@@ -74,6 +82,12 @@ class Trainer:
             raise ValueError(
                 "with_moe_stats needs an expert config and a stats-producing"
                 " moe_fn (make_sharded_moe(..., with_stats=True))")
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        if accum_steps > 1 and dp_port is not None:
+            raise ValueError(
+                "accum_steps composes with the local/fsdp step only; the "
+                "dp_port exchange path averages full-batch grads")
         if (mesh is None) != (fsdp_axis is None):
             raise ValueError("pass mesh and fsdp_axis together")
         if mesh is not None:
@@ -88,7 +102,8 @@ class Trainer:
             self.state.params = shard_tree(self.state.params, mesh, pspecs)
             self.state.opt_state = shard_tree(self.state.opt_state, mesh, ospecs)
             self._fsdp_step = make_fsdp_train_step(
-                make_train_step(cfg, tx, attn_fn, moe_fn), mesh, pspecs,
+                make_train_step(cfg, tx, attn_fn, moe_fn,
+                                accum_steps=accum_steps), mesh, pspecs,
                 ospecs, axis=fsdp_axis, donate=donate)
         if dp_port is not None:
             # step_dp gives each step a 256-tag window (base advances by 256
@@ -107,9 +122,29 @@ class Trainer:
             lambda p, o, g: apply_updates(tx, p, o, g),
             donate_argnums=(0, 1) if donate else (),
         )
+        self._accum_step = None
+        if accum_steps > 1 and self._fsdp_step is None:
+            # The fused accumulate-then-update step (make_train_step's
+            # lax.scan over microbatches); step_sync dispatches to it.
+            self._accum_step = jax.jit(
+                make_train_step(cfg, tx, attn_fn, moe_fn,
+                                accum_steps=accum_steps,
+                                with_moe_stats=with_moe_stats),
+                donate_argnums=(0, 1) if donate else ())
 
     def step_sync(self, batch) -> float:
         """One local step (no DP exchange)."""
+        if self._accum_step is not None:
+            with self.timer.span("accum_step"):
+                out = self._accum_step(self.state.params,
+                                       self.state.opt_state, batch)
+                if self.with_moe_stats:
+                    (self.state.params, self.state.opt_state, loss,
+                     self.last_moe_stats) = out
+                else:
+                    self.state.params, self.state.opt_state, loss = out
+            self.state.step += 1
+            return float(loss)
         if self._fsdp_step is not None:
             with self.timer.span("fsdp_step"):
                 self.state.params, self.state.opt_state, loss = self._fsdp_step(

@@ -155,3 +155,64 @@ async def test_trainer_dp_step_pair():
     finally:
         await client.aclose()
         await server.aclose()
+
+
+def test_trainer_accum_matches_full_batch():
+    """Trainer(accum_steps=2) reproduces the plain full-batch trainer step
+    (dense f32 debug preset -> tight tolerance) and refuses the dp_port
+    composition it doesn't implement."""
+    import optax
+
+    cfg = LlamaConfig.preset("debug")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    batch = jnp.asarray(np.random.default_rng(6).integers(
+        0, cfg.vocab_size, (8, 17), dtype=np.int32))
+
+    t1 = Trainer(cfg, optax.adamw(1e-3), params, donate=False)
+    t2 = Trainer(cfg, optax.adamw(1e-3), params, donate=False,
+                 accum_steps=2)
+    l1 = t1.step_sync(batch)
+    l2 = t2.step_sync(batch)
+    np.testing.assert_allclose(l2, l1, rtol=1e-6)
+    # Chunked summation reassociates f32 reductions and adamw's rsqrt
+    # amplifies ulp-level grad differences (same bound as
+    # tests/test_model.py's accumulation pin).
+    for a, b in zip(jax.tree_util.tree_leaves(t1.state.params),
+                    jax.tree_util.tree_leaves(t2.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=1e-3)
+    assert t2.state.step == 1
+
+    with pytest.raises(ValueError, match="accum_steps"):
+        Trainer(cfg, optax.adamw(1e-3), params, accum_steps=0)
+    with pytest.raises(ValueError, match="dp_port"):
+        Trainer(cfg, optax.adamw(1e-3), params, accum_steps=2,
+                dp_port=object())
+
+
+def test_trainer_fsdp_accum_matches_local():
+    """accum_steps composes with ZeRO/fsdp mode: the sharded
+    accumulate-then-update step reproduces the local accum trainer (the
+    P(axis)-sharded batch reshapes to (accum, B/accum, ...) inside the
+    GSPMD jit — this pins that resharding path)."""
+    import optax
+
+    from starway_tpu.parallel import make_mesh
+
+    cfg = LlamaConfig.preset("debug")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    batch = jnp.asarray(np.random.default_rng(7).integers(
+        0, cfg.vocab_size, (8, 17), dtype=np.int32))
+
+    local = Trainer(cfg, optax.adamw(1e-3), params, donate=False,
+                    accum_steps=2)
+    mesh = make_mesh({"fsdp": 4})
+    sharded = Trainer(cfg, optax.adamw(1e-3), params, donate=False,
+                      mesh=mesh, fsdp_axis="fsdp", accum_steps=2)
+    l1 = local.step_sync(batch)
+    l2 = sharded.step_sync(batch)
+    np.testing.assert_allclose(l2, l1, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(local.state.params),
+                    jax.tree_util.tree_leaves(sharded.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=1e-3)
