@@ -13,7 +13,7 @@ the demo model for a Llama-3.1-style config — decoupled ``head_dim`` and
 end to end (hf_convert.py; VERDICT r3 #6).
 
 Usage:  python examples/serve_hf.py [--model DIR] [--max-new 12]
-        [--arch llama\|llama31\|qwen2\|mixtral\|gemma]
+        [--arch llama\|llama31\|qwen2\|mixtral\|gemma\|phi3]
 """
 
 import argparse
@@ -34,12 +34,13 @@ def main() -> None:
                          "(half the weight HBM; see ops/quantize.py)")
     ap.add_argument("--arch",
                     choices=["llama", "llama31", "qwen2", "mixtral",
-                             "gemma"],
+                             "gemma", "phi3"],
                     default="llama",
                     help="demo-model flavour: llama31 = decoupled head_dim "
                          "+ llama3 rope scaling; qwen2 = q/k/v projection "
                          "biases; mixtral = SwiGLU top-2 MoE experts; "
-                         "gemma = GeGLU + (1+w) norms + scaled embeddings")
+                         "gemma = GeGLU + (1+w) norms + scaled embeddings; "
+                         "phi3 = fused qkv/gate_up projections")
     args = ap.parse_args()
 
     import jax
@@ -57,7 +58,7 @@ def main() -> None:
 
     if args.model:
         # Auto class: real checkpoints of every served family (Llama,
-        # Mistral, Qwen2, Mixtral, Gemma) load through their own
+        # Mistral, Qwen2, Mixtral, Gemma, Phi-3) load through their own
         # architecture.
         hf = transformers.AutoModelForCausalLM.from_pretrained(args.model)
     else:
@@ -78,6 +79,12 @@ def main() -> None:
             # Gemma-style: GeGLU, (1+w) norms, sqrt(d)-scaled embeddings.
             hf = transformers.GemmaForCausalLM(transformers.GemmaConfig(
                 **dims, head_dim=32))
+        elif args.arch == "phi3":
+            # Phi-3-style: fused qkv_proj + gate_up_proj, split at
+            # conversion.  (Phi3Config's default pad_token_id needs
+            # vocab > 32000.)
+            hf = transformers.Phi3ForCausalLM(transformers.Phi3Config(
+                **{**dims, "vocab_size": 33000}))
         else:
             extra = {}
             if args.arch == "llama31":

@@ -1,14 +1,16 @@
 """HuggingFace Llama-family checkpoint -> starway-tpu parameter tree.
 
-Bridges the ecosystem's weights into this framework — five served
+Bridges the ecosystem's weights into this framework — six served
 families: ``transformers.LlamaForCausalLM``, ``MistralForCausalLM``
 (sliding-window attention -> ``LlamaConfig.sliding_window``),
 ``Qwen2ForCausalLM`` (q/k/v projection biases ->
 ``cfg.attn_bias``/``bq``/``bk``/``bv`` leaves), ``MixtralForCausalLM``
 (SwiGLU top-2 MoE experts -> ``cfg.moe_swiglu``, dropless conversion
-capacity), and ``GemmaForCausalLM`` (GeGLU -> ``cfg.mlp_act``, the
+capacity), ``GemmaForCausalLM`` (GeGLU -> ``cfg.mlp_act``, the
 (1 + w) RMSNorm convention folded into the converted weights,
-sqrt(d_model)-scaled embeddings -> ``cfg.scaled_embed``) — all into the
+sqrt(d_model)-scaled embeddings -> ``cfg.scaled_embed``), and
+``Phi3ForCausalLM`` (fused ``qkv_proj``/``gate_up_proj`` row-sliced into
+separate projections at conversion) — all into the
 stacked-layer pytree ``models/llama.py`` trains and serves;
 ``config_from_hf`` derives the matching :class:`LlamaConfig`, including
 modern variants with decoupled ``head_dim`` and linear/llama3
@@ -94,6 +96,11 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
                 f"use_sliding_window with max_window_layers={mwl} windows "
                 f"only layers past it; this config represents a single "
                 "global sliding_window")
+    prf = getattr(hf_config, "partial_rotary_factor", None)
+    if prf is not None and float(prf) != 1.0:
+        raise NotImplementedError(
+            f"partial_rotary_factor={prf} rotates only part of each head; "
+            "this tree applies rope to the full head dim")
     # Newer HF configs may pin an explicit per-head dim decoupled from
     # hidden_size // num_attention_heads; llama.py keys every
     # projection/reshape off cfg.head_dim, so the override carries it.
@@ -242,10 +249,44 @@ def params_from_hf(model_or_state: Any, cfg: LlamaConfig, dtype=None, *,
 
     L = cfg.n_layers
     stack = lambda fn: jnp.asarray(np.stack([fn(i) for i in range(L)]), dt)
+    fused = prefix + "layers.0.self_attn.qkv_proj.weight" in state
+    if fused:
+        if (prefix + "layers.0.self_attn.qkv_proj.bias" in state
+                or prefix + "layers.0.mlp.gate_up_proj.bias" in state):
+            # Same loud-refusal contract as the split-projection bias
+            # probes below: silently dropping a bias is a wrong model.
+            raise NotImplementedError(
+                "fused qkv_proj/gate_up_proj biases are not represented "
+                "in this parameter tree; converting would silently drop "
+                "them")
+        # Phi-3 family: one fused qkv_proj [(Hq + 2*Hkv) * hd, D] — slice
+        # the OUT rows (HF [out, in]) into q/k/v before the transpose.
+        # Convert each fused tensor to f32 numpy ONCE and slice the cached
+        # copy (three fresh .float().numpy() copies per layer would 3x the
+        # conversion scratch the module docstring bounds).
+        nq = cfg.n_heads * cfg.head_dim
+        nkv = cfg.n_kv_heads * cfg.head_dim
+
+        def qkv_split(i):
+            w = _np(get(f"layers.{i}.self_attn.qkv_proj.weight"))
+            # .copy(): a view would pin the whole fused matrix until the
+            # final stack (L of them at once).
+            return (w[0:nq].T.copy(), w[nq:nq + nkv].T.copy(),
+                    w[nq + nkv:nq + 2 * nkv].T.copy())
+
+        qkv = [qkv_split(i) for i in range(L)]
+        wq = jnp.asarray(np.stack([q for q, _, _ in qkv]), dt)
+        wk = jnp.asarray(np.stack([k for _, k, _ in qkv]), dt)
+        wv = jnp.asarray(np.stack([v for _, _, v in qkv]), dt)
+        del qkv
+    else:
+        wq = stack(lambda i: _t(get(f"layers.{i}.self_attn.q_proj.weight")))
+        wk = stack(lambda i: _t(get(f"layers.{i}.self_attn.k_proj.weight")))
+        wv = stack(lambda i: _t(get(f"layers.{i}.self_attn.v_proj.weight")))
     layers = {
-        "wq": stack(lambda i: _t(get(f"layers.{i}.self_attn.q_proj.weight"))),
-        "wk": stack(lambda i: _t(get(f"layers.{i}.self_attn.k_proj.weight"))),
-        "wv": stack(lambda i: _t(get(f"layers.{i}.self_attn.v_proj.weight"))),
+        "wq": wq,
+        "wk": wk,
+        "wv": wv,
         "wo": stack(lambda i: _t(get(f"layers.{i}.self_attn.o_proj.weight"))),
         "attn_norm": stack(lambda i: _norm_w(
             get(f"layers.{i}.input_layernorm.weight"), norm_plus_one)),
@@ -272,6 +313,24 @@ def params_from_hf(model_or_state: Any, cfg: LlamaConfig, dtype=None, *,
             "w_in": estack("w3"),
             "w_out": estack("w2"),
         }
+    elif fused:
+        # Phi-3's fused gate_up_proj [2F, D]: first F rows gate, last F up
+        # (Phi3MLP chunks dim -1 after the matmul, gate first).  One f32
+        # conversion per layer, sliced cached.
+        F = cfg.d_ff
+
+        def gu_split(i):
+            w = _np(get(f"layers.{i}.mlp.gate_up_proj.weight"))
+            return w[:F].T.copy(), w[F:2 * F].T.copy()
+
+        gu = [gu_split(i) for i in range(L)]
+        layers.update(
+            w_gate=jnp.asarray(np.stack([g for g, _ in gu]), dt),
+            w_up=jnp.asarray(np.stack([u for _, u in gu]), dt),
+            w_down=stack(
+                lambda i: _t(get(f"layers.{i}.mlp.down_proj.weight"))),
+        )
+        del gu
     else:
         layers.update(
             w_gate=stack(lambda i: _t(get(f"layers.{i}.mlp.gate_proj.weight"))),

@@ -362,3 +362,37 @@ def test_mistral_logits_and_generation_match_transformers():
     # transformers may stop early at its default eos; tokens must agree on
     # the prefix it produced.
     np.testing.assert_array_equal(ours_gen[:, :hf_gen.shape[1]], hf_gen)
+
+
+def test_phi3_logits_and_generation_match_transformers():
+    """Phi-3 (a sixth served family): fused qkv_proj / gate_up_proj split
+    into this tree's separate projections at conversion — logits and
+    greedy generation match transformers' Phi3ForCausalLM.  (Phi3Config's
+    default pad_token_id forces vocab > 32000.)"""
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=33000, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=False, attn_implementation="eager")
+    torch.manual_seed(17)
+    hf = transformers.Phi3ForCausalLM(hf_cfg).eval()
+
+    cfg = config_from_hf(hf.config, dtype="float32")
+    params = params_from_hf(hf, cfg)
+    assert params["layers"]["wq"].shape == (2, 64, 64)
+    assert params["layers"]["w_gate"].shape == (2, 64, 112)
+
+    tokens = np.random.default_rng(8).integers(0, 1000, (2, 14),
+                                               dtype=np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=2e-3)
+
+    prompt = np.asarray([[5, 9, 3]], dtype=np.int64)
+    with torch.no_grad():
+        hf_gen = hf.generate(torch.from_numpy(prompt), max_new_tokens=8,
+                             do_sample=False, pad_token_id=0).numpy()
+    ours_gen = np.asarray(generate(params, cfg,
+                                   jnp.asarray(prompt, jnp.int32), 8))
+    np.testing.assert_array_equal(ours_gen[:, :hf_gen.shape[1]], hf_gen)
