@@ -396,3 +396,28 @@ def test_phi3_logits_and_generation_match_transformers():
     ours_gen = np.asarray(generate(params, cfg,
                                    jnp.asarray(prompt, jnp.int32), 8))
     np.testing.assert_array_equal(ours_gen[:, :hf_gen.shape[1]], hf_gen)
+
+
+def test_qwen2_all_layers_windowed_matches_transformers():
+    """Qwen2 with use_sliding_window=True and max_window_layers=0: every
+    layer windowed, which IS expressible as a global cfg.sliding_window —
+    conversion keeps it and logits match transformers (window longer than
+    some prompts and shorter than others: both mask regimes hit)."""
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        use_sliding_window=True, sliding_window=6, max_window_layers=0,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        attn_implementation="eager")
+    torch.manual_seed(21)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+
+    cfg = config_from_hf(hf.config, dtype="float32")
+    assert cfg.sliding_window == 6 and cfg.attn_bias
+    params = params_from_hf(hf, cfg)
+    tokens = np.random.default_rng(9).integers(0, 256, (2, 20),
+                                               dtype=np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
