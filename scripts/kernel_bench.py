@@ -604,6 +604,12 @@ def bench_serve(batch=1, model="llama", ragged=False, prompt_len=512,
         # Window < max_len: the aligned path decodes through the rolling
         # O(window) cache (bit-identical to full-cache, pinned by tests).
         kw["sliding_window"] = prompt_len
+    elif model == "mixtral":
+        # Dropless top-2 SwiGLU MoE (the Mixtral conversion shape): the
+        # per-token weight stream is the experts', so MoE decode tok/s is
+        # its own bandwidth regime.
+        kw.update(n_experts=8, moe_top_k=2, moe_swiglu=True,
+                  moe_capacity_factor=8.0, d_ff=1408)
     cfg = LlamaConfig.preset("debug", **kw)
     params = init_params(jax.random.PRNGKey(0), cfg)
     if weights == "int8":
@@ -897,6 +903,7 @@ BENCHES = {
     "gemv_int8": bench_gemv_int8,
     "serve_ragged_b8": functools.partial(bench_serve, batch=8, ragged=True),
     "serve_mistral": functools.partial(bench_serve, model="mistral"),
+    "serve_mixtral": functools.partial(bench_serve, model="mixtral"),
     "serve_continuous": bench_serve_continuous,
     "serve_prefix": bench_serve_prefix,
     "spec_verify": bench_spec_verify,

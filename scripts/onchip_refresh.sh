@@ -79,6 +79,7 @@ run train_mfu        train_step_mfu
 run serve            serve_llama_b1_tokens_per_s        # end-to-end generate() tok/s (VERDICT r3 #4)
 run serve_b8         serve_llama_b8_tokens_per_s
 run serve_mistral    serve_mistral_b1_tokens_per_s      # rolling O(window) cache path
+run serve_mixtral    serve_mixtral_b1_tokens_per_s      # dropless top-2 MoE decode
 run serve_ragged_b8  serve_llama_ragged_b8_tokens_per_s # mixed prompt lengths
 run serve_continuous serve_continuous_tokens_per_s      # wall-clock through slot reuse
 run decode_int8      decode_int8_us_per_token           # half-width int8 cache stream
