@@ -74,7 +74,7 @@ else
   rm -f "$tmp"
 fi
 
-run check            check_flash_fwd_onchip             # 6 on-chip numerics rows
+run check            check_flash_fwd_onchip             # 9 on-chip numerics rows
 run train_mfu        train_step_mfu
 run serve            serve_llama_b1_tokens_per_s        # end-to-end generate() tok/s (VERDICT r3 #4)
 run serve_b8         serve_llama_b8_tokens_per_s

@@ -26,7 +26,10 @@ from .pp_llama import (
     shard_ppv_params,
 )
 from .beam import generate_beam
+from .generate import (generate, init_cache, init_rolling_cache, prefill,
+                       prefill_rolling)
 from .serving import SlotServer
+from .trainer import Trainer
 from .speculative import (chunk_decode_step, draft_from_truncation,
                           generate_lookup, generate_speculative)
 
@@ -48,6 +51,12 @@ __all__ = [
     "ppv_merge_params",
     "shard_ppv_params",
     "SlotServer",
+    "Trainer",
+    "generate",
+    "init_cache",
+    "init_rolling_cache",
+    "prefill",
+    "prefill_rolling",
     "chunk_decode_step",
     "draft_from_truncation",
     "generate_beam",
