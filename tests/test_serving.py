@@ -288,3 +288,34 @@ def test_family_configs_serve_continuously(flavour):
         np.testing.assert_array_equal(
             done[rid], _oracle(fparams, fcfg, prompt, max_new),
             err_msg=f"{flavour} request {rid}")
+
+
+def test_fuzz_request_stream_with_prefixes(cfg, params):
+    """Randomised stream: random lengths/budgets, random prefix reuse,
+    staggered submission between steps — every request still matches its
+    generate(prefix + suffix) oracle (the serving analogue of the engine
+    fuzz tests)."""
+    rng = np.random.default_rng(1234)
+    srv = SlotServer(params, cfg, n_slots=3, max_len=64, chunk=3)
+    pres = [list(rng.integers(1, cfg.vocab_size, int(n)))
+            for n in rng.integers(2, 12, 3)]
+    pids = [srv.register_prefix(p) for p in pres]
+
+    want, done = {}, {}
+    for i in range(14):
+        which = int(rng.integers(-1, 3))  # -1 = no prefix
+        suffix = list(rng.integers(1, cfg.vocab_size, int(rng.integers(1, 8))))
+        max_new = int(rng.integers(1, 9))
+        pre = [] if which < 0 else pres[which]
+        rid = srv.submit(suffix, max_new,
+                         prefix=None if which < 0 else pids[which])
+        want[rid] = (pre + suffix, max_new)
+        if rng.random() < 0.5:
+            done.update(srv.step())  # stagger admissions mid-flight
+    done.update(srv.run())
+
+    assert sorted(done) == sorted(want)
+    for rid, (full, max_new) in want.items():
+        np.testing.assert_array_equal(
+            done[rid], _oracle(params, cfg, full, max_new),
+            err_msg=f"request {rid} (P={len(full)}, N={max_new})")
