@@ -487,3 +487,24 @@ def test_sampled_speculative_preserves_target_distribution():
     assert tvd_draft > tvd_target + 0.05, (
         f"output tracks the draft ({tvd_draft:.3f}) rather than the "
         f"target ({tvd_target:.3f})")
+
+
+@pytest.mark.parametrize("flavour", ["qwen2", "gemma"])
+def test_family_configs_speculate(flavour):
+    """The family knobs (Qwen2 projection biases; Gemma GeGLU + scaled
+    embeddings) flow through the speculative chunk verify: greedy
+    self-draft output is identical to generate()."""
+    kw = (dict(attn_bias=True) if flavour == "qwen2"
+          else dict(mlp_act="gelu_tanh", scaled_embed=True))
+    fcfg = LlamaConfig.preset("debug", **kw)
+    fparams = init_params(jax.random.PRNGKey(7), fcfg)
+    if flavour == "qwen2":
+        fparams["layers"]["bq"] = 0.3 * jax.random.normal(
+            jax.random.PRNGKey(8), fparams["layers"]["bq"].shape)
+    prompt = jnp.asarray(np.random.default_rng(7).integers(
+        1, fcfg.vocab_size, (2, 6), dtype=np.int32))
+    ref = generate(fparams, fcfg, prompt, 9)
+    spec = generate_speculative(fparams, fcfg, fparams, fcfg, prompt, 9,
+                                gamma=4)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec),
+                                  err_msg=flavour)
