@@ -11,12 +11,34 @@
 #
 # Usage:  bash scripts/onchip_refresh.sh [outfile]     (default /tmp/onchip_rows.json)
 #         FORCE=1 re-measures everything regardless of existing rows.
+#         REHEARSAL=1 runs every row's exact command on CPU with scaled
+#         budgets (kernel_bench.REHEARSAL_KW) — the pre-flight that proves
+#         no row can zero out a live tunnel window with a shape bug
+#         (VERDICT r4 #3).  Default outfile /tmp/rehearsal_rows.json.
 set -u
 cd "$(dirname "$0")/.."
-OUT="${1:-/tmp/onchip_rows.json}"
+REHEARSAL="${REHEARSAL:-0}"
+if [ "$REHEARSAL" = "1" ]; then
+  export STARWAY_BENCH_REHEARSAL=1 STARWAY_BENCH_CPU=1
+  OUT="${1:-/tmp/rehearsal_rows.json}"
+else
+  OUT="${1:-/tmp/onchip_rows.json}"
+fi
 touch "$OUT"
 
 probe() {
+  if [ "$REHEARSAL" = "1" ]; then
+    # timeout matters here too: sitecustomize registers the tunnel backend
+    # before the heredoc's config.update can run, and a wedged tunnel can
+    # hang interpreter/jax init itself.
+    timeout 90 python - <<'PY' 2>/dev/null || { echo "CPU jax unusable; aborting" >&2; exit 1; }
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as j
+float((j.ones(4) + 1).sum())
+PY
+    return
+  fi
   timeout 90 python -c "import jax, jax.numpy as j; float((j.ones(4)+1).sum())" \
     2>/dev/null || { echo "device backend unresponsive; aborting" >&2; exit 1; }
 }
@@ -64,7 +86,10 @@ else
   # bench.py's own watchdogs can burn 480s (device) + 240s (CPU retry);
   # the outer timeout must sit above that sum or the fallback dies unreported.
   timeout 780 python bench.py >"$tmp" 2>/dev/null
-  if grep -q vs_baseline "$tmp" && ! grep -q 'CPU FALLBACK\|FAILED' "$tmp"; then
+  # Rehearsal runs pipeline-validate on CPU: the FALLBACK label is the
+  # expected outcome there, not a failure.
+  if [ "$REHEARSAL" = "1" ]; then ok_filter='FAILED'; else ok_filter='CPU FALLBACK\|FAILED'; fi
+  if grep -q vs_baseline "$tmp" && ! grep -q "$ok_filter" "$tmp"; then
     tee -a "$OUT" < "$tmp"
     # Marker row so resume can see the prose-named headline landed.
     echo '{"metric": "driver_headline", "value": 1, "unit": "done"}' >> "$OUT"

@@ -90,6 +90,14 @@ class LlamaConfig:
     # train_step_mfu >= 0.40 target) at O(S * D) extra saved bytes per
     # layer.
     remat_policy: Optional[str] = None
+    # Layer iteration: True scans one compiled body over the stacked layer
+    # tree (constant compile time at any depth); False unrolls a Python
+    # loop over per-layer slices, which lets XLA schedule across layer
+    # boundaries at the cost of depth-proportional compile time.  The
+    # chunked "dots" remat is recompute-free under BOTH (pinned in
+    # tests/test_remat_policy.py); scanned is the default and the
+    # MFU-bench setting.
+    scan_layers: bool = True
     # RoPE frequency scaling, as a hashable tuple (configs key jit caches):
     #   ("linear", factor)  — all frequencies divided by factor;
     #   ("llama3", factor, low_freq_factor, high_freq_factor,
@@ -380,17 +388,18 @@ def token_ce(logits, targets):
 
 
 def _remat_wrap(layer, cfg: "LlamaConfig"):
-    """The one remat site: full-layer checkpoint, or the "dots" policy —
-    save no-batch-dim matmul outputs plus the attention output (tagged
-    ``attn_out``), so the backward replays only the elementwise chain
-    instead of re-running every matmul and the flash kernel forward."""
+    """Full-layer remat only.  The "dots" policy is NOT applied here: a
+    jax.checkpoint policy that marks the q/k/v projection dots saveable
+    around a pallas custom_vjp makes jax's partial-eval replay the flash
+    forward kernel in the backward anyway (observed on jax 0.9; pinned in
+    tests/test_remat_policy.py), so "dots" is implemented structurally
+    inside :func:`decoder_layer` — two checkpointed chunks around an
+    un-checkpointed attention call — rather than as a policy over the
+    whole layer body."""
     if not cfg.remat:
         return layer
     if cfg.remat_policy == "dots":
-        policy = jax.checkpoint_policies.save_from_both_policies(
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            jax.checkpoint_policies.save_only_these_names("attn_out"))
-        return jax.checkpoint(layer, policy=policy)
+        return layer  # chunked checkpointing lives inside decoder_layer
     return jax.checkpoint(layer)
 
 
@@ -495,46 +504,87 @@ def decoder_layer(lp, h, cfg: LlamaConfig, cos, sin,
     pipeline-parallel stage body (models/pp_llama.py)."""
     B, S, _ = h.shape
     hd = cfg.head_dim
-    x = rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
-    q, k, v = qkv_proj(x, lp, cfg)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
-    # kv stays in grouped (narrow) form; attention impls expand it, so
-    # the ring rotates 1/n_rep of the bytes over ICI.
-    o = attn_fn(q, k, v)  # [B, H, S, Dh]
-    # Tag for the "dots" remat policy: saving the kernel output means the
-    # backward never re-runs the flash forward (see _remat_wrap).
-    o = checkpoint_name(o, "attn_out")
-    o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_heads * hd)
-    h = h + matmul_w(o, lp["wo"])
+    # "dots" remat is CHUNKED: two checkpointed regions around an
+    # un-checkpointed attention call.  A whole-layer jax.checkpoint with a
+    # dots-saveable policy silently replays the flash forward kernel in the
+    # backward (jax 0.9 partial-eval; pinned in tests/test_remat_policy.py),
+    # while this structure provably does not: the pre chunk's saved
+    # boundary IS (q, k, v), the attention custom_vjp's residuals
+    # (q, k, v, o, lse) ride the scan as usual, and the post chunk
+    # name-saves only the gate/up dots — the backward replays nothing but
+    # norms, rope, and silu.
+    chunked = cfg.remat and cfg.remat_policy == "dots"
 
-    x = rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
-    stats = None
-    if cfg.n_experts > 0:
-        if moe_fn is not None:
-            # SwiGLU expert trees carry w_gate; pass it only when present
-            # so 4-arg moe_fns (Switch-style) keep working unchanged.
-            kw = ({"w_gate": lp["moe"]["w_gate"]} if "w_gate" in lp["moe"]
-                  else {})
-            out = moe_fn(
-                x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"],
-                **kw)
-            y, aux = out[0], out[1]
-            if len(out) > 2:  # with_stats moe_fn: router-health metrics
-                stats = out[2]
+    def pre(h, lp):
+        x = rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = qkv_proj(x, lp, cfg)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # kv stays in grouped (narrow) form; attention impls expand it, so
+        # the ring rotates 1/n_rep of the bytes over ICI.
+        return q, k, v
+
+    def post(h, o, lp):
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_heads * hd)
+        h = h + matmul_w(o, lp["wo"])
+
+        x = rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
+        stats = None
+        if cfg.n_experts > 0:
+            if moe_fn is not None:
+                # SwiGLU expert trees carry w_gate; pass it only when
+                # present so 4-arg moe_fns (Switch-style) keep working.
+                kw = ({"w_gate": lp["moe"]["w_gate"]}
+                      if "w_gate" in lp["moe"] else {})
+                out = moe_fn(
+                    x, lp["moe"]["router"], lp["moe"]["w_in"],
+                    lp["moe"]["w_out"], **kw)
+                y, aux = out[0], out[1]
+                if len(out) > 2:  # with_stats moe_fn: router-health metrics
+                    stats = out[2]
+            else:
+                from .moe import switch_moe
+
+                y, aux = switch_moe(
+                    x, lp["moe"]["router"], lp["moe"]["w_in"],
+                    lp["moe"]["w_out"],
+                    capacity_factor=cfg.moe_capacity_factor,
+                    k=cfg.moe_top_k, w_gate=lp["moe"].get("w_gate"),
+                )
+            h = h + y
         else:
-            from .moe import switch_moe
+            g = checkpoint_name(matmul_w(x, lp["w_gate"]), "mlp_gate")
+            u = checkpoint_name(matmul_w(x, lp["w_up"]), "mlp_up")
+            gate = mlp_gate_act(g, cfg).astype(x.dtype)
+            h = h + matmul_w(gate * u, lp["w_down"])
+            aux = jnp.zeros((), jnp.float32)
+        return h, aux, stats
 
-            y, aux = switch_moe(
-                x, lp["moe"]["router"], lp["moe"]["w_in"], lp["moe"]["w_out"],
-                capacity_factor=cfg.moe_capacity_factor, k=cfg.moe_top_k,
-                w_gate=lp["moe"].get("w_gate"),
-            )
-        h = h + y
-    else:
-        gate = mlp_gate_act(matmul_w(x, lp["w_gate"]), cfg).astype(x.dtype)
-        h = h + matmul_w(gate * matmul_w(x, lp["w_up"]), lp["w_down"])
-        aux = jnp.zeros((), jnp.float32)
+    if chunked:
+        # pre: boundary outputs (q, k, v) are saved by construction; the
+        # backward replays only rmsnorm + rope (the projection dot outputs
+        # are not themselves backward inputs).  post: gate/up dots saved
+        # by name (silu's vjp and dW_down need them); every other matmul
+        # output in the chunk is not a backward input, so the replay is
+        # elementwise.  No pallas call sits inside either region, so the
+        # policy pathology above cannot trigger.  MoE layers keep their
+        # dispatch collectives inside post — replayed in the backward,
+        # matching the pre-chunking "dots" behavior — while expert dot
+        # outputs are saved via dots_with_no_batch_dims.
+        pre = jax.checkpoint(pre)
+        post = jax.checkpoint(
+            post,
+            policy=jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "mlp_gate", "mlp_up")))
+
+    q, k, v = pre(h, lp)
+    o = attn_fn(q, k, v)  # [B, H, S, Dh]
+    # Tag kept for user-supplied whole-model remat policies; the flash
+    # kernel additionally tags o and lse internally (pallas_attention).
+    o = checkpoint_name(o, "attn_out")
+    h, aux, stats = post(h, o, lp)
     return h, aux, k, v, stats
 
 
@@ -596,8 +646,21 @@ def forward(params: dict, tokens, cfg: LlamaConfig,
                                       stats if return_moe_stats else None)
 
     body = _remat_wrap(layer, cfg)
-    (h, aux), (kv, moe_stats) = lax.scan(
-        body, (h, jnp.zeros((), jnp.float32)), params["layers"])
+    if cfg.scan_layers:
+        (h, aux), (kv, moe_stats) = lax.scan(
+            body, (h, jnp.zeros((), jnp.float32)), params["layers"])
+    else:
+        # Unrolled: same body, Python loop over layer slices; per-layer
+        # outputs are stacked to match the scan's [n_layers, ...] layout.
+        carry = (h, jnp.zeros((), jnp.float32))
+        ys = []
+        for i in range(cfg.n_layers):
+            lp = jax.tree_util.tree_map(lambda x: x[i], params["layers"])
+            carry, y = body(carry, lp)
+            ys.append(y)
+        h, aux = carry
+        kv, moe_stats = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *ys)
     if last_only:
         h = h[:, -1:]
     elif logit_positions is not None:

@@ -51,6 +51,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -779,16 +780,26 @@ def flash_partial_bwd(q, do, k, v, lse, delta, q_offset, kv_offset, *,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash(q, k, v, cfg: _Cfg):
-    return _fwd_impl(q, k, v, cfg, save_lse=False)
+    # lse is a PRIMAL output (not just a vjp residual), tagged here so that
+    # llama.py's "dots" remat policy (save attn_out + attn_lse) makes every
+    # backward residual a subset of {inputs} ∪ {saved outputs} — the layer
+    # backward then never re-runs this kernel.  With lse residual-only (the
+    # pre-round-5 design), jax.checkpoint had to replay the forward kernel
+    # inside every rematted layer just to regenerate lse, silently costing
+    # a full extra flash forward per layer per step.  The extra [B*H, S, 8]
+    # f32 store in inference paths is noise next to the O(S^2) compute.
+    o, lse = _fwd_impl(q, k, v, cfg, save_lse=True)
+    return checkpoint_name(o, "attn_out"), checkpoint_name(lse, "attn_lse")
 
 
 def _flash_fwd(q, k, v, cfg: _Cfg):
-    o, lse = _fwd_impl(q, k, v, cfg, save_lse=True)
-    return o, (q, k, v, o, lse)
+    o, lse = _flash(q, k, v, cfg)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd(cfg: _Cfg, res, do):
+def _flash_bwd(cfg: _Cfg, res, cts):
     q, k, v, o, lse = res
+    do, _dlse = cts  # lse is an aux statistic; its cotangent is discarded
     return _bwd_impl(q, k, v, o, lse, do, cfg)
 
 
@@ -847,4 +858,5 @@ def flash_attention(
         interpret=bool(interpret),
         window=None if window is None else int(window),
     )
-    return _flash(q, k, v, cfg)
+    o, _lse = _flash(q, k, v, cfg)
+    return o

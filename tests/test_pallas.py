@@ -77,7 +77,7 @@ def test_flash_grad_uneven_blocks():
     v = jax.random.normal(k3, (B, H, S, D), jnp.float32)
     cfg = _Cfg(causal=True, sm_scale=1.0 / D**0.5, block_q=64, block_k=128,
                bwd_block_q=128, bwd_block_k=64, interpret=True)
-    g = jax.grad(lambda *a: _flash(*a, cfg).sum(), argnums=(0, 1, 2))(q, k, v)
+    g = jax.grad(lambda *a: _flash(*a, cfg)[0].sum(), argnums=(0, 1, 2))(q, k, v)
     g_ref = jax.grad(
         lambda *a: blockwise_attention(*a, causal=True).sum(),
         argnums=(0, 1, 2))(q, k, v)
