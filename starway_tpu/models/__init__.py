@@ -28,6 +28,7 @@ from .pp_llama import (
 from .beam import generate_beam
 from .generate import (generate, init_cache, init_rolling_cache, prefill,
                        prefill_rolling)
+from .remote_serving import RemoteGenerateSession, RemoteSlotServer
 from .serving import SlotServer
 from .trainer import Trainer
 from .speculative import (chunk_decode_step, draft_from_truncation,
@@ -50,6 +51,8 @@ __all__ = [
     "ppv_split_params",
     "ppv_merge_params",
     "shard_ppv_params",
+    "RemoteGenerateSession",
+    "RemoteSlotServer",
     "SlotServer",
     "Trainer",
     "generate",

@@ -1,0 +1,324 @@
+"""Serving over the transport: tagged requests in, token streams out.
+
+The repo's two halves meet here (VERDICT r4 #2): the async tag-matched
+P2P transport — the reference's actual product surface
+(/root/reference/src/bindings/main.cpp:370,1172 — tag send/recv over
+endpoint connections) — carries the serving stack's actual workload.
+Requests arrive as tagged messages on a :class:`~starway_tpu.Server`,
+:class:`~starway_tpu.models.serving.SlotServer` admits them into its
+continuous batch, and each request's tokens stream back per decode chunk
+over the same connection.  Works over every data plane behind the one
+worker contract (in-process, TCP, shared-memory rings, the C++ engine) —
+pinned by tests/test_serve_remote.py's transport matrix.
+
+Wire protocol — all payloads are little-endian int32 arrays; the 64-bit
+tag's top byte is the message type (tag routing, reference-style):
+
+====== ========= ================ =======================================
+type   direction tag              payload
+====== ========= ================ =======================================
+0xA1   S -> C    ASSIGN           [client_id] — sent on accept; the
+                                  client's identity for request tags
+0xA2   C -> S    REQUEST | cid    [nonce, max_new, n, prompt x n]
+0xA3   S -> C    TOKENS | nonce   [nonce, done, count, tokens x count]
+====== ========= ================ =======================================
+
+Routing: the matcher reports a completed wildcard recv's SENDER TAG, not
+its endpoint, so the request tag carries the server-assigned client_id
+(low 32 bits) and the bridge maps it back to the accepted endpoint.  The
+token stream needs no client id in its tag — it rides the requesting
+client's own connection — so the low bits carry the client-chosen nonce,
+letting one client run many concurrent generates.
+
+The per-chunk TOKENS messages for one request are FIFO on one
+connection (the engine preserves per-connection send order), so the
+client just accumulates until ``done``.  Send completion is local
+(CLAUDE.md contract); no flush is needed for streaming — a dead client
+fails the pending sends, which the bridge logs and drops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ..api import Client, Server
+from .serving import SlotServer
+
+logger = logging.getLogger("starway.serve_remote")
+
+TAG_TYPE_SHIFT = 56
+TAG_ASSIGN = 0xA1 << TAG_TYPE_SHIFT
+TAG_REQUEST = 0xA2 << TAG_TYPE_SHIFT
+TAG_TOKENS = 0xA3 << TAG_TYPE_SHIFT
+TYPE_MASK = 0xFF << TAG_TYPE_SHIFT
+FULL_MASK = (1 << 64) - 1
+_ID_MASK = (1 << 32) - 1
+
+
+def _wire(words) -> np.ndarray:
+    """int32 payload -> the uint8 byte view the transport sends."""
+    return np.ascontiguousarray(np.asarray(words, np.int32)).view(np.uint8)
+
+
+def _recv_buf(n_words: int) -> np.ndarray:
+    """Receive target (the transport requires uint8); read back with
+    ``buf.view(np.int32)``."""
+    return np.empty(4 * n_words, np.uint8)
+
+
+class RemoteSlotServer:
+    """Serve a :class:`SlotServer` behind a transport :class:`Server`.
+
+    >>> bridge = RemoteSlotServer(slot_server)
+    >>> bridge.server.listen("127.0.0.1", port)
+    >>> await bridge.serve()            # until bridge.stop() from a task
+
+    Request ingestion is callback-chained on the engine thread (each
+    completed wildcard recv immediately re-posts); the asyncio drive loop
+    drains them into ``SlotServer.submit`` and advances decode chunks in
+    an executor so the event loop keeps absorbing arrivals while the
+    device computes.  Token emission rides ``SlotServer.on_tokens``.
+    """
+
+    def __init__(self, slot_server: SlotServer, server: Optional[Server] = None,
+                 *, max_prompt_tokens: int = 8192):
+        if slot_server.on_tokens is not None:
+            raise ValueError("slot_server.on_tokens is already claimed")
+        slot_server.on_tokens = self._on_tokens
+        self.slot = slot_server
+        self.server = server if server is not None else Server()
+        self.max_prompt_tokens = int(max_prompt_tokens)
+        self._eps: dict[int, object] = {}      # client_id -> endpoint
+        self._next_cid = 1
+        self._rid_route: dict[int, tuple] = {}  # rid -> (cid, nonce)
+        self._emissions: list = []              # (rid, tokens, done)
+        self._requests: deque = deque()         # (sender_tag, payload copy)
+        self._unassigned: deque = deque()       # cids awaiting their ASSIGN
+        self._dead_cids: deque = deque()        # send-failed clients to drop
+        self._stopping = False
+        self._closed = False
+        self._recv_posted = False
+        self.server.set_accept_cb(self._on_accept)
+
+    # ------------------------------------------------- engine-thread side
+    def _on_accept(self, ep) -> None:
+        cid = self._next_cid
+        self._next_cid += 1
+        self._eps[cid] = ep
+        # The ASSIGN cannot be sent from here: on the in-process path the
+        # accept callback fires inline DURING the client's connect, before
+        # the client worker reaches RUNNING, and the send would die with
+        # "peer closed".  The serve loop flushes it (the client's
+        # register() recv waits however late it lands).
+        self._unassigned.append(cid)
+
+    def _post_request_recv(self) -> None:
+        buf = _recv_buf(3 + self.max_prompt_tokens)
+
+        def done(stag, length, buf=buf):
+            self._requests.append(
+                (int(stag), buf.view(np.int32)[:length // 4].copy()))
+            if not self._closed:
+                self._post_request_recv()
+
+        def fail(reason):
+            # Expected at close ("cancel..."); anything else (e.g. a
+            # truncated oversized request) is logged AND the recv is
+            # re-posted — a failed recv is consumed by the matcher, so
+            # without the re-post one bad request would permanently halt
+            # all intake.
+            if self._closed or "cancel" in reason:
+                return
+            logger.warning("request recv failed: %s", reason)
+            try:
+                self._post_request_recv()
+            except Exception:
+                pass  # worker shutting down
+
+        self.server.recv(buf, TAG_REQUEST, TYPE_MASK, done, fail)
+
+    def _on_tokens(self, rid: int, tokens: list, done: bool) -> None:
+        # Fires inside SlotServer.step() (executor thread); the drive
+        # loop flushes after the step returns, preserving order.
+        self._emissions.append((rid, tokens, done))
+
+    # --------------------------------------------------- loop-thread side
+    def _drop_dead_clients(self) -> None:
+        while self._dead_cids:
+            cid = self._dead_cids.popleft()
+            if self._eps.pop(cid, None) is not None:
+                logger.warning("dropping client %d (send failed)", cid)
+            for rid, (rcid, _nonce) in list(self._rid_route.items()):
+                if rcid == cid:
+                    del self._rid_route[rid]
+
+    def _flush_assigns(self) -> None:
+        while self._unassigned:
+            cid = self._unassigned.popleft()
+            ep = self._eps.get(cid)
+            if ep is None:
+                continue
+            self.server.send(
+                ep, _wire([cid]), TAG_ASSIGN,
+                lambda: None,
+                lambda reason, cid=cid: logger.warning(
+                    "assign to client %d failed: %s", cid, reason))
+
+    def _drain_requests(self) -> int:
+        n = 0
+        while self._requests:
+            stag, arr = self._requests.popleft()
+            cid = stag & _ID_MASK
+            if cid not in self._eps:
+                # No endpoint to reply over; the sender is gone or buggy.
+                logger.warning("request from unknown client id %d", cid)
+                continue
+            if len(arr) < 3 or len(arr) != 3 + int(arr[2]):
+                logger.warning("malformed request from client %d "
+                               "(%d words)", cid, len(arr))
+                if len(arr) >= 1:
+                    # The nonce survived: reject fatally instead of
+                    # leaving the client's generate() awaiting forever.
+                    self._send_chunk(cid, int(arr[0]), [], True)
+                continue
+            nonce, max_new, n_tok = int(arr[0]), int(arr[1]), int(arr[2])
+            try:
+                rid = self.slot.submit(arr[3:3 + n_tok], max_new)
+            except (ValueError, KeyError) as e:
+                # Reject without killing the serve loop: an empty, fatal
+                # "done" stream tells the client this request is over.
+                logger.warning("rejected request from client %d: %s",
+                               cid, e)
+                self._send_chunk(cid, nonce, [], True)
+                continue
+            self._rid_route[rid] = (cid, nonce)
+            n += 1
+        return n
+
+    def _send_chunk(self, cid: int, nonce: int, tokens: list,
+                    done: bool) -> None:
+        ep = self._eps.get(cid)
+        if ep is None:
+            return
+        def failed(reason, cid=cid):
+            # Engine-thread callback: only enqueue; the serve loop drops
+            # the endpoint and its routes (no cross-thread dict mutation).
+            logger.warning("token chunk to client %d failed: %s",
+                           cid, reason)
+            self._dead_cids.append(cid)
+
+        self.server.send(
+            ep, _wire([nonce, int(done), len(tokens), *tokens]),
+            TAG_TOKENS | nonce, lambda: None, failed)
+
+    def _flush_emissions(self) -> None:
+        emissions, self._emissions = self._emissions, []
+        for rid, tokens, done in emissions:
+            route = self._rid_route.get(rid)
+            if route is None:
+                continue
+            cid, nonce = route
+            self._send_chunk(cid, nonce, tokens, done)
+            if done:
+                del self._rid_route[rid]
+
+    async def serve(self, *, idle_sleep: float = 0.002) -> None:
+        """Drive until :meth:`stop` AND all in-flight work has drained.
+        The server must be listening (posting a recv needs a RUNNING
+        worker), so call ``bridge.server.listen(...)`` first."""
+        if not self._recv_posted:
+            self._post_request_recv()
+            self._recv_posted = True
+        loop = asyncio.get_running_loop()
+        while not (self._stopping and not self.slot.busy
+                   and not self._requests):
+            self._drop_dead_clients()
+            self._flush_assigns()
+            self._drain_requests()
+            if self.slot.busy:
+                await loop.run_in_executor(None, self.slot.step)
+                self._flush_emissions()
+            else:
+                await asyncio.sleep(idle_sleep)
+        self._flush_emissions()
+
+    def stop(self) -> None:
+        """Finish in-flight requests, then let serve() return."""
+        self._stopping = True
+
+    async def aclose(self) -> None:
+        self._closed = True
+        await self.server.aclose()
+
+
+class RemoteGenerateSession:
+    """Client-side counterpart: submit prompts, await token streams.
+
+    >>> session = await RemoteGenerateSession.aconnect(addr, port)
+    >>> tokens = await session.generate(prompt, max_new_tokens=32)
+
+    ``generate`` calls may run concurrently on one session (distinct
+    nonces route the streams); tokens accumulate per decode chunk, so
+    wrapping the recv loop yields true streaming if a caller wants it.
+    """
+
+    def __init__(self, client: Client):
+        self.client = client
+        self.client_id: Optional[int] = None
+        self._nonce = 0
+
+    @classmethod
+    async def aconnect(cls, addr: str, port: int) -> "RemoteGenerateSession":
+        client = Client()
+        await client.aconnect(addr, port)
+        session = cls(client)
+        await session.register()
+        return session
+
+    async def register(self) -> int:
+        """Receive the server-assigned client id (sent on accept)."""
+        buf = _recv_buf(1)
+        await self.client.arecv(buf, TAG_ASSIGN, FULL_MASK)
+        self.client_id = int(buf.view(np.int32)[0])
+        return self.client_id
+
+    async def generate(self, prompt, max_new_tokens: int,
+                       *, max_chunk_tokens: int = 4096,
+                       on_tokens=None) -> np.ndarray:
+        """Round-trip one request; returns the generated tokens.
+
+        ``on_tokens(list)``: optional per-chunk streaming callback."""
+        if self.client_id is None:
+            raise RuntimeError("call register() (or aconnect()) first")
+        nonce = self._nonce
+        self._nonce += 1
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        req = _wire(np.concatenate([
+            np.asarray([nonce, int(max_new_tokens), len(prompt)], np.int32),
+            prompt]))
+        await self.client.asend(req, TAG_REQUEST | self.client_id)
+        out: list = []
+        while True:
+            buf = _recv_buf(3 + max_chunk_tokens)
+            await self.client.arecv(buf, TAG_TOKENS | nonce, FULL_MASK)
+            words = buf.view(np.int32)
+            count, done = int(words[2]), bool(words[1])
+            chunk = [int(t) for t in words[3:3 + count]]
+            out.extend(chunk)
+            if chunk and on_tokens is not None:
+                on_tokens(chunk)
+            if done:
+                if not out:
+                    raise ValueError(
+                        "request rejected by the server (empty stream); "
+                        "check prompt/max_new against the server's "
+                        "max_len")
+                return np.asarray(out, np.int32)
+
+    async def aclose(self) -> None:
+        await self.client.aclose()

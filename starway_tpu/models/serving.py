@@ -285,7 +285,7 @@ class SlotServer:
                  max_len: int = 512, chunk: int = 8,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, eos_id: Optional[int] = None,
-                 prompt_buckets=None, seed: int = 0):
+                 prompt_buckets=None, seed: int = 0, on_tokens=None):
         from .moe import require_dropless
 
         # Cohabiting slots share the batch-wide expert capacity; only
@@ -338,6 +338,13 @@ class SlotServer:
         self._slot_rid: dict[int, int] = {}
         self._collected: dict[int, list] = {}
         self._prefixes: dict[int, tuple] = {}  # pid -> (small, plen)
+        # Streaming hook: ``on_tokens(rid, tokens, done)`` fires inside
+        # step() — once per request per step with that step's new tokens
+        # (done=False), and exactly once with ``([], True)`` when the
+        # request finishes.  The transport bridge
+        # (models/remote_serving.py) rides this to stream tokens over the
+        # wire without waiting for full completion.
+        self.on_tokens = on_tokens
         self._next_pid = 0
 
     # ------------------------------------------------------------ intake
@@ -466,6 +473,8 @@ class SlotServer:
         tok_host = int(tok)
         self._slot_rid[slot] = rid
         self._collected[rid] = [tok_host]
+        if self.on_tokens is not None:
+            self.on_tokens(rid, [tok_host], False)
         done = (max_new == 1 or
                 (self.eos_id is not None and tok_host == self.eos_id))
         self.token = self.token.at[slot].set(tok_host)
@@ -480,6 +489,8 @@ class SlotServer:
                 finished[rid] = np.asarray(self._collected.pop(rid),
                                            np.int32)
                 del self._slot_rid[slot]
+                if self.on_tokens is not None:
+                    self.on_tokens(rid, [], True)
 
     def step(self) -> dict:
         """Admit what fits, decode one chunk; returns {rid: tokens} for
@@ -504,14 +515,21 @@ class SlotServer:
         toks = np.asarray(toks)
         mask = np.asarray(mask)
         for slot, rid in self._slot_rid.items():
-            self._collected[rid].extend(
-                int(t) for t, m in zip(toks[:, slot], mask[:, slot]) if m)
+            new = [int(t) for t, m in zip(toks[:, slot], mask[:, slot]) if m]
+            self._collected[rid].extend(new)
+            if self.on_tokens is not None and new:
+                self.on_tokens(rid, new, False)
         self._harvest_dead(finished)
         return finished
+
+    @property
+    def busy(self) -> bool:
+        """True while any request is queued or occupying a slot."""
+        return bool(self._pending or self._slot_rid)
 
     def run(self) -> dict:
         """Drive step() until every submitted request has finished."""
         finished: dict = {}
-        while self._pending or self._slot_rid:
+        while self.busy:
             finished.update(self.step())
         return finished
