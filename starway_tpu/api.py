@@ -248,6 +248,13 @@ class Server:
     def evaluate_perf(self, client_ep: ServerEndpoint, msg_size: int) -> float:
         return self._server.evaluate_perf(client_ep._conn, msg_size)
 
+    def evaluate_perf_detail(self, client_ep: ServerEndpoint,
+                             msg_size: int) -> dict:
+        """:meth:`evaluate_perf` plus ``calibrated``/``source`` honesty
+        fields — a live per-endpoint fit, a live class fit, and a
+        spec-sheet prior all say which they are (perf.py)."""
+        return self._server.evaluate_perf_detail(client_ep._conn, msg_size)
+
     def __del__(self):
         try:
             self._server.force_close()
@@ -356,6 +363,12 @@ class Client:
     # ------------------------------------------------------------ telemetry
     def evaluate_perf(self, msg_size: int) -> float:
         return self._client.evaluate_perf(self._client.primary_conn, msg_size)
+
+    def evaluate_perf_detail(self, msg_size: int) -> dict:
+        """:meth:`evaluate_perf` plus ``calibrated``/``source`` honesty
+        fields (perf.py)."""
+        return self._client.evaluate_perf_detail(self._client.primary_conn,
+                                                 msg_size)
 
     def __del__(self):
         try:

@@ -263,20 +263,27 @@ class Worker:
             ).encode()
         return self._address_blob
 
-    def evaluate_perf(self, conn, msg_size: int) -> float:
-        from .. import perf
-
+    def _perf_transport(self, conn) -> str:
         with self.lock:
             self._require_running()
             if conn is None:
-                transport = "tcp"
-            elif getattr(conn, "sm_negotiated", False):
-                transport = "sm"
-            else:
-                transport = conn.kind
+                return "tcp"
+            if getattr(conn, "sm_negotiated", False):
+                return "sm"
+            return conn.kind
+
+    def evaluate_perf(self, conn, msg_size: int) -> float:
+        from .. import perf
+
         # Per-endpoint first (live-calibrated, perf.autocalibrate[_ep]),
         # transport-class model otherwise.
-        return perf.conn_estimate(conn, transport, msg_size)
+        return perf.conn_estimate(conn, self._perf_transport(conn), msg_size)
+
+    def evaluate_perf_detail(self, conn, msg_size: int) -> dict:
+        from .. import perf
+
+        return perf.conn_estimate_detail(conn, self._perf_transport(conn),
+                                         msg_size)
 
     # --------------------------------------------------------- engine side
     def _wake(self) -> None:

@@ -733,16 +733,24 @@ class NativeWorkerBase:
             ).encode()
         return self._address_blob
 
+    def _perf_transport(self, conn) -> str:
+        self._require_running()
+        if isinstance(conn, NativeConn) and conn.transports() == [("shm", "sm")]:
+            return "sm"
+        return "tcp"
+
     def evaluate_perf(self, conn, msg_size: int) -> float:
         from .. import perf
 
-        self._require_running()
-        transport = "tcp"
-        if isinstance(conn, NativeConn) and conn.transports() == [("shm", "sm")]:
-            transport = "sm"
         # Per-endpoint first (live-calibrated, perf.autocalibrate[_ep]),
         # transport-class model otherwise.
-        return perf.conn_estimate(conn, transport, msg_size)
+        return perf.conn_estimate(conn, self._perf_transport(conn), msg_size)
+
+    def evaluate_perf_detail(self, conn, msg_size: int) -> dict:
+        from .. import perf
+
+        return perf.conn_estimate_detail(conn, self._perf_transport(conn),
+                                         msg_size)
 
     def __del__(self):
         try:

@@ -91,6 +91,8 @@ class WorkerProtocol(Protocol):
 
     def evaluate_perf(self, conn, msg_size: int) -> float: ...
 
+    def evaluate_perf_detail(self, conn, msg_size: int) -> dict: ...
+
 
 @runtime_checkable
 class ClientWorkerProtocol(WorkerProtocol, Protocol):

@@ -17,8 +17,9 @@ tag's top byte is the message type (tag routing, reference-style):
 ====== ========= ================ =======================================
 type   direction tag              payload
 ====== ========= ================ =======================================
-0xA1   S -> C    ASSIGN           [client_id] — sent on accept; the
-                                  client's identity for request tags
+0xA1   S -> C    ASSIGN           [client_id, max_prompt_tokens] — sent
+                                  on accept; identity for request tags +
+                                  the server's request-size limit
 0xA2   C -> S    REQUEST | cid    [nonce, max_new, n, prompt x n]
 0xA3   S -> C    TOKENS | nonce   [nonce, done, count, tokens x count]
 ====== ========= ================ =======================================
@@ -33,8 +34,10 @@ letting one client run many concurrent generates.
 The per-chunk TOKENS messages for one request are FIFO on one
 connection (the engine preserves per-connection send order), so the
 client just accumulates until ``done``.  Send completion is local
-(CLAUDE.md contract); no flush is needed for streaming — a dead client
-fails the pending sends, which the bridge logs and drops.
+(CLAUDE.md contract): mid-stream no flush is needed (a dead client just
+fails its pending sends, logged and dropped), but serve() flushes once
+before returning so a close right after cannot cancel the final chunks
+out from under still-reading clients.
 """
 
 from __future__ import annotations
@@ -163,8 +166,12 @@ class RemoteSlotServer:
             ep = self._eps.get(cid)
             if ep is None:
                 continue
+            # max_prompt_tokens rides along so the client can reject an
+            # oversized prompt LOCALLY — sent to the server it would
+            # truncate the wildcard recv before the nonce is parsed,
+            # leaving nothing to reply to.
             self.server.send(
-                ep, _wire([cid]), TAG_ASSIGN,
+                ep, _wire([cid, self.max_prompt_tokens]), TAG_ASSIGN,
                 lambda: None,
                 lambda reason, cid=cid: logger.warning(
                     "assign to client %d failed: %s", cid, reason))
@@ -246,6 +253,13 @@ class RemoteSlotServer:
             else:
                 await asyncio.sleep(idle_sleep)
         self._flush_emissions()
+        # Send completion is LOCAL (CLAUDE.md); a close right after serve()
+        # could cancel the final TOKENS chunks still in flight and hang
+        # mid-stream clients — the flush is the delivery barrier.
+        try:
+            await self.server.aflush()
+        except Exception as e:  # worker already closing
+            logger.warning("final flush failed: %s", e)
 
     def stop(self) -> None:
         """Finish in-flight requests, then let serve() return."""
@@ -270,6 +284,7 @@ class RemoteGenerateSession:
     def __init__(self, client: Client):
         self.client = client
         self.client_id: Optional[int] = None
+        self.server_max_prompt: Optional[int] = None
         self._nonce = 0
 
     @classmethod
@@ -282,9 +297,11 @@ class RemoteGenerateSession:
 
     async def register(self) -> int:
         """Receive the server-assigned client id (sent on accept)."""
-        buf = _recv_buf(1)
+        buf = _recv_buf(2)
         await self.client.arecv(buf, TAG_ASSIGN, FULL_MASK)
-        self.client_id = int(buf.view(np.int32)[0])
+        words = buf.view(np.int32)
+        self.client_id = int(words[0])
+        self.server_max_prompt = int(words[1])
         return self.client_id
 
     async def generate(self, prompt, max_new_tokens: int,
@@ -295,9 +312,16 @@ class RemoteGenerateSession:
         ``on_tokens(list)``: optional per-chunk streaming callback."""
         if self.client_id is None:
             raise RuntimeError("call register() (or aconnect()) first")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if (self.server_max_prompt is not None
+                and len(prompt) > self.server_max_prompt):
+            # Server-side this would truncate the request recv before the
+            # nonce is parsed — unanswerable; reject here instead.
+            raise ValueError(
+                f"prompt ({len(prompt)} tokens) exceeds the server's "
+                f"request limit ({self.server_max_prompt})")
         nonce = self._nonce
         self._nonce += 1
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
         req = _wire(np.concatenate([
             np.asarray([nonce, int(max_new_tokens), len(prompt)], np.int32),
             prompt]))

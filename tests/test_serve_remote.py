@@ -21,7 +21,6 @@ import jax.numpy as jnp
 
 from starway_tpu.models import LlamaConfig, SlotServer, init_params
 from starway_tpu.models.generate import generate
-from tests.conftest import free_port
 
 pytestmark = pytest.mark.asyncio
 
@@ -30,7 +29,11 @@ ADDR = "127.0.0.1"
 
 @pytest.fixture(params=["inproc", "tcp", "native"])
 def transport(request, monkeypatch):
-    if request.param == "tcp":
+    if request.param == "inproc":
+        # Ambient env must not silently turn this leg into tcp/native.
+        monkeypatch.delenv("STARWAY_TLS", raising=False)
+        monkeypatch.delenv("STARWAY_NATIVE", raising=False)
+    elif request.param == "tcp":
         monkeypatch.setenv("STARWAY_TLS", "tcp")
         monkeypatch.setenv("STARWAY_NATIVE", "0")
     elif request.param == "native":
@@ -160,11 +163,34 @@ async def test_remote_rejects_oversized(cfg, params, transport, port):
         await bridge.aclose()
 
 
+async def test_remote_client_rejects_oversized_prompt_locally(cfg, params,
+                                                              port):
+    """ASSIGN carries the server's request-size limit; generate() raises
+    client-side instead of sending an unanswerable truncated request."""
+    from starway_tpu.models.remote_serving import (RemoteGenerateSession,
+                                                   RemoteSlotServer)
+
+    slot = SlotServer(params, cfg, n_slots=1, max_len=64, chunk=4)
+    bridge = RemoteSlotServer(slot, max_prompt_tokens=16)
+    bridge.server.listen(ADDR, port)
+    serve_task = asyncio.create_task(bridge.serve())
+    session = await RemoteGenerateSession.aconnect(ADDR, port)
+    try:
+        assert session.server_max_prompt == 16
+        with pytest.raises(ValueError, match="request limit"):
+            await session.generate(list(range(1, 30)), 4)
+    finally:
+        bridge.stop()
+        await serve_task
+        await session.aclose()
+        await bridge.aclose()
+
+
 async def test_remote_intake_survives_truncated_request(cfg, params, port):
     """An oversized request truncates the server's wildcard recv; the
     bridge must re-post and keep serving everyone else (a one-request
     denial must not become a permanent one)."""
-    from starway_tpu.models.remote_serving import (FULL_MASK, TAG_REQUEST,
+    from starway_tpu.models.remote_serving import (TAG_REQUEST,
                                                    RemoteGenerateSession,
                                                    RemoteSlotServer, _wire)
 

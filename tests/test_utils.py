@@ -139,6 +139,129 @@ def test_perf_calibrate():
     assert abs(perf.estimate("tcp", 1 << 20) - (alpha + (1 << 20) / beta)) < 1e-6
 
 
+@pytest.fixture
+def perf_table_guard():
+    """calibrate() mutates the process-global class table; restore it."""
+    models = dict(perf.LINK_MODELS)
+    prov = dict(perf.PROVENANCE)
+    calibrated = set(perf.CALIBRATED)
+    yield
+    perf.LINK_MODELS.clear()
+    perf.LINK_MODELS.update(models)
+    perf.PROVENANCE.clear()
+    perf.PROVENANCE.update(prov)
+    perf.CALIBRATED.clear()
+    perf.CALIBRATED.update(calibrated)
+
+
+def test_perf_detail_prior_vs_calibrated(perf_table_guard):
+    """VERDICT r4 #5: an estimate from an uncalibrated spec-sheet prior
+    must say so; a live fit must say that instead."""
+    d = perf.estimate_detail("ici", 1 << 20)
+    assert d["calibrated"] is False
+    assert "prior" in d["source"] and "v5e" in d["source"]
+    assert d["seconds"] == pytest.approx(perf.estimate("ici", 1 << 20))
+
+    d = perf.estimate_detail("dcn", 1 << 20)
+    assert d["calibrated"] is False and "prior" in d["source"]
+
+    alpha, beta = 5e-6, 2e9
+    samples = [(n, alpha + n / beta) for n in (1024, 1 << 16, 1 << 20)]
+    perf.calibrate("dcn", samples)
+    d = perf.estimate_detail("dcn", 1 << 20)
+    assert d["calibrated"] is True
+    assert "live class fit" in d["source"]
+    assert d["beta"] == pytest.approx(beta, rel=0.05)
+
+    # Unknown transports fall back to the tcp class and say so honestly.
+    d = perf.estimate_detail("warp-drive", 1 << 20)
+    assert d["transport"] == "tcp"
+
+
+def test_perf_detail_per_endpoint_fit(perf_table_guard):
+    """A conn carrying a live per-endpoint model reports calibrated=True
+    with the endpoint-fit source; a bare conn reports the class entry."""
+
+    class FakeConn:
+        pass
+
+    conn = FakeConn()
+    d = perf.conn_estimate_detail(conn, "ici", 1 << 20)
+    assert d["calibrated"] is False and "prior" in d["source"]
+
+    conn.perf_model = (3e-6, 10e9)
+    d = perf.conn_estimate_detail(conn, "ici", 1 << 20)
+    assert d["calibrated"] is True and "per-endpoint" in d["source"]
+    assert d["seconds"] == pytest.approx(3e-6 + (1 << 20) / 10e9)
+
+
+def _dcn_standin_server(port, stop):
+    import asyncio
+    import os
+
+    os.environ["STARWAY_TLS"] = "tcp"
+    from starway_tpu import Server
+
+    async def main():
+        s = Server()
+        s.listen("127.0.0.1", port)
+        while not stop.is_set():
+            await asyncio.sleep(0.05)
+        await s.aclose()
+
+    asyncio.run(main())
+
+
+def test_autocalibrate_dcn_standin_two_processes(monkeypatch,
+                                                 perf_table_guard, port):
+    """The DCN class entry calibrated LIVE over a real 2-process TCP pair
+    (the in-sandbox stand-in for a cross-host DCN link): after
+    autocalibrate(transport="dcn"), both the class detail and the
+    client's per-endpoint detail report calibrated=True."""
+    import asyncio
+    import multiprocessing as mp
+
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+    ctx = mp.get_context("spawn")
+    stop = ctx.Event()
+    srv = ctx.Process(target=_dcn_standin_server, args=(port, stop))
+    srv.start()
+
+    async def drive():
+        from starway_tpu import Client
+
+        client = None
+        for _ in range(60):  # connect-once: fresh Client per attempt
+            c = Client()
+            try:
+                await c.aconnect("127.0.0.1", port)
+                client = c
+                break
+            except Exception:
+                await asyncio.sleep(0.25)
+        assert client is not None, "stand-in server never came up"
+        assert perf.estimate_detail("dcn", 1 << 20)["calibrated"] is False
+        await perf.autocalibrate(client, "dcn", sizes=(1 << 10, 1 << 14))
+        class_d = perf.estimate_detail("dcn", 1 << 20)
+        ep_d = client.evaluate_perf_detail(1 << 20)
+        await client.aclose()
+        return class_d, ep_d
+
+    try:
+        class_d, ep_d = asyncio.run(drive())
+    finally:
+        stop.set()
+        srv.join(timeout=30)
+        if srv.is_alive():
+            srv.terminate()
+    assert class_d["calibrated"] is True
+    assert "live class fit" in class_d["source"]
+    assert ep_d["calibrated"] is True
+    assert "per-endpoint" in ep_d["source"]
+    assert ep_d["seconds"] > 0
+
+
 def test_op_timer_summary():
     t = OpTimer()
     for _ in range(10):
