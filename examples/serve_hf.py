@@ -13,7 +13,7 @@ the demo model for a Llama-3.1-style config — decoupled ``head_dim`` and
 end to end (hf_convert.py; VERDICT r3 #6).
 
 Usage:  python examples/serve_hf.py [--model DIR] [--max-new 12]
-        [--arch llama\|llama31\|qwen2\|mixtral\|gemma\|phi3]
+        [--arch llama\|llama31\|qwen2\|qwen25\|mixtral\|gemma\|phi3]
 """
 
 import argparse
@@ -33,14 +33,15 @@ def main() -> None:
                     help="int8 = W8A16 weight-only serving tree "
                          "(half the weight HBM; see ops/quantize.py)")
     ap.add_argument("--arch",
-                    choices=["llama", "llama31", "qwen2", "mixtral",
-                             "gemma", "phi3"],
+                    choices=["llama", "llama31", "qwen2", "qwen25",
+                             "mixtral", "gemma", "phi3"],
                     default="llama",
                     help="demo-model flavour: llama31 = decoupled head_dim "
                          "+ llama3 rope scaling; qwen2 = q/k/v projection "
                          "biases; mixtral = SwiGLU top-2 MoE experts; "
                          "gemma = GeGLU + (1+w) norms + scaled embeddings; "
-                         "phi3 = fused qkv/gate_up projections")
+                         "phi3 = fused qkv/gate_up projections, "
+                         "qwen25 = Qwen2 biases + YaRN rope")
     args = ap.parse_args()
 
     import jax
@@ -71,6 +72,13 @@ def main() -> None:
             # Qwen2-style: q/k/v projection biases.
             hf = transformers.Qwen2ForCausalLM(
                 transformers.Qwen2Config(**dims))
+        elif args.arch == "qwen25":
+            # Qwen2.5-long style: Qwen2 biases + YaRN rope scaling
+            # (seventh served family).
+            hf = transformers.Qwen2ForCausalLM(transformers.Qwen2Config(
+                **dims, rope_scaling={
+                    "rope_type": "yarn", "factor": 4.0,
+                    "original_max_position_embeddings": 64}))
         elif args.arch == "mixtral":
             # Mixtral-style: SwiGLU top-2 MoE FFN (dropless conversion).
             hf = transformers.MixtralForCausalLM(transformers.MixtralConfig(

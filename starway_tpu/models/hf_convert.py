@@ -130,7 +130,8 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
         head_dim_override=(explicit_hd if explicit_hd is not None
                            and explicit_hd != derived_hd else None),
         rope_scaling=_rope_scaling_from_hf(
-            getattr(hf_config, "rope_scaling", None)),
+            getattr(hf_config, "rope_scaling", None),
+            getattr(hf_config, "max_position_embeddings", None)),
         mlp_act=mlp_act,
         # Gemma scales the embedding OUTPUT by sqrt(d_model); the tied
         # lm_head reads the raw table, so it is a runtime flag, not a
@@ -156,14 +157,19 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
-def _rope_scaling_from_hf(scaling) -> "tuple | None":
+def _rope_scaling_from_hf(scaling, max_position_embeddings=None) -> "tuple | None":
     """HF ``rope_scaling`` dict -> LlamaConfig's hashable tuple.
 
-    Implemented kinds: ``linear`` (position interpolation) and ``llama3``
-    (the Llama-3.1 banded scheme; see llama.py:rope_tables).  Anything
-    else (yarn, dynamic, longrope, ...) still refuses — silently dropping
-    a scaling scheme would change the rope frequencies vs transformers,
-    the exact failure mode this module exists to prevent."""
+    Implemented kinds: ``linear`` (position interpolation), ``llama3``
+    (the Llama-3.1 banded scheme), and ``yarn`` (NTK-by-parts,
+    Qwen2.5-long / DeepSeek-family; see llama.py:rope_tables).  yarn's
+    ``attention_factor`` is resolved HERE, HF-identically — explicit
+    value wins, then the mscale/mscale_all_dim ratio (DeepSeek), then
+    the paper default ``0.1*ln(factor)+1`` — so the config tuple carries
+    one final float.  Anything else (dynamic, longrope, ...) still
+    refuses — silently dropping a scaling scheme would change the rope
+    frequencies vs transformers, the exact failure mode this module
+    exists to prevent."""
     if not scaling:
         return None
     kind = scaling.get("rope_type", scaling.get("type"))
@@ -174,14 +180,41 @@ def _rope_scaling_from_hf(scaling) -> "tuple | None":
                 float(scaling["low_freq_factor"]),
                 float(scaling["high_freq_factor"]),
                 float(scaling["original_max_position_embeddings"]))
+    if kind == "yarn":
+        import math
+
+        factor = float(scaling["factor"])
+        att = scaling.get("attention_factor")
+        mscale = scaling.get("mscale")
+        mscale_all_dim = scaling.get("mscale_all_dim")
+
+        def get_mscale(scale, m=1.0):
+            return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+        if att is None:
+            if mscale and mscale_all_dim:
+                att = get_mscale(factor, mscale) / get_mscale(
+                    factor, mscale_all_dim)
+            else:
+                att = get_mscale(factor)
+        orig = (scaling.get("original_max_position_embeddings")
+                or max_position_embeddings)
+        if orig is None:
+            raise ValueError(
+                "yarn rope_scaling needs original_max_position_embeddings "
+                "(in the scaling dict or the model config)")
+        return ("yarn", factor, float(orig),
+                float(scaling.get("beta_fast") or 32),
+                float(scaling.get("beta_slow") or 1),
+                float(att), bool(scaling.get("truncate", True)))
     if kind == "default":
         # transformers normalises "no scaling" configs to
         # {"rope_type": "default"} in some versions.
         return None
     raise NotImplementedError(
-        f"rope_scaling={scaling!r} is not implemented here (linear and "
-        "llama3 are); converting would silently change the rope "
-        "frequencies vs transformers")
+        f"rope_scaling={scaling!r} is not implemented here (linear, "
+        "llama3, and yarn are); converting would silently change the "
+        "rope frequencies vs transformers")
 
 
 def _norm_w(w, plus_one: bool) -> np.ndarray:

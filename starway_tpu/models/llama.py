@@ -135,13 +135,16 @@ class LlamaConfig:
                 "would be silently ignored; set remat=True")
         if self.rope_scaling is not None:
             s = tuple(self.rope_scaling)
-            if not s or s[0] not in ("linear", "llama3") or (
+            if not s or s[0] not in ("linear", "llama3", "yarn") or (
                     s[0] == "linear" and len(s) != 2) or (
-                    s[0] == "llama3" and len(s) != 5):
+                    s[0] == "llama3" and len(s) != 5) or (
+                    s[0] == "yarn" and len(s) != 7):
                 raise ValueError(
-                    f"rope_scaling must be ('linear', factor) or ('llama3', "
+                    f"rope_scaling must be ('linear', factor), ('llama3', "
                     f"factor, low_freq_factor, high_freq_factor, "
-                    f"original_max_position_embeddings), got "
+                    f"original_max_position_embeddings), or ('yarn', "
+                    f"factor, original_max_position_embeddings, beta_fast, "
+                    f"beta_slow, attention_factor, truncate), got "
                     f"{self.rope_scaling!r}")
             object.__setattr__(self, "rope_scaling", s)
 
@@ -323,9 +326,19 @@ def rope_tables(seq_len: int, head_dim: int, theta: float, scaling=None):
     (public formula, as shipped in the checkpoints' reference code): long
     wavelengths (beyond ``orig/low``) scale by ``1/factor``, short ones
     (inside ``orig/high``) stay, and the band between interpolates
-    smoothly in ``orig/wavelength``.
+    smoothly in ``orig/wavelength``.  ``("yarn", factor,
+    original_max_position_embeddings, beta_fast, beta_slow,
+    attention_factor, truncate)`` is YaRN (NTK-by-parts, the public
+    paper 2309.00071 formula as HF ships it; Qwen2.5-long /
+    DeepSeek-family checkpoints): per-dimension blend of interpolated
+    (``1/factor``) and unscaled frequencies along a linear ramp between
+    the beta_fast/beta_slow correction dims, with ``attention_factor``
+    (resolved at conversion, incl. the mscale variants) multiplying the
+    cos/sin tables.
     """
+    half = head_dim // 2
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    att = 1.0
     if scaling is not None:
         kind = scaling[0]
         if kind == "linear":
@@ -338,11 +351,31 @@ def rope_tables(seq_len: int, head_dim: int, theta: float, scaling=None):
             inv_freq = jnp.where(
                 wavelen > orig / low, inv_freq / factor,
                 jnp.where(wavelen < orig / high, inv_freq, mid))
+        elif kind == "yarn":
+            import math
+
+            factor, orig, beta_fast, beta_slow, att, truncate = scaling[1:]
+
+            def corr_dim(rot):  # dimension rotating `rot` times over orig
+                return (head_dim * math.log(orig / (rot * 2.0 * math.pi))
+                        ) / (2.0 * math.log(theta))
+
+            low, high = corr_dim(beta_fast), corr_dim(beta_slow)
+            if truncate:
+                low, high = math.floor(low), math.ceil(high)
+            low, high = max(low, 0), min(high, head_dim - 1)
+            if low == high:
+                high += 0.001  # ramp singularity guard (HF-identical)
+            ramp = jnp.clip(
+                (jnp.arange(half, dtype=jnp.float32) - low) / (high - low),
+                0.0, 1.0)
+            extrap = 1.0 - ramp  # 1 where the dim extrapolates (short wl)
+            inv_freq = (inv_freq / factor) * (1.0 - extrap) + inv_freq * extrap
         else:  # LlamaConfig.__post_init__ already validated
             raise ValueError(f"unknown rope scaling kind {kind!r}")
     pos = jnp.arange(seq_len, dtype=jnp.float32)
     ang = pos[:, None] * inv_freq[None, :]
-    return jnp.cos(ang), jnp.sin(ang)
+    return jnp.cos(ang) * att, jnp.sin(ang) * att
 
 
 def cfg_rope_tables(cfg: "LlamaConfig", seq_len: int):

@@ -63,18 +63,16 @@ def estimate(transport: str, msg_size: int) -> float:
 
     Always > 0, matching the reference contract (tests/test_basic.py:445-457).
     """
-    return _apply(LINK_MODELS.get(transport, LINK_MODELS["tcp"]), msg_size)
+    return estimate_detail(transport, msg_size)["seconds"]
 
 
 def conn_estimate(conn, transport: str, msg_size: int) -> float:
     """Per-endpoint estimate: a live-calibrated model attached to the
     connection (``conn.perf_model``, set by :func:`autocalibrate` /
     :func:`autocalibrate_ep`) wins over the transport-class table —
-    both engines' ``evaluate_perf`` route through here."""
-    model = getattr(conn, "perf_model", None)
-    if model is not None:
-        return _apply(model, msg_size)
-    return estimate(transport, msg_size)
+    both engines' ``evaluate_perf`` route through here.  Delegates to
+    :func:`conn_estimate_detail` so the resolution policy lives once."""
+    return conn_estimate_detail(conn, transport, msg_size)["seconds"]
 
 
 def estimate_detail(transport: str, msg_size: int) -> dict:
