@@ -490,12 +490,14 @@ def test_qwen25_yarn_serves_end_to_end():
         bridge.server.listen("127.0.0.1", port)
         task = asyncio.create_task(bridge.serve())
         session = await RemoteGenerateSession.aconnect("127.0.0.1", port)
-        outs = await asyncio.gather(session.generate([7, 1, 9, 4], 8),
-                                    session.generate([3, 2, 5], 6))
-        bridge.stop()
-        await task
-        await session.aclose()
-        await bridge.aclose()
+        try:
+            outs = await asyncio.gather(session.generate([7, 1, 9, 4], 8),
+                                        session.generate([3, 2, 5], 6))
+        finally:
+            bridge.stop()
+            await task
+            await session.aclose()
+            await bridge.aclose()
         return outs
 
     outs = asyncio.run(drive())
