@@ -1,0 +1,78 @@
+#!/bin/bash
+# Execute the release pipeline once, locally (VERDICT r4 #4): build the
+# sdist, install it into a fresh venv, build the native engine from the
+# sdist's own sources, and run a smoke slice of the shipped test suite
+# with the venv interpreter.  The wheels workflow (.github/workflows/
+# wheels.yml) can't run in this sandbox; this proves the same artifacts
+# assemble and install.
+#
+# Offline by construction: --no-isolation builds with the system
+# setuptools, the venv uses --system-site-packages for numpy/jax/pytest,
+# and pip installs the local tarball with --no-deps --no-build-isolation.
+#
+# Usage: bash scripts/release_smoke.sh [workdir]   (default /tmp/sw_release)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+WORK="${1:-/tmp/sw_release}"
+rm -rf "$WORK"
+mkdir -p "$WORK"
+
+echo "== 1/5 sdist build (python -m build --sdist --no-isolation)"
+python -m build --sdist --no-isolation --outdir "$WORK/dist" . >"$WORK/build.log" 2>&1 \
+  || { tail -20 "$WORK/build.log"; exit 1; }
+SDIST="$(ls "$WORK"/dist/*.tar.gz)"
+echo "   $SDIST"
+
+echo "== 2/5 sdist completeness (native sources + tests ship)"
+tar tzf "$SDIST" | sed 's|^[^/]*/||' | sort > "$WORK/filelist"
+for f in native/sw_engine.cpp native/sw_engine.h native/CMakeLists.txt \
+         tests/test_basic.py tests/conftest.py starway_tpu/api.py \
+         starway_tpu/models/llama.py starway_tpu/native_build.py; do
+  grep -qx "$f" "$WORK/filelist" || { echo "MISSING from sdist: $f"; exit 1; }
+done
+if grep -qx "starway_tpu/_sw_native.so" "$WORK/filelist"; then
+  echo "sdist ships a prebuilt binary (_sw_native.so) — it must not"; exit 1
+fi
+echo "   $(wc -l < "$WORK/filelist") files; native sources + tests present, no prebuilt .so"
+
+echo "== 3/5 wheel built FROM the sdist tree; installed into a fresh venv"
+mkdir -p "$WORK/src"
+tar xzf "$SDIST" -C "$WORK/src" --strip-components=1
+# The wheel is built from the unpacked sdist (exactly what cibuildwheel
+# does in its container), with the system toolchain (--no-isolation: the
+# sandbox has no network for an isolated build env); the fresh venv then
+# installs the finished wheel — no build backend needed at install time.
+python -m build --wheel --no-isolation --outdir "$WORK/dist" "$WORK/src" \
+  >>"$WORK/build.log" 2>&1 || { tail -20 "$WORK/build.log"; exit 1; }
+WHEEL="$(ls "$WORK"/dist/*.whl)"
+python -m venv --system-site-packages "$WORK/venv"
+VPY="$WORK/venv/bin/python"
+# --system-site-packages chains to the BASE interpreter; the working
+# numpy/jax/pytest live in THIS interpreter's site-packages (the sandbox
+# runs from its own venv).  A .pth bridges them — offline, no installs.
+HOST_SITE="$(python -c 'import sysconfig; print(sysconfig.get_paths()["purelib"])')"
+VENV_SITE="$("$VPY" -c 'import sysconfig; print(sysconfig.get_paths()["purelib"])')"
+echo "$HOST_SITE" > "$VENV_SITE/_host_site.pth"
+"$VPY" -m pip install --no-deps --quiet "$WHEEL"
+# Import check from a NEUTRAL cwd: the repo root on sys.path would shadow
+# the installed package and prove nothing.
+(cd "$WORK" && SW_WORK="$WORK" "$VPY" - <<'PY'
+import os
+import starway_tpu
+from starway_tpu import Client, Server, check_sys_libs
+assert starway_tpu.__file__.startswith(os.environ["SW_WORK"]), starway_tpu.__file__
+print("   installed import ok:", starway_tpu.__file__)
+PY
+)
+
+echo "== 4/5 native engine built from the sdist's own sources"
+(cd "$WORK/src" && "$VPY" -m starway_tpu.native_build >"$WORK/native_build.log" 2>&1) \
+  || { tail -20 "$WORK/native_build.log"; exit 1; }
+ls -la "$WORK/src/starway_tpu/_sw_native.so"
+
+echo "== 5/5 smoke tests from the sdist tree on the venv interpreter"
+(cd "$WORK/src" && "$VPY" -m pytest \
+    tests/test_matching.py tests/test_protocol.py \
+    "tests/test_basic.py::test_client_to_server_send_recv[inproc]" -q)
+
+echo "RELEASE SMOKE: OK ($SDIST)"

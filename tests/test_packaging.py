@@ -1,0 +1,56 @@
+"""Release-artifact completeness (VERDICT r4 #4): the sdist must ship the
+native engine's sources and the test suite (MANIFEST.in contract), and
+must NOT ship a locally-built binary.  scripts/release_smoke.sh executes
+the full pipeline (sdist -> wheel -> fresh venv -> native build -> smoke
+tests); this pins the file-list half so a MANIFEST regression fails in CI
+rather than at release time."""
+
+import subprocess
+import sys
+import tarfile
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def sdist_names(tmp_path_factory):
+    pytest.importorskip("build")
+    out = tmp_path_factory.mktemp("dist")
+    r = subprocess.run(
+        [sys.executable, "-m", "build", "--sdist", "--no-isolation",
+         "--outdir", str(out), str(REPO)],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    (sdist,) = out.glob("*.tar.gz")
+    with tarfile.open(sdist) as tf:
+        return {n.split("/", 1)[1] for n in tf.getnames() if "/" in n}
+
+
+def test_sdist_ships_native_sources(sdist_names):
+    for f in ("native/sw_engine.cpp", "native/sw_engine.h",
+              "native/CMakeLists.txt", "starway_tpu/native_build.py"):
+        assert f in sdist_names, f"{f} missing from sdist"
+
+
+def test_sdist_ships_test_suite(sdist_names):
+    assert "tests/conftest.py" in sdist_names
+    repo_tests = {p.relative_to(REPO).as_posix()
+                  for p in (REPO / "tests").glob("test_*.py")}
+    missing = repo_tests - sdist_names
+    assert not missing, f"test files missing from sdist: {sorted(missing)}"
+
+
+def test_sdist_has_no_prebuilt_binary(sdist_names):
+    assert "starway_tpu/_sw_native.so" not in sdist_names, (
+        "a locally-built engine binary leaked into the SOURCE dist")
+
+
+def test_sdist_ships_package_complete(sdist_names):
+    repo_pkg = {p.relative_to(REPO).as_posix()
+                for p in (REPO / "starway_tpu").rglob("*.py")
+                if "egg-info" not in p.parts and "__pycache__" not in p.parts}
+    missing = repo_pkg - sdist_names
+    assert not missing, f"package files missing from sdist: {sorted(missing)}"
