@@ -48,8 +48,14 @@ have() {  # have <metric>: a non-error row for <metric> is already recorded
   grep "\"metric\": \"$1\"" "$OUT" | grep -qv '"error"'
 }
 
+want() {  # ROWS="a b c" restricts the queue to named rows; unset = all
+  [ -z "${ROWS:-}" ] && return 0
+  case " $ROWS " in *" $1 "*) return 0 ;; *) return 1 ;; esac
+}
+
 run() {  # [ROW_TIMEOUT=secs] run <which> <done_metric> [extra flags...]
   local which="$1" done_key="$2"; shift 2
+  want "$which" || return 0
   if have "$done_key"; then echo "== $which (already measured; skip)" >&2; return; fi
   echo "== $which" >&2
   probe  # the tunnel can die mid-queue; fail fast, not per-row timeouts
@@ -78,7 +84,9 @@ if [ "${FORCE:-0}" = "1" ]; then
 fi
 
 # -- fast, high-value pending rows first ------------------------------------
-if have driver_headline; then
+if ! want headline; then
+  : # ROWS filter excludes the headline
+elif have driver_headline; then
   echo "== headline (already measured; skip)" >&2
 else
   echo "== headline (driver bench.py)" >&2
@@ -115,7 +123,7 @@ run gemv_int8        gemv_int8_speedup                  # W8A16 weight stream vs
 run serve_w8_b1      serve_llama_int8_w8_b1_tokens_per_s # whole-model int8 serving (KV + weights)
 # 672M-param compiles x two differenced loop lengths can exceed the default
 # row timeout; give this one headroom.
-ROW_TIMEOUT=3000 run train_mfu_large train_step_mfu_large  # model-scale MFU (target >= 0.40)
+ROW_TIMEOUT="${ROW_TIMEOUT_LARGE:-3000}" run train_mfu_large train_step_mfu_large  # model-scale MFU (target >= 0.40)
 run decode_shapes    decode_shape_wins                  # ours-vs-lax at the r2 acceptance shapes
 
 # -- re-confirmation rows (captured 2026-07-31; skipped unless FORCE=1) -----
@@ -127,6 +135,6 @@ run decode       decode_ours_us_per_token   # stream default: beats lax 2.30x
 run decode_lax   decode_lax_us_per_token
 
 # -- slow optimization sweep last (stream already wins at its default) ------
-ROW_TIMEOUT=2400 run decode_tune decode_best_config
+ROW_TIMEOUT="${ROW_TIMEOUT_LARGE:-2400}" run decode_tune decode_best_config
 
 echo "rows written to $OUT" >&2

@@ -16,6 +16,7 @@ cd "$(dirname "$0")/.."
 WORK="${1:-/tmp/sw_release}"
 rm -rf "$WORK"
 mkdir -p "$WORK"
+WORK="$(cd "$WORK" && pwd)"   # later steps cd around; must be absolute
 
 echo "== 1/5 sdist build (python -m build --sdist --no-isolation)"
 python -m build --sdist --no-isolation --outdir "$WORK/dist" . >"$WORK/build.log" 2>&1 \
