@@ -21,7 +21,11 @@ type   direction tag              payload
                                   on accept; identity for request tags +
                                   the server's request-size limit
 0xA2   C -> S    REQUEST | cid    [nonce, max_new, n, prompt x n]
-0xA3   S -> C    TOKENS | nonce   [nonce, done, count, tokens x count]
+0xA3   S -> C    TOKENS | nonce   [nonce, status, count, tokens x count]
+                                  status: 0 = streaming, 1 = done,
+                                  2 = aborted (rejected or cancelled)
+0xA4   C -> S    CANCEL | cid     [nonce] — abort that request; its slot
+                                  frees on the next decode step
 ====== ========= ================ =======================================
 
 Routing: the matcher reports a completed wildcard recv's SENDER TAG, not
@@ -58,7 +62,10 @@ TAG_TYPE_SHIFT = 56
 TAG_ASSIGN = 0xA1 << TAG_TYPE_SHIFT
 TAG_REQUEST = 0xA2 << TAG_TYPE_SHIFT
 TAG_TOKENS = 0xA3 << TAG_TYPE_SHIFT
+TAG_CANCEL = 0xA4 << TAG_TYPE_SHIFT
 TYPE_MASK = 0xFF << TAG_TYPE_SHIFT
+
+STATUS_STREAMING, STATUS_DONE, STATUS_ABORTED = 0, 1, 2
 FULL_MASK = (1 << 64) - 1
 _ID_MASK = (1 << 32) - 1
 
@@ -106,6 +113,14 @@ class RemoteSlotServer:
         self._stopping = False
         self._closed = False
         self._recv_posted = False
+        self._cancels: deque = deque()          # (cid, nonce) to abort
+        # Cancels that arrived BEFORE their request was submitted (both
+        # can land in the queues during one multi-second decode step and
+        # cancels drain first): consulted at submit time so the request
+        # is rejected instead of the cancel being silently lost.
+        # Insertion-ordered and bounded: a cancel for a nonce that never
+        # shows up must not leak.
+        self._pre_cancels: dict[tuple, bool] = {}
         self.server.set_accept_cb(self._on_accept)
 
     # ------------------------------------------------- engine-thread side
@@ -120,30 +135,44 @@ class RemoteSlotServer:
         # register() recv waits however late it lands).
         self._unassigned.append(cid)
 
-    def _post_request_recv(self) -> None:
-        buf = _recv_buf(3 + self.max_prompt_tokens)
+    def _post_typed_recv(self, tag: int, n_words: int, on_msg) -> None:
+        """One self-re-posting wildcard recv chain per message type.
+        ``on_msg(sender_tag, words)`` runs on the engine thread and must
+        only enqueue.  Failures re-post too: a failed recv is consumed by
+        the matcher, so without the re-post one bad message (e.g. a
+        truncated oversized request) would permanently halt that type's
+        intake."""
+        buf = _recv_buf(n_words)
 
         def done(stag, length, buf=buf):
-            self._requests.append(
-                (int(stag), buf.view(np.int32)[:length // 4].copy()))
+            on_msg(int(stag), buf.view(np.int32)[:length // 4].copy())
             if not self._closed:
-                self._post_request_recv()
+                self._post_typed_recv(tag, n_words, on_msg)
 
         def fail(reason):
-            # Expected at close ("cancel..."); anything else (e.g. a
-            # truncated oversized request) is logged AND the recv is
-            # re-posted — a failed recv is consumed by the matcher, so
-            # without the re-post one bad request would permanently halt
-            # all intake.
+            # Expected at close ("cancel...") — not the CANCEL message
+            # type, but the engine's op-cancellation reason string.
             if self._closed or "cancel" in reason:
                 return
-            logger.warning("request recv failed: %s", reason)
+            logger.warning("recv (tag type %x) failed: %s",
+                           tag >> TAG_TYPE_SHIFT, reason)
             try:
-                self._post_request_recv()
+                self._post_typed_recv(tag, n_words, on_msg)
             except Exception:
                 pass  # worker shutting down
 
-        self.server.recv(buf, TAG_REQUEST, TYPE_MASK, done, fail)
+        self.server.recv(buf, tag, TYPE_MASK, done, fail)
+
+    def _post_request_recv(self) -> None:
+        self._post_typed_recv(
+            TAG_REQUEST, 3 + self.max_prompt_tokens,
+            lambda stag, words: self._requests.append((stag, words)))
+
+    def _post_cancel_recv(self) -> None:
+        self._post_typed_recv(
+            TAG_CANCEL, 1,
+            lambda stag, words: self._cancels.append(
+                (stag & _ID_MASK, int(words[0]))))
 
     def _on_tokens(self, rid: int, tokens: list, done: bool) -> None:
         # Fires inside SlotServer.step() (executor thread); the drive
@@ -158,7 +187,28 @@ class RemoteSlotServer:
                 logger.warning("dropping client %d (send failed)", cid)
             for rid, (rcid, _nonce) in list(self._rid_route.items()):
                 if rcid == cid:
+                    # Decoding for a peer that will never read the
+                    # stream is wasted chip time: free the slot too.
+                    self.slot.cancel(rid)
                     del self._rid_route[rid]
+
+    def _drain_cancels(self) -> None:
+        while self._cancels:
+            cid, nonce = self._cancels.popleft()
+            for rid, (rcid, rnonce) in list(self._rid_route.items()):
+                if rcid == cid and rnonce == nonce:
+                    self.slot.cancel(rid)
+                    del self._rid_route[rid]
+                    # Closure marker so a still-listening generate()
+                    # terminates instead of awaiting forever.
+                    self._send_chunk(cid, nonce, [], STATUS_ABORTED)
+                    break
+            else:
+                # Not routed yet: the REQUEST may still be in flight
+                # behind this cancel.  Stash so submit rejects it.
+                self._pre_cancels[(cid, nonce)] = True
+                while len(self._pre_cancels) > 1024:
+                    self._pre_cancels.pop(next(iter(self._pre_cancels)))
 
     def _flush_assigns(self) -> None:
         while self._unassigned:
@@ -191,9 +241,14 @@ class RemoteSlotServer:
                 if len(arr) >= 1:
                     # The nonce survived: reject fatally instead of
                     # leaving the client's generate() awaiting forever.
-                    self._send_chunk(cid, int(arr[0]), [], True)
+                    self._send_chunk(cid, int(arr[0]), [], STATUS_ABORTED)
                 continue
             nonce, max_new, n_tok = int(arr[0]), int(arr[1]), int(arr[2])
+            if self._pre_cancels.pop((cid, nonce), False):
+                # Cancelled before it was ever submitted (the CANCEL
+                # overtook the REQUEST in the drain order).
+                self._send_chunk(cid, nonce, [], STATUS_ABORTED)
+                continue
             try:
                 rid = self.slot.submit(arr[3:3 + n_tok], max_new)
             except (ValueError, KeyError) as e:
@@ -201,14 +256,14 @@ class RemoteSlotServer:
                 # "done" stream tells the client this request is over.
                 logger.warning("rejected request from client %d: %s",
                                cid, e)
-                self._send_chunk(cid, nonce, [], True)
+                self._send_chunk(cid, nonce, [], STATUS_ABORTED)
                 continue
             self._rid_route[rid] = (cid, nonce)
             n += 1
         return n
 
     def _send_chunk(self, cid: int, nonce: int, tokens: list,
-                    done: bool) -> None:
+                    status) -> None:
         ep = self._eps.get(cid)
         if ep is None:
             return
@@ -220,7 +275,7 @@ class RemoteSlotServer:
             self._dead_cids.append(cid)
 
         self.server.send(
-            ep, _wire([nonce, int(done), len(tokens), *tokens]),
+            ep, _wire([nonce, int(status), len(tokens), *tokens]),
             TAG_TOKENS | nonce, lambda: None, failed)
 
     def _flush_emissions(self) -> None:
@@ -228,9 +283,10 @@ class RemoteSlotServer:
         for rid, tokens, done in emissions:
             route = self._rid_route.get(rid)
             if route is None:
-                continue
+                continue  # cancelled mid-step; stream already closed
             cid, nonce = route
-            self._send_chunk(cid, nonce, tokens, done)
+            self._send_chunk(cid, nonce, tokens,
+                             STATUS_DONE if done else STATUS_STREAMING)
             if done:
                 del self._rid_route[rid]
 
@@ -240,11 +296,13 @@ class RemoteSlotServer:
         worker), so call ``bridge.server.listen(...)`` first."""
         if not self._recv_posted:
             self._post_request_recv()
+            self._post_cancel_recv()
             self._recv_posted = True
         loop = asyncio.get_running_loop()
         while not (self._stopping and not self.slot.busy
                    and not self._requests):
             self._drop_dead_clients()
+            self._drain_cancels()
             self._flush_assigns()
             self._drain_requests()
             if self.slot.busy:
@@ -281,6 +339,12 @@ class RemoteGenerateSession:
     wrapping the recv loop yields true streaming if a caller wants it.
     """
 
+    class Handle:
+        """Out-param for generate(): carries the request nonce so the
+        caller can cancel() a stream it no longer wants."""
+
+        nonce: Optional[int] = None
+
     def __init__(self, client: Client):
         self.client = client
         self.client_id: Optional[int] = None
@@ -306,10 +370,13 @@ class RemoteGenerateSession:
 
     async def generate(self, prompt, max_new_tokens: int,
                        *, max_chunk_tokens: int = 4096,
-                       on_tokens=None) -> np.ndarray:
+                       on_tokens=None, handle: "Optional[Handle]" = None) -> np.ndarray:
         """Round-trip one request; returns the generated tokens.
 
-        ``on_tokens(list)``: optional per-chunk streaming callback."""
+        ``on_tokens(list)``: optional per-chunk streaming callback.
+        ``handle``: a :class:`Handle` that receives the request nonce
+        before the request is sent — pass it to :meth:`cancel` from
+        another task to abort the stream server-side."""
         if self.client_id is None:
             raise RuntimeError("call register() (or aconnect()) first")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -322,6 +389,8 @@ class RemoteGenerateSession:
                 f"request limit ({self.server_max_prompt})")
         nonce = self._nonce
         self._nonce += 1
+        if handle is not None:
+            handle.nonce = nonce
         req = _wire(np.concatenate([
             np.asarray([nonce, int(max_new_tokens), len(prompt)], np.int32),
             prompt]))
@@ -331,18 +400,27 @@ class RemoteGenerateSession:
             buf = _recv_buf(3 + max_chunk_tokens)
             await self.client.arecv(buf, TAG_TOKENS | nonce, FULL_MASK)
             words = buf.view(np.int32)
-            count, done = int(words[2]), bool(words[1])
+            count, status = int(words[2]), int(words[1])
             chunk = [int(t) for t in words[3:3 + count]]
             out.extend(chunk)
             if chunk and on_tokens is not None:
                 on_tokens(chunk)
-            if done:
-                if not out:
-                    raise ValueError(
-                        "request rejected by the server (empty stream); "
-                        "check prompt/max_new against the server's "
-                        "max_len")
+            if status == STATUS_ABORTED:
+                raise ValueError(
+                    "request rejected or cancelled by the server "
+                    f"(after {len(out)} tokens); rejections mean "
+                    "prompt/max_new exceeded the server's max_len")
+            if status == STATUS_DONE:
                 return np.asarray(out, np.int32)
+
+    async def cancel(self, handle: "Handle") -> None:
+        """Abort the stream identified by ``handle`` server-side: its
+        slot frees on the next decode step and the stream terminates
+        with an aborted marker (the awaiting generate() raises)."""
+        if handle.nonce is None:
+            raise ValueError("handle was never passed to generate()")
+        await self.client.asend(_wire([handle.nonce]),
+                                TAG_CANCEL | self.client_id)
 
     async def aclose(self) -> None:
         await self.client.aclose()

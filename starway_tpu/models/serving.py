@@ -475,6 +475,11 @@ class SlotServer:
         self._collected[rid] = [tok_host]
         if self.on_tokens is not None:
             self.on_tokens(rid, [tok_host], False)
+            if rid not in self._collected:
+                # The callback cancel()ed this very request; writing the
+                # slot state below would resurrect it as an unrouted
+                # zombie that decodes garbage until slot reuse.
+                return
         done = (max_new == 1 or
                 (self.eos_id is not None and tok_host == self.eos_id))
         self.token = self.token.at[slot].set(tok_host)
@@ -482,13 +487,42 @@ class SlotServer:
         self.live = self.live.at[slot].set(not done)
         self.remaining = self.remaining.at[slot].set(max_new - 1)
 
+    def cancel(self, rid: int) -> bool:
+        """Abort a request: de-queue it if pending, else kill its slot so
+        the next step() frees it for waiting work (the transport bridge
+        calls this when a client disconnects or sends CANCEL — decoding
+        for a peer that will never read the tokens is wasted chip time).
+
+        Returns True if the request was found (pending or in a slot);
+        a finished/unknown rid returns False.  A cancelled request is
+        NOT reported by step()/run() and emits no on_tokens done event —
+        cancellation is the caller declaring the stream dead."""
+        for i, (qrid, *_rest) in enumerate(self._pending):
+            if qrid == rid:
+                del self._pending[i]
+                return True
+        for slot, srid in self._slot_rid.items():
+            if srid == rid:
+                self.live = self.live.at[slot].set(False)
+                self.remaining = self.remaining.at[slot].set(0)
+                del self._slot_rid[slot]
+                self._collected.pop(rid, None)
+                return True
+        return False
+
     def _harvest_dead(self, finished: dict) -> None:
         live = np.asarray(self.live)
+        # Snapshot + tolerant pops: a done-event on_tokens callback may
+        # cancel() another request that finished in this same step,
+        # removing its entries before the loop reaches them.
         for slot, rid in list(self._slot_rid.items()):
             if not live[slot]:
+                if rid not in self._collected:
+                    self._slot_rid.pop(slot, None)  # cancelled mid-loop
+                    continue
                 finished[rid] = np.asarray(self._collected.pop(rid),
                                            np.int32)
-                del self._slot_rid[slot]
+                self._slot_rid.pop(slot, None)
                 if self.on_tokens is not None:
                     self.on_tokens(rid, [], True)
 
@@ -514,7 +548,11 @@ class SlotServer:
                                  self.pos, self.live, self.remaining, sub)
         toks = np.asarray(toks)
         mask = np.asarray(mask)
-        for slot, rid in self._slot_rid.items():
+        # Snapshot: an on_tokens callback may legally cancel() a request
+        # (its own or another), which mutates _slot_rid/_collected.
+        for slot, rid in list(self._slot_rid.items()):
+            if rid not in self._collected:
+                continue  # cancelled by an earlier callback this step
             new = [int(t) for t, m in zip(toks[:, slot], mask[:, slot]) if m]
             self._collected[rid].extend(new)
             if self.on_tokens is not None and new:

@@ -240,7 +240,7 @@ async def test_remote_malformed_request_is_rejected(cfg, params, port):
         buf = _recv_buf(8)
         await session.client.arecv(buf, TAG_TOKENS | nonce, FULL_MASK)
         words = buf.view(np.int32)
-        assert int(words[1]) == 1 and int(words[2]) == 0  # fatal, empty
+        assert int(words[1]) == 2 and int(words[2]) == 0  # aborted, empty
         out = await session.generate([9, 1], 4)
         np.testing.assert_array_equal(out, _oracle(params, cfg, [9, 1], 4))
     finally:
@@ -339,3 +339,91 @@ def test_remote_multiprocess(cfg, params, port):
             np.testing.assert_array_equal(
                 np.asarray(got, np.int32),
                 _oracle(params, cfg, prompt, max_new))
+
+
+async def test_remote_cancel_frees_slot_and_aborts_stream(cfg, params,
+                                                          port):
+    """Client-initiated CANCEL: the awaiting generate() raises, the slot
+    frees for waiting work, and subsequent requests still match their
+    oracle."""
+    from starway_tpu.models.remote_serving import (RemoteGenerateSession,
+                                                   RemoteSlotServer)
+
+    slot = SlotServer(params, cfg, n_slots=1, max_len=64, chunk=2)
+    bridge = RemoteSlotServer(slot)
+    bridge.server.listen(ADDR, port)
+    serve_task = asyncio.create_task(bridge.serve())
+    session = await RemoteGenerateSession.aconnect(ADDR, port)
+    try:
+        handle = RemoteGenerateSession.Handle()
+        first_chunk = asyncio.Event()
+
+        async def doomed():
+            with pytest.raises(ValueError, match="rejected or cancelled"):
+                await session.generate(
+                    [4, 2, 8, 1], 40, handle=handle,
+                    on_tokens=lambda c: first_chunk.set())
+
+        task = asyncio.create_task(doomed())
+        await asyncio.wait_for(first_chunk.wait(), 120)
+        await session.cancel(handle)
+        await asyncio.wait_for(task, 120)
+
+        # The only slot must now be free for a fresh request.
+        out = await asyncio.wait_for(session.generate([9, 1, 5], 6), 120)
+        np.testing.assert_array_equal(out, _oracle(params, cfg, [9, 1, 5],
+                                                   6))
+    finally:
+        bridge.stop()
+        await serve_task
+        await session.aclose()
+        await bridge.aclose()
+
+
+async def test_remote_cancel_overtaking_request(cfg, params, port):
+    """A CANCEL drained before its REQUEST (both queue up during one
+    decode step; cancels drain first) must still abort the request —
+    the stash rejects it at submit time instead of losing the cancel."""
+    from starway_tpu.models.remote_serving import (RemoteGenerateSession,
+                                                   RemoteSlotServer)
+
+    slot = SlotServer(params, cfg, n_slots=1, max_len=64, chunk=4)
+    bridge = RemoteSlotServer(slot)
+    bridge.server.listen(ADDR, port)
+    # The session needs a running serve loop to receive its ASSIGN;
+    # pause the loop afterwards to stage the overtaking deterministically.
+    serve_task = asyncio.create_task(bridge.serve())
+    session = await RemoteGenerateSession.aconnect(ADDR, port)
+    bridge.stop()
+    await serve_task
+    bridge._stopping = False  # re-arm (white-box: serve() is re-entrant)
+    try:
+        # Pre-load BOTH queues while the loop is paused: the drain order
+        # processes cancels first — the CANCEL overtakes the REQUEST.
+        nonce = 0
+        bridge._requests.append((session.client_id, np.asarray(
+            [nonce, 30, 4, 4, 2, 8, 1], np.int32)))
+        bridge._cancels.append((session.client_id, nonce))
+        session._nonce = 1  # nonce 0 is taken by the hand-crafted request
+        task = asyncio.create_task(_await_aborted(session, nonce))
+        serve_task = asyncio.create_task(bridge.serve())
+        status = await asyncio.wait_for(task, 120)
+        assert status == 2  # aborted, never decoded
+        # Service continues for normal requests.
+        out = await asyncio.wait_for(session.generate([9, 1, 5], 6), 120)
+        np.testing.assert_array_equal(out, _oracle(params, cfg, [9, 1, 5],
+                                                   6))
+    finally:
+        bridge.stop()
+        await serve_task
+        await session.aclose()
+        await bridge.aclose()
+
+
+async def _await_aborted(session, nonce):
+    from starway_tpu.models.remote_serving import (FULL_MASK, TAG_TOKENS,
+                                                   _recv_buf)
+
+    buf = _recv_buf(8)
+    await session.client.arecv(buf, TAG_TOKENS | nonce, FULL_MASK)
+    return int(buf.view(np.int32)[1])

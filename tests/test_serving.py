@@ -319,3 +319,83 @@ def test_fuzz_request_stream_with_prefixes(cfg, params):
         np.testing.assert_array_equal(
             done[rid], _oracle(params, cfg, full, max_new),
             err_msg=f"request {rid} (P={len(full)}, N={max_new})")
+
+
+def test_cancel_pending_and_inflight(cfg, params):
+    """cancel() de-queues a pending request, kills an in-flight one's
+    slot (freed for waiting work on the next step), and neither is
+    reported by run(); survivors still match their oracle."""
+    srv = SlotServer(params, cfg, n_slots=1, max_len=64, chunk=3)
+    r0 = srv.submit([4, 2, 8, 1], 20)   # will occupy the only slot
+    r1 = srv.submit([6, 6, 3], 7)       # pending behind it
+    r2 = srv.submit([9, 1, 5], 6)       # pending behind that
+    srv.step()  # r0 mid-generation
+    assert srv.cancel(r1) is True       # pending: de-queued
+    assert srv.cancel(r0) is True       # in-flight: slot killed
+    assert srv.cancel(r0) is False      # already gone
+    assert srv.cancel(12345) is False   # unknown
+    done = srv.run()
+    assert sorted(done) == [r2]
+    np.testing.assert_array_equal(done[r2], _oracle(params, cfg,
+                                                    [9, 1, 5], 6))
+
+
+def test_cancel_emits_no_done_event(cfg, params):
+    """A cancelled request never fires the on_tokens done event (the
+    caller declared the stream dead); survivors still do."""
+    events = []
+    srv = SlotServer(params, cfg, n_slots=2, max_len=64, chunk=3,
+                     on_tokens=lambda rid, toks, done: events.append(
+                         (rid, list(toks), done)))
+    r0 = srv.submit([4, 2, 8], 12)
+    r1 = srv.submit([7, 7], 5)
+    srv.step()
+    srv.cancel(r0)
+    srv.run()
+    dones = [rid for rid, _t, d in events if d]
+    assert dones == [r1]
+
+
+def test_cancel_reentrant_from_on_tokens(cfg, params):
+    """cancel() called from inside the on_tokens callback (a stream
+    consumer declaring another stream dead mid-step) must not crash the
+    step and must take effect."""
+    state = {}
+
+    def hook(rid, toks, done):
+        # First emission from r0 kills r1.
+        if "r1" in state and rid == state["r0"] and not state.get("done"):
+            state["done"] = True
+            assert state["srv"].cancel(state["r1"]) is True
+
+    srv = SlotServer(params, cfg, n_slots=2, max_len=64, chunk=3,
+                     on_tokens=hook)
+    state["srv"] = srv
+    state["r0"] = srv.submit([4, 2, 8], 9)
+    state["r1"] = srv.submit([7, 7], 9)
+    done = srv.run()
+    assert sorted(done) == [state["r0"]]
+    np.testing.assert_array_equal(done[state["r0"]],
+                                  _oracle(params, cfg, [4, 2, 8], 9))
+
+
+def test_cancel_own_request_from_admit_callback(cfg, params):
+    """cancel() from the admit-time first-token callback must not leave
+    a zombie slot: the slot frees immediately and the next request
+    admits into it, matching its oracle."""
+    state = {}
+
+    def hook(rid, toks, done):
+        if rid == state.get("victim") and not done:
+            state["srv"].cancel(rid)
+
+    srv = SlotServer(params, cfg, n_slots=1, max_len=64, chunk=3,
+                     on_tokens=hook)
+    state["srv"] = srv
+    state["victim"] = srv.submit([4, 2, 8, 1], 20)
+    r1 = srv.submit([9, 1, 5], 6)
+    done = srv.run()
+    assert sorted(done) == [r1]
+    np.testing.assert_array_equal(done[r1], _oracle(params, cfg,
+                                                    [9, 1, 5], 6))
+    assert not srv.busy and not srv._slot_rid
