@@ -13,7 +13,7 @@ the demo model for a Llama-3.1-style config — decoupled ``head_dim`` and
 end to end (hf_convert.py; VERDICT r3 #6).
 
 Usage:  python examples/serve_hf.py [--model DIR] [--max-new 12]
-        [--arch llama\|llama31\|qwen2\|qwen25\|mixtral\|gemma\|phi3]
+        [--arch llama\|llama31\|qwen2\|qwen25\|mixtral\|gemma\|phi3\|phi35]
 """
 
 import argparse
@@ -34,14 +34,15 @@ def main() -> None:
                          "(half the weight HBM; see ops/quantize.py)")
     ap.add_argument("--arch",
                     choices=["llama", "llama31", "qwen2", "qwen25",
-                             "mixtral", "gemma", "phi3"],
+                             "mixtral", "gemma", "phi3", "phi35"],
                     default="llama",
                     help="demo-model flavour: llama31 = decoupled head_dim "
                          "+ llama3 rope scaling; qwen2 = q/k/v projection "
                          "biases; mixtral = SwiGLU top-2 MoE experts; "
                          "gemma = GeGLU + (1+w) norms + scaled embeddings; "
                          "phi3 = fused qkv/gate_up projections, "
-                         "qwen25 = Qwen2 biases + YaRN rope")
+                         "qwen25 = Qwen2 biases + YaRN rope, "
+                         "phi35 = Phi-3 projections + LongRoPE")
     args = ap.parse_args()
 
     import jax
@@ -93,6 +94,17 @@ def main() -> None:
             # vocab > 32000.)
             hf = transformers.Phi3ForCausalLM(transformers.Phi3Config(
                 **{**dims, "vocab_size": 33000}))
+        elif args.arch == "phi35":
+            # Phi-3.5/128k style: Phi-3 projections + LongRoPE per-dim
+            # short/long factor lists (eighth served family).
+            half = (dims["hidden_size"] // dims["num_attention_heads"]) // 2
+            hf = transformers.Phi3ForCausalLM(transformers.Phi3Config(
+                **{**dims, "vocab_size": 33000},
+                original_max_position_embeddings=64,
+                rope_scaling={
+                    "type": "longrope",
+                    "short_factor": [1.0 + 0.05 * i for i in range(half)],
+                    "long_factor": [2.0 + 0.1 * i for i in range(half)]}))
         else:
             extra = {}
             if args.arch == "llama31":

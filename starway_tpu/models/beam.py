@@ -135,6 +135,9 @@ def generate_beam(params: dict, cfg: LlamaConfig, prompt,
         raise ValueError(
             f"max_len={max_len} is smaller than prompt + max_new_tokens="
             f"{total}")
+    from .llama import resolve_longrope
+
+    cfg = resolve_longrope(cfg, max_len)  # one factor regime per run
     run = _compiled_beam(cfg, B, int(beams), P, max_new_tokens, max_len,
                          None if eos_id is None else int(eos_id))
     out, scores, fin = run(params, prompt)

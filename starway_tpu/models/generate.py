@@ -643,6 +643,12 @@ def generate(params: dict, cfg: LlamaConfig, prompt, max_new_tokens: int,
         )
     if key is None:
         key = jax.random.PRNGKey(0)
+    # LongRoPE: pin the factor regime to this run's horizon ONCE —
+    # prefill and decode tables are built at different lengths and must
+    # agree (llama.resolve_longrope).
+    from .llama import resolve_longrope
+
+    cfg = resolve_longrope(cfg, max_len)
     ragged = prompt_lengths is not None
     if ragged:
         from .moe import require_dropless

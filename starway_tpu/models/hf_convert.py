@@ -131,7 +131,8 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
                            and explicit_hd != derived_hd else None),
         rope_scaling=_rope_scaling_from_hf(
             getattr(hf_config, "rope_scaling", None),
-            getattr(hf_config, "max_position_embeddings", None)),
+            getattr(hf_config, "max_position_embeddings", None),
+            getattr(hf_config, "original_max_position_embeddings", None)),
         mlp_act=mlp_act,
         # Gemma scales the embedding OUTPUT by sqrt(d_model); the tied
         # lm_head reads the raw table, so it is a runtime flag, not a
@@ -157,17 +158,19 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
-def _rope_scaling_from_hf(scaling, max_position_embeddings=None) -> "tuple | None":
+def _rope_scaling_from_hf(scaling, max_position_embeddings=None,
+                          original_max_position_embeddings=None) -> "tuple | None":
     """HF ``rope_scaling`` dict -> LlamaConfig's hashable tuple.
 
     Implemented kinds: ``linear`` (position interpolation), ``llama3``
-    (the Llama-3.1 banded scheme), and ``yarn`` (NTK-by-parts,
-    Qwen2.5-long / DeepSeek-family; see llama.py:rope_tables).  yarn's
+    (the Llama-3.1 banded scheme), ``yarn`` (NTK-by-parts,
+    Qwen2.5-long / DeepSeek-family), and ``longrope`` (per-dim factor
+    lists, Phi-3.5/128k line; see llama.py:rope_tables).  yarn's
     ``attention_factor`` is resolved HERE, HF-identically — explicit
     value wins, then the mscale/mscale_all_dim ratio (DeepSeek), then
     the paper default ``0.1*ln(factor)+1`` — so the config tuple carries
-    one final float.  Anything else (dynamic, longrope, ...) still
-    refuses — silently dropping a scaling scheme would change the rope
+    one final float.  Anything else (dynamic, ...) still refuses —
+    silently dropping a scaling scheme would change the rope
     frequencies vs transformers, the exact failure mode this module
     exists to prevent."""
     if not scaling:
@@ -207,14 +210,41 @@ def _rope_scaling_from_hf(scaling, max_position_embeddings=None) -> "tuple | Non
                 float(scaling.get("beta_fast") or 32),
                 float(scaling.get("beta_slow") or 1),
                 float(att), bool(scaling.get("truncate", True)))
+    if kind == "longrope":
+        import math
+
+        short = tuple(float(x) for x in scaling["short_factor"])
+        long = tuple(float(x) for x in scaling["long_factor"])
+        # HF: Phi3-style configs carry original_max_position_embeddings
+        # at the CONFIG level and derive factor from the max/orig ratio;
+        # otherwise the scaling dict's factor applies and orig = max.
+        orig = original_max_position_embeddings
+        if orig:
+            factor = float(max_position_embeddings) / float(orig)
+        else:
+            orig = max_position_embeddings
+            factor = scaling.get("factor")
+        if orig is None or factor is None:
+            raise ValueError(
+                "longrope rope_scaling needs original_max_position_"
+                "embeddings (config level) or an explicit factor")
+        att = scaling.get("attention_factor")
+        if att is None:
+            att = (1.0 if factor <= 1.0
+                   else math.sqrt(1.0 + math.log(factor) / math.log(orig)))
+        # NOTE: the regime (short vs long factors) is chosen per rope
+        # TABLE by its seq_len (llama.py:rope_tables).  A generation
+        # whose horizon crosses orig uses one regime for the whole run;
+        # HF switches per step on such runs and diverges there.
+        return ("longrope", float(orig), float(att), short, long)
     if kind == "default":
         # transformers normalises "no scaling" configs to
         # {"rope_type": "default"} in some versions.
         return None
     raise NotImplementedError(
         f"rope_scaling={scaling!r} is not implemented here (linear, "
-        "llama3, and yarn are); converting would silently change the "
-        "rope frequencies vs transformers")
+        "llama3, yarn, and longrope are); converting would silently "
+        "change the rope frequencies vs transformers")
 
 
 def _norm_w(w, plus_one: bool) -> np.ndarray:

@@ -135,17 +135,37 @@ class LlamaConfig:
                 "would be silently ignored; set remat=True")
         if self.rope_scaling is not None:
             s = tuple(self.rope_scaling)
-            if not s or s[0] not in ("linear", "llama3", "yarn") or (
+            if not s or s[0] not in ("linear", "llama3", "yarn",
+                                     "longrope", "longrope_fixed") or (
                     s[0] == "linear" and len(s) != 2) or (
                     s[0] == "llama3" and len(s) != 5) or (
-                    s[0] == "yarn" and len(s) != 7):
+                    s[0] == "yarn" and len(s) != 7) or (
+                    s[0] == "longrope" and len(s) != 5) or (
+                    s[0] == "longrope_fixed" and len(s) != 3):
                 raise ValueError(
                     f"rope_scaling must be ('linear', factor), ('llama3', "
                     f"factor, low_freq_factor, high_freq_factor, "
-                    f"original_max_position_embeddings), or ('yarn', "
+                    f"original_max_position_embeddings), ('yarn', "
                     f"factor, original_max_position_embeddings, beta_fast, "
-                    f"beta_slow, attention_factor, truncate), got "
+                    f"beta_slow, attention_factor, truncate), or "
+                    f"('longrope', original_max_position_embeddings, "
+                    f"attention_factor, short_factors, long_factors), got "
                     f"{self.rope_scaling!r}")
+            if s[0] == "longrope":
+                short, long = tuple(s[3]), tuple(s[4])
+                half = self.head_dim // 2
+                if len(short) != half or len(long) != half:
+                    raise ValueError(
+                        f"longrope factor lists must have head_dim//2="
+                        f"{half} entries, got {len(short)}/{len(long)}")
+                s = (s[0], s[1], s[2], short, long)
+            elif s[0] == "longrope_fixed":
+                ext = tuple(s[2])
+                if len(ext) != self.head_dim // 2:
+                    raise ValueError(
+                        f"longrope_fixed factors must have head_dim//2="
+                        f"{self.head_dim // 2} entries, got {len(ext)}")
+                s = (s[0], s[1], ext)
             object.__setattr__(self, "rope_scaling", s)
 
     @property
@@ -371,6 +391,25 @@ def rope_tables(seq_len: int, head_dim: int, theta: float, scaling=None):
                 0.0, 1.0)
             extrap = 1.0 - ramp  # 1 where the dim extrapolates (short wl)
             inv_freq = (inv_freq / factor) * (1.0 - extrap) + inv_freq * extrap
+        elif kind == "longrope":
+            # LongRoPE (Phi-3.5/128k line; HF's longrope type): per-dim
+            # rescale factors, the SHORT set within the original training
+            # horizon and the LONG set beyond it — chosen by THIS table's
+            # seq_len, matching HF's per-call `seq_len > orig` switch.
+            # Multi-program runs (generate/serving build prefill AND
+            # decode tables at different lengths) must NOT use this form
+            # directly — mixed regimes within one run would silently
+            # break the cached keys' rotation geometry; they resolve the
+            # regime ONCE per run via resolve_longrope() below.
+            orig, att, short, long = scaling[1:]
+            ext = jnp.asarray(long if seq_len > orig else short,
+                              jnp.float32)
+            inv_freq = inv_freq / ext
+        elif kind == "longrope_fixed":
+            # Run-resolved longrope: one regime whatever this table's
+            # length (produced by resolve_longrope).
+            att, ext = scaling[1], jnp.asarray(scaling[2], jnp.float32)
+            inv_freq = inv_freq / ext
         else:  # LlamaConfig.__post_init__ already validated
             raise ValueError(f"unknown rope scaling kind {kind!r}")
     pos = jnp.arange(seq_len, dtype=jnp.float32)
@@ -384,6 +423,32 @@ def cfg_rope_tables(cfg: "LlamaConfig", seq_len: int):
     many call sites would silently mis-rotate positions)."""
     return rope_tables(seq_len, cfg.head_dim, cfg.rope_theta,
                        cfg.rope_scaling)
+
+
+def resolve_longrope(cfg: "LlamaConfig", horizon: int) -> "LlamaConfig":
+    """Pin a longrope config's factor regime to ``horizon`` (the run's
+    max total length) for the WHOLE run.
+
+    generate/serving/beam/speculative build prefill and decode tables at
+    DIFFERENT seq_lens; the raw ("longrope", ...) form keys the
+    short-vs-long choice off each table's own length, so a run with
+    prompt <= orig < horizon would rotate cached keys and decode queries
+    with different frequency sets — silently broken geometry.  This
+    returns a config whose rope_scaling is ("longrope_fixed",
+    attention_factor, ext_factors) chosen once by ``horizon``; every
+    table in the run then agrees.  (HF switches regimes per step on
+    horizon-crossing runs — a geometry-inconsistent quirk this design
+    deliberately does not reproduce.)  Non-longrope configs pass
+    through unchanged."""
+    import dataclasses
+
+    s = cfg.rope_scaling
+    if s is None or s[0] != "longrope":
+        return cfg
+    orig, att, short, long = s[1:]
+    ext = long if horizon > orig else short
+    return dataclasses.replace(
+        cfg, rope_scaling=("longrope_fixed", att, tuple(ext)))
 
 
 def apply_rope(x, cos, sin):

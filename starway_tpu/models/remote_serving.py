@@ -145,7 +145,12 @@ class RemoteSlotServer:
         buf = _recv_buf(n_words)
 
         def done(stag, length, buf=buf):
-            on_msg(int(stag), buf.view(np.int32)[:length // 4].copy())
+            try:
+                on_msg(int(stag), buf.view(np.int32)[:length // 4].copy())
+            except Exception:
+                # A sink crash must not break the re-post chain.
+                logger.exception("recv sink failed (tag type %x)",
+                                 tag >> TAG_TYPE_SHIFT)
             if not self._closed:
                 self._post_typed_recv(tag, n_words, on_msg)
 
@@ -169,10 +174,11 @@ class RemoteSlotServer:
             lambda stag, words: self._requests.append((stag, words)))
 
     def _post_cancel_recv(self) -> None:
-        self._post_typed_recv(
-            TAG_CANCEL, 1,
-            lambda stag, words: self._cancels.append(
-                (stag & _ID_MASK, int(words[0]))))
+        def on_msg(stag, words):
+            if len(words) >= 1:  # an empty CANCEL payload is just noise
+                self._cancels.append((stag & _ID_MASK, int(words[0])))
+
+        self._post_typed_recv(TAG_CANCEL, 1, on_msg)
 
     def _on_tokens(self, rid: int, tokens: list, done: bool) -> None:
         # Fires inside SlotServer.step() (executor thread); the drive
@@ -204,6 +210,8 @@ class RemoteSlotServer:
                     self._send_chunk(cid, nonce, [], STATUS_ABORTED)
                     break
             else:
+                if cid not in self._eps:
+                    continue  # junk/stale cid: nothing to stash for
                 # Not routed yet: the REQUEST may still be in flight
                 # behind this cancel.  Stash so submit rejects it.
                 self._pre_cancels[(cid, nonce)] = True

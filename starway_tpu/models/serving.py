@@ -292,6 +292,12 @@ class SlotServer:
         # provable droplessness keeps them independent (moe.py, the
         # single source of the rule).
         require_dropless(cfg, "continuous batching")
+        # LongRoPE: admit (bucket-length tables) and decode (max_len
+        # tables) must share one factor regime — pin it to the serving
+        # horizon (llama.resolve_longrope).
+        from .llama import resolve_longrope
+
+        cfg = resolve_longrope(cfg, max_len)
         self.rolling = cfg.sliding_window is not None
         if n_slots < 1 or chunk < 1:
             # Zero slots/chunk would make run() spin forever, not error.

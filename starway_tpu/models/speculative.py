@@ -506,6 +506,14 @@ def generate_speculative(params: dict, cfg: LlamaConfig, draft_params: dict,
     lengths = _validate_lengths(prompt_lengths, B, P)
     if key is None:
         key = jax.random.PRNGKey(0)
+    # LongRoPE regime resolves at the LOGICAL horizon (prompt + budget),
+    # BEFORE the gamma scratch headroom below — spec decode's contract is
+    # output-equivalence with generate() at the same request, and
+    # generate() resolves at this horizon (llama.resolve_longrope).
+    from .llama import resolve_longrope
+
+    cfg = resolve_longrope(cfg, P + max_new_tokens)
+    draft_cfg = resolve_longrope(draft_cfg, P + max_new_tokens)
     # Cache headroom: a macro step may write up to gamma - 1 positions
     # past the last kept token before the row's budget check stops it.
     max_len = P + max_new_tokens + gamma
@@ -604,6 +612,11 @@ def generate_lookup(params: dict, cfg: LlamaConfig, prompt,
     lengths = _validate_lengths(prompt_lengths, B, P)
     if key is None:
         key = jax.random.PRNGKey(0)
+    from .llama import resolve_longrope
+
+    cfg = resolve_longrope(cfg, P + max_new_tokens)  # logical horizon,
+    # matching generate()'s regime for the same request (spec decode's
+    # output-equivalence contract); the gamma headroom below is scratch.
     max_len = P + max_new_tokens + gamma
     if max_len == cfg.sliding_window:
         # Dodge chunk_decode_step's rolling-cache shape heuristic (a FULL

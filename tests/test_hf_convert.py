@@ -506,3 +506,135 @@ def test_qwen25_yarn_serves_end_to_end():
             params, cfg, jnp.asarray([prompt], jnp.int32),
             len(got))[0, len(prompt):])
         np.testing.assert_array_equal(got, want)
+
+
+def test_phi35_longrope_matches_transformers():
+    """Eighth served family: Phi-3.5/128k style = Phi-3 fused
+    projections + LongRoPE (per-dim short/long factor lists, regime by
+    seq_len).  Logits match transformers in BOTH regimes and greedy
+    generation is token-exact within the short regime.  (A generation
+    whose horizon crosses original_max_position_embeddings uses one
+    regime per compiled table; HF switches per step there — documented
+    at the conversion site.)"""
+    half = 16  # head_dim 32 -> 16 per-dim factors
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=256, original_max_position_embeddings=64,
+        rope_theta=10000.0, rope_scaling={
+            "type": "longrope",
+            "short_factor": [1.0 + 0.05 * i for i in range(half)],
+            "long_factor": [2.0 + 0.1 * i for i in range(half)]},
+        tie_word_embeddings=False, attn_implementation="eager",
+        pad_token_id=0)
+    torch.manual_seed(13)
+    hf = transformers.Phi3ForCausalLM(hf_cfg).eval()
+
+    cfg = config_from_hf(hf.config, dtype="float32")
+    assert cfg.rope_scaling[0] == "longrope"
+    # factor = max/orig = 4; attention factor sqrt(1 + ln4/ln64)
+    assert cfg.rope_scaling[2] == pytest.approx(
+        np.sqrt(1 + np.log(4.0) / np.log(64.0)))
+    assert len(cfg.rope_scaling[3]) == half
+    params = params_from_hf(hf, cfg)
+
+    for S in (50, 90):  # below and above orig: both factor regimes
+        tokens = np.random.default_rng(6).integers(0, 256, (2, S),
+                                                   dtype=np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(tokens)).logits.numpy()
+        ours = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32),
+                                  cfg))
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=2e-3)
+
+    prompt = np.asarray([[7, 3, 11, 5]], dtype=np.int64)
+    with torch.no_grad():
+        hf_gen = hf.generate(torch.from_numpy(prompt), max_new_tokens=10,
+                             do_sample=False, pad_token_id=0).numpy()
+    ours_gen = np.asarray(generate(params, cfg,
+                                   jnp.asarray(prompt, jnp.int32), 10))
+    np.testing.assert_array_equal(ours_gen[:, :hf_gen.shape[1]], hf_gen)
+
+    # Serve it through continuous batching (horizon inside one regime).
+    from starway_tpu.models import SlotServer
+
+    srv = SlotServer(params, cfg, n_slots=2, max_len=48, chunk=4)
+    rid = srv.submit([7, 3, 11, 5], 8)
+    done = srv.run()
+    want = np.asarray(generate(params, cfg,
+                               jnp.asarray([[7, 3, 11, 5]], jnp.int32),
+                               8)[0, 4:])
+    np.testing.assert_array_equal(done[rid], want)
+
+
+def test_phi35_longrope_crossing_horizon_consistent():
+    """Serving whose horizon crosses original_max_position_embeddings
+    (prompt bucket <= orig < max_len): every table in the run — bucketed
+    admit prefill AND max_len decode — must share ONE factor regime, so
+    SlotServer output equals generate() at the same horizon (both
+    resolved long).  Mixed regimes would silently break the cached
+    keys' rotation geometry."""
+    from starway_tpu.models import SlotServer
+    from starway_tpu.models.llama import resolve_longrope
+
+    half = 16
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=256, original_max_position_embeddings=32,
+        rope_theta=10000.0, rope_scaling={
+            "type": "longrope",
+            "short_factor": [1.0 + 0.05 * i for i in range(half)],
+            "long_factor": [2.0 + 0.1 * i for i in range(half)]},
+        tie_word_embeddings=False, attn_implementation="eager",
+        pad_token_id=0)
+    torch.manual_seed(17)
+    hf = transformers.Phi3ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf.config, dtype="float32")
+    params = params_from_hf(hf, cfg)
+
+    # Horizon 48 > orig 32; prompt (4) buckets at 32 <= orig.
+    resolved = resolve_longrope(cfg, 48)
+    assert resolved.rope_scaling[0] == "longrope_fixed"
+    assert resolved.rope_scaling[2] == cfg.rope_scaling[4]  # long set
+
+    prompt = [7, 3, 11, 5]
+    srv = SlotServer(params, cfg, n_slots=2, max_len=48, chunk=4)
+    rid = srv.submit(prompt, 12)
+    done = srv.run()
+    want = np.asarray(generate(
+        params, cfg, jnp.asarray([prompt], jnp.int32), 12,
+        max_len=48)[0, len(prompt):])
+    np.testing.assert_array_equal(done[rid], want)
+
+
+def test_phi35_longrope_speculative_matches_generate():
+    """Speculative decode resolves the LongRoPE regime at the LOGICAL
+    horizon (prompt + budget), not the gamma-padded cache length — with
+    orig inside the gamma window, a cache-length resolution would pin
+    the other factor set and diverge from generate() for the identical
+    request."""
+    from starway_tpu.models.speculative import generate_lookup
+
+    half = 16
+    # P=4, max_new=12 -> logical horizon 16 <= orig=18 < 16+gamma.
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=256, original_max_position_embeddings=18,
+        rope_theta=10000.0, rope_scaling={
+            "type": "longrope",
+            "short_factor": [1.0 + 0.05 * i for i in range(half)],
+            "long_factor": [2.0 + 0.1 * i for i in range(half)]},
+        tie_word_embeddings=False, attn_implementation="eager",
+        pad_token_id=0)
+    torch.manual_seed(19)
+    hf = transformers.Phi3ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf.config, dtype="float32")
+    params = params_from_hf(hf, cfg)
+
+    prompt = jnp.asarray([[7, 3, 11, 5]], jnp.int32)
+    want = np.asarray(generate(params, cfg, prompt, 12))
+    got = np.asarray(generate_lookup(params, cfg, prompt, 12, gamma=4,
+                                     ngram=2))
+    np.testing.assert_array_equal(got, want)
