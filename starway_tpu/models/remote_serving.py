@@ -197,6 +197,8 @@ class RemoteSlotServer:
                     # stream is wasted chip time: free the slot too.
                     self.slot.cancel(rid)
                     del self._rid_route[rid]
+            for k in [k for k in self._pre_cancels if k[0] == cid]:
+                self._pre_cancels.pop(k, None)  # free the stash budget
 
     def _drain_cancels(self) -> None:
         while self._cancels:
@@ -214,9 +216,12 @@ class RemoteSlotServer:
                     continue  # junk/stale cid: nothing to stash for
                 # Not routed yet: the REQUEST may still be in flight
                 # behind this cancel.  Stash so submit rejects it.
+                # Budget is PER CLIENT so one cancel-spraying peer
+                # cannot evict another client's genuine pre-cancel.
                 self._pre_cancels[(cid, nonce)] = True
-                while len(self._pre_cancels) > 1024:
-                    self._pre_cancels.pop(next(iter(self._pre_cancels)))
+                mine = [k for k in self._pre_cancels if k[0] == cid]
+                for k in mine[:max(0, len(mine) - 64)]:
+                    self._pre_cancels.pop(k, None)
 
     def _flush_assigns(self) -> None:
         while self._unassigned:
