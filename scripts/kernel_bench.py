@@ -375,6 +375,44 @@ def _train_mfu_row(metric: str, cfg_kw: dict, B: int, S: int, iters: int,
                       f"B={B} S={S} remat={cfg.remat}, {dt*1e3:.1f} ms/step"}
 
 
+def bench_decode_paged(b=1, hq=8, hkv=2, t=8192, d=128, page=512,
+                       iters: int = 64):
+    """Paged vs dense decode at the headline shape: the page-table
+    indirection must cost ~nothing (same bytes, same stream structure —
+    ops/pallas_paged.py) while buying pool-granularity memory.  Emits the
+    paged us/token row; compare against the adjacent decode_ours row."""
+    import numpy as np
+
+    from starway_tpu.ops.pallas_paged import paged_decode_attention
+
+    rng = np.random.default_rng(0)
+    max_pages = t // page
+    n_pages = b * max_pages + 1
+    kp = jnp.asarray(rng.standard_normal((n_pages, hkv, page, d)),
+                     jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((n_pages, hkv, page, d)),
+                     jnp.bfloat16)
+    table = jnp.asarray(
+        rng.permutation(np.arange(1, n_pages))[:b * max_pages].reshape(
+            b, max_pages), jnp.int32)
+    q = jnp.asarray(rng.standard_normal((b, hq, 1, d)), jnp.bfloat16)
+    pos = jnp.full((b,), t - 1, jnp.int32)
+    cache_bytes = 2 * b * hkv * t * d * kp.dtype.itemsize
+
+    def kern(q, kp, vp):
+        return paged_decode_attention(q, kp, vp, table, pos)
+
+    dt = _timeit(lambda q, kp, vp, iters: _chain(kern, q, kp, vp,
+                                                 iters=iters),
+                 q, kp, vp, iters=iters)
+    return {"metric": "decode_paged_us_per_token",
+            "value": round(dt * 1e6, 2), "unit": "us",
+            "detail": f"B={b} Hq={hq} Hkv={hkv} T={t} page={page} bf16 "
+                      f"scrambled tables, streamed {cache_bytes / 1e6:.1f} "
+                      f"MB -> {cache_bytes / dt / 1e9:.0f} GB/s effective "
+                      f"(compare decode_ours_us_per_token)"}
+
+
 def bench_decode_shapes(iters: int = 64, shapes=None):
     """Ours-vs-lax decode at the VERDICT r2 acceptance shapes: besides the
     headline (B=1, Hkv=2, T=8192 — measured by the adjacent
@@ -955,6 +993,7 @@ REHEARSAL_KW = {
     "decode_lax": dict(t=512, iters=2),
     "decode_int8": dict(t=512, iters=2),
     "decode_tune": dict(t=512, iters=2),
+    "decode_paged": dict(t=512, page=128, iters=2),
     "decode_shapes": dict(
         iters=2, shapes=[(2, 8, 2, 256), (1, 8, 4, 256), (2, 8, 1, 512)]),
     "train_mfu": dict(iters=2, B=2, S=128),
@@ -983,6 +1022,7 @@ BENCHES = {
     "decode_lax": functools.partial(bench_decode, impl="lax"),
     "decode_int8": functools.partial(bench_decode, impl="int8"),
     "decode_tune": bench_decode_tune,
+    "decode_paged": bench_decode_paged,
     "decode_shapes": bench_decode_shapes,
     "train_mfu": bench_train_mfu,
     "train_mfu_large": bench_train_mfu_large,

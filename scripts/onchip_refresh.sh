@@ -116,6 +116,7 @@ run serve_mixtral    serve_mixtral_b1_tokens_per_s      # dropless top-2 MoE dec
 run serve_ragged_b8  serve_llama_ragged_b8_tokens_per_s # mixed prompt lengths
 run serve_continuous serve_continuous_tokens_per_s      # wall-clock through slot reuse
 run decode_int8      decode_int8_us_per_token           # half-width int8 cache stream
+run decode_paged     decode_paged_us_per_token          # page-table stream vs dense (expect ~decode_ours)
 run serve_int8_b8    serve_llama_int8_b8_tokens_per_s   # int8 cache end to end
 run spec_verify      spec_verify_amortisation           # chunk verify vs gamma decode steps
 run serve_prefix     serve_prefix_admit_speedup         # prefix-cached admission vs full prefill

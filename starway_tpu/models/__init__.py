@@ -28,6 +28,7 @@ from .pp_llama import (
 from .beam import generate_beam
 from .generate import (generate, init_cache, init_rolling_cache, prefill,
                        prefill_rolling)
+from .paged import PagedSlotServer, init_paged_pool, paged_decode_step
 from .remote_serving import RemoteGenerateSession, RemoteSlotServer
 from .serving import SlotServer
 from .trainer import Trainer
@@ -51,6 +52,7 @@ __all__ = [
     "ppv_split_params",
     "ppv_merge_params",
     "shard_ppv_params",
+    "PagedSlotServer",
     "RemoteGenerateSession",
     "RemoteSlotServer",
     "SlotServer",

@@ -1,0 +1,269 @@
+"""Paged continuous batching: the serving cache as a shared page pool.
+
+The dense :class:`~starway_tpu.models.serving.SlotServer` cache reserves
+``n_slots x max_len`` positions whatever the requests actually use;
+paging (the vLLM block-table idea, built TPU-first here) shares one pool
+of fixed-size pages across slots, so HBM scales with LIVE tokens:
+
+* pool ``k/v [L, n_pages, Hkv, page, D]`` — sized by expected total
+  tokens in flight, independent of ``n_slots x max_len``;
+* host-managed page tables ``[n_slots, max_pages]`` + free list; pages
+  allocate lazily as each cursor grows and return to the pool the
+  moment a request finishes or is cancelled;
+* decode attention walks the table INSIDE the pallas kernel's DMA
+  stream (ops/pallas_paged.py) — no dense view is ever materialised,
+  and bandwidth per token equals the dense stream kernel's.
+
+Page id 0 is a reserved TRASH page: freed slots' table rows point at it,
+so the chunk program's frozen-cursor writes for dead slots (the dense
+design's "overwritten before read" invariant does not survive page
+REUSE) land in scratch that no live slot ever attends.
+
+Greedy outputs are bit-identical to the dense SlotServer and the
+standalone ``generate()`` oracle (tests/test_paged.py) — paging changes
+WHERE bytes live, never what attention computes.  v1 scope: full-causal
+bf16/f32 models (no sliding-window/rolling, no int8 pools, no prefix
+sharing — each refused loudly).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.pallas_paged import paged_decode_attention
+from .generate import _sample, cached_layer_scan, prefill
+from .llama import LlamaConfig, cfg_rope_tables, embed_tokens, matmul_w, rmsnorm
+from .serving import SlotServer, _bucket, make_chunk_scan_step
+
+
+def init_paged_pool(cfg: LlamaConfig, n_pages: int, page: int) -> dict:
+    """k/v pools ``[L, n_pages, Hkv, page, D]`` (page 0 is the trash
+    page)."""
+    hd = cfg.head_dim
+    shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page, hd)
+    return {"k": jnp.zeros(shape, cfg.compute_dtype),
+            "v": jnp.zeros(shape, cfg.compute_dtype)}
+
+
+def paged_decode_step(params, pool, table, token, pos, cfg: LlamaConfig,
+                      rope):
+    """One token in, next-token logits out, over the paged pool.
+
+    Mirrors :func:`~starway_tpu.models.generate.decode_step` exactly —
+    same :func:`cached_layer_scan` body — with page-table write/attend
+    closures: the write scatters each slot's k/v into
+    ``pool[table[b, pos_b // page], head, pos_b % page]``, and attention
+    streams the slot's pages through the paged kernel.  token/pos: [B]
+    (per-slot cursors, the serving shape)."""
+    page = pool["k"].shape[3]
+    cos, sin = rope
+    pos = jnp.asarray(pos, jnp.int32)
+    pids = jnp.take_along_axis(table, (pos // page)[:, None], axis=1)[:, 0]
+    offs = pos % page
+    cos_p = cos[pos][:, None, None, :]
+    sin_p = sin[pos][:, None, None, :]
+
+    def write(c, u):
+        # c [n_pages, Hkv, page, D] (one layer's pool slice in the scan);
+        # u [B, Hkv, 1, D].  Distinct slots own distinct pages (allocator
+        # invariant), so the scatter indices never collide.
+        return c.at[pids, :, offs, :].set(u[:, :, 0, :])
+
+    def attend(q, lc):
+        return paged_decode_attention(q, lc["k"], lc["v"], table, pos)
+
+    h = embed_tokens(params, token, cfg)[:, None, :]
+    h, out = cached_layer_scan(params, pool, h, cos_p, sin_p, cfg, write,
+                               attend)
+    h = rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    logits = matmul_w(h[:, 0, :], params["lm_head"]).astype(jnp.float32)
+    return logits, out
+
+
+@functools.cache
+def _compiled_paged_admit(cfg: LlamaConfig, p_bucket: int, page: int,
+                          temperature: float, top_k: Optional[int],
+                          top_p: Optional[float]):
+    """Prefill one request and scatter its cache into ``p_bucket // page``
+    pool pages; returns (pool, first token).  One compile per bucket."""
+    npb = p_bucket // page
+
+    def run(params, pool, prompt, length, pids, key):
+        logits, small = prefill(params, cfg, prompt, p_bucket,
+                                logit_positions=length[None] - 1)
+        pool = dict(pool)
+        for name in ("k", "v"):
+            # small[name] [L, 1, Hkv, p_bucket, D] -> [L, npb, Hkv, page, D]
+            L, _, hkv, _, d = small[name].shape
+            paged = small[name].reshape(L, hkv, npb, page, d).transpose(
+                0, 2, 1, 3, 4)
+            pool[name] = pool[name].at[:, pids].set(paged)
+        tok = _sample(logits, key, temperature, top_k, top_p)[0]
+        return pool, tok
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+@functools.cache
+def _compiled_paged_chunk(cfg: LlamaConfig, max_len: int, chunk: int,
+                          temperature: float, top_k: Optional[int],
+                          top_p: Optional[float], eos_id: Optional[int]):
+    """The chunk program over the pool: identical control flow to the
+    dense ``_compiled_chunk`` (liveness, budgets, eos, emission mask) —
+    only the decode step is paged."""
+    rope = cfg_rope_tables(cfg, max_len)
+
+    def run(params, pool, table, token, pos, live, remaining, key):
+        step = make_chunk_scan_step(
+            lambda pool, token, pos: paged_decode_step(
+                params, pool, table, token, pos, cfg, rope),
+            max_len, temperature, top_k, top_p, eos_id)
+        (pool, token, pos, live, remaining, key), (toks, mask) = lax.scan(
+            step, (pool, token, pos, live, remaining, key), None,
+            length=chunk)
+        return pool, token, pos, live, remaining, key, toks, mask
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+class PagedSlotServer(SlotServer):
+    """Continuous batching over a shared page pool.
+
+    >>> srv = PagedSlotServer(params, cfg, n_slots=8, max_len=512,
+    ...                       page=64, n_pages=33)
+    >>> rid = srv.submit(prompt, max_new_tokens=32)
+    >>> done = srv.run()
+
+    Same queue/streaming/cancel surface and the same greedy-equals-
+    ``generate()`` guarantee as the dense server; the difference is
+    memory: ``n_pages`` bounds TOTAL live tokens (``(n_pages - 1) *
+    page``), not per-slot reservations, so short requests don't pay for
+    ``max_len``, and pages recycle the moment a request finishes.
+    A request whose prompt the pool cannot cover yet simply STAYS
+    QUEUED (step() catches the allocator's RuntimeError and retries once
+    in-flight work frees pages); lazy per-chunk growth exhausting the
+    pool mid-generation raises RuntimeError — preemption is not wired,
+    so size ``n_pages`` for the expected concurrency.
+    """
+
+    def __init__(self, params, cfg: LlamaConfig, *, n_slots: int = 4,
+                 max_len: int = 512, page: int = 64,
+                 n_pages: Optional[int] = None, chunk: int = 8,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 on_tokens=None):
+        if cfg.sliding_window is not None:
+            raise NotImplementedError(
+                "paged serving v1 is full-causal; sliding-window models "
+                "already serve in O(window) via the rolling SlotServer")
+        if cfg.kv_quant != "none":
+            raise NotImplementedError(
+                "int8 paged pools are not wired yet; use the dense "
+                "SlotServer for kv_quant='int8'")
+        if max_len % page:
+            raise ValueError(f"page ({page}) must divide max_len "
+                             f"({max_len})")
+        self.page = int(page)
+        self.max_pages = max_len // page
+        if n_pages is None:
+            n_pages = 1 + n_slots * self.max_pages  # dense-equivalent
+        if n_pages < 2:
+            raise ValueError("need n_pages >= 2 (page 0 is the trash page)")
+        self.n_pages = int(n_pages)
+        # Buckets must be page multiples so admission scatters whole pages.
+        b, buckets = page, []
+        while b < max_len:
+            buckets.append(b)
+            b *= 2
+        buckets.append(max_len)
+        super().__init__(params, cfg, n_slots=n_slots, max_len=max_len,
+                         chunk=chunk, temperature=temperature, top_k=top_k,
+                         top_p=top_p, eos_id=eos_id,
+                         prompt_buckets=tuple(sorted(set(buckets))),
+                         seed=seed, on_tokens=on_tokens)
+
+    # ------------------------------------------------------------- hooks
+    def _make_cache(self):
+        return init_paged_pool(self.cfg, self.n_pages, self.page)
+
+    def _post_init(self) -> None:
+        # Host-side allocator: every slot starts on the trash page.
+        self._tables = np.zeros((self.n_slots, self.max_pages), np.int32)
+        self._free = list(range(1, self.n_pages))
+
+    def _on_slot_freed(self, slot: int) -> None:
+        for pid in self._tables[slot]:
+            if pid != 0:
+                self._free.append(int(pid))
+        self._tables[slot] = 0
+
+    @property
+    def pages_in_use(self) -> int:
+        """Live pool pages (the memory the paging saves elsewhere)."""
+        return self.n_pages - 1 - len(self._free)
+
+    def _alloc_to(self, slot: int, n_needed: int) -> None:
+        row = self._tables[slot]
+        have = int((row != 0).sum())
+        if n_needed > self.max_pages:
+            n_needed = self.max_pages
+        if n_needed > have and len(self._free) < n_needed - have:
+            raise RuntimeError(
+                f"page pool exhausted: slot {slot} needs "
+                f"{n_needed - have} more page(s), {len(self._free)} free "
+                f"(n_pages={self.n_pages}); finish/cancel requests or "
+                f"size the pool for the workload")
+        for i in range(have, n_needed):
+            row[i] = self._free.pop()
+
+    # --------------------------------------------------------- admission
+    def register_prefix(self, tokens) -> int:
+        raise NotImplementedError(
+            "prefix caching over the page pool (shared read-only pages) "
+            "is not wired yet; use the dense SlotServer for prefixes")
+
+    def _admit(self, slot: int, rid: int, prompt: np.ndarray,
+               max_new: int, prefix=None) -> None:
+        assert prefix is None  # submit() rejects prefixes (no registry)
+        self.key, sub = jax.random.split(self.key)
+        pb = _bucket(len(prompt), self.buckets)
+        self._alloc_to(slot, pb // self.page)
+        padded = np.zeros((1, pb), np.int32)
+        padded[0, :len(prompt)] = prompt
+        pids = jnp.asarray(self._tables[slot, :pb // self.page])
+        admit = _compiled_paged_admit(self.cfg, pb, self.page,
+                                      *self.sampling)
+        self.cache, tok = admit(self.params, self.cache,
+                                jnp.asarray(padded),
+                                jnp.asarray(len(prompt), jnp.int32),
+                                pids, sub)
+        self._finish_admit(slot, rid, tok, len(prompt), max_new)
+
+    # ------------------------------------------------------------ decode
+    def _run_chunk(self, sub):
+        # Lazy growth: every live slot needs pages covering its cursor's
+        # reach this chunk (writes go through table[pos // page]).
+        live = np.asarray(self.live)
+        pos = np.asarray(self.pos)
+        for slot in range(self.n_slots):
+            if live[slot]:
+                # The chunk writes positions pos .. pos+chunk-1 (reads
+                # only written positions), so the last page touched is
+                # (pos+chunk-1) // page.
+                reach = min(int(pos[slot]) + self.chunk, self.max_len)
+                self._alloc_to(slot, -(-reach // self.page))
+        run = _compiled_paged_chunk(self.cfg, self.max_len, self.chunk,
+                                    *self.sampling, self.eos_id)
+        (self.cache, self.token, self.pos, self.live, self.remaining,
+         _key, toks, mask) = run(self.params, self.cache,
+                                 jnp.asarray(self._tables), self.token,
+                                 self.pos, self.live, self.remaining, sub)
+        return toks, mask

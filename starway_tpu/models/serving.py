@@ -228,33 +228,47 @@ def _compiled_chunk(cfg: LlamaConfig, n_slots: int, max_len: int, chunk: int,
     rope = cfg_rope_tables(cfg, max_len)
 
     def run(params, cache, token, pos, live, remaining, key):
-        def step(carry, _):
-            cache, token, pos, live, remaining, key = carry
-            logits, cache = decode_step(params, cache, token, pos, cfg, rope,
-                                        rolling=rolling)
-            key, sub = jax.random.split(key)
-            nxt = _sample(logits, sub, temperature, top_k, top_p)
-            emit_live = live & (remaining > 0)
-            if eos_id is not None:
-                newly_done = emit_live & (nxt == eos_id)
-            else:
-                newly_done = jnp.zeros_like(emit_live)
-            remaining = remaining - emit_live.astype(jnp.int32)
-            live = emit_live & ~newly_done & (remaining > 0) & (
-                pos + 2 < max_len)
-            # Dead slots freeze: cursor stays, pending token irrelevant
-            # (their cache writes land on a position admission or the
-            # cursor overwrites before any read).
-            pos = pos + emit_live.astype(jnp.int32)
-            token = jnp.where(emit_live, nxt, token)
-            return (cache, token, pos, live, remaining, key), (nxt, emit_live)
-
+        step = make_chunk_scan_step(
+            lambda cache, token, pos: decode_step(
+                params, cache, token, pos, cfg, rope, rolling=rolling),
+            max_len, temperature, top_k, top_p, eos_id)
         (cache, token, pos, live, remaining, key), (toks, mask) = lax.scan(
             step, (cache, token, pos, live, remaining, key), None,
             length=chunk)
         return cache, token, pos, live, remaining, key, toks, mask
 
     return jax.jit(run, donate_argnums=(1,))
+
+
+def make_chunk_scan_step(decode_one, max_len: int, temperature: float,
+                         top_k, top_p, eos_id):
+    """THE per-step body of every chunked serving loop — dense and paged
+    (models/paged.py) scan exactly this, so the liveness/eos/budget/
+    emission semantics cannot drift between cache layouts.
+    ``decode_one(cache, token, pos) -> (logits, cache)``."""
+
+    def step(carry, _):
+        cache, token, pos, live, remaining, key = carry
+        logits, cache = decode_one(cache, token, pos)
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, sub, temperature, top_k, top_p)
+        emit_live = live & (remaining > 0)
+        if eos_id is not None:
+            newly_done = emit_live & (nxt == eos_id)
+        else:
+            newly_done = jnp.zeros_like(emit_live)
+        remaining = remaining - emit_live.astype(jnp.int32)
+        live = emit_live & ~newly_done & (remaining > 0) & (
+            pos + 2 < max_len)
+        # Dead slots freeze: cursor stays, pending token irrelevant
+        # (their cache writes land on a position admission or the
+        # cursor overwrites before any read — or, paged, in the trash
+        # page).
+        pos = pos + emit_live.astype(jnp.int32)
+        token = jnp.where(emit_live, nxt, token)
+        return (cache, token, pos, live, remaining, key), (nxt, emit_live)
+
+    return step
 
 
 class SlotServer:
@@ -331,9 +345,9 @@ class SlotServer:
 
         # Rolling (sliding-window) models keep an O(window) circular cache
         # per slot; max_len then bounds the ROPE horizon (prompt + budget),
-        # not cache memory.
-        self.cache = (init_rolling_cache(cfg, n_slots) if self.rolling
-                      else init_cache(cfg, n_slots, max_len))
+        # not cache memory.  (_make_cache is a subclass hook: the paged
+        # server allocates a shared page pool instead — models/paged.py.)
+        self.cache = self._make_cache()
         self.token = jnp.zeros((n_slots,), jnp.int32)
         self.pos = jnp.zeros((n_slots,), jnp.int32)
         self.live = jnp.zeros((n_slots,), bool)
@@ -351,9 +365,21 @@ class SlotServer:
         # (models/remote_serving.py) rides this to stream tokens over the
         # wire without waiting for full completion.
         self.on_tokens = on_tokens
+        self._post_init()
         self._next_pid = 0
 
     # ------------------------------------------------------------ intake
+    def _make_cache(self):
+        return (init_rolling_cache(self.cfg, self.n_slots) if self.rolling
+                else init_cache(self.cfg, self.n_slots, self.max_len))
+
+    def _post_init(self) -> None:
+        """Subclass hook, called at the end of __init__."""
+
+    def _on_slot_freed(self, slot: int) -> None:
+        """Subclass hook: a slot's request finished or was cancelled (the
+        paged server returns its pages to the pool here)."""
+
     def register_prefix(self, tokens) -> int:
         """Prefill a shared PREFIX (system prompt, few-shot preamble) once
         and return its id; requests submitted with ``prefix=pid`` reuse
@@ -476,6 +502,13 @@ class SlotServer:
                 self.params, self.cache, jnp.asarray(padded),
                 jnp.asarray(len(prompt), jnp.int32),
                 jnp.asarray(slot, jnp.int32), sub)
+        self._finish_admit(slot, rid, tok, plen + len(prompt), max_new)
+
+    def _finish_admit(self, slot: int, rid: int, tok, cursor: int,
+                      max_new: int) -> None:
+        """Shared tail of every admission path (dense, prefix, rolling,
+        paged): record the first token, fire the streaming hook, and set
+        the slot's cursor/liveness/budget."""
         tok_host = int(tok)
         self._slot_rid[slot] = rid
         self._collected[rid] = [tok_host]
@@ -489,7 +522,7 @@ class SlotServer:
         done = (max_new == 1 or
                 (self.eos_id is not None and tok_host == self.eos_id))
         self.token = self.token.at[slot].set(tok_host)
-        self.pos = self.pos.at[slot].set(plen + len(prompt))
+        self.pos = self.pos.at[slot].set(cursor)
         self.live = self.live.at[slot].set(not done)
         self.remaining = self.remaining.at[slot].set(max_new - 1)
 
@@ -513,6 +546,7 @@ class SlotServer:
                 self.remaining = self.remaining.at[slot].set(0)
                 del self._slot_rid[slot]
                 self._collected.pop(rid, None)
+                self._on_slot_freed(slot)
                 return True
         return False
 
@@ -529,6 +563,7 @@ class SlotServer:
                 finished[rid] = np.asarray(self._collected.pop(rid),
                                            np.int32)
                 self._slot_rid.pop(slot, None)
+                self._on_slot_freed(slot)
                 if self.on_tokens is not None:
                     self.on_tokens(rid, [], True)
 
@@ -540,18 +575,21 @@ class SlotServer:
         free = [s for s in range(self.n_slots) if s not in self._slot_rid]
         while free and self._pending:
             rid, prompt, max_new, prefix = self._pending.popleft()
-            self._admit(free.pop(0), rid, prompt, max_new, prefix)
+            try:
+                self._admit(free.pop(0), rid, prompt, max_new, prefix)
+            except RuntimeError:
+                # Transient resource exhaustion (the paged server's pool):
+                # the request STAYS QUEUED — in-flight work frees capacity
+                # and a later step admits it (the class docstring's
+                # "callers keep it queued / retry" contract).
+                self._pending.appendleft((rid, prompt, max_new, prefix))
+                break
         self._harvest_dead(finished)
         if not self._slot_rid:
             return finished
 
-        run = _compiled_chunk(self.cfg, self.n_slots, self.max_len,
-                              self.chunk, *self.sampling, self.eos_id,
-                              rolling=self.rolling)
         self.key, sub = jax.random.split(self.key)
-        (self.cache, self.token, self.pos, self.live, self.remaining,
-         _key, toks, mask) = run(self.params, self.cache, self.token,
-                                 self.pos, self.live, self.remaining, sub)
+        toks, mask = self._run_chunk(sub)
         toks = np.asarray(toks)
         mask = np.asarray(mask)
         # Snapshot: an on_tokens callback may legally cancel() a request
@@ -570,6 +608,17 @@ class SlotServer:
     def busy(self) -> bool:
         """True while any request is queued or occupying a slot."""
         return bool(self._pending or self._slot_rid)
+
+    def _run_chunk(self, sub):
+        """Advance one decode chunk (subclass hook: the paged server runs
+        its page-table program here); returns (tokens, mask)."""
+        run = _compiled_chunk(self.cfg, self.n_slots, self.max_len,
+                              self.chunk, *self.sampling, self.eos_id,
+                              rolling=self.rolling)
+        (self.cache, self.token, self.pos, self.live, self.remaining,
+         _key, toks, mask) = run(self.params, self.cache, self.token,
+                                 self.pos, self.live, self.remaining, sub)
+        return toks, mask
 
     def run(self) -> dict:
         """Drive step() until every submitted request has finished."""
