@@ -19,11 +19,17 @@ so the chunk program's frozen-cursor writes for dead slots (the dense
 design's "overwritten before read" invariant does not survive page
 REUSE) land in scratch that no live slot ever attends.
 
+PREFIX SHARING, zero copy: a registered prefix's whole pages are
+REFERENCED by every suffix request (refcounted; only the partial tail
+page the suffix continues inside is copied per slot) — strictly less
+admission work and less memory than the dense server's per-slot row
+copy, and the natural payoff of the paged layout.
+
 Greedy outputs are bit-identical to the dense SlotServer and the
 standalone ``generate()`` oracle (tests/test_paged.py) — paging changes
 WHERE bytes live, never what attention computes.  v1 scope: full-causal
-bf16/f32 models (no sliding-window/rolling, no int8 pools, no prefix
-sharing — each refused loudly).
+bf16/f32 models (sliding-window/rolling and int8 pools are refused
+loudly).
 """
 
 from __future__ import annotations
@@ -133,6 +139,92 @@ def _compiled_paged_chunk(cfg: LlamaConfig, max_len: int, chunk: int,
     return jax.jit(run, donate_argnums=(1,))
 
 
+@functools.cache
+def _compiled_paged_prefix_write(cfg: LlamaConfig, p_bucket: int, page: int,
+                                 n_full: int):
+    """Prefill a PREFIX once; scatter its ``n_full`` whole pages into the
+    pool and return the remainder as one padded tail page (junk above
+    ``plen % page`` — overwritten by the suffix ingest before any read).
+    One compile per (prefix bucket, n_full)."""
+
+    def run(params, pool, prompt, length, pids):
+        _logits, small = prefill(params, cfg, prompt, p_bucket,
+                                 logit_positions=length[None] - 1)
+        tails = {}
+        pool = dict(pool)
+        for name in ("k", "v"):
+            L, _, hkv, _, d = small[name].shape
+            paged = small[name].reshape(L, hkv, p_bucket // page, page,
+                                        d).transpose(0, 2, 1, 3, 4)
+            if n_full:
+                pool[name] = pool[name].at[:, pids].set(paged[:, :n_full])
+            # The page holding positions [n_full*page, plen): the suffix
+            # continues inside it, so it is copied per slot, not shared.
+            tails[name] = (paged[:, n_full] if n_full < p_bucket // page
+                           else jnp.zeros((L, hkv, page, d),
+                                          small[name].dtype))
+        return pool, tails["k"], tails["v"]
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+@functools.cache
+def _compiled_paged_prefix_admit(cfg: LlamaConfig, s_bucket: int, page: int,
+                                 max_pages: int, has_tail: bool,
+                                 temperature: float, top_k: Optional[int],
+                                 top_p: Optional[float]):
+    """Admit (shared prefix, fresh suffix): copy the prefix's partial
+    tail page into the slot's first OWN page, then ingest the suffix as
+    ONE C=s_bucket chunk forward over the page table (write-then-attend,
+    the decode-path semantics) and sample from the suffix's last real
+    position.  One compile per (suffix bucket, has_tail) — plen is a
+    traced argument, so prefixes of any length share the program."""
+    rope = cfg_rope_tables(cfg, max_pages * page)
+    cos, sin = rope
+
+    def run(params, pool, tail_k, tail_v, row, suffix, s_len, plen, key):
+        pool = dict(pool)
+        if has_tail:
+            own0 = row[0, plen // page]
+            pool["k"] = pool["k"].at[:, own0].set(tail_k)
+            pool["v"] = pool["v"].at[:, own0].set(tail_v)
+
+        spos = plen + jnp.arange(s_bucket)      # suffix positions
+        pids_c = row[0, spos // page]
+        offs = spos % page
+        cos_p = cos[spos][None, None, :, :]
+        sin_p = sin[spos][None, None, :, :]
+
+        def write(c, u):
+            # u [1, Hkv, s_bucket, D] -> scatter rows at (pid, :, off).
+            return c.at[pids_c, :, offs, :].set(u[0].transpose(1, 0, 2))
+
+        def attend(q, lc):
+            return paged_decode_attention(q, lc["k"], lc["v"], row,
+                                          plen[None])
+
+        from .llama import embed_tokens, head_logits
+
+        h = embed_tokens(params, suffix[0], cfg)[None]  # [1, s_bucket, D]
+        h, pool = cached_layer_scan(params, pool, h, cos_p, sin_p, cfg,
+                                    write, attend)
+        logits = head_logits(h[:, s_len - 1][:, None], params["final_norm"],
+                             params["lm_head"],
+                             cfg.norm_eps)[:, 0]
+        tok = _sample(logits, key, temperature, top_k, top_p)[0]
+        return pool, tok
+
+    if not has_tail:
+        # No tail operands at all: page-aligned prefixes must not pay two
+        # dead [L, Hkv, page, D] transfers per admission.
+        def run_no_tail(params, pool, row, suffix, s_len, plen, key):
+            return run(params, pool, None, None, row, suffix, s_len, plen,
+                       key)
+
+        return jax.jit(run_no_tail, donate_argnums=(1,))
+    return jax.jit(run, donate_argnums=(1,))
+
+
 class PagedSlotServer(SlotServer):
     """Continuous batching over a shared page pool.
 
@@ -198,11 +290,22 @@ class PagedSlotServer(SlotServer):
         # Host-side allocator: every slot starts on the trash page.
         self._tables = np.zeros((self.n_slots, self.max_pages), np.int32)
         self._free = list(range(1, self.n_pages))
+        # Shared prefix pages: refcount = registry (1) + referencing
+        # slots; a page returns to the pool at refcount 0.
+        self._page_refs: dict[int, int] = {}
 
     def _on_slot_freed(self, slot: int) -> None:
         for pid in self._tables[slot]:
-            if pid != 0:
-                self._free.append(int(pid))
+            pid = int(pid)
+            if pid == 0:
+                continue
+            if pid in self._page_refs:  # shared prefix page
+                self._page_refs[pid] -= 1
+                if self._page_refs[pid] == 0:  # prefix already dropped
+                    del self._page_refs[pid]
+                    self._free.append(pid)
+            else:
+                self._free.append(pid)
         self._tables[slot] = 0
 
     @property
@@ -211,6 +314,8 @@ class PagedSlotServer(SlotServer):
         return self.n_pages - 1 - len(self._free)
 
     def _alloc_to(self, slot: int, n_needed: int) -> None:
+        """Extend the slot's table to ``n_needed`` pages (the prefix of
+        the row is whatever admission set — shared or owned)."""
         row = self._tables[slot]
         have = int((row != 0).sum())
         if n_needed > self.max_pages:
@@ -226,13 +331,60 @@ class PagedSlotServer(SlotServer):
 
     # --------------------------------------------------------- admission
     def register_prefix(self, tokens) -> int:
-        raise NotImplementedError(
-            "prefix caching over the page pool (shared read-only pages) "
-            "is not wired yet; use the dense SlotServer for prefixes")
+        """Prefill a shared prefix ONCE into pool pages; requests with
+        ``prefix=pid`` then REFERENCE its whole pages (zero copy, pages
+        refcounted across slots) and copy only the partial tail page the
+        suffix continues inside — strictly less admission work AND less
+        memory than the dense server's per-slot row copy."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if len(tokens) < 1:
+            raise ValueError("empty prefix")
+        if len(tokens) + self.buckets[0] + 1 > self.max_len:
+            raise ValueError(
+                f"prefix ({len(tokens)}) + smallest suffix bucket "
+                f"({self.buckets[0]}) + 1 exceeds max_len={self.max_len}")
+        plen = len(tokens)
+        n_full = plen // self.page
+        if len(self._free) < n_full:
+            raise RuntimeError(
+                f"page pool exhausted: prefix needs {n_full} page(s), "
+                f"{len(self._free)} free")
+        pids = [self._free.pop() for _ in range(n_full)]
+        pb = _bucket(max(plen, self.page), self.buckets)
+        padded = np.zeros((1, pb), np.int32)
+        padded[0, :plen] = tokens
+        reg = _compiled_paged_prefix_write(self.cfg, pb, self.page, n_full)
+        self.cache, tail_k, tail_v = reg(
+            self.params, self.cache, jnp.asarray(padded),
+            jnp.asarray(plen, jnp.int32), jnp.asarray(pids, jnp.int32))
+        for pid_page in pids:
+            self._page_refs[pid_page] = 1  # the registry's own reference
+        pid = self._next_pid
+        self._next_pid += 1
+        has_tail = plen % self.page != 0
+        self._prefixes[pid] = (
+            (tuple(pids), tail_k if has_tail else None,
+             tail_v if has_tail else None), plen)
+        return pid
+
+    def drop_prefix(self, pid: int) -> None:
+        """Release the registry's reference; whole pages return to the
+        pool once no admitted slot still reads them."""
+        if any(p == pid for _rid, _pr, _mn, p in self._pending):
+            raise ValueError(
+                f"prefix {pid} is still referenced by queued requests; "
+                f"run()/step() them first")
+        (pids, _tk, _tv), _plen = self._prefixes.pop(pid)
+        for pid_page in pids:
+            self._page_refs[pid_page] -= 1
+            if self._page_refs[pid_page] == 0:
+                del self._page_refs[pid_page]
+                self._free.append(pid_page)
 
     def _admit(self, slot: int, rid: int, prompt: np.ndarray,
                max_new: int, prefix=None) -> None:
-        assert prefix is None  # submit() rejects prefixes (no registry)
+        if prefix is not None:
+            return self._admit_prefixed(slot, rid, prompt, max_new, prefix)
         self.key, sub = jax.random.split(self.key)
         pb = _bucket(len(prompt), self.buckets)
         self._alloc_to(slot, pb // self.page)
@@ -246,6 +398,43 @@ class PagedSlotServer(SlotServer):
                                 jnp.asarray(len(prompt), jnp.int32),
                                 pids, sub)
         self._finish_admit(slot, rid, tok, len(prompt), max_new)
+
+    def _admit_prefixed(self, slot: int, rid: int, suffix: np.ndarray,
+                        max_new: int, prefix: int) -> None:
+        if prefix not in self._prefixes:
+            raise KeyError(f"prefix {prefix} was dropped while request "
+                           f"{rid} waited in the queue")
+        (shared, tail_k, tail_v), plen = self._prefixes[prefix]
+        n_full = plen // self.page
+        sb = _bucket(len(suffix), self.buckets)
+        need = -(-(plen + sb) // self.page)
+        n_own = need - n_full
+        if len(self._free) < n_own:
+            raise RuntimeError(
+                f"page pool exhausted: prefixed admission needs {n_own} "
+                f"own page(s), {len(self._free)} free")
+        row = self._tables[slot]
+        row[:n_full] = shared
+        for i in range(n_full, need):
+            row[i] = self._free.pop()
+        for pid_page in shared:
+            self._page_refs[pid_page] += 1
+        self.key, sub = jax.random.split(self.key)
+        padded = np.zeros((1, sb), np.int32)
+        padded[0, :len(suffix)] = suffix
+        has_tail = tail_k is not None
+        admit = _compiled_paged_prefix_admit(
+            self.cfg, sb, self.page, self.max_pages, has_tail,
+            *self.sampling)
+        args = (jnp.asarray(self._tables[slot:slot + 1]),
+                jnp.asarray(padded), jnp.asarray(len(suffix), jnp.int32),
+                jnp.asarray(plen, jnp.int32), sub)
+        if has_tail:
+            self.cache, tok = admit(self.params, self.cache, tail_k,
+                                    tail_v, *args)
+        else:
+            self.cache, tok = admit(self.params, self.cache, *args)
+        self._finish_admit(slot, rid, tok, plen + len(suffix), max_new)
 
     # ------------------------------------------------------------ decode
     def _run_chunk(self, sub):

@@ -196,9 +196,6 @@ def test_paged_server_refusals(cfg, params):
         PagedSlotServer(params, LlamaConfig.preset("debug",
                                                    kv_quant="int8"),
                         max_len=64)
-    srv = PagedSlotServer(params, cfg, n_slots=1, max_len=64, page=16)
-    with pytest.raises(NotImplementedError, match="prefix"):
-        srv.register_prefix([1, 2, 3])
 
 
 def test_paged_server_behind_transport_bridge(cfg, params):
@@ -232,3 +229,46 @@ def test_paged_server_behind_transport_bridge(cfg, params):
     for prompt, got in zip(([4, 2, 8, 1], [9, 1]), outs):
         np.testing.assert_array_equal(
             got, _oracle(params, cfg, prompt, len(got)))
+
+def test_paged_prefix_shared_pages(cfg, params):
+    """Zero-copy prefix sharing: three suffix requests over one 20-token
+    prefix (page=16 -> 1 whole shared page + a partial tail) generate
+    exactly generate(prefix + suffix), and the shared page is counted
+    ONCE however many slots reference it."""
+    rng = np.random.default_rng(7)
+    prefix_toks = list(map(int, rng.integers(1, cfg.vocab_size, 20)))
+    srv = PagedSlotServer(params, cfg, n_slots=3, max_len=64, page=16,
+                          n_pages=12, chunk=4)
+    pid = srv.register_prefix(prefix_toks)
+    base_pages = srv.pages_in_use
+    assert base_pages == 1  # one whole shared page; the tail is host-held
+
+    suffixes = [[3, 1, 4], [1, 5], [9, 2, 6, 5]]
+    rids = [srv.submit(sfx, 6, prefix=pid) for sfx in suffixes]
+    srv.step()  # all three admitted: shared page counted once
+    assert srv.pages_in_use < 1 + 3 * 2 + 2  # far below per-slot copies
+    done = srv.run()
+    for rid, sfx in zip(rids, suffixes):
+        want = _oracle(params, cfg, prefix_toks + sfx, 6)
+        np.testing.assert_array_equal(done[rid], want,
+                                      err_msg=f"suffix {sfx}")
+    # All slot references released; the registry still holds its page.
+    assert srv.pages_in_use == 1
+    srv.drop_prefix(pid)
+    assert srv.pages_in_use == 0
+
+
+def test_paged_prefix_page_aligned(cfg, params):
+    """plen % page == 0: no tail page at all — the suffix starts on its
+    own fresh page."""
+    rng = np.random.default_rng(8)
+    prefix_toks = list(map(int, rng.integers(1, cfg.vocab_size, 16)))
+    srv = PagedSlotServer(params, cfg, n_slots=2, max_len=64, page=16,
+                          n_pages=10, chunk=4)
+    pid = srv.register_prefix(prefix_toks)
+    rid = srv.submit([7, 7, 2], 5, prefix=pid)
+    done = srv.run()
+    np.testing.assert_array_equal(
+        done[rid], _oracle(params, cfg, prefix_toks + [7, 7, 2], 5))
+    srv.drop_prefix(pid)
+    assert srv.pages_in_use == 0
