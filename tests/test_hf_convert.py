@@ -286,12 +286,12 @@ def test_bias_and_mixed_window_refusals(hf_model):
 
 
 def test_unknown_rope_scaling_refused(hf_model):
-    """dynamic/longrope/... still refuse loudly — silently dropping a
+    """dynamic/unknown kinds still refuse loudly — silently dropping a
     scaling scheme would change frequencies vs transformers."""
     import copy
 
     hf_cfg = copy.deepcopy(hf_model.config)
-    hf_cfg.rope_scaling = {"rope_type": "longrope", "factor": 2.0}
+    hf_cfg.rope_scaling = {"rope_type": "dynamic", "factor": 2.0}
     with pytest.raises(NotImplementedError, match="rope_scaling"):
         config_from_hf(hf_cfg)
 
