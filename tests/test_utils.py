@@ -129,7 +129,7 @@ def test_perf_estimate_positive_and_monotone():
         assert 0 < small < big
 
 
-def test_perf_calibrate():
+def test_perf_calibrate(perf_table_guard):
     # Synthetic samples from a known alpha/beta model round-trip the fit.
     alpha, beta = 5e-6, 2e9
     samples = [(n, alpha + n / beta) for n in (1024, 1 << 16, 1 << 20, 1 << 24)]
