@@ -35,6 +35,7 @@
 
 #include <atomic>
 #include <cerrno>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -78,6 +79,8 @@ constexpr uint8_t T_DATA = 3;
 constexpr uint8_t T_FLUSH = 4;
 constexpr uint8_t T_FLUSH_ACK = 5;
 constexpr uint8_t T_DEVPULL = 6;  // negotiated PJRT-pull descriptor (frames.py)
+constexpr uint8_t T_PING = 7;     // negotiated peer-liveness probe (frames.py)
+constexpr uint8_t T_PONG = 8;
 constexpr size_t HEADER_SIZE = 17;
 
 constexpr int ST_VOID = 0, ST_INIT = 1, ST_RUNNING = 2, ST_CLOSING = 3, ST_CLOSED = 4;
@@ -85,6 +88,9 @@ constexpr int ST_VOID = 0, ST_INIT = 1, ST_RUNNING = 2, ST_CLOSING = 3, ST_CLOSE
 const char* kCancelled = "Operation cancelled (local endpoint closed before completion)";
 const char* kNotConnected = "Endpoint is not connected";
 const char* kTruncated = "Message truncated: payload larger than posted receive buffer";
+const char* kTimedOut = "Operation timed out (deadline exceeded before completion)";
+
+using Clock = std::chrono::steady_clock;
 
 uint64_t rndv_threshold() {
   static uint64_t v = [] {
@@ -92,6 +98,28 @@ uint64_t rndv_threshold() {
     return e ? strtoull(e, nullptr, 10) : (uint64_t)(8u << 20);
   }();
   return v;
+}
+
+// Per-attempt connect + handshake deadline (config.py STARWAY_CONNECT_TIMEOUT,
+// seconds).  Read per connect, not cached: tests flip it between workers.
+int connect_timeout_ms() {
+  const char* e = getenv("STARWAY_CONNECT_TIMEOUT");
+  double s = e ? strtod(e, nullptr) : 0.0;
+  return s > 0 ? (int)(s * 1000.0) : 3000;
+}
+
+// Peer-liveness keepalive (config.py STARWAY_KEEPALIVE[_MISSES]).  0 =
+// disabled, the reference-parity default (peer death leaves recvs pending).
+double ka_interval_env() {
+  const char* e = getenv("STARWAY_KEEPALIVE");
+  double s = e ? strtod(e, nullptr) : 0.0;
+  return s > 0 ? s : 0.0;
+}
+
+int ka_misses_env() {
+  const char* e = getenv("STARWAY_KEEPALIVE_MISSES");
+  int v = e ? atoi(e) : 3;
+  return v > 0 ? v : 3;
 }
 
 // ------------------------------------------------------- shared-memory rings
@@ -524,6 +552,63 @@ struct Matcher {
     // stays in unexpected until claimed (spill holds the payload)
   }
 
+  // A deadline expired on a posted receive (identified by its ctx cookie):
+  // withdraw it and fail it with the stable "timed out" reason.  Returns
+  // false when the receive already settled (no-op).  A receive claimed
+  // mid-stream is detached: the partial is discarded (remaining bytes drain
+  // to the conn's scratch buffer) so the caller's buffer is immediately
+  // repostable -- the purge_inflight discipline.
+  bool expire_recv(void* ctx, FireList& fires) {
+    for (auto it = posted.begin(); it != posted.end(); ++it) {
+      if (it->ctx == ctx) {
+        auto fail = it->fail; auto c = it->ctx;
+        posted.erase(it);
+        fires.push_back([fail, c] { fail(c, kTimedOut); });
+        return true;
+      }
+    }
+    for (auto* m : inflight) {
+      if (m->has_pr && m->pr.ctx == ctx && !m->complete) {
+        auto fail = m->pr.fail; auto c = m->pr.ctx;
+        detach_claimed(m);
+        fires.push_back([fail, c] { fail(c, kTimedOut); });
+        return true;
+      }
+    }
+    return false;
+  }
+
+  // Fail every pending posted receive (queued or claimed mid-stream) with
+  // `reason`, leaving complete unexpected messages intact.  The liveness
+  // sweep runs this when the last alive conn expires.
+  void fail_pending(const std::string& reason, FireList& fires) {
+    for (auto& pr : posted) {
+      auto fail = pr.fail; auto ctx = pr.ctx;
+      fires.push_back([fail, ctx, reason] { fail(ctx, reason.c_str()); });
+    }
+    posted.clear();
+    for (auto* m : std::vector<InboundMsg*>(inflight.begin(), inflight.end())) {
+      if (m->has_pr && !m->complete) {
+        auto fail = m->pr.fail; auto ctx = m->pr.ctx;
+        detach_claimed(m);
+        fires.push_back([fail, ctx, reason] { fail(ctx, reason.c_str()); });
+      }
+    }
+  }
+
+  // Detach a mid-stream claim: the record becomes an ownerless discard
+  // (bytes drain to scratch; on_complete frees it; cancel_all's !use_spill
+  // path frees it if the stream never finishes).
+  void detach_claimed(InboundMsg* m) {
+    m->has_pr = false;
+    m->discard = true;
+    if (m->use_spill) {
+      for (auto it = unexpected.begin(); it != unexpected.end(); ++it)
+        if (*it == m) { unexpected.erase(it); break; }
+      m->use_spill = false;
+    }
+  }
+
   void purge_inflight(InboundMsg* m) {
     if (m->complete) return;
     m->discard = true;
@@ -615,6 +700,10 @@ struct Conn {
   // surfaced descriptors not yet resolved by the embedder; deferred acks
   // hold (flush seq, snapshot of pending at barrier arrival).
   bool devpull_ok = false;
+  // Peer-liveness keepalive (negotiated "ka": "ok"); last_rx is proof of
+  // life -- any inbound bytes (stream, ring, or doorbell) refresh it.
+  bool ka_ok = false;
+  Clock::time_point last_rx = Clock::now();
   uint64_t ctl_a = 0;  // header `a` of the ctl frame being accumulated
   std::unordered_set<uint64_t> devpull_pending;
   std::vector<std::pair<uint64_t, std::unordered_set<uint64_t>>> devpull_deferred;
@@ -698,6 +787,15 @@ struct Op {
 
 // --------------------------------------------------------------- worker
 
+// One armed op deadline.  Identified by the op's ctx cookie (unique per op:
+// the Python registry key).  Settled ops leave their timer behind; it fires
+// as a no-op (the cookie matches nothing).
+struct Timer {
+  Clock::time_point when;
+  enum Kind { SEND, RECV, FLUSH } kind;
+  void* ctx = nullptr;
+};
+
 struct Worker {
   std::mutex mu;
   std::atomic<int> status{ST_VOID};
@@ -706,6 +804,12 @@ struct Worker {
   std::thread::id engine_tid{};
   std::string worker_id;
   std::deque<Op> ops;
+  // Deadline timers (guarded by mu; armed from app threads, fired on the
+  // engine thread) + keepalive schedule (engine thread only).
+  std::vector<Timer> timers;
+  double ka_interval = 0.0;
+  int ka_misses = 3;
+  Clock::time_point next_ka{};
   std::unordered_map<uint64_t, Conn*> conns;
   std::vector<FlushRec*> flushes;
   Matcher matcher;
@@ -1099,10 +1203,14 @@ struct Worker {
   ssize_t stream_read(Conn* c, uint8_t* dst, size_t want, FireList& fires) {
     if (c->sm_active) {
       size_t n = c->sm_rx.read_into(dst, want);
+      if (n > 0) c->last_rx = Clock::now();
       return (ssize_t)n;
     }
     ssize_t r = ::recv(c->fd, dst, want, 0);
-    if (r > 0) return r;
+    if (r > 0) {
+      c->last_rx = Clock::now();
+      return r;
+    }
     if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return 0;
     conn_broken(c, fires);
     return -1;
@@ -1121,6 +1229,7 @@ struct Worker {
       char buf[4096];
       ssize_t r = ::recv(c->fd, buf, sizeof(buf), 0);
       if (r > 0) {
+        c->last_rx = Clock::now();  // doorbell bytes are proof of life
         if (memchr(buf, DB_STARVING, (size_t)r)) starving = true;
         continue;
       }
@@ -1230,6 +1339,13 @@ struct Worker {
         case T_FLUSH_ACK:
           on_flush_ack(c, a, fires);
           break;
+        case T_PING:
+          // Liveness probe: answer immediately (stream_read already
+          // refreshed last_rx, so inbound PINGs also prove the peer alive).
+          conn_send_ctl(c, T_PONG, 0, 0, "", fires);
+          break;
+        case T_PONG:
+          break;  // proof of life recorded by stream_read
         case T_HELLO:
         case T_HELLO_ACK:
         case T_DEVPULL:
@@ -1327,6 +1443,25 @@ struct Worker {
   // --------------------------------------------------------- conn death
   void conn_broken(Conn* c, FireList& fires) {
     if (!c->alive) return;
+    // With liveness detection active (STARWAY_KEEPALIVE > 0) on a
+    // ka-negotiated conn, the user opted out of recvs-pend-forever:
+    // whatever killed the conn, the receive it was streaming into fails,
+    // and once no alive conns remain every queued receive fails too
+    // (stable "not connected" keyword; the Python engine's _conn_broken
+    // carries the identical branch).
+    bool ka_live = ka_interval > 0 && c->ka_ok;
+    sw_fail_cb stranded_fail = nullptr;
+    void* stranded_ctx = nullptr;
+    if (ka_live && c->rx_msg) {
+      // Under mu: an app-thread sw_recv can be claiming this very in-flight
+      // message (Matcher::post_recv writes m->pr / has_pr under mu).
+      std::lock_guard<std::mutex> g(mu);
+      if (c->rx_msg->has_pr && !c->rx_msg->complete) {
+        stranded_fail = c->rx_msg->pr.fail;
+        stranded_ctx = c->rx_msg->pr.ctx;
+        c->rx_msg->has_pr = false;  // purge below then drops the partial whole
+      }
+    }
     c->alive = false;
     ep_del(c->fd);
     for (auto& item : c->tx) {
@@ -1353,6 +1488,22 @@ struct Worker {
     bool was_half_open = half_open.erase(c) > 0;
     auto snapshot = flushes;
     for (auto* rec : snapshot) try_complete_flush(rec, fires);
+    if (ka_live) {
+      std::string reason =
+          std::string(kNotConnected) + " (peer lost; liveness detection active)";
+      if (stranded_fail) {
+        fires.push_back([stranded_fail, stranded_ctx, reason] {
+          stranded_fail(stranded_ctx, reason.c_str());
+        });
+      }
+      bool any_alive = false;
+      {
+        std::lock_guard<std::mutex> g(mu);
+        for (auto& [id, cc] : conns)
+          if (cc->alive) { any_alive = true; break; }
+        if (!any_alive) matcher.fail_pending(reason, fires);
+      }
+    }
     if (was_half_open) delete c;  // never reached conns registry
   }
 
@@ -1417,9 +1568,11 @@ struct Worker {
     }
     if (devpull_advertise && json_field(body, "devpull") == "ok")
       c->devpull_ok = true;
+    if (json_field(body, "ka") == "ok") c->ka_ok = true;  // liveness capability
     std::string ack = std::string("{\"worker_id\": \"") + worker_id + "\"" +
                       (seg ? ", \"sm\": \"ok\"" : "") +
-                      (c->devpull_ok ? ", \"devpull\": \"ok\"" : "") + "}";
+                      (c->devpull_ok ? ", \"devpull\": \"ok\"" : "") +
+                      (c->ka_ok ? ", \"ka\": \"ok\"" : "") + "}";
     // The ACK is the transport switch point (see TxItem::switch_after).
     conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires,
                   /*switch_after=*/seg != nullptr);
@@ -1427,6 +1580,151 @@ struct Worker {
       auto cb = accept_cb; auto ctx = accept_ctx; uint64_t id = c->id;
       fires.push_back([cb, ctx, id] { cb(ctx, id); });
     }
+  }
+
+  // ---------------------------------------------------------- deadlines
+  // Arm a deadline for an op (thread-safe; caller wakes the engine).
+  void add_timer(Timer::Kind kind, void* ctx, double timeout_s) {
+    std::lock_guard<std::mutex> g(mu);
+    timers.push_back(Timer{
+        Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                           std::chrono::duration<double>(timeout_s)),
+        kind, ctx});
+  }
+
+  // epoll_wait timeout to the earliest timer / keepalive tick (ms), -1 when
+  // neither is armed.
+  int poll_timeout_ms() {
+    std::lock_guard<std::mutex> g(mu);
+    bool have = false;
+    Clock::time_point next{};
+    for (auto& t : timers)
+      if (!have || t.when < next) { next = t.when; have = true; }
+    if (ka_interval > 0 && (!have || next_ka < next)) {
+      next = next_ka;
+      have = true;
+    }
+    if (!have) return -1;
+    auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                  next - Clock::now()).count();
+    if (ms < 0) ms = 0;
+    if (ms > 60000) ms = 60000;
+    return (int)ms;
+  }
+
+  void check_timers(FireList& fires) {
+    auto now = Clock::now();
+    std::vector<Timer> due;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      for (auto it = timers.begin(); it != timers.end();) {
+        if (it->when <= now) {
+          due.push_back(*it);
+          it = timers.erase(it);
+        } else {
+          ++it;
+        }
+      }
+    }
+    for (auto& t : due) expire_op(t, fires);
+    if (ka_interval > 0 && now >= next_ka) {
+      next_ka = now + std::chrono::duration_cast<Clock::duration>(
+                          std::chrono::duration<double>(ka_interval));
+      ka_tick(fires);
+    }
+  }
+
+  void expire_op(const Timer& t, FireList& fires) {
+    if (t.kind == Timer::RECV) {
+      std::lock_guard<std::mutex> g(mu);
+      matcher.expire_recv(t.ctx, fires);
+      return;
+    }
+    // SEND / FLUSH: the op may still be queued (not yet drained)...
+    {
+      std::lock_guard<std::mutex> g(mu);
+      for (auto it = ops.begin(); it != ops.end(); ++it) {
+        bool send_like = it->kind == Op::SEND || it->kind == Op::SEND_DEVPULL;
+        if (it->ctx != t.ctx) continue;
+        if ((t.kind == Timer::SEND && send_like) ||
+            (t.kind == Timer::FLUSH && it->kind == Op::FLUSH)) {
+          auto fail = it->fail; auto ctx = it->ctx;
+          if (fail) fires.push_back([fail, ctx] { fail(ctx, kTimedOut); });
+          fire_op_release(*it, fires);
+          ops.erase(it);
+          return;
+        }
+      }
+    }
+    if (t.kind == Timer::FLUSH) {
+      // ...or an outstanding barrier record.
+      for (auto* rec : flushes) {
+        if (rec->ctx != t.ctx || rec->completed) continue;
+        rec->completed = true;
+        remove_flush(rec);
+        auto fail = rec->fail; auto ctx = rec->ctx;
+        if (fail) fires.push_back([fail, ctx] { fail(ctx, kTimedOut); });
+        delete rec;
+        return;
+      }
+      return;
+    }
+    // SEND: find the queued TxItem.  Untouched -> withdraw cleanly; already
+    // partially on the wire -> the stream cannot be resumed past a missing
+    // fragment, so fail the op and tear the conn down (UCX ep-error
+    // analogue).  Settled ops match nothing: no-op.
+    std::vector<Conn*> cs;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      for (auto& [id, c] : conns) cs.push_back(c);
+    }
+    for (Conn* c : cs) {
+      for (auto it = c->tx.begin(); it != c->tx.end(); ++it) {
+        if (!it->is_data || it->ctx != t.ctx || it->local_done) continue;
+        auto fail = it->fail; auto ctx = it->ctx;
+        if (it->off == 0) {
+          it->local_done = true;
+          if (fail) fires.push_back([fail, ctx] { fail(ctx, kTimedOut); });
+          fire_release(*it, fires);
+          c->tx.erase(it);
+        } else {
+          it->local_done = true;  // suppress the conn_broken cancel path
+          if (fail) fires.push_back([fail, ctx] { fail(ctx, kTimedOut); });
+          conn_broken(c, fires);
+        }
+        return;
+      }
+    }
+  }
+
+  // ---------------------------------------------------------- keepalive
+  void ka_tick(FireList& fires) {
+    auto now = Clock::now();
+    auto interval = std::chrono::duration<double>(ka_interval);
+    auto window = std::chrono::duration<double>(ka_interval * ka_misses);
+    std::vector<Conn*> cs;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      for (auto& [id, c] : conns) cs.push_back(c);
+    }
+    std::vector<Conn*> expired;
+    for (Conn* c : cs) {
+      if (!c->alive || !c->ka_ok) continue;
+      auto silent = now - c->last_rx;
+      if (silent > window) expired.push_back(c);
+      else if (silent >= interval) conn_send_ctl(c, T_PING, 0, 0, "", fires);
+    }
+    for (Conn* c : expired) conn_expired(c, fires);
+  }
+
+  // Liveness window elapsed: declare the peer dead.  conn_broken's
+  // liveness-active branch fails the streaming receive and (once no alive
+  // conns remain) every queued receive -- the keepalive-enabled
+  // replacement for recvs-pend-forever (core/engine.py _conn_expired is
+  // the Python twin).
+  void conn_expired(Conn* c, FireList& fires) {
+    SW_DEBUG("peer %s liveness expired", c->peer_name.c_str());
+    conn_broken(c, fires);
   }
 
   // --------------------------------------------------------------- main
@@ -1551,10 +1849,16 @@ struct Worker {
         return;
       }
     }
+    // Keepalive config sampled once per worker lifetime (config.py knobs).
+    ka_interval = ka_interval_env();
+    ka_misses = ka_misses_env();
+    if (ka_interval > 0)
+      next_ka = Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                                   std::chrono::duration<double>(ka_interval));
     epoll_event events[64];
     for (;;) {
       if (status.load() == ST_CLOSING) break;
-      int n = epoll_wait(epfd, events, 64, -1);
+      int n = epoll_wait(epfd, events, 64, poll_timeout_ms());
       if (n < 0) {
         if (errno == EINTR) continue;
         break;
@@ -1575,6 +1879,7 @@ struct Worker {
             conn_readable(c, fires);
         }
       }
+      check_timers(fires);
       drain_ops(fires);
       for (auto& f : fires) f();
     }
@@ -1663,10 +1968,11 @@ struct ClientWorker : Worker {
     addr.sin_port = htons((uint16_t)c_port);
     if (inet_pton(AF_INET, c_host.c_str(), &addr.sin_addr) != 1)
       return fail_connect("bad address " + c_host);
+    const int cto_ms = connect_timeout_ms();
     int rc = ::connect(fd, (sockaddr*)&addr, sizeof(addr));
     if (rc < 0 && errno != EINPROGRESS) return fail_connect(strerror(errno));
     pollfd pfd{fd, POLLOUT, 0};
-    if (poll(&pfd, 1, 3000) <= 0) return fail_connect("connect timeout");
+    if (poll(&pfd, 1, cto_ms) <= 0) return fail_connect("connect timeout");
     int err = 0;
     socklen_t elen = sizeof(err);
     getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen);
@@ -1685,6 +1991,7 @@ struct ClientWorker : Worker {
                nonce_hex + "\", \"sm_ring\": \"" + std::to_string(sm_offer->ring_size) + "\"";
     }
     if (devpull_advertise) hello += ", \"devpull\": \"ok\"";
+    hello += ", \"ka\": \"ok\"";  // liveness capability, always offered
     hello += "}";
     std::vector<uint8_t> frame(HEADER_SIZE + hello.size());
     pack_header(frame.data(), T_HELLO, 0, hello.size());
@@ -1695,7 +2002,7 @@ struct ClientWorker : Worker {
       if (w < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) {
           pollfd p2{fd, POLLOUT, 0};
-          if (poll(&p2, 1, 3000) <= 0) return fail_connect("handshake send timeout");
+          if (poll(&p2, 1, cto_ms) <= 0) return fail_connect("handshake send timeout");
           continue;
         }
         return fail_connect("handshake send failed");
@@ -1713,7 +2020,7 @@ struct ClientWorker : Worker {
         if (r == 0) return false;
         if (errno == EAGAIN || errno == EWOULDBLOCK) {
           pollfd p2{fd, POLLIN, 0};
-          if (poll(&p2, 1, 3000) <= 0) return false;
+          if (poll(&p2, 1, cto_ms) <= 0) return false;
           continue;
         }
         return false;
@@ -1735,6 +2042,7 @@ struct ClientWorker : Worker {
     std::string ack_body((char*)body.data(), body.size());
     c->peer_name = json_field(ack_body, "worker_id");
     c->devpull_ok = devpull_advertise && json_field(ack_body, "devpull") == "ok";
+    c->ka_ok = json_field(ack_body, "ka") == "ok";
     if (sm_offer) {
       if (json_field(ack_body, "sm") == "ok") {
         c->adopt_sm(sm_offer, /*creator=*/true, /*defer_tx=*/false);
@@ -1790,7 +2098,8 @@ int worker_start(Worker* w) {
 
 extern "C" {
 
-const char* sw_version() { return "starway-native-2"; }  // 2: sm transport
+// 2: sm transport; 3: op deadlines + PING/PONG peer liveness
+const char* sw_version() { return "starway-native-3"; }
 
 // Portable cursor atomics for the Python engine's sm ring (sw_engine.h).
 // std::atomic_ref would be C++20-tidy but libstdc++'s needs alignment UB
@@ -1886,7 +2195,7 @@ static Worker* W(void* h) { return (Worker*)h; }
 
 int sw_send(void* h, uint64_t conn_id, const void* buf, uint64_t len, uint64_t tag,
             sw_done_cb done, sw_fail_cb fail, void* ctx,
-            sw_done_cb release, void* release_ctx) {
+            sw_done_cb release, void* release_ctx, double timeout_s) {
   Worker* w = W(h);
   {
     std::lock_guard<std::mutex> g(w->mu);
@@ -1904,6 +2213,7 @@ int sw_send(void* h, uint64_t conn_id, const void* buf, uint64_t len, uint64_t t
     op.release_ctx = release_ctx;
     w->ops.push_back(op);
   }
+  if (timeout_s > 0) w->add_timer(Timer::SEND, ctx, timeout_s);
   w->wake();
   return 0;
 }
@@ -1974,7 +2284,7 @@ int sw_send_devpull(void* h, uint64_t conn_id, uint64_t tag,
 }
 
 int sw_recv(void* h, void* buf, uint64_t cap, uint64_t tag, uint64_t mask,
-            sw_recv_cb done, sw_fail_cb fail, void* ctx) {
+            sw_recv_cb done, sw_fail_cb fail, void* ctx, double timeout_s) {
   Worker* w = W(h);
   FireList fires;
   {
@@ -2003,12 +2313,19 @@ int sw_recv(void* h, void* buf, uint64_t cap, uint64_t tag, uint64_t mask,
       w->wake();
     }
   }
+  // Armed after the matcher ran: an immediately-settled recv (matched a
+  // complete unexpected message / truncated) leaves a no-op timer behind.
+  // The wake makes the engine recompute its epoll timeout.
+  if (timeout_s > 0) {
+    w->add_timer(Timer::RECV, ctx, timeout_s);
+    w->wake();
+  }
   for (auto& f : fires) f();
   return 0;
 }
 
 int sw_flush(void* h, uint64_t conn_id, int conn_scoped,
-             sw_done_cb done, sw_fail_cb fail, void* ctx) {
+             sw_done_cb done, sw_fail_cb fail, void* ctx, double timeout_s) {
   Worker* w = W(h);
   {
     std::lock_guard<std::mutex> g(w->mu);
@@ -2022,6 +2339,7 @@ int sw_flush(void* h, uint64_t conn_id, int conn_scoped,
     op.ctx = ctx;
     w->ops.push_back(op);
   }
+  if (timeout_s > 0) w->add_timer(Timer::FLUSH, ctx, timeout_s);
   w->wake();
   return 0;
 }

@@ -60,7 +60,8 @@ typedef void (*sw_status_cb)(void* ctx, const char* status);
 
 /* ----------------------------------------------------------- lifecycle */
 
-/* Engine identification string ("starway-native-1"). */
+/* Engine identification string ("starway-native-3": op deadlines +
+ * PING/PONG peer liveness). */
 const char* sw_version(void);
 
 /* Allocate a client/server worker in the VOID state.  `worker_id` is the
@@ -96,9 +97,16 @@ int sw_server_listen(void* h, const char* addr, int port);
  * written OR cancelled) — the buffer-keepalive signal, distinct from `done`
  * because rendezvous sends stream on after local completion.
  * Returns 0, or -1 if the worker is not RUNNING (no callback fires). */
+/* `timeout_s` (here and on sw_recv/sw_flush): optional deadline in
+ * seconds; <= 0 means no deadline.  An op that has not settled when the
+ * deadline fires fails with the stable "timed out" reason and releases its
+ * resources (a send partially on the wire also tears the connection down —
+ * the frame stream cannot be resumed past a withdrawn fragment; a receive
+ * claimed mid-stream redirects the remaining payload to scratch so the
+ * caller's buffer is immediately repostable). */
 int sw_send(void* h, uint64_t conn_id, const void* buf, uint64_t len,
             uint64_t tag, sw_done_cb done, sw_fail_cb fail, void* ctx,
-            sw_done_cb release, void* release_ctx);
+            sw_done_cb release, void* release_ctx, double timeout_s);
 
 /* Post a receive: worker-wide (any connection), matched by
  * (sender_tag & mask) == (tag & mask); mask 0 = wildcard.  FIFO against
@@ -106,7 +114,7 @@ int sw_send(void* h, uint64_t conn_id, const void* buf, uint64_t len,
  * message larger than `cap` fails the recv ("truncated").
  * Returns 0, or -1 if not RUNNING. */
 int sw_recv(void* h, void* buf, uint64_t cap, uint64_t tag, uint64_t mask,
-            sw_recv_cb done, sw_fail_cb fail, void* ctx);
+            sw_recv_cb done, sw_fail_cb fail, void* ctx, double timeout_s);
 
 /* Delivery barrier: `done` fires when every DATA frame sent so far on the
  * selected connections has been acknowledged by the peer's engine
@@ -114,7 +122,7 @@ int sw_recv(void* h, void* buf, uint64_t cap, uint64_t tag, uint64_t mask,
  * `conn_id` (the reference's flush_ep); otherwise all connections.
  * Fails if a dirty peer died ("peer reset").  Returns 0 or -1. */
 int sw_flush(void* h, uint64_t conn_id, int conn_scoped,
-             sw_done_cb done, sw_fail_cb fail, void* ctx);
+             sw_done_cb done, sw_fail_cb fail, void* ctx, double timeout_s);
 
 /* Graceful close: RUNNING -> CLOSING; the engine thread cancels queued and
  * in-flight ops (reason contains "cancel"), closes sockets (RST if a data
@@ -169,7 +177,14 @@ int sw_conn_info(void* h, uint64_t conn_id, char* out, int cap);
  * and calls sw_devpull_resolved(conn_id, msg_id) when the pull lands or
  * fails.  FLUSH_ACKs for barriers that arrived after the descriptor are
  * withheld until every such descriptor resolves (the sender's flush means
- * "payload resident at the receiver"). */
+ * "payload resident at the receiver").
+ *
+ * KNOWN LIMITATION: a receive claimed by a devpull descriptor leaves this
+ * engine's matcher (the embedder owns its completion), so a `timeout_s`
+ * armed on it and the keepalive fail-pending sweep cannot reach it from
+ * here; if the pull itself stalls forever the receive hangs.  The Python
+ * engine keeps such claims in its inflight set and expires them.  Bounding
+ * pull time natively needs a wrapper-side deadline (core/native.py). */
 typedef void (*sw_devpull_cb)(void* ctx, uint64_t conn_id, uint64_t tag,
                               const char* body, uint64_t len,
                               uint64_t msg_id, int rc, uint64_t recv_ctx);

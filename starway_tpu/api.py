@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from typing import Callable, Optional
 
 import numpy as np
@@ -27,6 +28,7 @@ import numpy as np
 from . import config
 from .core.endpoint import ServerEndpoint
 from .core.engine import ClientWorker, ServerWorker
+from .errors import REASON_TIMEOUT
 
 logger = logging.getLogger("starway_tpu")
 
@@ -187,7 +189,12 @@ class Server:
 
     # ----------------------------------------------------------------- send
     def send(self, client_ep: ServerEndpoint, buffer, tag: int,
-             done_callback: Callable[[], None], fail_callback: Callable[[str], None]) -> None:
+             done_callback: Callable[[], None], fail_callback: Callable[[str], None],
+             timeout: Optional[float] = None) -> None:
+        """``timeout`` (seconds) bounds local completion: an unsettled send
+        fails with the stable ``"timed out"`` reason.  Host payloads only;
+        device-plane (jax.Array) sends ride the PJRT pull path, which has
+        its own transfer lifecycle (device.py)."""
         if _is_device_payload(buffer):
             from . import device
 
@@ -196,18 +203,24 @@ class Server:
             return
         owner, view = _send_view(buffer)
         self._server.submit_send(client_ep._conn, view, _tag(tag),
-                                 done_callback, fail_callback, owner)
+                                 done_callback, fail_callback, owner,
+                                 timeout=timeout)
 
     def asend(self, client_ep: ServerEndpoint, buffer, tag: int,
-              loop: Optional[asyncio.AbstractEventLoop] = None):
+              loop: Optional[asyncio.AbstractEventLoop] = None,
+              timeout: Optional[float] = None):
         fut, done, fail = _future_pair(loop)
-        self.send(client_ep, buffer, tag, done, fail)
+        self.send(client_ep, buffer, tag, done, fail, timeout=timeout)
         return fut
 
     # ----------------------------------------------------------------- recv
     def recv(self, buffer, tag: int, tag_mask: int,
              done_callback: Callable[[int, int], None],
-             fail_callback: Callable[[str], None]) -> None:
+             fail_callback: Callable[[str], None],
+             timeout: Optional[float] = None) -> None:
+        """``timeout`` (seconds) bounds completion: an unmatched (or
+        mid-stream) receive fails with ``"timed out"`` and its buffer is
+        immediately safe to repost.  Host buffers only (see send)."""
         if _is_device_payload(buffer):
             from . import device
 
@@ -216,32 +229,39 @@ class Server:
             return
         owner, view = _recv_view(buffer)
         self._server.post_recv(view, _tag(tag), _tag(tag_mask),
-                               done_callback, fail_callback, owner)
+                               done_callback, fail_callback, owner,
+                               timeout=timeout)
 
     def arecv(self, buffer, tag: int, tag_mask: int,
-              loop: Optional[asyncio.AbstractEventLoop] = None):
+              loop: Optional[asyncio.AbstractEventLoop] = None,
+              timeout: Optional[float] = None):
         fut, done, fail = _future_pair(loop, result_factory=lambda st, ln: (st, ln))
-        self.recv(buffer, tag, tag_mask, done, fail)
+        self.recv(buffer, tag, tag_mask, done, fail, timeout=timeout)
         return fut
 
     # ---------------------------------------------------------------- flush
     def flush(self, done_callback: Callable[[], None],
-              fail_callback: Callable[[str], None]) -> None:
-        self._server.submit_flush(done_callback, fail_callback)
+              fail_callback: Callable[[str], None],
+              timeout: Optional[float] = None) -> None:
+        self._server.submit_flush(done_callback, fail_callback, timeout=timeout)
 
-    def aflush(self, loop: Optional[asyncio.AbstractEventLoop] = None):
+    def aflush(self, loop: Optional[asyncio.AbstractEventLoop] = None,
+               timeout: Optional[float] = None):
         fut, done, fail = _future_pair(loop)
-        self.flush(done, fail)
+        self.flush(done, fail, timeout=timeout)
         return fut
 
     def flush_ep(self, client_ep: ServerEndpoint, done_callback: Callable[[], None],
-                 fail_callback: Callable[[str], None]) -> None:
-        self._server.submit_flush(done_callback, fail_callback, [client_ep._conn])
+                 fail_callback: Callable[[str], None],
+                 timeout: Optional[float] = None) -> None:
+        self._server.submit_flush(done_callback, fail_callback, [client_ep._conn],
+                                  timeout=timeout)
 
     def aflush_ep(self, client_ep: ServerEndpoint,
-                  loop: Optional[asyncio.AbstractEventLoop] = None):
+                  loop: Optional[asyncio.AbstractEventLoop] = None,
+                  timeout: Optional[float] = None):
         fut, done, fail = _future_pair(loop)
-        self.flush_ep(client_ep, done, fail)
+        self.flush_ep(client_ep, done, fail, timeout=timeout)
         return fut
 
     # ------------------------------------------------------------ telemetry
@@ -269,33 +289,103 @@ class Client:
         self._client = _new_client_worker()
 
     # -------------------------------------------------------------- connect
-    def aconnect(self, addr: str, port: int,
-                 loop: Optional[asyncio.AbstractEventLoop] = None):
+    def _aconnect_once(self, target, loop, timeout):
+        """One connect attempt on the current (fresh) worker; returns an
+        awaitable resolving to None or raising Exception(reason)."""
         fut, done, fail = _future_pair(loop)
 
         def connection_cb(status: str):
             if status == "":
-                logger.debug("starway client connected to %s:%s", addr, port)
+                logger.debug("starway client connected to %s", target)
                 done()
             else:
                 fail(status)
 
-        self._client.connect(addr, port, connection_cb)
+        if isinstance(target, bytes):
+            self._client.connect_address(target, connection_cb, timeout=timeout)
+        else:
+            addr, port = target
+            self._client.connect(addr, port, connection_cb, timeout=timeout)
         return fut
+
+    def _aconnect(self, target, loop, timeout, retries, backoff):
+        """Connect with optional per-attempt ``timeout`` and ``retries``
+        failed attempts retried under exponential backoff + jitter.  Workers
+        are connect-once (the reference contract), so every retry swaps in a
+        fresh engine worker -- callers never observe the churn.
+        """
+        if retries == 0 and timeout is None:
+            return self._aconnect_once(target, loop, None)
+
+        async def attempt_loop():
+            last: Exception = Exception("connect: no attempt made")
+            for attempt in range(retries + 1):
+                if attempt > 0:
+                    # Exponential backoff, full jitter in [delay/2, delay]:
+                    # a fleet of clients chasing one restarted server must
+                    # not reconnect in lockstep.
+                    delay = backoff * (2 ** (attempt - 1))
+                    await asyncio.sleep(delay * (0.5 + random.random() / 2))
+                    # Connect-once: fresh engine per attempt.  The burnt
+                    # worker is force-closed, not just dropped -- a
+                    # wait_for-expired attempt may still complete its
+                    # handshake in the background and would otherwise leak
+                    # a live engine thread + a ghost conn on the server.
+                    old, self._client = self._client, _new_client_worker()
+                    try:
+                        old.force_close()
+                    except Exception:
+                        pass
+                fut = self._aconnect_once(target, loop, timeout)
+                try:
+                    if timeout is not None:
+                        await asyncio.wait_for(fut, timeout)
+                    else:
+                        await fut
+                    return
+                except asyncio.TimeoutError:
+                    last = Exception(f"{REASON_TIMEOUT} (connect attempt {attempt + 1})")
+                except Exception as e:  # "not connected: ..." from the engine
+                    last = e
+            # Out of attempts: retire the final burnt worker too (its
+            # engine may still finish the handshake in the background) and
+            # leave a fresh VOID worker so the Client can aconnect again.
+            burnt, self._client = self._client, _new_client_worker()
+            try:
+                burnt.force_close()
+            except Exception:
+                pass
+            raise last
+
+        coro = attempt_loop()
+        try:
+            # Schedule eagerly when a loop is running: the return value then
+            # behaves like the no-retry path's Future (connect underway
+            # without an await, add_done_callback available).
+            return asyncio.ensure_future(coro)
+        except RuntimeError:
+            return coro  # no running loop: caller awaits to drive it
+
+    def aconnect(self, addr: str, port: int,
+                 loop: Optional[asyncio.AbstractEventLoop] = None,
+                 timeout: Optional[float] = None,
+                 retries: int = 0, backoff: float = 0.5):
+        """Connect to ``addr:port``.
+
+        ``timeout`` bounds each attempt (default: the
+        ``STARWAY_CONNECT_TIMEOUT`` knob, see config.py); ``retries`` extra
+        attempts run under exponential backoff (base ``backoff`` seconds)
+        with jitter.  Failure raises with a stable reason keyword:
+        ``"not connected"`` (refused / reset / handshake failure) or
+        ``"timed out"`` (deadline elapsed).
+        """
+        return self._aconnect((addr, port), loop, timeout, retries, backoff)
 
     def aconnect_address(self, remote_address: bytes,
-                         loop: Optional[asyncio.AbstractEventLoop] = None):
-        fut, done, fail = _future_pair(loop)
-
-        def connection_cb(status: str):
-            if status == "":
-                logger.debug("starway client connected via worker address")
-                done()
-            else:
-                fail(status)
-
-        self._client.connect_address(remote_address, connection_cb)
-        return fut
+                         loop: Optional[asyncio.AbstractEventLoop] = None,
+                         timeout: Optional[float] = None,
+                         retries: int = 0, backoff: float = 0.5):
+        return self._aconnect(bytes(remote_address), loop, timeout, retries, backoff)
 
     def get_worker_address(self) -> bytes:
         return self._client.get_worker_address()
@@ -313,7 +403,10 @@ class Client:
 
     # ----------------------------------------------------------------- send
     def send(self, buffer, tag: int, done_callback: Callable[[], None],
-             fail_callback: Callable[[str], None]) -> None:
+             fail_callback: Callable[[str], None],
+             timeout: Optional[float] = None) -> None:
+        """``timeout`` (seconds) bounds local completion (host payloads;
+        see Server.send)."""
         if _is_device_payload(buffer):
             from . import device
 
@@ -322,18 +415,23 @@ class Client:
             return
         owner, view = _send_view(buffer)
         self._client.submit_send(self._client.primary_conn, view, _tag(tag),
-                                 done_callback, fail_callback, owner)
+                                 done_callback, fail_callback, owner,
+                                 timeout=timeout)
 
     def asend(self, buffer, tag: int,
-              loop: Optional[asyncio.AbstractEventLoop] = None):
+              loop: Optional[asyncio.AbstractEventLoop] = None,
+              timeout: Optional[float] = None):
         fut, done, fail = _future_pair(loop)
-        self.send(buffer, tag, done, fail)
+        self.send(buffer, tag, done, fail, timeout=timeout)
         return fut
 
     # ----------------------------------------------------------------- recv
     def recv(self, buffer, tag: int, tag_mask: int,
              done_callback: Callable[[int, int], None],
-             fail_callback: Callable[[str], None]) -> None:
+             fail_callback: Callable[[str], None],
+             timeout: Optional[float] = None) -> None:
+        """``timeout`` (seconds) fails an unmatched receive with
+        ``"timed out"``; the buffer is immediately safe to repost."""
         if _is_device_payload(buffer):
             from . import device
 
@@ -342,22 +440,26 @@ class Client:
             return
         owner, view = _recv_view(buffer)
         self._client.post_recv(view, _tag(tag), _tag(tag_mask),
-                               done_callback, fail_callback, owner)
+                               done_callback, fail_callback, owner,
+                               timeout=timeout)
 
     def arecv(self, buffer, tag: int, tag_mask: int,
-              loop: Optional[asyncio.AbstractEventLoop] = None):
+              loop: Optional[asyncio.AbstractEventLoop] = None,
+              timeout: Optional[float] = None):
         fut, done, fail = _future_pair(loop, result_factory=lambda st, ln: (st, ln))
-        self.recv(buffer, tag, tag_mask, done, fail)
+        self.recv(buffer, tag, tag_mask, done, fail, timeout=timeout)
         return fut
 
     # ---------------------------------------------------------------- flush
     def flush(self, done_callback: Callable[[], None],
-              fail_callback: Callable[[str], None]) -> None:
-        self._client.submit_flush(done_callback, fail_callback)
+              fail_callback: Callable[[str], None],
+              timeout: Optional[float] = None) -> None:
+        self._client.submit_flush(done_callback, fail_callback, timeout=timeout)
 
-    def aflush(self, loop: Optional[asyncio.AbstractEventLoop] = None):
+    def aflush(self, loop: Optional[asyncio.AbstractEventLoop] = None,
+               timeout: Optional[float] = None):
         fut, done, fail = _future_pair(loop)
-        self.flush(done, fail)
+        self.flush(done, fail, timeout=timeout)
         return fut
 
     # ------------------------------------------------------------ telemetry

@@ -56,6 +56,26 @@ benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
     "1" = route the Python sm ring's cursor ops through the native lib's
     acquire/release atomics even on x86 (the off-x86 code path, made
     testable on x86 CI; see core/shmring.py).
+
+``STARWAY_CONNECT_TIMEOUT``
+    Per-attempt connect + handshake deadline in seconds (default 3.0).
+    Both engines honour it; ``aconnect(..., timeout=)`` overrides it per
+    call on the Python engine.  Mirrors UCX's ``UCX_..._TIMEOUT`` knobs
+    replacing what used to be a hard-coded constant in core/engine.py.
+
+``STARWAY_KEEPALIVE``
+    Peer-liveness keepalive interval in seconds (default 0 = disabled,
+    matching the reference contract "peer death leaves posted recvs
+    pending").  When > 0 and both peers negotiated ``"ka": "ok"`` in the
+    handshake, each engine PINGs idle peers every interval and declares a
+    peer dead after ``STARWAY_KEEPALIVE_MISSES`` silent intervals: the
+    conn is torn down, its in-flight matcher state purged, and pending
+    receives fail with the stable ``"not connected"`` keyword.  The
+    analogue of UCX's ``UCX_KEEPALIVE_INTERVAL`` / err-handling mode.
+
+``STARWAY_KEEPALIVE_MISSES``
+    Silent keepalive intervals tolerated before a peer is declared dead
+    (default 3).
 """
 
 from __future__ import annotations
@@ -71,6 +91,9 @@ __all__ = [
     "devpull_enabled",
     "devpull_threshold",
     "decode_stream_enabled",
+    "connect_timeout",
+    "keepalive_interval",
+    "keepalive_misses",
 ]
 
 
@@ -131,6 +154,32 @@ def devpull_threshold() -> int:
 
 def rndv_threshold() -> int:
     return int(_env("STARWAY_RNDV_THRESHOLD", str(8 * 1024 * 1024)))
+
+
+def connect_timeout() -> float:
+    try:
+        v = float(_env("STARWAY_CONNECT_TIMEOUT", "3.0"))
+    except ValueError:
+        return 3.0
+    return v if v > 0 else 3.0
+
+
+def keepalive_interval() -> float:
+    """Seconds between liveness PINGs; 0 (the default) disables detection
+    entirely -- reference parity: peer death leaves posted recvs pending."""
+    try:
+        v = float(_env("STARWAY_KEEPALIVE", "0"))
+    except ValueError:
+        return 0.0
+    return v if v > 0 else 0.0
+
+
+def keepalive_misses() -> int:
+    try:
+        v = int(_env("STARWAY_KEEPALIVE_MISSES", "3"))
+    except ValueError:
+        return 3
+    return v if v > 0 else 3
 
 
 def use_native() -> bool:

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import itertools
 import socket
+import time
 from collections import deque
 from typing import Optional
 
@@ -65,8 +66,11 @@ DB_STARVING = 2
 class TxData:
     """An outgoing tagged message (header + zero-copy payload view)."""
 
+    # __weakref__: deadline timers (core/engine.py) hold queued sends
+    # weakly, so a completed send's payload is not pinned until its timer
+    # would have fired.
     __slots__ = ("header", "payload", "off", "done", "fail", "owner", "rndv",
-                 "local_done", "switch_after")
+                 "local_done", "switch_after", "__weakref__")
 
     def __init__(self, tag: int, payload: memoryview, done, fail, owner):
         self.header = frames.pack_data_header(tag, len(payload))
@@ -228,6 +232,11 @@ class TcpConn(BaseConn):
             pass
         self.sock = sock
         self.handshaken = handshaken  # False on server side until HELLO arrives
+        # Peer-liveness keepalive (frames.py PING/PONG): negotiated via
+        # "ka": "ok" in the handshake; last_rx is proof-of-life (any inbound
+        # bytes -- stream, ring, or doorbell -- refresh it).
+        self.ka_ok = False
+        self.last_rx = time.monotonic()
         self.tx: deque = deque()
         self._registered = False
         self._want_write = False
@@ -365,15 +374,20 @@ class TcpConn(BaseConn):
                 break
         return total
 
-    def send_data(self, tag: int, payload: memoryview, done, fail, owner, fires: list) -> None:
+    def send_data(self, tag: int, payload: memoryview, done, fail, owner, fires: list):
+        """Queue a tagged message.  Returns the TxData handle so the worker
+        can arm a deadline timer against it (core/engine.py), or None when
+        the conn is already dead."""
         if not self.alive:
             if fail is not None:
                 fires.append(lambda: fail(REASON_NOT_CONNECTED + " (connection reset)"))
-            return
+            return None
         self.dirty = True
         self._data_counter += 1
-        self.tx.append(TxData(tag, payload, done, fail, owner))
+        item = TxData(tag, payload, done, fail, owner)
+        self.tx.append(item)
         self.kick_tx(fires)
+        return item
 
     def send_flush(self, seq: int, fires: list) -> None:
         self._flush_marks[seq] = self._data_counter
@@ -388,6 +402,13 @@ class TcpConn(BaseConn):
     def send_ctl(self, data: bytes, fires: list, switch_after: bool = False) -> None:
         self.tx.append(TxCtl(data, switch_after))
         self.kick_tx(fires)
+
+    def send_ping(self, fires: list) -> None:
+        """Liveness probe (only sent on ka-negotiated conns).  Rides the
+        active transport -- ring for sm conns (the doorbell accompanies it
+        via kick_tx), socket otherwise."""
+        if self.alive:
+            self.send_ctl(frames.pack_ping(), fires)
 
     def send_devpull(self, data: bytes, done, fail, owner, fires: list) -> None:
         """Queue a DEVPULL descriptor (counts as data for flush/dirty
@@ -490,8 +511,12 @@ class TcpConn(BaseConn):
             n = self.sm_rx.read_into(target)
             if n == 0:
                 raise BlockingIOError
+            self.last_rx = time.monotonic()
             return n
-        return self.sock.recv_into(target)
+        n = self.sock.recv_into(target)
+        if n:
+            self.last_rx = time.monotonic()
+        return n
 
     def on_readable(self, fires: list) -> None:
         if not self.sm_active:
@@ -514,6 +539,7 @@ class TcpConn(BaseConn):
             if not b:
                 eof = True
                 break
+            self.last_rx = time.monotonic()  # doorbell bytes are proof of life
             if DB_STARVING in b:
                 starving = True
         self._pump_frames(fires)
@@ -621,6 +647,13 @@ class TcpConn(BaseConn):
                     self.send_ctl(frames.pack_flush_ack(a), fires)
             elif ftype == frames.T_FLUSH_ACK:
                 self.worker._on_flush_ack(self, a, fires)
+            elif ftype == frames.T_PING:
+                # Liveness probe: answer immediately.  _rx_read already
+                # refreshed last_rx, so receiving PINGs also proves the
+                # peer alive to us.
+                self.send_ctl(frames.pack_pong(), fires)
+            elif ftype == frames.T_PONG:
+                pass  # proof of life recorded by _rx_read
             elif ftype in (frames.T_HELLO, frames.T_HELLO_ACK, frames.T_DEVPULL):
                 self._ctl = (ftype, bytearray(b), 0, a)
             else:

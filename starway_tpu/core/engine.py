@@ -20,26 +20,32 @@ All user callbacks are invoked outside the worker lock.
 
 from __future__ import annotations
 
+import heapq
+import itertools
 import json
 import logging
 import selectors
 import socket
 import threading
+import time
 import uuid
 import weakref
 from collections import deque
 from typing import Callable, Optional
 
 from .. import config
-from ..errors import REASON_CANCELLED, REASON_NOT_CONNECTED, StarwayStateError
+from ..errors import (
+    REASON_CANCELLED,
+    REASON_NOT_CONNECTED,
+    REASON_TIMEOUT,
+    StarwayStateError,
+)
 from . import fabric, frames, state
 from .conn import InprocConn, TcpConn
 from .endpoint import ServerEndpoint
-from .matching import TagMatcher
+from .matching import PostedRecv, TagMatcher
 
 logger = logging.getLogger("starway_tpu")
-
-CONNECT_TIMEOUT_S = 3.0
 
 
 def _run_fires(fires) -> None:
@@ -93,6 +99,16 @@ class Worker:
         self._wake_w.setblocking(False)
         self.thread: Optional[threading.Thread] = None
         self._listener: Optional[socket.socket] = None
+        # Deadline timers: heap of (monotonic deadline, seq, fn(fires)).
+        # Armed from app threads under the lock; fired on the engine thread
+        # (the selector timeout tracks the earliest entry).  Settled ops
+        # leave their timer behind as a harmless no-op.
+        self._timers: list = []
+        self._timer_seq = itertools.count()
+        # Peer-liveness keepalive (config.keepalive_interval); sampled at
+        # engine start so one worker's lifetime sees one config.
+        self._ka_interval = 0.0
+        self._ka_misses = 3
         self.mode = "socket"
         self._address_blob: Optional[bytes] = None
         # PJRT transfer manager for cross-process device payloads
@@ -108,13 +124,22 @@ class Worker:
                 f"(status={state.NAMES[self.status]})"
             )
 
-    def post_recv(self, buf, tag: int, mask: int, done, fail, owner=None) -> None:
+    def post_recv(self, buf, tag: int, mask: int, done, fail, owner=None,
+                  timeout: Optional[float] = None) -> None:
+        pr = PostedRecv(buf, tag, mask, done, fail, owner)
         with self.lock:
             self._require_running()
-            fires = self.matcher.post_recv(buf, tag, mask, done, fail, owner)
+            fires = self.matcher.post_recv_pr(pr)
+        if timeout is not None:
+            # The timer holds the receive WEAKLY: the matcher is the only
+            # strong owner while it pends, so a settled receive (and its
+            # buffer) is collectable immediately and the late timer no-ops.
+            ref = weakref.ref(pr)
+            self._add_timer(timeout, lambda fires, r=ref: self._expire_recv_ref(r, fires))
         _run_fires(fires)
 
-    def submit_send(self, conn, view, tag: int, done, fail, owner=None) -> None:
+    def submit_send(self, conn, view, tag: int, done, fail, owner=None,
+                    timeout: Optional[float] = None) -> None:
         inline = False
         with self.lock:
             self._require_running()
@@ -122,15 +147,18 @@ class Worker:
                 inline = True
             else:
                 self._busy += 1
-                self.ops.append(("send", conn, view, tag, done, fail, owner))
+                self.ops.append(("send", conn, view, tag, done, fail, owner, timeout))
         if inline:
+            # Synchronous delivery: the op settles before a deadline could
+            # ever be armed, so `timeout` is moot here.
             fires: list = []
             conn.send_data(tag, view, done, fail, owner, fires)
             _run_fires(fires)
             return
         self._wake()
 
-    def submit_flush(self, done, fail, conns=None) -> None:
+    def submit_flush(self, done, fail, conns=None,
+                     timeout: Optional[float] = None) -> None:
         inline = False
         with self.lock:
             self._require_running()
@@ -142,12 +170,12 @@ class Worker:
                 inline = True
             else:
                 self._busy += 1
-                self.ops.append(("flush", done, fail, conns))
+                self.ops.append(("flush", done, fail, conns, timeout))
         if inline:
             # All in-process traffic already delivered synchronously in
             # submission order: the barrier is trivially met.
             fires = []
-            self._start_flush(done, fail, targets, fires)
+            self._start_flush(done, fail, targets, fires, timeout)
             _run_fires(fires)
             return
         self._wake()
@@ -302,21 +330,29 @@ class Worker:
         try:
             self.selector = selectors.DefaultSelector()
             self.selector.register(self._wake_r, selectors.EVENT_READ, self._on_wake)
+            self._ka_interval = config.keepalive_interval()
+            self._ka_misses = config.keepalive_misses()
             if not self._setup():
                 self._teardown_sockets()
                 return
+            if self._ka_interval > 0:
+                self._add_timer(self._ka_interval, self._ka_tick)
             while True:
                 with self.lock:
                     if self.status == state.CLOSING:
                         break
+                    timeout = None
+                    if self._timers:
+                        timeout = max(0.0, self._timers[0][0] - time.monotonic())
                 try:
-                    events = self.selector.select(None)
+                    events = self.selector.select(timeout)
                 except OSError:
                     break
                 for key, mask in events:
                     fires: list = []
                     key.data(mask, fires)
                     _run_fires(fires)
+                self._run_timers()
                 self._drain_ops()
             self._do_close()
         except Exception:
@@ -350,14 +386,128 @@ class Worker:
                     self._busy -= 1
             _run_fires(fires)
 
+    # ------------------------------------------------------------ deadlines
+    def _add_timer(self, delay: float, fn) -> None:
+        """Arm ``fn(fires)`` to run on the engine thread after ``delay``
+        seconds.  Callable from any thread."""
+        with self.lock:
+            heapq.heappush(
+                self._timers, (time.monotonic() + delay, next(self._timer_seq), fn)
+            )
+        self._wake()
+
+    def _run_timers(self) -> None:
+        while True:
+            with self.lock:
+                if not self._timers or self._timers[0][0] > time.monotonic():
+                    return
+                if self.status != state.RUNNING:
+                    return
+                _, _, fn = heapq.heappop(self._timers)
+            fires: list = []
+            try:
+                fn(fires)
+            except Exception:
+                logger.exception("starway: deadline timer raised")
+            _run_fires(fires)
+
+    def _expire_recv_ref(self, ref, fires) -> None:
+        pr = ref()
+        if pr is None:
+            return  # settled and collected: nothing to expire
+        with self.lock:
+            fires.extend(self.matcher.expire_recv(pr))
+
+    def _expire_send_ref(self, conn, ref, fires) -> None:
+        item = ref()
+        if item is None:
+            return  # settled and collected
+        self._expire_send(conn, item, fires)
+
+    def _expire_send(self, conn, item, fires) -> None:
+        """A deadline expired on a queued send.  An untouched item is
+        withdrawn cleanly; one already partially on the wire cannot be
+        unsent without corrupting the frame stream, so the conn is torn
+        down (the UCX endpoint-error analogue)."""
+        started = False
+        with self.lock:
+            if item.local_done:
+                return  # settled (completed locally, or cancelled)
+            started = item.off > 0
+            if not started:
+                try:
+                    conn.tx.remove(item)
+                except ValueError:
+                    return  # drained between checks
+            item.local_done = True  # suppress the close-time cancel path
+        if item.fail is not None:
+            fires.append(lambda f=item.fail: f(REASON_TIMEOUT))
+        if started:
+            self._conn_broken(conn, fires)
+
+    def _expire_flush(self, rec, fires) -> None:
+        if rec.completed:
+            return
+        rec.completed = True
+        if rec in self.flush_records:
+            self.flush_records.remove(rec)
+        if rec.fail is not None:
+            fires.append(lambda f=rec.fail: f(REASON_TIMEOUT))
+
+    # ------------------------------------------------------------ keepalive
+    def _ka_tick(self, fires) -> None:
+        """Recurring liveness sweep: PING quiet ka-negotiated conns, expire
+        those silent past the miss window."""
+        interval = self._ka_interval
+        window = interval * self._ka_misses
+        now = time.monotonic()
+        with self.lock:
+            conns = list(self.conns.values())
+        expired = []
+        for c in conns:
+            if c.kind != "tcp" or not c.alive or not getattr(c, "ka_ok", False):
+                continue
+            if now - c.last_rx > window:
+                expired.append(c)
+            elif now - c.last_rx >= interval:
+                c.send_ping(fires)
+        for c in expired:
+            self._conn_expired(c, fires)
+        with self.lock:
+            running = self.status == state.RUNNING
+        if running:
+            self._add_timer(interval, self._ka_tick)
+
+    def _conn_expired(self, conn, fires) -> None:
+        """Liveness window elapsed: declare the peer dead.  _conn_broken
+        (liveness-active branch) fails the receive the conn was streaming
+        into and, once no alive conns remain, every queued receive -- the
+        keepalive-enabled replacement for recvs-pend-forever.  On a server
+        with other live peers, queued (fan-in) receives stay postable."""
+        logger.warning(
+            "starway: peer %s liveness expired (%.3gs silent > %d x %.3gs)",
+            conn.peer_name or conn.conn_id,
+            time.monotonic() - conn.last_rx, self._ka_misses, self._ka_interval,
+        )
+        self._conn_broken(conn, fires)
+
     def _process_op(self, op, fires) -> None:
         if op[0] == "send":
-            _, conn, view, tag, done, fail, owner = op
+            _, conn, view, tag, done, fail, owner, timeout = op
             if conn is None or not conn.alive:
                 if fail is not None:
                     fires.append(lambda f=fail: f(REASON_NOT_CONNECTED))
                 return
-            conn.send_data(tag, view, done, fail, owner, fires)
+            item = conn.send_data(tag, view, done, fail, owner, fires)
+            if timeout is not None and item is not None and not item.local_done:
+                # Weak, like the recv timer: the tx queue is the only
+                # strong owner, so a drained send's payload is not pinned
+                # for the rest of the timeout.
+                ref = weakref.ref(item)
+                self._add_timer(
+                    timeout,
+                    lambda fires, c=conn, r=ref: self._expire_send_ref(c, r, fires),
+                )
         elif op[0] == "devpull":
             _, conn, data, done, fail, owner = op
             if conn is None or not conn.alive:
@@ -371,11 +521,12 @@ class Worker:
                 fires.extend(self.matcher.on_remote_complete(msg, payload, error))
             msg.remote.conn.remote_resolved(msg, fires)
         elif op[0] == "flush":
-            _, done, fail, conns = op
-            self._start_flush(done, fail, conns, fires)
+            _, done, fail, conns, timeout = op
+            self._start_flush(done, fail, conns, fires, timeout)
 
     # -------------------------------------------------------------- flush
-    def _start_flush(self, done, fail, conns, fires) -> None:
+    def _start_flush(self, done, fail, conns, fires,
+                     timeout: Optional[float] = None) -> None:
         with self.lock:
             candidates = conns if conns is not None else list(self.conns.values())
         # A dead connection with unacknowledged tagged data means the barrier
@@ -395,6 +546,8 @@ class Worker:
         for c in targets:
             c.send_flush(rec.waits[c], fires)
         self._try_complete_flush(rec, fires)
+        if timeout is not None and not rec.completed:
+            self._add_timer(timeout, lambda fires, r=rec: self._expire_flush(r, fires))
 
     def _on_flush_ack(self, conn, seq: int, fires) -> None:
         conn.flush_acked = max(conn.flush_acked, seq)
@@ -460,8 +613,30 @@ class Worker:
         """Peer died / stream reset.  Pending posted receives stay pending
         (the reference's UCX workers never fail posted recvs on peer death;
         pinned by tests/test_basic.py:250-277) -- only flush barriers
-        targeting the connection fail."""
+        targeting the connection fail.
+
+        With liveness detection active (STARWAY_KEEPALIVE > 0) on a
+        ka-negotiated conn, the user has opted out of recvs-pend-forever:
+        whatever killed the conn (liveness expiry, RST, EOF), the receive
+        it was streaming into fails, and once no alive conns remain every
+        queued receive fails too -- stable "not connected" keyword."""
+        ka_live = (self._ka_interval > 0 and conn.alive
+                   and getattr(conn, "ka_ok", False))
+        stranded = None
+        if ka_live:
+            with self.lock:
+                msg = getattr(conn, "_rx_msg", None)
+                if msg is not None and msg.posted is not None and not msg.complete:
+                    stranded = msg.posted
+                    msg.posted = None  # mark_dead's purge drops the partial
         conn.mark_dead(fires)
+        if ka_live:
+            reason = REASON_NOT_CONNECTED + " (peer lost; liveness detection active)"
+            if stranded is not None and stranded.fail is not None:
+                fires.append(lambda f=stranded.fail, r=reason: f(r))
+            with self.lock:
+                if not any(c.alive for c in self.conns.values()):
+                    fires.extend(self.matcher.fail_pending(reason))
         # Unclaimed, unstarted pull descriptors from the dead peer can never
         # resolve: drop them (a claimed one keeps its receive pending, the
         # peer-death contract; a started pull resolves on its own).
@@ -564,8 +739,10 @@ class ClientWorker(Worker):
         self.primary_conn = None
         self._connect_cb = None
         self._connect_target = None
+        self._connect_timeout: Optional[float] = None
 
-    def connect(self, addr: str, port: int, cb) -> None:
+    def connect(self, addr: str, port: int, cb,
+                timeout: Optional[float] = None) -> None:
         with self.lock:
             if self.status != state.VOID:
                 raise StarwayStateError(
@@ -574,10 +751,12 @@ class ClientWorker(Worker):
                 )
             self.status = state.INIT
         self._connect_cb = cb
+        self._connect_timeout = timeout
         self._connect_target = ("socket", addr, port, None)
         self._start_thread()
 
-    def connect_address(self, blob: bytes, cb) -> None:
+    def connect_address(self, blob: bytes, cb,
+                        timeout: Optional[float] = None) -> None:
         info = json.loads(bytes(blob).decode())
         with self.lock:
             if self.status != state.VOID:
@@ -587,6 +766,7 @@ class ClientWorker(Worker):
                 )
             self.status = state.INIT
         self._connect_cb = cb
+        self._connect_timeout = timeout
         self._connect_target = (
             "address",
             info.get("host", "127.0.0.1"),
@@ -633,8 +813,9 @@ class ClientWorker(Worker):
                 sm_offer = shmring.ShmSegment.create(self.worker_id[:8])
             except Exception:
                 sm_offer = None
+        connect_timeout = self._connect_timeout or config.connect_timeout()
         try:
-            extra = {}
+            extra = {"ka": "ok"}  # liveness capability, always offered
             if sm_offer is not None:
                 extra.update(
                     sm_key=sm_offer.key,
@@ -645,9 +826,8 @@ class ClientWorker(Worker):
 
             if _device.devpull_supported():
                 extra["devpull"] = "ok"
-            extra = extra or None
-            sock = socket.create_connection((addr, port), timeout=CONNECT_TIMEOUT_S)
-            sock.settimeout(CONNECT_TIMEOUT_S)
+            sock = socket.create_connection((addr, port), timeout=connect_timeout)
+            sock.settimeout(connect_timeout)
             sock.sendall(frames.pack_hello(self.worker_id, mode, self.name, extra))
             hdr = _read_exact(sock, frames.HEADER_SIZE)
             ftype, _, blen = frames.unpack_header(hdr)
@@ -663,6 +843,7 @@ class ClientWorker(Worker):
         conn = TcpConn(self, sock, mode, handshaken=True)
         conn.peer_name = ack.get("worker_id", "")
         conn.devpull_ok = ack.get("devpull") == "ok"
+        conn.ka_ok = ack.get("ka") == "ok"
         if sm_offer is not None:
             if ack.get("sm") == "ok":
                 conn.adopt_sm(sm_offer, creator=True)
@@ -813,6 +994,11 @@ class ServerWorker(Worker):
         ack_extra = {}
         if sm_seg is not None:
             ack_extra["sm"] = "ok"
+        if info.get("ka") == "ok":
+            # Liveness capability negotiated: both sides may PING and both
+            # must PONG (activation stays per-process via STARWAY_KEEPALIVE).
+            conn.ka_ok = True
+            ack_extra["ka"] = "ok"
         from .. import device as _device
 
         if info.get("devpull") == "ok" and _device.devpull_supported():

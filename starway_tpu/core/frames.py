@@ -23,7 +23,21 @@ DATA     sender tag                   payload length (bytes that follow)
 FLUSH    flush sequence number        0
 FLUSH_ACK flush sequence number       0
 DEVPULL  sender tag                   length of JSON descriptor that follows
+PING     0                            0
+PONG     0                            0
 ======== ============================ =====================================
+
+PING / PONG are the *negotiated* peer-liveness probe (``"ka": "ok"``
+offered in HELLO and confirmed in HELLO_ACK, like ``sm``/``devpull``):
+when ``STARWAY_KEEPALIVE`` enables liveness detection (config.py), each
+engine PINGs peers that have been silent for an interval and the peer
+answers PONG; any inbound bytes count as proof of life.  A peer silent
+for ``STARWAY_KEEPALIVE_MISSES`` intervals is declared dead.  Both
+engines ignore unknown HELLO keys, so an old peer simply never confirms
+``ka`` and is never PINGed -- all pairings interoperate.  On sm-upgraded
+conns the probes ride the rings while the socket stays the doorbell +
+liveness channel (core/shmring.py), so process death is still detected
+instantly by EOF/RST and the PING path only covers silent wedges.
 
 DEVPULL is a *negotiated extension* (``"devpull": "ok"`` offered in HELLO
 and confirmed in HELLO_ACK, like ``sm``): instead of streaming a device
@@ -77,6 +91,8 @@ T_DATA = 3
 T_FLUSH = 4
 T_FLUSH_ACK = 5
 T_DEVPULL = 6
+T_PING = 7
+T_PONG = 8
 
 
 def pack_header(ftype: int, a: int, b: int) -> bytes:
@@ -117,6 +133,14 @@ def pack_flush(seq: int) -> bytes:
 
 def pack_flush_ack(seq: int) -> bytes:
     return pack_header(T_FLUSH_ACK, seq, 0)
+
+
+def pack_ping() -> bytes:
+    return pack_header(T_PING, 0, 0)
+
+
+def pack_pong() -> bytes:
+    return pack_header(T_PONG, 0, 0)
 
 
 def pack_devpull(tag: int, desc: dict) -> bytes:

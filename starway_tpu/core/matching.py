@@ -35,7 +35,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Callable, Optional
 
-from ..errors import REASON_CANCELLED, REASON_TRUNCATED
+from ..errors import REASON_CANCELLED, REASON_TIMEOUT, REASON_TRUNCATED
 
 DoneCb = Callable[[int, int], None]  # (sender_tag, length)
 FailCb = Callable[[str], None]
@@ -69,7 +69,11 @@ class PostedRecv:
     ``buf`` is a writable host memoryview or a DeviceRecvSink.
     """
 
-    __slots__ = ("buf", "tag", "mask", "done", "fail", "claimed", "owner")
+    # __weakref__: deadline timers (core/engine.py) hold posted receives
+    # weakly, so a settled receive's buffer is not pinned until its timer
+    # would have fired.
+    __slots__ = ("buf", "tag", "mask", "done", "fail", "claimed", "owner",
+                 "__weakref__")
 
     def __init__(self, buf, tag: int, mask: int, done: DoneCb, fail: FailCb, owner=None):
         self.buf = buf
@@ -152,6 +156,13 @@ class TagMatcher:
     def post_recv(self, buf, tag: int, mask: int, done: DoneCb, fail: FailCb, owner=None) -> list:
         """Post a receive.  Returns fire thunks (may complete immediately
         against a fully-arrived unexpected message)."""
+        return self.post_recv_pr(PostedRecv(buf, tag, mask, done, fail, owner))
+
+    def post_recv_pr(self, pr: PostedRecv) -> list:
+        """:meth:`post_recv` with a caller-built record, so the caller can
+        keep the handle (the deadline timer in core/engine.py cancels
+        through it via :meth:`expire_recv`)."""
+        buf, tag, mask, done, fail = pr.buf, pr.tag, pr.mask, pr.done, pr.fail
         fires: list = []
         size = _size(buf)
         for msg in self.unexpected:
@@ -166,7 +177,6 @@ class TagMatcher:
                         msg.discard = True
                         fires.append(lambda m=msg: m.remote.start(m))
                     return fires
-                pr = PostedRecv(buf, tag, mask, done, fail, owner)
                 if msg.remote is not None and not msg.complete:
                     # Unpulled remote payload: claim it and start the pull
                     # (outside the lock -- fires run after release).
@@ -190,7 +200,7 @@ class TagMatcher:
                 pr.claimed = True
                 msg.posted = pr
                 return fires
-        self.posted.append(PostedRecv(buf, tag, mask, done, fail, owner))
+        self.posted.append(pr)
         return fires
 
     # -------------------------------------------------------- inbound (tcp)
@@ -367,6 +377,56 @@ class TagMatcher:
                 self.unexpected.remove(msg)
             except ValueError:
                 pass
+
+    # ----------------------------------------------------------- deadlines
+    def expire_recv(self, pr: PostedRecv) -> list:
+        """A deadline expired on a posted receive: withdraw it and fail it
+        with the stable ``"timed out"`` reason.
+
+        No-op (empty list) when the receive already completed or failed.
+        A receive claimed mid-stream reuses the :meth:`purge_inflight`
+        discipline: the partial message is discarded (remaining payload
+        bytes drain to the connection's scratch buffer, never into the
+        caller's buffer), it can never re-enter matching, and the caller's
+        buffer is immediately safe to repost.
+        """
+        fires: list = []
+        try:
+            self.posted.remove(pr)
+        except ValueError:
+            # Not queued: completed already, or claimed by an in-flight
+            # message (streamed or remote-pull) that is still arriving.
+            for msg in list(self.inflight):
+                if msg.posted is pr and not msg.complete:
+                    msg.posted = None
+                    msg.sink = None  # remaining bytes drain to conn scratch
+                    self.purge_inflight(msg)
+                    break
+            else:
+                return fires
+        fires.append(lambda pr=pr: pr.fail(REASON_TIMEOUT))
+        return fires
+
+    # ----------------------------------------------------- liveness expiry
+    def fail_pending(self, reason: str) -> list:
+        """Fail every pending posted receive (queued or claimed mid-stream)
+        with ``reason``, leaving complete unexpected messages intact so
+        already-delivered data can still satisfy future receives.  The
+        peer-liveness sweep (core/engine.py) runs this when the last alive
+        connection expires -- the keepalive-enabled replacement for "peer
+        death leaves posted recvs pending"."""
+        fires: list = []
+        while self.posted:
+            pr = self.posted.popleft()
+            fires.append(lambda pr=pr, reason=reason: pr.fail(reason))
+        for msg in list(self.inflight):
+            if msg.posted is not None and not msg.complete:
+                pr = msg.posted
+                msg.posted = None
+                msg.sink = None
+                self.purge_inflight(msg)
+                fires.append(lambda pr=pr, reason=reason: pr.fail(reason))
+        return fires
 
     # --------------------------------------------------------------- close
     def cancel_all(self) -> list:

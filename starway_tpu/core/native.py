@@ -85,15 +85,16 @@ def load() -> Optional[ctypes.CDLL]:
         lib.sw_send.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
             ctypes.c_uint64, _DONE_CB, _FAIL_CB, ctypes.c_void_p,
-            _DONE_CB, ctypes.c_void_p,
+            _DONE_CB, ctypes.c_void_p, ctypes.c_double,
         ]
         lib.sw_recv.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
             ctypes.c_uint64, _RECV_CB, _FAIL_CB, ctypes.c_void_p,
+            ctypes.c_double,
         ]
         lib.sw_flush.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, _DONE_CB, _FAIL_CB,
-            ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_double,
         ]
         lib.sw_close.argtypes = [ctypes.c_void_p, _DONE_CB, ctypes.c_void_p]
         lib.sw_status.argtypes = [ctypes.c_void_p]
@@ -264,6 +265,17 @@ def _on_devpull_claim(ctx, remote_id, recv_ctx, flags):
 
 def _is_device_sink(obj) -> bool:
     return obj is not None and hasattr(obj, "devbuf") and hasattr(obj, "accept_device")
+
+
+def _timeout_s(timeout) -> float:
+    """Map an optional per-op deadline to the C ABI sentinel (<= 0 = no
+    deadline).  A caller-passed 0/negative timeout means "already expired"
+    on the Python engine, so it becomes a minimal positive deadline here
+    instead of silently disabling the clock (two engines, one contract)."""
+    if timeout is None:
+        return 0.0
+    t = float(timeout)
+    return t if t > 0 else 1e-9
 
 
 # ------------------------------------------------------------- endpoints
@@ -624,7 +636,8 @@ class NativeWorkerBase:
             _take(key)
             raise StarwayStateError("starway native send rejected (not running)")
 
-    def submit_send(self, conn, view, tag: int, done, fail, owner=None) -> None:
+    def submit_send(self, conn, view, tag: int, done, fail, owner=None,
+                    timeout=None) -> None:
         self._require_running()
         conn_id = conn.conn_id if isinstance(conn, NativeConn) else 0
         mv = memoryview(view)
@@ -635,13 +648,15 @@ class NativeWorkerBase:
         # only thing allowed to drop this reference.
         rel_key = _register(None, None, mv, owner, keep)
         rc = self._lib.sw_send(self._h, conn_id, addr, len(mv), tag,
-                               _on_done, _on_fail, key, _on_release, rel_key)
+                               _on_done, _on_fail, key, _on_release, rel_key,
+                               _timeout_s(timeout))
         if rc != 0:
             _take(key)
             _take(rel_key)
             raise StarwayStateError("starway native send rejected (not running)")
 
-    def post_recv(self, buf, tag: int, mask: int, done, fail, owner=None) -> None:
+    def post_recv(self, buf, tag: int, mask: int, done, fail, owner=None,
+                  timeout=None) -> None:
         self._require_running()
         user_done = done
         if isinstance(buf, memoryview):
@@ -660,19 +675,21 @@ class NativeWorkerBase:
         # Slot 5 (user_done) lets a devpull claim complete the receive via
         # the device path instead of the staging-wrapped `done`.
         key = _register(done, fail, mv, owner, keep, user_done)
-        rc = self._lib.sw_recv(self._h, addr, len(mv), tag, mask, _on_recv, _on_fail, key)
+        rc = self._lib.sw_recv(self._h, addr, len(mv), tag, mask, _on_recv,
+                               _on_fail, key, _timeout_s(timeout))
         if rc != 0:
             _take(key)
             raise StarwayStateError("starway native recv rejected (not running)")
 
-    def submit_flush(self, done, fail, conns=None) -> None:
+    def submit_flush(self, done, fail, conns=None, timeout=None) -> None:
         self._require_running()
         key = _register(done, fail)
+        t = _timeout_s(timeout)
         if conns:
             conn_id = conns[0].conn_id if isinstance(conns[0], NativeConn) else 0
-            rc = self._lib.sw_flush(self._h, conn_id, 1, _on_done, _on_fail, key)
+            rc = self._lib.sw_flush(self._h, conn_id, 1, _on_done, _on_fail, key, t)
         else:
-            rc = self._lib.sw_flush(self._h, 0, 0, _on_done, _on_fail, key)
+            rc = self._lib.sw_flush(self._h, 0, 0, _on_done, _on_fail, key, t)
         if rc != 0:
             _take(key)
             raise StarwayStateError("starway native flush rejected (not running)")
@@ -793,10 +810,15 @@ class NativeClientWorker(NativeWorkerBase):
             _take(key)
             raise StarwayStateError("starway client supports a single connect")
 
-    def connect(self, addr: str, port: int, cb) -> None:
+    def connect(self, addr: str, port: int, cb, timeout=None) -> None:
+        # Per-call timeout override rides the env knob on the native engine
+        # (the C engine samples STARWAY_CONNECT_TIMEOUT at connect); the api
+        # layer additionally bounds the attempt with asyncio.wait_for.
+        del timeout
         self._do_connect(addr, port, "socket", cb)
 
-    def connect_address(self, blob: bytes, cb) -> None:
+    def connect_address(self, blob: bytes, cb, timeout=None) -> None:
+        del timeout
         info = json.loads(bytes(blob).decode())
         self._do_connect(info.get("host", "127.0.0.1"), int(info.get("port", 0)),
                          "address", cb)

@@ -19,10 +19,15 @@ Callback conventions (reference: src/starway/_bindings.pyi:30-90):
 * ``done_callback`` for sends/flushes takes no arguments.
 * ``done_callback`` for recvs takes ``(sender_tag, length)``.
 * ``fail_callback`` takes a single ``reason`` string; cancellation reasons
-  contain the substring ``"cancel"`` (pinned by tests/test_basic.py).
+  contain the substring ``"cancel"`` (pinned by tests/test_basic.py);
+  deadline expiry reasons contain ``"timed out"`` (tests/test_faults.py).
 * Connect callbacks take a status string, ``""`` meaning success.
 * Callbacks may be invoked from the engine thread but never while any worker
   lock is held.
+* ``timeout`` (seconds, ``None`` = unbounded) is an optional per-op
+  deadline both engines honour: an op not settled when it fires fails with
+  the stable ``"timed out"`` keyword and releases its transport/matcher
+  resources (a timed-out receive's buffer is immediately repostable).
 """
 
 from __future__ import annotations
@@ -74,14 +79,15 @@ class WorkerProtocol(Protocol):
 
     def submit_send(self, conn, view, tag: int,
                     done: DoneCallback, fail: FailCallback,
-                    owner=None) -> None: ...
+                    owner=None, timeout: Optional[float] = None) -> None: ...
 
     def post_recv(self, buf, tag: int, mask: int,
                   done: RecvDoneCallback, fail: FailCallback,
-                  owner=None) -> None: ...
+                  owner=None, timeout: Optional[float] = None) -> None: ...
 
     def submit_flush(self, done: DoneCallback, fail: FailCallback,
-                     conns: Optional[Iterable] = None) -> None: ...
+                     conns: Optional[Iterable] = None,
+                     timeout: Optional[float] = None) -> None: ...
 
     def close(self, cb: DoneCallback) -> None: ...
 
@@ -101,9 +107,11 @@ class ClientWorkerProtocol(WorkerProtocol, Protocol):
     @property
     def primary_conn(self): ...
 
-    def connect(self, addr: str, port: int, cb: ConnectCallback) -> None: ...
+    def connect(self, addr: str, port: int, cb: ConnectCallback,
+                timeout: Optional[float] = None) -> None: ...
 
-    def connect_address(self, blob: bytes, cb: ConnectCallback) -> None: ...
+    def connect_address(self, blob: bytes, cb: ConnectCallback,
+                        timeout: Optional[float] = None) -> None: ...
 
 
 @runtime_checkable

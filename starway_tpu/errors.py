@@ -11,8 +11,11 @@ tests/test_basic.py:500-511).  We keep those observable contracts:
 
   - ``"cancel"``     -- op cancelled by local close (tests/test_basic.py:638-663)
   - ``"not connected"`` -- connect failure / op on dead endpoint
-    (tests/test_basic.py:514-518)
+    (tests/test_basic.py:514-518), including peer-liveness expiry when
+    keepalive is enabled (STARWAY_KEEPALIVE, see config.py)
   - ``"truncated"``  -- message larger than the posted receive buffer
+  - ``"timed out"``  -- op deadline (``timeout=`` on asend/arecv/aflush/
+    aconnect) expired before completion (tests/test_faults.py)
 """
 
 from __future__ import annotations
@@ -35,4 +38,5 @@ class StarwayStateError(RuntimeError):
 REASON_CANCELLED = "Operation cancelled (local endpoint closed before completion)"
 REASON_NOT_CONNECTED = "Endpoint is not connected"
 REASON_TRUNCATED = "Message truncated: payload larger than posted receive buffer"
+REASON_TIMEOUT = "Operation timed out (deadline exceeded before completion)"
 REASON_INTERNAL = "Internal transport error"

@@ -2,7 +2,10 @@
 
 No pip/pybind11 in the image, so the extension is a plain shared object
 compiled with g++ and driven through ctypes.  Built lazily into the package
-directory; rebuilt when the source is newer than the artifact.
+directory; rebuilt when the source is newer than the artifact.  Every build
+attempt's compiler output is captured to ``_sw_native.build.log`` next to
+the artifact, and failures raise with the output tail + the log path, so a
+broken toolchain is diagnosable from the exception alone.
 """
 
 from __future__ import annotations
@@ -14,6 +17,9 @@ _PKG_DIR = Path(__file__).parent
 _SRC = _PKG_DIR.parent / "native" / "sw_engine.cpp"
 _HDR = _PKG_DIR.parent / "native" / "sw_engine.h"
 _OUT = _PKG_DIR / "_sw_native.so"
+_LOG = _PKG_DIR / "_sw_native.build.log"
+
+_BUILD_TIMEOUT_S = 300
 
 
 def prebuilt() -> "Path | None":
@@ -31,6 +37,26 @@ def prebuilt() -> "Path | None":
                                             _HDR.stat().st_mtime)):
         return _OUT
     return None
+
+
+def _capture_log(cmd: list, stdout, stderr) -> str:
+    """Write the build transcript to _LOG (best-effort) and return the
+    combined output tail for embedding in the raised error."""
+    def _text(x) -> str:
+        if x is None:
+            return ""
+        if isinstance(x, bytes):
+            return x.decode(errors="replace")
+        return x
+
+    out, err = _text(stdout), _text(stderr)
+    body = f"$ {' '.join(cmd)}\n--- stdout ---\n{out}\n--- stderr ---\n{err}\n"
+    try:
+        _LOG.write_text(body)
+    except OSError:
+        pass  # read-only install dir: the tail in the exception still helps
+    tail = (out + "\n" + err).strip()
+    return tail[-4000:]
 
 
 def ensure_built(force: bool = False) -> Path:
@@ -53,15 +79,35 @@ def ensure_built(force: bool = False) -> Path:
     if not force and _OUT.exists() and _OUT.stat().st_mtime >= src_mtime:
         return _OUT
     tmp = _OUT.with_suffix(f".tmp.{os.getpid()}.so")
+    # -lrt: shm_open/shm_unlink live in librt on glibc < 2.34 (harmless
+    # no-op link on newer glibc, where librt is a stub).
     cmd = [
         "g++", "-std=c++20", "-O2", "-fPIC", "-shared", "-pthread",
         "-Wall", "-Wextra",
-        str(_SRC), "-o", str(tmp),
+        str(_SRC), "-o", str(tmp), "-lrt",
     ]
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=_BUILD_TIMEOUT_S)
+        except subprocess.TimeoutExpired as e:
+            tail = _capture_log(cmd, e.stdout, e.stderr)
+            raise RuntimeError(
+                f"native build timed out after {_BUILD_TIMEOUT_S}s "
+                f"(log: {_LOG})\n{tail}"
+            ) from e
+        except OSError as e:  # g++ missing / not executable
+            raise RuntimeError(
+                f"native build could not start ({e}); is a C++ toolchain "
+                f"installed? (cmd: {' '.join(cmd)})"
+            ) from e
         if proc.returncode != 0:
-            raise RuntimeError(f"native build failed:\n{proc.stderr[-4000:]}")
+            tail = _capture_log(cmd, proc.stdout, proc.stderr)
+            raise RuntimeError(
+                f"native build failed with exit code {proc.returncode} "
+                f"(log: {_LOG})\n{tail}"
+            )
+        _capture_log(cmd, proc.stdout, proc.stderr)  # keep the success log too
         os.replace(tmp, _OUT)
     finally:
         tmp.unlink(missing_ok=True)

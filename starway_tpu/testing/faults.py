@@ -1,0 +1,279 @@
+"""TCP fault-injection proxy fabric.
+
+Production communication stacks treat partial failure as a first-class
+input: connections that die mid-frame, peers that accept and then go
+silent, links that partition without an RST.  The reference repo never
+exercises any of these (its failure tests kill whole processes); this
+module makes them reproducible on loopback so the fault-tolerance layer
+(deadlines, keepalive liveness, reconnect -- see DESIGN.md "Failure
+semantics & deadlines") can be driven through real sockets in-process.
+
+:class:`FaultProxy` sits between a starway client and server::
+
+    server.listen("127.0.0.1", sport)
+    proxy = FaultProxy("127.0.0.1", sport)        # transparent forwarder
+    proxy.start()
+    await client.aconnect("127.0.0.1", proxy.port)
+    ...
+    proxy.partition()   # both directions go silent; sockets stay open
+
+Fault modes (constructor ``mode=``):
+
+``forward``
+    Transparent byte pump (the default).  Runtime faults are injected
+    with :meth:`partition` / :meth:`heal`.
+``delay``
+    Forward with ``delay`` seconds of added latency per chunk.
+``drop``
+    Forward ``limit_bytes`` of client->server traffic, then hard-kill both
+    sides with an RST (SO_LINGER 0) -- the mid-frame connection kill.
+``truncate``
+    Forward ``limit_bytes`` of client->server traffic, then FIN both
+    sides -- the peer observes a clean EOF in the middle of a frame.
+``blackhole``
+    Accept the client, never dial the target, read and discard inbound
+    bytes, send nothing -- the accept-then-silence failure (a wedged or
+    firewalled peer).
+
+``partition_after`` (bytes, any mode that forwards) auto-triggers
+:meth:`partition` once that much client->server traffic has passed --
+deterministic mid-stream silence without test-side sleeps.
+
+Threads: one acceptor plus two pumps per proxied connection, all daemons;
+:meth:`stop` closes every socket and joins.  Loopback-only by design --
+this is a test harness, not a production relay.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+_CHUNK = 1 << 16
+
+MODES = ("forward", "delay", "drop", "truncate", "blackhole")
+
+
+class _ConnPair:
+    """One proxied connection: the client-side socket and (unless
+    blackholed) the upstream socket to the real server."""
+
+    def __init__(self, downstream: socket.socket, upstream: Optional[socket.socket]):
+        self.down = downstream
+        self.up = upstream
+        self.dead = False
+
+    def kill(self, rst: bool) -> None:
+        if self.dead:
+            return
+        self.dead = True
+        for s in (self.down, self.up):
+            if s is None:
+                continue
+            try:
+                if rst:
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                 struct.pack("ii", 1, 0))
+            except OSError:
+                pass
+            try:
+                # shutdown() interrupts a pump thread blocked in recv();
+                # close() alone does not and would strand it until the
+                # join timeout.
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class FaultProxy:
+    def __init__(self, target_host: str, target_port: int, mode: str = "forward",
+                 *, listen_host: str = "127.0.0.1", delay: float = 0.0,
+                 limit_bytes: int = 0, partition_after: Optional[int] = None):
+        if mode not in MODES:
+            raise ValueError(f"unknown fault mode {mode!r}; expected one of {MODES}")
+        self.target = (target_host, target_port)
+        self.mode = mode
+        self.delay = delay
+        self.limit_bytes = limit_bytes
+        self.partition_after = partition_after
+        self._partitioned = threading.Event()
+        self._stalled = threading.Event()
+        self._stopping = threading.Event()
+        self._lock = threading.Lock()
+        self._pairs: list[_ConnPair] = []
+        self._threads: list[threading.Thread] = []
+        self._c2s_bytes = 0  # client->server bytes forwarded (fault triggers)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((listen_host, 0))
+        self._listener.listen(64)
+        self.port: int = self._listener.getsockname()[1]
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "FaultProxy":
+        t = threading.Thread(target=self._accept_loop, name="faultproxy-accept",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def __enter__(self) -> "FaultProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        self._stalled.clear()  # release pumps parked in the stall loop
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)  # wake a blocked accept
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            pairs = list(self._pairs)
+        for p in pairs:
+            p.kill(rst=False)
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # ------------------------------------------------------ runtime faults
+    def partition(self) -> None:
+        """Go silent in both directions.  Sockets stay open: neither peer
+        sees EOF or RST -- the network-partition / wedged-peer failure that
+        only deadlines or keepalive liveness can detect."""
+        self._partitioned.set()
+
+    def heal(self) -> None:
+        """Resume forwarding.  Bytes swallowed during the partition are
+        gone (this is a byte pipe, not a retransmitting relay), so healing
+        mid-message leaves the framed stream corrupt -- heal only between
+        messages, or expect the engines to declare the conn broken."""
+        self._partitioned.clear()
+
+    def stall(self) -> None:
+        """Stop READING from both sides (unlike :meth:`partition`, which
+        keeps draining and discarding).  Kernel buffers back up and the
+        peers' sockets wedge -- the backpressure failure that blocks even
+        a send's first byte."""
+        self._stalled.set()
+
+    def unstall(self) -> None:
+        self._stalled.clear()
+
+    def kill_all(self, rst: bool = True) -> None:
+        """Tear down every proxied connection now (RST by default)."""
+        with self._lock:
+            pairs = list(self._pairs)
+        for p in pairs:
+            p.kill(rst)
+
+    @property
+    def forwarded_bytes(self) -> int:
+        return self._c2s_bytes
+
+    # ------------------------------------------------------------ internals
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                down, _ = self._listener.accept()
+            except OSError:
+                return
+            down.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self.mode == "blackhole":
+                pair = _ConnPair(down, None)
+                with self._lock:
+                    self._pairs.append(pair)
+                t = threading.Thread(target=self._blackhole_loop, args=(pair,),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+                continue
+            try:
+                up = socket.create_connection(self.target, timeout=5)
+                up.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                down.close()
+                continue
+            pair = _ConnPair(down, up)
+            with self._lock:
+                self._pairs.append(pair)
+            for src, dst, is_c2s in ((down, up, True), (up, down, False)):
+                t = threading.Thread(target=self._pump, args=(pair, src, dst, is_c2s),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    def _blackhole_loop(self, pair: _ConnPair) -> None:
+        # Accept-then-silence: drain inbound (so the client's kernel buffer
+        # never backs up into a send-side signal), respond with nothing.
+        while not self._stopping.is_set() and not pair.dead:
+            try:
+                if not pair.down.recv(_CHUNK):
+                    break
+            except OSError:
+                break
+        pair.kill(rst=False)
+
+    def _pump(self, pair: _ConnPair, src: socket.socket, dst: socket.socket,
+              is_c2s: bool) -> None:
+        while not self._stopping.is_set() and not pair.dead:
+            while (self._stalled.is_set() and not self._stopping.is_set()
+                   and not pair.dead):
+                time.sleep(0.01)  # backpressure: let kernel buffers fill
+            try:
+                data = src.recv(_CHUNK)
+            except OSError:
+                break
+            if not data:
+                if self._partitioned.is_set():
+                    return  # a partition swallows EOFs too: pure silence
+                # Clean EOF from one side: half-close towards the other so
+                # graceful shutdowns still look graceful through the proxy.
+                try:
+                    dst.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+                return
+            if self._partitioned.is_set():
+                continue  # swallowed: silence, not EOF
+            if self.delay > 0:
+                time.sleep(self.delay)
+            if is_c2s and self.mode in ("drop", "truncate"):
+                remaining = self.limit_bytes - self._c2s_bytes
+                if remaining <= 0:
+                    pair.kill(rst=self.mode == "drop")
+                    return
+                if len(data) > remaining:
+                    data = data[:remaining]  # deliver the partial frame...
+                    if not self._send_all(pair, dst, data, is_c2s):
+                        return
+                    pair.kill(rst=self.mode == "drop")  # ...then the fault
+                    return
+            if not self._send_all(pair, dst, data, is_c2s):
+                return
+            if (is_c2s and self.partition_after is not None
+                    and self._c2s_bytes >= self.partition_after):
+                self._partitioned.set()
+
+    def _send_all(self, pair: _ConnPair, dst: socket.socket, data: bytes,
+                  is_c2s: bool) -> bool:
+        try:
+            dst.sendall(data)
+        except OSError:
+            pair.kill(rst=False)
+            return False
+        if is_c2s:
+            self._c2s_bytes += len(data)
+        return True

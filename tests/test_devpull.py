@@ -29,6 +29,25 @@ MASK = (1 << 64) - 1
 N = 1 << 20  # 1 MiB: comfortably above STARWAY_DEVPULL_MIN
 
 
+def _pull_available() -> bool:
+    """Whether this jax build ships the transfer API at all (jax 0.4.37,
+    for example, has no jax.experimental.transfer / start_transfer_server).
+    Without it the capability is never negotiated and payloads stage --
+    correct delivery, so only the tests asserting the PULL transport must
+    skip; fallback/ordering/truncation tests still run."""
+    jax.devices()  # backend up first: the probe never initialises one
+    from starway_tpu.device import devpull_supported
+
+    return devpull_supported()
+
+
+requires_pull = pytest.mark.skipif(
+    not _pull_available(),
+    reason="PJRT transfer API unavailable in this jax build "
+           "(devpull_supported() is False; payloads stage instead)",
+)
+
+
 
 @pytest.fixture(autouse=True)
 def _force_tcp(monkeypatch):
@@ -50,6 +69,7 @@ async def _pair(port):
     return server, client
 
 
+@requires_pull
 async def test_devpull_same_host_two_workers(port):
     """Two workers over a real socket in one process: the payload must
     arrive via the pull path (array handoff), not host staging."""
@@ -140,6 +160,7 @@ async def test_devpull_host_buffer_recv(port):
     [(True, True), (True, False), (False, True)],
     ids=["native/native", "native-server/py-client", "py-server/native-client"],
 )
+@requires_pull
 async def test_devpull_engine_matrix(port, monkeypatch, server_native, client_native):
     """devpull is one wire contract across BOTH engines: every pairing
     negotiates it and the payload arrives via the pull path (the native
@@ -337,6 +358,7 @@ def _child_send_device(port, flush_then_close):
     asyncio.run(run())
 
 
+@requires_pull
 async def test_devpull_cross_process(port):
     """Real two-process transfer: jax.Array crosses processes via the pull
     path into a DeviceBuffer, bytes never staged through this framework."""
@@ -435,6 +457,7 @@ def _distributed_member(role, coord_port, data_port, q):
         q.put((role, traceback.format_exc()))
 
 
+@requires_pull
 async def test_devpull_between_jax_distributed_members(port):
     """Two spawned processes, EACH a jax.distributed member (CPU backend),
     exchange device payloads over devpull in both directions — the

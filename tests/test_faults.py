@@ -1,0 +1,406 @@
+"""Fault-tolerance layer under injected faults (DESIGN.md "Failure
+semantics & deadlines").
+
+Every scenario runs through :class:`starway_tpu.testing.faults.FaultProxy`
+-- a real TCP proxy on loopback that can drop, delay, truncate mid-frame,
+blackhole (accept-then-silence), stall, and partition connections -- and
+drives BOTH engines (pure-Python and native C++) plus mixed pairings.
+
+The acceptance contract: with a deadline or an expired liveness window,
+every pending asend/arecv/aflush fails with its stable reason keyword
+("timed out" / "not connected") within a bounded time -- zero hangs; with
+keepalive and timeouts unset, seed behaviour is unchanged (peer death
+leaves posted recvs pending, tests/test_basic.py).
+
+Wall-clock bounds are deliberately loose (the CI box is 1-core and noisy):
+they prove "bounded, not hung", not latency.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from starway_tpu import Client, Server
+from starway_tpu.testing.faults import FaultProxy
+
+pytestmark = pytest.mark.asyncio
+
+ADDR = "127.0.0.1"
+
+
+@pytest.fixture(params=["py", "native"])
+def engine(request, monkeypatch):
+    """Both engines behind the one worker contract (CLAUDE.md).  Workers
+    sample the env at construction, so this must run before Server()/
+    Client() are built."""
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    if request.param == "native":
+        from starway_tpu.core import native
+
+        if not native.available():
+            pytest.skip("native engine unavailable (no toolchain)")
+        monkeypatch.setenv("STARWAY_NATIVE", "1")
+    else:
+        monkeypatch.setenv("STARWAY_NATIVE", "0")
+    return request.param
+
+
+async def _aclose_all(*objs):
+    for o in objs:
+        try:
+            await asyncio.wait_for(o.aclose(), timeout=10)
+        except Exception:
+            pass
+
+
+async def _roundtrip(client, server, tag, n=64):
+    buf = np.zeros(n, dtype=np.uint8)
+    fut = server.arecv(buf, tag, (1 << 64) - 1)
+    await client.asend((np.arange(n) % 256).astype(np.uint8), tag)
+    stag, ln = await asyncio.wait_for(fut, timeout=15)
+    assert stag == tag and ln == n
+    np.testing.assert_array_equal(buf, (np.arange(n) % 256).astype(np.uint8))
+
+
+# --------------------------------------------------------------- deadlines
+
+
+async def test_recv_timeout_and_repost(engine, port):
+    """An unmatched arecv with a deadline fails "timed out" and its buffer
+    is immediately safe to repost (the regression the matcher's
+    expire/purge path pins)."""
+    server = Server()
+    server.listen(ADDR, port)
+    client = Client()
+    await client.aconnect(ADDR, port)
+    try:
+        buf = np.zeros(128, dtype=np.uint8)
+        t0 = time.monotonic()
+        with pytest.raises(Exception) as e:
+            await asyncio.wait_for(server.arecv(buf, 0x5, (1 << 64) - 1, timeout=0.4),
+                                   timeout=10)
+        assert "timed out" in str(e.value).lower()
+        assert time.monotonic() - t0 < 5.0
+        # Repost the SAME buffer: the matcher must have fully released it.
+        fut = server.arecv(buf, 0x5, (1 << 64) - 1)
+        await client.asend(np.arange(128, dtype=np.uint8), 0x5)
+        _, ln = await asyncio.wait_for(fut, timeout=15)
+        assert ln == 128
+        np.testing.assert_array_equal(buf, np.arange(128, dtype=np.uint8))
+    finally:
+        await _aclose_all(client, server)
+
+
+async def test_recv_timeout_midstream_partition(engine, port):
+    """A receive claimed by a message that stalls mid-stream (link
+    partitioned inside the frame) still honours its deadline, and the
+    partial never lands in the caller's buffer as a completion."""
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port, partition_after=100_000).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        n = 1 << 20  # eager (default rndv threshold is 8 MiB)
+        buf = np.zeros(n, dtype=np.uint8)
+        fut = server.arecv(buf, 0x6, (1 << 64) - 1, timeout=0.6)
+        await client.asend(np.ones(n, dtype=np.uint8), 0x6)
+        t0 = time.monotonic()
+        with pytest.raises(Exception) as e:
+            await asyncio.wait_for(fut, timeout=15)
+        assert "timed out" in str(e.value).lower()
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+async def test_partition_flush_timeout(engine, port):
+    """A flush whose FLUSH_ACK is swallowed by a partition fails "timed
+    out" at its deadline instead of hanging forever."""
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _roundtrip(client, server, 0x1)
+        proxy.partition()
+        await client.asend(np.arange(256, dtype=np.uint8), 0x2)  # eager, local
+        t0 = time.monotonic()
+        with pytest.raises(Exception) as e:
+            await asyncio.wait_for(client.aflush(timeout=0.6), timeout=15)
+        assert "timed out" in str(e.value).lower()
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+async def test_send_timeout_wedged_socket(engine, port, monkeypatch):
+    """A send that cannot even begin transmission (socket wedged behind a
+    stalled peer) fails "timed out" and is withdrawn without corrupting
+    the stream (the in-front rendezvous send keeps its place)."""
+    monkeypatch.setenv("STARWAY_RNDV_THRESHOLD", str(1 << 20))
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _roundtrip(client, server, 0x1)
+        proxy.partition()
+        proxy.stall()
+        # Fill the kernel pipe: rndv send completes locally at header write
+        # and then wedges mid-payload at the queue front.
+        big = np.zeros(64 << 20, dtype=np.uint8)
+        await asyncio.wait_for(client.asend(big, 0x2), timeout=30)
+        t0 = time.monotonic()
+        with pytest.raises(Exception) as e:
+            await asyncio.wait_for(
+                client.asend(np.arange(64, dtype=np.uint8), 0x3, timeout=0.5),
+                timeout=15)
+        assert "timed out" in str(e.value).lower()
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+# --------------------------------------------------- hard connection faults
+
+
+async def test_drop_midframe_fails_flush(engine, port):
+    """Mid-frame RST: the sender's flush fails with a stable keyword
+    instead of hanging; the receiver's claimed partial never completes."""
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port, mode="drop", limit_bytes=300_000).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        n = 1 << 20
+        sink = np.zeros(n, dtype=np.uint8)
+        recv_done = []
+        server.recv(sink, 0x9, (1 << 64) - 1,
+                    lambda t, ln: recv_done.append("done"),
+                    lambda r: recv_done.append(r))
+        await client.asend(np.ones(n, dtype=np.uint8), 0x9)
+        with pytest.raises(Exception) as e:
+            await asyncio.wait_for(client.aflush(timeout=10), timeout=30)
+        msg = str(e.value).lower()
+        assert "not connected" in msg or "cancel" in msg or "timed out" in msg
+        await asyncio.sleep(0.3)
+        assert not recv_done  # claimed partial stays pending (seed contract)
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+async def test_truncate_midframe_breaks_conn(engine, port):
+    """Clean EOF in the middle of a frame: the conn is declared broken and
+    a dirty flush fails instead of passing vacuously."""
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port, mode="truncate", limit_bytes=200_000).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await client.asend(np.ones(1 << 20, dtype=np.uint8), 0xA)
+        with pytest.raises(Exception) as e:
+            await asyncio.wait_for(client.aflush(timeout=10), timeout=30)
+        msg = str(e.value).lower()
+        assert "not connected" in msg or "cancel" in msg or "timed out" in msg
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+# ---------------------------------------------------------------- liveness
+
+
+async def test_keepalive_partition_fails_recv(engine, port, monkeypatch):
+    """A partitioned (silent, no RST) peer is declared dead after the
+    keepalive window and pending receives fail "not connected" -- bounded
+    by ~2x the configured window, not forever."""
+    monkeypatch.setenv("STARWAY_KEEPALIVE", "0.15")
+    monkeypatch.setenv("STARWAY_KEEPALIVE_MISSES", "2")
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _roundtrip(client, server, 0x1)
+        fut = client.arecv(np.zeros(64, dtype=np.uint8), 0x2, (1 << 64) - 1)
+        await asyncio.sleep(0)  # recv posted before the lights go out
+        proxy.partition()
+        t0 = time.monotonic()
+        with pytest.raises(Exception) as e:
+            await asyncio.wait_for(fut, timeout=20)
+        assert "not connected" in str(e.value).lower()
+        # window = interval * misses = 0.3s; generous 1-core bound.
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+async def test_keepalive_off_seed_parity(engine, port):
+    """With keepalive unset (the default), a partitioned peer leaves posted
+    receives pending -- the seed contract (tests/test_basic.py) unchanged."""
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _roundtrip(client, server, 0x1)
+        outcome = []
+        client.recv(np.zeros(64, dtype=np.uint8), 0x2, (1 << 64) - 1,
+                    lambda t, ln: outcome.append("done"),
+                    lambda r: outcome.append(r))
+        proxy.partition()
+        await asyncio.sleep(1.0)
+        assert not outcome  # still pending: no liveness, no deadline
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+@pytest.mark.parametrize(
+    "server_native,client_native",
+    [(False, True), (True, False)],
+    ids=["py-server/native-client", "native-server/py-client"],
+)
+async def test_keepalive_mixed_engine_interop(port, monkeypatch,
+                                              server_native, client_native):
+    """PING/PONG is a cross-engine wire contract: mixed pairings must (a)
+    keep a healthy-but-idle conn alive across several keepalive windows --
+    each engine answering the other's PINGs -- and (b) both declare death
+    after a partition (satellite: the test_sm_engine_interop pattern for
+    the ka extension, exercised in both directions)."""
+    from starway_tpu.core import native
+
+    if not native.available():
+        pytest.skip("native engine unavailable (no toolchain)")
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_KEEPALIVE", "0.15")
+    monkeypatch.setenv("STARWAY_KEEPALIVE_MISSES", "2")
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if server_native else "0")
+    server = Server()
+    server.listen(ADDR, port)
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if client_native else "0")
+    client = Client()
+    proxy = FaultProxy(ADDR, port).start()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _roundtrip(client, server, 0x1)
+        # Idle across > misses * interval: only PONGs keep the link alive.
+        await asyncio.sleep(0.8)
+        await _roundtrip(client, server, 0x2)  # both directions still deliver
+        # Now the partition: both sides must detect death, so the client's
+        # pending receive AND the server's pending receive fail.
+        cfut = client.arecv(np.zeros(64, dtype=np.uint8), 0x3, (1 << 64) - 1)
+        sfut = server.arecv(np.zeros(64, dtype=np.uint8), 0x4, (1 << 64) - 1)
+        await asyncio.sleep(0)
+        proxy.partition()
+        for fut in (cfut, sfut):
+            with pytest.raises(Exception) as e:
+                await asyncio.wait_for(fut, timeout=20)
+            assert "not connected" in str(e.value).lower()
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+# --------------------------------------------------------------- reconnect
+
+
+async def test_connect_retries_backoff(engine, port):
+    """aconnect(retries=, backoff=): a server that comes up late is reached
+    by the retry loop (fresh connect-once worker per attempt)."""
+    client = Client()
+    server = Server()
+
+    async def late_listen():
+        await asyncio.sleep(0.4)
+        server.listen(ADDR, port)
+
+    task = asyncio.ensure_future(late_listen())
+    try:
+        await asyncio.wait_for(
+            client.aconnect(ADDR, port, retries=6, backoff=0.1), timeout=30)
+        await _roundtrip(client, server, 0x1)
+    finally:
+        await task
+        await _aclose_all(client, server)
+
+
+async def test_connect_timeout_configurable(engine, port, monkeypatch):
+    """STARWAY_CONNECT_TIMEOUT bounds a handshake against an accept-then-
+    silent peer (blackhole) in both engines -- replacing the old hard-coded
+    3 s constant."""
+    monkeypatch.setenv("STARWAY_CONNECT_TIMEOUT", "0.4")
+    proxy = FaultProxy(ADDR, 1, mode="blackhole").start()  # target never dialed
+    client = Client()
+    t0 = time.monotonic()
+    with pytest.raises(Exception) as e:
+        await asyncio.wait_for(client.aconnect(ADDR, proxy.port), timeout=20)
+    assert "not connected" in str(e.value).lower()
+    assert time.monotonic() - t0 < 10.0
+    proxy.stop()
+
+
+async def test_connect_timeout_param_and_retries_exhaust(port):
+    """Per-call aconnect(timeout=) overrides the knob; exhausted retries
+    surface the last failure with a stable keyword."""
+    proxy = FaultProxy(ADDR, 1, mode="blackhole").start()
+    client = Client()
+    t0 = time.monotonic()
+    with pytest.raises(Exception) as e:
+        await asyncio.wait_for(
+            client.aconnect(ADDR, proxy.port, timeout=0.3, retries=1, backoff=0.1),
+            timeout=20)
+    msg = str(e.value).lower()
+    assert "timed out" in msg or "not connected" in msg
+    assert time.monotonic() - t0 < 10.0
+    proxy.stop()
+
+
+# ------------------------------------------------------------------- slow
+
+
+@pytest.mark.slow
+async def test_fault_cycles_stress(engine, port, monkeypatch):
+    """Long soak: repeated partition -> liveness expiry -> reconnect-with-
+    backoff cycles.  Each cycle must fully recover (fresh conn, data
+    flows) -- no leaked state across generations of dead conns."""
+    monkeypatch.setenv("STARWAY_KEEPALIVE", "0.15")
+    monkeypatch.setenv("STARWAY_KEEPALIVE_MISSES", "2")
+    server = Server()
+    server.listen(ADDR, port)
+    clients = []
+    proxies = []
+    try:
+        for cycle in range(3):
+            proxy = FaultProxy(ADDR, port).start()
+            proxies.append(proxy)
+            client = Client()
+            clients.append(client)
+            await asyncio.wait_for(
+                client.aconnect(ADDR, proxy.port, retries=3, backoff=0.1),
+                timeout=30)
+            await _roundtrip(client, server, 0x10 + cycle)
+            fut = client.arecv(np.zeros(64, dtype=np.uint8), 0x50, (1 << 64) - 1)
+            await asyncio.sleep(0)
+            proxy.partition()
+            with pytest.raises(Exception):
+                await asyncio.wait_for(fut, timeout=20)
+    finally:
+        await _aclose_all(*clients)
+        await _aclose_all(server)
+        for p in proxies:
+            p.stop()
