@@ -1605,8 +1605,11 @@ struct Worker {
       have = true;
     }
     if (!have) return -1;
-    auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+    // Round UP: truncating the sub-millisecond tail to 0 would busy-spin
+    // epoll until the timer lands (check_timers finds nothing due yet).
+    auto us = std::chrono::duration_cast<std::chrono::microseconds>(
                   next - Clock::now()).count();
+    auto ms = (us + 999) / 1000;
     if (ms < 0) ms = 0;
     if (ms > 60000) ms = 60000;
     return (int)ms;

@@ -60,8 +60,11 @@ typedef void (*sw_status_cb)(void* ctx, const char* status);
 
 /* ----------------------------------------------------------- lifecycle */
 
-/* Engine identification string ("starway-native-3": op deadlines +
- * PING/PONG peer liveness). */
+/* Engine identification string: op deadlines + PING/PONG peer liveness.
+ * The annotation below is machine-checked against the sw_engine.cpp
+ * implementation by the contract checker (python -m starway_tpu.analysis,
+ * rule contract-version) -- bump BOTH when the protocol changes.
+ * swcheck: engine-version "starway-native-3" */
 const char* sw_version(void);
 
 /* Allocate a client/server worker in the VOID state.  `worker_id` is the

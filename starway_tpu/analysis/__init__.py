@@ -1,0 +1,66 @@
+"""swcheck: static cross-engine contract checker and concurrency lint.
+
+``python -m starway_tpu.analysis`` runs four passes and exits non-zero on
+any finding (the CI merge gate; also step 1 of scripts/release_smoke.sh):
+
+* **contract** -- diffs the wire/shm/ABI/reason/handshake contract between
+  ``core/engine.py``-side sources and ``native/sw_engine.{h,cpp}``
+  ("two engines, one contract", CLAUDE.md).
+* **concurrency** -- callbacks never fire under a worker lock; no blocking
+  calls on the engine thread (DESIGN.md §2).
+* **layering** -- no jax imports under core/.
+* **markers** -- multi-GiB test payloads must carry @pytest.mark.slow.
+
+Waivers: a finding is suppressed by an explicit justified comment on (or
+directly above) the flagged line::
+
+    # swcheck: allow(blocking-call): bench harness runs off-engine
+
+A waiver without the ``: why`` justification, or naming an unknown rule,
+is itself a finding (``bad-waiver``).  See DESIGN.md §11.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, Optional
+
+from . import concurrency, contract, layering, markers
+from .base import (  # noqa: F401  (re-exported for tests and tooling)
+    RULES,
+    Finding,
+    apply_waivers,
+    core_py_files,
+    find_root,
+    scan_bad_waivers,
+    test_files,
+    waiver_audit_files,
+)
+
+PASSES = {
+    "contract": contract.run,
+    "concurrency": concurrency.run,
+    "layering": layering.run,
+    "markers": markers.run,
+}
+
+
+def run_all(root: Optional[str] = None,
+            passes: Optional[Iterable[str]] = None) -> list:
+    """Run the selected passes (default: all) against ``root`` and return
+    the post-waiver findings, sorted by location."""
+    rootp = find_root(root) if not isinstance(root, Path) else root
+    selected = list(passes) if passes else list(PASSES)
+    findings: list = []
+    for name in selected:
+        findings.extend(PASSES[name](rootp))
+    findings = apply_waivers(rootp, findings)
+    findings.extend(scan_bad_waivers(rootp, waiver_audit_files(rootp)))
+    seen = set()
+    unique = []
+    for f in sorted(findings, key=lambda f: (f.file, f.line, f.rule, f.message)):
+        key = (f.file, f.line, f.rule, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique

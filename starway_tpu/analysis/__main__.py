@@ -1,0 +1,55 @@
+"""CLI entry: ``python -m starway_tpu.analysis [--root DIR] [pass ...]``.
+
+Exit status 0 = contract holds; 1 = findings (printed one per line as
+``file:line: [rule] message``); 2 = usage error.  Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import PASSES, RULES, find_root, run_all
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m starway_tpu.analysis",
+        description="swcheck: cross-engine contract checker + concurrency "
+                    "lint (see DESIGN.md §11)",
+    )
+    parser.add_argument("passes", nargs="*", metavar="pass",
+                        help=f"subset of passes to run ({', '.join(PASSES)}); "
+                             "default: all")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: autodetect from cwd or the "
+                             "package location)")
+    parser.add_argument("--rules", action="store_true",
+                        help="list every rule name (waiver targets) and exit")
+    args = parser.parse_args(argv)
+
+    if args.rules:
+        for name, desc in sorted(RULES.items()):
+            print(f"{name:22s} {desc}")
+        return 0
+
+    unknown = [p for p in args.passes if p not in PASSES]
+    if unknown:
+        parser.error(f"unknown pass(es) {unknown}; choose from "
+                     f"{', '.join(PASSES)}")
+
+    root = find_root(args.root)
+    findings = run_all(root, args.passes or None)
+    for f in findings:
+        print(f.render())
+    ran = ", ".join(args.passes or PASSES)
+    if findings:
+        print(f"swcheck: {len(findings)} finding(s) [{ran}] in {root}",
+              file=sys.stderr)
+        return 1
+    print(f"swcheck: OK [{ran}] in {root}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,148 @@
+"""Pass 2: concurrency lint over starway_tpu/core/.
+
+Two invariants from DESIGN.md §2 (the FireList discipline):
+
+* ``callback-under-lock`` -- user callbacks are NEVER invoked while a
+  worker lock is held.  Inside a ``with <x>.lock:`` (or ``*_lock``) block
+  the only allowed pattern is *deferral*: append the callback (usually a
+  lambda) to a ``fires`` list and run it after the lock is released via
+  ``_run_fires``.  Flagged: any call to ``_run_fires`` inside a lock
+  block, and any direct invocation of a callback-shaped name (``done``,
+  ``fail``, ``cb`` ...).  Lambdas and nested defs are deferred execution
+  and are skipped.
+
+* ``blocking-call`` -- the engine thread is a shared event loop (one per
+  worker, zero CPU when idle); a blocking call wedges every connection on
+  it.  Flagged: ``time.sleep``, ``socket.create_connection`` without a
+  ``timeout=``, ``sock.settimeout(None)``, ``sock.setblocking(True)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .base import Finding, core_py_files, parse_or_finding, rel
+
+#: Names that, when *called* under a lock, are overwhelmingly user
+#: callbacks (the worker protocol's done/fail/recv/accept/close hooks).
+_CALLBACK_NAMES = {
+    "done", "fail", "cb", "callback", "user_done", "accept_cb", "close_cb",
+    "done_cb", "fail_cb", "on_done", "on_fail",
+}
+
+
+def _terminal_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    name = _terminal_name(node)
+    return name == "lock" or name.endswith("_lock")
+
+
+class _LockLint(ast.NodeVisitor):
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.lock_depth = 0
+        self.findings: list = []
+
+    # Function/lambda bodies are deferred execution: a callback *defined*
+    # under a lock runs later, outside it (that is the allowed pattern).
+    def _visit_deferred(self, node: ast.AST) -> None:
+        saved, self.lock_depth = self.lock_depth, 0
+        self.generic_visit(node)
+        self.lock_depth = saved
+
+    def visit_FunctionDef(self, node):        # noqa: N802
+        self._visit_deferred(node)
+
+    def visit_AsyncFunctionDef(self, node):   # noqa: N802
+        self._visit_deferred(node)
+
+    def visit_Lambda(self, node):             # noqa: N802
+        self._visit_deferred(node)
+
+    def visit_With(self, node):               # noqa: N802
+        is_lock = any(_is_lock_expr(item.context_expr) for item in node.items)
+        for item in node.items:
+            self.visit(item.context_expr)
+        if is_lock:
+            self.lock_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if is_lock:
+            self.lock_depth -= 1
+
+    def visit_Call(self, node):               # noqa: N802
+        if self.lock_depth > 0:
+            name = _terminal_name(node.func)
+            if name == "_run_fires":
+                self.findings.append(Finding(
+                    self.relpath, node.lineno, "callback-under-lock",
+                    "_run_fires invoked inside a `with ...lock:` block -- "
+                    "collect into `fires` and run after release "
+                    "(DESIGN.md §2: callbacks never fire under a worker lock)"))
+            elif name in _CALLBACK_NAMES:
+                self.findings.append(Finding(
+                    self.relpath, node.lineno, "callback-under-lock",
+                    f"callback `{name}(...)` invoked inside a `with ...lock:` "
+                    "block -- defer it via `fires.append(...)` instead"))
+        self.generic_visit(node)
+
+
+class _BlockingLint(ast.NodeVisitor):
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.findings: list = []
+
+    def visit_Call(self, node):               # noqa: N802
+        func = node.func
+        name = _terminal_name(func)
+        if name == "sleep" and isinstance(func, ast.Attribute) \
+                and _terminal_name(func.value) == "time":
+            self.findings.append(Finding(
+                self.relpath, node.lineno, "blocking-call",
+                "time.sleep under core/ -- the engine thread is an event "
+                "loop; use a deadline timer (Worker._add_timer) instead"))
+        elif name == "create_connection" \
+                and not any(kw.arg == "timeout" for kw in node.keywords) \
+                and len(node.args) < 2:  # timeout is the 2nd positional
+            self.findings.append(Finding(
+                self.relpath, node.lineno, "blocking-call",
+                "socket.create_connection without timeout= can block the "
+                "engine thread indefinitely (STARWAY_CONNECT_TIMEOUT exists "
+                "for this)"))
+        elif name == "settimeout" and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and node.args[0].value is None:
+            self.findings.append(Finding(
+                self.relpath, node.lineno, "blocking-call",
+                "settimeout(None) makes the socket blocking on the engine "
+                "thread"))
+        elif name == "setblocking" and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and node.args[0].value is True:
+            self.findings.append(Finding(
+                self.relpath, node.lineno, "blocking-call",
+                "setblocking(True) on an engine-thread socket"))
+        self.generic_visit(node)
+
+
+def run(root: Path) -> list:
+    out: list = []
+    for path in core_py_files(root):
+        relpath = rel(root, path)
+        tree, err = parse_or_finding(path, relpath)
+        if tree is None:
+            out.append(err)
+            continue
+        for lint_cls in (_LockLint, _BlockingLint):
+            lint = lint_cls(relpath)
+            lint.visit(tree)
+            out.extend(lint.findings)
+    return out

@@ -1,0 +1,46 @@
+"""Pass 3: layering lint -- no ``import jax`` anywhere under core/.
+
+The matcher and transports are byte-oriented; device awareness enters
+only through the duck-typed sink/payload protocols in device.py
+(CLAUDE.md architecture invariants).  A jax import in core/ would make
+the host transport unimportable in jax-free processes (the wheel's
+test-command imports core.native with only numpy installed) and couple
+the engine to the device plane.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .base import Finding, core_py_files, parse_or_finding, rel
+
+
+def _is_jax(module: str) -> bool:
+    return module == "jax" or module.startswith("jax.")
+
+
+def run(root: Path) -> list:
+    out: list = []
+    for path in core_py_files(root):
+        relpath = rel(root, path)
+        tree, err = parse_or_finding(path, relpath)
+        if tree is None:
+            out.append(err)
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if _is_jax(alias.name):
+                        out.append(Finding(
+                            relpath, node.lineno, "layering-jax",
+                            f"`import {alias.name}` under core/ -- device "
+                            "awareness enters only via device.py's "
+                            "duck-typed sink/payload protocols"))
+            elif isinstance(node, ast.ImportFrom):
+                if node.level == 0 and node.module and _is_jax(node.module):
+                    out.append(Finding(
+                        relpath, node.lineno, "layering-jax",
+                        f"`from {node.module} import ...` under core/ -- "
+                        "device awareness enters only via device.py"))
+    return out

@@ -14,18 +14,18 @@ Every frame starts with a fixed 17-byte little-endian header::
 
 Frame types (fields a / b):
 
-======== ============================ =====================================
-type     a                            b
-======== ============================ =====================================
-HELLO    0                            length of JSON body that follows
-HELLO_ACK0                            length of JSON body that follows
-DATA     sender tag                   payload length (bytes that follow)
-FLUSH    flush sequence number        0
-FLUSH_ACK flush sequence number       0
-DEVPULL  sender tag                   length of JSON descriptor that follows
-PING     0                            0
-PONG     0                            0
-======== ============================ =====================================
+========= ============================ ======================================
+type      a                            b
+========= ============================ ======================================
+HELLO     0                            length of JSON body that follows
+HELLO_ACK 0                            length of JSON body that follows
+DATA      sender tag                   payload length (bytes that follow)
+FLUSH     flush sequence number        0
+FLUSH_ACK flush sequence number        0
+DEVPULL   sender tag                   length of JSON descriptor that follows
+PING      0                            0
+PONG      0                            0
+========= ============================ ======================================
 
 PING / PONG are the *negotiated* peer-liveness probe (``"ka": "ok"``
 offered in HELLO and confirmed in HELLO_ACK, like ``sm``/``devpull``):

@@ -1,0 +1,320 @@
+"""swcheck (starway_tpu/analysis) -- the static contract gate's own tests.
+
+Two halves:
+
+* HEAD is clean: every pass runs green against this checkout (the same
+  invocation CI's ``swcheck`` job and release_smoke.sh step 1 make).
+* Each rule actually fires: a minimal copy of the contract surface is
+  seeded into tmpdir, one violation is mutated in, and the matching rule
+  must report it with a real file:line anchor.  The six ISSUE-2 fixtures
+  (bumped frame constant, changed shm offset, dropped timeout_s ABI arg,
+  callback under lock, jax import in core/, reworded reason string) are
+  all here, plus the waiver policy, the docstring frame table, the
+  engine-version annotation, and the multi-GiB marker guard.
+
+Violation payloads are embedded as *strings* so this file itself stays
+clean under the very passes it tests.
+"""
+
+from __future__ import annotations
+
+import re
+import shutil
+from pathlib import Path
+
+import pytest
+
+from starway_tpu import analysis
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _seed(tmp_path: Path) -> Path:
+    """Copy the minimal contract surface (core/, errors.py, native/) into
+    tmpdir so mutations never touch the real tree."""
+    root = tmp_path / "repo"
+    shutil.copytree(
+        REPO / "starway_tpu" / "core", root / "starway_tpu" / "core",
+        ignore=shutil.ignore_patterns("__pycache__"))
+    (root / "starway_tpu" / "errors.py").write_text(
+        (REPO / "starway_tpu" / "errors.py").read_text())
+    (root / "native").mkdir()
+    for name in ("sw_engine.h", "sw_engine.cpp"):
+        (root / "native" / name).write_text(
+            (REPO / "native" / name).read_text())
+    return root
+
+
+def _edit(root: Path, relpath: str, old: str, new: str) -> None:
+    p = root / relpath
+    text = p.read_text()
+    assert old in text, f"fixture drift: {old!r} not in {relpath}"
+    p.write_text(text.replace(old, new, 1))
+
+
+def _findings(root: Path, rule: str) -> list:
+    return [f for f in analysis.run_all(root) if f.rule == rule]
+
+
+def _assert_caught(root: Path, rule: str, needle: str, in_file: str) -> None:
+    hits = _findings(root, rule)
+    assert hits, f"rule {rule} did not fire"
+    hit = next((f for f in hits if needle in f.message), None)
+    assert hit is not None, f"no [{rule}] finding mentions {needle!r}: {hits}"
+    assert hit.line > 0 and hit.file.endswith(in_file), hit.render()
+    assert f"{hit.file}:{hit.line}: [{rule}]" in hit.render()
+
+
+# ------------------------------------------------------------- HEAD clean
+
+
+def test_head_is_clean():
+    findings = analysis.run_all(REPO)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_seeded_copy_is_clean(tmp_path):
+    # The mutation fixtures below are only meaningful if the unmutated
+    # copy passes: a dirty baseline would mask which rule fired.
+    root = _seed(tmp_path)
+    findings = analysis.run_all(root)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------- the six ISSUE-2 violations
+
+
+def test_bumped_frame_constant(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/frames.py", "T_DATA = 3", "T_DATA = 9")
+    _assert_caught(root, "contract-frames", "T_DATA", "frames.py")
+
+
+def test_changed_shm_offset(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/shmring.py", "OFF_HEAD = 64", "OFF_HEAD = 128")
+    _assert_caught(root, "contract-shm", "OFF_HEAD", "shmring.py")
+
+
+def test_dropped_timeout_abi_arg(tmp_path):
+    root = _seed(tmp_path)
+    p = root / "starway_tpu" / "core" / "native.py"
+    text = p.read_text()
+    new = re.sub(
+        r"(_RECV_CB, _FAIL_CB, ctypes\.c_void_p,\s*)ctypes\.c_double,",
+        r"\1", text, count=1)
+    assert new != text, "fixture drift: sw_recv argtypes shape changed"
+    p.write_text(new)
+    _assert_caught(root, "contract-abi", "sw_recv", "native.py")
+    hit = next(f for f in _findings(root, "contract-abi") if "sw_recv" in f.message)
+    assert "8 argtypes" in hit.message and "9 parameters" in hit.message
+
+
+def test_callback_under_lock(tmp_path):
+    root = _seed(tmp_path)
+    (root / "starway_tpu" / "core" / "_seeded_lock.py").write_text(
+        "def _run_fires(fires):\n"
+        "    pass\n"
+        "\n"
+        "class W:\n"
+        "    def bad(self, fires, fail):\n"
+        "        with self.lock:\n"
+        "            _run_fires(fires)\n"
+        "            fail('boom')\n"
+        "    def good(self, fires, fail):\n"
+        "        with self.lock:\n"
+        "            fires.append(lambda: fail('deferred is fine'))\n"
+        "        _run_fires(fires)\n"
+    )
+    hits = _findings(root, "callback-under-lock")
+    assert {f.line for f in hits} == {7, 8}, hits
+    _assert_caught(root, "callback-under-lock", "_run_fires", "_seeded_lock.py")
+    _assert_caught(root, "callback-under-lock", "`fail(...)`", "_seeded_lock.py")
+
+
+def test_import_jax_in_core(tmp_path):
+    root = _seed(tmp_path)
+    (root / "starway_tpu" / "core" / "_seeded_jax.py").write_text(
+        "import jax\n"
+        "from jax.experimental import transfer\n"
+    )
+    hits = _findings(root, "layering-jax")
+    assert {f.line for f in hits} == {1, 2}, hits
+    _assert_caught(root, "layering-jax", "import jax", "_seeded_jax.py")
+
+
+def test_reworded_reason_string(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/errors.py",
+          'REASON_TIMEOUT = "Operation timed out (deadline exceeded before completion)"',
+          'REASON_TIMEOUT = "Operation exceeded its deadline"')
+    hits = _findings(root, "contract-reason")
+    # Both sub-checks fire: the stable "timed out" keyword is gone AND the
+    # literal no longer matches the C++ engine's kTimedOut.
+    assert any("stable keyword" in f.message for f in hits), hits
+    assert any("kTimedOut" in f.message for f in hits), hits
+    _assert_caught(root, "contract-reason", "REASON_TIMEOUT", "errors.py")
+
+
+# ------------------------------------------------- remaining rule surface
+
+
+def test_blocking_call_on_engine_thread(tmp_path):
+    root = _seed(tmp_path)
+    (root / "starway_tpu" / "core" / "_seeded_sleep.py").write_text(
+        "import time\n"
+        "def spin():\n"
+        "    time.sleep(0.5)\n"
+    )
+    _assert_caught(root, "blocking-call", "time.sleep", "_seeded_sleep.py")
+
+
+def test_garbled_doc_table(tmp_path):
+    # Re-introduce the pre-fix bug this PR repaired: the HELLO_ACK row
+    # losing its column separator must be caught, so the docstring table
+    # can never silently drift from the T_* constants again.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/frames.py", "HELLO_ACK 0", "HELLO_ACK0 ")
+    hits = _findings(root, "contract-doctable")
+    assert any("HELLO_ACK0" in f.message for f in hits), hits
+    assert any("missing from the docstring table" in f.message for f in hits), hits
+
+
+def test_version_drift(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          'return "starway-native-3"', 'return "starway-native-4"')
+    _assert_caught(root, "contract-version", "starway-native-4", "sw_engine.h")
+
+
+def test_unmarked_multi_gib_test(tmp_path):
+    root = _seed(tmp_path)
+    tests = root / "tests"
+    tests.mkdir()
+    (tests / "test_seeded_huge.py").write_text(
+        "def test_moves_4gib():\n"
+        "    buf = bytearray(4 << 30)\n"
+        "    assert buf\n"
+    )
+    _assert_caught(root, "marker-slow", "test_moves_4gib", "test_seeded_huge.py")
+    # The same payload behind the marker is allowed.
+    (tests / "test_seeded_huge.py").write_text(
+        "import pytest\n"
+        "@pytest.mark.slow\n"
+        "def test_moves_4gib():\n"
+        "    buf = bytearray(4 << 30)\n"
+        "    assert buf\n"
+    )
+    assert _findings(root, "marker-slow") == []
+
+
+# ----------------------------------------------------------- waiver policy
+
+
+# The waiver comments below are assembled from halves so the text-based
+# waiver scanner does not see live waivers inside THIS file.
+_SWA = "# swcheck" + ": allow"
+
+
+def test_waiver_with_justification_suppresses(tmp_path):
+    root = _seed(tmp_path)
+    (root / "starway_tpu" / "core" / "_seeded_jax.py").write_text(
+        f"import jax  {_SWA}(layering-jax): exercising the waiver path\n"
+    )
+    assert _findings(root, "layering-jax") == []
+    assert _findings(root, "bad-waiver") == []
+
+
+def test_waiver_without_justification_is_a_finding(tmp_path):
+    root = _seed(tmp_path)
+    (root / "starway_tpu" / "core" / "_seeded_jax.py").write_text(
+        f"import jax  {_SWA}(layering-jax)\n"
+    )
+    assert _findings(root, "layering-jax") == []  # replaced, not doubled
+    _assert_caught(root, "bad-waiver", "no justification", "_seeded_jax.py")
+
+
+def test_waiver_unknown_rule_is_a_finding(tmp_path):
+    root = _seed(tmp_path)
+    (root / "starway_tpu" / "core" / "_seeded_waiver.py").write_text(
+        f"x = 1  {_SWA}(no-such-rule): why\n"
+    )
+    _assert_caught(root, "bad-waiver", "no-such-rule", "_seeded_waiver.py")
+
+
+def test_waiver_above_line_without_justification_single_finding(tmp_path):
+    # The above-the-line placement must behave like the same-line one:
+    # exactly ONE bad-waiver finding, anchored at the waiver's own line.
+    root = _seed(tmp_path)
+    (root / "starway_tpu" / "core" / "_seeded_jax.py").write_text(
+        f"{_SWA}(layering-jax)\n"
+        "import jax\n"
+    )
+    findings = analysis.run_all(root)
+    assert [(f.rule, f.line) for f in findings] == [("bad-waiver", 1)], findings
+
+
+def test_bad_waiver_in_native_sources_is_audited(tmp_path):
+    # Waivers are honoured in every file findings anchor to, so a broken
+    # waiver in the C++ sources must be reported too.
+    root = _seed(tmp_path)
+    p = root / "native" / "sw_engine.cpp"
+    p.write_text(p.read_text() + "\n// swcheck" + ": allow(contract-reasons): typo'd rule\n")
+    _assert_caught(root, "bad-waiver", "contract-reasons", "sw_engine.cpp")
+
+
+def test_handshake_key_only_in_comments_still_fails(tmp_path):
+    # Deleting the negotiation code must fire even when the key survives
+    # in comments/docstrings (the checker searches code literals only).
+    root = _seed(tmp_path)
+    p = root / "starway_tpu" / "core" / "engine.py"
+    p.write_text(p.read_text().replace('"ka"', '"kx"')
+                 + '\n# the "ka" key lives only in this comment now\n')
+    _assert_caught(root, "contract-handshake", '"ka"', "engine.py")
+    root2 = _seed(tmp_path / "two")
+    p = root2 / "native" / "sw_engine.cpp"
+    p.write_text(p.read_text().replace('"ka"', '"kx"')
+                 + '\n// the "ka" key lives only in this comment now\n')
+    _assert_caught(root2, "contract-handshake", '"ka"', "sw_engine.cpp")
+
+
+def test_unparseable_core_file_is_a_finding_in_every_pass(tmp_path):
+    # No pass may skip an unparseable file vacuously -- even run standalone
+    # -- and the cross-pass copies dedupe to one parse-error finding.
+    root = _seed(tmp_path)
+    (root / "starway_tpu" / "core" / "_seeded_syntax.py").write_text(
+        "def broken(:\n")
+    for passes in (["layering"], ["concurrency"], None):
+        hits = [f for f in analysis.run_all(root, passes)
+                if f.rule == "parse-error"]
+        assert len(hits) == 1 and hits[0].file.endswith("_seeded_syntax.py"), \
+            (passes, hits)
+
+
+def test_parametrized_multi_gib_payload_is_caught(tmp_path):
+    root = _seed(tmp_path)
+    tests = root / "tests"
+    tests.mkdir()
+    (tests / "test_seeded_param.py").write_text(
+        "import pytest\n"
+        "@pytest.mark.parametrize('size', [4 << 30])\n"
+        "def test_param_big(size):\n"
+        "    assert bytearray(size)\n"
+    )
+    _assert_caught(root, "marker-slow", "test_param_big", "test_seeded_param.py")
+
+
+# ------------------------------------------------------------- CLI surface
+
+
+def test_cli_exit_codes(tmp_path):
+    from starway_tpu.analysis.__main__ import main
+
+    assert main(["--root", str(REPO)]) == 0
+    assert main(["--root", str(REPO), "--rules"]) == 0
+    root = _seed(tmp_path)
+    (root / "starway_tpu" / "core" / "_seeded_jax.py").write_text("import jax\n")
+    assert main(["--root", str(root)]) == 1
+    assert main(["--root", str(root), "contract"]) == 0  # pass selection
+    with pytest.raises(SystemExit):
+        main(["--root", str(root), "nonsense-pass"])
