@@ -101,12 +101,18 @@ async def _pingpong(devices) -> tuple[list[float], list[float]]:
     # runs ~100 ms/dispatch; don't spend minutes on warmup).  Decide from the
     # min over the first two passes: the first pass alone conflates one-time
     # jit/alloc cold-start with link latency.
+    from starway_tpu import perf
+
     warmup, iters = WARMUP, ITERS
     fw_rtts: list[float] = []
     raw_rtts: list[float] = []
     first: list[float] = []
     i = 0
     while i < warmup + iters:
+        if i == warmup:
+            # Per-stage telemetry (perf.record_stage) covers measured
+            # iterations only, not warmup/cold-start.
+            perf.stage_reset()
         fw_dt = await fw_iter()
         raw_dt = raw_iter()
         if i < 2:
@@ -123,6 +129,28 @@ async def _pingpong(devices) -> tuple[list[float], list[float]]:
     return fw_rtts, raw_rtts
 
 
+def _pct(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile of an ascending list (stdlib-only)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q / 100 * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _stage_summary() -> str:
+    """Compact per-stage breakdown (stage=D2H, tx, rx, place=H2D): average
+    microseconds per recorded sample, measured iterations only."""
+    from starway_tpu import perf
+
+    snap = perf.stage_snapshot()
+    parts = []
+    for name in ("stage", "tx", "rx", "place"):
+        s = snap.get(name)
+        if s and s["count"]:
+            parts.append(f"{name}:{s['seconds'] / s['count'] * 1e6:.0f}us")
+    return ",".join(parts) if parts else "none"
+
+
 def main() -> None:
     import jax
 
@@ -136,7 +164,9 @@ def main() -> None:
     devices = jax.devices()
     fw, raw = asyncio.run(_pingpong(devices))
 
-    fw_p50 = statistics.median(fw)
+    fw_sorted = sorted(fw)
+    fw_p10, fw_p50, fw_p90 = (_pct(fw_sorted, 10), statistics.median(fw),
+                              _pct(fw_sorted, 90))
     raw_p50 = statistics.median(raw)
     fw_gbps = 2 * MSG_BYTES / fw_p50 / 1e9
     raw_gbps = 2 * MSG_BYTES / raw_p50 / 1e9
@@ -148,7 +178,9 @@ def main() -> None:
                 "metric": "1MiB jax.Array pingpong bandwidth via asend/arecv "
                 f"({'device-to-device' if len(devices) >= 2 else 'host-to-device'}, "
                 f"{len(devices)} dev, p50 of {len(fw)} interleaved iters; "
-                f"raw={raw_gbps:.2f}GB/s p50_rtt={fw_p50 * 1e6:.0f}us"
+                f"raw={raw_gbps:.2f}GB/s "
+                f"p10/p50/p90_rtt={fw_p10 * 1e6:.0f}/{fw_p50 * 1e6:.0f}/"
+                f"{fw_p90 * 1e6:.0f}us stages={_stage_summary()}"
                 f"{'; CPU FALLBACK: device backend unresponsive' if cpu_fallback else ''})",
                 "value": round(fw_gbps, 3),
                 "unit": "GB/s",

@@ -1032,6 +1032,10 @@ struct Worker {
   // messages cost one syscall (and one TCP segment) for header+payload
   // instead of two, and bursts of messages coalesce.  Returns bytes
   // written, 0 when the socket is full, -1 when the conn broke.
+  // Mirrored by the Python engine's TcpConn._gather_tx + kick_tx
+  // (core/conn.py): both engines batch at most 64 iovecs / 4 MiB per
+  // pass and never batch bytes past the sm transport switch point --
+  // keep the two pumps in lockstep when changing either.
   ssize_t tcp_tx_gather(Conn* c, FireList& fires) {
     constexpr int kMaxIov = 64;
     constexpr uint64_t kMaxBytes = 4u << 20;

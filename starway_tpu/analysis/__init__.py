@@ -1,6 +1,6 @@
 """swcheck: static cross-engine contract checker and concurrency lint.
 
-``python -m starway_tpu.analysis`` runs four passes and exits non-zero on
+``python -m starway_tpu.analysis`` runs five passes and exits non-zero on
 any finding (the CI merge gate; also step 1 of scripts/release_smoke.sh):
 
 * **contract** -- diffs the wire/shm/ABI/reason/handshake contract between
@@ -10,6 +10,8 @@ any finding (the CI merge gate; also step 1 of scripts/release_smoke.sh):
   calls on the engine thread (DESIGN.md §2).
 * **layering** -- no jax imports under core/.
 * **markers** -- multi-GiB test payloads must carry @pytest.mark.slow.
+* **hotpath** -- no full-payload ``bytes(...)``/``.tobytes()`` copies on
+  core/ data paths (the zero-copy discipline, DESIGN.md §12).
 
 Waivers: a finding is suppressed by an explicit justified comment on (or
 directly above) the flagged line::
@@ -25,7 +27,7 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Iterable, Optional
 
-from . import concurrency, contract, layering, markers
+from . import concurrency, contract, hotpath, layering, markers
 from .base import (  # noqa: F401  (re-exported for tests and tooling)
     RULES,
     Finding,
@@ -42,6 +44,7 @@ PASSES = {
     "concurrency": concurrency.run,
     "layering": layering.run,
     "markers": markers.run,
+    "hotpath": hotpath.run,
 }
 
 

@@ -32,6 +32,7 @@ RULES = {
     "blocking-call": "blocking call reachable on the engine thread",
     "layering-jax": "jax imported under core/ (device.py owns that boundary)",
     "marker-slow": "multi-GiB test payload without a `slow` marker",
+    "hotpath-copy": "full-payload bytes()/.tobytes() copy on a core/ data path",
     "bad-waiver": "swcheck waiver without a justification string",
     "parse-error": "a scanned Python file does not parse",
 }

@@ -323,6 +323,7 @@ async def run_loopback(args: argparse.Namespace) -> list:
 
 
 def dump_results(results, args: argparse.Namespace) -> None:
+    from . import perf
     from .benchmarks import get_scenario
 
     if not results:
@@ -333,12 +334,23 @@ def dump_results(results, args: argparse.Namespace) -> None:
         print(f"\n[{result.name}] {get_scenario(result.name).description}")
         for key, value in result.metrics.items():
             print(f"  {key}: {value:.6f}" if isinstance(value, float) else f"  {key}: {value}")
+    stages = perf.stage_snapshot()
+    if stages:
+        print("\n[pipeline stages] (this process, whole run; "
+              "stage=D2H tx/rx=transport place=H2D)")
+        for name, s in sorted(stages.items()):
+            avg_us = s["seconds"] / s["count"] * 1e6 if s["count"] else 0.0
+            print(f"  {name}: n={s['count']} avg={avg_us:.1f}us "
+                  f"bytes={s['bytes']} ({s['gbps']:.2f} GB/s)")
     if args.output:
         args.output.parent.mkdir(parents=True, exist_ok=True)
         report = {
             "timestamp": time.time(),
             "transport": os.environ.get("STARWAY_TLS"),
             "scenarios": [r.to_dict(include_samples=args.store_trace) for r in results],
+            # Per-stage pipeline telemetry (DESIGN.md §12): loopback runs
+            # see both sides; client-role runs see the client's half.
+            "stages": stages,
         }
         args.output.write_text(json.dumps(report, indent=2))
         print(f"\nJSON results written to {args.output}")

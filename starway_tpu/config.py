@@ -57,6 +57,15 @@ benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
     acquire/release atomics even on x86 (the off-x86 code path, made
     testable on x86 CI; see core/shmring.py).
 
+``STARWAY_CHUNK``
+    Data-plane pipelining granularity in bytes (default 256 KiB; 0
+    disables pipelining).  Device payloads crossing the framed stream are
+    staged device-to-host one chunk at a time so the D2H of chunk k+1
+    overlaps the transport write of chunk k, and receive-side host-to-
+    device placement of completed chunks overlaps the remaining stream
+    reads (DESIGN.md §12).  Also sizes the reusable host staging-buffer
+    pool that replaces per-transfer allocation.
+
 ``STARWAY_CONNECT_TIMEOUT``
     Per-attempt connect + handshake deadline in seconds (default 3.0).
     Both engines honour it; ``aconnect(..., timeout=)`` overrides it per
@@ -86,6 +95,7 @@ __all__ = [
     "transports_enabled",
     "advertised_host",
     "rndv_threshold",
+    "chunk_bytes",
     "use_native",
     "device_backend",
     "devpull_enabled",
@@ -154,6 +164,16 @@ def devpull_threshold() -> int:
 
 def rndv_threshold() -> int:
     return int(_env("STARWAY_RNDV_THRESHOLD", str(8 * 1024 * 1024)))
+
+
+def chunk_bytes() -> int:
+    """Data-plane pipelining granularity (STARWAY_CHUNK); 0 disables
+    chunked staging and the receive-side placement overlap."""
+    try:
+        v = int(_env("STARWAY_CHUNK", str(256 * 1024)))
+    except ValueError:
+        return 256 * 1024
+    return v if v > 0 else 0
 
 
 def connect_timeout() -> float:

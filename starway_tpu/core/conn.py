@@ -37,20 +37,28 @@ Send completion semantics (mirrors UCX eager/RNDV, SURVEY.md section 5
 from __future__ import annotations
 
 import itertools
+import logging
 import socket
 import time
 from collections import deque
 from typing import Optional
 
-from .. import config
+from .. import config, perf
 from ..errors import REASON_CANCELLED, REASON_NOT_CONNECTED
 from . import frames, state
 from .matching import InboundMsg
+
+logger = logging.getLogger("starway_tpu")
 
 _conn_ids = itertools.count(1)
 
 TX_CHUNK = 1 << 22  # 4 MiB socket write granularity
 RX_CHUNK = 1 << 22
+# Gathered-write bounds for the socket TX pump (kick_tx): views per sendmsg
+# (well under IOV_MAX=1024) and bytes per pass.  Mirrors the native engine's
+# tcp_tx_gather (native/sw_engine.cpp) -- one syscall covers a burst of
+# queued small frames plus the front of a large payload.
+GATHER_IOV = 64
 
 # Doorbell byte values on an sm-upgraded conn's socket (the contract shared
 # with the native engine -- native/sw_engine.cpp).  Any byte wakes the peer
@@ -64,39 +72,96 @@ DB_STARVING = 2
 
 
 class TxData:
-    """An outgoing tagged message (header + zero-copy payload view)."""
+    """An outgoing tagged message (header + zero-copy payload view).
+
+    ``payload`` is either a flat host ``memoryview`` or a *chunked* payload
+    duck type (``nbytes`` + ``host_chunk(pos) -> (chunk_start, view)``, see
+    device.py DevicePayload.chunked): the TX pump then materialises host
+    bytes one chunk at a time, and the payload prefetches the next chunk's
+    device-to-host copy before returning the current one -- staging overlaps
+    transmission (DESIGN.md §12).  Either way the wire sees one ordinary
+    DATA frame.
+    """
 
     # __weakref__: deadline timers (core/engine.py) hold queued sends
     # weakly, so a completed send's payload is not pinned until its timer
     # would have fired.
-    __slots__ = ("header", "payload", "off", "done", "fail", "owner", "rndv",
-                 "local_done", "switch_after", "__weakref__")
+    __slots__ = ("header", "payload", "nbytes", "off", "done", "fail",
+                 "owner", "rndv", "local_done", "switch_after",
+                 "_chunk_start", "_chunk_view", "__weakref__")
 
-    def __init__(self, tag: int, payload: memoryview, done, fail, owner):
-        self.header = frames.pack_data_header(tag, len(payload))
+    def __init__(self, tag: int, payload, done, fail, owner):
+        if isinstance(payload, memoryview):
+            self.nbytes = len(payload)
+            self._chunk_start = 0
+            self._chunk_view: Optional[memoryview] = payload
+        else:  # chunked payload duck type
+            self.nbytes = int(payload.nbytes)
+            self._chunk_start = 0
+            self._chunk_view = None
+        self.header = frames.pack_data_header(tag, self.nbytes)
         self.payload = payload
         self.off = 0
         self.done = done
         self.fail = fail
         self.owner = owner
-        self.rndv = len(payload) > config.rndv_threshold()
+        self.rndv = self.nbytes > config.rndv_threshold()
         self.local_done = False
         self.switch_after = False
 
     @property
     def total(self) -> int:
-        return len(self.header) + len(self.payload)
+        return len(self.header) + self.nbytes
+
+    @property
+    def remaining(self) -> int:
+        return self.total - self.off
+
+    def payload_slice(self, pos: int, limit: int) -> memoryview:
+        """Up to ``limit`` payload bytes starting at ``pos``, never crossing
+        a staging-chunk boundary."""
+        view, start = self._chunk_view, self._chunk_start
+        if view is None or not (start <= pos < start + len(view)):
+            start, view = self.payload.host_chunk(pos)
+            self._chunk_start, self._chunk_view = start, view
+        rel = pos - start
+        return view[rel : rel + limit]
+
+    def tx_views(self, max_bytes: int) -> list:
+        """Unwritten views for the gathered socket pump (header remnant +
+        the current payload chunk), bounded by ``max_bytes``."""
+        views = []
+        off, hlen, take = self.off, len(self.header), 0
+        if off < hlen:
+            h = memoryview(self.header)[off:]
+            views.append(h)
+            take = len(h)
+            off = hlen
+        if take < max_bytes and off < self.total:
+            sl = self.payload_slice(off - hlen, min(TX_CHUNK, max_bytes - take))
+            if len(sl):
+                views.append(sl)
+        return views
+
+    def advance(self, n: int, fires: list) -> None:
+        self.off += n
+        self._maybe_local_complete(fires)
+        if self.off >= self.total and not self.local_done:
+            self.local_done = True
+            if self.done is not None:
+                fires.append(self.done)
 
     def write(self, conn: "TcpConn", fires: list) -> bool:
-        """Write as much as possible.  True when fully written."""
+        """Write as much as possible (ring transport).  True when fully
+        written.  (The socket transport uses the gathered pump in kick_tx.)"""
         hlen = len(self.header)
         while self.off < self.total:
             if self.off < hlen:
                 # Header + first payload chunk in one gathered write: small
                 # messages cost one syscall (and one TCP segment), not two.
                 views = [memoryview(self.header)[self.off :]]
-                if len(self.payload):
-                    views.append(self.payload[:TX_CHUNK])
+                if self.nbytes:
+                    views.append(self.payload_slice(0, TX_CHUNK))
                 try:
                     n = conn._tx_writev(views)
                 except BlockingIOError:
@@ -105,7 +170,7 @@ class TxData:
             else:
                 p = self.off - hlen
                 try:
-                    n = conn._tx_write(self.payload[p : p + TX_CHUNK])
+                    n = conn._tx_write(self.payload_slice(p, TX_CHUNK))
                 except BlockingIOError:
                     self._maybe_local_complete(fires)
                     return False
@@ -147,6 +212,20 @@ class TxDevpull:
         self.owner = owner
         self.switch_after = False
 
+    @property
+    def remaining(self) -> int:
+        return len(self.data) - self.off
+
+    def tx_views(self, max_bytes: int) -> list:
+        v = memoryview(self.data)[self.off : self.off + max_bytes]
+        return [v] if len(v) else []
+
+    def advance(self, n: int, fires: list) -> None:
+        self.off += n
+        if self.off >= len(self.data) and self.done is not None:
+            done, self.done = self.done, None
+            fires.append(done)
+
     def write(self, conn: "TcpConn", fires: list) -> bool:
         while self.off < len(self.data):
             try:
@@ -181,6 +260,17 @@ class TxCtl:
         self.data = data
         self.off = 0
         self.switch_after = switch_after
+
+    @property
+    def remaining(self) -> int:
+        return len(self.data) - self.off
+
+    def tx_views(self, max_bytes: int) -> list:
+        v = memoryview(self.data)[self.off : self.off + max_bytes]
+        return [v] if len(v) else []
+
+    def advance(self, n: int, fires: list) -> None:
+        self.off += n
 
     def write(self, conn: "TcpConn", fires: list) -> bool:
         while self.off < len(self.data):
@@ -345,8 +435,12 @@ class TcpConn(BaseConn):
     def _tx_write(self, chunk) -> int:
         """Write bytes to the active transport; raises BlockingIOError when
         it cannot take any (socket buffer / ring full)."""
+        t0 = time.perf_counter()
         if not self._tx_via_ring:
-            return self.sock.send(chunk)
+            n = self.sock.send(chunk)
+            if n:
+                perf.record_stage("tx", time.perf_counter() - t0, n)
+            return n
         n = self.sm_tx.write(chunk)
         if n == 0:
             # Ring full.  kick_tx signals the peer with a starving doorbell;
@@ -354,13 +448,14 @@ class TcpConn(BaseConn):
             # signaling rides the socket, so syscall ordering makes the sleep
             # race-free even though pure Python cannot fence (shmring.py).
             raise BlockingIOError
+        perf.record_stage("tx", time.perf_counter() - t0, n)
         return n
 
     def _tx_writev(self, views: list) -> int:
-        """Gathered write of several views; raises BlockingIOError when the
-        transport cannot take any bytes."""
-        if not self._tx_via_ring:
-            return self.sock.sendmsg(views)
+        """Gathered write of several views via :meth:`_tx_write` (the
+        socket transport instead gathers across whole queue items in
+        kick_tx's sendmsg pump); raises BlockingIOError when the transport
+        cannot take any bytes."""
         total = 0
         for v in views:
             try:
@@ -374,10 +469,16 @@ class TcpConn(BaseConn):
                 break
         return total
 
-    def send_data(self, tag: int, payload: memoryview, done, fail, owner, fires: list):
+    def send_data(self, tag: int, payload, done, fail, owner, fires: list,
+                  kick: bool = True):
         """Queue a tagged message.  Returns the TxData handle so the worker
         can arm a deadline timer against it (core/engine.py), or None when
-        the conn is already dead."""
+        the conn is already dead.
+
+        ``kick=False`` defers the transport push: the engine's op drain
+        queues a whole burst of sends first and kicks each conn once, so
+        the gathered pump coalesces the burst into single sendmsg passes
+        (Worker._drain_ops)."""
         if not self.alive:
             if fail is not None:
                 fires.append(lambda: fail(REASON_NOT_CONNECTED + " (connection reset)"))
@@ -386,7 +487,8 @@ class TcpConn(BaseConn):
         self._data_counter += 1
         item = TxData(tag, payload, done, fail, owner)
         self.tx.append(item)
-        self.kick_tx(fires)
+        if kick:
+            self.kick_tx(fires)
         return item
 
     def send_flush(self, seq: int, fires: list) -> None:
@@ -410,7 +512,8 @@ class TcpConn(BaseConn):
         if self.alive:
             self.send_ctl(frames.pack_ping(), fires)
 
-    def send_devpull(self, data: bytes, done, fail, owner, fires: list) -> None:
+    def send_devpull(self, data: bytes, done, fail, owner, fires: list,
+                     kick: bool = True) -> None:
         """Queue a DEVPULL descriptor (counts as data for flush/dirty
         accounting: the flush barrier must cover the pulled payload)."""
         if not self.alive:
@@ -420,7 +523,8 @@ class TcpConn(BaseConn):
         self.dirty = True
         self._data_counter += 1
         self.tx.append(TxDevpull(data, done, fail, owner))
-        self.kick_tx(fires)
+        if kick:
+            self.kick_tx(fires)
 
     # ------------------------------------------------- devpull rx tracking
     def remote_received(self, msg) -> None:
@@ -448,6 +552,34 @@ class TcpConn(BaseConn):
             for seq, _ in ready:
                 self.send_ctl(frames.pack_flush_ack(seq), fires)
 
+    def _gather_tx(self) -> tuple[list, list]:
+        """Collect unwritten views across queued items for one sendmsg pass
+        (the multi-item extension of the header+payload ``_tx_writev``;
+        mirrors the native engine's tcp_tx_gather).  Returns (views,
+        [(item, offered_bytes)]); never batches past the sm switch point."""
+        views: list = []
+        spans: list = []
+        take = 0
+        for item in self.tx:
+            if len(views) >= GATHER_IOV or take >= TX_CHUNK:
+                break
+            offered = 0
+            for v in item.tx_views(TX_CHUNK - take):
+                views.append(v)
+                offered += len(v)
+            take += offered
+            if offered:
+                spans.append((item, offered))
+            if item.switch_after:
+                break
+            if offered < item.remaining:
+                # Item not fully offered (byte budget, or a chunked payload
+                # whose later chunks are not staged yet): nothing behind it
+                # may ride this pass, or the later frame's bytes would land
+                # inside this item's in-flight DATA payload.
+                break
+        return views, spans
+
     def kick_tx(self, fires: list) -> None:
         if not self.alive:
             return
@@ -455,16 +587,56 @@ class TcpConn(BaseConn):
         blocked = False
         try:
             while self.tx:
-                item = self.tx[0]
-                if not item.write(self, fires):
+                if self._tx_via_ring:
+                    item = self.tx[0]
+                    if not item.write(self, fires):
+                        blocked = True
+                        break
+                    self.tx.popleft()
+                    continue
+                # Socket: one gathered sendmsg per pass across queued items
+                # -- a burst of small frames costs one syscall, and a large
+                # payload's next chunk rides along with whatever control
+                # frames queued behind it.
+                views, spans = self._gather_tx()
+                if not views:
+                    break
+                tw0 = time.perf_counter()
+                try:
+                    n = self.sock.sendmsg(views)
+                except BlockingIOError:
+                    first = self.tx[0]
+                    if isinstance(first, TxData):
+                        first._maybe_local_complete(fires)
                     blocked = True
                     break
-                self.tx.popleft()
-                if getattr(item, "switch_after", False):
-                    # The sm switch point (HELLO_ACK) left the socket: every
-                    # later item rides the ring, even those already queued.
-                    self._tx_via_ring = True
+                perf.record_stage("tx", time.perf_counter() - tw0, n)
+                for item, offered in spans:
+                    adv = min(n, offered)
+                    if adv == 0:
+                        break
+                    item.advance(adv, fires)
+                    n -= adv
+                    if item.remaining == 0 and self.tx and self.tx[0] is item:
+                        self.tx.popleft()
+                        if getattr(item, "switch_after", False):
+                            # The sm switch point (HELLO_ACK) left the
+                            # socket: every later item rides the ring, even
+                            # those already queued.  _gather_tx stopped at
+                            # this item, so no later bytes were sent.
+                            self._tx_via_ring = True
         except (BrokenPipeError, ConnectionResetError, OSError):
+            self.worker._conn_broken(self, fires)
+            return
+        except Exception:
+            # Chunked D2H staging failed mid-message (host_chunk raised:
+            # the array was deleted/donated after asend, or a device
+            # runtime error).  The frame header already promised nbytes the
+            # stream can no longer produce, so reset the connection (the
+            # same discipline as a deadline on a started send) -- queued
+            # ops fail with the stable "cancel" reason instead of the
+            # whole engine emergency-closing.
+            logger.exception("starway: TX staging failed; resetting connection")
             self.worker._conn_broken(self, fires)
             return
         if blocked:
@@ -507,15 +679,18 @@ class TcpConn(BaseConn):
         Raises BlockingIOError when nothing is available; returns 0 only on
         TCP EOF (the ring has no EOF -- peer death surfaces on the socket).
         """
+        t0 = time.perf_counter()
         if self.sm_active:
             n = self.sm_rx.read_into(target)
             if n == 0:
                 raise BlockingIOError
             self.last_rx = time.monotonic()
+            perf.record_stage("rx", time.perf_counter() - t0, n)
             return n
         n = self.sock.recv_into(target)
         if n:
             self.last_rx = time.monotonic()
+            perf.record_stage("rx", time.perf_counter() - t0, n)
         return n
 
     def on_readable(self, fires: list) -> None:
@@ -581,6 +756,12 @@ class TcpConn(BaseConn):
                     self.worker._conn_broken(self, fires)
                     return
                 m.received += n
+                if (m.progress is not None and not m.discard
+                        and m.sink is not None):
+                    # Device-sink overlap: fully-arrived chunks start their
+                    # async H2D while the rest of the payload streams in
+                    # (device.py DeviceRecvSink.staged; DESIGN.md §12).
+                    m.progress(m.received)
                 if m.received >= m.length:
                     with lock:
                         fires.extend(matcher.on_message_complete(m))
@@ -603,7 +784,8 @@ class TcpConn(BaseConn):
                     self._ctl = (ftype, body, got, a)
                     continue
                 self._ctl = None
-                info = frames.unpack_json_body(bytes(body))
+                # json.loads reads the bytearray directly: no full-body copy.
+                info = frames.unpack_json_body(body)
                 if ftype == frames.T_HELLO:
                     self.worker._on_hello(self, info, fires)
                 elif ftype == frames.T_DEVPULL:
@@ -727,7 +909,10 @@ class InprocConn(BaseConn):
         self.peer_worker_ref = peer_worker_ref  # weakref.ref
         self.peer_conn: Optional["InprocConn"] = None
 
-    def send_data(self, tag: int, payload, done, fail, owner, fires: list) -> None:
+    def send_data(self, tag: int, payload, done, fail, owner, fires: list,
+                  kick: bool = True) -> None:
+        # ``kick`` is the TcpConn deferred-push knob; in-process delivery
+        # is synchronous, so there is nothing to defer.
         peer = self.peer_worker_ref()
         if not self.alive or peer is None or peer.status != state.RUNNING:
             if fail is not None:

@@ -77,6 +77,11 @@ class FlushRec:
 
 class Worker:
     kind = "worker"
+    # The TX pump understands chunked payload duck types (TxData in
+    # core/conn.py): device.py routes incremental device-to-host staging
+    # through this engine only.  The native engine stages via a flat host
+    # view instead (its ABI takes a raw pointer + length).
+    supports_chunked_tx = True
 
     def __init__(self, name: str = ""):
         self.lock = threading.RLock()
@@ -348,12 +353,25 @@ class Worker:
                     events = self.selector.select(timeout)
                 except OSError:
                     break
-                for key, mask in events:
-                    fires: list = []
-                    key.data(mask, fires)
+                # One fires batch per wakeup: every completion this pass
+                # produces (I/O events, due timers, drained ops) is
+                # delivered in a single sweep after all engine work, so a
+                # burst of N completions crosses into user code -- and
+                # through the api layer's asyncio trampoline -- as one
+                # batch, not N wakeups (mirrors the native engine's
+                # per-epoll-pass FireList).
+                fires: list = []
+                try:
+                    for key, mask in events:
+                        key.data(mask, fires)
+                    self._run_timers(fires)
+                    self._drain_ops(fires)
+                finally:
+                    # Deliver even when a later handler in the sweep
+                    # raises: completions already collected belong to ops
+                    # the matcher/tx queues no longer track, so dropping
+                    # them would hang their futures past emergency close.
                     _run_fires(fires)
-                self._run_timers()
-                self._drain_ops()
             self._do_close()
         except Exception:
             logger.exception("starway: engine thread crashed; emergency close")
@@ -372,19 +390,27 @@ class Worker:
         except (BlockingIOError, OSError):
             pass
 
-    def _drain_ops(self) -> None:
-        while True:
-            with self.lock:
-                if not self.ops or self.status != state.RUNNING:
-                    return
-                op = self.ops.popleft()
-            fires: list = []
-            try:
-                self._process_op(op, fires)
-            finally:
+    def _drain_ops(self, fires: list) -> None:
+        # Sends queue their tx items with the kick deferred, and every
+        # touched conn is kicked ONCE after the whole backlog is queued:
+        # a burst of small sends then leaves in single gathered sendmsg
+        # passes instead of one syscall per op (core/conn.py _gather_tx).
+        pending_kicks: set = set()
+        try:
+            while True:
                 with self.lock:
-                    self._busy -= 1
-            _run_fires(fires)
+                    if not self.ops or self.status != state.RUNNING:
+                        return
+                    op = self.ops.popleft()
+                try:
+                    self._process_op(op, fires, pending_kicks)
+                finally:
+                    with self.lock:
+                        self._busy -= 1
+        finally:
+            for conn in pending_kicks:
+                if conn.alive:
+                    conn.kick_tx(fires)
 
     # ------------------------------------------------------------ deadlines
     def _add_timer(self, delay: float, fn) -> None:
@@ -396,7 +422,7 @@ class Worker:
             )
         self._wake()
 
-    def _run_timers(self) -> None:
+    def _run_timers(self, fires: list) -> None:
         while True:
             with self.lock:
                 if not self._timers or self._timers[0][0] > time.monotonic():
@@ -404,12 +430,10 @@ class Worker:
                 if self.status != state.RUNNING:
                     return
                 _, _, fn = heapq.heappop(self._timers)
-            fires: list = []
             try:
                 fn(fires)
             except Exception:
                 logger.exception("starway: deadline timer raised")
-            _run_fires(fires)
 
     def _expire_recv_ref(self, ref, fires) -> None:
         pr = ref()
@@ -491,14 +515,18 @@ class Worker:
         )
         self._conn_broken(conn, fires)
 
-    def _process_op(self, op, fires) -> None:
+    def _process_op(self, op, fires, pending_kicks=None) -> None:
         if op[0] == "send":
             _, conn, view, tag, done, fail, owner, timeout = op
             if conn is None or not conn.alive:
                 if fail is not None:
                     fires.append(lambda f=fail: f(REASON_NOT_CONNECTED))
                 return
-            item = conn.send_data(tag, view, done, fail, owner, fires)
+            defer = pending_kicks is not None and conn.kind != "inproc"
+            item = conn.send_data(tag, view, done, fail, owner, fires,
+                                  kick=not defer)
+            if defer:
+                pending_kicks.add(conn)
             if timeout is not None and item is not None and not item.local_done:
                 # Weak, like the recv timer: the tx queue is the only
                 # strong owner, so a drained send's payload is not pinned
@@ -514,7 +542,11 @@ class Worker:
                 if fail is not None:
                     fires.append(lambda f=fail: f(REASON_NOT_CONNECTED))
                 return
-            conn.send_devpull(data, done, fail, owner, fires)
+            if pending_kicks is not None and conn.kind != "inproc":
+                conn.send_devpull(data, done, fail, owner, fires, kick=False)
+                pending_kicks.add(conn)
+            else:
+                conn.send_devpull(data, done, fail, owner, fires)
         elif op[0] == "pull_done":
             _, msg, payload, error = op
             with self.lock:
@@ -757,7 +789,7 @@ class ClientWorker(Worker):
 
     def connect_address(self, blob: bytes, cb,
                         timeout: Optional[float] = None) -> None:
-        info = json.loads(bytes(blob).decode())
+        info = frames.unpack_json_body(blob)
         with self.lock:
             if self.status != state.VOID:
                 raise StarwayStateError(
@@ -833,7 +865,7 @@ class ClientWorker(Worker):
             ftype, _, blen = frames.unpack_header(hdr)
             if ftype != frames.T_HELLO_ACK:
                 raise ConnectionError("unexpected frame during handshake")
-            ack = frames.unpack_json_body(bytes(_read_exact(sock, blen)))
+            ack = frames.unpack_json_body(_read_exact(sock, blen))
         except Exception as e:
             if sm_offer is not None:
                 sm_offer.unlink()

@@ -119,8 +119,16 @@ def pack_hello_ack(worker_id: str, extra: dict | None = None) -> bytes:
     return pack_header(T_HELLO_ACK, 0, len(body)) + body
 
 
-def unpack_json_body(body: bytes) -> dict:
-    return json.loads(body.decode())
+def unpack_json_body(body) -> dict:
+    """Parse a JSON control body straight from the accumulation buffer.
+
+    ``json.loads`` reads ``bytes``/``bytearray`` directly, so the callers
+    (core/conn.py's ctl parser, core/engine.py's handshake) pass their
+    buffers as-is with no intermediate full-body ``bytes()`` copy; a
+    ``memoryview`` is materialised here because json cannot read one."""
+    if isinstance(body, memoryview):
+        body = body.tobytes()
+    return json.loads(body)
 
 
 def pack_data_header(tag: int, length: int) -> bytes:

@@ -101,7 +101,7 @@ class InboundMsg:
     """
 
     __slots__ = ("tag", "length", "sink", "received", "posted", "complete",
-                 "discard", "spill", "device_payload", "remote")
+                 "discard", "spill", "device_payload", "remote", "progress")
 
     def __init__(self, tag: int, length: int):
         self.tag = tag
@@ -117,6 +117,12 @@ class InboundMsg:
         # sender's transfer server until pulled.  Duck-typed: the matcher
         # only ever calls ``remote.start(msg)`` via fire thunks.
         self.remote = None
+        # Optional RX progress hook (device sinks streaming directly into
+        # their staging buffer): the conn calls ``progress(received)`` after
+        # each read so placement can overlap the remaining stream
+        # (device.py DeviceRecvSink.staged).  Duck-typed; None for host
+        # targets and spill buffers.
+        self.progress = None
 
 
 def _copy_complete(pr: PostedRecv, payload, length: int) -> None:
@@ -229,7 +235,11 @@ class TagMatcher:
                 pr.claimed = True
                 msg.posted = pr
                 self.posted.remove(pr)
-                msg.sink = pr.buf if _is_host(pr.buf) else pr.buf.host_staging()
+                if _is_host(pr.buf):
+                    msg.sink = pr.buf
+                else:
+                    msg.sink = pr.buf.host_staging()
+                    msg.progress = getattr(pr.buf, "staged", None)
                 return msg, fires
         msg.spill = bytearray(length)
         msg.sink = memoryview(msg.spill)
@@ -400,6 +410,7 @@ class TagMatcher:
                 if msg.posted is pr and not msg.complete:
                     msg.posted = None
                     msg.sink = None  # remaining bytes drain to conn scratch
+                    msg.progress = None
                     self.purge_inflight(msg)
                     break
             else:
@@ -424,6 +435,7 @@ class TagMatcher:
                 pr = msg.posted
                 msg.posted = None
                 msg.sink = None
+                msg.progress = None
                 self.purge_inflight(msg)
                 fires.append(lambda pr=pr, reason=reason: pr.fail(reason))
         return fires

@@ -819,7 +819,9 @@ class NativeClientWorker(NativeWorkerBase):
 
     def connect_address(self, blob: bytes, cb, timeout=None) -> None:
         del timeout
-        info = json.loads(bytes(blob).decode())
+        from . import frames
+
+        info = frames.unpack_json_body(blob)
         self._do_connect(info.get("host", "127.0.0.1"), int(info.get("port", 0)),
                          "address", cb)
 

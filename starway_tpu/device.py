@@ -33,9 +33,17 @@ from __future__ import annotations
 
 import logging
 import sys
+import threading
+import time
 from typing import Optional
 
 logger = logging.getLogger("starway_tpu")
+
+
+def _record_stage(name: str, seconds: float, nbytes: int) -> None:
+    from . import perf
+
+    perf.record_stage(name, seconds, nbytes)
 
 
 def _np_dtype(dtype):
@@ -112,6 +120,113 @@ def _copy_to_device(array, device, plan_cache):
     return jax.device_put(array, device)
 
 
+# ------------------------------------------------------------- fast H2D
+#
+# Receive-side twin of the fast copy above: host placement goes through the
+# PJRT client's buffer_from_pyval entry point, which performs exactly ONE
+# host-to-device copy (force_copy=True: the result never aliases the source,
+# so staging buffers are immediately reusable) and skips jax.device_put's
+# per-call Python dispatch.  Private API -> probed once, device_put fallback.
+
+_fast_h2d_state = None  # None = unprobed, False = unavailable, else semantics
+
+
+def _fast_h2d(np_arr, device):
+    """One-copy H2D of ``np_arr`` onto ``device`` via PJRT, or None when the
+    entry point is unavailable (caller falls back to jax.device_put).
+    ``device`` must be concrete: with no target device the caller's
+    device_put fallback is what honours jax's default-device context.
+
+    IMMUTABLE_ONLY_DURING_CALL is load-bearing: the runtime must finish
+    reading the source buffer *during* the call (a synchronous staging
+    copy), so the caller may recycle a pooled staging buffer the moment
+    this returns.  The laxer default semantics allow the DMA to keep
+    reading the host buffer asynchronously after return, which would
+    corrupt a recycled buffer's previous delivery on real accelerators."""
+    global _fast_h2d_state
+    if _fast_h2d_state is False or device is None:
+        return None
+    if _fast_h2d_state is None:
+        try:
+            from jax._src.lib import xla_client as xc
+
+            _fast_h2d_state = xc.HostBufferSemantics.IMMUTABLE_ONLY_DURING_CALL
+        except Exception:
+            _fast_h2d_state = False
+            return None
+    try:
+        return device.client.buffer_from_pyval(
+            np_arr, device, force_copy=True,
+            host_buffer_semantics=_fast_h2d_state)
+    except (TypeError, AttributeError):
+        # Drift-shaped failure (signature/symbol changed): this entry
+        # point will never work here -- stop retrying for the process.
+        _fast_h2d_state = False
+        logger.warning(
+            "PJRT buffer_from_pyval unusable; falling back to "
+            "jax.device_put for host placement", exc_info=True)
+        return None
+    except Exception:
+        # Anything else (transient allocator pressure, one exotic payload
+        # PJRT rejects): fall back for THIS transfer only; the fast path
+        # stays available.
+        return None
+
+
+# ------------------------------------------------------- staging buffer pool
+#
+# Host staging buffers for streamed (TCP/sm) device payloads are reused
+# across transfers instead of np.empty'd per transfer: first-touch page
+# faults on a fresh multi-MiB buffer cost more than the memcpy it serves.
+# Exact-size buckets (transfer sizes repeat in steady-state workloads),
+# bounded total bytes.  A buffer is recycled ONLY when placement provably
+# copied out of it (_fast_h2d force_copy); the jax.device_put fallback may
+# zero-copy-alias host memory on CPU targets, and an aliased buffer must
+# never be handed to the next transfer.
+
+
+class _StagingPool:
+    def __init__(self, cap_bytes: int = 64 << 20):
+        self._lock = threading.Lock()
+        self._buckets: dict[int, list] = {}
+        self._held = 0
+        self._cap = cap_bytes
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, nbytes: int):
+        import numpy as np
+
+        with self._lock:
+            bucket = self._buckets.get(nbytes)
+            if bucket:
+                self._held -= nbytes
+                self.hits += 1
+                return bucket.pop()
+            self.misses += 1
+        return np.empty(nbytes, dtype=np.uint8)
+
+    def put(self, arr) -> None:
+        n = int(arr.nbytes)
+        with self._lock:
+            if self._held + n > self._cap:
+                return  # dropped: the pool stays bounded
+            self._buckets.setdefault(n, []).append(arr)
+            self._held += n
+
+
+_staging_pool = _StagingPool()
+
+
+def _rx_overlap_ok(device) -> bool:
+    """Chunked receive placement (async H2D per completed chunk + one
+    device-side concatenate) only pays on accelerator targets where the
+    DMA genuinely overlaps the remaining stream reads; on CPU the
+    concatenate costs more than it hides.  Module-level so tests can
+    force the path on the virtual CPU mesh."""
+    return device is not None and getattr(device, "platform", "cpu") != "cpu"
+
+
 _jax_array_type = None
 
 
@@ -174,71 +289,259 @@ class DeviceBuffer:
 
 
 class DevicePayload:
-    """Send-side wrapper: a jax.Array plus a lazily-created host view."""
+    """Send-side wrapper: a jax.Array plus a lazily-created host view.
 
-    __slots__ = ("array", "nbytes", "_host_view")
+    Two staging modes feed the framed stream:
+
+    * ``as_host_view()`` -- one full-payload D2H (the in-process delivery
+      path, and the fallback for engines without chunked TX support).
+    * ``chunked(chunk_bytes)`` + ``host_chunk(pos)`` -- incremental D2H:
+      the TX pump asks for the chunk containing byte ``pos`` and the
+      payload kicks off the async device-to-host copy of the NEXT chunk
+      before returning, so staging chunk k+1 overlaps the transport write
+      of chunk k (DESIGN.md §12).  The duck protocol core/conn.py sees is
+      just ``nbytes`` + ``host_chunk``.
+    """
+
+    __slots__ = ("array", "nbytes", "_host_view", "_flat", "_chunk_elems",
+                 "_chunk_b", "_dev_chunks", "_host_chunks")
 
     def __init__(self, array):
         self.array = array
         self.nbytes = int(array.nbytes)
         self._host_view: Optional[memoryview] = None
+        self._flat = None  # chunked mode state (see chunked())
+        self._chunk_elems = 0
+        self._chunk_b = 0
+        self._dev_chunks: Optional[dict] = None
+        self._host_chunks: Optional[dict] = None
 
     def as_host_view(self) -> memoryview:
         if self._host_view is None:
             import numpy as np
 
+            t0 = time.perf_counter()
             host = np.ascontiguousarray(np.asarray(self.array))
             self._host_view = memoryview(host).cast("B")
+            _record_stage("stage", time.perf_counter() - t0, self.nbytes)
         return self._host_view
+
+    # ------------------------------------------------------- chunked D2H
+    def chunked(self, chunk_bytes: int) -> Optional["DevicePayload"]:
+        """Arm incremental staging, or None when it cannot help (payload
+        smaller than two chunks, pipelining disabled, or the array refuses
+        the flat view).  Arming prefetches chunk 0 so its D2H runs while
+        the message header is still being written."""
+        if chunk_bytes <= 0 or self.nbytes < 2 * chunk_bytes:
+            return None
+        try:
+            flat = self.array.reshape(-1)
+            itemsize = _np_dtype(flat.dtype).itemsize
+            elems = chunk_bytes // itemsize
+            if elems <= 0 or self.nbytes < 2 * elems * itemsize:
+                return None
+            self._flat = flat
+            self._chunk_elems = elems
+            self._chunk_b = elems * itemsize
+            self._dev_chunks = {}
+            self._host_chunks = {}
+            self._prefetch(0)
+        except Exception:
+            logger.debug("chunked staging unavailable for this payload",
+                         exc_info=True)
+            return None
+        return self
+
+    def _prefetch(self, k: int) -> None:
+        """Start the async D2H of chunk ``k`` (device-side slice +
+        copy_to_host_async); no-op past the end or when already started."""
+        if k * self._chunk_b >= self.nbytes or k in self._dev_chunks:
+            return
+        if self._host_chunks is not None and k in self._host_chunks:
+            return
+        sl = self._flat[k * self._chunk_elems:(k + 1) * self._chunk_elems]
+        try:
+            sl.copy_to_host_async()
+        except Exception:
+            pass  # best-effort: np.asarray below still blocks correctly
+        self._dev_chunks[k] = sl
+
+    def host_chunk(self, pos: int) -> tuple[int, memoryview]:
+        """(chunk_start, host_view) for the chunk containing byte ``pos``,
+        prefetching the following chunk before materialising this one."""
+        import numpy as np
+
+        k = pos // self._chunk_b
+        self._prefetch(k)
+        self._prefetch(k + 1)
+        view = self._host_chunks.get(k)
+        if view is None:
+            t0 = time.perf_counter()
+            host = np.ascontiguousarray(np.asarray(self._dev_chunks.pop(k)))
+            view = memoryview(host).cast("B")
+            _record_stage("stage", time.perf_counter() - t0, len(view))
+            self._host_chunks[k] = view
+            # The pump only moves forward: chunk k-1 is fully on the wire.
+            self._host_chunks.pop(k - 1, None)
+        return k * self._chunk_b, view
 
 
 class DeviceRecvSink:
-    """Receive-side adapter bridging the byte matcher to a DeviceBuffer."""
+    """Receive-side adapter bridging the byte matcher to a DeviceBuffer.
 
-    __slots__ = ("devbuf", "_staging", "_staging_view")
+    Streamed (TCP/sm) payloads land in a pooled host staging buffer; on
+    accelerator targets the conn's RX pump reports progress via
+    :meth:`staged` and every completed chunk starts its async H2D while
+    later chunks are still on the wire, with one device-side concatenate
+    at :meth:`finalize_from_host` (DESIGN.md §12)."""
+
+    __slots__ = ("devbuf", "_staging", "_staging_view", "_chunk_elems",
+                 "_chunk_b", "_placed", "_recyclable")
 
     def __init__(self, devbuf: DeviceBuffer):
         self.devbuf = devbuf
         self._staging = None
         self._staging_view: Optional[memoryview] = None
+        self._chunk_elems = 0  # >0 = chunked placement armed
+        self._chunk_b = 0
+        self._placed: Optional[list] = None
+        self._recyclable = True
 
     @property
     def nbytes(self) -> int:
         return self.devbuf.nbytes
 
     def host_staging(self) -> memoryview:
-        """Host bounce buffer for streamed (TCP) payloads."""
+        """Host bounce buffer for streamed (TCP) payloads (pooled)."""
         if self._staging_view is None:
-            import numpy as np
+            from . import config
 
-            self._staging = np.empty(self.nbytes, dtype=np.uint8)
+            self._staging = _staging_pool.get(self.nbytes)
             self._staging_view = memoryview(self._staging).cast("B")
+            chunk = config.chunk_bytes()
+            itemsize = self.devbuf.dtype.itemsize
+            elems = chunk // itemsize if chunk > 0 else 0
+            if (elems > 0 and self.nbytes >= 2 * elems * itemsize
+                    and _rx_overlap_ok(self.devbuf.device)):
+                self._chunk_elems = elems
+                self._chunk_b = elems * itemsize
+                self._placed = []
         return self._staging_view
+
+    def staged(self, received: int) -> None:
+        """RX progress hook (engine thread): start the async H2D of every
+        fully-arrived chunk.  No-op unless chunked placement is armed.
+
+        Chunked placement is purely an overlap optimisation -- the staging
+        buffer receives every byte regardless -- so any failure here (or in
+        the finalize assemble) disarms it and the transfer falls back to
+        one full-buffer placement instead of killing the engine thread."""
+        if not self._chunk_b:
+            return
+        try:
+            while (len(self._placed) + 1) * self._chunk_b <= received:
+                off = len(self._placed) * self._chunk_b
+                self._place_chunk(off, self._chunk_b)
+        except Exception:
+            logger.warning("chunked H2D placement failed; falling back to "
+                           "full-buffer placement", exc_info=True)
+            self._disarm_chunks()
+
+    def _disarm_chunks(self) -> None:
+        self._chunk_elems = self._chunk_b = 0
+        self._placed = None
+
+    def _place_chunk(self, off: int, nbytes: int) -> None:
+        import jax
+
+        t0 = time.perf_counter()
+        arr = self._staging[off:off + nbytes].view(self.devbuf.dtype)
+        placed = _fast_h2d(arr, self.devbuf.device)
+        if placed is None:
+            # Fallback may zero-copy-alias the staging buffer (CPU): the
+            # buffer then belongs to the delivered array, not the pool.
+            self._recyclable = False
+            placed = (jax.device_put(arr, self.devbuf.device)
+                      if self.devbuf.device is not None else jax.device_put(arr))
+        self._placed.append(placed)
+        _record_stage("place", time.perf_counter() - t0, nbytes)
 
     def finalize_from_host(self, length: int) -> None:
         """Staged bytes fully arrived: view as dtype/shape, place on device."""
         import numpy as np
 
-        self._place(np.asarray(self._staging[:length]), length)
+        assembled = False
+        if self._placed:
+            try:
+                self._finalize_chunked(length)
+                assembled = True
+            except Exception:
+                logger.warning("chunked H2D assemble failed; falling back "
+                               "to full-buffer placement", exc_info=True)
+                self._disarm_chunks()
+        if not assembled:
+            self._place(np.asarray(self._staging[:length]), length)
+        if self._recyclable and self._staging is not None:
+            _staging_pool.put(self._staging)
         self._staging = None
         self._staging_view = None
+        self._disarm_chunks()
+        self._recyclable = True
+
+    def _finalize_chunked(self, length: int) -> None:
+        """Assemble the chunk arrays placed mid-stream into the delivered
+        array (one device-side concatenate, pinned to the target device)."""
+        import contextlib
+
+        import jax
+        import jax.numpy as jnp
+
+        done_b = len(self._placed) * self._chunk_b
+        if done_b < length:
+            self._place_chunk(done_b, length - done_b)
+        t0 = time.perf_counter()
+        dev = self.devbuf.device
+        # buffer_from_pyval chunks are uncommitted: pin the assemble to
+        # the target device or jax's default device would claim it.
+        ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
+        with ctx:
+            arr = (jnp.concatenate(self._placed) if len(self._placed) > 1
+                   else self._placed[0])
+            if length == self.nbytes:
+                arr = arr.reshape(self.devbuf.shape)
+        if dev is not None and arr.devices() != {dev}:
+            arr = _copy_to_device(arr, dev, self.devbuf._plan)
+        self.devbuf.array = arr
+        self.devbuf.last_transport = "staged"
+        _record_stage("place", time.perf_counter() - t0, 0)
 
     def accept_host(self, view, length: int) -> None:
         """Complete host bytes already in hand (in-process delivery, or an
-        owned unexpected-queue spill): device_put straight from the source
-        view, eliding the staging memcpy, where that is safe.
+        owned unexpected-queue spill): place straight from the source view,
+        eliding the staging memcpy, where that is safe.
 
-        It is NOT safe on CPU targets: jax zero-copies aligned host numpy
-        buffers onto the CPU device, which would alias the SENDER's buffer
-        — and send completion explicitly licenses the sender to reuse it
-        (pinned by tests/test_device.py::test_host_to_device_inline_
-        snapshots, which fails loudly if a jax release changes either
-        behavior).  Accelerator targets always copy host->HBM, so the
-        elision stands there."""
+        The fast path (_fast_h2d, PJRT buffer_from_pyval with
+        force_copy=True) performs exactly one copy and never aliases the
+        source, so it is safe on every target.  The jax.device_put
+        fallback is NOT safe on CPU targets: jax zero-copies aligned host
+        numpy buffers onto the CPU device, which would alias the SENDER's
+        buffer — and send completion explicitly licenses the sender to
+        reuse it (pinned by tests/test_device.py::test_host_to_device_
+        inline_snapshots, which fails loudly if a jax release changes
+        either behavior).  Accelerator targets always copy host->HBM, so
+        the elision stands there."""
         import numpy as np
         import jax
 
         raw = np.frombuffer(view, dtype=np.uint8, count=length)
+        t0 = time.perf_counter()
+        placed = _fast_h2d(self._as_target(raw, length), self.devbuf.device)
+        if placed is not None:
+            placed.block_until_ready()  # recv-complete = data resident
+            self.devbuf.array = placed
+            self.devbuf.last_transport = "staged"
+            _record_stage("place", time.perf_counter() - t0, length)
+            return
         dev = self.devbuf.device
         platform = dev.platform if dev is not None else jax.local_devices()[0].platform
         if platform == "cpu":
@@ -252,18 +555,28 @@ class DeviceRecvSink:
             self._place(raw, length)
             self.devbuf.array.block_until_ready()
 
-    def _place(self, raw, length: int) -> None:
-        import jax
-
+    def _as_target(self, raw, length: int):
+        """View staged uint8 bytes as the sink's dtype (and shape, when the
+        payload fills the buffer exactly)."""
         arr = raw.view(self.devbuf.dtype)
         if length == self.nbytes:
             arr = arr.reshape(self.devbuf.shape)
-        self.devbuf.array = (
-            jax.device_put(arr, self.devbuf.device)
-            if self.devbuf.device is not None
-            else jax.device_put(arr)
-        )
+        return arr
+
+    def _place(self, raw, length: int) -> None:
+        import jax
+
+        arr = self._as_target(raw, length)
+        t0 = time.perf_counter()
+        placed = _fast_h2d(arr, self.devbuf.device)
+        if placed is None:
+            self._recyclable = False  # fallback may alias `raw` (CPU)
+            placed = (jax.device_put(arr, self.devbuf.device)
+                      if self.devbuf.device is not None
+                      else jax.device_put(arr))
+        self.devbuf.array = placed
         self.devbuf.last_transport = "staged"
+        _record_stage("place", time.perf_counter() - t0, length)
 
     def accept_device(self, array) -> None:
         """Direct device handoff (in-process path): HBM -> HBM over ICI when
@@ -563,6 +876,21 @@ def send_device(worker, conn, buffer, tag, done, fail):
         desc = mgr.offer(payload.array) if mgr is not None else None
         if desc is not None:
             worker.submit_devpull(conn, desc, tag, done, fail, payload)
+            return
+    if (getattr(worker, "supports_chunked_tx", False)
+            and payload.nbytes <= config.rndv_threshold()):
+        # Framed-stream staging pipelines: the TX pump pulls host chunks
+        # incrementally so the D2H of chunk k+1 overlaps the write of
+        # chunk k (core/conn.py TxData; DESIGN.md §12).  Eager payloads
+        # only: an eager send completes when the LAST chunk is staged and
+        # written, so completion still licenses the caller to delete or
+        # donate the array.  A rendezvous send completes at header-on-wire
+        # with lazy staging still reading the array afterwards, which
+        # would silently revoke that license -- rndv payloads keep the
+        # full up-front host snapshot instead.
+        chunked = payload.chunked(config.chunk_bytes())
+        if chunked is not None:
+            worker.submit_send(conn, chunked, tag, done, fail, payload)
             return
     view = payload.as_host_view()
     worker.submit_send(conn, view, tag, done, fail, payload)

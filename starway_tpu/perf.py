@@ -25,6 +25,60 @@ peer's matcher consumes and drops them (core/matching.py, sw_engine.cpp).
 
 from __future__ import annotations
 
+import threading
+
+# ------------------------------------------------------ per-stage telemetry
+#
+# The data plane records wall time + bytes per pipeline stage so a bench
+# regression is attributable to the stage that moved (DESIGN.md §12):
+#
+#   ``stage`` -- device-to-host staging (D2H) on the send side (device.py)
+#   ``tx``    -- transport writes (socket sendmsg / sm ring) (core/conn.py)
+#   ``rx``    -- transport reads (core/conn.py)
+#   ``place`` -- host-to-device placement (H2D) on the receive side
+#
+# Recording is two perf_counter calls + one short lock per transport
+# syscall -- noise next to the syscall itself.  Consumers: bench.py's
+# metric string, the bench CLI's JSON report, and evaluate_perf_detail.
+
+_stage_lock = threading.Lock()
+_stages: dict[str, list] = {}  # name -> [count, seconds, bytes]
+
+
+def record_stage(name: str, seconds: float, nbytes: int = 0) -> None:
+    """Accumulate one sample for pipeline stage ``name`` (thread-safe;
+    called from engine threads and the app thread alike)."""
+    with _stage_lock:
+        acc = _stages.get(name)
+        if acc is None:
+            _stages[name] = [1, seconds, nbytes]
+        else:
+            acc[0] += 1
+            acc[1] += seconds
+            acc[2] += nbytes
+
+
+def stage_snapshot() -> dict:
+    """``{stage: {"count", "seconds", "bytes", "gbps"}}`` accumulated since
+    process start (or the last :func:`stage_reset`)."""
+    with _stage_lock:
+        out = {}
+        for name, (count, seconds, nbytes) in _stages.items():
+            out[name] = {
+                "count": count,
+                "seconds": seconds,
+                "bytes": nbytes,
+                "gbps": (nbytes / seconds / 1e9) if seconds > 0 else 0.0,
+            }
+        return out
+
+
+def stage_reset() -> None:
+    """Drop accumulated stage samples (bench warmup boundary)."""
+    with _stage_lock:
+        _stages.clear()
+
+
 # transport -> (alpha seconds, beta bytes/second)
 LINK_MODELS: dict[str, tuple[float, float]] = {
     "inproc": (2.0e-6, 30.0e9),  # same-process memcpy / HBM-to-HBM handoff
@@ -87,6 +141,10 @@ def estimate_detail(transport: str, msg_size: int) -> dict:
         "transport": key,
         "calibrated": key in CALIBRATED,
         "source": PROVENANCE.get(key, "prior: unknown transport class"),
+        # Live per-stage pipeline timings observed by THIS process
+        # (stage/tx/rx/place -- see record_stage), so a model estimate and
+        # the measured data plane sit side by side.
+        "stages": stage_snapshot(),
     }
 
 
@@ -106,6 +164,7 @@ def conn_estimate_detail(conn, transport: str, msg_size: int) -> dict:
             "calibrated": True,
             "source": "live per-endpoint fit (autocalibrate/"
                       "autocalibrate_ep over PROBE_TAG)",
+            "stages": stage_snapshot(),
         }
     return estimate_detail(transport, msg_size)
 

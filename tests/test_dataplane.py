@@ -1,0 +1,282 @@
+"""Data-plane pipelining tests (DESIGN.md §12).
+
+Covers the PR-3 hot-path work: chunked device staging overlapping the
+framed stream, receive-side placement overlap, the pooled staging buffers,
+the gathered socket TX pump, per-stage telemetry, and -- the pinned
+regression -- batched completion delivery: a burst of N completions crosses
+the engine->asyncio boundary in O(1) ``call_soon_threadsafe`` hops, not N.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from starway_tpu import Client, DeviceBuffer, Server, device, perf
+
+pytestmark = pytest.mark.asyncio
+
+ADDR = "127.0.0.1"
+MASK = (1 << 64) - 1
+
+
+async def _pair(port):
+    server = Server()
+    client = Client()
+    server.listen(ADDR, port)
+    await client.aconnect(ADDR, port)
+    for _ in range(200):
+        if server.list_clients():
+            break
+        await asyncio.sleep(0.005)
+    return server, client, server.list_clients().pop()
+
+
+def _force_tcp(monkeypatch, *, native: bool, chunk: int | None = None):
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if native else "0")
+    monkeypatch.setenv("STARWAY_DEVPULL", "0")  # exercise the framed stream
+    if chunk is not None:
+        monkeypatch.setenv("STARWAY_CHUNK", str(chunk))
+
+
+# ------------------------------------------------- completion batching
+
+
+@pytest.mark.parametrize("engine", ["python", "native"])
+async def test_completion_batch_single_trampoline_hop(port, monkeypatch, engine):
+    """A burst of N engine-thread completions reaches asyncio in O(1)
+    call_soon_threadsafe hops (the api-layer trampoline batches them);
+    pinned for BOTH engines."""
+    if engine == "native":
+        from starway_tpu.core import native
+
+        if not native.available():
+            pytest.skip("native engine unavailable")
+    _force_tcp(monkeypatch, native=(engine == "native"))
+    server, client, _ep = await _pair(port)
+    loop = asyncio.get_running_loop()
+    try:
+        n_ops = 32
+        sinks = [np.empty(256, dtype=np.uint8) for _ in range(n_ops)]
+        recv_futs = [server.arecv(b, 0x900 + i, MASK) for i, b in enumerate(sinks)]
+        await asyncio.sleep(0.1)  # recvs posted on the engine
+
+        hops = {"n": 0}
+        orig = loop.call_soon_threadsafe
+
+        def counting(cb, *args):
+            hops["n"] += 1
+            return orig(cb, *args)
+
+        monkeypatch.setattr(loop, "call_soon_threadsafe", counting)
+        payloads = [np.full(256, i % 251, dtype=np.uint8) for i in range(n_ops)]
+        send_futs = [client.asend(p, 0x900 + i) for i, p in enumerate(payloads)]
+        # Block the loop thread: every send/recv completion (2*n_ops of
+        # them) must pile up behind ONE scheduled drain, not n per op.
+        time.sleep(0.5)
+        await asyncio.gather(*send_futs, *recv_futs)
+        monkeypatch.setattr(loop, "call_soon_threadsafe", orig)
+
+        assert 1 <= hops["n"] <= n_ops // 4, (
+            f"{2 * n_ops} completions took {hops['n']} call_soon_threadsafe "
+            "hops; expected an O(1) batch")
+        for i, b in enumerate(sinks):
+            np.testing.assert_array_equal(b, payloads[i])
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+# ------------------------------------------------- chunked device staging
+
+
+async def test_chunked_send_overlaps_staging(port, monkeypatch):
+    """A device payload on the framed stream stages D2H chunk-by-chunk
+    (DevicePayload.host_chunk) instead of one full-payload np.asarray."""
+    _force_tcp(monkeypatch, native=False, chunk=64 * 1024)
+    calls: list = []
+    orig = device.DevicePayload.host_chunk
+
+    def spy(self, pos):
+        calls.append(pos)
+        return orig(self, pos)
+
+    monkeypatch.setattr(device.DevicePayload, "host_chunk", spy)
+    server, client, _ep = await _pair(port)
+    try:
+        src = jax.device_put(
+            jnp.arange(256 * 1024, dtype=jnp.float32), jax.devices()[0])
+        sink = DeviceBuffer((256 * 1024,), jnp.float32, device=jax.devices()[1])
+        recv_fut = server.arecv(sink, 31, MASK)
+        await asyncio.sleep(0.01)
+        await client.asend(src, 31)
+        tag, length = await recv_fut
+        assert (tag, length) == (31, src.nbytes)
+        np.testing.assert_array_equal(np.asarray(sink.array), np.asarray(src))
+        chunks_touched = {pos // (64 * 1024) for pos in calls}
+        assert len(chunks_touched) >= 2, (
+            f"chunked staging never engaged (host_chunk calls: {calls[:8]})")
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_chunked_send_with_queued_frames_behind(port, monkeypatch):
+    """Frames queued behind a partially-staged chunked send must NOT ride
+    the same gathered sendmsg pass (their bytes would land inside the
+    in-flight DATA payload).  Regression for the _gather_tx over-offer:
+    a chunked payload + a second send + a flush, all queued in one burst,
+    must deliver both payloads intact and complete the flush."""
+    _force_tcp(monkeypatch, native=False, chunk=64 * 1024)
+    server, client, _ep = await _pair(port)
+    try:
+        src = jax.device_put(
+            jnp.arange(256 * 1024, dtype=jnp.float32), jax.devices()[0])
+        tail = np.random.randint(0, 255, 2048, dtype=np.uint8)
+        sink = DeviceBuffer((256 * 1024,), jnp.float32, device=jax.devices()[1])
+        tail_sink = np.empty(2048, dtype=np.uint8)
+        f1 = server.arecv(sink, 61, MASK)
+        f2 = server.arecv(tail_sink, 62, MASK)
+        await asyncio.sleep(0.01)
+        s1 = client.asend(src, 61)
+        s2 = client.asend(tail, 62)
+        fl = client.aflush()
+        await asyncio.gather(s1, s2, fl, f1, f2)
+        np.testing.assert_array_equal(np.asarray(sink.array), np.asarray(src))
+        np.testing.assert_array_equal(tail_sink, tail)
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_chunked_send_over_sm_ring(port, monkeypatch):
+    """The chunked payload protocol also feeds the sm ring TX path
+    (TxData.write payload_slice), not just the socket gather."""
+    monkeypatch.setenv("STARWAY_TLS", "sm,tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+    monkeypatch.setenv("STARWAY_DEVPULL", "0")
+    monkeypatch.setenv("STARWAY_CHUNK", str(64 * 1024))
+    server, client, _ep = await _pair(port)
+    try:
+        src = jnp.arange(128 * 1024, dtype=jnp.float32)  # 512 KiB = 8 chunks
+        sink = DeviceBuffer((128 * 1024,), jnp.float32, device=jax.devices()[2])
+        recv_fut = server.arecv(sink, 33, MASK)
+        await asyncio.sleep(0.01)
+        await client.asend(src, 33)
+        tag, length = await recv_fut
+        assert (tag, length) == (33, src.nbytes)
+        np.testing.assert_array_equal(np.asarray(sink.array), np.asarray(src))
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_chunked_recv_placement_overlap(port, monkeypatch):
+    """With the overlap gate forced open (it is accelerator-only by
+    default), completed chunks start their H2D mid-stream and the
+    finalize concatenates them into the target dtype/shape/device."""
+    _force_tcp(monkeypatch, native=False, chunk=64 * 1024)
+    monkeypatch.setattr(device, "_rx_overlap_ok", lambda dev: dev is not None)
+    placed: list = []
+    orig = device.DeviceRecvSink._place_chunk
+
+    def spy(self, off, nbytes):
+        placed.append((off, nbytes))
+        return orig(self, off, nbytes)
+
+    monkeypatch.setattr(device.DeviceRecvSink, "_place_chunk", spy)
+    server, client, _ep = await _pair(port)
+    try:
+        src = np.random.randint(0, 255, 512 * 1024, dtype=np.uint8)
+        sink = DeviceBuffer((128 * 1024,), jnp.float32, device=jax.devices()[3])
+        assert sink.nbytes == src.nbytes
+        recv_fut = server.arecv(sink, 35, MASK)
+        await asyncio.sleep(0.01)
+        await client.asend(src, 35)
+        tag, length = await recv_fut
+        assert (tag, length) == (35, src.nbytes)
+        assert len(placed) >= 2, "chunked placement never engaged"
+        assert sink.array.devices() == {jax.devices()[3]}
+        assert sink.last_transport == "staged"
+        np.testing.assert_array_equal(
+            np.asarray(sink.array), src.view(np.float32).reshape(128 * 1024))
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+# ------------------------------------------------------- staging pool
+
+
+async def test_staging_pool_recycles_buffers(port, monkeypatch):
+    """The second streamed receive of a size reuses the first's staging
+    buffer instead of allocating (pool hit), because fast-path placement
+    provably copied out of it."""
+    _force_tcp(monkeypatch, native=False)
+    server, client, _ep = await _pair(port)
+    try:
+        nbytes = 96 * 1024 + 512  # unlikely to collide with other suites
+        src = np.random.randint(0, 255, nbytes, dtype=np.uint8)
+        hits0 = device._staging_pool.hits
+        for i in range(2):
+            sink = DeviceBuffer((nbytes,), jnp.uint8, device=jax.devices()[0])
+            recv_fut = server.arecv(sink, 40 + i, MASK)
+            await asyncio.sleep(0.01)
+            await client.asend(src, 40 + i)
+            await recv_fut
+            np.testing.assert_array_equal(np.asarray(sink.array), src)
+        assert device._staging_pool.hits > hits0, (
+            "second transfer did not reuse the pooled staging buffer")
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+# ------------------------------------------- gathered TX + telemetry
+
+
+async def test_small_send_burst_gathered_in_order(port, monkeypatch):
+    """A burst of small sends coalesces through the gathered sendmsg pump
+    and still delivers every payload, in tag order, with per-stage tx/rx
+    telemetry recorded."""
+    _force_tcp(monkeypatch, native=False)
+    server, client, _ep = await _pair(port)
+    try:
+        perf.stage_reset()
+        n_msgs = 64
+        sinks = [np.empty(128, dtype=np.uint8) for _ in range(n_msgs)]
+        recv_futs = [server.arecv(b, 0x700 + i, MASK) for i, b in enumerate(sinks)]
+        await asyncio.sleep(0.05)
+        payloads = [np.full(128, (i * 7) % 251, dtype=np.uint8) for i in range(n_msgs)]
+        await asyncio.gather(
+            *(client.asend(p, 0x700 + i) for i, p in enumerate(payloads)))
+        await asyncio.gather(*recv_futs)
+        await client.aflush()
+        for i, b in enumerate(sinks):
+            np.testing.assert_array_equal(b, payloads[i])
+        snap = perf.stage_snapshot()
+        assert snap.get("tx", {}).get("count", 0) > 0, snap
+        assert snap.get("rx", {}).get("count", 0) > 0, snap
+        # The gather batches the burst: far fewer sendmsg passes than
+        # messages (each message is 145 bytes; one pass takes many).
+        assert snap["tx"]["count"] < n_msgs, snap["tx"]
+        assert snap["tx"]["bytes"] >= n_msgs * 128
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_evaluate_perf_detail_reports_stages(port, monkeypatch):
+    _force_tcp(monkeypatch, native=False)
+    server, client, _ep = await _pair(port)
+    try:
+        detail = client.evaluate_perf_detail(1 << 20)
+        assert "stages" in detail and isinstance(detail["stages"], dict)
+    finally:
+        await client.aclose()
+        await server.aclose()

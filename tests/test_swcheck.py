@@ -304,6 +304,45 @@ def test_parametrized_multi_gib_payload_is_caught(tmp_path):
     _assert_caught(root, "marker-slow", "test_param_big", "test_seeded_param.py")
 
 
+# ----------------------------------------------------------- hotpath pass
+
+
+def test_hotpath_copy_seeded(tmp_path):
+    root = _seed(tmp_path)
+    (root / "starway_tpu" / "core" / "_seeded_copy.py").write_text(
+        "def leak(view, arr):\n"
+        "    a = bytes(view)\n"
+        "    b = arr.tobytes()\n"
+        "    c = bytes([1, 2])\n"
+        "    d = bytes(16)\n"
+        "    return a, b, c, d\n"
+    )
+    hits = _findings(root, "hotpath-copy")
+    # Only the buffer copies fire; bytes([..]) / bytes(16) are allocation.
+    assert {f.line for f in hits} == {2, 3}, hits
+    _assert_caught(root, "hotpath-copy", "bytes(...)", "_seeded_copy.py")
+    _assert_caught(root, "hotpath-copy", ".tobytes()", "_seeded_copy.py")
+
+
+def test_hotpath_copy_waiver(tmp_path):
+    root = _seed(tmp_path)
+    (root / "starway_tpu" / "core" / "_seeded_copy.py").write_text(
+        "def ok(view):\n"
+        f"    return bytes(view)  {_SWA}(hotpath-copy): control-sized blob\n"
+    )
+    assert _findings(root, "hotpath-copy") == []
+    assert _findings(root, "bad-waiver") == []
+
+
+def test_hotpath_skips_frames_codec(tmp_path):
+    # frames.py is the control-frame codec: its small bounded JSON bodies
+    # are exempt by design (documented in analysis/hotpath.py).
+    root = _seed(tmp_path)
+    p = root / "starway_tpu" / "core" / "frames.py"
+    p.write_text(p.read_text() + "\ndef _seeded(v):\n    return bytes(v)\n")
+    assert _findings(root, "hotpath-copy") == []
+
+
 # ------------------------------------------------------------- CLI surface
 
 
