@@ -130,11 +130,11 @@ async def _pingpong(devices) -> tuple[list[float], list[float]]:
 
 
 def _pct(sorted_vals: list, q: float) -> float:
-    """Nearest-rank percentile of an ascending list (stdlib-only)."""
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, max(0, round(q / 100 * (len(sorted_vals) - 1))))
-    return sorted_vals[idx]
+    """Nearest-rank percentile of an ascending list (perf.percentile --
+    the shared implementation the bench CLI's p-tiles also use)."""
+    from starway_tpu.perf import percentile
+
+    return percentile(sorted_vals, q)
 
 
 def _stage_summary() -> str:

@@ -92,6 +92,116 @@ const char* kTimedOut = "Operation timed out (deadline exceeded before completio
 
 using Clock = std::chrono::steady_clock;
 
+// --------------------------------------------------- swtrace (observability)
+//
+// Counter registry + per-op trace ring (DESIGN.md §13), the C++ twin of
+// starway_tpu/core/swtrace.py.  The event-type literals and the counter
+// vocabulary are cross-engine contract surface: `python -m
+// starway_tpu.analysis` (rule contract-trace) diffs them against the
+// Python EV_* constants and COUNTER_NAMES tuple -- keep the two in
+// lockstep when adding either.
+
+const char* kEvSendPost = "send_post";
+const char* kEvSendDone = "send_done";
+const char* kEvRecvPost = "recv_post";
+const char* kEvRecvMatch = "recv_match";
+const char* kEvRecvDone = "recv_done";
+const char* kEvFlushPost = "flush_post";
+const char* kEvFlushDone = "flush_done";
+const char* kEvOpFail = "op_fail";
+const char* kEvConnUp = "conn_up";
+const char* kEvConnDown = "conn_down";
+[[maybe_unused]] const char* kEvStage = "stage_span";  // recorded by the
+//               Python data plane only; declared for vocabulary parity
+
+// Counter vocabulary, same order as the Counters fields and the values
+// array in sw_counters() below (and as core/swtrace.py COUNTER_NAMES).
+// staging_* / reconnects live in the Python wrapper (process-global
+// staging pool / api-layer reconnect loop) and stay 0 here; the wrapper
+// overlays them at snapshot time.
+const char* kCounterNames[] = {
+    "sends_posted",      "sends_completed",
+    "recvs_posted",      "recvs_completed",
+    "flushes_posted",    "flushes_completed",
+    "ops_timed_out",     "ops_cancelled",
+    "bytes_tx",          "bytes_rx",
+    "gather_passes",     "gather_items",
+    "staging_hits",      "staging_misses",
+    "ka_misses",         "reconnects",
+};
+
+struct Counters {
+  std::atomic<uint64_t> sends_posted{0}, sends_completed{0};
+  std::atomic<uint64_t> recvs_posted{0}, recvs_completed{0};
+  std::atomic<uint64_t> flushes_posted{0}, flushes_completed{0};
+  std::atomic<uint64_t> ops_timed_out{0}, ops_cancelled{0};
+  std::atomic<uint64_t> bytes_tx{0}, bytes_rx{0};
+  std::atomic<uint64_t> gather_passes{0}, gather_items{0};
+  std::atomic<uint64_t> staging_hits{0}, staging_misses{0};  // wrapper-owned
+  std::atomic<uint64_t> ka_misses{0}, reconnects{0};         // reconnects: wrapper
+};
+
+inline void bump(std::atomic<uint64_t>& c, uint64_t n = 1) {
+  c.fetch_add(n, std::memory_order_relaxed);
+}
+
+struct TraceEvent {
+  double t = 0.0;
+  const char* ev = nullptr;  // one of the kEv* literals (static storage)
+  uint64_t tag = 0, conn = 0, nbytes = 0;
+  char reason[48] = {0};
+};
+
+// Bounded lock-free per-worker event ring: writers bump an atomic index
+// and fill their slot; no lock is ever taken, so recording is legal from
+// any context, including under the matcher's mutex (it is a data write,
+// not a callback -- the FireList discipline concerns user code).  A slot
+// being overwritten while sw_trace reads it may render garbled; the dump
+// is post-mortem/bench tooling and tolerates that.
+struct TraceRing {
+  bool enabled = false;
+  uint64_t cap = 0;
+  std::vector<TraceEvent> buf;
+  std::atomic<uint64_t> widx{0};
+
+  // Armed per worker at creation: STARWAY_TRACE on, or a flight-recorder
+  // directory configured (core/swtrace.py active() is the Python twin).
+  void init() {
+    const char* t = getenv("STARWAY_TRACE");
+    const char* f = getenv("STARWAY_FLIGHT_DIR");
+    enabled = (t && *t && strcmp(t, "0") != 0) || (f && *f);
+    if (!enabled) return;
+    const char* rs = getenv("STARWAY_TRACE_RING");
+    uint64_t c = rs ? strtoull(rs, nullptr, 10) : 4096;
+    if (c < 16) c = 16;
+    if (c > (1u << 20)) c = 1u << 20;
+    cap = c;
+    buf.resize((size_t)c);
+  }
+
+  void rec(const char* ev, uint64_t tag = 0, uint64_t conn = 0,
+           uint64_t nbytes = 0, const char* reason = nullptr) {
+    if (!enabled) return;
+    uint64_t i = widx.fetch_add(1, std::memory_order_relaxed);
+    TraceEvent& e = buf[(size_t)(i % cap)];
+    e.t = std::chrono::duration<double>(Clock::now().time_since_epoch()).count();
+    e.tag = tag;
+    e.conn = conn;
+    e.nbytes = nbytes;
+    if (reason) {
+      size_t j = 0;
+      for (; reason[j] && j < sizeof(e.reason) - 1; j++) {
+        char c = reason[j];
+        e.reason[j] = (c < 0x20 || c == '"' || c == '\\') ? ' ' : c;
+      }
+      e.reason[j] = 0;
+    } else {
+      e.reason[0] = 0;
+    }
+    e.ev = ev;  // written last: a nonnull ev marks the slot renderable
+  }
+};
+
 uint64_t rndv_threshold() {
   static uint64_t v = [] {
     const char* e = getenv("STARWAY_RNDV_THRESHOLD");
@@ -383,6 +493,15 @@ struct Matcher {
   std::deque<PostedRecv> posted;
   std::deque<InboundMsg*> unexpected;
   std::unordered_set<InboundMsg*> inflight;
+  // swtrace observability: set once by the owning Worker before the engine
+  // starts.  Ring appends are lock-free data writes -- legal under mu.
+  TraceRing* ring = nullptr;
+  Counters* ctr = nullptr;
+
+  void rec(const char* ev, uint64_t tag, uint64_t nbytes,
+           const char* reason = nullptr) {
+    if (ring) ring->rec(ev, tag, 0, nbytes, reason);
+  }
   // devpull claim outcome of a post_recv: reported to the caller (sw_recv
   // marshals it through the engine op queue so a claim can never be
   // observed by the embedder before the descriptor that created the
@@ -413,17 +532,22 @@ struct Matcher {
             claim->rctx = trunc ? 0 : (uint64_t)(uintptr_t)pr_in.ctx;
             claim->flags = trunc ? 1 : 0;
           }
+          uint64_t mtag = m->tag, mlen = m->length;
           unexpected.erase(it);
           delete m;
           if (trunc) {
+            rec(kEvOpFail, pr_in.tag, 0, kTruncated);
             auto fail = pr_in.fail; auto ctx = pr_in.ctx;
             fires.push_back([fail, ctx] { fail(ctx, kTruncated); });
+          } else {
+            rec(kEvRecvMatch, mtag, mlen);
           }
           return;
         }
         if (m->length > pr_in.cap) {
           unexpected.erase(it);
           if (!m->complete) { m->discard = true; } else { delete m; }
+          rec(kEvOpFail, pr_in.tag, 0, kTruncated);
           auto fail = pr_in.fail; auto ctx = pr_in.ctx;
           fires.push_back([fail, ctx] { fail(ctx, kTruncated); });
           return;
@@ -433,6 +557,9 @@ struct Matcher {
           uint64_t t = m->tag, n = m->length;
           unexpected.erase(it);
           delete m;
+          rec(kEvRecvMatch, t, n);
+          rec(kEvRecvDone, t, n);
+          if (ctr) bump(ctr->recvs_completed);
           auto done = pr_in.done; auto ctx = pr_in.ctx;
           fires.push_back([done, ctx, t, n] { done(ctx, t, n); });
           return;
@@ -440,6 +567,7 @@ struct Matcher {
         m->pr = pr_in;
         m->pr.claimed = true;
         m->has_pr = true;  // copied from spill at completion
+        rec(kEvRecvMatch, m->tag, m->length);
         return;
       }
     }
@@ -461,6 +589,8 @@ struct Matcher {
       if (it->claimed || !tags_match(tag, it->tag, it->mask)) continue;
       *out_ctx = (uint64_t)(uintptr_t)it->ctx;
       int rc = nbytes > it->cap ? -1 : 1;
+      if (rc == 1) rec(kEvRecvMatch, tag, nbytes);
+      else rec(kEvOpFail, tag, nbytes, kTruncated);
       posted.erase(it);
       return rc;
     }
@@ -513,6 +643,7 @@ struct Matcher {
         if (length > it->cap) {
           auto fail = it->fail; auto ctx = it->ctx;
           posted.erase(it);
+          rec(kEvOpFail, tag, length, kTruncated);
           fires.push_back([fail, ctx] { fail(ctx, kTruncated); });
           m->discard = true;
           return m;
@@ -521,6 +652,7 @@ struct Matcher {
         m->pr.claimed = true;
         m->has_pr = true;
         posted.erase(it);
+        rec(kEvRecvMatch, tag, length);
         return m;  // streams straight into pr.buf
       }
     }
@@ -545,6 +677,8 @@ struct Matcher {
       }
       auto done = m->pr.done; auto ctx = m->pr.ctx;
       uint64_t t = m->tag, n = m->length;
+      rec(kEvRecvDone, t, n);
+      if (ctr) bump(ctr->recvs_completed);
       fires.push_back([done, ctx, t, n] { done(ctx, t, n); });
       delete m;
       return;
@@ -562,6 +696,8 @@ struct Matcher {
     for (auto it = posted.begin(); it != posted.end(); ++it) {
       if (it->ctx == ctx) {
         auto fail = it->fail; auto c = it->ctx;
+        rec(kEvOpFail, it->tag, 0, kTimedOut);
+        if (ctr) bump(ctr->ops_timed_out);
         posted.erase(it);
         fires.push_back([fail, c] { fail(c, kTimedOut); });
         return true;
@@ -570,6 +706,8 @@ struct Matcher {
     for (auto* m : inflight) {
       if (m->has_pr && m->pr.ctx == ctx && !m->complete) {
         auto fail = m->pr.fail; auto c = m->pr.ctx;
+        rec(kEvOpFail, m->tag, m->length, kTimedOut);
+        if (ctr) bump(ctr->ops_timed_out);
         detach_claimed(m);
         fires.push_back([fail, c] { fail(c, kTimedOut); });
         return true;
@@ -584,12 +722,14 @@ struct Matcher {
   void fail_pending(const std::string& reason, FireList& fires) {
     for (auto& pr : posted) {
       auto fail = pr.fail; auto ctx = pr.ctx;
+      rec(kEvOpFail, pr.tag, 0, reason.c_str());
       fires.push_back([fail, ctx, reason] { fail(ctx, reason.c_str()); });
     }
     posted.clear();
     for (auto* m : std::vector<InboundMsg*>(inflight.begin(), inflight.end())) {
       if (m->has_pr && !m->complete) {
         auto fail = m->pr.fail; auto ctx = m->pr.ctx;
+        rec(kEvOpFail, m->tag, m->length, reason.c_str());
         detach_claimed(m);
         fires.push_back([fail, ctx, reason] { fail(ctx, reason.c_str()); });
       }
@@ -625,12 +765,16 @@ struct Matcher {
   void cancel_all(FireList& fires) {
     for (auto& pr : posted) {
       auto fail = pr.fail; auto ctx = pr.ctx;
+      rec(kEvOpFail, pr.tag, 0, kCancelled);
+      if (ctr) bump(ctr->ops_cancelled);
       fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
     }
     posted.clear();
     for (auto* m : inflight) {
       if (m->has_pr && !m->complete) {
         auto fail = m->pr.fail; auto ctx = m->pr.ctx;
+        rec(kEvOpFail, m->tag, m->length, kCancelled);
+        if (ctr) bump(ctr->ops_cancelled);
         fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
       }
       if (!m->use_spill) delete m;  // spill-owned records freed below
@@ -800,6 +944,10 @@ struct Worker {
   std::mutex mu;
   std::atomic<int> status{ST_VOID};
   std::atomic<int> refs{1};  // python handle; engine thread takes one more
+  // swtrace observability (DESIGN.md §13): counters always live (relaxed
+  // atomics); the trace ring armed per worker at creation (env knobs).
+  Counters counters;
+  TraceRing trace;
   int epfd = -1, evfd = -1;
   std::thread::id engine_tid{};
   std::string worker_id;
@@ -880,6 +1028,8 @@ struct Worker {
   void conn_send_data(Conn* c, const Op& op, FireList& fires) {
     if (!c->alive) {
       auto fail = op.fail; auto ctx = op.ctx;
+      trace.rec(kEvOpFail, op.tag, c->id, op.len,
+                "Endpoint is not connected (connection reset)");
       if (fail) fires.push_back([fail, ctx] { fail(ctx, "Endpoint is not connected (connection reset)"); });
       fire_op_release(op, fires);
       return;
@@ -918,6 +1068,8 @@ struct Worker {
   void conn_send_devpull(Conn* c, const Op& op, FireList& fires) {
     if (!c->alive) {
       auto fail = op.fail; auto ctx = op.ctx;
+      trace.rec(kEvOpFail, op.tag, c->id, op.len,
+                "Endpoint is not connected (connection reset)");
       if (fail) fires.push_back([fail, ctx] { fail(ctx, "Endpoint is not connected (connection reset)"); });
       return;
     }
@@ -981,7 +1133,9 @@ struct Worker {
     if (c->tx_via_ring) {
       // 0 = ring full; kick_tx signals the peer with a starving doorbell
       // and its reply (after draining) re-enters kick_tx.
-      return (ssize_t)c->sm_tx.write(p, n);
+      ssize_t w = (ssize_t)c->sm_tx.write(p, n);
+      if (w > 0) bump(counters.bytes_tx, (uint64_t)w);
+      return w;
     }
     ssize_t w = ::send(c->fd, p, n, MSG_NOSIGNAL);
     if (w < 0) {
@@ -989,6 +1143,7 @@ struct Worker {
       conn_broken(c, fires);
       return -1;
     }
+    if (w > 0) bump(counters.bytes_tx, (uint64_t)w);
     return w;
   }
 
@@ -1076,7 +1231,24 @@ struct Worker {
       conn_broken(c, fires);
       return -1;
     }
+    if (w > 0) {
+      bump(counters.bytes_tx, (uint64_t)w);
+      bump(counters.gather_passes);
+      bump(counters.gather_items, (uint64_t)niov);
+    }
     return w;
+  }
+
+  // A tagged (is_data) TxItem fully handed to the transport: account it
+  // and record its send_done event (tag lives in the packed header).
+  void tx_item_completed(Conn* c, const TxItem& item) {
+    if (!item.is_data) return;
+    bump(counters.sends_completed);
+    if (trace.enabled && item.header.size() >= HEADER_SIZE) {
+      uint64_t tag = 0;
+      memcpy(&tag, item.header.data() + 1, 8);
+      trace.rec(kEvSendDone, tag, c->id, item.paylen);
+    }
   }
 
   void kick_tx(Conn* c, FireList& fires) {
@@ -1117,6 +1289,7 @@ struct Worker {
               }
             }
             bool flip = item.switch_after;
+            tx_item_completed(c, item);
             fire_release(item, fires);
             c->tx.pop_front();
             if (flip) {
@@ -1166,6 +1339,7 @@ struct Worker {
             fires.push_back([done, ctx] { done(ctx); });
           }
         }
+        tx_item_completed(c, item);
         fire_release(item, fires);
         c->tx.pop_front();
       }
@@ -1207,12 +1381,16 @@ struct Worker {
   ssize_t stream_read(Conn* c, uint8_t* dst, size_t want, FireList& fires) {
     if (c->sm_active) {
       size_t n = c->sm_rx.read_into(dst, want);
-      if (n > 0) c->last_rx = Clock::now();
+      if (n > 0) {
+        c->last_rx = Clock::now();
+        bump(counters.bytes_rx, (uint64_t)n);
+      }
       return (ssize_t)n;
     }
     ssize_t r = ::recv(c->fd, dst, want, 0);
     if (r > 0) {
       c->last_rx = Clock::now();
+      bump(counters.bytes_rx, (uint64_t)r);
       return r;
     }
     if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return 0;
@@ -1379,6 +1557,8 @@ struct Worker {
     for (Conn* c : candidates) {
       if (!c->alive && c->dirty) {
         auto fail = op.fail; auto ctx = op.ctx;
+        trace.rec(kEvOpFail, 0, c->id, 0,
+                  "Endpoint is not connected (peer reset before flush)");
         if (fail) fires.push_back([fail, ctx] { fail(ctx, "Endpoint is not connected (peer reset before flush)"); });
         return;
       }
@@ -1424,12 +1604,15 @@ struct Worker {
     if (dead) {
       rec->completed = true;
       remove_flush(rec);
+      trace.rec(kEvOpFail, 0, 0, 0, "Endpoint is not connected (peer reset during flush)");
       auto fail = rec->fail; auto ctx = rec->ctx;
       if (fail) fires.push_back([fail, ctx] { fail(ctx, "Endpoint is not connected (peer reset during flush)"); });
       delete rec;
     } else if (!pending) {
       rec->completed = true;
       remove_flush(rec);
+      bump(counters.flushes_completed);
+      trace.rec(kEvFlushDone);
       auto done = rec->done; auto ctx = rec->ctx;
       if (done) fires.push_back([done, ctx] { done(ctx); });
       delete rec;
@@ -1468,9 +1651,11 @@ struct Worker {
     }
     c->alive = false;
     ep_del(c->fd);
+    trace.rec(kEvConnDown, 0, c->id);
     for (auto& item : c->tx) {
       if (item.is_data && !item.local_done && item.fail) {
         auto fail = item.fail; auto ctx = item.ctx;
+        bump(counters.ops_cancelled);
         fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
       }
       fire_release(item, fires);
@@ -1517,6 +1702,7 @@ struct Worker {
     for (auto& item : c->tx) {
       if (item.is_data && !item.local_done && item.fail) {
         auto fail = item.fail; auto ctx = item.ctx;
+        bump(counters.ops_cancelled);
         fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
       }
       fire_release(item, fires);
@@ -1580,6 +1766,7 @@ struct Worker {
     // The ACK is the transport switch point (see TxItem::switch_after).
     conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires,
                   /*switch_after=*/seg != nullptr);
+    trace.rec(kEvConnUp, 0, c->id);
     if (accept_cb) {
       auto cb = accept_cb; auto ctx = accept_ctx; uint64_t id = c->id;
       fires.push_back([cb, ctx, id] { cb(ctx, id); });
@@ -1656,6 +1843,8 @@ struct Worker {
         if ((t.kind == Timer::SEND && send_like) ||
             (t.kind == Timer::FLUSH && it->kind == Op::FLUSH)) {
           auto fail = it->fail; auto ctx = it->ctx;
+          bump(counters.ops_timed_out);
+          trace.rec(kEvOpFail, it->tag, it->conn_id, it->len, kTimedOut);
           if (fail) fires.push_back([fail, ctx] { fail(ctx, kTimedOut); });
           fire_op_release(*it, fires);
           ops.erase(it);
@@ -1669,6 +1858,8 @@ struct Worker {
         if (rec->ctx != t.ctx || rec->completed) continue;
         rec->completed = true;
         remove_flush(rec);
+        bump(counters.ops_timed_out);
+        trace.rec(kEvOpFail, 0, 0, 0, kTimedOut);
         auto fail = rec->fail; auto ctx = rec->ctx;
         if (fail) fires.push_back([fail, ctx] { fail(ctx, kTimedOut); });
         delete rec;
@@ -1689,6 +1880,10 @@ struct Worker {
       for (auto it = c->tx.begin(); it != c->tx.end(); ++it) {
         if (!it->is_data || it->ctx != t.ctx || it->local_done) continue;
         auto fail = it->fail; auto ctx = it->ctx;
+        bump(counters.ops_timed_out);
+        uint64_t tg = 0;
+        if (it->header.size() >= HEADER_SIZE) memcpy(&tg, it->header.data() + 1, 8);
+        trace.rec(kEvOpFail, tg, c->id, it->paylen, kTimedOut);
         if (it->off == 0) {
           it->local_done = true;
           if (fail) fires.push_back([fail, ctx] { fail(ctx, kTimedOut); });
@@ -1731,6 +1926,7 @@ struct Worker {
   // the Python twin).
   void conn_expired(Conn* c, FireList& fires) {
     SW_DEBUG("peer %s liveness expired", c->peer_name.c_str());
+    bump(counters.ka_misses);
     conn_broken(c, fires);
   }
 
@@ -1780,6 +1976,7 @@ struct Worker {
           if (c) devpull_resolve(c, op.msg_id, fires);
         } else if (!c || !c->alive) {
           auto fail = op.fail; auto ctx = op.ctx;
+          trace.rec(kEvOpFail, op.tag, op.conn_id, op.len, kNotConnected);
           if (fail) fires.push_back([fail, ctx] { fail(ctx, kNotConnected); });
           fire_op_release(op, fires);
         } else if (op.kind == Op::SEND_DEVPULL) {
@@ -1807,7 +2004,10 @@ struct Worker {
           fires.push_back([cb, cctx, rid, rctx, flags] { cb(cctx, rid, rctx, flags); });
         }
         auto fail = op.fail; auto ctx = op.ctx;
-        if (fail) fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
+        if (fail) {
+          bump(counters.ops_cancelled);
+          fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
+        }
         fire_op_release(op, fires);
         ops.pop_front();
       }
@@ -1816,6 +2016,7 @@ struct Worker {
     for (auto* rec : flushes) {
       if (!rec->completed && rec->fail) {
         auto fail = rec->fail; auto ctx = rec->ctx;
+        bump(counters.ops_cancelled);
         fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
       }
       delete rec;
@@ -2077,6 +2278,7 @@ struct ClientWorker : Worker {
       primary_conn = c->id;
     }
     ep_add(fd, EPOLLIN, c);
+    trace.rec(kEvConnUp, 0, c->id);
     int expect = ST_INIT;
     status.compare_exchange_strong(expect, ST_RUNNING);
     if (c_status_cb) {
@@ -2105,8 +2307,9 @@ int worker_start(Worker* w) {
 
 extern "C" {
 
-// 2: sm transport; 3: op deadlines + PING/PONG peer liveness
-const char* sw_version() { return "starway-native-3"; }
+// 2: sm transport; 3: op deadlines + PING/PONG peer liveness;
+// 4: swtrace observability (sw_counters/sw_trace)
+const char* sw_version() { return "starway-native-4"; }
 
 // Portable cursor atomics for the Python engine's sm ring (sw_engine.h).
 // std::atomic_ref would be C++20-tidy but libstdc++'s needs alignment UB
@@ -2125,6 +2328,9 @@ void sw_atomic_store_u64(void* p, uint64_t v) {
 void* sw_client_new(const char* worker_id) {
   auto* w = new ClientWorker();
   w->worker_id = worker_id ? worker_id : "";
+  w->trace.init();
+  w->matcher.ring = &w->trace;
+  w->matcher.ctr = &w->counters;
   return w;
 }
 
@@ -2146,6 +2352,9 @@ int sw_client_connect(void* h, const char* host, int port, const char* mode,
 void* sw_server_new(const char* worker_id) {
   auto* w = new ServerWorker();
   w->worker_id = worker_id ? worker_id : "";
+  w->trace.init();
+  w->matcher.ring = &w->trace;
+  w->matcher.ctr = &w->counters;
   return w;
 }
 
@@ -2219,6 +2428,11 @@ int sw_send(void* h, uint64_t conn_id, const void* buf, uint64_t len, uint64_t t
     op.release = release;
     op.release_ctx = release_ctx;
     w->ops.push_back(op);
+    // Recorded under mu, like sw_recv: once the lock drops the engine
+    // thread may complete the op, and its DONE event must not precede
+    // this POST in the ring.
+    bump(w->counters.sends_posted);
+    w->trace.rec(kEvSendPost, tag, conn_id, len);
   }
   if (timeout_s > 0) w->add_timer(Timer::SEND, ctx, timeout_s);
   w->wake();
@@ -2285,6 +2499,8 @@ int sw_send_devpull(void* h, uint64_t conn_id, uint64_t tag,
     op.fail = fail;
     op.ctx = ctx;
     w->ops.push_back(op);
+    bump(w->counters.sends_posted);  // under mu: POST must precede DONE
+    w->trace.rec(kEvSendPost, tag, conn_id, len);
   }
   w->wake();
   return 0;
@@ -2297,6 +2513,10 @@ int sw_recv(void* h, void* buf, uint64_t cap, uint64_t tag, uint64_t mask,
   {
     std::lock_guard<std::mutex> g(w->mu);
     if (w->status.load() != ST_RUNNING) return -1;
+    // Posted before the matcher runs so the ring shows post -> match in
+    // program order (bump/rec are lock-free; legal under mu).
+    bump(w->counters.recvs_posted);
+    w->trace.rec(kEvRecvPost, tag, 0, cap);
     PostedRecv pr;
     pr.buf = (uint8_t*)buf;
     pr.cap = cap;
@@ -2345,6 +2565,8 @@ int sw_flush(void* h, uint64_t conn_id, int conn_scoped,
     op.fail = fail;
     op.ctx = ctx;
     w->ops.push_back(op);
+    bump(w->counters.flushes_posted);  // under mu: POST must precede DONE
+    w->trace.rec(kEvFlushPost, 0, conn_id);
   }
   if (timeout_s > 0) w->add_timer(Timer::FLUSH, ctx, timeout_s);
   w->wake();
@@ -2401,6 +2623,70 @@ int sw_conn_info(void* h, uint64_t conn_id, char* out, int cap) {
   if (n < 0 || n >= cap) return -1;
   memcpy(out, buf, (size_t)n + 1);
   return n;
+}
+
+// Counter snapshot over the shared vocabulary as a JSON object
+// (sw_engine.h).  Thread-safe: relaxed loads of the atomic registry.
+int sw_counters(void* h, char* out, int cap) {
+  Worker* w = W(h);
+  Counters& c = w->counters;
+  const uint64_t vals[] = {
+      c.sends_posted.load(),   c.sends_completed.load(),
+      c.recvs_posted.load(),   c.recvs_completed.load(),
+      c.flushes_posted.load(), c.flushes_completed.load(),
+      c.ops_timed_out.load(),  c.ops_cancelled.load(),
+      c.bytes_tx.load(),       c.bytes_rx.load(),
+      c.gather_passes.load(),  c.gather_items.load(),
+      c.staging_hits.load(),   c.staging_misses.load(),
+      c.ka_misses.load(),      c.reconnects.load(),
+  };
+  constexpr size_t kN = sizeof(kCounterNames) / sizeof(kCounterNames[0]);
+  static_assert(sizeof(vals) / sizeof(vals[0]) == kN,
+                "counter names and values out of sync");
+  int off = 0;
+  for (size_t i = 0; i < kN; i++) {
+    int m = snprintf(out + off, cap > off ? (size_t)(cap - off) : 0,
+                     "%s\"%s\": %llu", i == 0 ? "{" : ", ", kCounterNames[i],
+                     (unsigned long long)vals[i]);
+    if (m < 0 || off + m >= cap) return -1;
+    off += m;
+  }
+  if (off + 2 >= cap) return -1;
+  out[off++] = '}';
+  out[off] = 0;
+  return off;
+}
+
+// Trace-ring dump as a JSON array, oldest first (sw_engine.h).  Reads the
+// ring without locking; an entry mid-overwrite may render garbled but the
+// JSON framing stays intact (ev written last; reason always terminated).
+int sw_trace(void* h, char* out, int cap) {
+  Worker* w = W(h);
+  TraceRing& r = w->trace;
+  if (cap < 3) return -1;
+  int off = 0;
+  out[off++] = '[';
+  if (r.enabled) {
+    uint64_t end = r.widx.load(std::memory_order_relaxed);
+    uint64_t n = end < r.cap ? end : r.cap;
+    bool first = true;
+    for (uint64_t i = end - n; i < end; i++) {
+      const TraceEvent& e = r.buf[(size_t)(i % r.cap)];
+      if (!e.ev) continue;
+      int m = snprintf(
+          out + off, (size_t)(cap - off),
+          "%s{\"t\": %.9f, \"ev\": \"%s\", \"tag\": %llu, \"conn\": %llu, "
+          "\"n\": %llu, \"reason\": \"%s\"}",
+          first ? "" : ", ", e.t, e.ev, (unsigned long long)e.tag,
+          (unsigned long long)e.conn, (unsigned long long)e.nbytes, e.reason);
+      if (m < 0 || off + m >= cap - 2) return -1;
+      off += m;
+      first = false;
+    }
+  }
+  out[off++] = ']';
+  out[off] = 0;
+  return off;
 }
 
 // Destructor path: never blocks, never fails.  Signals close if running and

@@ -60,11 +60,12 @@ typedef void (*sw_status_cb)(void* ctx, const char* status);
 
 /* ----------------------------------------------------------- lifecycle */
 
-/* Engine identification string: op deadlines + PING/PONG peer liveness.
- * The annotation below is machine-checked against the sw_engine.cpp
- * implementation by the contract checker (python -m starway_tpu.analysis,
- * rule contract-version) -- bump BOTH when the protocol changes.
- * swcheck: engine-version "starway-native-3" */
+/* Engine identification string: op deadlines + PING/PONG peer liveness +
+ * swtrace observability (sw_counters/sw_trace).  The annotation below is
+ * machine-checked against the sw_engine.cpp implementation by the contract
+ * checker (python -m starway_tpu.analysis, rule contract-version) -- bump
+ * BOTH when the protocol changes.
+ * swcheck: engine-version "starway-native-4" */
 const char* sw_version(void);
 
 /* Allocate a client/server worker in the VOID state.  `worker_id` is the
@@ -150,6 +151,32 @@ int sw_list_conns(void* h, uint64_t* out, int cap);
  * remote_addr, remote_port} for `conn_id` into `out` (NUL-terminated).
  * Returns the body length, or -1 if unknown/too small. */
 int sw_conn_info(void* h, uint64_t conn_id, char* out, int cap);
+
+/* ------------------------------------------------------ swtrace (observability)
+ *
+ * The engine implements the swtrace counter registry and per-op trace ring
+ * (starway_tpu/core/swtrace.py is the Python twin; DESIGN.md §13).  The
+ * counter vocabulary (kCounterNames in sw_engine.cpp) and the trace
+ * event-type literals (kEv*) are part of the two-engine contract,
+ * machine-checked by `python -m starway_tpu.analysis` (rule
+ * contract-trace).  Recording is lock-free (atomic counters; atomic ring
+ * index) and compiled down to one `enabled` test per event when tracing
+ * is off (STARWAY_TRACE / STARWAY_FLIGHT_DIR both unset). */
+
+/* Counter snapshot as a JSON object {"sends_posted": N, ...} over the
+ * shared vocabulary (NUL-terminated).  Thread-safe; callable in any
+ * lifecycle state until sw_free.  Returns the body length, or -1 when
+ * `cap` is too small. */
+int sw_counters(void* h, char* out, int cap);
+
+/* Trace-ring dump as a JSON array, oldest event first, each
+ * {"t": seconds, "ev": "...", "tag": N, "conn": N, "n": N, "reason": "..."}
+ * with `t` on the CLOCK_MONOTONIC timeline (comparable with the Python
+ * ring's time.perf_counter stamps).  "[]" when tracing is off.  Returns
+ * the body length, or -1 when `cap` is too small.  Thread-safe; an event
+ * being overwritten concurrently may render garbled but never corrupts
+ * the JSON framing. */
+int sw_trace(void* h, char* out, int cap);
 
 /* ------------------------------------------------------------- devpull
  *

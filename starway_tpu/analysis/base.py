@@ -28,6 +28,7 @@ RULES = {
     "contract-handshake": "negotiated handshake key missing on one side",
     "contract-version": "native engine version string drifted",
     "contract-doctable": "frames.py docstring frame table drifted",
+    "contract-trace": "swtrace event/counter vocabulary differs between engines",
     "callback-under-lock": "user callback invoked while holding a worker lock",
     "blocking-call": "blocking call reachable on the engine thread",
     "layering-jax": "jax imported under core/ (device.py owns that boundary)",

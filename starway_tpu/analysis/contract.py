@@ -253,6 +253,63 @@ def _check_handshake(py: PyModel, cpp: CppModel, out: list) -> None:
                                "code by the C++ engine"))
 
 
+def _ev_cpp_name(py_name: str) -> str:
+    """EV_SEND_POST -> kEvSendPost (the mechanical cross-engine mapping)."""
+    return "kEv" + "".join(
+        part.capitalize() for part in py_name[3:].lower().split("_"))
+
+
+def _check_trace(py: PyModel, cpp: CppModel, out: list) -> None:
+    """swtrace vocabulary parity (ISSUE 4): trace event-type constants and
+    the counter-name vocabulary must exist, identically, in both engines --
+    a counter or event added to one engine only is a finding."""
+    f_sw = py.files["swtrace"]
+    claimed = set()
+    for name, (val, line) in sorted(py.trace_events.items()):
+        cname = _ev_cpp_name(name)
+        claimed.add(cname)
+        if cname not in cpp.trace_events:
+            out.append(Finding(
+                f_sw, line, "contract-trace",
+                f"{name} = {val!r} has no {cname} counterpart in "
+                f"{cpp.cpp_file} (two engines, one trace vocabulary)"))
+        elif cpp.trace_events[cname][0] != val:
+            cval, cline = cpp.trace_events[cname]
+            out.append(Finding(
+                f_sw, line, "contract-trace",
+                f"{name} = {val!r} but {cpp.cpp_file}:{cline} has "
+                f"{cname} = {cval!r}"))
+    for cname, (cval, cline) in sorted(cpp.trace_events.items()):
+        if cname not in claimed:
+            out.append(Finding(
+                cpp.cpp_file, cline, "contract-trace",
+                f"{cname} = {cval!r} has no EV_* counterpart in {f_sw}"))
+    if py.counter_names is None:
+        out.append(Finding(f_sw, 1, "contract-trace",
+                           "COUNTER_NAMES tuple not found"))
+        return
+    if cpp.counter_names is None:
+        out.append(Finding(cpp.cpp_file, 1, "contract-trace",
+                           "kCounterNames[] array not found"))
+        return
+    py_names, py_line = py.counter_names
+    cpp_names, cpp_line = cpp.counter_names
+    for name in py_names:
+        if name not in cpp_names:
+            out.append(Finding(
+                f_sw, py_line, "contract-trace",
+                f"counter {name!r} is declared in COUNTER_NAMES only -- "
+                f"{cpp.cpp_file}:{cpp_line} kCounterNames[] lacks it "
+                "(a counter added to one engine only)"))
+    for name in cpp_names:
+        if name not in py_names:
+            out.append(Finding(
+                cpp.cpp_file, cpp_line, "contract-trace",
+                f"counter {name!r} is declared in kCounterNames[] only -- "
+                f"{f_sw}:{py_line} COUNTER_NAMES lacks it "
+                "(a counter added to one engine only)"))
+
+
 def _check_version(cpp: CppModel, out: list) -> None:
     if cpp.version is None:
         out.append(Finding(cpp.cpp_file, 1, "contract-version",
@@ -328,6 +385,7 @@ def run(root: Path) -> list:
     for ok, where, what in [
         (py.frames, py.files["frames"], "T_* frame constants"),
         (py.argtypes, py.files["native"], "lib.*.argtypes declarations"),
+        (py.trace_events, py.files["swtrace"], "EV_* trace event constants"),
         (cpp.constants, cpp.cpp_file, "constexpr constants"),
         (cpp.functions, cpp.h_file, "sw_* ABI declarations"),
     ]:
@@ -343,6 +401,7 @@ def run(root: Path) -> list:
     _check_abi(py, cpp, out)
     _check_reasons(py, cpp, out)
     _check_handshake(py, cpp, out)
+    _check_trace(py, cpp, out)
     _check_version(cpp, out)
     _check_doctable(py, out)
     return out

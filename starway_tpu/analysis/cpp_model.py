@@ -62,6 +62,8 @@ class CppFunc:
 class CppModel:
     constants: dict = field(default_factory=dict)   # name -> (int, line)
     reasons: dict = field(default_factory=dict)     # kName -> (str, line)
+    trace_events: dict = field(default_factory=dict)  # kEv* -> (str, line)
+    counter_names: Optional[tuple] = None           # (list[str], line)
     version: Optional[tuple] = None                 # (str, line) from .cpp
     header_version: Optional[tuple] = None          # (str, line) from .h
     functions: dict = field(default_factory=dict)   # name -> CppFunc (.h)
@@ -78,6 +80,12 @@ _CONSTEXPR_RE = re.compile(
 )
 
 _REASON_RE = re.compile(r'const\s+char\s*\*\s*(k\w+)\s*=\s*"([^"]*)"\s*;')
+
+# const char* kCounterNames[] = {"a", "b", ...}; -- the swtrace counter
+# vocabulary (contract-trace pairs it with core/swtrace.py COUNTER_NAMES).
+_COUNTERS_RE = re.compile(
+    r"const\s+char\s*\*\s*kCounterNames\s*\[\s*\]\s*=\s*\{([^}]*)\}", re.S
+)
 
 _VERSION_RE = re.compile(
     r'const\s+char\s*\*\s*sw_version\s*\(\s*\)\s*\{\s*return\s*"([^"]+)"\s*;'
@@ -143,7 +151,16 @@ def extract_cpp(root: Path) -> CppModel:
                     model.constants[name] = (val, line)
                     env[name] = val
         for m in _REASON_RE.finditer(text):
-            model.reasons[m.group(1)] = (m.group(2), _line_of(text, m.start()))
+            name = m.group(1)
+            entry = (m.group(2), _line_of(text, m.start()))
+            if name.startswith("kEv"):
+                model.trace_events[name] = entry
+            else:
+                model.reasons[name] = entry
+        m = _COUNTERS_RE.search(text)
+        if m:
+            names = re.findall(r'"([^"]*)"', m.group(1))
+            model.counter_names = (names, _line_of(text, m.start()))
         m = _VERSION_RE.search(text)
         if m:
             model.version = (m.group(1), _line_of(text, m.start()))

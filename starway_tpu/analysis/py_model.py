@@ -129,6 +129,8 @@ class PyModel:
     restype: dict = field(default_factory=dict)      # fn -> (str, line)
     cfunctypes: dict = field(default_factory=dict)   # _X_CB -> (list[str], line)
     engine_strings: set = field(default_factory=set)  # engine.py code literals
+    trace_events: dict = field(default_factory=dict)  # EV_* -> (str, line)
+    counter_names: Optional[tuple] = None            # (list[str], line)
     native_text: str = ""                            # core/native.py source
     files: dict = field(default_factory=dict)        # logical -> repo-rel path
 
@@ -150,6 +152,7 @@ def extract_py(root: Path) -> PyModel:
         "native": "starway_tpu/core/native.py",
         "engine": "starway_tpu/core/engine.py",
         "errors": "starway_tpu/errors.py",
+        "swtrace": "starway_tpu/core/swtrace.py",
     }
 
     tree = _parse(core / "frames.py")
@@ -221,5 +224,23 @@ def extract_py(root: Path) -> PyModel:
     tree = _parse(core / "engine.py")
     if tree is not None:
         model.engine_strings = code_string_literals(tree)
+
+    tree = _parse(core / "swtrace.py")
+    if tree is not None:
+        model.trace_events = {
+            k: v for k, v in module_str_constants(tree).items()
+            if k.startswith("EV_")
+        }
+        for node in tree.body:
+            # COUNTER_NAMES = ("sends_posted", ...) -- the shared counter
+            # vocabulary (contract-trace pairs it with kCounterNames[]).
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "COUNTER_NAMES" \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                names = [e.value for e in node.value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)]
+                model.counter_names = (names, node.lineno)
 
     return model

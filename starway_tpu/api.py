@@ -29,6 +29,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from . import config
+from .core import swtrace
 from .core.endpoint import ServerEndpoint
 from .core.engine import ClientWorker, ServerWorker
 from .errors import REASON_TIMEOUT
@@ -405,6 +406,10 @@ class Client:
             last: Exception = Exception("connect: no attempt made")
             for attempt in range(retries + 1):
                 if attempt > 0:
+                    # Reconnect-attempt accounting is process-global by
+                    # nature: every retry burns the old worker, so no
+                    # single worker's registry could carry it.
+                    swtrace.GLOBAL.reconnects += 1
                     # Exponential backoff, full jitter in [delay/2, delay]:
                     # a fleet of clients chasing one restarted server must
                     # not reconnect in lockstep.

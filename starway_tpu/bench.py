@@ -85,6 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stream-warmup", type=int)
     p.add_argument("--output", type=Path, help="Path to write the JSON report.")
     p.add_argument("--store-trace", action="store_true", help="Include per-iteration samples in the report.")
+    p.add_argument(
+        "--trace", type=Path, metavar="PATH",
+        help="Enable swtrace (STARWAY_TRACE=1) for the run and write a "
+             "Chrome trace_event JSON here (open in Perfetto); the printed "
+             "report gains a p-tile stage breakdown.",
+    )
     return p
 
 
@@ -322,6 +328,42 @@ async def run_loopback(args: argparse.Namespace) -> list:
         raise
 
 
+def _dump_trace(args: argparse.Namespace) -> "dict | None":
+    """Write the Chrome trace for --trace runs and print the p-tile stage
+    breakdown from the recorded EV_STAGE spans.  Returns the ring dumps'
+    per-stage p-tiles for the JSON report (None when --trace is off)."""
+    from . import trace as trace_mod
+    from .core import swtrace
+    from .perf import percentile as _percentile
+
+    dumps = swtrace.dump_all()
+    path = trace_mod.write_chrome(dumps, args.trace)
+    n_events = sum(len(d["events"]) for d in dumps)
+    print(f"\nChrome trace written to {path} ({n_events} events, "
+          f"{len(dumps)} worker(s)); open in Perfetto or chrome://tracing")
+    durs: dict[str, list] = {}
+    for dump in dumps:
+        for ev in dump["events"]:
+            if ev[1] == swtrace.EV_STAGE and ev[6] > 0:
+                durs.setdefault(ev[5], []).append(ev[6])
+    if not durs:
+        # Stage spans are recorded by the Python data plane; a pure native
+        # run still gets op spans, just no stage breakdown.
+        return None
+    print("[stage p-tiles] (us per recorded span; stage=D2H tx/rx=transport "
+          "place=H2D)")
+    ptiles = {}
+    for name in sorted(durs):
+        xs = sorted(durs[name])
+        p50, p90, p99 = (_percentile(xs, 50) * 1e6, _percentile(xs, 90) * 1e6,
+                         _percentile(xs, 99) * 1e6)
+        ptiles[name] = {"count": len(xs), "p50_us": p50, "p90_us": p90,
+                        "p99_us": p99}
+        print(f"  {name}: n={len(xs)} p50={p50:.1f}us p90={p90:.1f}us "
+              f"p99={p99:.1f}us")
+    return ptiles
+
+
 def dump_results(results, args: argparse.Namespace) -> None:
     from . import perf
     from .benchmarks import get_scenario
@@ -342,6 +384,7 @@ def dump_results(results, args: argparse.Namespace) -> None:
             avg_us = s["seconds"] / s["count"] * 1e6 if s["count"] else 0.0
             print(f"  {name}: n={s['count']} avg={avg_us:.1f}us "
                   f"bytes={s['bytes']} ({s['gbps']:.2f} GB/s)")
+    stage_ptiles = _dump_trace(args) if args.trace else None
     if args.output:
         args.output.parent.mkdir(parents=True, exist_ok=True)
         report = {
@@ -352,6 +395,10 @@ def dump_results(results, args: argparse.Namespace) -> None:
             # see both sides; client-role runs see the client's half.
             "stages": stages,
         }
+        if args.trace:
+            report["trace"] = str(args.trace)
+            if stage_ptiles:
+                report["stage_ptiles"] = stage_ptiles
         args.output.write_text(json.dumps(report, indent=2))
         print(f"\nJSON results written to {args.output}")
 
@@ -360,6 +407,10 @@ def main(argv: Sequence[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.tls:
         os.environ["STARWAY_TLS"] = args.tls
+    if args.trace:
+        # Must land before any worker is created: rings are armed per
+        # worker at construction (core/swtrace.py).
+        os.environ["STARWAY_TRACE"] = "1"
     if getattr(args, "payload", None) == "device":
         # devpull is only advertised in the handshake once the jax backend
         # is up (the handshake never initialises one); device-payload runs

@@ -85,6 +85,24 @@ benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
 ``STARWAY_KEEPALIVE_MISSES``
     Silent keepalive intervals tolerated before a peer is declared dead
     (default 3).
+
+``STARWAY_TRACE``
+    "1" = record per-op lifecycle events (posted/matched/completed/
+    failed, stage spans, connection churn) into a bounded per-worker ring
+    in BOTH engines (core/swtrace.py, native sw_trace).  Default off:
+    the hot path then carries a single ``is None`` check per op -- no
+    allocation, no syscall.  Export with ``python -m starway_tpu.trace``
+    or ``python -m starway_tpu.bench --trace PATH`` (Chrome/Perfetto).
+
+``STARWAY_TRACE_RING``
+    Trace ring capacity in events per worker (default 4096; min 16).
+
+``STARWAY_FLIGHT_DIR``
+    Directory for flight-recorder dumps.  When set, the first op failure
+    with a non-cancel reason, an engine emergency close, and a close
+    after a fault each dump the worker's last-N trace events + counter
+    snapshot as JSON there (post-mortem forensics, DESIGN.md §13).
+    Setting it implicitly arms the trace ring even without STARWAY_TRACE.
 """
 
 from __future__ import annotations
@@ -104,6 +122,9 @@ __all__ = [
     "connect_timeout",
     "keepalive_interval",
     "keepalive_misses",
+    "trace_enabled",
+    "trace_ring_size",
+    "flight_dir",
 ]
 
 
@@ -200,6 +221,27 @@ def keepalive_misses() -> int:
     except ValueError:
         return 3
     return v if v > 0 else 3
+
+
+def trace_enabled() -> bool:
+    """Per-op lifecycle tracing (STARWAY_TRACE); off by default -- the
+    tracing-off hot path must stay allocation-free (DESIGN.md §13)."""
+    return _env("STARWAY_TRACE", "0") not in ("", "0")
+
+
+def trace_ring_size() -> int:
+    """Trace ring capacity in events per worker (STARWAY_TRACE_RING)."""
+    try:
+        v = int(_env("STARWAY_TRACE_RING", "4096"))
+    except ValueError:
+        return 4096
+    return max(16, v)
+
+
+def flight_dir() -> str:
+    """Flight-recorder output directory (STARWAY_FLIGHT_DIR); empty =
+    recorder disabled."""
+    return _env("STARWAY_FLIGHT_DIR", "")
 
 
 def use_native() -> bool:

@@ -45,7 +45,7 @@ from typing import Optional
 
 from .. import config, perf
 from ..errors import REASON_CANCELLED, REASON_NOT_CONNECTED
-from . import frames, state
+from . import frames, state, swtrace
 from .matching import InboundMsg
 
 logger = logging.getLogger("starway_tpu")
@@ -289,6 +289,10 @@ class BaseConn:
     def __init__(self, worker, mode: str):
         self.conn_id = next(_conn_ids)
         self.worker = worker
+        # swtrace counters + per-worker stage scope, cached so the data
+        # path pays one attribute load per sample (DESIGN.md §13).
+        self._ctr = getattr(worker, "counters", None) or swtrace.Counters()
+        self._scope = getattr(worker, "stage_scope", None)
         self.mode = mode  # "socket" | "address"
         self.alive = True
         self.peer_name = ""
@@ -439,7 +443,9 @@ class TcpConn(BaseConn):
         if not self._tx_via_ring:
             n = self.sock.send(chunk)
             if n:
-                perf.record_stage("tx", time.perf_counter() - t0, n)
+                self._ctr.bytes_tx += n
+                perf.record_stage("tx", time.perf_counter() - t0, n,
+                                  self._scope)
             return n
         n = self.sm_tx.write(chunk)
         if n == 0:
@@ -448,7 +454,8 @@ class TcpConn(BaseConn):
             # signaling rides the socket, so syscall ordering makes the sleep
             # race-free even though pure Python cannot fence (shmring.py).
             raise BlockingIOError
-        perf.record_stage("tx", time.perf_counter() - t0, n)
+        self._ctr.bytes_tx += n
+        perf.record_stage("tx", time.perf_counter() - t0, n, self._scope)
         return n
 
     def _tx_writev(self, views: list) -> int:
@@ -593,6 +600,8 @@ class TcpConn(BaseConn):
                         blocked = True
                         break
                     self.tx.popleft()
+                    if not isinstance(item, TxCtl):
+                        self._ctr.sends_completed += 1
                     continue
                 # Socket: one gathered sendmsg per pass across queued items
                 # -- a burst of small frames costs one syscall, and a large
@@ -610,7 +619,12 @@ class TcpConn(BaseConn):
                         first._maybe_local_complete(fires)
                     blocked = True
                     break
-                perf.record_stage("tx", time.perf_counter() - tw0, n)
+                ctr = self._ctr
+                ctr.bytes_tx += n
+                ctr.gather_passes += 1
+                ctr.gather_items += len(views)
+                perf.record_stage("tx", time.perf_counter() - tw0, n,
+                                  self._scope)
                 for item, offered in spans:
                     adv = min(n, offered)
                     if adv == 0:
@@ -619,6 +633,8 @@ class TcpConn(BaseConn):
                     n -= adv
                     if item.remaining == 0 and self.tx and self.tx[0] is item:
                         self.tx.popleft()
+                        if not isinstance(item, TxCtl):
+                            ctr.sends_completed += 1
                         if getattr(item, "switch_after", False):
                             # The sm switch point (HELLO_ACK) left the
                             # socket: every later item rides the ring, even
@@ -685,12 +701,14 @@ class TcpConn(BaseConn):
             if n == 0:
                 raise BlockingIOError
             self.last_rx = time.monotonic()
-            perf.record_stage("rx", time.perf_counter() - t0, n)
+            self._ctr.bytes_rx += n
+            perf.record_stage("rx", time.perf_counter() - t0, n, self._scope)
             return n
         n = self.sock.recv_into(target)
         if n:
             self.last_rx = time.monotonic()
-            perf.record_stage("rx", time.perf_counter() - t0, n)
+            self._ctr.bytes_rx += n
+            perf.record_stage("rx", time.perf_counter() - t0, n, self._scope)
         return n
 
     def on_readable(self, fires: list) -> None:
@@ -854,7 +872,10 @@ class TcpConn(BaseConn):
         """
         abort = self.has_unfinished_data_tx()
         for item in self.tx:
+            before = len(fires)
             item.cancel(fires)
+            if len(fires) > before:
+                self._ctr.ops_cancelled += 1
         self.tx.clear()
         if self.alive:
             self.alive = False
@@ -876,7 +897,10 @@ class TcpConn(BaseConn):
             self.alive = False
             self.worker._unregister_conn_io(self)
             for item in self.tx:
+                before = len(fires)
                 item.cancel(fires)
+                if len(fires) > before:
+                    self._ctr.ops_cancelled += 1
             self.tx.clear()
             if self._rx_msg is not None:
                 with self.worker.lock:
@@ -921,6 +945,12 @@ class InprocConn(BaseConn):
         with peer.lock:
             peer_fires = peer.matcher.deliver(tag, payload)
         fires.extend(peer_fires)
+        nbytes = len(payload) if isinstance(payload, memoryview) else int(payload.nbytes)
+        self._ctr.bytes_tx += nbytes
+        self._ctr.sends_completed += 1
+        peer_ctr = getattr(peer, "counters", None)
+        if peer_ctr is not None:
+            peer_ctr.bytes_rx += nbytes
         if done is not None:
             fires.append(done)
 

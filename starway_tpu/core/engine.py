@@ -33,14 +33,14 @@ import weakref
 from collections import deque
 from typing import Callable, Optional
 
-from .. import config
+from .. import config, perf
 from ..errors import (
     REASON_CANCELLED,
     REASON_NOT_CONNECTED,
     REASON_TIMEOUT,
     StarwayStateError,
 )
-from . import fabric, frames, state
+from . import fabric, frames, state, swtrace
 from .conn import InprocConn, TcpConn
 from .endpoint import ServerEndpoint
 from .matching import PostedRecv, TagMatcher
@@ -89,6 +89,18 @@ class Worker:
         self.worker_id = uuid.uuid4().hex
         self.name = name or self.worker_id[:8]
         self.matcher = TagMatcher()
+        # swtrace observability (DESIGN.md §13): the counter registry is
+        # always live (plain int increments); the trace ring and the
+        # per-op callback wraps exist only when STARWAY_TRACE /
+        # STARWAY_FLIGHT_DIR armed them -- the off path is one `is None`
+        # check per op.
+        self.counters = swtrace.Counters()
+        self._trace = swtrace.worker_ring()
+        self._faulted = False
+        self.matcher.counters = self.counters
+        self.matcher.trace = self._trace
+        self.stage_scope = perf.StageScope(ring=self._trace)
+        swtrace.register_worker(self)
         self.ops: deque = deque()
         # Ops queued or currently executing on the engine thread.  When zero,
         # in-process sends/flushes may run inline on the caller thread (no
@@ -129,11 +141,37 @@ class Worker:
                 f"(status={state.NAMES[self.status]})"
             )
 
+    # --------------------------------------------------------- observability
+    @property
+    def trace_label(self) -> str:
+        return f"{self.kind}-{self.name}"
+
+    def trace_events(self) -> list:
+        """Snapshot of this worker's swtrace ring ([] when tracing off)."""
+        return self._trace.snapshot() if self._trace is not None else []
+
+    def counters_snapshot(self) -> dict:
+        """This worker's counter registry, with the process-global
+        counters (staging pool, reconnects) overlaid -- the same shape the
+        native engine surfaces through ``sw_counters``."""
+        return swtrace.merge_global_counters(self.counters.snapshot())
+
     def post_recv(self, buf, tag: int, mask: int, done, fail, owner=None,
                   timeout: Optional[float] = None) -> None:
+        tr = self._trace
+        if tr is not None:
+            nbytes = int(buf.nbytes if hasattr(buf, "nbytes") else len(buf))
+            done, fail = swtrace.wrap_op(self, tr, swtrace.EV_RECV_DONE,
+                                         tag, 0, nbytes, done, fail)
         pr = PostedRecv(buf, tag, mask, done, fail, owner)
         with self.lock:
             self._require_running()
+            # Counted/recorded only once the submit is accepted (the C++
+            # engine bumps after its status check too -- one accounting);
+            # RECV_POST lands before the matcher can record RECV_MATCH.
+            self.counters.recvs_posted += 1
+            if tr is not None:
+                tr.rec(swtrace.EV_RECV_POST, tag, 0, nbytes)
             fires = self.matcher.post_recv_pr(pr)
         if timeout is not None:
             # The timer holds the receive WEAKLY: the matcher is the only
@@ -145,9 +183,18 @@ class Worker:
 
     def submit_send(self, conn, view, tag: int, done, fail, owner=None,
                     timeout: Optional[float] = None) -> None:
+        tr = self._trace
+        if tr is not None:
+            cid = conn.conn_id if conn is not None else 0
+            nbytes = int(view.nbytes if hasattr(view, "nbytes") else len(view))
+            done, fail = swtrace.wrap_op(self, tr, swtrace.EV_SEND_DONE,
+                                         tag, cid, nbytes, done, fail)
         inline = False
         with self.lock:
             self._require_running()
+            self.counters.sends_posted += 1  # accepted-submit accounting
+            if tr is not None:
+                tr.rec(swtrace.EV_SEND_POST, tag, cid, nbytes)
             if self._busy == 0 and conn is not None and conn.kind == "inproc" and conn.alive:
                 inline = True
             else:
@@ -164,9 +211,16 @@ class Worker:
 
     def submit_flush(self, done, fail, conns=None,
                      timeout: Optional[float] = None) -> None:
+        tr = self._trace
+        if tr is not None:
+            done, fail = swtrace.wrap_op(self, tr, swtrace.EV_FLUSH_DONE,
+                                         0, 0, 0, done, fail)
         inline = False
         with self.lock:
             self._require_running()
+            self.counters.flushes_posted += 1  # accepted-submit accounting
+            if tr is not None:
+                tr.rec(swtrace.EV_FLUSH_POST)
             targets = conns if conns is not None else list(self.conns.values())
             # Inline only when the engine owns no TCP state at all: flush
             # bookkeeping (flush_records) is engine-thread territory
@@ -191,9 +245,18 @@ class Worker:
         ordering in the stream is what the flush barrier builds on."""
         from . import frames as _frames
 
+        tr = self._trace
+        if tr is not None:
+            cid = conn.conn_id if conn is not None else 0
+            nbytes = int(desc.get("n", 0))
+            done, fail = swtrace.wrap_op(self, tr, swtrace.EV_SEND_DONE,
+                                         tag, cid, nbytes, done, fail)
         data = _frames.pack_devpull(tag, desc)
         with self.lock:
             self._require_running()
+            self.counters.sends_posted += 1  # accepted-submit accounting
+            if tr is not None:
+                tr.rec(swtrace.EV_SEND_POST, tag, cid, nbytes)
             self._busy += 1
             self.ops.append(("devpull", conn, data, done, fail, owner))
         self._wake()
@@ -263,6 +326,10 @@ class Worker:
             fires.append(lambda m=msg: m.remote.start(m))
 
     def close(self, cb) -> None:
+        if self._faulted:
+            # Post-mortem snapshot before teardown wipes the state the
+            # fault left behind (DESIGN.md §13 flight recorder).
+            swtrace.flight_dump("close-after-fault", self)
         with self.lock:
             self._require_running()
             self.status = state.CLOSING
@@ -306,17 +373,15 @@ class Worker:
             return conn.kind
 
     def evaluate_perf(self, conn, msg_size: int) -> float:
-        from .. import perf
-
         # Per-endpoint first (live-calibrated, perf.autocalibrate[_ep]),
         # transport-class model otherwise.
         return perf.conn_estimate(conn, self._perf_transport(conn), msg_size)
 
     def evaluate_perf_detail(self, conn, msg_size: int) -> dict:
-        from .. import perf
-
-        return perf.conn_estimate_detail(conn, self._perf_transport(conn),
-                                         msg_size)
+        detail = perf.conn_estimate_detail(conn, self._perf_transport(conn),
+                                           msg_size, scope=self.stage_scope)
+        detail["counters"] = self.counters_snapshot()
+        return detail
 
     # --------------------------------------------------------- engine side
     def _wake(self) -> None:
@@ -375,6 +440,7 @@ class Worker:
             self._do_close()
         except Exception:
             logger.exception("starway: engine thread crashed; emergency close")
+            swtrace.flight_dump("engine-crash", self)
             try:
                 self._do_close()
             except Exception:
@@ -440,7 +506,10 @@ class Worker:
         if pr is None:
             return  # settled and collected: nothing to expire
         with self.lock:
-            fires.extend(self.matcher.expire_recv(pr))
+            expired = self.matcher.expire_recv(pr)
+        if expired:
+            self.counters.ops_timed_out += 1
+        fires.extend(expired)
 
     def _expire_send_ref(self, conn, ref, fires) -> None:
         item = ref()
@@ -464,6 +533,7 @@ class Worker:
                 except ValueError:
                     return  # drained between checks
             item.local_done = True  # suppress the close-time cancel path
+        self.counters.ops_timed_out += 1
         if item.fail is not None:
             fires.append(lambda f=item.fail: f(REASON_TIMEOUT))
         if started:
@@ -475,6 +545,7 @@ class Worker:
         rec.completed = True
         if rec in self.flush_records:
             self.flush_records.remove(rec)
+        self.counters.ops_timed_out += 1
         if rec.fail is not None:
             fires.append(lambda f=rec.fail: f(REASON_TIMEOUT))
 
@@ -513,6 +584,7 @@ class Worker:
             conn.peer_name or conn.conn_id,
             time.monotonic() - conn.last_rx, self._ka_misses, self._ka_interval,
         )
+        self.counters.ka_misses += 1
         self._conn_broken(conn, fires)
 
     def _process_op(self, op, fires, pending_kicks=None) -> None:
@@ -605,6 +677,7 @@ class Worker:
             rec.completed = True
             if rec in self.flush_records:
                 self.flush_records.remove(rec)
+            self.counters.flushes_completed += 1
             if rec.done is not None:
                 fires.append(rec.done)
 
@@ -652,6 +725,8 @@ class Worker:
         whatever killed the conn (liveness expiry, RST, EOF), the receive
         it was streaming into fails, and once no alive conns remain every
         queued receive fails too -- stable "not connected" keyword."""
+        if self._trace is not None and conn.alive:
+            self._trace.rec(swtrace.EV_CONN_DOWN, 0, conn.conn_id)
         ka_live = (self._ka_interval > 0 and conn.alive
                    and getattr(conn, "ka_ok", False))
         stranded = None
@@ -704,6 +779,7 @@ class Worker:
                 idx = _fail_idx.get(op[0])
                 fail = op[idx] if idx is not None else None
                 if fail is not None:
+                    self.counters.ops_cancelled += 1
                     fires.append(lambda f=fail: f(REASON_CANCELLED))
             fires.extend(self.matcher.cancel_all())
             conns = list(self.conns.values())
@@ -714,6 +790,7 @@ class Worker:
             mgr.close()
         for rec in self.flush_records:
             if not rec.completed and rec.fail is not None:
+                self.counters.ops_cancelled += 1
                 fires.append(lambda f=rec.fail: f(REASON_CANCELLED))
         self.flush_records.clear()
         for c in conns:
@@ -741,6 +818,9 @@ class Worker:
             cb = self.close_cb
             self.close_cb = None
         _run_fires(fires)
+        # Park the ring's final contents for post-close consumers (bench
+        # --trace reports run after the workers are gone).
+        swtrace.retire(self)
         if cb is not None:
             try:
                 cb()
@@ -831,6 +911,8 @@ class ClientWorker(Worker):
                     if self.status == state.INIT:
                         self.status = state.RUNNING
                 fabric.register_worker(self)
+                if self._trace is not None:
+                    self._trace.rec(swtrace.EV_CONN_UP, 0, conn.conn_id)
                 if cb is not None:
                     _run_fires([lambda: cb("")])
                 return True
@@ -889,6 +971,8 @@ class ClientWorker(Worker):
                 self.status = state.RUNNING
         self._register_conn_io(conn)
         fabric.register_worker(self)
+        if self._trace is not None:
+            self._trace.rec(swtrace.EV_CONN_UP, 0, conn.conn_id)
         if cb is not None:
             _run_fires([lambda: cb("")])
         return True
@@ -1041,6 +1125,8 @@ class ServerWorker(Worker):
         # even while the ACK itself is still draining to the socket.
         conn.send_ctl(frames.pack_hello_ack(self.worker_id, ack_extra or None),
                       fires, switch_after=sm_seg is not None)
+        if self._trace is not None:
+            self._trace.rec(swtrace.EV_CONN_UP, 0, conn.conn_id)
         if self.accept_cb is not None:
             fires.append(lambda ep=ep: self.accept_cb(ep))
 
@@ -1069,6 +1155,8 @@ class ServerWorker(Worker):
                 raise StarwayStateError("server is not in a running state")
             self.conns[server_side.conn_id] = server_side
             self.eps[server_side.conn_id] = ep
+        if self._trace is not None:
+            self._trace.rec(swtrace.EV_CONN_UP, 0, server_side.conn_id)
         if self.accept_cb is not None:
             _run_fires([lambda: self.accept_cb(ep)])
         return client_side

@@ -36,6 +36,7 @@ from collections import deque
 from typing import Callable, Optional
 
 from ..errors import REASON_CANCELLED, REASON_TIMEOUT, REASON_TRUNCATED
+from . import swtrace
 
 DoneCb = Callable[[int, int], None]  # (sender_tag, length)
 FailCb = Callable[[str], None]
@@ -157,6 +158,18 @@ class TagMatcher:
         self.unexpected: deque[InboundMsg] = deque()
         # Messages whose payload is still streaming in (for close-time cancel).
         self.inflight: set[InboundMsg] = set()
+        # swtrace observability (DESIGN.md §13): the owning Worker swaps in
+        # its own Counters (and, when tracing is on, its TraceRing) so
+        # match/completion accounting lands per worker.  Ring appends are
+        # GIL-atomic data writes -- unlike user callbacks, they are safe
+        # under the worker lock the matcher runs beneath.
+        self.counters = swtrace.Counters()
+        self.trace = None
+
+    def _rec_match(self, tag: int, length: int) -> None:
+        tr = self.trace
+        if tr is not None:
+            tr.rec(swtrace.EV_RECV_MATCH, tag, 0, length)
 
     # ------------------------------------------------------------------ post
     def post_recv(self, buf, tag: int, mask: int, done: DoneCb, fail: FailCb, owner=None) -> list:
@@ -190,6 +203,7 @@ class TagMatcher:
                     msg.posted = pr
                     self.unexpected.remove(msg)
                     self.inflight.add(msg)
+                    self._rec_match(msg.tag, msg.length)
                     fires.append(lambda m=msg: m.remote.start(m))
                     return fires
                 if msg.complete:
@@ -199,12 +213,15 @@ class TagMatcher:
                     else:
                         _copy_complete(pr, memoryview(msg.spill)[: msg.length] if msg.spill is not None else memoryview(b""), msg.length)
                     stag, length = msg.tag, msg.length
+                    self._rec_match(stag, length)
+                    self.counters.recvs_completed += 1
                     fires.append(lambda done=done, stag=stag, length=length: done(stag, length))
                     return fires
                 # In flight: claim it; payload keeps streaming into the spill
                 # buffer and is copied on completion.
                 pr.claimed = True
                 msg.posted = pr
+                self._rec_match(msg.tag, msg.length)
                 return fires
         self.posted.append(pr)
         return fires
@@ -235,6 +252,7 @@ class TagMatcher:
                 pr.claimed = True
                 msg.posted = pr
                 self.posted.remove(pr)
+                self._rec_match(tag, length)
                 if _is_host(pr.buf):
                     msg.sink = pr.buf
                 else:
@@ -265,6 +283,7 @@ class TagMatcher:
             elif not _is_host(pr.buf):
                 # Streamed straight into the device sink's staging buffer.
                 pr.buf.finalize_from_host(msg.length)
+            self.counters.recvs_completed += 1
             fires.append(lambda pr=pr, m=msg: pr.done(m.tag, m.length))
         # else: stays in the unexpected queue until a matching recv is posted.
         return fires
@@ -291,6 +310,7 @@ class TagMatcher:
                 msg.posted = pr
                 self.posted.remove(pr)
                 self.inflight.add(msg)
+                self._rec_match(tag, length)
                 fires.append(lambda m=msg: m.remote.start(m))
                 return msg, fires
         self.unexpected.append(msg)
@@ -327,6 +347,7 @@ class TagMatcher:
         pr = msg.posted
         if pr is not None:
             _copy_complete(pr, payload, msg.length)
+            self.counters.recvs_completed += 1
             fires.append(lambda pr=pr, m=msg: pr.done(m.tag, m.length))
         else:
             # Force-started by a flush barrier before any receive matched:
@@ -354,6 +375,8 @@ class TagMatcher:
                     fires.append(lambda pr=pr: pr.fail(REASON_TRUNCATED))
                     return fires
                 _copy_complete(pr, payload, length)
+                self._rec_match(tag, length)
+                self.counters.recvs_completed += 1
                 fires.append(lambda pr=pr, t=tag, n=length: pr.done(t, n))
                 return fires
         msg = InboundMsg(tag, length)
@@ -451,6 +474,7 @@ class TagMatcher:
         fires: list = []
         while self.posted:
             pr = self.posted.popleft()
+            self.counters.ops_cancelled += 1
             fires.append(lambda pr=pr: pr.fail(REASON_CANCELLED))
         # In-flight claimed messages (streaming directly into a posted buffer
         # or claimed while spilling): their PostedRecv is no longer in
@@ -460,6 +484,7 @@ class TagMatcher:
                 pr = msg.posted
                 msg.posted = None
                 msg.discard = True
+                self.counters.ops_cancelled += 1
                 fires.append(lambda pr=pr: pr.fail(REASON_CANCELLED))
         self.inflight.clear()
         self.unexpected.clear()

@@ -40,9 +40,9 @@ import uuid
 import weakref
 from typing import Optional
 
-from .. import config
+from .. import config, perf
 from ..errors import StarwayStateError
-from . import state
+from . import state, swtrace
 from .engine import logger
 
 _lib = None
@@ -105,6 +105,12 @@ def load() -> Optional[ctypes.CDLL]:
         ]
         lib.sw_conn_info.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int
+        ]
+        lib.sw_counters.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
+        ]
+        lib.sw_trace.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
         ]
         lib.sw_free.argtypes = [ctypes.c_void_p]
         lib.sw_set_devpull.argtypes = [
@@ -397,6 +403,77 @@ class NativeWorkerBase:
         self._devpull_entries: dict[int, _PendingPull] = {}
         self._devpull_claimed: list[_PendingPull] = []
         self._devpull_lock = threading.Lock()
+        # swtrace observability (DESIGN.md §13): lifecycle events and the
+        # counter registry live in the ENGINE (TraceRing / Counters in
+        # sw_engine.cpp, pulled through sw_trace / sw_counters); the
+        # wrapper adds the per-worker stage scope (device placement runs
+        # in Python) and the flight-recorder fault triggers.
+        self._faulted = False
+        # Armed-state cached at construction, like the Python engine's
+        # self._trace: the off path must stay env-lookup-free per op.
+        self._swtrace_on = swtrace.active()
+        self.stage_scope = perf.StageScope()
+        swtrace.register_worker(self)
+
+    # --------------------------------------------------------- observability
+    @property
+    def trace_label(self) -> str:
+        return f"{self.kind}-{self.worker_id[:8]}"
+
+    def trace_events(self) -> list:
+        """The engine-side swtrace ring, pulled through ``sw_trace`` and
+        reshaped to the Python ring's event tuples ([] when tracing off
+        or the handle is gone)."""
+        if self._h is None:
+            return []
+        cap = 256 + 224 * config.trace_ring_size()
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.sw_trace(self._h, buf, cap)
+        if n <= 0:
+            return []
+        try:
+            raw = json.loads(buf.value.decode(errors="replace"))
+        except ValueError:
+            return []
+        return [(e.get("t", 0.0), e.get("ev", ""), int(e.get("tag", 0)),
+                 int(e.get("conn", 0)), int(e.get("n", 0)),
+                 e.get("reason", ""), 0.0) for e in raw]
+
+    def counters_snapshot(self) -> dict:
+        """The engine's counter registry (``sw_counters``) in the shared
+        COUNTER_NAMES vocabulary, with the process-global counters
+        (staging pool, reconnects) overlaid -- same shape as the Python
+        engine's ``Worker.counters_snapshot``."""
+        snap = {name: 0 for name in swtrace.COUNTER_NAMES}
+        if self._h is not None:
+            buf = ctypes.create_string_buffer(2048)
+            n = self._lib.sw_counters(self._h, buf, 2048)
+            if n > 0:
+                try:
+                    for key, val in json.loads(buf.value.decode()).items():
+                        if key in snap:
+                            snap[key] = int(val)
+                except ValueError:
+                    pass
+        return swtrace.merge_global_counters(snap)
+
+    def _flight_fail(self, fail):
+        """Wrap an op's fail callback with the flight-recorder trigger
+        (first non-cancel failure dumps).  Identity when tracing/flight
+        are off -- no per-op closure on the default path."""
+        if not self._swtrace_on:
+            return fail
+        wself = weakref.ref(self)
+
+        def traced_fail(reason: str):
+            s = wself()
+            if s is not None and "cancel" not in reason.lower():
+                s._faulted = True
+                swtrace.flight_dump("op-failed", s, reason)
+            if fail is not None:
+                fail(reason)
+
+        return traced_fail
 
     @property
     def status(self) -> int:
@@ -629,7 +706,7 @@ class NativeWorkerBase:
         self._require_running()
         conn_id = conn.conn_id if isinstance(conn, NativeConn) else 0
         body = json.dumps(desc, separators=(",", ":")).encode()
-        key = _register(done, fail, owner)
+        key = _register(done, self._flight_fail(fail), owner)
         rc = self._lib.sw_send_devpull(self._h, conn_id, tag, body, len(body),
                                        _on_done, _on_fail, key)
         if rc != 0:
@@ -642,7 +719,7 @@ class NativeWorkerBase:
         conn_id = conn.conn_id if isinstance(conn, NativeConn) else 0
         mv = memoryview(view)
         addr, keep = self._mv_pointer(mv)
-        key = _register(done, fail)
+        key = _register(done, self._flight_fail(fail))
         # The payload must outlive the op past local completion (rndv sends
         # stream after `done` fires); the engine's release callback is the
         # only thing allowed to drop this reference.
@@ -674,7 +751,8 @@ class NativeWorkerBase:
         addr, keep = self._mv_pointer(mv)
         # Slot 5 (user_done) lets a devpull claim complete the receive via
         # the device path instead of the staging-wrapped `done`.
-        key = _register(done, fail, mv, owner, keep, user_done)
+        key = _register(done, self._flight_fail(fail), mv, owner, keep,
+                        user_done)
         rc = self._lib.sw_recv(self._h, addr, len(mv), tag, mask, _on_recv,
                                _on_fail, key, _timeout_s(timeout))
         if rc != 0:
@@ -683,7 +761,7 @@ class NativeWorkerBase:
 
     def submit_flush(self, done, fail, conns=None, timeout=None) -> None:
         self._require_running()
-        key = _register(done, fail)
+        key = _register(done, self._flight_fail(fail))
         t = _timeout_s(timeout)
         if conns:
             conn_id = conns[0].conn_id if isinstance(conns[0], NativeConn) else 0
@@ -696,8 +774,14 @@ class NativeWorkerBase:
 
     def close(self, cb) -> None:
         self._require_running()
+        if self._faulted:
+            # Post-mortem snapshot before teardown (DESIGN.md §13).
+            swtrace.flight_dump("close-after-fault", self)
 
         def cb_devpull_cleanup(_cb=cb):
+            # Park the engine ring's final contents for post-close
+            # consumers; the handle stays valid until sw_free.
+            swtrace.retire(self)
             self._drop_devpull()
             if _cb is not None:
                 _cb()
@@ -757,19 +841,21 @@ class NativeWorkerBase:
         return "tcp"
 
     def evaluate_perf(self, conn, msg_size: int) -> float:
-        from .. import perf
-
         # Per-endpoint first (live-calibrated, perf.autocalibrate[_ep]),
         # transport-class model otherwise.
         return perf.conn_estimate(conn, self._perf_transport(conn), msg_size)
 
     def evaluate_perf_detail(self, conn, msg_size: int) -> dict:
-        from .. import perf
-
-        return perf.conn_estimate_detail(conn, self._perf_transport(conn),
-                                         msg_size)
+        detail = perf.conn_estimate_detail(conn, self._perf_transport(conn),
+                                           msg_size, scope=self.stage_scope)
+        detail["counters"] = self.counters_snapshot()
+        return detail
 
     def __del__(self):
+        try:
+            swtrace.retire(self)
+        except Exception:
+            pass
         try:
             self._drop_devpull()
         except Exception:

@@ -1,0 +1,306 @@
+"""swtrace: per-op lifecycle tracing, counter registry, flight recorder.
+
+Observability spine of the host runtime (DESIGN.md §13).  Three pieces,
+all spanning both engines:
+
+* **Trace ring** -- a bounded per-worker event buffer recording each op's
+  lifecycle (``recv_post`` -> ``recv_match`` -> ``recv_done``, ``send_post``
+  -> ``send_done``, flush barriers, failures, connection churn, and the
+  data-plane stage spans from perf.record_stage).  Opt-in via
+  ``STARWAY_TRACE=1`` (or implicitly when ``STARWAY_FLIGHT_DIR`` is set);
+  when off, every hot-path hook is a single ``is None`` check -- no per-op
+  allocation, no syscall (pinned by tests/test_trace.py's overhead guard).
+  Appends are single ``deque.append`` calls on a ``maxlen`` deque:
+  GIL-atomic and lock-free, safe from any thread, and -- unlike user
+  callbacks -- permitted while a worker lock is held (no user code runs).
+  The C++ engine records the same event vocabulary into its own ring
+  (native/sw_engine.cpp ``TraceRing``), surfaced through the ``sw_trace``
+  ABI call.
+
+* **Counter registry** -- the fixed ``COUNTER_NAMES`` vocabulary below,
+  implemented identically in core/engine.py (``Worker.counters``) and
+  native/sw_engine.cpp (``Counters`` + the ``sw_counters`` ABI call), and
+  merged into ``evaluate_perf_detail()["counters"]``.  The vocabulary is
+  part of the cross-engine contract: swcheck's ``contract-trace`` pass
+  diffs it (and the ``EV_*`` event types) against the C++ sources, so a
+  counter added to one engine only is a merge-gate finding.
+
+* **Flight recorder** -- on the first op failure with a non-cancel reason,
+  on engine emergency close, and on ``close()`` after a fault, the last-N
+  trace events plus a counter snapshot are dumped to a JSON file under
+  ``STARWAY_FLIGHT_DIR`` for post-mortem forensics (the fault paths of
+  DESIGN.md §10).  One dump per (worker, trigger); dump failures are
+  swallowed -- the recorder must never take the engine down with it.
+
+Export tooling lives in starway_tpu/trace.py (``python -m
+starway_tpu.trace``): ring/flight dumps -> Chrome ``trace_event`` JSON.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from pathlib import Path
+from typing import Optional
+
+from .. import config
+
+# ------------------------------------------------------ event vocabulary
+#
+# Shared with the C++ engine (native/sw_engine.cpp kEv* literals); the
+# mapping is mechanical (EV_SEND_POST <-> kEvSendPost) and machine-checked
+# by `python -m starway_tpu.analysis` (rule contract-trace).
+
+EV_SEND_POST = "send_post"    # tagged send (or DEVPULL descriptor) submitted
+EV_SEND_DONE = "send_done"    # send locally complete (eager: handed to
+#                               transport; rndv: transmission begun)
+EV_RECV_POST = "recv_post"    # receive posted on the worker
+EV_RECV_MATCH = "recv_match"  # receive claimed an inbound message (or vice
+#                               versa) in the matcher
+EV_RECV_DONE = "recv_done"    # receive delivered (tag = sender tag)
+EV_FLUSH_POST = "flush_post"  # delivery barrier submitted
+EV_FLUSH_DONE = "flush_done"  # barrier acknowledged by every target conn
+EV_OP_FAIL = "op_fail"        # any op failed; reason carried verbatim
+EV_CONN_UP = "conn_up"        # connection handshaken / attached
+EV_CONN_DOWN = "conn_down"    # connection broken (peer death / reset)
+EV_STAGE = "stage_span"       # data-plane stage span (perf.record_stage):
+#                               reason = stage name, dur = span seconds
+
+# ----------------------------------------------------- counter vocabulary
+#
+# One name list, two implementations (engine.py Worker.counters and the
+# C++ kCounterNames/Counters pair).  `staging_hits`/`staging_misses` and
+# `reconnects` are PROCESS-GLOBAL (the staging pool and the api-layer
+# reconnect loop are not per-worker); merge_global_counters overlays them
+# onto every worker snapshot so one dict answers "what happened here".
+
+COUNTER_NAMES = (
+    "sends_posted",       # tagged sends + DEVPULL descriptors submitted
+    "sends_completed",    # send payloads fully handed to a transport
+    "recvs_posted",       # receives posted
+    "recvs_completed",    # receives delivered
+    "flushes_posted",     # flush barriers submitted
+    "flushes_completed",  # flush barriers acknowledged
+    "ops_timed_out",      # ops failed by a deadline (REASON_TIMEOUT)
+    "ops_cancelled",      # ops cancelled by local close
+    "bytes_tx",           # payload/frame bytes handed to transports
+    "bytes_rx",           # payload/frame bytes read from transports
+    "gather_passes",      # gathered sendmsg passes (TX pump)
+    "gather_items",       # iovecs submitted across gathered passes
+    "staging_hits",       # staging-pool buffer reuses (process-global)
+    "staging_misses",     # staging-pool fresh allocations (process-global)
+    "ka_misses",          # peers declared dead by keepalive liveness
+    "reconnects",         # aconnect retry attempts (process-global)
+)
+
+
+class Counters:
+    """Fixed-vocabulary integer counters (one instance per worker, plus
+    the process-global ``GLOBAL``).  Plain attribute increments: writers
+    are effectively single-threaded per counter (submit counters on the
+    app thread, data-plane counters on the engine thread), so the
+    read-modify-write race window is theoretical; telemetry tolerates it.
+    """
+
+    __slots__ = COUNTER_NAMES
+
+    def __init__(self):
+        for name in COUNTER_NAMES:
+            setattr(self, name, 0)
+
+    def snapshot(self) -> dict:
+        return {name: getattr(self, name) for name in COUNTER_NAMES}
+
+
+#: Process-global counters (staging pool, api-layer reconnects).
+GLOBAL = Counters()
+
+_GLOBAL_NAMES = ("staging_hits", "staging_misses", "reconnects")
+
+
+def merge_global_counters(snap: dict) -> dict:
+    """Overlay the process-global counters onto a worker snapshot."""
+    for name in _GLOBAL_NAMES:
+        snap[name] = getattr(GLOBAL, name)
+    return snap
+
+
+# ------------------------------------------------------------ trace ring
+
+
+def active() -> bool:
+    """Tracing hooks armed for new workers?  True when ``STARWAY_TRACE``
+    is on or a flight directory is configured (the recorder needs the
+    ring's last-N events even when nobody asked for a full trace)."""
+    return config.trace_enabled() or bool(config.flight_dir())
+
+
+class TraceRing:
+    """Bounded per-worker event ring.
+
+    Events are ``(t, ev, tag, conn, nbytes, reason, dur)`` tuples with
+    ``t`` from ``time.perf_counter()`` (CLOCK_MONOTONIC -- the same epoch
+    the C++ ring stamps with ``steady_clock``, so one process's rings
+    share a timeline).  ``dur`` is nonzero only for EV_STAGE spans.
+    """
+
+    __slots__ = ("events",)
+
+    def __init__(self, capacity: int):
+        self.events: deque = deque(maxlen=max(16, int(capacity)))
+
+    def rec(self, ev: str, tag: int = 0, conn: int = 0, nbytes: int = 0,
+            reason: str = "", dur: float = 0.0) -> None:
+        self.events.append(
+            (time.perf_counter(), ev, tag, conn, nbytes, reason, dur))
+
+    def snapshot(self) -> list:
+        return list(self.events)
+
+
+def worker_ring() -> Optional[TraceRing]:
+    """A fresh ring for a new worker, or None when tracing is off (the
+    worker then carries no per-op hooks at all)."""
+    if not active():
+        return None
+    return TraceRing(config.trace_ring_size())
+
+
+def wrap_op(worker, ring: TraceRing, done_ev: str, tag: int, conn: int,
+            nbytes: int, done, fail):
+    """Wrap an op's (done, fail) callbacks to record its terminal event
+    (and arm the flight recorder on non-cancel failures).  Only called
+    when tracing is active -- the off path never allocates these closures.
+    """
+
+    def traced_done(*args):
+        if done_ev == EV_RECV_DONE and len(args) >= 2:
+            ring.rec(done_ev, args[0], conn, args[1])
+        else:
+            ring.rec(done_ev, tag, conn, nbytes)
+        if done is not None:
+            done(*args)
+
+    def traced_fail(reason: str):
+        ring.rec(EV_OP_FAIL, tag, conn, nbytes, reason)
+        if "cancel" not in reason.lower():
+            worker._faulted = True
+            flight_dump("op-failed", worker, reason)
+        if fail is not None:
+            fail(reason)
+
+    return traced_done, traced_fail
+
+
+# ---------------------------------------------------------- ring registry
+#
+# `python -m starway_tpu.bench --trace` (and the trace CLI) need every
+# ring the process produced, including workers already closed by the time
+# the report is written.  Live workers are held weakly; closed workers
+# snapshot their ring into a bounded retired list via retire().
+
+_reg_lock = threading.Lock()
+_live: list = []      # weakref.ref(worker)
+_retired: list = []   # {"worker": label, "events": [...]}
+_RETIRED_CAP = 64
+
+
+def register_worker(worker) -> None:
+    if not active():
+        return
+    with _reg_lock:
+        _live.append(weakref.ref(worker))
+        _live[:] = [r for r in _live if r() is not None]
+
+
+def retire(worker) -> None:
+    """Snapshot a closing worker's ring into the retired list so its
+    events survive the worker object (bench reports run after close)."""
+    if not active() or getattr(worker, "_trace_retired", False):
+        return
+    worker._trace_retired = True
+    try:
+        events = worker.trace_events()
+    except Exception:
+        events = []
+    if not events:
+        return
+    with _reg_lock:
+        _retired.append({"worker": worker.trace_label, "events": events})
+        del _retired[:-_RETIRED_CAP]
+
+
+def dump_all() -> list:
+    """``[{"worker": label, "events": [...]}, ...]`` for every traced
+    worker this process has seen (retired first, then live)."""
+    with _reg_lock:
+        out = list(_retired)
+        live = [r() for r in _live]
+    for w in live:
+        if w is None or getattr(w, "_trace_retired", False):
+            continue
+        try:
+            events = w.trace_events()
+        except Exception:
+            continue
+        if events:
+            out.append({"worker": w.trace_label, "events": events})
+    return out
+
+
+def reset() -> None:
+    """Drop registry state (test isolation)."""
+    with _reg_lock:
+        _live.clear()
+        _retired.clear()
+
+
+# -------------------------------------------------------- flight recorder
+
+_flight_seq = itertools.count(1)
+
+
+def flight_dump(trigger: str, worker, reason: str = "") -> Optional[Path]:
+    """Dump the worker's last-N trace events + counter snapshot to
+    ``STARWAY_FLIGHT_DIR`` (no-op when unset).  Once per (worker,
+    trigger); never raises -- forensics must not add failure modes."""
+    flight_dir = config.flight_dir()
+    if not flight_dir:
+        return None
+    trigs = getattr(worker, "_flight_trigs", None)
+    if trigs is None:
+        trigs = worker._flight_trigs = set()
+    if trigger in trigs:
+        return None
+    trigs.add(trigger)
+    try:
+        label = getattr(worker, "trace_label", "worker")
+        try:
+            events = worker.trace_events()
+        except Exception:
+            events = []
+        try:
+            counters = worker.counters_snapshot()
+        except Exception:
+            counters = {}
+        payload = {
+            "trigger": trigger,
+            "worker": label,
+            "reason": reason,
+            "pid": os.getpid(),
+            "time": time.time(),
+            "counters": counters,
+            "events": [list(e) for e in events],
+        }
+        out_dir = Path(flight_dir)
+        out_dir.mkdir(parents=True, exist_ok=True)
+        path = out_dir / f"flight-{label}-{os.getpid()}-{next(_flight_seq)}.json"
+        path.write_text(json.dumps(payload, indent=1))
+        return path
+    except Exception:
+        return None

@@ -40,10 +40,10 @@ from typing import Optional
 logger = logging.getLogger("starway_tpu")
 
 
-def _record_stage(name: str, seconds: float, nbytes: int) -> None:
+def _record_stage(name: str, seconds: float, nbytes: int, scope=None) -> None:
     from . import perf
 
-    perf.record_stage(name, seconds, nbytes)
+    perf.record_stage(name, seconds, nbytes, scope)
 
 
 def _np_dtype(dtype):
@@ -197,13 +197,17 @@ class _StagingPool:
     def get(self, nbytes: int):
         import numpy as np
 
+        from .core import swtrace
+
         with self._lock:
             bucket = self._buckets.get(nbytes)
             if bucket:
                 self._held -= nbytes
                 self.hits += 1
+                swtrace.GLOBAL.staging_hits += 1
                 return bucket.pop()
             self.misses += 1
+            swtrace.GLOBAL.staging_misses += 1
         return np.empty(nbytes, dtype=np.uint8)
 
     def put(self, arr) -> None:
@@ -303,12 +307,13 @@ class DevicePayload:
       just ``nbytes`` + ``host_chunk``.
     """
 
-    __slots__ = ("array", "nbytes", "_host_view", "_flat", "_chunk_elems",
-                 "_chunk_b", "_dev_chunks", "_host_chunks")
+    __slots__ = ("array", "nbytes", "scope", "_host_view", "_flat",
+                 "_chunk_elems", "_chunk_b", "_dev_chunks", "_host_chunks")
 
     def __init__(self, array):
         self.array = array
         self.nbytes = int(array.nbytes)
+        self.scope = None  # owning worker's perf.StageScope (send_device)
         self._host_view: Optional[memoryview] = None
         self._flat = None  # chunked mode state (see chunked())
         self._chunk_elems = 0
@@ -323,7 +328,8 @@ class DevicePayload:
             t0 = time.perf_counter()
             host = np.ascontiguousarray(np.asarray(self.array))
             self._host_view = memoryview(host).cast("B")
-            _record_stage("stage", time.perf_counter() - t0, self.nbytes)
+            _record_stage("stage", time.perf_counter() - t0, self.nbytes,
+                          self.scope)
         return self._host_view
 
     # ------------------------------------------------------- chunked D2H
@@ -379,7 +385,8 @@ class DevicePayload:
             t0 = time.perf_counter()
             host = np.ascontiguousarray(np.asarray(self._dev_chunks.pop(k)))
             view = memoryview(host).cast("B")
-            _record_stage("stage", time.perf_counter() - t0, len(view))
+            _record_stage("stage", time.perf_counter() - t0, len(view),
+                          self.scope)
             self._host_chunks[k] = view
             # The pump only moves forward: chunk k-1 is fully on the wire.
             self._host_chunks.pop(k - 1, None)
@@ -395,11 +402,12 @@ class DeviceRecvSink:
     later chunks are still on the wire, with one device-side concatenate
     at :meth:`finalize_from_host` (DESIGN.md §12)."""
 
-    __slots__ = ("devbuf", "_staging", "_staging_view", "_chunk_elems",
-                 "_chunk_b", "_placed", "_recyclable")
+    __slots__ = ("devbuf", "scope", "_staging", "_staging_view",
+                 "_chunk_elems", "_chunk_b", "_placed", "_recyclable")
 
     def __init__(self, devbuf: DeviceBuffer):
         self.devbuf = devbuf
+        self.scope = None  # owning worker's perf.StageScope (post_device_recv)
         self._staging = None
         self._staging_view: Optional[memoryview] = None
         self._chunk_elems = 0  # >0 = chunked placement armed
@@ -464,7 +472,7 @@ class DeviceRecvSink:
             placed = (jax.device_put(arr, self.devbuf.device)
                       if self.devbuf.device is not None else jax.device_put(arr))
         self._placed.append(placed)
-        _record_stage("place", time.perf_counter() - t0, nbytes)
+        _record_stage("place", time.perf_counter() - t0, nbytes, self.scope)
 
     def finalize_from_host(self, length: int) -> None:
         """Staged bytes fully arrived: view as dtype/shape, place on device."""
@@ -513,7 +521,7 @@ class DeviceRecvSink:
             arr = _copy_to_device(arr, dev, self.devbuf._plan)
         self.devbuf.array = arr
         self.devbuf.last_transport = "staged"
-        _record_stage("place", time.perf_counter() - t0, 0)
+        _record_stage("place", time.perf_counter() - t0, 0, self.scope)
 
     def accept_host(self, view, length: int) -> None:
         """Complete host bytes already in hand (in-process delivery, or an
@@ -540,7 +548,7 @@ class DeviceRecvSink:
             placed.block_until_ready()  # recv-complete = data resident
             self.devbuf.array = placed
             self.devbuf.last_transport = "staged"
-            _record_stage("place", time.perf_counter() - t0, length)
+            _record_stage("place", time.perf_counter() - t0, length, self.scope)
             return
         dev = self.devbuf.device
         platform = dev.platform if dev is not None else jax.local_devices()[0].platform
@@ -576,7 +584,7 @@ class DeviceRecvSink:
                       else jax.device_put(arr))
         self.devbuf.array = placed
         self.devbuf.last_transport = "staged"
-        _record_stage("place", time.perf_counter() - t0, length)
+        _record_stage("place", time.perf_counter() - t0, length, self.scope)
 
     def accept_device(self, array) -> None:
         """Direct device handoff (in-process path): HBM -> HBM over ICI when
@@ -867,6 +875,7 @@ def send_device(worker, conn, buffer, tag, done, fail):
         payload = DevicePayload(buffer.array)
     else:
         payload = DevicePayload(buffer)
+    payload.scope = getattr(worker, "stage_scope", None)
     if conn is not None and conn.kind == "inproc":
         worker.submit_send(conn, payload, tag, done, fail, payload)
         return
@@ -900,4 +909,5 @@ def post_device_recv(worker, buffer, tag, mask, done, fail):
     if not isinstance(buffer, DeviceBuffer):
         raise TypeError("device receives require a DeviceBuffer sink")
     sink = DeviceRecvSink(buffer)
+    sink.scope = getattr(worker, "stage_scope", None)
     worker.post_recv(sink, tag, mask, done, fail, owner=sink)

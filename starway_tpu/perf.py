@@ -38,16 +38,79 @@ import threading
 #   ``place`` -- host-to-device placement (H2D) on the receive side
 #
 # Recording is two perf_counter calls + one short lock per transport
-# syscall -- noise next to the syscall itself.  Consumers: bench.py's
-# metric string, the bench CLI's JSON report, and evaluate_perf_detail.
+# syscall -- noise next to the syscall itself.  Samples land twice: in the
+# recorder's :class:`StageScope` (per worker, so two concurrent clients --
+# or bench loopback's two roles -- never pollute each other's
+# ``evaluate_perf_detail()["stages"]``) and in the module-level aggregate
+# below (the whole-process view bench.py and the bench CLI report).
 
 _stage_lock = threading.Lock()
 _stages: dict[str, list] = {}  # name -> [count, seconds, bytes]
 
 
-def record_stage(name: str, seconds: float, nbytes: int = 0) -> None:
+class StageScope:
+    """Per-worker stage accumulator (same shape as the module aggregate).
+
+    ``ring`` optionally carries a core/swtrace.py TraceRing: each recorded
+    sample then also lands as an EV_STAGE span in the worker's trace, so
+    a bench run's Chrome export shows the stage timeline per op stream.
+    """
+
+    __slots__ = ("_lock", "_stages", "ring")
+
+    def __init__(self, ring=None):
+        self._lock = threading.Lock()
+        self._stages: dict[str, list] = {}
+        self.ring = ring
+
+    def record(self, name: str, seconds: float, nbytes: int = 0) -> None:
+        with self._lock:
+            acc = self._stages.get(name)
+            if acc is None:
+                self._stages[name] = [1, seconds, nbytes]
+            else:
+                acc[0] += 1
+                acc[1] += seconds
+                acc[2] += nbytes
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return _render_stages(self._stages)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stages.clear()
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile of an ascending sequence (stdlib-only) --
+    the one implementation both the driver bench and the bench CLI's
+    stage p-tiles report through."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, round(q / 100 * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _render_stages(stages: dict) -> dict:
+    out = {}
+    for name, (count, seconds, nbytes) in stages.items():
+        out[name] = {
+            "count": count,
+            "seconds": seconds,
+            "bytes": nbytes,
+            "gbps": (nbytes / seconds / 1e9) if seconds > 0 else 0.0,
+        }
+    return out
+
+
+def record_stage(name: str, seconds: float, nbytes: int = 0,
+                 scope: "StageScope | None" = None) -> None:
     """Accumulate one sample for pipeline stage ``name`` (thread-safe;
-    called from engine threads and the app thread alike)."""
+    called from engine threads and the app thread alike).  ``scope`` is
+    the recording worker's :class:`StageScope`; the module aggregate is
+    always updated too."""
     with _stage_lock:
         acc = _stages.get(name)
         if acc is None:
@@ -56,21 +119,21 @@ def record_stage(name: str, seconds: float, nbytes: int = 0) -> None:
             acc[0] += 1
             acc[1] += seconds
             acc[2] += nbytes
+    if scope is not None:
+        scope.record(name, seconds, nbytes)
+        ring = scope.ring
+        if ring is not None:
+            from .core import swtrace
+
+            ring.rec(swtrace.EV_STAGE, 0, 0, nbytes, name, seconds)
 
 
 def stage_snapshot() -> dict:
     """``{stage: {"count", "seconds", "bytes", "gbps"}}`` accumulated since
-    process start (or the last :func:`stage_reset`)."""
+    process start (or the last :func:`stage_reset`) -- the whole-process
+    aggregate; per-worker views live on ``Worker.stage_scope``."""
     with _stage_lock:
-        out = {}
-        for name, (count, seconds, nbytes) in _stages.items():
-            out[name] = {
-                "count": count,
-                "seconds": seconds,
-                "bytes": nbytes,
-                "gbps": (nbytes / seconds / 1e9) if seconds > 0 else 0.0,
-            }
-        return out
+        return _render_stages(_stages)
 
 
 def stage_reset() -> None:
@@ -129,7 +192,8 @@ def conn_estimate(conn, transport: str, msg_size: int) -> float:
     return conn_estimate_detail(conn, transport, msg_size)["seconds"]
 
 
-def estimate_detail(transport: str, msg_size: int) -> dict:
+def estimate_detail(transport: str, msg_size: int,
+                    scope: "StageScope | None" = None) -> dict:
     """:func:`estimate` with honesty attached: the model, whether it came
     from a live fit, and its provenance."""
     key = transport if transport in LINK_MODELS else "tcp"
@@ -141,14 +205,16 @@ def estimate_detail(transport: str, msg_size: int) -> dict:
         "transport": key,
         "calibrated": key in CALIBRATED,
         "source": PROVENANCE.get(key, "prior: unknown transport class"),
-        # Live per-stage pipeline timings observed by THIS process
-        # (stage/tx/rx/place -- see record_stage), so a model estimate and
-        # the measured data plane sit side by side.
-        "stages": stage_snapshot(),
+        # Live per-stage pipeline timings (stage/tx/rx/place -- see
+        # record_stage), so a model estimate and the measured data plane
+        # sit side by side.  Scoped to the querying worker when it passes
+        # its StageScope; the whole-process aggregate otherwise.
+        "stages": scope.snapshot() if scope is not None else stage_snapshot(),
     }
 
 
-def conn_estimate_detail(conn, transport: str, msg_size: int) -> dict:
+def conn_estimate_detail(conn, transport: str, msg_size: int,
+                         scope: "StageScope | None" = None) -> dict:
     """:func:`conn_estimate` with honesty attached (VERDICT r4 #5): a
     caller can tell a live per-endpoint fit from a class fit from a
     spec-sheet prior — confident numbers from uncalibrated constants are
@@ -164,9 +230,10 @@ def conn_estimate_detail(conn, transport: str, msg_size: int) -> dict:
             "calibrated": True,
             "source": "live per-endpoint fit (autocalibrate/"
                       "autocalibrate_ep over PROBE_TAG)",
-            "stages": stage_snapshot(),
+            "stages": (scope.snapshot() if scope is not None
+                       else stage_snapshot()),
         }
-    return estimate_detail(transport, msg_size)
+    return estimate_detail(transport, msg_size, scope=scope)
 
 
 async def _probe_samples(send, flush, sizes):

@@ -1,0 +1,169 @@
+"""swtrace export: ring / flight-recorder dumps -> Chrome ``trace_event``.
+
+``python -m starway_tpu.trace dump1.json [dump2.json ...] -o out.json``
+converts flight-recorder dumps (core/swtrace.py flight_dump) into one
+Chrome/Perfetto-loadable trace; ``python -m starway_tpu.bench --trace
+PATH`` uses :func:`write_chrome` directly on the live ring registry.
+
+Layout: one trace *process* per worker (pid = worker index, process_name
+metadata carries the worker label), one *thread* per connection (tid =
+conn id; tid 0 is the worker-wide track: posted receives are fan-in and
+have no conn until matched).  Op lifecycles render as complete ("X")
+spans -- ``send_post``..``send_done``, ``recv_post``..``recv_done``,
+``flush_post``..``flush_done``, with ``op_fail`` closing whichever op it
+matches -- stage spans (``stage_span`` events from perf.record_stage)
+as "X" spans of their measured duration, and everything unpaired
+(matches, connection churn) as instants.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import deque
+from pathlib import Path
+from typing import Iterable
+
+from .core import swtrace
+
+# POST event -> (span kind, terminal event)
+_POSTS = {
+    swtrace.EV_SEND_POST: "send",
+    swtrace.EV_RECV_POST: "recv",
+    swtrace.EV_FLUSH_POST: "flush",
+}
+_DONES = {
+    swtrace.EV_SEND_DONE: "send",
+    swtrace.EV_RECV_DONE: "recv",
+    swtrace.EV_FLUSH_DONE: "flush",
+}
+
+
+def _pop_start(open_spans: dict, kind: str, tag: int, fifo_fallback: bool):
+    """The matching open span for a terminal event: exact (kind, tag)
+    first; with ``fifo_fallback``, the oldest open span of that kind (a
+    wildcard receive completes with the SENDER's tag, which may differ
+    from the posted one).  Failure events carry the op's own posted tag,
+    so they match exactly or not at all -- a fallback there would close
+    an unrelated pending op's span."""
+    q = open_spans.get((kind, tag))
+    if q:
+        return q.popleft()
+    if not fifo_fallback:
+        return None
+    oldest_key, oldest = None, None
+    for (k, t), dq in open_spans.items():
+        if k != kind or not dq:
+            continue
+        if oldest is None or dq[0][0] < oldest[0]:
+            oldest_key, oldest = (k, t), dq[0]
+    if oldest_key is not None:
+        return open_spans[oldest_key].popleft()
+    return None
+
+
+def chrome_events(label: str, events: Iterable, pid: int) -> list:
+    """Chrome trace events for one worker's swtrace ring."""
+    out = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": label}}]
+    tids = set()
+    open_spans: dict = {}  # (kind, tag) -> deque[(ts_us, conn, nbytes)]
+    for t, ev, tag, conn, nbytes, reason, dur in events:
+        ts = t * 1e6
+        tids.add(conn)
+        if ev in _POSTS:
+            open_spans.setdefault((_POSTS[ev], tag), deque()).append(
+                (ts, conn, nbytes))
+        elif ev in _DONES or ev == swtrace.EV_OP_FAIL:
+            if ev == swtrace.EV_OP_FAIL:
+                # A failure terminates the op whose posted tag it carries
+                # (exact match only -- see _pop_start).
+                start = None
+                for kind in ("recv", "send", "flush"):
+                    start = _pop_start(open_spans, kind, tag,
+                                       fifo_fallback=False)
+                    if start is not None:
+                        break
+                name = f"FAIL tag={tag:#x}"
+            else:
+                kind = _DONES[ev]
+                start = _pop_start(open_spans, kind, tag,
+                                   fifo_fallback=(kind == "recv"))
+                name = f"{kind} tag={tag:#x}" if kind != "flush" else "flush"
+            if start is None:
+                out.append({"ph": "i", "name": name, "ts": ts, "pid": pid,
+                            "tid": conn, "s": "t",
+                            "args": {"nbytes": nbytes, "reason": reason}})
+                continue
+            ts0, conn0, nb0 = start
+            tid = conn or conn0
+            tids.add(tid)
+            out.append({"ph": "X", "name": name, "ts": ts0,
+                        "dur": max(0.0, ts - ts0), "pid": pid, "tid": tid,
+                        "args": {"nbytes": nbytes or nb0, "reason": reason}})
+        elif ev == swtrace.EV_STAGE:
+            out.append({"ph": "X", "name": reason or "stage",
+                        "ts": (t - dur) * 1e6, "dur": max(0.0, dur * 1e6),
+                        "pid": pid, "tid": conn, "cat": "stage",
+                        "args": {"nbytes": nbytes}})
+        else:  # recv_match, conn_up, conn_down, anything future
+            out.append({"ph": "i", "name": ev, "ts": ts, "pid": pid,
+                        "tid": conn, "s": "t",
+                        "args": {"tag": tag, "nbytes": nbytes}})
+    # Spans still open at dump time (ops pending when the ring was read).
+    for (kind, tag), dq in open_spans.items():
+        for ts0, conn0, nb0 in dq:
+            out.append({"ph": "i", "name": f"pending {kind} tag={tag:#x}",
+                        "ts": ts0, "pid": pid, "tid": conn0, "s": "t",
+                        "args": {"nbytes": nb0}})
+    for tid in sorted(tids):
+        out.append({"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                    "args": {"name": "worker" if tid == 0 else f"conn {tid}"}})
+    return out
+
+
+def to_chrome(dumps: Iterable[dict]) -> dict:
+    """``{"traceEvents": [...]}`` from ``[{"worker", "events"}, ...]``
+    dumps (the shape of swtrace.dump_all() and of flight-recorder files).
+    """
+    trace_events: list = []
+    for pid, dump in enumerate(dumps, start=1):
+        trace_events.extend(
+            chrome_events(dump.get("worker", f"worker-{pid}"),
+                          dump.get("events", []), pid))
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def write_chrome(dumps: Iterable[dict], path) -> Path:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(to_chrome(dumps), indent=1))
+    return path
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m starway_tpu.trace",
+        description="Convert swtrace flight-recorder dumps to Chrome "
+                    "trace_event JSON (open in Perfetto / chrome://tracing).")
+    p.add_argument("inputs", nargs="+", type=Path,
+                   help="flight-recorder JSON dumps (STARWAY_FLIGHT_DIR)")
+    p.add_argument("-o", "--output", type=Path, default=Path("swtrace.json"))
+    args = p.parse_args(argv)
+    dumps = []
+    for path in args.inputs:
+        raw = json.loads(path.read_text())
+        if "events" not in raw:
+            print(f"{path}: not a swtrace dump (no 'events' key)",
+                  file=sys.stderr)
+            return 1
+        dumps.append(raw)
+    out = write_chrome(dumps, args.output)
+    n = sum(len(d.get("events", [])) for d in dumps)
+    print(f"wrote {out} ({n} events from {len(dumps)} dump(s))")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

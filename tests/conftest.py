@@ -48,6 +48,12 @@ def port() -> int:
     return free_port()
 
 
+@pytest.fixture
+def port2() -> int:
+    """A second independent listener port (two-pair tests)."""
+    return free_port()
+
+
 # Minimal asyncio test support (pytest-asyncio is not available in the image):
 # coroutine test functions run under asyncio.run, mirroring the reference's
 # module-wide `pytestmark = pytest.mark.asyncio` setup.

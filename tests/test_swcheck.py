@@ -183,8 +183,8 @@ def test_garbled_doc_table(tmp_path):
 def test_version_drift(tmp_path):
     root = _seed(tmp_path)
     _edit(root, "native/sw_engine.cpp",
-          'return "starway-native-3"', 'return "starway-native-4"')
-    _assert_caught(root, "contract-version", "starway-native-4", "sw_engine.h")
+          'return "starway-native-4"', 'return "starway-native-5"')
+    _assert_caught(root, "contract-version", "starway-native-5", "sw_engine.h")
 
 
 def test_unmarked_multi_gib_test(tmp_path):
@@ -302,6 +302,43 @@ def test_parametrized_multi_gib_payload_is_caught(tmp_path):
         "    assert bytearray(size)\n"
     )
     _assert_caught(root, "marker-slow", "test_param_big", "test_seeded_param.py")
+
+
+# ---------------------------------------------------- swtrace vocabulary
+
+
+def test_counter_added_to_one_engine_only(tmp_path):
+    # ISSUE 4 satellite: the counter-name vocabulary is contract surface;
+    # renaming (= adding/removing) a counter in the C++ array alone must
+    # fire on BOTH sides of the diff.
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp", '"bytes_tx",', '"bytes_tx_v2",')
+    _assert_caught(root, "contract-trace", "bytes_tx_v2", "sw_engine.cpp")
+    _assert_caught(root, "contract-trace", "'bytes_tx'", "swtrace.py")
+
+
+def test_counter_added_to_python_only(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/swtrace.py",
+          '"reconnects",         # ', '"reconnects",\n    "rebalances",  # ')
+    _assert_caught(root, "contract-trace", "rebalances", "swtrace.py")
+
+
+def test_trace_event_value_drift(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/swtrace.py",
+          'EV_SEND_POST = "send_post"', 'EV_SEND_POST = "send_posted"')
+    _assert_caught(root, "contract-trace", "EV_SEND_POST", "swtrace.py")
+
+
+def test_trace_event_only_in_cpp(tmp_path):
+    root = _seed(tmp_path)
+    p = root / "native" / "sw_engine.cpp"
+    p.write_text(p.read_text().replace(
+        'const char* kEvConnDown = "conn_down";',
+        'const char* kEvConnDown = "conn_down";\n'
+        'const char* kEvRetry = "retry";', 1))
+    _assert_caught(root, "contract-trace", "kEvRetry", "sw_engine.cpp")
 
 
 # ----------------------------------------------------------- hotpath pass

@@ -1,0 +1,369 @@
+"""swtrace tests (DESIGN.md §13): per-op lifecycle tracing, the counter
+registry, the flight recorder, and the tracing-off overhead guard.
+
+Covers BOTH engines where they implement the surface (the trace ring and
+counter registry live in core/engine.py and native/sw_engine.cpp; the
+flight recorder and stage scopes live in the Python wrapper layer either
+way), plus mixed-engine counter parity over real sockets.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from starway_tpu import Client, DeviceBuffer, Server, perf
+from starway_tpu.core import swtrace
+from starway_tpu.testing.faults import FaultProxy
+
+pytestmark = pytest.mark.asyncio
+
+ADDR = "127.0.0.1"
+MASK = (1 << 64) - 1
+
+
+def _native_available() -> bool:
+    from starway_tpu.core import native
+
+    return native.available()
+
+
+def _env(monkeypatch, *, native: bool, trace: bool = True, flight=None):
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if native else "0")
+    monkeypatch.setenv("STARWAY_DEVPULL", "0")
+    if trace:
+        monkeypatch.setenv("STARWAY_TRACE", "1")
+    else:
+        monkeypatch.delenv("STARWAY_TRACE", raising=False)
+    if flight is not None:
+        monkeypatch.setenv("STARWAY_FLIGHT_DIR", str(flight))
+    else:
+        monkeypatch.delenv("STARWAY_FLIGHT_DIR", raising=False)
+    swtrace.reset()
+
+
+async def _pair(port):
+    server = Server()
+    client = Client()
+    server.listen(ADDR, port)
+    await client.aconnect(ADDR, port)
+    for _ in range(200):
+        if server.list_clients():
+            break
+        await asyncio.sleep(0.005)
+    return server, client, server.list_clients().pop()
+
+
+def _first_index(events, ev_name):
+    for i, e in enumerate(events):
+        if e[1] == ev_name:
+            return i
+    return None
+
+
+# ------------------------------------------------------ lifecycle ordering
+
+
+@pytest.mark.parametrize("engine", ["python", "native"])
+async def test_lifecycle_event_order(port, monkeypatch, engine):
+    """posted -> matched -> completed on the receiving worker and
+    send_post -> send_done, flush_post -> flush_done on the sender, in
+    ring order, on BOTH engines."""
+    if engine == "native" and not _native_available():
+        pytest.skip("native engine unavailable")
+    _env(monkeypatch, native=(engine == "native"))
+    server, client, _ep = await _pair(port)
+    try:
+        buf = np.empty(1024, dtype=np.uint8)
+        recv_fut = server.arecv(buf, 0x77, MASK)
+        await asyncio.sleep(0.05)  # recv posted before the send arrives
+        await client.asend(np.ones(1024, dtype=np.uint8), 0x77)
+        tag, length = await recv_fut
+        assert (tag, length) == (0x77, 1024)
+        await client.aflush()
+
+        sev = server._server.trace_events()
+        cev = client._client.trace_events()
+        order = [_first_index(sev, name) for name in
+                 ("recv_post", "recv_match", "recv_done")]
+        assert None not in order, sev
+        assert order == sorted(order), (
+            f"recv lifecycle out of order: {[(e[1], e[2]) for e in sev]}")
+        # Event payloads: tag + nbytes ride along.
+        match = sev[order[1]]
+        assert match[2] == 0x77 and match[4] == 1024, match
+        corder = [_first_index(cev, name) for name in
+                  ("send_post", "send_done", "flush_post", "flush_done")]
+        assert None not in corder, cev
+        assert corder == sorted(corder), (
+            f"send lifecycle out of order: {[(e[1], e[2]) for e in cev]}")
+        assert cev[corder[0]][2] == 0x77 and cev[corder[0]][4] == 1024
+        assert _first_index(cev, "conn_up") is not None
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+# ------------------------------------------------------- counter registry
+
+
+async def test_counter_parity_mixed_engine_interop(port, monkeypatch):
+    """Native client <-> Python server over real sockets: both expose the
+    identical COUNTER_NAMES vocabulary with matching op accounting."""
+    if not _native_available():
+        pytest.skip("native engine unavailable")
+    _env(monkeypatch, native=False, trace=False)
+    server = Server()
+    server.listen(ADDR, port)
+    monkeypatch.setenv("STARWAY_NATIVE", "1")
+    client = Client()
+    from starway_tpu.core.native import NativeClientWorker
+
+    assert isinstance(client._client, NativeClientWorker)
+    await client.aconnect(ADDR, port)
+    try:
+        n_ops, nbytes = 8, 4096
+        sinks = [np.empty(nbytes, dtype=np.uint8) for _ in range(n_ops)]
+        recv_futs = [server.arecv(b, 0x500 + i, MASK)
+                     for i, b in enumerate(sinks)]
+        await asyncio.sleep(0.05)
+        payloads = [np.full(nbytes, i + 1, dtype=np.uint8)
+                    for i in range(n_ops)]
+        await asyncio.gather(
+            *(client.asend(p, 0x500 + i) for i, p in enumerate(payloads)))
+        await asyncio.gather(*recv_futs)
+        await client.aflush()
+
+        cs = client._client.counters_snapshot()
+        ss = server._server.counters_snapshot()
+        # One vocabulary, both engines (enforced statically by swcheck's
+        # contract-trace rule; exercised live here).
+        assert set(cs) == set(ss) == set(swtrace.COUNTER_NAMES)
+        assert cs["sends_posted"] == n_ops
+        assert cs["sends_completed"] == n_ops
+        assert cs["bytes_tx"] >= n_ops * nbytes
+        assert cs["flushes_posted"] == 1 and cs["flushes_completed"] == 1
+        assert ss["recvs_posted"] == n_ops
+        assert ss["recvs_completed"] == n_ops
+        assert ss["bytes_rx"] >= n_ops * nbytes
+        assert cs["gather_passes"] >= 1 and cs["gather_items"] >= 1
+        # ...and they surface through evaluate_perf_detail on both sides.
+        assert client.evaluate_perf_detail(1024)["counters"] == \
+            client._client.counters_snapshot()
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+async def test_stage_scope_per_worker(port, port2, monkeypatch):
+    """Satellite fix: stage telemetry is scoped per worker -- a second
+    idle client pair no longer sees the first pair's tx/rx samples in its
+    evaluate_perf_detail()["stages"]; the module API stays an aggregate."""
+    _env(monkeypatch, native=False, trace=False)
+    s1, c1, _ = await _pair(port)
+    s2, c2, _ = await _pair(port2)
+    try:
+        perf.stage_reset()
+        sink = np.empty(64 * 1024, dtype=np.uint8)
+        fut = s1.arecv(sink, 9, MASK)
+        await asyncio.sleep(0.05)
+        await c1.asend(np.ones(64 * 1024, dtype=np.uint8), 9)
+        await fut
+        await c1.aflush()
+        busy = c1.evaluate_perf_detail(1 << 20)["stages"]
+        idle = c2.evaluate_perf_detail(1 << 20)["stages"]
+        assert busy.get("tx", {}).get("count", 0) > 0, busy
+        assert idle.get("tx", {}).get("count", 0) == 0, (
+            f"idle client polluted by the busy pair's samples: {idle}")
+        # Module-level aggregate still sees the whole process.
+        assert perf.stage_snapshot().get("tx", {}).get("count", 0) > 0
+    finally:
+        for h in (c1, c2, s1, s2):
+            await h.aclose()
+
+
+# -------------------------------------------------------- flight recorder
+
+
+@pytest.mark.parametrize("mode", ["drop", "truncate"])
+async def test_flight_recorder_on_fault(port, monkeypatch, tmp_path, mode):
+    """A FaultProxy-killed connection fails the flush with a non-cancel
+    reason; the flight recorder dumps events + counters to
+    STARWAY_FLIGHT_DIR (drop = RST mid-frame, truncate = clean EOF
+    mid-frame)."""
+    flight = tmp_path / "flight"
+    _env(monkeypatch, native=False, flight=flight)
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port, mode=mode, limit_bytes=8 * 1024).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        # Bigger than the proxy's byte budget: the conn dies mid-frame.
+        await client.asend(np.ones(64 * 1024, dtype=np.uint8), 5)
+        with pytest.raises(Exception) as err:
+            # The dead conn fails the barrier; the timeout backstops the
+            # case where the kill lands before the flush frame (both
+            # reasons are non-cancel -> the recorder must trigger).
+            await client.aflush(timeout=5.0)
+        assert "cancel" not in str(err.value).lower()
+        dumps = sorted(flight.glob("flight-*.json"))
+        assert dumps, "no flight-recorder dump written"
+        payload = json.loads(dumps[0].read_text())
+        assert payload["trigger"] == "op-failed"
+        assert set(payload["counters"]) == set(swtrace.COUNTER_NAMES)
+        evs = [e[1] for e in payload["events"]]
+        assert "send_post" in evs and "op_fail" in evs, evs
+        n_before = len(list(flight.glob("flight-*.json")))
+    finally:
+        await client.aclose()
+        await server.aclose()
+        proxy.stop()
+    # aclose after the fault adds the close-time snapshot.
+    assert len(list(flight.glob("flight-*.json"))) > n_before
+    triggers = {json.loads(p.read_text())["trigger"]
+                for p in flight.glob("flight-*.json")}
+    assert "close-after-fault" in triggers, triggers
+
+
+async def test_flight_recorder_native_fault(port, monkeypatch, tmp_path):
+    """Native-engine path: the wrapper's fail hook triggers the dump with
+    the engine's own sw_trace events inside."""
+    if not _native_available():
+        pytest.skip("native engine unavailable")
+    flight = tmp_path / "flight"
+    _env(monkeypatch, native=True, flight=flight)
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port, mode="drop", limit_bytes=8 * 1024).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await client.asend(np.ones(64 * 1024, dtype=np.uint8), 5)
+        with pytest.raises(Exception) as err:
+            await client.aflush(timeout=5.0)
+        assert "cancel" not in str(err.value).lower()
+        dumps = sorted(flight.glob("flight-*.json"))
+        assert dumps, "no flight-recorder dump written"
+        payload = json.loads(dumps[0].read_text())
+        assert payload["trigger"] == "op-failed"
+        assert any(e[1] == "send_post" for e in payload["events"]), (
+            "native sw_trace events missing from the dump")
+    finally:
+        await client.aclose()
+        await server.aclose()
+        proxy.stop()
+
+
+# -------------------------------------------------------- overhead guard
+
+
+async def test_tracing_off_hot_path_is_dark(port, monkeypatch):
+    """With STARWAY_TRACE and STARWAY_FLIGHT_DIR unset, workers carry no
+    ring and the per-op path never touches the tracing subsystem: no ring
+    append, no wrapper closure, no flight I/O -- no per-op allocation or
+    syscall from swtrace (the acceptance bar for the off path)."""
+    _env(monkeypatch, native=False, trace=False)
+    server, client, _ep = await _pair(port)
+    try:
+        assert client._client._trace is None
+        assert server._server._trace is None
+
+        def boom(*a, **k):
+            raise AssertionError("swtrace hot-path hook ran with tracing off")
+
+        monkeypatch.setattr(swtrace.TraceRing, "rec", boom)
+        monkeypatch.setattr(swtrace, "wrap_op", boom)
+        monkeypatch.setattr(swtrace, "flight_dump", boom)
+        sinks = [np.empty(512, dtype=np.uint8) for _ in range(8)]
+        futs = [server.arecv(b, 0x40 + i, MASK) for i, b in enumerate(sinks)]
+        await asyncio.sleep(0.05)
+        await asyncio.gather(*(client.asend(np.full(512, i, dtype=np.uint8),
+                                            0x40 + i) for i in range(8)))
+        await asyncio.gather(*futs)
+        await client.aflush()
+        # Counters still accumulate (plain int adds, no allocation).
+        cs = client._client.counters_snapshot()
+        assert cs["sends_posted"] == 8 and cs["sends_completed"] == 8
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+# ---------------------------------------------------------- chrome export
+
+
+async def test_chrome_export_spans_per_conn(port, monkeypatch, tmp_path):
+    """A traced run exports well-formed Chrome trace_event JSON: every
+    event carries name/ph/ts/pid/tid, op lifecycles render as complete
+    spans, and send spans land on the connection's track."""
+    from starway_tpu import trace as trace_mod
+
+    _env(monkeypatch, native=False)
+    server, client, _ep = await _pair(port)
+    try:
+        sink = np.empty(2048, dtype=np.uint8)
+        fut = server.arecv(sink, 3, MASK)
+        await asyncio.sleep(0.05)
+        await client.asend(np.ones(2048, dtype=np.uint8), 3)
+        await fut
+        await client.aflush()
+    finally:
+        await client.aclose()
+        await server.aclose()
+    dumps = swtrace.dump_all()
+    assert len(dumps) >= 2, [d["worker"] for d in dumps]
+    out = trace_mod.write_chrome(dumps, tmp_path / "trace.json")
+    doc = json.loads(out.read_text())
+    events = doc["traceEvents"]
+    assert events
+    for e in events:
+        assert {"name", "ph", "pid", "tid"} <= set(e), e
+        if e["ph"] != "M":
+            assert "ts" in e and e["ts"] >= 0, e
+        if e["ph"] == "X":
+            assert e["dur"] >= 0, e
+    spans = [e for e in events if e["ph"] == "X"]
+    assert any(e["name"].startswith("send tag=") for e in spans), spans
+    assert any(e["name"].startswith("recv tag=") for e in spans), spans
+    # Send spans sit on the conn's track (tid != 0), per-conn layout.
+    assert any(e["tid"] != 0 for e in spans
+               if e["name"].startswith("send tag=")), spans
+    # The CLI converts flight-style dumps to the same format.
+    dump_file = tmp_path / "ring.json"
+    dump_file.write_text(json.dumps(
+        {"worker": "w", "events": [list(ev) for ev in dumps[0]["events"]]}))
+    rc = trace_mod.main([str(dump_file), "-o", str(tmp_path / "cli.json")])
+    assert rc == 0
+    assert json.loads((tmp_path / "cli.json").read_text())["traceEvents"]
+
+
+async def test_device_payload_stage_spans_in_trace(port, monkeypatch):
+    """Device-plane transfers record stage spans (D2H 'stage', H2D
+    'place') into the owning worker's ring via its StageScope."""
+    import jax
+
+    _env(monkeypatch, native=False)
+    monkeypatch.setenv("STARWAY_CHUNK", str(64 * 1024))
+    server, client, _ep = await _pair(port)
+    try:
+        src = jax.device_put(jnp.arange(64 * 1024, dtype=jnp.float32),
+                             jax.devices()[0])
+        sink = DeviceBuffer((64 * 1024,), jnp.float32, device=jax.devices()[1])
+        fut = server.arecv(sink, 21, MASK)
+        await asyncio.sleep(0.05)
+        await client.asend(src, 21)
+        await fut
+        cli_stages = {e[5] for e in client._client.trace_events()
+                      if e[1] == swtrace.EV_STAGE}
+        srv_stages = {e[5] for e in server._server.trace_events()
+                      if e[1] == swtrace.EV_STAGE}
+        assert "stage" in cli_stages, cli_stages   # D2H on the sender
+        assert "place" in srv_stages, srv_stages   # H2D on the receiver
+    finally:
+        await client.aclose()
+        await server.aclose()
