@@ -81,6 +81,9 @@ constexpr uint8_t T_FLUSH_ACK = 5;
 constexpr uint8_t T_DEVPULL = 6;  // negotiated PJRT-pull descriptor (frames.py)
 constexpr uint8_t T_PING = 7;     // negotiated peer-liveness probe (frames.py)
 constexpr uint8_t T_PONG = 8;
+constexpr uint8_t T_SEQ = 9;      // session layer: next frame's sequence number
+constexpr uint8_t T_ACK = 10;     // session layer: cumulative received seq
+constexpr uint8_t T_BYE = 11;     // session layer: peer's clean local close
 constexpr size_t HEADER_SIZE = 17;
 
 constexpr int ST_VOID = 0, ST_INIT = 1, ST_RUNNING = 2, ST_CLOSING = 3, ST_CLOSED = 4;
@@ -89,6 +92,7 @@ const char* kCancelled = "Operation cancelled (local endpoint closed before comp
 const char* kNotConnected = "Endpoint is not connected";
 const char* kTruncated = "Message truncated: payload larger than posted receive buffer";
 const char* kTimedOut = "Operation timed out (deadline exceeded before completion)";
+const char* kSessionExpired = "Session expired (resume window elapsed or peer restarted)";
 
 using Clock = std::chrono::steady_clock;
 
@@ -113,6 +117,8 @@ const char* kEvConnUp = "conn_up";
 const char* kEvConnDown = "conn_down";
 [[maybe_unused]] const char* kEvStage = "stage_span";  // recorded by the
 //               Python data plane only; declared for vocabulary parity
+const char* kEvSessResume = "sess_resume";
+const char* kEvSessExpire = "sess_expire";
 
 // Counter vocabulary, same order as the Counters fields and the values
 // array in sw_counters() below (and as core/swtrace.py COUNTER_NAMES).
@@ -128,6 +134,9 @@ const char* kCounterNames[] = {
     "gather_passes",     "gather_items",
     "staging_hits",      "staging_misses",
     "ka_misses",         "reconnects",
+    "sessions_resumed",  "frames_replayed",
+    "dup_frames_dropped",
+    "acks_tx",           "acks_rx",
 };
 
 struct Counters {
@@ -139,6 +148,9 @@ struct Counters {
   std::atomic<uint64_t> gather_passes{0}, gather_items{0};
   std::atomic<uint64_t> staging_hits{0}, staging_misses{0};  // wrapper-owned
   std::atomic<uint64_t> ka_misses{0}, reconnects{0};         // reconnects: wrapper
+  std::atomic<uint64_t> sessions_resumed{0}, frames_replayed{0};
+  std::atomic<uint64_t> dup_frames_dropped{0};
+  std::atomic<uint64_t> acks_tx{0}, acks_rx{0};
 };
 
 inline void bump(std::atomic<uint64_t>& c, uint64_t n = 1) {
@@ -230,6 +242,26 @@ int ka_misses_env() {
   const char* e = getenv("STARWAY_KEEPALIVE_MISSES");
   int v = e ? atoi(e) : 3;
   return v > 0 ? v : 3;
+}
+
+// Resilient-session knobs (config.py STARWAY_SESSION*).  Off by default:
+// seed parity is "a dropped conn cancels every in-flight op".  Read per
+// handshake, like sm_enabled().
+bool session_enabled() {
+  const char* e = getenv("STARWAY_SESSION");
+  return e && *e && strcmp(e, "0") != 0;
+}
+
+uint64_t session_journal_bytes_env() {
+  const char* e = getenv("STARWAY_SESSION_JOURNAL_BYTES");
+  uint64_t v = e ? strtoull(e, nullptr, 10) : (uint64_t)(16u << 20);
+  return v < 4096 ? 4096 : v;
+}
+
+double session_grace_env() {
+  const char* e = getenv("STARWAY_SESSION_GRACE");
+  double s = e ? strtod(e, nullptr) : 0.0;
+  return s > 0 ? s : 30.0;
 }
 
 // ------------------------------------------------------- shared-memory rings
@@ -809,17 +841,52 @@ struct TxItem {
   // writing to the socket, TX flips to the ring -- items queued behind it
   // ride the ring even while this one is still draining.
   bool switch_after = false;
+  // --- session layer (Conn::sess) ---
+  bool counted = false;       // sends_completed recorded (replay can't re-count)
+  uint64_t sess_seq = 0;      // sequence number (0 = unframed)
+  uint64_t sess_nbytes = 0;   // journal accounting (prefix + header + payload)
+  std::vector<uint8_t> owned; // eager payload snapshot (the user may reuse
+  //                             the buffer once done fires; a replay must
+  //                             resend the originally-promised bytes)
+  bool hold_release = false;  // rndv payload pinned until the peer ACKs
 
   uint64_t total() const { return header.size() + paylen; }
 };
 
-void fire_release(TxItem& item, FireList& fires) {
-  if (item.is_data && item.release) {
+using TxRef = std::shared_ptr<TxItem>;
+
+// `force` overrides a session journal's payload pin (hold_release):
+// teardown paths are terminal, so the buffer is released regardless.
+void fire_release(TxItem& item, FireList& fires, bool force = false) {
+  if (item.is_data && item.release && (force || !item.hold_release)) {
     auto rel = item.release; auto rctx = item.release_ctx;
     item.release = nullptr;
     fires.push_back([rel, rctx] { rel(rctx); });
   }
 }
+
+// Resilient-session state (the C++ twin of core/session.py SessionState):
+// everything that must survive a connection incarnation.  Negotiated via
+// the "sess"/"sess_id"/"sess_epoch"/"sess_ack" handshake keys; wire half
+// is T_SEQ/T_ACK (frames.py).  See DESIGN.md §14.
+struct Session {
+  std::string id, epoch;
+  uint64_t journal_cap = 16u << 20;
+  double grace = 30.0;
+  // tx
+  uint64_t tx_seq = 0;
+  std::deque<TxRef> journal;  // framed, unacked items in seq order
+  uint64_t journal_bytes = 0;
+  std::deque<TxRef> waiting;  // unframed items parked by backpressure
+  uint64_t peer_acked = 0;
+  // rx
+  uint64_t rx_cum = 0;     // highest in-order seq fully processed
+  uint64_t acked_sent = 0; // last cumulative ACK put on the wire
+  // lifecycle
+  bool suspended = false, expired = false;
+  Clock::time_point deadline{};  // resume deadline while suspended
+  int attempt = 0;               // client redial backoff counter
+};
 
 struct Conn {
   uint64_t id = 0;
@@ -830,7 +897,15 @@ struct Conn {
   std::string peer_name, mode = "socket";
   std::string local_addr, remote_addr;
   int local_port = 0, remote_port = 0;
-  std::deque<TxItem> tx;
+  std::deque<TxRef> tx;
+  // session layer (nullptr on seed-parity conns: every hook below is one
+  // null check)
+  std::unique_ptr<Session> sess;
+  uint64_t sess_pending = 0;   // seq announced by the last T_SEQ
+  bool sess_drop = false;      // next frame is a duplicate: drain + drop
+  uint64_t rx_skip = 0;        // dup-frame payload bytes left to drain
+  bool sess_ack_armed = false; // idle ACK timer outstanding
+  const char* sess_fail = nullptr;  // flush-failure override at expiry
   // rx parser
   uint8_t hdr[HEADER_SIZE];
   size_t hdr_got = 0;
@@ -871,7 +946,7 @@ struct Conn {
 
   bool has_unfinished_data() const {
     for (auto& t : tx)
-      if (t.is_data && t.off < t.total()) return true;
+      if (t->is_data && t->off < t->total()) return true;
     return false;
   }
 
@@ -883,7 +958,7 @@ struct Conn {
     seg->unlink();
     if (!defer_tx) {
       if (tx.empty()) tx_via_ring = true;
-      else tx.back().switch_after = true;  // pre-switch items drain first
+      else tx.back()->switch_after = true;  // pre-switch items drain first
     }
   }
 
@@ -936,7 +1011,10 @@ struct Op {
 // as a no-op (the cookie matches nothing).
 struct Timer {
   Clock::time_point when;
-  enum Kind { SEND, RECV, FLUSH } kind;
+  // SESS_* timers carry a conn id (not an op cookie) in ctx: the idle
+  // cumulative-ACK flush, the session grace deadline, and the client's
+  // backoff redial tick (DESIGN.md §14).
+  enum Kind { SEND, RECV, FLUSH, SESS_ACK, SESS_GRACE, SESS_REDIAL } kind;
   void* ctx = nullptr;
 };
 
@@ -944,6 +1022,13 @@ struct Worker {
   std::mutex mu;
   std::atomic<int> status{ST_VOID};
   std::atomic<int> refs{1};  // python handle; engine thread takes one more
+  // Resilient sessions (DESIGN.md §14): sess_id -> conn.  Server side:
+  // suspended conns wait here for the peer's resume dial (sess_hello).
+  std::unordered_map<std::string, Conn*> sessions;
+  // Engine-event callback (sw_set_event_cb): session resume/expiry
+  // notifications for the wrapper's flight recorder.
+  sw_event_cb event_cb = nullptr;
+  void* event_cb_ctx = nullptr;
   // swtrace observability (DESIGN.md §13): counters always live (relaxed
   // atomics); the trace ring armed per worker at creation (env knobs).
   Counters counters;
@@ -970,6 +1055,12 @@ struct Worker {
   sw_accept_cb accept_cb = nullptr;
   void* accept_ctx = nullptr;
   std::unordered_set<Conn*> half_open;
+  // Accept wrappers consumed by a session resume (sess_hello moved their
+  // socket onto the suspended conn).  Deleted at the end of the event-loop
+  // pass -- the pump that delivered the HELLO still holds the pointer, and
+  // parking them in half_open until worker close would leak one Conn per
+  // resume on a long-lived server (the Python engine's wrapper just GCs).
+  std::vector<Conn*> sess_reap;
   // devpull extension (sw_engine.h)
   bool devpull_advertise = false;
   sw_devpull_cb devpull_cb = nullptr;
@@ -1036,31 +1127,41 @@ struct Worker {
     }
     c->dirty = true;
     c->data_counter++;
-    TxItem item;
-    item.header.resize(HEADER_SIZE);
-    pack_header(item.header.data(), T_DATA, op.tag, op.len);
-    item.payload = op.buf;
-    item.paylen = op.len;
-    item.is_data = true;
-    item.rndv = op.len > rndv_threshold();
-    item.done = op.done;
-    item.fail = op.fail;
-    item.ctx = op.ctx;
-    item.release = op.release;
-    item.release_ctx = op.release_ctx;
+    auto item = std::make_shared<TxItem>();
+    item->header.resize(HEADER_SIZE);
+    pack_header(item->header.data(), T_DATA, op.tag, op.len);
+    item->payload = op.buf;
+    item->paylen = op.len;
+    item->is_data = true;
+    item->rndv = op.len > rndv_threshold();
+    item->done = op.done;
+    item->fail = op.fail;
+    item->ctx = op.ctx;
+    item->release = op.release;
+    item->release_ctx = op.release_ctx;
+    if (c->sess) {
+      sess_submit(c, item, fires);
+      return;
+    }
     c->tx.push_back(std::move(item));
     kick_tx(c, fires);
   }
 
   void conn_send_ctl(Conn* c, uint8_t type, uint64_t a, uint64_t b,
                      const std::string& body, FireList& fires,
-                     bool switch_after = false) {
+                     bool switch_after = false, bool sess_frame = false) {
     if (!c->alive) return;
-    TxItem item;
-    item.header.resize(HEADER_SIZE + body.size());
-    pack_header(item.header.data(), type, a, b);
-    if (!body.empty()) memcpy(item.header.data() + HEADER_SIZE, body.data(), body.size());
-    item.switch_after = switch_after;
+    auto item = std::make_shared<TxItem>();
+    item->header.resize(HEADER_SIZE + body.size());
+    pack_header(item->header.data(), type, a, b);
+    if (!body.empty()) memcpy(item->header.data() + HEADER_SIZE, body.data(), body.size());
+    item->switch_after = switch_after;
+    if (sess_frame && c->sess) {
+      // FLUSH / FLUSH_ACK are sequenced session frames: a barrier (or its
+      // ack) lost with a conn must replay, or the peer's flush hangs.
+      sess_submit(c, item, fires);
+      return;
+    }
     c->tx.push_back(std::move(item));
     kick_tx(c, fires);
   }
@@ -1077,16 +1178,544 @@ struct Worker {
     // pulled payload (the receiver defers the ACK until pulls resolve).
     c->dirty = true;
     c->data_counter++;
-    TxItem item;
-    item.header.resize(HEADER_SIZE + op.body.size());
-    pack_header(item.header.data(), T_DEVPULL, op.tag, op.body.size());
-    memcpy(item.header.data() + HEADER_SIZE, op.body.data(), op.body.size());
-    item.is_data = true;  // local completion at full write; flush-counted
-    item.done = op.done;
-    item.fail = op.fail;
-    item.ctx = op.ctx;
+    auto item = std::make_shared<TxItem>();
+    item->header.resize(HEADER_SIZE + op.body.size());
+    pack_header(item->header.data(), T_DEVPULL, op.tag, op.body.size());
+    memcpy(item->header.data() + HEADER_SIZE, op.body.data(), op.body.size());
+    item->is_data = true;  // local completion at full write; flush-counted
+    item->done = op.done;
+    item->fail = op.fail;
+    item->ctx = op.ctx;
+    if (c->sess) {
+      sess_submit(c, item, fires);
+      return;
+    }
     c->tx.push_back(std::move(item));
     kick_tx(c, fires);
+  }
+
+  // ------------------------------------------------------------- session
+  //
+  // The C++ half of the resilient-session layer (core/session.py +
+  // core/conn.py carry the Python twin; DESIGN.md §14).  Every sequenced
+  // frame gains a T_SEQ prefix and lives in the journal until the peer's
+  // cumulative ACK covers it; on conn death with a live session the conn
+  // SUSPENDS (queues/journal/flush bookkeeping survive), the client
+  // redials under backoff, and resume replays everything past the
+  // handshake-carried ACK.  Exactly-once delivery comes from the
+  // receiver dropping any seq it has already processed.
+
+  static uint64_t sess_wire_bytes(const TxRef& item) {
+    // Wire footprint once framed: current frame + the T_SEQ prefix.
+    return item->total() + HEADER_SIZE;
+  }
+
+  void fire_event(const char* what, uint64_t conn_id, FireList& fires) {
+    if (!event_cb) return;
+    auto cb = event_cb; auto ctx = event_cb_ctx;
+    fires.push_back([cb, ctx, what, conn_id] { cb(ctx, what, conn_id); });
+  }
+
+  // Frame (assign seq + embed the T_SEQ prefix) and journal one item.
+  // Eager payloads are snapshotted -- the user may legally reuse the
+  // buffer once `done` fires, and a replay must resend what was promised.
+  // Rendezvous payloads stay by reference: the journal pins them by
+  // deferring the release callback until the peer's ACK (the §14 fence --
+  // rndv bytes are never blind-replayed from a possibly-reused buffer).
+  void sess_frame_and_queue(Conn* c, const TxRef& item) {
+    Session* s = c->sess.get();
+    uint64_t seq = ++s->tx_seq;
+    std::vector<uint8_t> prefixed(HEADER_SIZE + item->header.size());
+    pack_header(prefixed.data(), T_SEQ, seq, 0);
+    memcpy(prefixed.data() + HEADER_SIZE, item->header.data(),
+           item->header.size());
+    item->header = std::move(prefixed);
+    item->sess_seq = seq;
+    if (item->is_data && item->payload && item->paylen > 0) {
+      if (item->rndv) {
+        item->hold_release = true;
+      } else {
+        item->owned.assign(item->payload, item->payload + item->paylen);
+        item->payload = item->owned.data();
+      }
+    }
+    item->sess_nbytes = item->total();
+    s->journal.push_back(item);
+    s->journal_bytes += item->sess_nbytes;
+    c->tx.push_back(item);
+  }
+
+  // Frame + journal + queue, or park when the journal is at its byte cap
+  // (backpressure: the send completes late instead of the journal
+  // OOMing).  Parked items keep FIFO order; an empty journal always
+  // admits one frame so a single over-cap payload cannot deadlock.
+  void sess_submit(Conn* c, const TxRef& item, FireList& fires) {
+    Session* s = c->sess.get();
+    bool room = s->waiting.empty() &&
+                (s->journal.empty() ||
+                 s->journal_bytes + sess_wire_bytes(item) <= s->journal_cap);
+    if (!room) {
+      s->waiting.push_back(item);
+      return;
+    }
+    sess_frame_and_queue(c, item);
+    kick_tx(c, fires);
+  }
+
+  // Move parked items into the journal/tx as ACKs free room.
+  bool sess_drain_waiting(Conn* c) {
+    Session* s = c->sess.get();
+    bool moved = false;
+    while (!s->waiting.empty()) {
+      TxRef item = s->waiting.front();
+      if (!s->journal.empty() &&
+          s->journal_bytes + sess_wire_bytes(item) > s->journal_cap)
+        break;
+      s->waiting.pop_front();
+      sess_frame_and_queue(c, item);
+      moved = true;
+    }
+    return moved;
+  }
+
+  // Peer's cumulative ACK: trim the journal (releasing pinned rndv
+  // payloads), unblock parked sends.
+  void sess_on_ack(Conn* c, uint64_t cum, FireList& fires) {
+    bump(counters.acks_rx);
+    Session* s = c->sess.get();
+    if (cum > s->peer_acked) s->peer_acked = cum;
+    sess_trim_journal(s, cum, fires);
+    if (sess_drain_waiting(c)) kick_tx(c, fires);
+  }
+
+  void sess_trim_journal(Session* s, uint64_t cum, FireList& fires) {
+    while (!s->journal.empty() && s->journal.front()->sess_seq <= cum) {
+      TxRef item = s->journal.front();
+      s->journal.pop_front();
+      s->journal_bytes -= item->sess_nbytes;
+      fire_release(*item, fires, /*force=*/true);
+    }
+    if (s->journal.empty()) s->journal_bytes = 0;
+  }
+
+  // T_SEQ announcing the next frame's sequence number.  Returns false
+  // when the conn was torn down (protocol violation / seq gap).
+  bool sess_on_seq(Conn* c, uint64_t seq, FireList& fires) {
+    Session* s = c->sess.get();
+    if (!s) {
+      conn_broken(c, fires);  // session frames on a non-session conn
+      return false;
+    }
+    if (seq <= s->rx_cum) {
+      // Already processed (replay overlap): drain + drop the frame.
+      bump(counters.dup_frames_dropped);
+      c->sess_drop = true;
+    } else if (seq == s->rx_cum + 1) {
+      c->sess_pending = seq;
+    } else {
+      // Gap inside one incarnation (reordered/corrupted relay): the
+      // framed stream cannot be repaired in place -- reset and let the
+      // resume handshake replay from the cumulative ACK.
+      conn_broken(c, fires);
+      return false;
+    }
+    return true;
+  }
+
+  // The sequenced frame announced by the last T_SEQ was fully processed:
+  // advance the cumulative counter and make sure an ACK eventually goes
+  // out even if no further reads piggyback one.
+  void sess_commit(Conn* c) {
+    if (!c->sess || c->sess_pending == 0) return;
+    c->sess->rx_cum = c->sess_pending;
+    c->sess_pending = 0;
+    if (!c->sess_ack_armed) {
+      c->sess_ack_armed = true;
+      add_timer(Timer::SESS_ACK, (void*)(uintptr_t)c->id, 0.2);
+    }
+  }
+
+  // Piggybacked cumulative ACK: sent at the end of a read pass (and from
+  // the idle timer) whenever rx progress is unacknowledged.
+  void sess_maybe_ack(Conn* c, FireList& fires) {
+    Session* s = c->sess.get();
+    if (!s || !c->alive || s->suspended || c->fd < 0) return;
+    if (s->rx_cum > s->acked_sent) {
+      s->acked_sent = s->rx_cum;
+      bump(counters.acks_tx);
+      conn_send_ctl(c, T_ACK, s->acked_sent, 0, "", fires);
+    }
+  }
+
+  // The transport died but the session is resumable: drop the socket and
+  // all per-incarnation parser state, keep every queue, journal, and
+  // flush bookkeeping.  The conn stays `alive` so flush barriers keep
+  // waiting and new sends keep queueing -- they complete after resume.
+  void sess_suspend(Conn* c, FireList& fires) {
+    Session* s = c->sess.get();
+    SW_DEBUG("conn %llu lost; session suspended", (unsigned long long)c->id);
+    s->suspended = true;
+    s->deadline = Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                                     std::chrono::duration<double>(s->grace));
+    if (c->fd >= 0) {
+      ep_del(c->fd);
+      close(c->fd);
+      c->fd = -1;
+    }
+    c->want_write = false;
+    c->db_out.clear();
+    // rx parser reset: the replayed stream restarts at a frame boundary.
+    c->hdr_got = 0;
+    c->ctl_type = 0;
+    c->ctl_body.clear();
+    c->ctl_need = 0;
+    c->ctl_a = 0;
+    c->rx_skip = 0;
+    c->sess_drop = false;
+    c->sess_pending = 0;
+    if (c->rx_msg) {
+      InboundMsg* m = c->rx_msg;
+      bool unowned = c->rx_msg_unowned;
+      c->rx_msg = nullptr;
+      c->rx_msg_unowned = false;
+      std::lock_guard<std::mutex> g(mu);
+      if (unowned) {
+        delete m;  // probe record: this conn owns it
+      } else if (m->has_pr && !m->complete) {
+        // Re-arm the stranded receive at the FRONT of the queue: the
+        // replayed frame must claim the same receive (its buffer was
+        // partially written; the replay rewrites it from the start).
+        PostedRecv pr = m->pr;
+        pr.claimed = false;
+        m->has_pr = false;
+        matcher.purge_inflight(m);
+        matcher.posted.push_front(pr);
+      } else {
+        matcher.purge_inflight(m);
+      }
+    }
+    // Journaled frames replay from the journal; bare per-incarnation ctl
+    // (PING/PONG/ACK/handshake) queued on the old transport dies with it.
+    c->tx.clear();
+    (void)fires;
+    add_timer(Timer::SESS_GRACE, (void*)(uintptr_t)c->id, s->grace);
+    if (!is_server)
+      add_timer(Timer::SESS_REDIAL, (void*)(uintptr_t)c->id, 0.01);
+  }
+
+  // A reconnect re-handshake matched this session: adopt the new socket,
+  // trim the journal by the peer's cumulative ACK (carried in the
+  // handshake), and replay everything past it.  `ack_body` is the
+  // acceptor's HELLO_ACK JSON -- it must precede replayed frames on the
+  // wire ("" on the client side, which already consumed the peer's ACK).
+  void sess_resume(Conn* c, int fd, uint64_t peer_ack,
+                   const std::string& ack_body, FireList& fires) {
+    Session* s = c->sess.get();
+    s->suspended = false;
+    s->attempt = 0;
+    c->fd = fd;
+    c->last_rx = Clock::now();
+    if (peer_ack > s->peer_acked) s->peer_acked = peer_ack;
+    sess_trim_journal(s, peer_ack, fires);
+    // The handshake carried our rx_cum as sess_ack: the peer starts from
+    // it, so there is nothing older to re-ACK.
+    s->acked_sent = s->rx_cum;
+    // Frames queued while suspended are all journaled (framing happens at
+    // submit): rebuild tx purely from the journal, or those items would
+    // ride the wire twice.
+    c->tx.clear();
+    bump(counters.sessions_resumed);
+    if (!ack_body.empty()) {
+      auto ack = std::make_shared<TxItem>();
+      ack->header.resize(HEADER_SIZE + ack_body.size());
+      pack_header(ack->header.data(), T_HELLO_ACK, 0, ack_body.size());
+      memcpy(ack->header.data() + HEADER_SIZE, ack_body.data(),
+             ack_body.size());
+      c->tx.push_back(std::move(ack));
+    }
+    uint64_t replayed = 0;
+    for (auto& item : s->journal) {
+      item->off = 0;
+      c->tx.push_back(item);
+      replayed++;
+    }
+    bump(counters.frames_replayed, replayed);
+    sess_drain_waiting(c);  // trim may have freed journal room
+    trace.rec(kEvSessResume, 0, c->id, replayed);
+    fire_event("session-resume", c->id, fires);
+    ep_add(fd, EPOLLIN, c);
+    kick_tx(c, fires);
+  }
+
+  // Terminal session failure: grace elapsed, or the peer answered a
+  // resume dial with a new epoch.  Everything that was riding out the
+  // outage fails with the stable "session expired" reason.
+  void sess_expire(Conn* c, FireList& fires) {
+    Session* s = c->sess.get();
+    if (!s || s->expired) return;
+    s->expired = true;
+    c->sess_fail = kSessionExpired;
+    SW_DEBUG("session expired (conn %llu)", (unsigned long long)c->id);
+    trace.rec(kEvSessExpire, 0, c->id, 0, kSessionExpired);
+    fire_event("session-expired", c->id, fires);
+    sess_cancel_terminal(c, fires, kSessionExpired);
+    if (c->alive) {
+      c->alive = false;
+      if (c->fd >= 0) {
+        ep_del(c->fd);
+        close(c->fd);
+        c->fd = -1;
+      }
+      for (auto& ref : c->tx) {
+        TxItem& item = *ref;
+        if (item.is_data && !item.local_done && item.fail) {
+          item.local_done = true;
+          bump(counters.ops_cancelled);
+          auto fail = item.fail; auto ctx = item.ctx;
+          fires.push_back([fail, ctx] { fail(ctx, kSessionExpired); });
+        }
+        fire_release(item, fires, /*force=*/true);
+      }
+      c->tx.clear();
+      if (c->rx_msg) {
+        std::lock_guard<std::mutex> g(mu);
+        matcher.purge_inflight(c->rx_msg);
+        c->rx_msg = nullptr;
+        c->rx_msg_unowned = false;
+      }
+      std::lock_guard<std::mutex> g(mu);
+      matcher.purge_remote_conn(c->id);
+    }
+    // Session users opted into bounded failure (like the keepalive
+    // contract): queued receives fail once no alive conns remain.
+    {
+      std::lock_guard<std::mutex> g(mu);
+      bool any_alive = false;
+      for (auto& [id, cc] : conns)
+        if (cc->alive) { any_alive = true; break; }
+      if (!any_alive) matcher.fail_pending(kSessionExpired, fires);
+    }
+    auto snapshot = flushes;
+    for (auto* rec : snapshot) try_complete_flush(rec, fires);
+  }
+
+  // Terminal teardown sweep for session state: cancel journaled / parked
+  // items exactly once (`local_done` dedupes against the tx loop -- a
+  // journaled item may also sit in tx) and release pinned payloads.
+  void sess_cancel_terminal(Conn* c, FireList& fires, const char* reason) {
+    if (!c->sess) return;
+    Session* s = c->sess.get();
+    auto cancel_item = [&](const TxRef& item) {
+      if (item->is_data && !item->local_done && item->fail) {
+        item->local_done = true;
+        bump(counters.ops_cancelled);
+        auto fail = item->fail; auto ctx = item->ctx;
+        fires.push_back([fail, ctx, reason] { fail(ctx, reason); });
+      }
+      fire_release(*item, fires, /*force=*/true);
+    };
+    for (auto& item : s->journal) cancel_item(item);
+    for (auto& item : s->waiting) cancel_item(item);
+    s->journal.clear();
+    s->journal_bytes = 0;
+    s->waiting.clear();
+    sessions.erase(s->id);
+  }
+
+  // SESS_* timer dispatch (ctx carries the conn id).
+  void sess_timer(const Timer& t, FireList& fires) {
+    uint64_t cid = (uint64_t)(uintptr_t)t.ctx;
+    Conn* c = nullptr;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      auto it = conns.find(cid);
+      if (it != conns.end()) c = it->second;
+    }
+    if (!c || !c->sess) return;
+    Session* s = c->sess.get();
+    if (t.kind == Timer::SESS_ACK) {
+      c->sess_ack_armed = false;
+      sess_maybe_ack(c, fires);
+      return;
+    }
+    if (s->expired) return;
+    if (t.kind == Timer::SESS_GRACE) {
+      if (s->suspended && Clock::now() >= s->deadline) sess_expire(c, fires);
+      return;
+    }
+    // SESS_REDIAL (client only)
+    if (!s->suspended || status.load() != ST_RUNNING) return;
+    if (Clock::now() >= s->deadline) {
+      sess_expire(c, fires);
+      return;
+    }
+    sess_redial(c, fires);
+  }
+
+  // One resume attempt for a suspended session (engine thread; re-armed
+  // under exponential backoff with jitter -- the PR-1 reconnect shape,
+  // now transparent).  The dial blocks the engine loop for at most the
+  // connect timeout, like the Python engine's _sess_dial.
+  void sess_redial(Conn* c, FireList& fires) {
+    Session* s = c->sess.get();
+    int fd = -1;
+    std::string ack_body;
+    if (!sess_dial(s, &fd, &ack_body)) {
+      s->attempt++;
+      int shift = s->attempt - 1 > 5 ? 5 : s->attempt - 1;
+      double base = 0.05 * (double)(1u << shift);
+      if (base > 1.0) base = 1.0;
+      double delay = base * (0.5 + (double)(rand() % 1000) / 2000.0);
+      add_timer(Timer::SESS_REDIAL, (void*)(uintptr_t)c->id, delay);
+      return;
+    }
+    if (json_field(ack_body, "sess") != "ok" ||
+        json_field(ack_body, "sess_epoch") != s->epoch) {
+      // The peer restarted (or forgot us): a new epoch is a new session
+      // -- ours is expired, not resumable.
+      close(fd);
+      sess_expire(c, fires);
+      return;
+    }
+    uint64_t peer_ack =
+        strtoull(json_field(ack_body, "sess_ack").c_str(), nullptr, 10);
+    sess_resume(c, fd, peer_ack, "", fires);
+  }
+
+  // One blocking resume dial + handshake, bounded by the connect timeout.
+  // Returns true with *out_fd (nonblocking) and *out_ack on success.
+  bool sess_dial(Session* s, int* out_fd, std::string* out_ack) {
+    const int cto_ms = connect_timeout_ms();
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return false;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)c_port);
+    if (inet_pton(AF_INET, c_host.c_str(), &addr.sin_addr) != 1) {
+      close(fd);
+      return false;
+    }
+    int rc = ::connect(fd, (sockaddr*)&addr, sizeof(addr));
+    if (rc < 0 && errno != EINPROGRESS) {
+      close(fd);
+      return false;
+    }
+    pollfd pfd{fd, POLLOUT, 0};
+    int err = 0;
+    socklen_t elen = sizeof(err);
+    if (poll(&pfd, 1, cto_ms) <= 0 ||
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) != 0 || err != 0) {
+      close(fd);
+      return false;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::string hello = std::string("{\"worker_id\": \"") + worker_id +
+                        "\", \"mode\": \"" + c_mode + "\", \"name\": \"\"" +
+                        ", \"ka\": \"ok\", \"sess\": \"ok\", \"sess_id\": \"" +
+                        s->id + "\", \"sess_epoch\": \"" + s->epoch +
+                        "\", \"sess_ack\": \"" + std::to_string(s->rx_cum) +
+                        "\"";
+    if (devpull_advertise) hello += ", \"devpull\": \"ok\"";
+    hello += "}";
+    std::vector<uint8_t> frame(HEADER_SIZE + hello.size());
+    pack_header(frame.data(), T_HELLO, 0, hello.size());
+    memcpy(frame.data() + HEADER_SIZE, hello.data(), hello.size());
+    size_t off = 0;
+    while (off < frame.size()) {
+      ssize_t w = ::send(fd, frame.data() + off, frame.size() - off,
+                         MSG_NOSIGNAL);
+      if (w < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          pollfd p2{fd, POLLOUT, 0};
+          if (poll(&p2, 1, cto_ms) <= 0) { close(fd); return false; }
+          continue;
+        }
+        close(fd);
+        return false;
+      }
+      off += (size_t)w;
+    }
+    auto read_exact = [&](uint8_t* out, size_t n) -> bool {
+      size_t got = 0;
+      while (got < n) {
+        ssize_t r = ::recv(fd, out + got, n - got, 0);
+        if (r > 0) { got += (size_t)r; continue; }
+        if (r == 0) return false;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          pollfd p2{fd, POLLIN, 0};
+          if (poll(&p2, 1, cto_ms) <= 0) return false;
+          continue;
+        }
+        return false;
+      }
+      return true;
+    };
+    uint8_t hdr[HEADER_SIZE];
+    uint8_t type;
+    uint64_t a, b;
+    if (!read_exact(hdr, HEADER_SIZE)) { close(fd); return false; }
+    unpack_header(hdr, &type, &a, &b);
+    if (type != T_HELLO_ACK || b > 4096) { close(fd); return false; }
+    std::vector<uint8_t> body(b);
+    if (b && !read_exact(body.data(), b)) { close(fd); return false; }
+    out_ack->assign((char*)body.data(), body.size());
+    *out_fd = fd;
+    return true;
+  }
+
+  // Session half of the accept handshake.  Returns true when this dial
+  // RESUMED an existing suspended session (`c` -- the fresh accept
+  // wrapper -- was consumed: its socket moved onto the suspended conn);
+  // false when a new session was registered on `c` and the normal accept
+  // path continues.
+  bool sess_hello(Conn* c, const std::string& body, FireList& fires) {
+    std::string sid = json_field(body, "sess_id");
+    std::string req_epoch = json_field(body, "sess_epoch");
+    auto it = sessions.find(sid);
+    Conn* existing = it == sessions.end() ? nullptr : it->second;
+    if (existing && existing->sess && !existing->sess->expired &&
+        existing->sess->epoch == req_epoch) {
+      if (!existing->sess->suspended) {
+        // One-sided failure: the client saw its conn die and redialed
+        // before this side noticed (no EOF yet, ka not expired).  The
+        // resume dial itself proves the old incarnation dead --
+        // supersede it instead of expiring a resumable session.
+        sess_suspend(existing, fires);
+      }
+      uint64_t peer_ack =
+          strtoull(json_field(body, "sess_ack").c_str(), nullptr, 10);
+      int fd = c->fd;
+      ep_del(fd);
+      c->fd = -1;
+      c->alive = false;
+      sess_reap.push_back(c);  // zombie wrapper: freed at end of this pass
+      std::string ack =
+          std::string("{\"worker_id\": \"") + worker_id +
+          "\", \"sess\": \"ok\", \"sess_epoch\": \"" + existing->sess->epoch +
+          "\", \"sess_ack\": \"" + std::to_string(existing->sess->rx_cum) +
+          "\"" + (existing->ka_ok ? ", \"ka\": \"ok\"" : "") +
+          (existing->devpull_ok ? ", \"devpull\": \"ok\"" : "") + "}";
+      sess_resume(existing, fd, peer_ack, ack, fires);
+      return true;
+    }
+    if (existing && existing != c) {
+      // Same session id, stale epoch: the old incarnation can never
+      // resume -- expire it before the new registration shadows it in
+      // the registry.
+      sess_expire(existing, fires);
+    }
+    c->sess = std::make_unique<Session>();
+    c->sess->id = sid;
+    uint64_t r = 0;
+    if (getrandom(&r, 8, 0) != 8) r = (uint64_t)(uintptr_t)c ^ c->id;
+    char ep[17];
+    snprintf(ep, sizeof(ep), "%08x", (uint32_t)r);
+    c->sess->epoch = ep;
+    c->sess->journal_cap = session_journal_bytes_env();
+    c->sess->grace = session_grace_env();
+    sessions[sid] = c;
+    return false;
   }
 
   // A surfaced descriptor resolved (embedder's pull landed or failed):
@@ -1105,7 +1734,9 @@ struct Worker {
       }
     }
     for (uint64_t seq : ready)
-      if (c->alive) conn_send_ctl(c, T_FLUSH_ACK, seq, 0, "", fires);
+      if (c->alive)
+        conn_send_ctl(c, T_FLUSH_ACK, seq, 0, "", fires,
+                      /*switch_after=*/false, /*sess_frame=*/true);
   }
 
   void on_devpull(Conn* c, uint64_t tag, const std::string& body, FireList& fires) {
@@ -1197,7 +1828,8 @@ struct Worker {
     struct iovec iov[kMaxIov];
     int niov = 0;
     uint64_t bytes = 0;
-    for (auto& item : c->tx) {
+    for (auto& ref : c->tx) {
+      TxItem& item = *ref;
       if (niov >= kMaxIov || bytes >= kMaxBytes) break;
       uint64_t hlen = item.header.size();
       uint64_t off = item.off;
@@ -1241,18 +1873,23 @@ struct Worker {
 
   // A tagged (is_data) TxItem fully handed to the transport: account it
   // and record its send_done event (tag lives in the packed header).
-  void tx_item_completed(Conn* c, const TxItem& item) {
-    if (!item.is_data) return;
+  // `counted` makes this once-only: a session replay re-writes journaled
+  // items but must not re-count them.
+  void tx_item_completed(Conn* c, TxItem& item) {
+    if (!item.is_data || item.counted) return;
+    item.counted = true;
     bump(counters.sends_completed);
     if (trace.enabled && item.header.size() >= HEADER_SIZE) {
       uint64_t tag = 0;
-      memcpy(&tag, item.header.data() + 1, 8);
+      size_t toff = item.sess_seq ? HEADER_SIZE : 0;  // skip the T_SEQ prefix
+      memcpy(&tag, item.header.data() + toff + 1, 8);
       trace.rec(kEvSendDone, tag, c->id, item.paylen);
     }
   }
 
   void kick_tx(Conn* c, FireList& fires) {
-    if (!c->alive) return;
+    // fd < 0: session-suspended (resume re-kicks).
+    if (!c->alive || c->fd < 0) return;
     uint64_t t0 = c->sm_active ? c->sm_tx.tail().load(std::memory_order_relaxed) : 0;
     bool blocked = false;
     while (!c->tx.empty() && !blocked) {
@@ -1267,7 +1904,8 @@ struct Worker {
         }
         uint64_t budget = (uint64_t)w;
         while (budget > 0 && !c->tx.empty()) {
-          TxItem& item = c->tx.front();
+          TxRef ref = c->tx.front();  // keep alive across the pop
+          TxItem& item = *ref;
           uint64_t take = item.total() - item.off;
           if (take > budget) take = budget;
           item.off += take;
@@ -1302,7 +1940,8 @@ struct Worker {
         continue;
       }
       // Ring path: stream the front item chunk-by-chunk (no syscalls).
-      TxItem& item = c->tx.front();
+      TxRef ref = c->tx.front();  // keep alive across the pop
+      TxItem& item = *ref;
       uint64_t hlen = item.header.size();
       while (item.off < item.total()) {
         const uint8_t* p;
@@ -1401,6 +2040,7 @@ struct Worker {
   void conn_readable(Conn* c, FireList& fires) {
     if (!c->sm_active) {
       pump_frames(c, fires);
+      if (c->alive) sess_maybe_ack(c, fires);  // piggybacked cumulative ACK
       return;
     }
     // sm mode: the socket carries only doorbells (and EOF/RST).  Drain it,
@@ -1437,6 +2077,17 @@ struct Worker {
 
   void pump_frames(Conn* c, FireList& fires) {
     while (c->alive) {
+      if (c->rx_skip) {
+        // Duplicate sequenced frame: drain its payload to scratch without
+        // touching the matcher (exactly-once delivery).
+        if (c->scratch.size() < (1u << 20)) c->scratch.resize(1u << 20);
+        size_t want = c->rx_skip > c->scratch.size() ? c->scratch.size()
+                                                     : (size_t)c->rx_skip;
+        ssize_t r = stream_read(c, c->scratch.data(), want, fires);
+        if (r <= 0) return;
+        c->rx_skip -= (uint64_t)r;
+        continue;
+      }
       if (c->rx_msg) {
         InboundMsg* m = c->rx_msg;
         uint64_t remaining = m->length - m->received;
@@ -1463,6 +2114,7 @@ struct Worker {
           }
           c->rx_msg = nullptr;
           c->rx_msg_unowned = false;
+          sess_commit(c);
         }
         continue;
       }
@@ -1482,7 +2134,10 @@ struct Worker {
         c->ctl_type = 0;
         c->ctl_a = 0;
         if (t == T_HELLO) on_hello(c, body, fires);
-        else if (t == T_DEVPULL) on_devpull(c, ctl_a, body, fires);
+        else if (t == T_DEVPULL) {
+          on_devpull(c, ctl_a, body, fires);
+          sess_commit(c);
+        }
         // T_HELLO_ACK handled synchronously during client connect
         continue;
       }
@@ -1496,30 +2151,65 @@ struct Worker {
       unpack_header(c->hdr, &type, &a, &b);
       switch (type) {
         case T_DATA: {
-          std::lock_guard<std::mutex> g(mu);
-          InboundMsg* m = matcher.on_start(a, b, fires);
-          if (b == 0) {
-            matcher.on_complete(m, fires);
-          } else {
-            c->rx_msg = m;
-            // Probe records live in no matcher queue: this conn owns them
-            // (close must free them without touching freed matcher state).
-            c->rx_msg_unowned = (a == Matcher::kProbeTag);
+          if (c->sess_drop) {
+            c->sess_drop = false;
+            if (b) c->rx_skip = b;
+            break;
           }
+          {
+            std::lock_guard<std::mutex> g(mu);
+            InboundMsg* m = matcher.on_start(a, b, fires);
+            if (b == 0) {
+              matcher.on_complete(m, fires);
+            } else {
+              c->rx_msg = m;
+              // Probe records live in no matcher queue: this conn owns them
+              // (close must free them without touching freed matcher state).
+              c->rx_msg_unowned = (a == Matcher::kProbeTag);
+            }
+          }
+          if (b == 0) sess_commit(c);
           break;
         }
         case T_FLUSH:
+          if (c->sess_drop) {
+            c->sess_drop = false;
+            break;
+          }
+          sess_commit(c);
           if (!c->devpull_pending.empty()) {
             // Descriptors preceding this barrier are unresolved: withhold
             // the ACK until their pulls land (snapshot, so descriptors
             // arriving after the barrier cannot extend the wait).
             c->devpull_deferred.emplace_back(a, c->devpull_pending);
           } else {
-            conn_send_ctl(c, T_FLUSH_ACK, a, 0, "", fires);
+            conn_send_ctl(c, T_FLUSH_ACK, a, 0, "", fires,
+                          /*switch_after=*/false, /*sess_frame=*/true);
           }
           break;
         case T_FLUSH_ACK:
+          if (c->sess_drop) {
+            c->sess_drop = false;
+            break;
+          }
+          sess_commit(c);
           on_flush_ack(c, a, fires);
+          break;
+        case T_SEQ:
+          if (!sess_on_seq(c, a, fires)) return;
+          break;
+        case T_ACK:
+          if (c->sess) sess_on_ack(c, a, fires);
+          break;
+        case T_BYE:
+          // Peer's clean local close on a session conn: the session is
+          // over -- the imminent EOF must take the seed/keepalive death
+          // contract (prompt "not connected", no fault dump), not a
+          // grace-window suspend + redial.
+          if (c->sess && !c->sess->expired) {
+            c->sess->expired = true;
+            sessions.erase(c->sess->id);
+          }
           break;
         case T_PING:
           // Liveness probe: answer immediately (stream_read already
@@ -1531,6 +2221,11 @@ struct Worker {
         case T_HELLO:
         case T_HELLO_ACK:
         case T_DEVPULL:
+          if (type == T_DEVPULL && c->sess_drop) {
+            c->sess_drop = false;
+            if (b) c->rx_skip = b;
+            break;
+          }
           c->ctl_type = type;
           c->ctl_need = (size_t)b;
           c->ctl_a = a;
@@ -1556,10 +2251,13 @@ struct Worker {
     }
     for (Conn* c : candidates) {
       if (!c->alive && c->dirty) {
+        // An expired session owns the failure reason (DESIGN.md §14).
+        const char* reason = c->sess_fail
+            ? c->sess_fail
+            : "Endpoint is not connected (peer reset before flush)";
         auto fail = op.fail; auto ctx = op.ctx;
-        trace.rec(kEvOpFail, 0, c->id, 0,
-                  "Endpoint is not connected (peer reset before flush)");
-        if (fail) fires.push_back([fail, ctx] { fail(ctx, "Endpoint is not connected (peer reset before flush)"); });
+        trace.rec(kEvOpFail, 0, c->id, 0, reason);
+        if (fail) fires.push_back([fail, ctx, reason] { fail(ctx, reason); });
         return;
       }
     }
@@ -1572,7 +2270,8 @@ struct Worker {
       uint64_t seq = ++c->flush_seq;
       rec->waits[c->id] = seq;
       c->flush_marks[seq] = c->data_counter;
-      conn_send_ctl(c, T_FLUSH, seq, 0, "", fires);
+      conn_send_ctl(c, T_FLUSH, seq, 0, "", fires,
+                    /*switch_after=*/false, /*sess_frame=*/true);
     }
     flushes.push_back(rec);
     try_complete_flush(rec, fires);
@@ -1592,21 +2291,28 @@ struct Worker {
   void try_complete_flush(FlushRec* rec, FireList& fires) {
     if (rec->completed) return;
     bool pending = false, dead = false;
+    // A session that expired (rather than a bare reset) owns the failure
+    // reason: "session expired" instead of "not connected".
+    const char* dead_reason = "Endpoint is not connected (peer reset during flush)";
     for (auto& [cid, seq] : rec->waits) {
       auto it = conns.find(cid);
       if (it == conns.end()) continue;
       Conn* c = it->second;
       if (c->flush_acked < seq) {
-        if (!c->alive) dead = true;
-        else pending = true;
+        if (!c->alive) {
+          dead = true;
+          if (c->sess_fail) dead_reason = c->sess_fail;
+        } else {
+          pending = true;
+        }
       }
     }
     if (dead) {
       rec->completed = true;
       remove_flush(rec);
-      trace.rec(kEvOpFail, 0, 0, 0, "Endpoint is not connected (peer reset during flush)");
+      trace.rec(kEvOpFail, 0, 0, 0, dead_reason);
       auto fail = rec->fail; auto ctx = rec->ctx;
-      if (fail) fires.push_back([fail, ctx] { fail(ctx, "Endpoint is not connected (peer reset during flush)"); });
+      if (fail) fires.push_back([fail, ctx, dead_reason] { fail(ctx, dead_reason); });
       delete rec;
     } else if (!pending) {
       rec->completed = true;
@@ -1630,6 +2336,17 @@ struct Worker {
   // --------------------------------------------------------- conn death
   void conn_broken(Conn* c, FireList& fires) {
     if (!c->alive) return;
+    // With a live session (STARWAY_SESSION negotiated via "sess"), the
+    // conn SUSPENDS instead of failing: queues/journal/flush bookkeeping
+    // survive, the client redials under backoff, and in-flight ops
+    // complete late after the resume replay (DESIGN.md §14).  Only
+    // session expiry falls through to terminal teardown.
+    if (c->sess && !c->sess->expired && !c->sess->suspended &&
+        status.load() == ST_RUNNING) {
+      trace.rec(kEvConnDown, 0, c->id);
+      sess_suspend(c, fires);
+      return;
+    }
     // With liveness detection active (STARWAY_KEEPALIVE > 0) on a
     // ka-negotiated conn, the user opted out of recvs-pend-forever:
     // whatever killed the conn, the receive it was streaming into fails,
@@ -1652,13 +2369,16 @@ struct Worker {
     c->alive = false;
     ep_del(c->fd);
     trace.rec(kEvConnDown, 0, c->id);
-    for (auto& item : c->tx) {
+    sess_cancel_terminal(c, fires, kCancelled);
+    for (auto& ref : c->tx) {
+      TxItem& item = *ref;
       if (item.is_data && !item.local_done && item.fail) {
+        item.local_done = true;
         auto fail = item.fail; auto ctx = item.ctx;
         bump(counters.ops_cancelled);
         fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
       }
-      fire_release(item, fires);
+      fire_release(item, fires, /*force=*/true);
     }
     c->tx.clear();
     if (c->rx_msg) {
@@ -1699,13 +2419,26 @@ struct Worker {
   void conn_close_local(Conn* c, FireList& fires) {
     if (!c->alive) return;
     bool abort = c->has_unfinished_data();
-    for (auto& item : c->tx) {
+    if (c->sess && !c->sess->suspended && !c->sess->expired && !abort &&
+        c->fd >= 0 && (c->tx.empty() || c->tx.front()->off == 0)) {
+      // Clean close on a session conn: tell the peer the session is over
+      // (T_BYE) so it fails over to the seed death contract instead of
+      // suspending for the grace window.  Best-effort -- a lost BYE only
+      // costs the peer the grace-expiry fallback.
+      uint8_t hdr[HEADER_SIZE];
+      pack_header(hdr, T_BYE, 0, 0);
+      (void)!send(c->fd, hdr, HEADER_SIZE, MSG_NOSIGNAL | MSG_DONTWAIT);
+    }
+    sess_cancel_terminal(c, fires, kCancelled);
+    for (auto& ref : c->tx) {
+      TxItem& item = *ref;
       if (item.is_data && !item.local_done && item.fail) {
+        item.local_done = true;
         auto fail = item.fail; auto ctx = item.ctx;
         bump(counters.ops_cancelled);
         fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
       }
-      fire_release(item, fires);
+      fire_release(item, fires, /*force=*/true);
     }
     c->tx.clear();
     c->alive = false;
@@ -1740,10 +2473,19 @@ struct Worker {
     }
     c->handshaken = true;
     half_open.erase(c);
+    // Resilient-session handshake (STARWAY_SESSION): a resume dial adopts
+    // the new socket into the suspended conn; a fresh offer registers a
+    // new session.  Session conns never take the sm upgrade (the rings
+    // are a per-incarnation transport with no replay journal).
+    bool sess_offered = session_enabled() &&
+                        json_field(body, "sess") == "ok" &&
+                        !json_field(body, "sess_id").empty();
+    if (sess_offered && sess_hello(c, body, fires))
+      return;  // resumed onto the suspended conn; this wrapper consumed
     // Shared-memory offer: map + validate, confirm in the ACK; any failure
     // silently stays on TCP (mirrors core/engine.py ServerWorker._on_hello).
     SmSegment* seg = nullptr;
-    if (sm_enabled()) {
+    if (sm_enabled() && !sess_offered) {
       std::string key = json_field(body, "sm_key");
       if (!key.empty()) {
         uint64_t nonce = strtoull(json_field(body, "sm_nonce").c_str(), nullptr, 16);
@@ -1759,10 +2501,14 @@ struct Worker {
     if (devpull_advertise && json_field(body, "devpull") == "ok")
       c->devpull_ok = true;
     if (json_field(body, "ka") == "ok") c->ka_ok = true;  // liveness capability
+    std::string sess_ext;
+    if (c->sess)
+      sess_ext = std::string(", \"sess\": \"ok\", \"sess_epoch\": \"") +
+                 c->sess->epoch + "\", \"sess_ack\": \"0\"";
     std::string ack = std::string("{\"worker_id\": \"") + worker_id + "\"" +
                       (seg ? ", \"sm\": \"ok\"" : "") +
                       (c->devpull_ok ? ", \"devpull\": \"ok\"" : "") +
-                      (c->ka_ok ? ", \"ka\": \"ok\"" : "") + "}";
+                      (c->ka_ok ? ", \"ka\": \"ok\"" : "") + sess_ext + "}";
     // The ACK is the transport switch point (see TxItem::switch_after).
     conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires,
                   /*switch_after=*/seg != nullptr);
@@ -1829,6 +2575,11 @@ struct Worker {
   }
 
   void expire_op(const Timer& t, FireList& fires) {
+    if (t.kind == Timer::SESS_ACK || t.kind == Timer::SESS_GRACE ||
+        t.kind == Timer::SESS_REDIAL) {
+      sess_timer(t, fires);
+      return;
+    }
     if (t.kind == Timer::RECV) {
       std::lock_guard<std::mutex> g(mu);
       matcher.expire_recv(t.ctx, fires);
@@ -1878,23 +2629,57 @@ struct Worker {
     }
     for (Conn* c : cs) {
       for (auto it = c->tx.begin(); it != c->tx.end(); ++it) {
-        if (!it->is_data || it->ctx != t.ctx || it->local_done) continue;
-        auto fail = it->fail; auto ctx = it->ctx;
+        TxItem& item = **it;
+        if (!item.is_data || item.ctx != t.ctx || item.local_done) continue;
+        if (c->sess && !c->sess->expired && item.sess_seq) {
+          // Live session, sequenced frame: the send is PROMISED -- the
+          // journal delivers it (now, or via a replay), so failing it
+          // "timed out" would lie about an op the peer still receives,
+          // and tearing a healthy conn down would force a needless
+          // resume cycle.  The op completes late; only grace/epoch
+          // expiry may fail it (DESIGN.md §14; the Python engine's
+          // _expire_send defers the same way).  Parked-unframed sends
+          // (no seq yet) stay cleanly expirable below.
+          return;
+        }
+        auto fail = item.fail; auto ctx = item.ctx;
         bump(counters.ops_timed_out);
         uint64_t tg = 0;
-        if (it->header.size() >= HEADER_SIZE) memcpy(&tg, it->header.data() + 1, 8);
-        trace.rec(kEvOpFail, tg, c->id, it->paylen, kTimedOut);
-        if (it->off == 0) {
-          it->local_done = true;
+        size_t toff = item.sess_seq ? HEADER_SIZE : 0;
+        if (item.header.size() >= toff + HEADER_SIZE)
+          memcpy(&tg, item.header.data() + toff + 1, 8);
+        trace.rec(kEvOpFail, tg, c->id, item.paylen, kTimedOut);
+        // A sequenced session frame was already promised to the peer
+        // (withdrawing it would leave a seq hole the receiver must treat
+        // as a gap): expire it like a started send.
+        if (item.off == 0 && item.sess_seq == 0) {
+          item.local_done = true;
           if (fail) fires.push_back([fail, ctx] { fail(ctx, kTimedOut); });
-          fire_release(*it, fires);
+          fire_release(item, fires);
           c->tx.erase(it);
         } else {
-          it->local_done = true;  // suppress the conn_broken cancel path
+          item.local_done = true;  // suppress the conn_broken cancel path
           if (fail) fires.push_back([fail, ctx] { fail(ctx, kTimedOut); });
           conn_broken(c, fires);
         }
         return;
+      }
+      // Session backpressure may have parked it unframed: withdraw
+      // cleanly from the waiting queue.
+      if (c->sess) {
+        auto& waiting = c->sess->waiting;
+        for (auto it = waiting.begin(); it != waiting.end(); ++it) {
+          TxItem& item = **it;
+          if (!item.is_data || item.ctx != t.ctx || item.local_done) continue;
+          auto fail = item.fail; auto ctx = item.ctx;
+          bump(counters.ops_timed_out);
+          trace.rec(kEvOpFail, 0, c->id, item.paylen, kTimedOut);
+          item.local_done = true;
+          if (fail) fires.push_back([fail, ctx] { fail(ctx, kTimedOut); });
+          fire_release(item, fires, /*force=*/true);
+          waiting.erase(it);
+          return;
+        }
       }
     }
   }
@@ -1912,6 +2697,8 @@ struct Worker {
     std::vector<Conn*> expired;
     for (Conn* c : cs) {
       if (!c->alive || !c->ka_ok) continue;
+      if (c->sess && c->sess->suspended)
+        continue;  // no transport to probe; the grace timer governs
       auto silent = now - c->last_rx;
       if (silent > window) expired.push_back(c);
       else if (silent >= interval) conn_send_ctl(c, T_PING, 0, 0, "", fires);
@@ -2090,6 +2877,8 @@ struct Worker {
       check_timers(fires);
       drain_ops(fires);
       for (auto& f : fires) f();
+      for (Conn* z : sess_reap) delete z;
+      sess_reap.clear();
     }
     FireList fires;
     do_close(fires);
@@ -2188,10 +2977,18 @@ struct ClientWorker : Worker {
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     // HELLO / HELLO_ACK handshake (blocking with poll deadlines).  Offer a
-    // same-host shared-memory upgrade when enabled (see SmSegment).
-    if (sm_enabled()) sm_offer = SmSegment::create(worker_id.substr(0, 8));
+    // same-host shared-memory upgrade when enabled (see SmSegment).  A
+    // session offer (STARWAY_SESSION) disables the sm upgrade: the rings
+    // are a per-incarnation transport with no replay journal.
+    bool sess_on = session_enabled();
+    if (sm_enabled() && !sess_on) sm_offer = SmSegment::create(worker_id.substr(0, 8));
     std::string hello = std::string("{\"worker_id\": \"") + worker_id +
                         "\", \"mode\": \"" + c_mode + "\", \"name\": \"\"";
+    if (sess_on)
+      // Stable session id + epoch 0 (the acceptor assigns the real
+      // epoch); sess_ack is our cumulative rx seq (0 for a new session).
+      hello += std::string(", \"sess\": \"ok\", \"sess_id\": \"") + worker_id +
+               "\", \"sess_epoch\": \"0\", \"sess_ack\": \"0\"";
     if (sm_offer) {
       char nonce_hex[17];
       snprintf(nonce_hex, sizeof(nonce_hex), "%016llx", (unsigned long long)sm_offer->nonce);
@@ -2251,6 +3048,13 @@ struct ClientWorker : Worker {
     c->peer_name = json_field(ack_body, "worker_id");
     c->devpull_ok = devpull_advertise && json_field(ack_body, "devpull") == "ok";
     c->ka_ok = json_field(ack_body, "ka") == "ok";
+    if (sess_on && json_field(ack_body, "sess") == "ok") {
+      c->sess = std::make_unique<Session>();
+      c->sess->id = worker_id;
+      c->sess->epoch = json_field(ack_body, "sess_epoch");
+      c->sess->journal_cap = session_journal_bytes_env();
+      c->sess->grace = session_grace_env();
+    }
     if (sm_offer) {
       if (json_field(ack_body, "sm") == "ok") {
         c->adopt_sm(sm_offer, /*creator=*/true, /*defer_tx=*/false);
@@ -2308,8 +3112,9 @@ int worker_start(Worker* w) {
 extern "C" {
 
 // 2: sm transport; 3: op deadlines + PING/PONG peer liveness;
-// 4: swtrace observability (sw_counters/sw_trace)
-const char* sw_version() { return "starway-native-4"; }
+// 4: swtrace observability (sw_counters/sw_trace);
+// 5: resilient sessions (T_SEQ/T_ACK, "sess" handshake, sw_set_event_cb)
+const char* sw_version() { return "starway-native-5"; }
 
 // Portable cursor atomics for the Python engine's sm ring (sw_engine.h).
 // std::atomic_ref would be C++20-tidy but libstdc++'s needs alignment UB
@@ -2639,6 +3444,9 @@ int sw_counters(void* h, char* out, int cap) {
       c.gather_passes.load(),  c.gather_items.load(),
       c.staging_hits.load(),   c.staging_misses.load(),
       c.ka_misses.load(),      c.reconnects.load(),
+      c.sessions_resumed.load(), c.frames_replayed.load(),
+      c.dup_frames_dropped.load(),
+      c.acks_tx.load(),        c.acks_rx.load(),
   };
   constexpr size_t kN = sizeof(kCounterNames) / sizeof(kCounterNames[0]);
   static_assert(sizeof(vals) / sizeof(vals[0]) == kN,
@@ -2687,6 +3495,17 @@ int sw_trace(void* h, char* out, int cap) {
   out[off++] = ']';
   out[off] = 0;
   return off;
+}
+
+// Engine-event notifications (session resume/expiry) for the wrapper's
+// flight recorder.  Persistent registration; fires on the engine thread
+// with no locks held (FireList discipline).  Install before
+// listen/connect.
+void sw_set_event_cb(void* h, sw_event_cb cb, void* ctx) {
+  Worker* w = W(h);
+  std::lock_guard<std::mutex> g(w->mu);
+  w->event_cb = cb;
+  w->event_cb_ctx = ctx;
 }
 
 // Destructor path: never blocks, never fails.  Signals close if running and

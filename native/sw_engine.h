@@ -58,14 +58,22 @@ typedef void (*sw_accept_cb)(void* ctx, uint64_t conn_id);
 /* Connect outcome: status == "" on success, error text otherwise. */
 typedef void (*sw_status_cb)(void* ctx, const char* status);
 
+/* Engine lifecycle event (resilient sessions, DESIGN.md §14): `event` is
+ * a static string ("session-resume" / "session-expired"), valid for the
+ * duration of the call.  The wrapper uses these as flight-recorder dump
+ * triggers (core/swtrace.py). */
+typedef void (*sw_event_cb)(void* ctx, const char* event, uint64_t conn_id);
+
 /* ----------------------------------------------------------- lifecycle */
 
 /* Engine identification string: op deadlines + PING/PONG peer liveness +
- * swtrace observability (sw_counters/sw_trace).  The annotation below is
- * machine-checked against the sw_engine.cpp implementation by the contract
- * checker (python -m starway_tpu.analysis, rule contract-version) -- bump
- * BOTH when the protocol changes.
- * swcheck: engine-version "starway-native-4" */
+ * swtrace observability (sw_counters/sw_trace) + resilient sessions
+ * (T_SEQ/T_ACK sequence-numbered exactly-once delivery, replay journal,
+ * transparent resume -- negotiated via "sess", DESIGN.md §14).  The
+ * annotation below is machine-checked against the sw_engine.cpp
+ * implementation by the contract checker (python -m starway_tpu.analysis,
+ * rule contract-version) -- bump BOTH when the protocol changes.
+ * swcheck: engine-version "starway-native-5" */
 const char* sw_version(void);
 
 /* Allocate a client/server worker in the VOID state.  `worker_id` is the
@@ -240,6 +248,19 @@ void sw_devpull_purge(void* h, uint64_t remote_id);
 int sw_send_devpull(void* h, uint64_t conn_id, uint64_t tag,
                     const char* body, uint64_t len,
                     sw_done_cb done, sw_fail_cb fail, void* ctx);
+
+/* ------------------------------------------------------------- sessions
+ *
+ * Resilient sessions (DESIGN.md §14; negotiated via the "sess" handshake
+ * key when STARWAY_SESSION=1).  The engine implements the whole state
+ * machine internally -- sequence-numbered delivery (T_SEQ), cumulative
+ * ACKs (T_ACK), the bounded replay journal, transparent suspend/redial/
+ * resume -- and surfaces only two observable edges to the wrapper:
+ * op failures carrying the stable "session expired" reason, and the
+ * lifecycle events below.  Install before listen/connect; persistent
+ * registration, fired on the engine thread with no locks held.  The
+ * wrapper (core/native.py) uses them as flight-recorder dump triggers. */
+void sw_set_event_cb(void* h, sw_event_cb cb, void* ctx);
 
 /* Destructor path: never blocks, never fails.  Signals close if RUNNING
  * and drops the caller's reference; the engine thread frees the worker

@@ -18,24 +18,25 @@ rm -rf "$WORK"
 mkdir -p "$WORK"
 WORK="$(cd "$WORK" && pwd)"   # later steps cd around; must be absolute
 
-echo "== 1/7 swcheck: cross-engine contract + concurrency lint"
+echo "== 1/8 swcheck: cross-engine contract + concurrency lint"
 # Nothing ships until the two engines agree on the wire format, shm
 # layout, ABI, and reason strings (python -m starway_tpu.analysis,
 # DESIGN.md §11).  Runs from the repo tree, before any artifact exists.
 python -m starway_tpu.analysis
 
-echo "== 2/7 sdist build (python -m build --sdist --no-isolation)"
+echo "== 2/8 sdist build (python -m build --sdist --no-isolation)"
 python -m build --sdist --no-isolation --outdir "$WORK/dist" . >"$WORK/build.log" 2>&1 \
   || { tail -20 "$WORK/build.log"; exit 1; }
 SDIST="$(ls "$WORK"/dist/*.tar.gz)"
 echo "   $SDIST"
 
-echo "== 3/7 sdist completeness (native sources + tests ship)"
+echo "== 3/8 sdist completeness (native sources + tests ship)"
 tar tzf "$SDIST" | sed 's|^[^/]*/||' | sort > "$WORK/filelist"
 for f in native/sw_engine.cpp native/sw_engine.h native/CMakeLists.txt \
          tests/test_basic.py tests/conftest.py starway_tpu/api.py \
          starway_tpu/models/llama.py starway_tpu/native_build.py \
-         starway_tpu/analysis/__main__.py tests/test_swcheck.py; do
+         starway_tpu/analysis/__main__.py tests/test_swcheck.py \
+         tests/test_session.py scripts/session_chaos.py; do
   grep -qx "$f" "$WORK/filelist" || { echo "MISSING from sdist: $f"; exit 1; }
 done
 if grep -qx "starway_tpu/_sw_native.so" "$WORK/filelist"; then
@@ -43,7 +44,7 @@ if grep -qx "starway_tpu/_sw_native.so" "$WORK/filelist"; then
 fi
 echo "   $(wc -l < "$WORK/filelist") files; native sources + tests present, no prebuilt .so"
 
-echo "== 4/7 wheel built FROM the sdist tree; installed into a fresh venv"
+echo "== 4/8 wheel built FROM the sdist tree; installed into a fresh venv"
 mkdir -p "$WORK/src"
 tar xzf "$SDIST" -C "$WORK/src" --strip-components=1
 # The wheel is built from the unpacked sdist (exactly what cibuildwheel
@@ -73,21 +74,30 @@ print("   installed import ok:", starway_tpu.__file__)
 PY
 )
 
-echo "== 5/7 native engine built from the sdist's own sources"
+echo "== 5/8 native engine built from the sdist's own sources"
 (cd "$WORK/src" && "$VPY" -m starway_tpu.native_build >"$WORK/native_build.log" 2>&1) \
   || { tail -20 "$WORK/native_build.log"; exit 1; }
 ls -la "$WORK/src/starway_tpu/_sw_native.so"
 
-echo "== 6/7 smoke tests from the sdist tree on the venv interpreter"
+echo "== 6/8 smoke tests from the sdist tree on the venv interpreter"
 (cd "$WORK/src" && "$VPY" -m pytest \
     tests/test_matching.py tests/test_protocol.py \
     "tests/test_basic.py::test_client_to_server_send_recv[inproc]" -q)
 
-echo "== 7/7 fault-injection smoke (drop + partition, small payloads)"
+echo "== 7/8 fault-injection smoke (drop + partition, small payloads)"
 # The shipped FaultProxy harness against the shipped engines: a mid-frame
 # drop and a partition-driven timeout/liveness slice, small payloads only
 # (the long soaks are @slow and excluded).
 (cd "$WORK/src" && "$VPY" -m pytest tests/test_faults.py -q -m "not slow" \
     -k "drop or partition or repost")
+
+echo "== 8/8 session-chaos smoke (resets mid-burst, exactly-once oracle)"
+# The shipped resilient-session layer (STARWAY_SESSION, DESIGN.md §14)
+# through the shipped FaultProxy: periodic connection resets mid-burst,
+# swtrace counters prove every op completed exactly once.  Both engines
+# (the sdist tree built its own native engine in step 5).
+(cd "$WORK/src" && "$VPY" scripts/session_chaos.py --cycles 3)
+(cd "$WORK/src" && "$VPY" scripts/session_chaos.py --cycles 3 \
+    --server-engine native --client-engine native)
 
 echo "RELEASE SMOKE: OK ($SDIST)"

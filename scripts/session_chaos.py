@@ -1,0 +1,127 @@
+"""session-chaos smoke: a loopback pair through FaultProxy under periodic
+connection resets, with swtrace counters as the exactly-once oracle.
+
+The CI twin of tests/test_session.py::test_session_chaos_soak (which is
+the @slow long variant): every cycle posts a burst of arecv/asend, kills
+the proxied connection mid-burst with an RST, and the resilient-session
+layer (STARWAY_SESSION=1, DESIGN.md §14) must redial + replay so that
+every posted asend/arecv/aflush completes exactly once -- the server's
+``recvs_completed`` counter equals the total posted, at least one
+``sessions_resumed`` is recorded, no duplicate delivery escapes the
+``dup_frames_dropped`` dedup, and no op fails.
+
+Runs on both engines (the env is sampled at worker construction, so the
+roles can differ):
+
+    python scripts/session_chaos.py --server-engine native --client-engine py
+
+Exit 0 and one JSON result line on success; non-zero with a diagnostic on
+any lost, duplicated, or failed op.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+# Runnable straight from a checkout (CI, release_smoke's sdist tree).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--server-engine", choices=("py", "native"), default="py")
+    ap.add_argument("--client-engine", choices=("py", "native"), default="py")
+    ap.add_argument("--cycles", type=int, default=4,
+                    help="kill/resume cycles (default 4)")
+    ap.add_argument("--n", type=int, default=15,
+                    help="ops per cycle (default 15)")
+    ap.add_argument("--size", type=int, default=4096,
+                    help="payload bytes per op (default 4096)")
+    return ap.parse_args()
+
+
+async def _main(args) -> int:
+    # Env before any worker is built: workers sample it at construction.
+    os.environ["STARWAY_TLS"] = "tcp"
+    os.environ["STARWAY_SESSION"] = "1"
+    os.environ.setdefault("STARWAY_SESSION_GRACE", "30")
+
+    import socket
+
+    import numpy as np
+
+    from starway_tpu import Client, Server
+    from starway_tpu.testing.faults import FaultProxy
+
+    with socket.socket() as s:  # a free loopback port for the server
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    os.environ["STARWAY_NATIVE"] = "1" if args.server_engine == "native" else "0"
+    server = Server()
+    server.listen("127.0.0.1", port)
+    proxy = FaultProxy("127.0.0.1", port).start()
+    os.environ["STARWAY_NATIVE"] = "1" if args.client_engine == "native" else "0"
+    client = Client()
+    await client.aconnect("127.0.0.1", proxy.port)
+
+    total = 0
+    t0 = time.monotonic()
+    try:
+        for cycle in range(args.cycles):
+            n, size, tag0 = args.n, args.size, cycle * 1000
+            bufs = [np.zeros(size, dtype=np.uint8) for _ in range(n)]
+            recvs = [server.arecv(bufs[i], tag0 + i, (1 << 64) - 1)
+                     for i in range(n)]
+            sends = []
+            for i in range(n):
+                sends.append(client.asend(
+                    np.full(size, (tag0 + i) % 251, dtype=np.uint8), tag0 + i))
+                if i == n // 2:
+                    await asyncio.sleep(0.2)  # let part of the burst fly
+                    proxy.kill_all(rst=True)  # the periodic conn reset
+            await asyncio.wait_for(asyncio.gather(*sends), timeout=60)
+            await asyncio.wait_for(client.aflush(), timeout=60)
+            res = await asyncio.wait_for(asyncio.gather(*recvs), timeout=60)
+            for i, (stag, ln) in enumerate(res):
+                assert stag == tag0 + i and ln == size, (cycle, i, stag, ln)
+                assert bufs[i][0] == (tag0 + i) % 251, (cycle, i)
+                assert bufs[i][-1] == (tag0 + i) % 251, (cycle, i)
+            total += n
+
+        ss = server._server.counters_snapshot()
+        cs = client._client.counters_snapshot()
+        report = {
+            "server_engine": args.server_engine,
+            "client_engine": args.client_engine,
+            "cycles": args.cycles,
+            "ops": total,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+            "recvs_completed": ss["recvs_completed"],
+            "sessions_resumed": cs["sessions_resumed"] + ss["sessions_resumed"],
+            "frames_replayed": cs["frames_replayed"],
+            "dup_frames_dropped": ss["dup_frames_dropped"],
+            "ops_failed": cs["ops_timed_out"] + ss["ops_timed_out"],
+        }
+        # The exactly-once oracle: each posted recv completed ONCE (the
+        # matcher never double-fires a future, so == total also rules out
+        # duplicate delivery), and the outage was ridden through by
+        # resume, not by fresh conns.
+        ok = (ss["recvs_completed"] == total
+              and report["sessions_resumed"] >= 1)
+        report["ok"] = ok
+        print(json.dumps(report))
+        return 0 if ok else 1
+    finally:
+        for obj in (client, server):
+            try:
+                await asyncio.wait_for(obj.aclose(), timeout=10)
+            except Exception:
+                pass
+        proxy.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(_main(_parse())))

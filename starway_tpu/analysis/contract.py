@@ -36,10 +36,13 @@ _REASON_PAIRS = [
     ("REASON_NOT_CONNECTED", "kNotConnected", "not connected"),
     ("REASON_TRUNCATED", "kTruncated", "truncated"),
     ("REASON_TIMEOUT", "kTimedOut", "timed out"),
+    ("REASON_SESSION_EXPIRED", "kSessionExpired", "session expired"),
 ]
 
 # Negotiated handshake keys: offered in HELLO, confirmed in HELLO_ACK.
-_HANDSHAKE_KEYS = ["ka", "sm", "devpull"]
+# "sess" is the resilient-session negotiation (DESIGN.md §14; carries the
+# sess_id/sess_epoch/sess_ack triple alongside it).
+_HANDSHAKE_KEYS = ["ka", "sm", "devpull", "sess"]
 
 # Normalised C type -> acceptable canonical ctypes spellings.
 _C2CTYPES = {

@@ -86,6 +86,32 @@ benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
     Silent keepalive intervals tolerated before a peer is declared dead
     (default 3).
 
+``STARWAY_SESSION``
+    "1" = negotiate the resilient-session layer (off by default for seed
+    parity).  Session-enabled Client<->Server pairs survive connection
+    death mid-transfer: HELLO carries a stable session id + epoch, every
+    eager DATA/ctl frame is sequence-numbered (frames.py T_SEQ), receivers
+    ACK cumulatively (T_ACK) and drop duplicate seqs, senders keep a
+    bounded replay journal of unacked frames, and on conn death the client
+    transparently redials (exponential backoff) and both sides replay from
+    the peer's cumulative ACK -- in-flight asend/arecv/aflush complete
+    late instead of failing.  Only session expiry
+    (``STARWAY_SESSION_GRACE`` exceeded, or the peer answers the resume
+    handshake with a new epoch) fails them, with the stable
+    ``"session expired"`` reason.  See DESIGN.md §14.
+
+``STARWAY_SESSION_JOURNAL_BYTES``
+    Replay-journal cap per connection direction in bytes (default 16 MiB).
+    When unacknowledged journaled frames reach the cap, further sends
+    *block* (they park unframed and drain as ACKs free space) instead of
+    growing the journal without bound.
+
+``STARWAY_SESSION_GRACE``
+    Seconds a dead session-enabled connection may stay resumable (default
+    30).  Past the grace window the session expires: suspended ops fail
+    with ``"session expired"`` and the seed failure contract applies from
+    then on.
+
 ``STARWAY_TRACE``
     "1" = record per-op lifecycle events (posted/matched/completed/
     failed, stage spans, connection churn) into a bounded per-worker ring
@@ -122,6 +148,9 @@ __all__ = [
     "connect_timeout",
     "keepalive_interval",
     "keepalive_misses",
+    "session_enabled",
+    "session_journal_bytes",
+    "session_grace",
     "trace_enabled",
     "trace_ring_size",
     "flight_dir",
@@ -221,6 +250,32 @@ def keepalive_misses() -> int:
     except ValueError:
         return 3
     return v if v > 0 else 3
+
+
+def session_enabled() -> bool:
+    """Resilient-session layer (STARWAY_SESSION); off by default --
+    seed parity: a dropped conn cancels every in-flight op."""
+    return _env("STARWAY_SESSION", "0") not in ("", "0")
+
+
+def session_journal_bytes() -> int:
+    """Replay-journal cap per conn direction (STARWAY_SESSION_JOURNAL_BYTES);
+    sends block (park unframed) when unacked journaled bytes reach it."""
+    try:
+        v = int(_env("STARWAY_SESSION_JOURNAL_BYTES", str(16 * 1024 * 1024)))
+    except ValueError:
+        return 16 * 1024 * 1024
+    return max(4096, v)
+
+
+def session_grace() -> float:
+    """Seconds a dead session conn stays resumable (STARWAY_SESSION_GRACE);
+    past it the session expires and ops fail with "session expired"."""
+    try:
+        v = float(_env("STARWAY_SESSION_GRACE", "30"))
+    except ValueError:
+        return 30.0
+    return v if v > 0 else 30.0
 
 
 def trace_enabled() -> bool:

@@ -87,7 +87,8 @@ class TxData:
     # weakly, so a completed send's payload is not pinned until its timer
     # would have fired.
     __slots__ = ("header", "payload", "nbytes", "off", "done", "fail",
-                 "owner", "rndv", "local_done", "switch_after",
+                 "owner", "rndv", "local_done", "switch_after", "counted",
+                 "sess_seq", "sess_nbytes",
                  "_chunk_start", "_chunk_view", "__weakref__")
 
     def __init__(self, tag: int, payload, done, fail, owner):
@@ -108,6 +109,9 @@ class TxData:
         self.rndv = self.nbytes > config.rndv_threshold()
         self.local_done = False
         self.switch_after = False
+        self.counted = False  # sends_completed recorded (replay must not re-count)
+        self.sess_seq = 0     # session sequence number (0 = unframed)
+        self.sess_nbytes = 0  # journal accounting (prefix + header + payload)
 
     @property
     def total(self) -> int:
@@ -189,11 +193,38 @@ class TxData:
             if self.done is not None:
                 fires.append(self.done)
 
-    def cancel(self, fires: list) -> None:
+    def cancel(self, fires: list, reason: str = REASON_CANCELLED) -> None:
         if not self.local_done:
             self.local_done = True
             if self.fail is not None:
-                fires.append(lambda f=self.fail: f(REASON_CANCELLED))
+                fires.append(lambda f=self.fail, r=reason: f(r))
+
+    # ------------------------------------------------------------ session
+    def sess_wrap(self, seq: int, prefix: bytes) -> None:
+        """Frame for the session layer: embed the T_SEQ prefix and, for
+        eager flat payloads, snapshot the bytes -- the user may legally
+        reuse the buffer once ``done`` fires, and a later replay must
+        resend what was originally promised.  Rendezvous payloads stay
+        by-reference (delivery is only promised after a flush; the
+        journal pins the payload object until the peer ACKs -- the §14
+        stability contract).  Eager payloads are always flat host views
+        here: device.py keeps the lazy-chunked pipeline off session
+        conns, so the snapshot below covers every eager frame."""
+        self.sess_seq = seq
+        self.header = prefix + self.header
+        if not self.rndv and isinstance(self.payload, memoryview):
+            # swcheck: allow(hotpath-copy): journal must own eager payload bytes past local completion (session opt-in)
+            snap = memoryview(bytes(self.payload))
+            self.payload = snap
+            self._chunk_view = snap
+            self._chunk_start = 0
+            self.owner = None
+        self.sess_nbytes = self.total
+
+    def reset_for_replay(self) -> None:
+        self.off = 0
+        self._chunk_start = 0
+        self._chunk_view = self.payload if isinstance(self.payload, memoryview) else None
 
 
 class TxDevpull:
@@ -202,7 +233,8 @@ class TxDevpull:
     descriptor fully handed to the transport (eager semantics: the array
     itself is already registered for pull)."""
 
-    __slots__ = ("data", "off", "done", "fail", "owner", "switch_after")
+    __slots__ = ("data", "off", "done", "fail", "owner", "switch_after",
+                 "counted", "sess_seq", "sess_nbytes")
 
     def __init__(self, data: bytes, done, fail, owner):
         self.data = data
@@ -211,6 +243,9 @@ class TxDevpull:
         self.fail = fail
         self.owner = owner
         self.switch_after = False
+        self.counted = False
+        self.sess_seq = 0
+        self.sess_nbytes = 0
 
     @property
     def remaining(self) -> int:
@@ -238,11 +273,19 @@ class TxDevpull:
             fires.append(done)
         return True
 
-    def cancel(self, fires: list) -> None:
+    def cancel(self, fires: list, reason: str = REASON_CANCELLED) -> None:
         if self.done is not None and self.fail is not None:
             fail, self.fail = self.fail, None
             self.done = None
-            fires.append(lambda: fail(REASON_CANCELLED))
+            fires.append(lambda r=reason: fail(r))
+
+    def sess_wrap(self, seq: int, prefix: bytes) -> None:
+        self.sess_seq = seq
+        self.data = prefix + self.data
+        self.sess_nbytes = len(self.data)
+
+    def reset_for_replay(self) -> None:
+        self.off = 0
 
 
 class TxCtl:
@@ -254,12 +297,14 @@ class TxCtl:
     so stream bytes can never follow the ACK onto the socket.
     """
 
-    __slots__ = ("data", "off", "switch_after")
+    __slots__ = ("data", "off", "switch_after", "sess_seq", "sess_nbytes")
 
     def __init__(self, data: bytes, switch_after: bool = False):
         self.data = data
         self.off = 0
         self.switch_after = switch_after
+        self.sess_seq = 0     # nonzero on sequenced session ctl (FLUSH/FLUSH_ACK)
+        self.sess_nbytes = 0
 
     @property
     def remaining(self) -> int:
@@ -281,8 +326,16 @@ class TxCtl:
             self.off += n
         return True
 
-    def cancel(self, fires: list) -> None:
+    def cancel(self, fires: list, reason: str = REASON_CANCELLED) -> None:
         pass
+
+    def sess_wrap(self, seq: int, prefix: bytes) -> None:
+        self.sess_seq = seq
+        self.data = prefix + self.data
+        self.sess_nbytes = len(self.data)
+
+    def reset_for_replay(self) -> None:
+        self.off = 0
 
 
 class BaseConn:
@@ -362,6 +415,15 @@ class TcpConn(BaseConn):
         self.devpull_ok = False
         self._remote_msgs: set = set()
         self._deferred_flush_acks: list = []
+        # Resilient-session state (core/session.py; negotiated via the
+        # "sess" handshake key).  None on seed-parity conns: every session
+        # hook below is a single `is None` check.
+        self.sess = None
+        self._sess_pending = None   # seq announced by the last T_SEQ
+        self._sess_drop = False     # next frame is a duplicate: drain + drop
+        self._rx_skip = 0           # dup-frame payload bytes left to drain
+        self._sess_ack_armed = False  # idle ACK timer outstanding
+        self.sess_fail_reason = None  # flush-failure override at expiry
         if mode == "socket":
             try:
                 self.local_addr, self.local_port = sock.getsockname()[:2]
@@ -493,6 +555,9 @@ class TcpConn(BaseConn):
         self.dirty = True
         self._data_counter += 1
         item = TxData(tag, payload, done, fail, owner)
+        if self.sess is not None:
+            self._sess_submit(item, fires, kick)
+            return item
         self.tx.append(item)
         if kick:
             self.kick_tx(fires)
@@ -500,7 +565,21 @@ class TcpConn(BaseConn):
 
     def send_flush(self, seq: int, fires: list) -> None:
         self._flush_marks[seq] = self._data_counter
-        self.tx.append(TxCtl(frames.pack_flush(seq)))
+        item = TxCtl(frames.pack_flush(seq))
+        if self.sess is not None:
+            self._sess_submit(item, fires, True)
+            return
+        self.tx.append(item)
+        self.kick_tx(fires)
+
+    def send_flush_ack(self, seq: int, fires: list) -> None:
+        """FLUSH_ACK is a *sequenced* session frame (a barrier ACK lost
+        with a conn must replay, or the peer's flush hangs forever)."""
+        item = TxCtl(frames.pack_flush_ack(seq))
+        if self.sess is not None:
+            self._sess_submit(item, fires, True)
+            return
+        self.tx.append(item)
         self.kick_tx(fires)
 
     def on_flush_acked(self, seq: int) -> None:
@@ -529,9 +608,196 @@ class TcpConn(BaseConn):
             return
         self.dirty = True
         self._data_counter += 1
-        self.tx.append(TxDevpull(data, done, fail, owner))
+        item = TxDevpull(data, done, fail, owner)
+        if self.sess is not None:
+            self._sess_submit(item, fires, kick)
+            return
+        self.tx.append(item)
         if kick:
             self.kick_tx(fires)
+
+    # ------------------------------------------------------------- session
+    @staticmethod
+    def _sess_wire_bytes(item) -> int:
+        """Wire footprint of an unframed item (payload + frame header +
+        the T_SEQ prefix it will gain)."""
+        base = item.total if isinstance(item, TxData) else len(item.data)
+        return base + frames.HEADER_SIZE
+
+    def _sess_frame(self, item) -> None:
+        seq = self.sess.next_seq()
+        item.sess_wrap(seq, frames.pack_seq(seq))
+        self.sess.journal_add(item, item.sess_nbytes)
+
+    def _sess_submit(self, item, fires: list, kick: bool) -> None:
+        """Frame + journal + queue a session frame, or park it when the
+        journal is at its byte cap (backpressure: the send completes late
+        instead of the journal OOMing).  Parked items keep FIFO order."""
+        sess = self.sess
+        if not sess.has_room(self._sess_wire_bytes(item)):
+            sess.waiting.append(item)
+            return
+        self._sess_frame(item)
+        self.tx.append(item)
+        if kick:
+            self.kick_tx(fires)
+
+    def _sess_drain_waiting(self) -> bool:
+        """Move parked items into the journal/tx as ACKs free room.
+        Returns True when anything moved (caller kicks)."""
+        sess = self.sess
+        moved = False
+        while sess.waiting:
+            item = sess.waiting[0]
+            nb = self._sess_wire_bytes(item)
+            if sess.journal and sess.journal_bytes + nb > sess.journal_cap:
+                break
+            sess.waiting.popleft()
+            self._sess_frame(item)
+            self.tx.append(item)
+            moved = True
+        return moved
+
+    def _on_ack(self, cum_seq: int, fires: list) -> None:
+        """Peer's cumulative ACK: trim the journal, unblock parked sends."""
+        self._ctr.acks_rx += 1
+        self.sess.journal_trim(cum_seq)
+        if self._sess_drain_waiting():
+            self.kick_tx(fires)
+
+    def _on_seq(self, seq: int, fires: list) -> bool:
+        """T_SEQ announcing the next frame's sequence number.  Returns
+        False when the conn was torn down (seq gap)."""
+        sess = self.sess
+        if sess is None:
+            # Peer speaks the session protocol on a conn that never
+            # negotiated it: protocol violation.
+            self.worker._conn_broken(self, fires)
+            return False
+        if seq <= sess.rx_cum:
+            # Already processed (a replay overlap): drain + drop the frame.
+            self._ctr.dup_frames_dropped += 1
+            self._sess_drop = True
+        elif seq == sess.rx_cum + 1:
+            self._sess_pending = seq
+        else:
+            # Gap inside one incarnation (reordered/corrupted relay): the
+            # framed stream cannot be repaired in place -- reset and let
+            # the resume handshake replay from the cumulative ACK.
+            self.worker._conn_broken(self, fires)
+            return False
+        return True
+
+    def _sess_commit(self) -> None:
+        """The sequenced frame announced by the last T_SEQ was fully
+        processed: advance the cumulative counter and make sure an ACK
+        eventually goes out even if no further reads piggyback one."""
+        if self._sess_pending is None:
+            return
+        self.sess.rx_cum = self._sess_pending
+        self._sess_pending = None
+        if not self._sess_ack_armed:
+            self._sess_ack_armed = True
+            self.worker._add_timer(0.2, self._sess_ack_tick)
+
+    def _sess_ack_tick(self, fires: list) -> None:
+        self._sess_ack_armed = False
+        self._sess_maybe_ack(fires)
+
+    def _sess_maybe_ack(self, fires: list) -> None:
+        """Piggybacked cumulative ACK: sent at the end of a read pass (and
+        from the idle timer) whenever rx progress is unacknowledged."""
+        sess = self.sess
+        if sess is None or not self.alive or sess.suspended:
+            return
+        if sess.rx_cum > sess.acked_sent:
+            sess.acked_sent = sess.rx_cum
+            self._ctr.acks_tx += 1
+            self.send_ctl(frames.pack_ack(sess.acked_sent), fires)
+
+    def suspend(self, fires: list) -> None:
+        """The transport died but the session is resumable: drop the
+        socket and all per-incarnation parser state, keep every queue,
+        journal, and flush bookkeeping.  The conn stays ``alive`` so
+        flush barriers keep waiting and new sends keep queueing -- they
+        complete after resume instead of failing."""
+        sess = self.sess
+        sess.suspend()
+        self.worker._unregister_conn_io(self)
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+        # rx parser reset: the replayed stream restarts at a frame boundary.
+        self._hdr_got = 0
+        self._ctl = None
+        self._rx_skip = 0
+        self._sess_drop = False
+        self._sess_pending = None
+        msg, self._rx_msg = self._rx_msg, None
+        if msg is not None:
+            with self.worker.lock:
+                pr = msg.posted
+                if pr is not None and not msg.complete:
+                    # Re-arm the stranded receive at the FRONT of the
+                    # queue: the replayed frame must claim the same
+                    # receive (its buffer was partially written; the
+                    # replay rewrites it from the start).
+                    msg.posted = None
+                    pr.claimed = False
+                    self.worker.matcher.purge_inflight(msg)
+                    self.worker.matcher.posted.appendleft(pr)
+                else:
+                    self.worker.matcher.purge_inflight(msg)
+        # Journaled frames replay from the journal; bare per-incarnation
+        # ctl (PING/PONG/ACK) queued on the old transport dies with it.
+        self.tx.clear()
+        self._db_out = bytearray()
+        self._want_write = False
+        self._tx_want_sock = False
+
+    def resume(self, sock: socket.socket, peer_ack: int, fires: list,
+               ack_ctl: Optional[bytes] = None) -> None:
+        """A reconnect re-handshake matched this session: adopt the new
+        socket, trim the journal by the peer's cumulative ACK (carried in
+        the handshake), and replay everything past it.  ``ack_ctl`` is the
+        acceptor's HELLO_ACK -- it must precede replayed frames on the
+        wire."""
+        sess = self.sess
+        sock.setblocking(False)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self.sock = sock
+        self.last_rx = time.monotonic()
+        sess.resume()
+        sess.journal_trim(peer_ack)
+        # The handshake carried our rx_cum as sess_ack: the peer starts
+        # from it, so there is nothing older to re-ACK.
+        sess.acked_sent = sess.rx_cum
+        # Frames queued while suspended are all journaled (submit framing
+        # happens at queue time): rebuild tx purely from the journal, or
+        # those items would ride the wire twice.
+        self.tx.clear()
+        self._ctr.sessions_resumed += 1
+        if ack_ctl is not None:
+            self.tx.append(TxCtl(ack_ctl))
+        replayed = 0
+        for item in sess.journal:
+            item.reset_for_replay()
+            self.tx.append(item)
+            replayed += 1
+        self._ctr.frames_replayed += replayed
+        self._sess_drain_waiting()  # trim may have freed journal room
+        tr = getattr(self.worker, "_trace", None)
+        if tr is not None:
+            tr.rec(swtrace.EV_SESS_RESUME, 0, self.conn_id, replayed)
+        swtrace.flight_dump("session-resume", self.worker)
+        self.worker._register_conn_io(self)
+        self.kick_tx(fires)
 
     # ------------------------------------------------- devpull rx tracking
     def remote_received(self, msg) -> None:
@@ -557,7 +823,7 @@ class TcpConn(BaseConn):
         self._deferred_flush_acks = remaining
         if self.alive:
             for seq, _ in ready:
-                self.send_ctl(frames.pack_flush_ack(seq), fires)
+                self.send_flush_ack(seq, fires)
 
     def _gather_tx(self) -> tuple[list, list]:
         """Collect unwritten views across queued items for one sendmsg pass
@@ -588,8 +854,8 @@ class TcpConn(BaseConn):
         return views, spans
 
     def kick_tx(self, fires: list) -> None:
-        if not self.alive:
-            return
+        if not self.alive or self.sock is None:
+            return  # dead, or session-suspended (resume re-kicks)
         t0 = self.sm_tx.tail if self.sm_active else 0
         blocked = False
         try:
@@ -600,7 +866,8 @@ class TcpConn(BaseConn):
                         blocked = True
                         break
                     self.tx.popleft()
-                    if not isinstance(item, TxCtl):
+                    if not isinstance(item, TxCtl) and not item.counted:
+                        item.counted = True
                         self._ctr.sends_completed += 1
                     continue
                 # Socket: one gathered sendmsg per pass across queued items
@@ -633,7 +900,8 @@ class TcpConn(BaseConn):
                     n -= adv
                     if item.remaining == 0 and self.tx and self.tx[0] is item:
                         self.tx.popleft()
-                        if not isinstance(item, TxCtl):
+                        if not isinstance(item, TxCtl) and not item.counted:
+                            item.counted = True
                             ctr.sends_completed += 1
                         if getattr(item, "switch_after", False):
                             # The sm switch point (HELLO_ACK) left the
@@ -714,6 +982,7 @@ class TcpConn(BaseConn):
     def on_readable(self, fires: list) -> None:
         if not self.sm_active:
             self._pump_frames(fires)
+            self._sess_maybe_ack(fires)  # piggybacked cumulative ACK
             return
         # sm mode: the socket carries only doorbells (and EOF/RST).  Drain
         # it, then pump the ring.  On EOF the peer is gone, but bytes it
@@ -754,6 +1023,24 @@ class TcpConn(BaseConn):
         matcher = self.worker.matcher
         lock = self.worker.lock
         while self.alive:
+            if self._rx_skip:
+                # Duplicate sequenced frame: drain its payload to scratch
+                # without touching the matcher (exactly-once delivery).
+                if self._scratch is None:
+                    self._scratch = bytearray(RX_CHUNK)
+                target = memoryview(self._scratch)[: min(self._rx_skip, RX_CHUNK)]
+                try:
+                    n = self._rx_read(target)
+                except BlockingIOError:
+                    return
+                except (ConnectionResetError, OSError):
+                    self.worker._conn_broken(self, fires)
+                    return
+                if n == 0:
+                    self.worker._conn_broken(self, fires)
+                    return
+                self._rx_skip -= n
+                continue
             m = self._rx_msg
             if m is not None:
                 remaining = m.length - m.received
@@ -784,6 +1071,7 @@ class TcpConn(BaseConn):
                     with lock:
                         fires.extend(matcher.on_message_complete(m))
                     self._rx_msg = None
+                    self._sess_commit()
                 continue
             if self._ctl is not None:
                 ftype, body, got, a = self._ctl
@@ -808,6 +1096,7 @@ class TcpConn(BaseConn):
                     self.worker._on_hello(self, info, fires)
                 elif ftype == frames.T_DEVPULL:
                     self.worker._on_devpull(self, a, info, fires)
+                    self._sess_commit()
                 else:
                     self.worker._on_hello_ack(self, info, fires)
                 continue
@@ -828,6 +1117,11 @@ class TcpConn(BaseConn):
             self._hdr_got = 0
             ftype, a, b = frames.unpack_header(self._hdr)
             if ftype == frames.T_DATA:
+                if self._sess_drop:
+                    self._sess_drop = False
+                    if b:
+                        self._rx_skip = b
+                    continue
                 with lock:
                     msg, f = matcher.on_message_start(a, b)
                     fires.extend(f)
@@ -835,7 +1129,13 @@ class TcpConn(BaseConn):
                         fires.extend(matcher.on_message_complete(msg))
                     else:
                         self._rx_msg = msg
+                if b == 0:
+                    self._sess_commit()
             elif ftype == frames.T_FLUSH:
+                if self._sess_drop:
+                    self._sess_drop = False
+                    continue
+                self._sess_commit()
                 if self._remote_msgs:
                     # Unresolved pulls precede this barrier in the stream:
                     # defer the ACK until they land (the sender's flush must
@@ -844,9 +1144,28 @@ class TcpConn(BaseConn):
                     self.defer_flush_ack(a)
                     self.worker._force_start_pulls(self, fires)
                 else:
-                    self.send_ctl(frames.pack_flush_ack(a), fires)
+                    self.send_flush_ack(a, fires)
             elif ftype == frames.T_FLUSH_ACK:
+                if self._sess_drop:
+                    self._sess_drop = False
+                    continue
+                self._sess_commit()
                 self.worker._on_flush_ack(self, a, fires)
+            elif ftype == frames.T_SEQ:
+                if not self._on_seq(a, fires):
+                    return
+            elif ftype == frames.T_ACK:
+                if self.sess is not None:
+                    self._on_ack(a, fires)
+            elif ftype == frames.T_BYE:
+                # Peer's clean local close on a session conn: the session
+                # is over -- the imminent EOF must take the seed/keepalive
+                # death contract (prompt "not connected", no fault dump),
+                # not a grace-window suspend + redial.
+                if self.sess is not None and not self.sess.expired:
+                    self.sess.expired = True
+                    getattr(self.worker, "_sessions", {}).pop(
+                        self.sess.sid, None)
             elif ftype == frames.T_PING:
                 # Liveness probe: answer immediately.  _rx_read already
                 # refreshed last_rx, so receiving PINGs also proves the
@@ -855,12 +1174,36 @@ class TcpConn(BaseConn):
             elif ftype == frames.T_PONG:
                 pass  # proof of life recorded by _rx_read
             elif ftype in (frames.T_HELLO, frames.T_HELLO_ACK, frames.T_DEVPULL):
+                if ftype == frames.T_DEVPULL and self._sess_drop:
+                    self._sess_drop = False
+                    if b:
+                        self._rx_skip = b
+                    continue
                 self._ctl = (ftype, bytearray(b), 0, a)
             else:
                 self.worker._conn_broken(self, fires)
                 return
 
     # --------------------------------------------------------------- close
+    def _cancel_tx_state(self, fires: list,
+                         reason: str = REASON_CANCELLED,
+                         count: bool = True) -> None:
+        """Cancel every queued / journaled / parked tx item exactly once
+        (cancel() is idempotent; journal entries may also sit in tx)."""
+        items = list(self.tx)
+        if self.sess is not None:
+            items.extend(self.sess.journal)
+            items.extend(self.sess.waiting)
+            self.sess.journal.clear()
+            self.sess.journal_bytes = 0
+            self.sess.waiting.clear()
+        for item in items:
+            before = len(fires)
+            item.cancel(fires, reason)
+            if count and len(fires) > before:
+                self._ctr.ops_cancelled += 1
+        self.tx.clear()
+
     def close(self, fires: list) -> None:
         """Close at local shutdown.
 
@@ -871,45 +1214,50 @@ class TcpConn(BaseConn):
         still drain to the peer.
         """
         abort = self.has_unfinished_data_tx()
-        for item in self.tx:
-            before = len(fires)
-            item.cancel(fires)
-            if len(fires) > before:
-                self._ctr.ops_cancelled += 1
-        self.tx.clear()
+        if (self.alive and self.sock is not None and self.sess is not None
+                and not self.sess.suspended and not self.sess.expired
+                and not abort and (not self.tx or self.tx[0].off == 0)):
+            # Clean close on a session conn: tell the peer the session is
+            # over (T_BYE) so it fails over to the seed death contract
+            # instead of suspending for the grace window.  Best-effort --
+            # a lost BYE only costs the peer the grace-expiry fallback.
+            try:
+                self.sock.sendall(frames.pack_bye())
+            except OSError:
+                pass
+        self._cancel_tx_state(fires)
         if self.alive:
             self.alive = False
             self.worker._unregister_conn_io(self)
             try:
-                if abort:
-                    self.sock.setsockopt(
-                        socket.SOL_SOCKET,
-                        socket.SO_LINGER,
-                        socket_linger_struct(),
-                    )
-                self.sock.close()
+                if self.sock is not None:
+                    if abort:
+                        self.sock.setsockopt(
+                            socket.SOL_SOCKET,
+                            socket.SO_LINGER,
+                            socket_linger_struct(),
+                        )
+                    self.sock.close()
             except OSError:
                 pass
+            self.sock = None
         self._close_sm()
 
     def mark_dead(self, fires: list) -> None:
         if self.alive:
             self.alive = False
             self.worker._unregister_conn_io(self)
-            for item in self.tx:
-                before = len(fires)
-                item.cancel(fires)
-                if len(fires) > before:
-                    self._ctr.ops_cancelled += 1
-            self.tx.clear()
+            self._cancel_tx_state(fires)
             if self._rx_msg is not None:
                 with self.worker.lock:
                     self.worker.matcher.purge_inflight(self._rx_msg)
                 self._rx_msg = None
             try:
-                self.sock.close()
+                if self.sock is not None:
+                    self.sock.close()
             except OSError:
                 pass
+            self.sock = None
         self._close_sm()
 
     def transports(self) -> list[tuple[str, str]]:

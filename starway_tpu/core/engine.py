@@ -24,6 +24,7 @@ import heapq
 import itertools
 import json
 import logging
+import random
 import selectors
 import socket
 import threading
@@ -37,11 +38,13 @@ from .. import config, perf
 from ..errors import (
     REASON_CANCELLED,
     REASON_NOT_CONNECTED,
+    REASON_SESSION_EXPIRED,
     REASON_TIMEOUT,
     StarwayStateError,
 )
 from . import fabric, frames, state, swtrace
 from .conn import InprocConn, TcpConn
+from .session import SessionState
 from .endpoint import ServerEndpoint
 from .matching import PostedRecv, TagMatcher
 
@@ -526,12 +529,33 @@ class Worker:
         with self.lock:
             if item.local_done:
                 return  # settled (completed locally, or cancelled)
-            started = item.off > 0
+            # A sequenced session frame was already promised to the peer
+            # (withdrawing it would leave a seq hole the receiver must
+            # treat as a gap): expire it like a started send.
+            started = item.off > 0 or getattr(item, "sess_seq", 0) != 0
+            sess = getattr(conn, "sess", None)
+            if started and sess is not None and not sess.expired:
+                # Live session, sequenced frame: the send is PROMISED.
+                # The journal delivers it -- now, or via a replay after a
+                # suspend -- so failing it "timed out" would lie about an
+                # op the peer still receives (an app-level retry would
+                # then duplicate the message), and tearing down a healthy
+                # conn would force a needless resume cycle.  The op
+                # completes late; only grace/epoch expiry may fail it
+                # (DESIGN.md §14).  Deadlines can still fail a session
+                # send while it is parked UNFRAMED by backpressure (no
+                # seq assigned yet -- the clean-withdraw path below).
+                return
             if not started:
                 try:
                     conn.tx.remove(item)
                 except ValueError:
-                    return  # drained between checks
+                    # Session backpressure may have parked it unframed.
+                    sess = getattr(conn, "sess", None)
+                    if sess is not None and item in sess.waiting:
+                        sess.waiting.remove(item)
+                    else:
+                        return  # drained between checks
             item.local_done = True  # suppress the close-time cancel path
         self.counters.ops_timed_out += 1
         if item.fail is not None:
@@ -562,6 +586,8 @@ class Worker:
         for c in conns:
             if c.kind != "tcp" or not c.alive or not getattr(c, "ka_ok", False):
                 continue
+            if getattr(c, "sess", None) is not None and c.sess.suspended:
+                continue  # no transport to probe; the grace timer governs
             if now - c.last_rx > window:
                 expired.append(c)
             elif now - c.last_rx >= interval:
@@ -669,10 +695,14 @@ class Worker:
             rec.completed = True
             if rec in self.flush_records:
                 self.flush_records.remove(rec)
+            # A session that expired (rather than a bare reset) owns the
+            # failure reason: "session expired" instead of "not connected".
+            reason = next(
+                (c.sess_fail_reason for c in dead
+                 if getattr(c, "sess_fail_reason", None)),
+                REASON_NOT_CONNECTED + " (peer reset during flush)")
             if rec.fail is not None:
-                fires.append(
-                    lambda f=rec.fail: f(REASON_NOT_CONNECTED + " (peer reset during flush)")
-                )
+                fires.append(lambda f=rec.fail, r=reason: f(r))
         elif not pending:
             rec.completed = True
             if rec in self.flush_records:
@@ -720,6 +750,13 @@ class Worker:
         pinned by tests/test_basic.py:250-277) -- only flush barriers
         targeting the connection fail.
 
+        With a live session (STARWAY_SESSION negotiated via "sess"), the
+        conn SUSPENDS instead: queues/journal/flush bookkeeping survive,
+        the client redials under backoff, and in-flight ops complete late
+        after the resume replay (DESIGN.md §14).  Only session expiry
+        (grace elapsed / epoch mismatch) falls back to failure, with the
+        stable "session expired" reason.
+
         With liveness detection active (STARWAY_KEEPALIVE > 0) on a
         ka-negotiated conn, the user has opted out of recvs-pend-forever:
         whatever killed the conn (liveness expiry, RST, EOF), the receive
@@ -727,6 +764,14 @@ class Worker:
         queued receive fails too -- stable "not connected" keyword."""
         if self._trace is not None and conn.alive:
             self._trace.rec(swtrace.EV_CONN_DOWN, 0, conn.conn_id)
+        sess = getattr(conn, "sess", None)
+        if (sess is not None and conn.alive and not sess.expired
+                and not sess.suspended):
+            with self.lock:
+                running = self.status == state.RUNNING
+            if running:
+                self._sess_suspend(conn, fires)
+                return
         ka_live = (self._ka_interval > 0 and conn.alive
                    and getattr(conn, "ka_ok", False))
         stranded = None
@@ -759,6 +804,70 @@ class Worker:
                             pass
                         remote_msgs.discard(msg)
         getattr(self, "_half_open", set()).discard(conn)
+        for rec in list(self.flush_records):
+            self._try_complete_flush(rec, fires)
+
+    # ------------------------------------------------------------- session
+    @staticmethod
+    def _sess_int(v) -> int:
+        """Peer-supplied session integers (sess_ack) arrive as JSON
+        strings; a malformed value must not raise on the engine thread
+        (one bad handshake would emergency-close the whole worker).
+        Junk parses as 0 -- replay everything, the receiver's dedup
+        absorbs it (the C++ engine's strtoull does the same)."""
+        try:
+            return int(str(v))
+        except (TypeError, ValueError):
+            return 0
+
+    def _sess_suspend(self, conn, fires) -> None:
+        """A session-enabled conn lost its transport: suspend instead of
+        cancelling.  The client side redials under backoff; the server
+        side waits for the peer's resume dial; either side expires the
+        session once the grace window elapses."""
+        logger.warning(
+            "starway: conn %s lost; session %s suspended (grace %.3gs)",
+            conn.conn_id, conn.sess.sid[:8], conn.sess.grace)
+        conn.suspend(fires)
+        self._add_timer(conn.sess.grace,
+                        lambda fires, c=conn: self._sess_check_grace(c, fires))
+        if self.kind == "client":
+            self._add_timer(0.01,
+                            lambda fires, c=conn: self._sess_redial(c, fires))
+
+    def _sess_check_grace(self, conn, fires) -> None:
+        sess = conn.sess
+        if sess is None or sess.expired or not sess.suspended:
+            return
+        if time.monotonic() >= sess.deadline:
+            self._sess_expire(conn, fires)
+
+    def _sess_expire(self, conn, fires) -> None:
+        """Terminal session failure: grace elapsed, or the peer answered a
+        resume dial with a new epoch.  Everything that was riding out the
+        outage fails with the stable "session expired" reason."""
+        sess = conn.sess
+        if sess is None or sess.expired:
+            return
+        sess.expired = True
+        reason = REASON_SESSION_EXPIRED
+        conn.sess_fail_reason = reason
+        logger.warning("starway: session %s expired", sess.sid[:8])
+        if self._trace is not None:
+            self._trace.rec(swtrace.EV_SESS_EXPIRE, 0, conn.conn_id, 0, reason)
+        self._faulted = True
+        swtrace.flight_dump("session-expired", self, reason)
+        # count=True: the C++ engine bumps ops_cancelled per item it fails
+        # at expiry (sess_cancel_terminal) -- the cross-engine counter
+        # registry must agree for identical wire histories.
+        conn._cancel_tx_state(fires, reason, count=True)
+        conn.mark_dead(fires)
+        getattr(self, "_sessions", {}).pop(sess.sid, None)
+        # Session users opted into bounded failure (like the keepalive
+        # contract): queued receives fail once no alive conns remain.
+        with self.lock:
+            if not any(c.alive for c in self.conns.values()):
+                fires.extend(self.matcher.fail_pending(reason))
         for rec in list(self.flush_records):
             self._try_complete_flush(rec, fires)
 
@@ -852,6 +961,7 @@ class ClientWorker(Worker):
         self._connect_cb = None
         self._connect_target = None
         self._connect_timeout: Optional[float] = None
+        self._sess_target: Optional[tuple] = None  # (addr, port) for redials
 
     def connect(self, addr: str, port: int, cb,
                 timeout: Optional[float] = None) -> None:
@@ -918,9 +1028,13 @@ class ClientWorker(Worker):
                 return True
         # Real TCP path (cross-process / DCN bootstrap).  The HELLO offers a
         # same-host shared-memory upgrade when enabled; a peer that mapped
-        # the segment confirms with "sm": "ok" (core/shmring.py).
+        # the segment confirms with "sm": "ok" (core/shmring.py).  A
+        # session offer (STARWAY_SESSION) disables the sm upgrade: the
+        # rings are a per-incarnation transport with no replay journal.
+        sess_on = config.session_enabled()
+        self._sess_target = (addr, port)
         sm_offer = None
-        if config.sm_enabled():
+        if config.sm_enabled() and not sess_on:
             try:
                 from . import shmring
 
@@ -930,6 +1044,11 @@ class ClientWorker(Worker):
         connect_timeout = self._connect_timeout or config.connect_timeout()
         try:
             extra = {"ka": "ok"}  # liveness capability, always offered
+            if sess_on:
+                # Stable session id + epoch 0 (the acceptor assigns the
+                # real epoch); sess_ack is our cumulative rx seq (0 new).
+                extra.update(sess="ok", sess_id=self.worker_id,
+                             sess_epoch="0", sess_ack="0")
             if sm_offer is not None:
                 extra.update(
                     sm_key=sm_offer.key,
@@ -958,6 +1077,9 @@ class ClientWorker(Worker):
         conn.peer_name = ack.get("worker_id", "")
         conn.devpull_ok = ack.get("devpull") == "ok"
         conn.ka_ok = ack.get("ka") == "ok"
+        if sess_on and ack.get("sess") == "ok":
+            conn.sess = SessionState(self.worker_id,
+                                     str(ack.get("sess_epoch", "")))
         if sm_offer is not None:
             if ack.get("sm") == "ok":
                 conn.adopt_sm(sm_offer, creator=True)
@@ -977,6 +1099,75 @@ class ClientWorker(Worker):
             _run_fires([lambda: cb("")])
         return True
 
+    # ------------------------------------------------------ session redial
+    def _sess_redial(self, conn, fires) -> None:
+        """One resume attempt for a suspended session (engine thread;
+        scheduled by _sess_suspend and re-armed under exponential backoff
+        with jitter -- the PR-1 reconnect shape, now transparent)."""
+        sess = conn.sess
+        with self.lock:
+            running = self.status == state.RUNNING
+        if not running or sess is None or sess.expired or not sess.suspended:
+            return
+        if time.monotonic() >= sess.deadline:
+            self._sess_expire(conn, fires)
+            return
+        addr, port = self._sess_target
+        try:
+            sock, ack = self._sess_dial(addr, port, sess)
+        except Exception as e:
+            # NOT counted in swtrace.GLOBAL.reconnects: that counter is
+            # api-layer aconnect retries, and the native engine's redial
+            # path has no equivalent hook -- bumping it here would break
+            # cross-engine counter parity for identical outages.
+            delay = sess.redial_delay() * (0.5 + random.random() / 2)
+            logger.debug("starway: session redial failed (%s); retry in %.3gs",
+                         e, delay)
+            self._add_timer(delay,
+                            lambda fires, c=conn: self._sess_redial(c, fires))
+            return
+        if (ack.get("sess") != "ok"
+                or str(ack.get("sess_epoch", "")) != sess.epoch):
+            # The peer restarted (or forgot us): a new epoch is a new
+            # session -- ours is expired, not resumable.
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._sess_expire(conn, fires)
+            return
+        conn.resume(sock, self._sess_int(ack.get("sess_ack", "0")), fires)
+
+    def _sess_dial(self, addr: str, port: int, sess) -> tuple:
+        """One blocking resume dial + handshake (bounded by the connect
+        timeout; the engine thread sleeps in backoff between attempts).
+        Returns (socket, parsed HELLO_ACK dict); raises on failure."""
+        timeout = self._connect_timeout or config.connect_timeout()
+        extra = {"ka": "ok", "sess": "ok", "sess_id": sess.sid,
+                 "sess_epoch": sess.epoch, "sess_ack": str(sess.rx_cum)}
+        from .. import device as _device
+
+        if _device.devpull_supported():
+            extra["devpull"] = "ok"
+        mode = self._connect_target[0] if self._connect_target else "socket"
+        sock = socket.create_connection((addr, port), timeout=timeout)
+        try:
+            sock.settimeout(timeout)
+            sock.sendall(frames.pack_hello(self.worker_id, mode, self.name,
+                                           extra))
+            hdr = _read_exact(sock, frames.HEADER_SIZE)
+            ftype, _, blen = frames.unpack_header(hdr)
+            if ftype != frames.T_HELLO_ACK:
+                raise ConnectionError("unexpected frame during session resume")
+            ack = frames.unpack_json_body(_read_exact(sock, blen))
+        except Exception:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        return sock, ack
+
 
 class ServerWorker(Worker):
     """Engine behind ``starway_tpu.Server`` (reference: struct Server,
@@ -991,6 +1182,9 @@ class ServerWorker(Worker):
         # Accepted TCP conns whose HELLO has not arrived yet; they join
         # self.conns at handshake and must still be torn down at close.
         self._half_open: set = set()
+        # Resilient sessions: sess_id -> conn (suspended conns wait here
+        # for the peer's resume dial; see _sess_hello / DESIGN.md §14).
+        self._sessions: dict = {}
 
     def set_accept_cb(self, cb) -> None:
         self.accept_cb = cb
@@ -1083,11 +1277,19 @@ class ServerWorker(Worker):
             conn.local_port = conn.remote_port = 0
         conn.handshaken = True
         self._half_open.discard(conn)
+        # Resilient-session handshake (config.py STARWAY_SESSION): a
+        # resume dial adopts the new socket into the suspended conn; a
+        # fresh offer registers a new session.  Session conns never take
+        # the sm upgrade (the rings are per-incarnation, no replay).
+        sess_offered = (config.session_enabled()
+                        and info.get("sess") == "ok" and "sess_id" in info)
+        if sess_offered and self._sess_hello(conn, info, fires):
+            return  # resumed onto the suspended conn; this wrapper consumed
         # Same-host shared-memory offer: map + validate the segment, confirm
         # in the ACK.  Any failure (different host, bad nonce, sm disabled)
         # silently stays on TCP.
         sm_seg = None
-        if config.sm_enabled() and "sm_key" in info:
+        if config.sm_enabled() and "sm_key" in info and not sess_offered:
             try:
                 from . import shmring
 
@@ -1120,6 +1322,9 @@ class ServerWorker(Worker):
         if info.get("devpull") == "ok" and _device.devpull_supported():
             conn.devpull_ok = True
             ack_extra["devpull"] = "ok"
+        if sess_offered:
+            ack_extra.update(sess="ok", sess_epoch=conn.sess.epoch,
+                             sess_ack="0")
         # The ACK is the transport switch point: marking it routes anything
         # queued behind it (e.g. sends from the accept callback) to the ring
         # even while the ACK itself is still draining to the socket.
@@ -1129,6 +1334,50 @@ class ServerWorker(Worker):
             self._trace.rec(swtrace.EV_CONN_UP, 0, conn.conn_id)
         if self.accept_cb is not None:
             fires.append(lambda ep=ep: self.accept_cb(ep))
+
+    def _sess_hello(self, conn, info, fires) -> bool:
+        """Session half of the accept handshake.  Returns True when this
+        dial RESUMED an existing suspended session (``conn`` -- the fresh
+        accept wrapper -- was consumed: its socket moved onto the
+        suspended conn); False when a new session was registered on
+        ``conn`` and the normal accept path continues."""
+        sid = str(info["sess_id"])
+        req_epoch = str(info.get("sess_epoch", "0"))
+        existing = self._sessions.get(sid)
+        if (existing is not None and existing.sess is not None
+                and not existing.sess.expired
+                and existing.sess.epoch == req_epoch):
+            if not existing.sess.suspended:
+                # One-sided failure: the client saw its conn die and
+                # redialed before this side noticed (no EOF yet, ka not
+                # expired).  The resume dial itself proves the old
+                # incarnation dead -- supersede it instead of expiring a
+                # perfectly resumable session.
+                self._sess_suspend(existing, fires)
+            peer_ack = self._sess_int(info.get("sess_ack", "0"))
+            self._unregister_conn_io(conn)
+            sock, conn.sock = conn.sock, None
+            conn.alive = False  # wrapper never entered self.conns
+            ack_extra = {"sess": "ok", "sess_epoch": existing.sess.epoch,
+                         "sess_ack": str(existing.sess.rx_cum)}
+            if existing.ka_ok:
+                ack_extra["ka"] = "ok"
+            if existing.devpull_ok:
+                ack_extra["devpull"] = "ok"
+            existing.resume(
+                sock, peer_ack, fires,
+                ack_ctl=frames.pack_hello_ack(self.worker_id, ack_extra))
+            return True
+        if existing is not None and existing is not conn:
+            # Same session id, stale epoch: the old incarnation can never
+            # resume -- expire it before the new registration shadows it
+            # in the registry.
+            self._sess_expire(existing, fires)
+        # New session: the acceptor assigns the epoch; a resuming client
+        # that lands here sees the mismatch and expires its session.
+        conn.sess = SessionState(sid, uuid.uuid4().hex[:8])
+        self._sessions[sid] = conn
+        return False
 
     def attach_inproc(self, client_worker, mode: str):
         """Attach a same-process client (called from the client's engine

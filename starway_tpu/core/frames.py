@@ -25,6 +25,9 @@ FLUSH_ACK flush sequence number        0
 DEVPULL   sender tag                   length of JSON descriptor that follows
 PING      0                            0
 PONG      0                            0
+SEQ       next session frame's seq     0
+ACK       cumulative received seq      0
+BYE       0                            0
 ========= ============================ ======================================
 
 PING / PONG are the *negotiated* peer-liveness probe (``"ka": "ok"``
@@ -70,6 +73,26 @@ engines ignore unknown keys -- old and new peers interoperate, falling
 back to plain TCP.  This mirrors UCX's transport negotiation
 (``UCX_TLS`` including ``sm``; reference: benchmark.md:114-126).
 
+SEQ / ACK belong to the *negotiated* resilient-session layer
+(``STARWAY_SESSION``, offered as ``"sess": "ok"`` with a stable
+``sess_id`` / ``sess_epoch`` / ``sess_ack`` triple in HELLO and confirmed
+in HELLO_ACK -- all JSON strings, like the other extensions): on a
+session conn every replayable frame (DATA / DEVPULL / FLUSH / FLUSH_ACK)
+is preceded by a SEQ frame announcing its per-conn sequence number.  The
+receiver tracks the cumulative in-order seq, drops any frame whose seq it
+has already processed (exactly-once delivery across replays), and sends
+cumulative ACKs -- piggybacked on each read pass and flushed by an idle
+timer.  The sender keeps unacked frames in a bounded replay journal and,
+after a reconnect handshake carrying the same ``sess_id``/``sess_epoch``,
+replays everything past the peer's ``sess_ack``.  PING/PONG/ACK/handshake
+frames are per-connection-incarnation and are never sequenced or
+journaled.  BYE is the session goodbye: a peer closing *locally* on a
+clean frame boundary sends it (best-effort) right before the FIN so the
+survivor knows the session is over and takes the seed/keepalive death
+contract immediately -- without it, EOF is indistinguishable from a
+crash and the survivor would suspend for the full grace window.  A lost
+BYE only costs the peer that grace-expiry fallback.  See DESIGN.md §14.
+
 FLUSH / FLUSH_ACK implement the delivery barrier: because the byte stream is
 processed in order, a FLUSH_ACK for sequence *n* proves every DATA payload
 enqueued before flush *n* has been fully ingested by the peer's matching
@@ -93,6 +116,9 @@ T_FLUSH_ACK = 5
 T_DEVPULL = 6
 T_PING = 7
 T_PONG = 8
+T_SEQ = 9
+T_ACK = 10
+T_BYE = 11
 
 
 def pack_header(ftype: int, a: int, b: int) -> bytes:
@@ -149,6 +175,18 @@ def pack_ping() -> bytes:
 
 def pack_pong() -> bytes:
     return pack_header(T_PONG, 0, 0)
+
+
+def pack_seq(seq: int) -> bytes:
+    return pack_header(T_SEQ, seq, 0)
+
+
+def pack_ack(cum_seq: int) -> bytes:
+    return pack_header(T_ACK, cum_seq, 0)
+
+
+def pack_bye() -> bytes:
+    return pack_header(T_BYE, 0, 0)
 
 
 def pack_devpull(tag: int, desc: dict) -> bytes:

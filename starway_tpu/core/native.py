@@ -59,6 +59,8 @@ _DEVPULL_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64,
                                ctypes.c_int, ctypes.c_uint64)
 _DEVPULL_CLAIM_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64,
                                      ctypes.c_uint64, ctypes.c_int)
+_EVENT_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_char_p,
+                             ctypes.c_uint64)
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -124,6 +126,9 @@ def load() -> Optional[ctypes.CDLL]:
         lib.sw_send_devpull.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p,
             ctypes.c_uint64, _DONE_CB, _FAIL_CB, ctypes.c_void_p,
+        ]
+        lib.sw_set_event_cb.argtypes = [
+            ctypes.c_void_p, _EVENT_CB, ctypes.c_void_p
         ]
         # Optional (older .so builds lack them): portable sm cursor atomics
         # for the Python engine on non-TSO architectures (core/shmring.py).
@@ -267,6 +272,16 @@ def _on_devpull_claim(ctx, remote_id, recv_ctx, flags):
             rec[1](int(remote_id), int(recv_ctx), int(flags))
         except Exception:
             logger.exception("starway native devpull claim callback raised")
+
+
+@_EVENT_CB
+def _on_event(ctx, event, conn_id):
+    rec = _peek(ctx)  # persistent registration: not popped
+    if rec and rec[0] is not None:
+        try:
+            rec[0]((event or b"").decode(), int(conn_id))
+        except Exception:
+            logger.exception("starway native event callback raised")
 
 
 def _is_device_sink(obj) -> bool:
@@ -413,7 +428,30 @@ class NativeWorkerBase:
         # self._trace: the off path must stay env-lookup-free per op.
         self._swtrace_on = swtrace.active()
         self.stage_scope = perf.StageScope()
+        self._event_key: Optional[int] = None
         swtrace.register_worker(self)
+
+    # ------------------------------------------------------ session events
+    def _install_events(self) -> None:
+        """Register the engine-event callback (sw_set_event_cb): session
+        resume / expiry are flight-recorder dump triggers (DESIGN.md §14)
+        and the resume events recorded in the engine's trace ring must
+        reach the post-mortem dump.  Armed only when swtrace is active --
+        the default path takes no per-event trampoline."""
+        if not self._swtrace_on or not config.session_enabled():
+            return
+        wself = weakref.ref(self)
+
+        def dispatch(event: str, conn_id: int) -> None:
+            s = wself()
+            if s is None:
+                return
+            if event == "session-expired":
+                s._faulted = True
+            swtrace.flight_dump(event, s)
+
+        self._event_key = _register(dispatch, None)
+        self._lib.sw_set_event_cb(self._h, _on_event, self._event_key)
 
     # --------------------------------------------------------- observability
     @property
@@ -795,6 +833,9 @@ class NativeWorkerBase:
             )
 
     def _drop_devpull(self) -> None:
+        if self._event_key is not None:
+            _take(self._event_key)
+            self._event_key = None
         if self._devpull_key is not None:
             _take(self._devpull_key)
             self._devpull_key = None
@@ -888,6 +929,7 @@ class NativeClientWorker(NativeWorkerBase):
                 f"(status={state.NAMES.get(self.status, self.status)})"
             )
         self._install_devpull()
+        self._install_events()
         key = _register(cb, None)
         rc = self._lib.sw_client_connect(
             self._h, host.encode(), port, mode.encode(), _on_status, key
@@ -976,6 +1018,7 @@ class NativeServerWorker(NativeWorkerBase):
             raise StarwayStateError("starway server already listening or closed")
         self._install_accept()
         self._install_devpull()
+        self._install_events()
         rc = int(self._lib.sw_server_listen(self._h, addr.encode(), port))
         if rc <= 0:
             raise OSError(-rc, f"native listen failed on {addr}:{port}")
@@ -990,6 +1033,7 @@ class NativeServerWorker(NativeWorkerBase):
             raise StarwayStateError("starway server already listening or closed")
         self._install_accept()
         self._install_devpull()
+        self._install_events()
         rc = int(self._lib.sw_server_listen(self._h, b"0.0.0.0", 0))
         if rc <= 0:
             raise OSError(-rc, "native listen_address failed")

@@ -70,6 +70,9 @@ EV_CONN_UP = "conn_up"        # connection handshaken / attached
 EV_CONN_DOWN = "conn_down"    # connection broken (peer death / reset)
 EV_STAGE = "stage_span"       # data-plane stage span (perf.record_stage):
 #                               reason = stage name, dur = span seconds
+EV_SESS_RESUME = "sess_resume"  # session conn resumed after a reconnect
+#                               (conn = conn id; nbytes = frames replayed)
+EV_SESS_EXPIRE = "sess_expire"  # session expired (grace elapsed / new epoch)
 
 # ----------------------------------------------------- counter vocabulary
 #
@@ -96,6 +99,11 @@ COUNTER_NAMES = (
     "staging_misses",     # staging-pool fresh allocations (process-global)
     "ka_misses",          # peers declared dead by keepalive liveness
     "reconnects",         # aconnect retry attempts (process-global)
+    "sessions_resumed",   # session conns resumed after a reconnect
+    "frames_replayed",    # journaled frames re-queued at session resume
+    "dup_frames_dropped", # duplicate-seq frames dropped by the receiver
+    "acks_tx",            # cumulative session ACK frames sent
+    "acks_rx",            # cumulative session ACK frames received
 )
 
 

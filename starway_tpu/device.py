@@ -886,7 +886,16 @@ def send_device(worker, conn, buffer, tag, done, fail):
         if desc is not None:
             worker.submit_devpull(conn, desc, tag, done, fail, payload)
             return
+    # A session conn's replay journal must OWN every eager frame's bytes
+    # past local completion (core/conn.py sess_wrap snapshots flat host
+    # views), but a chunked payload is re-staged lazily from the device
+    # buffer -- which the eager contract lets the caller delete or donate
+    # once ``done`` fires.  Journaled eager sends therefore take the full
+    # host snapshot below instead of the chunked pipeline.
+    journaled = (config.session_enabled() if conn is None
+                 else getattr(conn, "sess", None) is not None)
     if (getattr(worker, "supports_chunked_tx", False)
+            and not journaled
             and payload.nbytes <= config.rndv_threshold()):
         # Framed-stream staging pipelines: the TX pump pulls host chunks
         # incrementally so the D2H of chunk k+1 overlaps the write of

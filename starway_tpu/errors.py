@@ -16,6 +16,11 @@ tests/test_basic.py:500-511).  We keep those observable contracts:
   - ``"truncated"``  -- message larger than the posted receive buffer
   - ``"timed out"``  -- op deadline (``timeout=`` on asend/arecv/aflush/
     aconnect) expired before completion (tests/test_faults.py)
+  - ``"session expired"`` -- a session-enabled connection (``STARWAY_SESSION``,
+    see config.py) stayed dead past ``STARWAY_SESSION_GRACE``, or the peer
+    answered the resume handshake with a new epoch; ops that were riding
+    out the outage fail with this reason instead of completing late
+    (tests/test_session.py)
 """
 
 from __future__ import annotations
@@ -39,4 +44,5 @@ REASON_CANCELLED = "Operation cancelled (local endpoint closed before completion
 REASON_NOT_CONNECTED = "Endpoint is not connected"
 REASON_TRUNCATED = "Message truncated: payload larger than posted receive buffer"
 REASON_TIMEOUT = "Operation timed out (deadline exceeded before completion)"
+REASON_SESSION_EXPIRED = "Session expired (resume window elapsed or peer restarted)"
 REASON_INTERNAL = "Internal transport error"

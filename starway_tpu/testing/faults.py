@@ -34,10 +34,28 @@ Fault modes (constructor ``mode=``):
     Accept the client, never dial the target, read and discard inbound
     bytes, send nothing -- the accept-then-silence failure (a wedged or
     firewalled peer).
+``duplicate``
+    Frame-aware c->s forwarding that sends every *sequenced* session unit
+    (T_SEQ prefix + its frame, core/frames.py) past ``limit_bytes``
+    TWICE -- the replayed-frame overlap a resilient-session receiver must
+    drop by sequence number (``dup_frames_dropped``).  Handshake and
+    unsequenced frames pass through untouched, so the mode is a no-op on
+    seed-parity conns (they carry no T_SEQ frames at all).
+``reorder``
+    Frame-aware c->s forwarding that swaps ONE adjacent pair of sequenced
+    units past ``limit_bytes`` (then forwards transparently).  The
+    receiver sees a sequence gap it cannot repair in place, resets the
+    conn, and the session layer's redial + replay-from-cumulative-ACK
+    path runs end to end.
 
 ``partition_after`` (bytes, any mode that forwards) auto-triggers
 :meth:`partition` once that much client->server traffic has passed --
 deterministic mid-stream silence without test-side sleeps.
+:meth:`reset_mid_message` arms a byte-exact RST: the proxy forwards
+client->server traffic up to an absolute byte offset (splitting a chunk
+if needed, so the kill really lands mid-frame) and then hard-kills both
+sides -- the deterministic connection-death-mid-transfer the session
+resume tests are built on.
 
 Threads: one acceptor plus two pumps per proxied connection, all daemons;
 :meth:`stop` closes every socket and joins.  Loopback-only by design --
@@ -54,7 +72,17 @@ from typing import Optional
 
 _CHUNK = 1 << 16
 
-MODES = ("forward", "delay", "drop", "truncate", "blackhole")
+MODES = ("forward", "delay", "drop", "truncate", "blackhole", "duplicate",
+         "reorder")
+
+# Wire-format knowledge for the frame-aware modes (core/frames.py): 17-byte
+# little-endian header {u8 type, u64 a, u64 b}; HELLO/HELLO_ACK/DATA/DEVPULL
+# stream `b` payload bytes behind the header, everything else is bare.  A
+# T_SEQ frame (9) is the session layer's sequence prefix and travels glued
+# to the frame it announces -- duplicate/reorder treat the pair as one unit.
+_HDR = 17
+_T_SEQ = 9
+_BODY_TYPES = frozenset((1, 2, 3, 6))  # HELLO, HELLO_ACK, DATA, DEVPULL
 
 
 class _ConnPair:
@@ -110,6 +138,8 @@ class FaultProxy:
         self._pairs: list[_ConnPair] = []
         self._threads: list[threading.Thread] = []
         self._c2s_bytes = 0  # client->server bytes forwarded (fault triggers)
+        self._reset_at: Optional[int] = None  # armed byte-exact RST offset
+        self._reordered = False  # reorder mode fires its one swap only once
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((listen_host, 0))
@@ -179,6 +209,15 @@ class FaultProxy:
         for p in pairs:
             p.kill(rst)
 
+    def reset_mid_message(self, at_bytes: int) -> None:
+        """Arm a byte-exact connection kill: forward client->server bytes
+        up to absolute offset ``at_bytes`` (splitting the chunk that
+        crosses it, so the RST genuinely lands mid-frame) then hard-kill
+        both sides.  Single-shot: a reconnecting session pair pumps
+        through undisturbed afterwards -- the deterministic
+        death-mid-transfer the resume tests are built on."""
+        self._reset_at = at_bytes
+
     @property
     def forwarded_bytes(self) -> int:
         return self._c2s_bytes
@@ -210,7 +249,12 @@ class FaultProxy:
             with self._lock:
                 self._pairs.append(pair)
             for src, dst, is_c2s in ((down, up, True), (up, down, False)):
-                t = threading.Thread(target=self._pump, args=(pair, src, dst, is_c2s),
+                # duplicate/reorder are frame-aware on the faulted (c->s)
+                # direction only; the return path stays a byte pipe.
+                fn = (self._pump_framed
+                      if is_c2s and self.mode in ("duplicate", "reorder")
+                      else self._pump)
+                t = threading.Thread(target=fn, args=(pair, src, dst, is_c2s),
                                      daemon=True)
                 t.start()
                 self._threads.append(t)
@@ -250,6 +294,16 @@ class FaultProxy:
                 continue  # swallowed: silence, not EOF
             if self.delay > 0:
                 time.sleep(self.delay)
+            if is_c2s and self._reset_at is not None:
+                remaining = self._reset_at - self._c2s_bytes
+                if len(data) >= remaining:
+                    # Deliver exactly up to the armed offset, then RST:
+                    # the kill lands mid-frame, byte-deterministically.
+                    self._reset_at = None
+                    if remaining > 0:
+                        self._send_all(pair, dst, data[:remaining], is_c2s)
+                    pair.kill(rst=True)
+                    return
             if is_c2s and self.mode in ("drop", "truncate"):
                 remaining = self.limit_bytes - self._c2s_bytes
                 if remaining <= 0:
@@ -266,6 +320,103 @@ class FaultProxy:
             if (is_c2s and self.partition_after is not None
                     and self._c2s_bytes >= self.partition_after):
                 self._partitioned.set()
+
+    def _pump_framed(self, pair: _ConnPair, src: socket.socket,
+                     dst: socket.socket, is_c2s: bool) -> None:
+        """Frame-aware client->server pump for the duplicate/reorder
+        modes: reassembles the byte stream into wire units (header +
+        payload, with a T_SEQ prefix glued to the frame it announces) and
+        injects the fault on *sequenced* units past ``limit_bytes``.
+        Unsequenced traffic (handshake, liveness, ACKs) passes through
+        untouched, so seed-parity conns see a transparent proxy."""
+        buf = bytearray()
+        held_seq: Optional[bytes] = None   # T_SEQ unit awaiting its frame
+        reorder_hold: Optional[bytes] = None
+        try:
+            src.settimeout(0.2)  # idle tick: a held swap must not hang a quiet stream
+        except OSError:
+            pass
+        while not self._stopping.is_set() and not pair.dead:
+            while (self._stalled.is_set() and not self._stopping.is_set()
+                   and not pair.dead):
+                time.sleep(0.01)
+            try:
+                data = src.recv(_CHUNK)
+            except socket.timeout:
+                if reorder_hold is not None:
+                    # Nothing followed the held unit: flush it (the swap
+                    # degenerates to a delay) so a trailing barrier frame
+                    # cannot wedge the stream.
+                    unit, reorder_hold = reorder_hold, None
+                    if not self._forward_unit(pair, dst, unit, is_c2s):
+                        return
+                continue
+            except OSError:
+                break
+            if not data:
+                if self._partitioned.is_set():
+                    return
+                try:
+                    dst.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+                return
+            if self._partitioned.is_set():
+                continue
+            buf += data
+            while True:
+                if len(buf) < _HDR:
+                    break
+                ftype = buf[0]
+                blen = struct.unpack_from("<Q", buf, 9)[0]
+                need = _HDR + (blen if ftype in _BODY_TYPES else 0)
+                if len(buf) < need:
+                    break
+                unit = bytes(buf[:need])
+                del buf[:need]
+                if ftype == _T_SEQ:
+                    held_seq = unit  # glue to the frame it announces
+                    continue
+                sequenced = held_seq is not None
+                if sequenced:
+                    unit = held_seq + unit
+                    held_seq = None
+                out = unit
+                past = self._c2s_bytes >= self.limit_bytes
+                if sequenced and past and self.mode == "duplicate":
+                    out = unit + unit  # replay overlap: receiver must dedup
+                elif (sequenced and past and self.mode == "reorder"
+                      and not self._reordered):
+                    if reorder_hold is None:
+                        reorder_hold = unit
+                        continue  # hold; the NEXT sequenced unit goes first
+                    out = unit + reorder_hold
+                    reorder_hold = None
+                    self._reordered = True
+                if not self._forward_unit(pair, dst, out, is_c2s):
+                    return
+
+    def _forward_unit(self, pair: _ConnPair, dst: socket.socket, out: bytes,
+                      is_c2s: bool) -> bool:
+        """Forward one (possibly duplicated/swapped) wire unit from the
+        framed pump, honouring the byte-level triggers the raw pump also
+        implements: an armed :meth:`reset_mid_message` offset splits the
+        unit so the RST lands byte-exactly, and ``partition_after``
+        swallows everything past its threshold."""
+        if self._reset_at is not None:
+            remaining = self._reset_at - self._c2s_bytes
+            if len(out) >= remaining:
+                self._reset_at = None
+                if remaining > 0:
+                    self._send_all(pair, dst, out[:remaining], is_c2s)
+                pair.kill(rst=True)
+                return False
+        if not self._send_all(pair, dst, out, is_c2s):
+            return False
+        if (self.partition_after is not None
+                and self._c2s_bytes >= self.partition_after):
+            self._partitioned.set()
+        return True
 
     def _send_all(self, pair: _ConnPair, dst: socket.socket, data: bytes,
                   is_c2s: bool) -> bool:

@@ -370,6 +370,83 @@ async def test_connect_timeout_param_and_retries_exhaust(port):
     proxy.stop()
 
 
+# ----------------------------- frame-aware session fault modes (ISSUE 5)
+#
+# duplicate / reorder / reset_mid_message are the injection primitives the
+# resilient-session layer's dedup/replay paths are tested with
+# (tests/test_session.py drives session-enabled pairs through them).  Here:
+# the modes themselves -- frame-aware forwarding must be TRANSPARENT on a
+# seed-parity conn (no T_SEQ frames, so there is nothing to duplicate or
+# swap), and the byte-exact reset must land exactly where it was armed.
+
+
+@pytest.mark.parametrize("mode", ["duplicate", "reorder"])
+async def test_framed_modes_transparent_without_session(engine, port, mode):
+    """Without the session opt-in no frame is sequenced, so the
+    frame-aware pump forwards everything untouched: deliveries are
+    exactly-once and in order through the reassembling proxy (including a
+    payload larger than the proxy's read chunk)."""
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port, mode=mode).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        for tag in range(5):
+            await _roundtrip(client, server, tag)
+        big = 1 << 20  # reassembled across many 64 KiB proxy reads
+        sink = np.zeros(big, dtype=np.uint8)
+        fut = server.arecv(sink, 0x40, (1 << 64) - 1)
+        await client.asend(np.full(big, 7, dtype=np.uint8), 0x40)
+        await asyncio.wait_for(client.aflush(), timeout=30)
+        _, ln = await asyncio.wait_for(fut, timeout=30)
+        assert ln == big and sink[0] == 7 and sink[-1] == 7
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+async def test_reset_mid_message_kills_at_exact_byte(engine, port):
+    """reset_mid_message(at) forwards client->server traffic up to
+    EXACTLY the armed absolute offset -- splitting the chunk that crosses
+    it, so the RST genuinely lands mid-frame -- then hard-kills both
+    sides (the deterministic death-mid-transfer the session resume tests
+    are built on).  On a seed-parity pair the kill is just the usual
+    mid-frame fault: the dirty flush fails with a stable keyword."""
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _roundtrip(client, server, 0x1)  # handshake + one delivery
+        at = proxy.forwarded_bytes + 2000  # inside the next 1 MiB payload
+        proxy.reset_mid_message(at)
+        recv_done = []
+        server.recv(np.zeros(1 << 20, dtype=np.uint8), 0x2, (1 << 64) - 1,
+                    lambda t, ln: recv_done.append("done"),
+                    lambda r: recv_done.append(r))
+        # The RST lands 2000 bytes into the 1 MiB frame: depending on how
+        # much the kernel buffered first, the send itself and/or the dirty
+        # flush fails -- always with a stable keyword, never a hang (and
+        # the flush may pass vacuously if the dead conn was already
+        # reaped before the barrier was posted).
+        for op in (client.asend(np.ones(1 << 20, dtype=np.uint8), 0x2),
+                   client.aflush(timeout=10)):
+            try:
+                await asyncio.wait_for(op, timeout=30)
+            except Exception as e:
+                msg = str(e).lower()
+                assert ("not connected" in msg or "cancel" in msg
+                        or "timed out" in msg), msg
+        assert proxy.forwarded_bytes == at, (proxy.forwarded_bytes, at)
+        await asyncio.sleep(0.3)
+        assert not recv_done  # 2000 bytes of 1 MiB: claimed partial pends
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
 # ------------------------------------------------------------------- slow
 
 

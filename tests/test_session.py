@@ -1,0 +1,749 @@
+"""Resilient sessions: exactly-once delivery across connection death
+(DESIGN.md §14).
+
+``STARWAY_SESSION=1`` opts a Client<->Server pair into riding through
+transient peer loss: every eager frame is sequence-numbered, receivers
+ACK cumulatively and drop duplicate seqs, senders journal unacked frames,
+and a dead conn suspends + redials + replays instead of cancelling.  The
+acceptance contract (ISSUE 5): under a FaultProxy-injected reset
+mid-transfer, a session-enabled pair -- each of py<->py, native<->native,
+and both mixed pairings -- completes every posted asend/arecv/aflush
+exactly once (``dup_frames_dropped`` is the dedup oracle, no
+"not connected" failures), while with ``STARWAY_SESSION`` unset the seed
+failure contract of tests/test_basic.py is byte-identical.
+
+Wall-clock bounds are loose (1-core noisy CI box): they prove "bounded,
+not hung", not latency.
+"""
+
+import asyncio
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from starway_tpu import Client, Server
+from starway_tpu.core import frames
+from starway_tpu.testing.faults import FaultProxy
+
+pytestmark = pytest.mark.asyncio
+
+ADDR = "127.0.0.1"
+
+PAIRS = ["py-py", "native-native", "py-native", "native-py"]
+
+
+@pytest.fixture(params=PAIRS)
+def pair(request, monkeypatch):
+    """(server_engine, client_engine) with the session layer armed.
+    Workers sample the env at construction, so the per-side STARWAY_NATIVE
+    flip happens in _mk_server/_mk_client, not here."""
+    s_eng, c_eng = request.param.split("-")
+    if "native" in (s_eng, c_eng):
+        from starway_tpu.core import native
+
+        if not native.available():
+            pytest.skip("native engine unavailable (no toolchain)")
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_SESSION", "1")
+    monkeypatch.setenv("STARWAY_SESSION_GRACE", "20")
+    return s_eng, c_eng, monkeypatch
+
+
+def _mk_server(eng, monkeypatch, port):
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if eng == "native" else "0")
+    server = Server()
+    server.listen(ADDR, port)
+    return server
+
+
+def _mk_client(eng, monkeypatch):
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if eng == "native" else "0")
+    return Client()
+
+
+async def _aclose_all(*objs):
+    for o in objs:
+        try:
+            await asyncio.wait_for(o.aclose(), timeout=10)
+        except Exception:
+            pass
+
+
+def _sess_counters(worker_owner):
+    """Session-relevant counter slice from an api-level Client/Server."""
+    w = getattr(worker_owner, "_client", None) or worker_owner._server
+    return w.counters_snapshot()
+
+
+async def _burst(client, server, n=20, size=4096, kill=None, tag0=0):
+    """Post n recvs + n sends; optionally invoke `kill` mid-burst.
+    Returns the recv results (order = tag order)."""
+    bufs = [np.zeros(size, dtype=np.uint8) for _ in range(n)]
+    recvs = [server.arecv(bufs[i], tag0 + i, (1 << 64) - 1) for i in range(n)]
+    sends = []
+    for i in range(n):
+        sends.append(client.asend(
+            np.full(size, (tag0 + i) % 251, dtype=np.uint8), tag0 + i))
+        if kill is not None and i == n // 2:
+            await asyncio.sleep(0.3)  # let part of the burst reach the wire
+            kill()
+    await asyncio.wait_for(asyncio.gather(*sends), timeout=60)
+    await asyncio.wait_for(client.aflush(), timeout=60)
+    res = await asyncio.wait_for(asyncio.gather(*recvs), timeout=60)
+    for i, (stag, ln) in enumerate(res):
+        assert stag == tag0 + i and ln == size
+        assert bufs[i][0] == (tag0 + i) % 251 and bufs[i][-1] == (tag0 + i) % 251
+    return res
+
+
+# ------------------------------------------------------------------ resume
+
+
+async def test_reset_mid_transfer_completes_exactly_once(pair, port):
+    """The acceptance scenario: a connection reset mid-transfer on a
+    session-enabled pair.  Every posted asend/arecv/aflush completes
+    exactly once -- no duplicate deliveries, no "not connected"."""
+    s_eng, c_eng, mp = pair
+    server = _mk_server(s_eng, mp, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = _mk_client(c_eng, mp)
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        n = 20
+        await _burst(client, server, n=n,
+                     kill=lambda: proxy.kill_all(rst=True))
+        cs = _sess_counters(client)
+        ss = _sess_counters(server)
+        assert cs["sessions_resumed"] >= 1
+        # Exactly-once: the server's matcher completed each posted recv
+        # once, and anything the replay re-offered was dropped by seq.
+        assert ss["recvs_completed"] == n
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+async def test_reset_mid_message_byte_exact(pair, port):
+    """reset_mid_message lands the RST inside a frame: the partially
+    delivered message is rewritten from the start by the replay, and the
+    stranded receive completes with intact data."""
+    s_eng, c_eng, mp = pair
+    server = _mk_server(s_eng, mp, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = _mk_client(c_eng, mp)
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _burst(client, server, n=4, tag0=100)  # handshake + warm-up
+        # Kill 2000 bytes into the NEXT burst: mid-payload of its first
+        # 4 KiB message.
+        proxy.reset_mid_message(proxy.forwarded_bytes + 2000)
+        await _burst(client, server, n=8, tag0=200)
+        assert _sess_counters(client)["sessions_resumed"] >= 1
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+async def test_deadline_defers_while_suspended(pair, port):
+    """A send deadline elapsing while the session is SUSPENDED defers:
+    the op completes late after the resume replay instead of failing
+    "timed out" and tearing the suspended session down into terminal
+    cancel (DESIGN.md §14 -- only grace/epoch expiry fails suspended
+    ops; both engines must agree)."""
+    s_eng, c_eng, mp = pair
+    mp.setenv("STARWAY_CONNECT_TIMEOUT", "0.25")  # fast redial cycles
+    server = _mk_server(s_eng, mp, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = _mk_client(c_eng, mp)
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _burst(client, server, n=2, tag0=400)  # warm-up
+        size = 4096
+        buf = np.zeros(size, dtype=np.uint8)
+        recv = server.arecv(buf, 444, (1 << 64) - 1)
+        proxy.partition()          # redial handshakes die into silence
+        proxy.kill_all(rst=True)   # suspend the session
+        await asyncio.sleep(0.2)
+        send = client.asend(np.full(size, 9, dtype=np.uint8), 444,
+                            timeout=0.5)
+        await asyncio.sleep(1.2)   # deadline elapses mid-outage
+        assert not send.done(), "suspended send must defer, not time out"
+        proxy.heal()
+        await asyncio.wait_for(send, timeout=30)
+        await asyncio.wait_for(client.aflush(), timeout=30)
+        stag, ln = await asyncio.wait_for(recv, timeout=30)
+        assert (stag, ln) == (444, size) and buf[0] == 9 and buf[-1] == 9
+        assert _sess_counters(client)["sessions_resumed"] >= 1
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+@pytest.mark.parametrize("c_eng", ["py", "native"])
+async def test_deadline_defers_once_framed_on_live_session(c_eng, port,
+                                                           monkeypatch):
+    """A sequenced session send is PROMISED: its deadline defers even on
+    a live, healthy conn (here jammed by proxy backpressure).  Failing it
+    "timed out" would lie -- the journal still delivers the frame -- and
+    must not bounce the healthy conn into a resume cycle."""
+    if c_eng == "native":
+        from starway_tpu.core import native
+
+        if not native.available():
+            pytest.skip("native engine unavailable (no toolchain)")
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_SESSION", "1")
+    monkeypatch.setenv("STARWAY_SESSION_GRACE", "20")
+    server = _mk_server("py", monkeypatch, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = _mk_client(c_eng, monkeypatch)
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _burst(client, server, n=2, tag0=800)
+        proxy.stall()  # backpressure: frames jam on the LIVE conn
+        n, size = 48, 262144  # ~12 MiB backlog: exceeds the kernel socket
+        # buffers (so the probe genuinely jams) while staying under the
+        # 16 MiB journal cap (so the probe is framed, not parked).
+        fill = [client.asend(np.full(size, i % 251, dtype=np.uint8), 900 + i)
+                for i in range(n)]
+        await asyncio.sleep(0.3)
+        probe = client.asend(np.full(4096, 7, dtype=np.uint8), 999,
+                             timeout=0.5)
+        await asyncio.sleep(1.2)  # deadline elapses while framed + jammed
+        assert not probe.done(), "framed session send must defer, not fail"
+        proxy.unstall()
+        bufs = [np.zeros(size, dtype=np.uint8) for _ in range(n)]
+        recvs = [server.arecv(bufs[i], 900 + i, (1 << 64) - 1)
+                 for i in range(n)]
+        pbuf = np.zeros(4096, dtype=np.uint8)
+        precv = server.arecv(pbuf, 999, (1 << 64) - 1)
+        await asyncio.wait_for(asyncio.gather(*fill), timeout=60)
+        await asyncio.wait_for(probe, timeout=60)
+        await asyncio.wait_for(asyncio.gather(*recvs), timeout=60)
+        await asyncio.wait_for(precv, timeout=60)
+        assert pbuf[0] == 7 and pbuf[-1] == 7
+        # The healthy conn was never torn down into a resume cycle.
+        assert _sess_counters(client)["sessions_resumed"] == 0
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+@pytest.mark.parametrize("eng", ["py", "native"])
+async def test_malformed_sess_ack_does_not_crash_server(eng, port,
+                                                        monkeypatch):
+    """A resume dial carrying junk in sess_ack must not raise on the
+    acceptor's engine thread (one bad handshake would emergency-close
+    every session on the worker): junk parses as 0 -- replay everything,
+    dedup absorbs it -- and the server keeps serving."""
+    if eng == "native":
+        from starway_tpu.core import native
+
+        if not native.available():
+            pytest.skip("native engine unavailable (no toolchain)")
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_SESSION", "1")
+    monkeypatch.setenv("STARWAY_SESSION_GRACE", "20")
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if eng == "native" else "0")
+    server = Server()
+    server.listen(ADDR, port)
+    sid = "feed" * 4
+    s1 = s2 = None
+    try:
+        s1 = socket.create_connection((ADDR, port), timeout=10)
+        s1.settimeout(10)
+        ack1 = _raw_hello(s1, sid, "0", 0)
+        assert ack1.get("sess") == "ok"
+        epoch = ack1["sess_epoch"]
+        s2 = socket.create_connection((ADDR, port), timeout=10)
+        s2.settimeout(10)
+        ack2 = _raw_hello(s2, sid, epoch, "junk")  # malformed resume dial
+        assert ack2.get("sess") == "ok", ack2
+        assert ack2.get("sess_epoch") == epoch
+        # The worker survived and the resumed session still delivers.
+        buf = np.zeros(64, dtype=np.uint8)
+        r = server.arecv(buf, 0x3, (1 << 64) - 1)
+        s2.sendall(frames.pack_seq(1)
+                   + frames.pack_data_header(0x3, 64) + b"\x33" * 64)
+        stag, ln = await asyncio.wait_for(r, timeout=15)
+        assert (stag, ln) == (0x3, 64) and buf[0] == 0x33
+    finally:
+        for s in (s1, s2):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        await _aclose_all(server)
+
+
+async def test_reset_mid_message_under_duplicate_mode(port, monkeypatch):
+    """The frame-aware pumps honour the raw pump's byte-level triggers
+    too: an armed reset_mid_message fires byte-exactly while `duplicate`
+    mode is injecting replay overlap, and the session still delivers
+    everything exactly once."""
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+    monkeypatch.setenv("STARWAY_SESSION", "1")
+    monkeypatch.setenv("STARWAY_SESSION_GRACE", "20")
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port, mode="duplicate").start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _burst(client, server, n=4, tag0=500)
+        proxy.reset_mid_message(proxy.forwarded_bytes + 2000)
+        n = 6
+        await _burst(client, server, n=n, tag0=600)
+        cs = _sess_counters(client)
+        ss = _sess_counters(server)
+        assert cs["sessions_resumed"] >= 1   # the armed RST actually fired
+        assert ss["dup_frames_dropped"] > 0  # duplicate mode stayed active
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+def _read_exactly(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("EOF")
+        buf += chunk
+    return buf
+
+
+def _raw_hello(sock, sid, epoch, ack):
+    """Speak the session handshake from a raw socket; returns the parsed
+    HELLO_ACK body (skipping any interleaved bare ctl frames)."""
+    sock.sendall(frames.pack_hello("raw-" + sid, "socket", "", {
+        "sess": "ok", "sess_id": sid, "sess_epoch": epoch,
+        "sess_ack": str(ack)}))
+    while True:
+        hdr = _read_exactly(sock, frames.HEADER_SIZE)
+        ftype, _, blen = frames.unpack_header(hdr)
+        if ftype == frames.T_HELLO_ACK:
+            return json.loads(_read_exactly(sock, blen))
+
+
+@pytest.mark.parametrize("eng", ["py", "native"])
+async def test_resume_supersedes_undetected_stale_conn(eng, port, monkeypatch):
+    """One-sided failure: the client detects its conn's death and redials
+    while the server's side of the old socket still looks alive (no EOF,
+    ka not expired).  The resume dial itself proves the old incarnation
+    dead, so the acceptor must SUPERSEDE it -- answer with the same
+    epoch and adopt the fresh socket -- never expire a same-epoch
+    resumable session just because it had not noticed the death yet."""
+    if eng == "native":
+        from starway_tpu.core import native
+
+        if not native.available():
+            pytest.skip("native engine unavailable (no toolchain)")
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_SESSION", "1")
+    monkeypatch.setenv("STARWAY_SESSION_GRACE", "20")
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if eng == "native" else "0")
+    server = Server()
+    server.listen(ADDR, port)
+    sid = "cafe" * 4
+    s1 = s2 = None
+    try:
+        s1 = socket.create_connection((ADDR, port), timeout=10)
+        s1.settimeout(10)
+        ack1 = _raw_hello(s1, sid, "0", 0)
+        assert ack1.get("sess") == "ok"
+        epoch = ack1["sess_epoch"]
+        buf1 = np.zeros(64, dtype=np.uint8)
+        r1 = server.arecv(buf1, 0x1, (1 << 64) - 1)
+        s1.sendall(frames.pack_seq(1)
+                   + frames.pack_data_header(0x1, 64) + b"\x11" * 64)
+        await asyncio.wait_for(r1, timeout=15)
+        # Resume dial with the SAME (sid, epoch) while s1 is still open:
+        # the server has had no reason to consider the old conn dead.
+        s2 = socket.create_connection((ADDR, port), timeout=10)
+        s2.settimeout(10)
+        ack2 = _raw_hello(s2, sid, epoch, 0)
+        assert ack2.get("sess") == "ok", ack2
+        assert ack2.get("sess_epoch") == epoch, \
+            f"supersede must keep the epoch, got {ack2!r}"
+        # The adopted socket carries the session forward (seq continues).
+        buf2 = np.zeros(64, dtype=np.uint8)
+        r2 = server.arecv(buf2, 0x2, (1 << 64) - 1)
+        s2.sendall(frames.pack_seq(2)
+                   + frames.pack_data_header(0x2, 64) + b"\x22" * 64)
+        stag, ln = await asyncio.wait_for(r2, timeout=15)
+        assert (stag, ln) == (0x2, 64) and buf2[0] == 0x22 and buf2[-1] == 0x22
+        # ...and the stale incarnation's socket was torn down.
+        try:
+            s1.settimeout(10)
+            while s1.recv(4096):  # drain buffered ACKs until EOF/RST
+                pass
+        except OSError:
+            pass  # RST is as dead as EOF
+    finally:
+        for s in (s1, s2):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        await _aclose_all(server)
+
+
+async def test_clean_close_takes_seed_contract_not_grace(pair, port, tmp_path):
+    """A peer's routine aclose() is not a fault: the T_BYE goodbye lets
+    the survivor fail over to the ordinary disconnect contract at once
+    -- no grace-window stall, no redial, no session-expired flight dump
+    -- on every engine pairing (BYE tx and rx in both engines)."""
+    s_eng, c_eng, mp = pair
+    mp.setenv("STARWAY_FLIGHT_DIR", str(tmp_path))
+    server = _mk_server(s_eng, mp, port)
+    client = _mk_client(c_eng, mp)
+    await client.aconnect(ADDR, port)
+    try:
+        await _burst(client, server, n=2)
+        await asyncio.wait_for(server.aclose(), timeout=15)
+        await asyncio.sleep(1.0)  # BYE + EOF reach the client
+        t0 = time.monotonic()
+        with pytest.raises(Exception) as e:
+            await asyncio.wait_for(
+                client.asend(np.zeros(64, dtype=np.uint8), 0x9), timeout=15)
+        msg = str(e.value).lower()
+        # Prompt seed-style failure, never the 20s grace stall -> expiry.
+        assert "session expired" not in msg, msg
+        assert "not connected" in msg or "cancel" in msg, msg
+        assert time.monotonic() - t0 < 10
+        assert _sess_counters(client)["sessions_resumed"] == 0
+        blobs = [json.loads(p.read_text()) for p in tmp_path.iterdir()]
+        triggers = [b.get("trigger") for b in blobs]
+        assert "session-expired" not in triggers, triggers
+    finally:
+        await _aclose_all(client, server)
+
+
+# ------------------------------------------------- dedup / replay fault modes
+
+
+async def test_duplicate_frames_dropped(pair, port):
+    """FaultProxy `duplicate` mode sends every sequenced unit twice: the
+    receiver must drop the replays by sequence number (dup_frames_dropped
+    is the oracle) and deliver each message exactly once."""
+    s_eng, c_eng, mp = pair
+    server = _mk_server(s_eng, mp, port)
+    proxy = FaultProxy(ADDR, port, mode="duplicate").start()
+    client = _mk_client(c_eng, mp)
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        n = 10
+        await _burst(client, server, n=n)
+        ss = _sess_counters(server)
+        assert ss["dup_frames_dropped"] > 0
+        assert ss["recvs_completed"] == n
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+async def test_reorder_triggers_replay(pair, port):
+    """FaultProxy `reorder` mode swaps one adjacent pair of sequenced
+    units: the receiver sees an unrepairable gap, resets the conn, and
+    the redial + replay-from-cumulative-ACK path completes everything."""
+    s_eng, c_eng, mp = pair
+    server = _mk_server(s_eng, mp, port)
+    proxy = FaultProxy(ADDR, port, mode="reorder").start()
+    client = _mk_client(c_eng, mp)
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        n = 12
+        await _burst(client, server, n=n)
+        ss = _sess_counters(server)
+        cs = _sess_counters(client)
+        # The gap forces at least one resume; replay overlap may also
+        # produce dups, which must have been dropped, never delivered.
+        assert cs["sessions_resumed"] >= 1
+        assert ss["recvs_completed"] == n
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+# ------------------------------------------------------------- backpressure
+
+
+async def test_journal_backpressure_blocks_instead_of_growing(port, monkeypatch):
+    """With the journal capped tiny, sends past the cap park UNFRAMED
+    (bounded memory) and complete late as ACKs free room -- the
+    send-blocks-not-OOMs contract.  Py<->py so the journal is
+    inspectable."""
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+    monkeypatch.setenv("STARWAY_SESSION", "1")
+    monkeypatch.setenv("STARWAY_SESSION_GRACE", "30")
+    cap = 16384
+    monkeypatch.setenv("STARWAY_SESSION_JOURNAL_BYTES", str(cap))
+    monkeypatch.setenv("STARWAY_KEEPALIVE", "0.2")
+    monkeypatch.setenv("STARWAY_KEEPALIVE_MISSES", "2")
+    # Redial handshakes die fast: the engine thread must not sit in a 3s
+    # dial while this test inspects the journal between attempts.
+    monkeypatch.setenv("STARWAY_CONNECT_TIMEOUT", "0.25")
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        n, size = 12, 4096  # ~12x4KiB >> 16KiB cap
+        bufs = [np.zeros(size, dtype=np.uint8) for _ in range(n)]
+        recvs = [server.arecv(bufs[i], i, (1 << 64) - 1) for i in range(n)]
+        # Partition: keepalive detects the dead link -> suspend.  The
+        # proxy keeps swallowing the redial handshakes, holding the
+        # suspension while the burst lands on the journal.
+        proxy.partition()
+        await asyncio.sleep(1.0)
+        sends = [client.asend(np.full(size, i % 251, dtype=np.uint8), i)
+                 for i in range(n)]
+        worker = client._client
+        conns = [c for c in worker.conns.values() if getattr(c, "sess", None)]
+        assert conns, "session conn missing"
+        sess = conns[0].sess
+        # The engine drains submits between redial attempts; poll until
+        # the burst has been framed-or-parked.
+        deadline = time.monotonic() + 10
+        while (len(sess.waiting) + len(sess.journal) < n
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.1)
+        assert sess.journal_bytes <= cap + size + 64, sess.journal_bytes
+        assert len(sess.waiting) > 0  # backpressure parked the overflow
+        proxy.heal()
+        await asyncio.wait_for(asyncio.gather(*sends), timeout=60)
+        await asyncio.wait_for(client.aflush(), timeout=60)
+        res = await asyncio.wait_for(asyncio.gather(*recvs), timeout=60)
+        assert len(res) == n
+        assert not sess.waiting  # drained as ACKs freed room
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+# ------------------------------------------------------------------ expiry
+
+
+async def test_epoch_mismatch_session_expired(pair, port):
+    """The peer restarting (same address, new epoch) is not resumable:
+    ops riding out the outage fail with the stable "session expired"
+    reason instead of completing against the wrong incarnation."""
+    s_eng, c_eng, mp = pair
+    server = _mk_server(s_eng, mp, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = _mk_client(c_eng, mp)
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _burst(client, server, n=2)
+        # Simulate a server CRASH, not a clean shutdown: partition first
+        # so the close's T_BYE goodbye never reaches the client (a clean
+        # close would legitimately end the session without expiry -- see
+        # test_clean_close_takes_seed_contract_not_grace).
+        proxy.partition()
+        await _aclose_all(server)
+        # Let the proxy pumps drain-and-discard the close's BYE/EOF before
+        # healing: heal() too early would forward a BYE still sitting in
+        # the proxy's kernel buffer, turning the "crash" into a clean
+        # goodbye (and this test into the clean-close test).
+        await asyncio.sleep(0.4)
+        proxy.heal()
+        proxy.kill_all(rst=True)
+        # New server incarnation on the same port: resume dials reach it,
+        # but it answers with a fresh epoch.
+        server2 = _mk_server(s_eng, mp, port)
+        with pytest.raises(Exception) as e:
+            await asyncio.wait_for(
+                client.asend(np.zeros(64, dtype=np.uint8), 0x77), timeout=40)
+        msg = str(e.value).lower()
+        assert "session expired" in msg, msg
+        await _aclose_all(server2)
+    finally:
+        await _aclose_all(client)
+        proxy.stop()
+
+
+async def test_grace_elapsed_session_expired(port, monkeypatch):
+    """No peer comes back inside STARWAY_SESSION_GRACE: suspended ops
+    fail with "session expired" (bounded failure, not a hang)."""
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+    monkeypatch.setenv("STARWAY_SESSION", "1")
+    monkeypatch.setenv("STARWAY_SESSION_GRACE", "1.5")
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _burst(client, server, n=2)
+        proxy.stop()  # no resume target: redials fail until grace elapses
+        fut = client.asend(np.zeros(64, dtype=np.uint8), 0x99)
+        flush = client.aflush()
+        t0 = time.monotonic()
+        with pytest.raises(Exception) as e:
+            await asyncio.wait_for(fut, timeout=30)
+        assert "session expired" in str(e.value).lower()
+        with pytest.raises(Exception) as e2:
+            await asyncio.wait_for(flush, timeout=30)
+        assert "session expired" in str(e2.value).lower()
+        assert time.monotonic() - t0 < 20
+    finally:
+        await _aclose_all(client, server)
+
+
+# -------------------------------------------------------------- seed parity
+
+
+async def test_seed_parity_session_unset(port, monkeypatch):
+    """STARWAY_SESSION unset: a dead conn keeps the seed failure contract
+    of tests/test_basic.py -- in-flight sends cancel, posted recvs stay
+    pending, flush fails "not connected" -- and the session machinery
+    stays completely dark (all session counters zero)."""
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+    monkeypatch.delenv("STARWAY_SESSION", raising=False)
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        # Dirty the conn with a delivered message, then kill it.
+        buf0 = np.zeros(64, dtype=np.uint8)
+        fut0 = server.arecv(buf0, 0x0, (1 << 64) - 1)
+        await client.asend(np.ones(64, dtype=np.uint8), 0x0)
+        await asyncio.wait_for(fut0, timeout=15)
+        buf = np.zeros(64, dtype=np.uint8)
+        pending = server.arecv(buf, 0x1, (1 << 64) - 1)
+        await asyncio.sleep(0.2)
+        proxy.kill_all(rst=True)
+        await asyncio.sleep(0.5)
+        # Posted recv stays pending (peer death leaves recvs pending).
+        assert not pending.done()
+        # A send on the dead conn fails immediately ("not connected" --
+        # no transparent redial without the session opt-in)...
+        with pytest.raises(Exception) as es:
+            await asyncio.wait_for(
+                client.asend(np.zeros(64, dtype=np.uint8), 0x2), timeout=20)
+        assert "not connected" in str(es.value).lower()
+        # ...and a flush against the dead dirty conn fails the same way.
+        with pytest.raises(Exception) as e:
+            await asyncio.wait_for(client.aflush(), timeout=20)
+        assert "not connected" in str(e.value).lower()
+        for owner in (client, server):
+            snap = _sess_counters(owner)
+            for k in ("sessions_resumed", "frames_replayed",
+                      "dup_frames_dropped", "acks_tx", "acks_rx"):
+                assert snap[k] == 0, (k, snap[k])
+        pending.cancel()
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+# ---------------------------------------------------------- flight recorder
+
+
+async def test_flight_dump_on_native_resume(port, monkeypatch, tmp_path):
+    """A session resume is a flight-recorder dump trigger on the native
+    engine (sw_set_event_cb end to end): the post-mortem ring in the dump
+    carries the engine's sess_resume event."""
+    from starway_tpu.core import native
+
+    if not native.available():
+        pytest.skip("native engine unavailable (no toolchain)")
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "1")
+    monkeypatch.setenv("STARWAY_SESSION", "1")
+    monkeypatch.setenv("STARWAY_SESSION_GRACE", "20")
+    monkeypatch.setenv("STARWAY_FLIGHT_DIR", str(tmp_path))
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _burst(client, server, n=6,
+                     kill=lambda: proxy.kill_all(rst=True))
+        deadline = time.monotonic() + 10
+        blobs = []
+        while time.monotonic() < deadline:
+            blobs = [json.loads(p.read_text()) for p in tmp_path.iterdir()]
+            if any(b.get("trigger") == "session-resume" for b in blobs):
+                break
+            await asyncio.sleep(0.2)
+        resume = [b for b in blobs if b.get("trigger") == "session-resume"]
+        assert resume, [b.get("trigger") for b in blobs]
+        evs = {e[1] for e in resume[0].get("events", [])}
+        assert "sess_resume" in evs, evs
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+async def test_flight_dump_on_session_expiry(port, monkeypatch, tmp_path):
+    """Session expiry is the other dump trigger (py engine end)."""
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+    monkeypatch.setenv("STARWAY_SESSION", "1")
+    monkeypatch.setenv("STARWAY_SESSION_GRACE", "1.0")
+    monkeypatch.setenv("STARWAY_FLIGHT_DIR", str(tmp_path))
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        await _burst(client, server, n=2)
+        proxy.stop()
+        with pytest.raises(Exception):
+            await asyncio.wait_for(
+                client.asend(np.zeros(64, dtype=np.uint8), 0x5), timeout=30)
+        blobs = [json.loads(p.read_text()) for p in tmp_path.iterdir()]
+        assert any(b.get("trigger") == "session-expired" for b in blobs), \
+            [b.get("trigger") for b in blobs]
+    finally:
+        await _aclose_all(client, server)
+
+
+# ------------------------------------------------------------------- slow
+
+
+@pytest.mark.slow
+async def test_session_chaos_soak(port, monkeypatch):
+    """Soak: repeated kill/resume cycles with continuous traffic.  Every
+    op of every generation completes exactly once; the session survives
+    all of it (the CI session-chaos smoke is the short twin of this)."""
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+    monkeypatch.setenv("STARWAY_SESSION", "1")
+    monkeypatch.setenv("STARWAY_SESSION_GRACE", "30")
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        total = 0
+        for cycle in range(6):
+            n = 15
+            await _burst(client, server, n=n, tag0=cycle * 1000,
+                         kill=lambda: proxy.kill_all(rst=True))
+            total += n
+        ss = _sess_counters(server)
+        cs = _sess_counters(client)
+        assert ss["recvs_completed"] == total
+        assert cs["sessions_resumed"] >= 3
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()

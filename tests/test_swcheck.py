@@ -183,8 +183,8 @@ def test_garbled_doc_table(tmp_path):
 def test_version_drift(tmp_path):
     root = _seed(tmp_path)
     _edit(root, "native/sw_engine.cpp",
-          'return "starway-native-4"', 'return "starway-native-5"')
-    _assert_caught(root, "contract-version", "starway-native-5", "sw_engine.h")
+          'return "starway-native-5"', 'return "starway-native-6"')
+    _assert_caught(root, "contract-version", "starway-native-6", "sw_engine.h")
 
 
 def test_unmarked_multi_gib_test(tmp_path):
@@ -378,6 +378,82 @@ def test_hotpath_skips_frames_codec(tmp_path):
     p = root / "starway_tpu" / "core" / "frames.py"
     p.write_text(p.read_text() + "\ndef _seeded(v):\n    return bytes(v)\n")
     assert _findings(root, "hotpath-copy") == []
+
+
+# ------------------------- ISSUE 5: the resilient-session contract surface
+#
+# The session layer grew the wire format (T_SEQ/T_ACK), a handshake key
+# ("sess"), a reason literal ("session expired"), and five counters --
+# every one is contract surface the checker must hold across both engines.
+
+
+def test_session_frame_constant_drift(tmp_path):
+    # The new frame-table rows: T_SEQ/T_ACK diverging between the engines
+    # (either direction) is a finding.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/frames.py", "T_SEQ = 9", "T_SEQ = 11")
+    _assert_caught(root, "contract-frames", "T_SEQ", "frames.py")
+    root2 = _seed(tmp_path / "two")
+    _edit(root2, "native/sw_engine.cpp",
+          "constexpr uint8_t T_ACK = 10;", "constexpr uint8_t T_ACK = 12;")
+    # Frame diffs anchor at the Python side of the pair (the reference
+    # table), whichever engine drifted.
+    _assert_caught(root2, "contract-frames", "T_ACK = 12", "frames.py")
+
+
+def test_session_handshake_key_dropped(tmp_path):
+    # Deleting the "sess" negotiation from either engine's code fires,
+    # even when the key survives in comments/docstrings.
+    root = _seed(tmp_path)
+    p = root / "starway_tpu" / "core" / "engine.py"
+    p.write_text(p.read_text().replace('"sess"', '"sesz"')
+                 + '\n# the "sess" key lives only in this comment now\n')
+    _assert_caught(root, "contract-handshake", '"sess"', "engine.py")
+    root2 = _seed(tmp_path / "two")
+    p = root2 / "native" / "sw_engine.cpp"
+    p.write_text(p.read_text().replace('"sess"', '"sesz"')
+                 + '\n// the "sess" key lives only in this comment now\n')
+    _assert_caught(root2, "contract-handshake", '"sess"', "sw_engine.cpp")
+
+
+def test_session_reason_reworded(tmp_path):
+    # "session expired" is a stable reason keyword callers match on
+    # (tests/test_session.py): rewording it fires both sub-checks --
+    # keyword gone AND literal drift from the C++ kSessionExpired.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/errors.py",
+          'REASON_SESSION_EXPIRED = "Session expired (resume window elapsed'
+          ' or peer restarted)"',
+          'REASON_SESSION_EXPIRED = "Resume window closed"')
+    hits = _findings(root, "contract-reason")
+    assert any("stable keyword" in f.message for f in hits), hits
+    assert any("kSessionExpired" in f.message for f in hits), hits
+    _assert_caught(root, "contract-reason", "REASON_SESSION_EXPIRED",
+                   "errors.py")
+
+
+def test_session_counter_dropped_from_cpp(tmp_path):
+    # The five session counters (sessions_resumed, frames_replayed,
+    # dup_frames_dropped, acks_tx/rx) are vocabulary: renaming one in the
+    # C++ array alone fires on BOTH sides of the diff.
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          '"sessions_resumed"', '"sessions_resumed_v2"')
+    _assert_caught(root, "contract-trace", "sessions_resumed_v2",
+                   "sw_engine.cpp")
+    _assert_caught(root, "contract-trace", "'sessions_resumed'", "swtrace.py")
+
+
+def test_session_doc_table_row_garbled(tmp_path):
+    # The SEQ row of the frames.py docstring table must track T_SEQ; a
+    # garbled label is "constant missing from the table", never silence.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/frames.py",
+          "SEQ       next session frame's seq", "SEQX      next session frame's seq")
+    hits = _findings(root, "contract-doctable")
+    assert any("SEQX" in f.message for f in hits), hits
+    assert any("missing from the docstring table" in f.message
+               for f in hits), hits
 
 
 # ------------------------------------------------------------- CLI surface
