@@ -36,6 +36,7 @@
 #include <atomic>
 #include <cerrno>
 #include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -119,6 +120,13 @@ const char* kEvConnDown = "conn_down";
 //               Python data plane only; declared for vocabulary parity
 const char* kEvSessResume = "sess_resume";
 const char* kEvSessExpire = "sess_expire";
+// swscope (DESIGN.md §15): tag = per-conn per-direction wire ordinal,
+// reason = "<trace-conn id>:tx|rx|sup"; equal (id, ordinal) at the two
+// ends of a conn is ONE message (trace --merge pairs them).
+const char* kEvE2e = "e2e";
+// Clock-offset sample from a timestamped PING/PONG round trip:
+// reason = "<trace-conn id>:<offset_us>:<err_us>".
+const char* kEvClock = "clock_sample";
 
 // Counter vocabulary, same order as the Counters fields and the values
 // array in sw_counters() below (and as core/swtrace.py COUNTER_NAMES).
@@ -137,6 +145,19 @@ const char* kCounterNames[] = {
     "sessions_resumed",  "frames_replayed",
     "dup_frames_dropped",
     "acks_tx",           "acks_rx",
+};
+
+// swscope per-conn gauge vocabulary, same order as the values rendered by
+// sw_gauges() below (and as core/telemetry.py GAUGE_NAMES -- swcheck's
+// contract-trace rule diffs the two).  Instantaneous values, computed ON
+// the engine thread (sw_gauges marshals through the op queue), so the
+// data path carries no shadow state for them.  `posted_recvs` rides
+// alongside at worker level; `staging_pool_bytes` is wrapper-global and
+// overlaid by core/native.py, like the staging counters.
+const char* kGaugeNames[] = {
+    "tx_queue_depth",  "tx_queue_bytes",
+    "inflight_sends",  "inflight_recvs",
+    "journal_bytes",   "journal_frames",
 };
 
 struct Counters {
@@ -213,6 +234,14 @@ struct TraceRing {
     e.ev = ev;  // written last: a nonnull ev marks the slot renderable
   }
 };
+
+// CLOCK_MONOTONIC nanoseconds -- the same epoch the trace ring's `t`
+// stamps use (steady_clock), wire format of the PING/PONG clock channel.
+uint64_t now_ns() {
+  return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+             Clock::now().time_since_epoch())
+      .count();
+}
 
 uint64_t rndv_threshold() {
   static uint64_t v = [] {
@@ -843,6 +872,7 @@ struct TxItem {
   bool switch_after = false;
   // --- session layer (Conn::sess) ---
   bool counted = false;       // sends_completed recorded (replay can't re-count)
+  uint64_t e2e_ord = 0;       // swscope wire ordinal (assigned at first full TX)
   uint64_t sess_seq = 0;      // sequence number (0 = unframed)
   uint64_t sess_nbytes = 0;   // journal accounting (prefix + header + payload)
   std::vector<uint8_t> owned; // eager payload snapshot (the user may reuse
@@ -923,6 +953,14 @@ struct Conn {
   // life -- any inbound bytes (stream, ring, or doorbell) refresh it.
   bool ka_ok = false;
   Clock::time_point last_rx = Clock::now();
+  // swscope (DESIGN.md §15): negotiated trace-conn id ("tr" handshake
+  // key; empty = dark), per-direction wire ordinals pairing EV_E2E
+  // events across processes, and the best clock-offset estimate from
+  // timestamped PING/PONG samples (peer ~= local + offset).
+  char tr_hex[17] = {0};
+  uint64_t tx_e2e = 0, rx_e2e = 0;
+  int64_t clock_off_us = 0;
+  uint64_t clock_err_us = 0;  // 0 = no sample yet
   uint64_t ctl_a = 0;  // header `a` of the ctl frame being accumulated
   std::unordered_set<uint64_t> devpull_pending;
   std::vector<std::pair<uint64_t, std::unordered_set<uint64_t>>> devpull_deferred;
@@ -985,9 +1023,22 @@ struct FlushRec {
 
 // ------------------------------------------------------------------ ops
 
+// sw_gauges rendezvous: the calling thread parks on the condvar while the
+// engine thread renders the snapshot.  Gauges are computed from live
+// engine-owned state (tx queues, journals, rx parser), so marshaling one
+// op beats maintaining lock-free shadow copies of every queue -- and the
+// off path stays untouched.  Heap-held via shared_ptr: a timed-out caller
+// may return before the engine signals, and the op must not dangle.
+struct GaugesWait {
+  std::mutex m;
+  std::condition_variable cv;
+  bool done = false;
+  std::string json;
+};
+
 struct Op {
   enum Kind { SEND, FLUSH, SEND_DEVPULL, DEVPULL_RESOLVED,
-              DEVPULL_CLAIM, DEVPULL_PURGE } kind;
+              DEVPULL_CLAIM, DEVPULL_PURGE, GAUGES } kind;
   uint64_t conn_id = 0;       // SEND target; FLUSH: 0 = all conns
   bool conn_scoped = false;   // FLUSH limited to conn_id
   const uint8_t* buf = nullptr;
@@ -1002,6 +1053,7 @@ struct Op {
   uint64_t msg_id = 0;  // DEVPULL_RESOLVED / _CLAIM / _PURGE: remote id
   uint64_t rctx = 0;    // DEVPULL_CLAIM: claimed receive's registry ctx
   int flags = 0;        // DEVPULL_CLAIM: 0 claimed, 1 truncated
+  std::shared_ptr<GaugesWait> gwait;  // GAUGES: rendezvous with the caller
 };
 
 // --------------------------------------------------------------- worker
@@ -1438,6 +1490,15 @@ struct Worker {
       item->off = 0;
       c->tx.push_back(item);
       replayed++;
+      if (trace.enabled && c->tr_hex[0] && item->counted && item->e2e_ord) {
+        // swscope: this frame's ordinal was recorded at its first full
+        // transmission; the replay rewrites the bytes (the receiver's
+        // seq dedup drops them if they landed) -- mark it superseded,
+        // never recount it.
+        char reason[24];
+        snprintf(reason, sizeof(reason), "%s:sup", c->tr_hex);
+        trace.rec(kEvE2e, item->e2e_ord, c->id, 0, reason);
+      }
     }
     bump(counters.frames_replayed, replayed);
     sess_drain_waiting(c);  // trim may have freed journal room
@@ -1885,6 +1946,25 @@ struct Worker {
       memcpy(&tag, item.header.data() + toff + 1, 8);
       trace.rec(kEvSendDone, tag, c->id, item.paylen);
     }
+    if (trace.enabled && c->tr_hex[0]) {
+      // swscope tx ordinal: completion order IS wire order, so this
+      // ordinal equals the receiver's accept ordinal for the same
+      // message; `counted` above makes it once-only across replays.
+      item.e2e_ord = ++c->tx_e2e;
+      char reason[24];
+      snprintf(reason, sizeof(reason), "%s:tx", c->tr_hex);
+      trace.rec(kEvE2e, item.e2e_ord, c->id, item.paylen, reason);
+    }
+  }
+
+  // swscope rx ordinal: one EV_E2E per accepted (non-dup) data frame, in
+  // stream order (dup session frames drain via sess_drop/rx_skip and
+  // never reach this counter).
+  void rx_e2e(Conn* c, uint64_t nbytes) {
+    if (!trace.enabled || !c->tr_hex[0]) return;
+    char reason[24];
+    snprintf(reason, sizeof(reason), "%s:rx", c->tr_hex);
+    trace.rec(kEvE2e, ++c->rx_e2e, c->id, nbytes, reason);
   }
 
   void kick_tx(Conn* c, FireList& fires) {
@@ -2108,12 +2188,14 @@ struct Worker {
         if (r <= 0) return;
         m->received += (uint64_t)r;
         if (m->received >= m->length) {
+          uint64_t mlen = m->length;
           {
             std::lock_guard<std::mutex> g(mu);
             matcher.on_complete(m, fires);
           }
           c->rx_msg = nullptr;
           c->rx_msg_unowned = false;
+          rx_e2e(c, mlen);
           sess_commit(c);
         }
         continue;
@@ -2136,6 +2218,7 @@ struct Worker {
         if (t == T_HELLO) on_hello(c, body, fires);
         else if (t == T_DEVPULL) {
           on_devpull(c, ctl_a, body, fires);
+          rx_e2e(c, body.size());
           sess_commit(c);
         }
         // T_HELLO_ACK handled synchronously during client connect
@@ -2168,7 +2251,10 @@ struct Worker {
               c->rx_msg_unowned = (a == Matcher::kProbeTag);
             }
           }
-          if (b == 0) sess_commit(c);
+          if (b == 0) {
+            rx_e2e(c, 0);
+            sess_commit(c);
+          }
           break;
         }
         case T_FLUSH:
@@ -2213,10 +2299,35 @@ struct Worker {
           break;
         case T_PING:
           // Liveness probe: answer immediately (stream_read already
-          // refreshed last_rx, so inbound PINGs also prove the peer alive).
-          conn_send_ctl(c, T_PONG, 0, 0, "", fires);
+          // refreshed last_rx, so inbound PINGs also prove the peer
+          // alive).  A timestamped PING gets its echo + our own clock
+          // reading -- the swscope sample channel (frames.py).
+          conn_send_ctl(c, T_PONG, a, now_ns(), "", fires);
           break;
         case T_PONG:
+          // Timestamped PONG: one NTP-style clock sample for this peer
+          // (offset = t_peer - (t_tx + rtt/2), error rtt/2).  Zero
+          // fields mean an old peer's plain probe answer.
+          if (a && b) {
+            uint64_t now = now_ns();
+            if (now >= a) {
+              uint64_t rtt = now - a;
+              uint64_t err_us = rtt / 2000;
+              if (err_us < 1) err_us = 1;
+              int64_t off_us =
+                  ((int64_t)b - (int64_t)(a + rtt / 2)) / 1000;
+              if (c->clock_err_us == 0 || err_us < c->clock_err_us) {
+                c->clock_off_us = off_us;
+                c->clock_err_us = err_us;
+              }
+              if (trace.enabled && c->tr_hex[0]) {
+                char reason[48];
+                snprintf(reason, sizeof(reason), "%s:%lld:%llu", c->tr_hex,
+                         (long long)off_us, (unsigned long long)err_us);
+                trace.rec(kEvClock, 0, c->id, 0, reason);
+              }
+            }
+          }
           break;  // proof of life recorded by stream_read
         case T_HELLO:
         case T_HELLO_ACK:
@@ -2501,6 +2612,13 @@ struct Worker {
     if (devpull_advertise && json_field(body, "devpull") == "ok")
       c->devpull_ok = true;
     if (json_field(body, "ka") == "ok") c->ka_ok = true;  // liveness capability
+    if (trace.enabled) {
+      // swscope stitching: adopt the connector's trace-conn id so both
+      // rings tag this conn's EV_E2E events identically (DESIGN.md §15).
+      std::string tr = json_field(body, "tr");
+      if (!tr.empty() && tr.size() < sizeof(c->tr_hex))
+        snprintf(c->tr_hex, sizeof(c->tr_hex), "%s", tr.c_str());
+    }
     std::string sess_ext;
     if (c->sess)
       sess_ext = std::string(", \"sess\": \"ok\", \"sess_epoch\": \"") +
@@ -2508,7 +2626,8 @@ struct Worker {
     std::string ack = std::string("{\"worker_id\": \"") + worker_id + "\"" +
                       (seg ? ", \"sm\": \"ok\"" : "") +
                       (c->devpull_ok ? ", \"devpull\": \"ok\"" : "") +
-                      (c->ka_ok ? ", \"ka\": \"ok\"" : "") + sess_ext + "}";
+                      (c->ka_ok ? ", \"ka\": \"ok\"" : "") +
+                      (c->tr_hex[0] ? ", \"tr\": \"ok\"" : "") + sess_ext + "}";
     // The ACK is the transport switch point (see TxItem::switch_after).
     conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires,
                   /*switch_after=*/seg != nullptr);
@@ -2701,7 +2820,9 @@ struct Worker {
         continue;  // no transport to probe; the grace timer governs
       auto silent = now - c->last_rx;
       if (silent > window) expired.push_back(c);
-      else if (silent >= interval) conn_send_ctl(c, T_PING, 0, 0, "", fires);
+      else if (silent >= interval)
+        // Timestamped: the PONG doubles as a swscope clock sample.
+        conn_send_ctl(c, T_PING, now_ns(), 0, "", fires);
     }
     for (Conn* c : expired) conn_expired(c, fires);
   }
@@ -2717,6 +2838,64 @@ struct Worker {
     conn_broken(c, fires);
   }
 
+  // ------------------------------------------------------ swscope gauges
+  // Render the per-conn gauge snapshot (kGaugeNames order; the
+  // core/telemetry.py GAUGE_NAMES twin) plus worker-level posted_recvs.
+  // Engine-thread context only (or a quiescent worker): the values read
+  // live engine-owned queues, which is exactly why sw_gauges marshals
+  // here instead of maintaining lock-free shadows on the data path.
+  std::string gauges_json() {
+    std::string s = "{\"conns\": {";
+    std::lock_guard<std::mutex> g(mu);
+    bool first = true;
+    for (auto& [id, c] : conns) {
+      uint64_t depth = c->tx.size(), qbytes = 0, infl = 0;
+      for (auto& ref : c->tx) {
+        qbytes += ref->total() - ref->off;
+        if (ref->is_data && ref->off < ref->total()) infl++;
+      }
+      uint64_t jb = 0, jf = 0;
+      if (c->sess) {
+        Session* ss = c->sess.get();
+        depth += ss->waiting.size();
+        for (auto& ref : ss->waiting) {
+          qbytes += ref->total();
+          if (ref->is_data) infl++;
+        }
+        jb = ss->journal_bytes;
+        jf = ss->journal.size();
+      }
+      uint64_t inflr = (c->rx_msg ? 1 : 0) + c->devpull_pending.size();
+      const uint64_t vals[] = {depth, qbytes, infl, inflr, jb, jf};
+      static_assert(sizeof(vals) / sizeof(vals[0]) ==
+                        sizeof(kGaugeNames) / sizeof(kGaugeNames[0]),
+                    "gauge names and values out of sync");
+      char buf[96];
+      int n = snprintf(buf, sizeof(buf), "%s\"%llu\": {", first ? "" : ", ",
+                       (unsigned long long)id);
+      s.append(buf, (size_t)n);
+      for (size_t i = 0; i < sizeof(vals) / sizeof(vals[0]); i++) {
+        n = snprintf(buf, sizeof(buf), "%s\"%s\": %llu", i == 0 ? "" : ", ",
+                     kGaugeNames[i], (unsigned long long)vals[i]);
+        s.append(buf, (size_t)n);
+      }
+      s += "}";
+      first = false;
+    }
+    s += "}, \"posted_recvs\": " + std::to_string(matcher.posted.size()) + "}";
+    return s;
+  }
+
+  static void gauges_signal(const std::shared_ptr<GaugesWait>& wait,
+                            std::string json) {
+    {
+      std::lock_guard<std::mutex> lg(wait->m);
+      wait->json = std::move(json);
+      wait->done = true;
+    }
+    wait->cv.notify_all();
+  }
+
   // --------------------------------------------------------------- main
   void drain_ops(FireList& fires) {
     for (;;) {
@@ -2726,6 +2905,10 @@ struct Worker {
         if (ops.empty() || status.load() != ST_RUNNING) return;
         op = ops.front();
         ops.pop_front();
+      }
+      if (op.kind == Op::GAUGES) {
+        gauges_signal(op.gwait, gauges_json());
+        continue;
       }
       if (op.kind == Op::DEVPULL_CLAIM) {
         if (devpull_claim_cb) {
@@ -2782,6 +2965,13 @@ struct Worker {
       std::lock_guard<std::mutex> g(mu);
       while (!ops.empty()) {
         Op& op = ops.front();
+        if (op.kind == Op::GAUGES) {
+          // Never leave a sw_gauges caller parked on a dead engine: a
+          // closed worker's gauges are all drained-to-zero by contract.
+          gauges_signal(op.gwait, "{\"conns\": {}, \"posted_recvs\": 0}");
+          ops.pop_front();
+          continue;
+        }
         if (op.kind == Op::DEVPULL_CLAIM && devpull_claim_cb) {
           // Deliver the claim so the embedder's close sweep can cancel the
           // receive (it left the matcher; nothing else can reach it).
@@ -2997,6 +3187,14 @@ struct ClientWorker : Worker {
     }
     if (devpull_advertise) hello += ", \"devpull\": \"ok\"";
     hello += ", \"ka\": \"ok\"";  // liveness capability, always offered
+    char tr_offer[17] = {0};
+    if (trace.enabled) {
+      // swscope stitching: offer a fresh trace-conn id (DESIGN.md §15).
+      uint64_t r = 0;
+      if (getrandom(&r, 8, 0) != 8) r = (uint64_t)(uintptr_t)this ^ now_ns();
+      snprintf(tr_offer, sizeof(tr_offer), "%016llx", (unsigned long long)r);
+      hello += std::string(", \"tr\": \"") + tr_offer + "\"";
+    }
     hello += "}";
     std::vector<uint8_t> frame(HEADER_SIZE + hello.size());
     pack_header(frame.data(), T_HELLO, 0, hello.size());
@@ -3048,6 +3246,8 @@ struct ClientWorker : Worker {
     c->peer_name = json_field(ack_body, "worker_id");
     c->devpull_ok = devpull_advertise && json_field(ack_body, "devpull") == "ok";
     c->ka_ok = json_field(ack_body, "ka") == "ok";
+    if (tr_offer[0] && json_field(ack_body, "tr") == "ok")
+      memcpy(c->tr_hex, tr_offer, sizeof(c->tr_hex));
     if (sess_on && json_field(ack_body, "sess") == "ok") {
       c->sess = std::make_unique<Session>();
       c->sess->id = worker_id;
@@ -3083,6 +3283,11 @@ struct ClientWorker : Worker {
     }
     ep_add(fd, EPOLLIN, c);
     trace.rec(kEvConnUp, 0, c->id);
+    if (c->tr_hex[0]) {
+      // One-shot clock exchange at handshake: a timestamped PING whose
+      // PONG yields the first EV_CLOCK sample even with keepalive off.
+      conn_send_ctl(c, T_PING, now_ns(), 0, "", fires);
+    }
     int expect = ST_INIT;
     status.compare_exchange_strong(expect, ST_RUNNING);
     if (c_status_cb) {
@@ -3113,8 +3318,10 @@ extern "C" {
 
 // 2: sm transport; 3: op deadlines + PING/PONG peer liveness;
 // 4: swtrace observability (sw_counters/sw_trace);
-// 5: resilient sessions (T_SEQ/T_ACK, "sess" handshake, sw_set_event_cb)
-const char* sw_version() { return "starway-native-5"; }
+// 5: resilient sessions (T_SEQ/T_ACK, "sess" handshake, sw_set_event_cb);
+// 6: swscope ("tr" handshake + EV_E2E ordinals, timestamped PING/PONG
+//    clock samples, per-conn gauges via sw_gauges)
+const char* sw_version() { return "starway-native-6"; }
 
 // Portable cursor atomics for the Python engine's sm ring (sw_engine.h).
 // std::atomic_ref would be C++20-tidy but libstdc++'s needs alignment UB
@@ -3495,6 +3702,53 @@ int sw_trace(void* h, char* out, int cap) {
   out[off++] = ']';
   out[off] = 0;
   return off;
+}
+
+// swscope gauge snapshot (sw_engine.h).  The gauges read live
+// engine-owned queues, so the call marshals to the engine thread via the
+// op queue and parks on a condvar; direct render when called ON the
+// engine thread (a user callback) or when the engine is quiescent
+// (VOID/CLOSED).  A wedged engine times out to -1 instead of hanging
+// the sampler.
+int sw_gauges(void* h, char* out, int cap) {
+  Worker* w = W(h);
+  std::string json;
+  if (std::this_thread::get_id() == w->engine_tid) {
+    json = w->gauges_json();
+  } else {
+    auto wait = std::make_shared<GaugesWait>();
+    bool queued = false;
+    {
+      std::lock_guard<std::mutex> g(w->mu);
+      int st = w->status.load();
+      if (st == ST_INIT || st == ST_RUNNING || st == ST_CLOSING) {
+        Op op;
+        op.kind = Op::GAUGES;
+        op.gwait = wait;
+        w->ops.push_back(op);
+        queued = true;
+      }
+    }
+    if (queued) {
+      w->wake();
+      std::unique_lock<std::mutex> lk(wait->m);
+      if (!wait->cv.wait_for(lk, std::chrono::seconds(2),
+                             [&] { return wait->done; }))
+        return -1;  // engine wedged: no snapshot beats a torn one
+      json = wait->json;
+    } else {
+      // VOID / CLOSED: no engine thread is touching conn queues.
+      json = w->gauges_json();
+    }
+  }
+  int n = (int)json.size();
+  // Cap too small: report the needed size (negated, incl. NUL) so the
+  // caller can retry sized exactly -- a high-fan-out worker's snapshot
+  // must not silently degrade to empty.  Distinct from the wedged -1
+  // (n >= 20 always, so -(n + 1) never collides with it).
+  if (n + 1 > cap) return -(n + 1);
+  memcpy(out, json.c_str(), (size_t)n + 1);
+  return n;
 }
 
 // Engine-event notifications (session resume/expiry) for the wrapper's

@@ -69,11 +69,14 @@ typedef void (*sw_event_cb)(void* ctx, const char* event, uint64_t conn_id);
 /* Engine identification string: op deadlines + PING/PONG peer liveness +
  * swtrace observability (sw_counters/sw_trace) + resilient sessions
  * (T_SEQ/T_ACK sequence-numbered exactly-once delivery, replay journal,
- * transparent resume -- negotiated via "sess", DESIGN.md §14).  The
- * annotation below is machine-checked against the sw_engine.cpp
- * implementation by the contract checker (python -m starway_tpu.analysis,
- * rule contract-version) -- bump BOTH when the protocol changes.
- * swcheck: engine-version "starway-native-5" */
+ * transparent resume -- negotiated via "sess", DESIGN.md §14) + swscope
+ * (end-to-end EV_E2E ordinals via the "tr" handshake key, timestamped
+ * PING/PONG clock samples, per-conn gauges via sw_gauges -- DESIGN.md
+ * §15).  The annotation below is machine-checked against the
+ * sw_engine.cpp implementation by the contract checker (python -m
+ * starway_tpu.analysis, rule contract-version) -- bump BOTH when the
+ * protocol changes.
+ * swcheck: engine-version "starway-native-6" */
 const char* sw_version(void);
 
 /* Allocate a client/server worker in the VOID state.  `worker_id` is the
@@ -185,6 +188,20 @@ int sw_counters(void* h, char* out, int cap);
  * being overwritten concurrently may render garbled but never corrupts
  * the JSON framing. */
 int sw_trace(void* h, char* out, int cap);
+
+/* swscope live-gauge snapshot (DESIGN.md §15): a JSON object
+ * {"conns": {"<conn_id>": {"tx_queue_depth": N, "tx_queue_bytes": N,
+ * "inflight_sends": N, "inflight_recvs": N, "journal_bytes": N,
+ * "journal_frames": N}}, "posted_recvs": N} over the kGaugeNames
+ * vocabulary (the core/telemetry.py GAUGE_NAMES twin, machine-checked by
+ * rule contract-trace).  Values are instantaneous and drain to zero on
+ * an idle, flushed worker.  Thread-safe: the call marshals to the engine
+ * thread (gauges read live engine-owned queues) and blocks briefly;
+ * callable from engine-thread callbacks (renders directly).  Returns the
+ * body length; -(needed bytes) when `cap` is too small (retry with that
+ * capacity); -1 when the engine did not answer within the internal
+ * deadline. */
+int sw_gauges(void* h, char* out, int cap);
 
 /* ------------------------------------------------------------- devpull
  *

@@ -15,6 +15,11 @@ roles can differ):
 
     python scripts/session_chaos.py --server-engine native --client-engine py
 
+Progress is LIVE: the swscope telemetry sampler (core/telemetry.py,
+DESIGN.md §15) is armed for the run and every cycle prints the current
+resume count and session-journal residency from its latest sample -- a
+stalled chaos run shows where it stalled, not just a missing final line.
+
 Exit 0 and one JSON result line on success; non-zero with a diagnostic on
 any lost, duplicated, or failed op.
 """
@@ -43,17 +48,35 @@ def _parse():
     return ap.parse_args()
 
 
+def _print_live(cycle: int, total: int, sample: dict) -> None:
+    """One progress line per cycle, read from the sampler's snapshot (the
+    same JSONL shape STARWAY_METRICS_PATH emits)."""
+    resumes = replayed = journal = 0
+    for wk in sample.get("workers", {}).values():
+        ctr = wk.get("counters", {})
+        resumes += ctr.get("sessions_resumed", 0)
+        replayed += ctr.get("frames_replayed", 0)
+        for g in wk.get("gauges", {}).get("conns", {}).values():
+            journal += g.get("journal_bytes", 0)
+    print(f"[cycle {cycle}] ops={total} resumes={resumes} "
+          f"replayed={replayed} journal_bytes={journal}",
+          file=sys.stderr, flush=True)
+
+
 async def _main(args) -> int:
     # Env before any worker is built: workers sample it at construction.
     os.environ["STARWAY_TLS"] = "tcp"
     os.environ["STARWAY_SESSION"] = "1"
     os.environ.setdefault("STARWAY_SESSION_GRACE", "30")
+    # Arm the swscope sampler so progress prints come from live samples.
+    os.environ.setdefault("STARWAY_METRICS_INTERVAL", "0.25")
 
     import socket
 
     import numpy as np
 
     from starway_tpu import Client, Server
+    from starway_tpu.core import telemetry
     from starway_tpu.testing.faults import FaultProxy
 
     with socket.socket() as s:  # a free loopback port for the server
@@ -90,6 +113,7 @@ async def _main(args) -> int:
                 assert bufs[i][0] == (tag0 + i) % 251, (cycle, i)
                 assert bufs[i][-1] == (tag0 + i) % 251, (cycle, i)
             total += n
+            _print_live(cycle, total, telemetry.sample_now())
 
         ss = server._server.counters_snapshot()
         cs = client._client.counters_snapshot()

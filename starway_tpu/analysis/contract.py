@@ -41,8 +41,9 @@ _REASON_PAIRS = [
 
 # Negotiated handshake keys: offered in HELLO, confirmed in HELLO_ACK.
 # "sess" is the resilient-session negotiation (DESIGN.md §14; carries the
-# sess_id/sess_epoch/sess_ack triple alongside it).
-_HANDSHAKE_KEYS = ["ka", "sm", "devpull", "sess"]
+# sess_id/sess_epoch/sess_ack triple alongside it); "tr" is the swscope
+# end-to-end trace-conn id (DESIGN.md §15).
+_HANDSHAKE_KEYS = ["ka", "sm", "devpull", "sess", "tr"]
 
 # Normalised C type -> acceptable canonical ctypes spellings.
 _C2CTYPES = {
@@ -311,6 +312,33 @@ def _check_trace(py: PyModel, cpp: CppModel, out: list) -> None:
                 f"counter {name!r} is declared in kCounterNames[] only -- "
                 f"{f_sw}:{py_line} COUNTER_NAMES lacks it "
                 "(a counter added to one engine only)"))
+    # swscope gauge vocabulary (ISSUE 6): GAUGE_NAMES <-> kGaugeNames[],
+    # vacuity-guarded like the counter pair above.
+    f_tel = py.files["telemetry"]
+    if py.gauge_names is None:
+        out.append(Finding(f_tel, 1, "contract-trace",
+                           "GAUGE_NAMES tuple not found"))
+        return
+    if cpp.gauge_names is None:
+        out.append(Finding(cpp.cpp_file, 1, "contract-trace",
+                           "kGaugeNames[] array not found"))
+        return
+    pg_names, pg_line = py.gauge_names
+    cg_names, cg_line = cpp.gauge_names
+    for name in pg_names:
+        if name not in cg_names:
+            out.append(Finding(
+                f_tel, pg_line, "contract-trace",
+                f"gauge {name!r} is declared in GAUGE_NAMES only -- "
+                f"{cpp.cpp_file}:{cg_line} kGaugeNames[] lacks it "
+                "(a gauge added to one engine only)"))
+    for name in cg_names:
+        if name not in pg_names:
+            out.append(Finding(
+                cpp.cpp_file, cg_line, "contract-trace",
+                f"gauge {name!r} is declared in kGaugeNames[] only -- "
+                f"{f_tel}:{pg_line} GAUGE_NAMES lacks it "
+                "(a gauge added to one engine only)"))
 
 
 def _check_version(cpp: CppModel, out: list) -> None:

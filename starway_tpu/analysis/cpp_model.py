@@ -64,6 +64,7 @@ class CppModel:
     reasons: dict = field(default_factory=dict)     # kName -> (str, line)
     trace_events: dict = field(default_factory=dict)  # kEv* -> (str, line)
     counter_names: Optional[tuple] = None           # (list[str], line)
+    gauge_names: Optional[tuple] = None             # (list[str], line)
     version: Optional[tuple] = None                 # (str, line) from .cpp
     header_version: Optional[tuple] = None          # (str, line) from .h
     functions: dict = field(default_factory=dict)   # name -> CppFunc (.h)
@@ -85,6 +86,12 @@ _REASON_RE = re.compile(r'const\s+char\s*\*\s*(k\w+)\s*=\s*"([^"]*)"\s*;')
 # vocabulary (contract-trace pairs it with core/swtrace.py COUNTER_NAMES).
 _COUNTERS_RE = re.compile(
     r"const\s+char\s*\*\s*kCounterNames\s*\[\s*\]\s*=\s*\{([^}]*)\}", re.S
+)
+
+# const char* kGaugeNames[] = {"a", ...}; -- the swscope per-conn gauge
+# vocabulary (contract-trace pairs it with core/telemetry.py GAUGE_NAMES).
+_GAUGES_RE = re.compile(
+    r"const\s+char\s*\*\s*kGaugeNames\s*\[\s*\]\s*=\s*\{([^}]*)\}", re.S
 )
 
 _VERSION_RE = re.compile(
@@ -161,6 +168,10 @@ def extract_cpp(root: Path) -> CppModel:
         if m:
             names = re.findall(r'"([^"]*)"', m.group(1))
             model.counter_names = (names, _line_of(text, m.start()))
+        m = _GAUGES_RE.search(text)
+        if m:
+            names = re.findall(r'"([^"]*)"', m.group(1))
+            model.gauge_names = (names, _line_of(text, m.start()))
         m = _VERSION_RE.search(text)
         if m:
             model.version = (m.group(1), _line_of(text, m.start()))

@@ -131,6 +131,7 @@ class PyModel:
     engine_strings: set = field(default_factory=set)  # engine.py code literals
     trace_events: dict = field(default_factory=dict)  # EV_* -> (str, line)
     counter_names: Optional[tuple] = None            # (list[str], line)
+    gauge_names: Optional[tuple] = None              # (list[str], line)
     native_text: str = ""                            # core/native.py source
     files: dict = field(default_factory=dict)        # logical -> repo-rel path
 
@@ -153,6 +154,7 @@ def extract_py(root: Path) -> PyModel:
         "engine": "starway_tpu/core/engine.py",
         "errors": "starway_tpu/errors.py",
         "swtrace": "starway_tpu/core/swtrace.py",
+        "telemetry": "starway_tpu/core/telemetry.py",
     }
 
     tree = _parse(core / "frames.py")
@@ -242,5 +244,19 @@ def extract_py(root: Path) -> PyModel:
                          if isinstance(e, ast.Constant)
                          and isinstance(e.value, str)]
                 model.counter_names = (names, node.lineno)
+
+    tree = _parse(core / "telemetry.py")
+    if tree is not None:
+        for node in tree.body:
+            # GAUGE_NAMES = ("tx_queue_depth", ...) -- the swscope per-conn
+            # gauge vocabulary (contract-trace pairs it with kGaugeNames[]).
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "GAUGE_NAMES" \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                names = [e.value for e in node.value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)]
+                model.gauge_names = (names, node.lineno)
 
     return model

@@ -91,6 +91,13 @@ def build_parser() -> argparse.ArgumentParser:
              "Chrome trace_event JSON here (open in Perfetto); the printed "
              "report gains a p-tile stage breakdown.",
     )
+    p.add_argument(
+        "--metrics", type=Path, metavar="PATH",
+        help="Arm the swscope telemetry sampler (STARWAY_METRICS_PATH) for "
+             "the run, appending JSONL samples here; the JSON report gains "
+             "a 'telemetry' time-series summary (peak/mean queue depth, "
+             "journal high-water).  View with python -m starway_tpu.metrics.",
+    )
     return p
 
 
@@ -399,8 +406,33 @@ def dump_results(results, args: argparse.Namespace) -> None:
             report["trace"] = str(args.trace)
             if stage_ptiles:
                 report["stage_ptiles"] = stage_ptiles
+        if args.metrics:
+            report["metrics"] = str(args.metrics)
+            report["telemetry"] = _telemetry_summary()
         args.output.write_text(json.dumps(report, indent=2))
         print(f"\nJSON results written to {args.output}")
+    elif args.metrics:
+        _telemetry_summary()
+
+
+def _telemetry_summary() -> dict:
+    """Close the --metrics run: one final sample (so the last counter
+    deltas land in the JSONL) and the whole-run gauge summary."""
+    from .core import telemetry
+
+    telemetry.sample_now()
+    summary = telemetry.summarize(
+        telemetry.recent_samples(config_metrics_window()))
+    print(f"[telemetry] {summary['samples']} sample(s); peak tx queue depth "
+          f"{summary['peak_tx_queue_depth']}, peak journal bytes "
+          f"{summary['peak_journal_bytes']}")
+    return summary
+
+
+def config_metrics_window() -> int:
+    from . import config
+
+    return config.metrics_ring_size()
 
 
 def main(argv: Sequence[str] | None = None) -> int:
@@ -411,6 +443,17 @@ def main(argv: Sequence[str] | None = None) -> int:
         # Must land before any worker is created: rings are armed per
         # worker at construction (core/swtrace.py).
         os.environ["STARWAY_TRACE"] = "1"
+    if args.metrics:
+        # Same construction-time rule for the sampler registry
+        # (core/telemetry.py register_worker).  Start the file fresh:
+        # the emitter appends, and stale samples from an earlier run
+        # would break the per-process mono ordering consumers assert.
+        try:
+            args.metrics.unlink()
+        except OSError:
+            pass
+        os.environ["STARWAY_METRICS_PATH"] = str(args.metrics)
+        os.environ.setdefault("STARWAY_METRICS_INTERVAL", "0.25")
     if getattr(args, "payload", None) == "device":
         # devpull is only advertised in the handshake once the jax backend
         # is up (the handshake never initialises one); device-payload runs

@@ -129,6 +129,31 @@ benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
     after a fault each dump the worker's last-N trace events + counter
     snapshot as JSON there (post-mortem forensics, DESIGN.md §13).
     Setting it implicitly arms the trace ring even without STARWAY_TRACE.
+
+``STARWAY_METRICS_INTERVAL``
+    swscope live-telemetry sampling period in seconds (default 0 =
+    sampler off, DESIGN.md §15).  When > 0, a daemon thread snapshots
+    every worker's counter registry plus the per-conn gauges (TX queue
+    depth/bytes, in-flight sends/recvs, session journal residency,
+    staging-pool occupancy -- core/telemetry.py GAUGE_NAMES; native side
+    via the ``sw_gauges`` ABI call) into a bounded ring of timestamped
+    samples, surfaced through ``evaluate_perf_detail()["telemetry"]``
+    and flight-recorder dumps.  The off path adds no per-op work: the
+    sampler is a background thread, armed per worker at construction.
+
+``STARWAY_METRICS_PATH``
+    JSONL file the sampler appends each sample to (one JSON object per
+    line).  Setting it arms the sampler even without
+    STARWAY_METRICS_INTERVAL (at the 1 s default period).  View live or
+    post-hoc with ``python -m starway_tpu.metrics <path>``.
+
+``STARWAY_METRICS_ADDR``
+    ``host:port`` for the sampler's live feed listener: each connecting
+    viewer (``python -m starway_tpu.metrics host:port``) receives the
+    JSONL sample stream as it is produced.  Also arms the sampler.
+
+``STARWAY_METRICS_RING``
+    In-memory telemetry sample ring capacity (default 512; min 16).
 """
 
 from __future__ import annotations
@@ -154,6 +179,10 @@ __all__ = [
     "trace_enabled",
     "trace_ring_size",
     "flight_dir",
+    "metrics_interval",
+    "metrics_path",
+    "metrics_addr",
+    "metrics_ring_size",
 ]
 
 
@@ -297,6 +326,37 @@ def flight_dir() -> str:
     """Flight-recorder output directory (STARWAY_FLIGHT_DIR); empty =
     recorder disabled."""
     return _env("STARWAY_FLIGHT_DIR", "")
+
+
+def metrics_interval() -> float:
+    """swscope sampler period in seconds (STARWAY_METRICS_INTERVAL);
+    0 (the default) disables the sampler thread.  A metrics path/addr
+    with no explicit interval samples at 1 s."""
+    try:
+        v = float(_env("STARWAY_METRICS_INTERVAL", "0"))
+    except ValueError:
+        return 0.0
+    return v if v > 0 else 0.0
+
+
+def metrics_path() -> str:
+    """JSONL telemetry emitter path (STARWAY_METRICS_PATH); empty = off."""
+    return _env("STARWAY_METRICS_PATH", "")
+
+
+def metrics_addr() -> str:
+    """host:port for the live telemetry feed (STARWAY_METRICS_ADDR);
+    empty = no listener."""
+    return _env("STARWAY_METRICS_ADDR", "")
+
+
+def metrics_ring_size() -> int:
+    """In-memory telemetry sample ring capacity (STARWAY_METRICS_RING)."""
+    try:
+        v = int(_env("STARWAY_METRICS_RING", "512"))
+    except ValueError:
+        return 512
+    return max(16, v)
 
 
 def use_native() -> bool:

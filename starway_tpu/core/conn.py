@@ -88,7 +88,7 @@ class TxData:
     # would have fired.
     __slots__ = ("header", "payload", "nbytes", "off", "done", "fail",
                  "owner", "rndv", "local_done", "switch_after", "counted",
-                 "sess_seq", "sess_nbytes",
+                 "sess_seq", "sess_nbytes", "e2e_ord",
                  "_chunk_start", "_chunk_view", "__weakref__")
 
     def __init__(self, tag: int, payload, done, fail, owner):
@@ -112,6 +112,7 @@ class TxData:
         self.counted = False  # sends_completed recorded (replay must not re-count)
         self.sess_seq = 0     # session sequence number (0 = unframed)
         self.sess_nbytes = 0  # journal accounting (prefix + header + payload)
+        self.e2e_ord = 0      # swscope wire ordinal (assigned at first full TX)
 
     @property
     def total(self) -> int:
@@ -234,7 +235,7 @@ class TxDevpull:
     itself is already registered for pull)."""
 
     __slots__ = ("data", "off", "done", "fail", "owner", "switch_after",
-                 "counted", "sess_seq", "sess_nbytes")
+                 "counted", "sess_seq", "sess_nbytes", "e2e_ord")
 
     def __init__(self, data: bytes, done, fail, owner):
         self.data = data
@@ -246,6 +247,7 @@ class TxDevpull:
         self.counted = False
         self.sess_seq = 0
         self.sess_nbytes = 0
+        self.e2e_ord = 0
 
     @property
     def remaining(self) -> int:
@@ -346,6 +348,18 @@ class BaseConn:
         # path pays one attribute load per sample (DESIGN.md §13).
         self._ctr = getattr(worker, "counters", None) or swtrace.Counters()
         self._scope = getattr(worker, "stage_scope", None)
+        # swscope (DESIGN.md §15): the worker's trace ring (None = dark),
+        # the negotiated trace-conn id ("tr" handshake key; "" until both
+        # sides confirm), and the per-direction wire ordinals that pair
+        # send-side and recv-side EV_E2E events across processes.
+        self._ring = getattr(worker, "_trace", None)
+        self.tr_id = ""
+        self.tx_e2e_ord = 0
+        self.rx_e2e_ord = 0
+        # Best clock-offset estimate for the peer (EV_CLOCK samples from
+        # timestamped PING/PONG round trips): peer ~= local + offset.
+        self.clock_off_us = 0
+        self.clock_err_us = 0  # 0 = no sample yet
         self.mode = mode  # "socket" | "address"
         self.alive = True
         self.peer_name = ""
@@ -594,9 +608,56 @@ class TcpConn(BaseConn):
     def send_ping(self, fires: list) -> None:
         """Liveness probe (only sent on ka-negotiated conns).  Rides the
         active transport -- ring for sm conns (the doorbell accompanies it
-        via kick_tx), socket otherwise."""
+        via kick_tx), socket otherwise.  Always timestamped: the PONG then
+        doubles as a swscope clock sample (old peers echo zeros)."""
         if self.alive:
-            self.send_ctl(frames.pack_ping(), fires)
+            self.send_ctl(frames.pack_ping(time.perf_counter_ns()), fires)
+
+    # ------------------------------------------------------------ swscope
+    def _tx_e2e(self, item) -> None:
+        """One EV_E2E per data frame, at its FIRST full handoff to the
+        transport -- completion order IS wire order, so the ordinal here
+        equals the receiver's accept ordinal for the same message
+        (DESIGN.md §15).  The ``counted`` guard on the call sites makes
+        this once-only across session replays."""
+        if self._ring is None or not self.tr_id:
+            return
+        self.tx_e2e_ord += 1
+        item.e2e_ord = self.tx_e2e_ord
+        nbytes = getattr(item, "nbytes", None)
+        if nbytes is None:
+            nbytes = len(item.data)
+        self._ring.rec(swtrace.EV_E2E, self.tx_e2e_ord, self.conn_id,
+                       nbytes, self.tr_id + ":tx")
+
+    def _rx_e2e(self, nbytes: int) -> None:
+        """Receiver half of the pair: one EV_E2E per accepted (non-dup)
+        data frame, in stream order.  Dup session frames drain via
+        ``_sess_drop``/``_rx_skip`` and never reach this counter."""
+        if self._ring is None or not self.tr_id:
+            return
+        self.rx_e2e_ord += 1
+        self._ring.rec(swtrace.EV_E2E, self.rx_e2e_ord, self.conn_id,
+                       nbytes, self.tr_id + ":rx")
+
+    def _on_pong(self, echo_ns: int, peer_ns: int) -> None:
+        """A timestamped PONG closed the loop: one NTP-style clock sample
+        for this peer -- ``offset = t_peer - (t_tx + rtt/2)``, error
+        ``rtt/2``.  Zero fields mean an old peer's plain probe answer."""
+        if not echo_ns or not peer_ns:
+            return
+        now = time.perf_counter_ns()
+        rtt = now - echo_ns
+        if rtt < 0:
+            return  # a replayed/garbled echo cannot yield a sane sample
+        err_us = max(1, rtt // 2000)
+        off_us = (peer_ns - (echo_ns + rtt // 2)) // 1000
+        if self.clock_err_us == 0 or err_us < self.clock_err_us:
+            self.clock_off_us = off_us
+            self.clock_err_us = err_us
+        if self._ring is not None and self.tr_id:
+            self._ring.rec(swtrace.EV_CLOCK, 0, self.conn_id, 0,
+                           f"{self.tr_id}:{off_us}:{err_us}")
 
     def send_devpull(self, data: bytes, done, fail, owner, fires: list,
                      kick: bool = True) -> None:
@@ -790,6 +851,15 @@ class TcpConn(BaseConn):
             item.reset_for_replay()
             self.tx.append(item)
             replayed += 1
+            if not isinstance(item, TxCtl) and item.counted \
+                    and item.e2e_ord and self._ring is not None \
+                    and self.tr_id:
+                # swscope: this frame's ordinal was already recorded at
+                # its first full transmission; the replay rewrites the
+                # bytes (the receiver's seq dedup drops them if they
+                # landed) -- mark it superseded, never recount it.
+                self._ring.rec(swtrace.EV_E2E, item.e2e_ord, self.conn_id,
+                               0, self.tr_id + ":sup")
         self._ctr.frames_replayed += replayed
         self._sess_drain_waiting()  # trim may have freed journal room
         tr = getattr(self.worker, "_trace", None)
@@ -869,6 +939,7 @@ class TcpConn(BaseConn):
                     if not isinstance(item, TxCtl) and not item.counted:
                         item.counted = True
                         self._ctr.sends_completed += 1
+                        self._tx_e2e(item)
                     continue
                 # Socket: one gathered sendmsg per pass across queued items
                 # -- a burst of small frames costs one syscall, and a large
@@ -903,6 +974,7 @@ class TcpConn(BaseConn):
                         if not isinstance(item, TxCtl) and not item.counted:
                             item.counted = True
                             ctr.sends_completed += 1
+                            self._tx_e2e(item)
                         if getattr(item, "switch_after", False):
                             # The sm switch point (HELLO_ACK) left the
                             # socket: every later item rides the ring, even
@@ -1071,6 +1143,7 @@ class TcpConn(BaseConn):
                     with lock:
                         fires.extend(matcher.on_message_complete(m))
                     self._rx_msg = None
+                    self._rx_e2e(m.length)
                     self._sess_commit()
                 continue
             if self._ctl is not None:
@@ -1096,6 +1169,7 @@ class TcpConn(BaseConn):
                     self.worker._on_hello(self, info, fires)
                 elif ftype == frames.T_DEVPULL:
                     self.worker._on_devpull(self, a, info, fires)
+                    self._rx_e2e(len(body))
                     self._sess_commit()
                 else:
                     self.worker._on_hello_ack(self, info, fires)
@@ -1130,6 +1204,7 @@ class TcpConn(BaseConn):
                     else:
                         self._rx_msg = msg
                 if b == 0:
+                    self._rx_e2e(0)
                     self._sess_commit()
             elif ftype == frames.T_FLUSH:
                 if self._sess_drop:
@@ -1169,10 +1244,12 @@ class TcpConn(BaseConn):
             elif ftype == frames.T_PING:
                 # Liveness probe: answer immediately.  _rx_read already
                 # refreshed last_rx, so receiving PINGs also proves the
-                # peer alive to us.
-                self.send_ctl(frames.pack_pong(), fires)
+                # peer alive to us.  A timestamped PING gets its echo +
+                # our own clock reading (the swscope sample channel).
+                self.send_ctl(frames.pack_pong(a, time.perf_counter_ns()),
+                              fires)
             elif ftype == frames.T_PONG:
-                pass  # proof of life recorded by _rx_read
+                self._on_pong(a, b)  # proof of life recorded by _rx_read
             elif ftype in (frames.T_HELLO, frames.T_HELLO_ACK, frames.T_DEVPULL):
                 if ftype == frames.T_DEVPULL and self._sess_drop:
                     self._sess_drop = False

@@ -42,7 +42,7 @@ from ..errors import (
     REASON_TIMEOUT,
     StarwayStateError,
 )
-from . import fabric, frames, state, swtrace
+from . import fabric, frames, state, swtrace, telemetry
 from .conn import InprocConn, TcpConn
 from .session import SessionState
 from .endpoint import ServerEndpoint
@@ -104,6 +104,7 @@ class Worker:
         self.matcher.trace = self._trace
         self.stage_scope = perf.StageScope(ring=self._trace)
         swtrace.register_worker(self)
+        telemetry.register_worker(self)
         self.ops: deque = deque()
         # Ops queued or currently executing on the engine thread.  When zero,
         # in-process sends/flushes may run inline on the caller thread (no
@@ -158,6 +159,24 @@ class Worker:
         counters (staging pool, reconnects) overlaid -- the same shape the
         native engine surfaces through ``sw_counters``."""
         return swtrace.merge_global_counters(self.counters.snapshot())
+
+    def gauges_snapshot(self) -> dict:
+        """Instantaneous per-conn gauges (telemetry.GAUGE_NAMES) plus the
+        worker-level ``posted_recvs`` and the process-global staging-pool
+        occupancy -- the shape the native engine surfaces through the
+        ``sw_gauges`` ABI call (DESIGN.md §15).  Only the conn list and
+        the posted count are read under the worker lock; the per-conn
+        values are then read lock-free (telemetry.conn_gauges tolerates
+        torn reads -- a skewed sample, never a crash).  Every gauge
+        drains to 0 on an idle, flushed worker."""
+        with self.lock:
+            conns = list(self.conns.values())
+            posted = len(self.matcher.posted)
+        snap = {
+            "conns": {c.conn_id: telemetry.conn_gauges(c) for c in conns},
+            "posted_recvs": posted,
+        }
+        return telemetry.merge_global_gauges(snap)
 
     def post_recv(self, buf, tag: int, mask: int, done, fail, owner=None,
                   timeout: Optional[float] = None) -> None:
@@ -384,6 +403,7 @@ class Worker:
         detail = perf.conn_estimate_detail(conn, self._perf_transport(conn),
                                            msg_size, scope=self.stage_scope)
         detail["counters"] = self.counters_snapshot()
+        detail["telemetry"] = telemetry.detail_for(self)
         return detail
 
     # --------------------------------------------------------- engine side
@@ -1044,6 +1064,14 @@ class ClientWorker(Worker):
         connect_timeout = self._connect_timeout or config.connect_timeout()
         try:
             extra = {"ka": "ok"}  # liveness capability, always offered
+            # swscope end-to-end stitching (DESIGN.md §15): with tracing
+            # armed, offer a fresh trace-conn id; a tracing acceptor
+            # confirms with "tr": "ok" and both rings tag EV_E2E events
+            # with it.
+            tr_offer = ""
+            if self._trace is not None:
+                tr_offer = uuid.uuid4().hex[:16]
+                extra["tr"] = tr_offer
             if sess_on:
                 # Stable session id + epoch 0 (the acceptor assigns the
                 # real epoch); sess_ack is our cumulative rx seq (0 new).
@@ -1077,6 +1105,8 @@ class ClientWorker(Worker):
         conn.peer_name = ack.get("worker_id", "")
         conn.devpull_ok = ack.get("devpull") == "ok"
         conn.ka_ok = ack.get("ka") == "ok"
+        if tr_offer and ack.get("tr") == "ok":
+            conn.tr_id = tr_offer
         if sess_on and ack.get("sess") == "ok":
             conn.sess = SessionState(self.worker_id,
                                      str(ack.get("sess_epoch", "")))
@@ -1095,6 +1125,14 @@ class ClientWorker(Worker):
         fabric.register_worker(self)
         if self._trace is not None:
             self._trace.rec(swtrace.EV_CONN_UP, 0, conn.conn_id)
+        if conn.tr_id:
+            # One-shot clock exchange at handshake (engine thread, before
+            # the loop): a timestamped PING whose PONG yields the first
+            # EV_CLOCK sample, so trace --merge can align this process's
+            # ring with the peer's even when keepalive never fires.
+            ping_fires: list = []
+            conn.send_ping(ping_fires)
+            _run_fires(ping_fires)
         if cb is not None:
             _run_fires([lambda: cb("")])
         return True
@@ -1317,6 +1355,11 @@ class ServerWorker(Worker):
             # must PONG (activation stays per-process via STARWAY_KEEPALIVE).
             conn.ka_ok = True
             ack_extra["ka"] = "ok"
+        if self._trace is not None and info.get("tr"):
+            # swscope stitching: adopt the connector's trace-conn id so
+            # both rings tag this conn's EV_E2E events identically.
+            conn.tr_id = str(info["tr"])
+            ack_extra["tr"] = "ok"
         from .. import device as _device
 
         if info.get("devpull") == "ok" and _device.devpull_supported():

@@ -23,8 +23,8 @@ DATA      sender tag                   payload length (bytes that follow)
 FLUSH     flush sequence number        0
 FLUSH_ACK flush sequence number        0
 DEVPULL   sender tag                   length of JSON descriptor that follows
-PING      0                            0
-PONG      0                            0
+PING      sender tx time (ns; 0=none)  0
+PONG      echoed PING tx time          responder tx time (ns)
 SEQ       next session frame's seq     0
 ACK       cumulative received seq      0
 BYE       0                            0
@@ -41,6 +41,32 @@ engines ignore unknown HELLO keys, so an old peer simply never confirms
 conns the probes ride the rings while the socket stays the doorbell +
 liveness channel (core/shmring.py), so process death is still detected
 instantly by EOF/RST and the PING path only covers silent wedges.
+
+The probe pair doubles as the swscope clock-offset channel (DESIGN.md
+§15): a PING may carry the sender's CLOCK_MONOTONIC timestamp in ``a``
+(nanoseconds; 0 = plain liveness probe) and the PONG echoes it in ``a``
+with the responder's own timestamp in ``b``.  The pinger then has an
+NTP-style sample -- ``offset = t_responder - (t_tx + rtt/2)`` with error
+``rtt/2`` -- recorded as an EV_CLOCK trace event so ``python -m
+starway_tpu.trace --merge`` can align rings from different processes
+onto one timeline.  Old peers answer a timestamped PING with a zero
+PONG (no sample, never an error), so all pairings interoperate.  When
+tracing is armed the connector additionally sends one timestamped PING
+right after the handshake, so clock samples exist even with keepalive
+off.
+
+``tr`` is the swscope end-to-end trace negotiation: a connector with
+tracing armed (STARWAY_TRACE / STARWAY_FLIGHT_DIR) offers ``"tr":
+"<16-hex trace-conn id>"`` in HELLO; an acceptor that is also tracing
+confirms with ``"tr": "ok"`` and both sides adopt the id.  Each engine
+then emits an EV_E2E trace event per DATA/DEVPULL frame -- tagged with
+the trace-conn id, the direction, and a per-conn per-direction wire
+ordinal (delivery is in-order per conn, so equal ordinals at the two
+ends are the same message; no per-frame wire bytes needed).  Session
+replays never double-count: the sender records an ordinal once at the
+frame's first full transmission (a replayed already-counted frame emits
+a ``:sup`` superseded marker instead), and the receiver's seq dedup
+drops duplicate frames before they reach the ordinal counter.
 
 DEVPULL is a *negotiated extension* (``"devpull": "ok"`` offered in HELLO
 and confirmed in HELLO_ACK, like ``sm``): instead of streaming a device
@@ -169,12 +195,15 @@ def pack_flush_ack(seq: int) -> bytes:
     return pack_header(T_FLUSH_ACK, seq, 0)
 
 
-def pack_ping() -> bytes:
-    return pack_header(T_PING, 0, 0)
+def pack_ping(t_ns: int = 0) -> bytes:
+    """Liveness probe; ``t_ns`` (CLOCK_MONOTONIC nanoseconds) arms the
+    swscope clock-sample reply, 0 keeps the plain PR-1 probe."""
+    return pack_header(T_PING, t_ns, 0)
 
 
-def pack_pong() -> bytes:
-    return pack_header(T_PONG, 0, 0)
+def pack_pong(echo_ns: int = 0, t_ns: int = 0) -> bytes:
+    """Probe answer: echo the PING's timestamp and stamp our own."""
+    return pack_header(T_PONG, echo_ns, t_ns)
 
 
 def pack_seq(seq: int) -> bytes:

@@ -42,7 +42,7 @@ from typing import Optional
 
 from .. import config, perf
 from ..errors import StarwayStateError
-from . import state, swtrace
+from . import state, swtrace, telemetry
 from .engine import logger
 
 _lib = None
@@ -112,6 +112,9 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
         ]
         lib.sw_trace.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
+        ]
+        lib.sw_gauges.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
         ]
         lib.sw_free.argtypes = [ctypes.c_void_p]
@@ -430,6 +433,7 @@ class NativeWorkerBase:
         self.stage_scope = perf.StageScope()
         self._event_key: Optional[int] = None
         swtrace.register_worker(self)
+        telemetry.register_worker(self)
 
     # ------------------------------------------------------ session events
     def _install_events(self) -> None:
@@ -494,6 +498,34 @@ class NativeWorkerBase:
                 except ValueError:
                     pass
         return swtrace.merge_global_counters(snap)
+
+    def gauges_snapshot(self) -> dict:
+        """The engine's live per-conn gauges (``sw_gauges``; rendered on
+        the engine thread) with the process-global staging-pool occupancy
+        overlaid -- same shape as the Python engine's
+        ``Worker.gauges_snapshot`` (DESIGN.md §15)."""
+        snap: dict = {"conns": {}, "posted_recvs": 0}
+        if self._h is not None:
+            cap = 65536
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.sw_gauges(self._h, buf, cap)
+            if n < -1:
+                # Snapshot outgrew the buffer (-n = needed bytes); retry
+                # sized with headroom for conns added meanwhile.
+                cap = -n + 4096
+                buf = ctypes.create_string_buffer(cap)
+                n = self._lib.sw_gauges(self._h, buf, cap)
+            if n > 0:
+                try:
+                    raw = json.loads(buf.value.decode())
+                    snap["posted_recvs"] = int(raw.get("posted_recvs", 0))
+                    snap["conns"] = {
+                        int(cid): {k: int(v) for k, v in g.items()}
+                        for cid, g in raw.get("conns", {}).items()
+                    }
+                except (ValueError, TypeError):
+                    pass
+        return telemetry.merge_global_gauges(snap)
 
     def _flight_fail(self, fail):
         """Wrap an op's fail callback with the flight-recorder trigger
@@ -890,6 +922,7 @@ class NativeWorkerBase:
         detail = perf.conn_estimate_detail(conn, self._perf_transport(conn),
                                            msg_size, scope=self.stage_scope)
         detail["counters"] = self.counters_snapshot()
+        detail["telemetry"] = telemetry.detail_for(self)
         return detail
 
     def __del__(self):

@@ -73,6 +73,18 @@ EV_STAGE = "stage_span"       # data-plane stage span (perf.record_stage):
 EV_SESS_RESUME = "sess_resume"  # session conn resumed after a reconnect
 #                               (conn = conn id; nbytes = frames replayed)
 EV_SESS_EXPIRE = "sess_expire"  # session expired (grace elapsed / new epoch)
+EV_E2E = "e2e"                # swscope end-to-end marker (DESIGN.md §15):
+#                               tag = per-conn per-direction wire ordinal,
+#                               reason = "<trace-conn id>:tx|rx|sup" --
+#                               equal (id, ordinal) at the two ends of a
+#                               conn is ONE message; trace --merge draws
+#                               the send->recv flow from the pair.  ":sup"
+#                               marks a session replay of an already-
+#                               counted frame (superseded, not recounted).
+EV_CLOCK = "clock_sample"     # swscope clock-offset sample from a
+#                               timestamped PING/PONG round trip: reason =
+#                               "<trace-conn id>:<offset_us>:<err_us>"
+#                               (peer_clock ~= local_clock + offset).
 
 # ----------------------------------------------------- counter vocabulary
 #
@@ -268,6 +280,24 @@ def reset() -> None:
         _retired.clear()
 
 
+def write_ring_dump(path) -> Path:
+    """Dump every traced worker's ring to one JSON file -- the per-process
+    input ``python -m starway_tpu.trace --merge`` stitches (each process
+    of a distributed run writes one before exiting)."""
+    payload = {
+        "pid": os.getpid(),
+        "time": time.time(),
+        "workers": [
+            {"worker": d["worker"], "events": [list(e) for e in d["events"]]}
+            for d in dump_all()
+        ],
+    }
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload))
+    return path
+
+
 # -------------------------------------------------------- flight recorder
 
 _flight_seq = itertools.count(1)
@@ -296,6 +326,19 @@ def flight_dump(trigger: str, worker, reason: str = "") -> Optional[Path]:
             counters = worker.counters_snapshot()
         except Exception:
             counters = {}
+        # Telemetry trend + the per-conn gauge snapshot at trigger time:
+        # a post-mortem then shows the queue/journal trajectory INTO the
+        # failure, not just the instant (DESIGN.md §15).
+        try:
+            gauges = worker.gauges_snapshot()
+        except Exception:
+            gauges = {}
+        try:
+            from . import telemetry
+
+            samples = telemetry.recent_samples()
+        except Exception:
+            samples = []
         payload = {
             "trigger": trigger,
             "worker": label,
@@ -303,6 +346,8 @@ def flight_dump(trigger: str, worker, reason: str = "") -> Optional[Path]:
             "pid": os.getpid(),
             "time": time.time(),
             "counters": counters,
+            "gauges": gauges,
+            "telemetry": samples,
             "events": [list(e) for e in events],
         }
         out_dir = Path(flight_dir)

@@ -183,8 +183,8 @@ def test_garbled_doc_table(tmp_path):
 def test_version_drift(tmp_path):
     root = _seed(tmp_path)
     _edit(root, "native/sw_engine.cpp",
-          'return "starway-native-5"', 'return "starway-native-6"')
-    _assert_caught(root, "contract-version", "starway-native-6", "sw_engine.h")
+          'return "starway-native-6"', 'return "starway-native-7"')
+    _assert_caught(root, "contract-version", "starway-native-7", "sw_engine.h")
 
 
 def test_unmarked_multi_gib_test(tmp_path):
@@ -454,6 +454,84 @@ def test_session_doc_table_row_garbled(tmp_path):
     assert any("SEQX" in f.message for f in hits), hits
     assert any("missing from the docstring table" in f.message
                for f in hits), hits
+
+
+# ---------------------- ISSUE 6: the swscope contract surface (DESIGN §15)
+#
+# swscope grew a handshake key ("tr"), two trace events (EV_E2E /
+# EV_CLOCK), a per-conn gauge vocabulary (GAUGE_NAMES <-> kGaugeNames[]),
+# and an ABI call (sw_gauges) -- each is contract surface the checker
+# must hold across both engines.
+
+
+def test_tr_handshake_key_dropped(tmp_path):
+    # Deleting the "tr" negotiation from either engine's code fires, even
+    # when the key survives in comments/docstrings.
+    root = _seed(tmp_path)
+    p = root / "starway_tpu" / "core" / "engine.py"
+    p.write_text(p.read_text().replace('"tr"', '"tz"')
+                 + '\n# the "tr" key lives only in this comment now\n')
+    _assert_caught(root, "contract-handshake", '"tr"', "engine.py")
+    root2 = _seed(tmp_path / "two")
+    p = root2 / "native" / "sw_engine.cpp"
+    p.write_text(p.read_text().replace('"tr"', '"tz"')
+                 + '\n// the "tr" key lives only in this comment now\n')
+    _assert_caught(root2, "contract-handshake", '"tr"', "sw_engine.cpp")
+
+
+def test_gauge_dropped_from_cpp(tmp_path):
+    # Renaming a gauge in the C++ array alone fires on BOTH sides of the
+    # set diff (a gauge added to one engine only).
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          '"journal_bytes",', '"journal_bytes_v2",')
+    _assert_caught(root, "contract-trace", "journal_bytes_v2", "sw_engine.cpp")
+    _assert_caught(root, "contract-trace", "'journal_bytes'", "telemetry.py")
+
+
+def test_gauge_added_to_python_only(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/telemetry.py",
+          '"journal_frames",', '"journal_frames",\n    "rx_backlog",')
+    _assert_caught(root, "contract-trace", "rx_backlog", "telemetry.py")
+
+
+def test_gauge_vocabulary_vacuity_guard(tmp_path):
+    # An extractor that silently loses the vocabulary must be a finding,
+    # never a vacuous pass.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/telemetry.py",
+          "GAUGE_NAMES = (", "GAUGE_LABELS = (")
+    _assert_caught(root, "contract-trace", "GAUGE_NAMES tuple not found",
+                   "telemetry.py")
+
+
+def test_e2e_event_value_drift(tmp_path):
+    # The swscope events ride the existing EV_* <-> kEv* mechanical diff.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/swtrace.py",
+          'EV_E2E = "e2e"', 'EV_E2E = "e2e_v2"')
+    _assert_caught(root, "contract-trace", "EV_E2E", "swtrace.py")
+    root2 = _seed(tmp_path / "two")
+    _edit(root2, "native/sw_engine.cpp",
+          'const char* kEvClock = "clock_sample";',
+          'const char* kEvClock = "clock_tick";')
+    _assert_caught(root2, "contract-trace", "EV_CLOCK", "swtrace.py")
+
+
+def test_sw_gauges_abi_dropped(tmp_path):
+    # The sw_gauges ABI row: dropping the ctypes argtypes while the
+    # header still declares the function is a stale-binding finding.
+    root = _seed(tmp_path)
+    p = root / "starway_tpu" / "core" / "native.py"
+    text = p.read_text()
+    new = text.replace(
+        "        lib.sw_gauges.argtypes = [\n"
+        "            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int\n"
+        "        ]\n", "", 1)
+    assert new != text, "fixture drift: sw_gauges argtypes shape changed"
+    p.write_text(new)
+    _assert_caught(root, "contract-abi", "sw_gauges", "sw_engine.h")
 
 
 # ------------------------------------------------------------- CLI surface

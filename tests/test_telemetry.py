@@ -1,0 +1,318 @@
+"""swscope live telemetry plane (DESIGN.md §15): per-conn gauges, the
+sampler, the JSONL emitter + metrics CLI, and the metrics-off overhead
+guard -- on BOTH engines (gauges render in core/engine.py and through the
+``sw_gauges`` ABI call in native/sw_engine.cpp).
+
+Sampler tests drive ``telemetry.sample_now()`` directly instead of racing
+the daemon thread (the interval is set far beyond the test's lifetime),
+so every assertion sees a deterministic sample sequence.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from starway_tpu import Client, Server
+from starway_tpu.core import swtrace, telemetry
+from starway_tpu.testing.faults import FaultProxy
+
+pytestmark = pytest.mark.asyncio
+
+ADDR = "127.0.0.1"
+MASK = (1 << 64) - 1
+
+ENGINES = ["python", "native"]
+
+
+def _native_available() -> bool:
+    from starway_tpu.core import native
+
+    return native.available()
+
+
+def _env(monkeypatch, *, native: bool, armed: bool = True):
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if native else "0")
+    monkeypatch.setenv("STARWAY_DEVPULL", "0")
+    monkeypatch.delenv("STARWAY_TRACE", raising=False)
+    monkeypatch.delenv("STARWAY_FLIGHT_DIR", raising=False)
+    monkeypatch.delenv("STARWAY_METRICS_PATH", raising=False)
+    monkeypatch.delenv("STARWAY_METRICS_ADDR", raising=False)
+    if armed:
+        # Armed, but the thread's first tick is beyond the test's
+        # lifetime: tests sample explicitly via sample_now().
+        monkeypatch.setenv("STARWAY_METRICS_INTERVAL", "3600")
+    else:
+        monkeypatch.delenv("STARWAY_METRICS_INTERVAL", raising=False)
+    swtrace.reset()
+    telemetry.reset()
+
+
+async def _pair(port):
+    server = Server()
+    client = Client()
+    server.listen(ADDR, port)
+    await client.aconnect(ADDR, port)
+    for _ in range(200):
+        if server.list_clients():
+            break
+        await asyncio.sleep(0.005)
+    return server, client
+
+
+def _skip_unless(engine):
+    if engine == "native" and not _native_available():
+        pytest.skip("native engine unavailable")
+
+
+# ------------------------------------------------------- sampler / parity
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+async def test_sampler_counter_delta_parity(port, monkeypatch, engine):
+    """Samples are monotonically timestamped, and their counter deltas
+    match what the final registry snapshot (``sw_counters`` on native)
+    says happened between them -- the acceptance parity bar."""
+    _skip_unless(engine)
+    _env(monkeypatch, native=(engine == "native"))
+    server, client = await _pair(port)
+    try:
+        n1, n2, size = 4, 6, 2048
+
+        async def burst(n, tag0):
+            sinks = [np.empty(size, dtype=np.uint8) for _ in range(n)]
+            futs = [server.arecv(b, tag0 + i, MASK)
+                    for i, b in enumerate(sinks)]
+            await asyncio.sleep(0.05)
+            await asyncio.gather(*(client.asend(
+                np.full(size, i + 1, dtype=np.uint8), tag0 + i)
+                for i in range(n)))
+            await asyncio.gather(*futs)
+            await client.aflush()
+
+        await burst(n1, 0x100)
+        s1 = telemetry.sample_now()
+        await burst(n2, 0x200)
+        s2 = telemetry.sample_now()
+
+        assert s2["mono"] > s1["mono"] and s2["t"] >= s1["t"]
+        # Both workers registered and sampled.
+        labels = set(s2["workers"])
+        assert client._client.trace_label in labels, labels
+        assert server._server.trace_label in labels, labels
+        c1 = s1["workers"][client._client.trace_label]["counters"]
+        c2 = s2["workers"][client._client.trace_label]["counters"]
+        assert set(c2) == set(swtrace.COUNTER_NAMES)
+        assert c2["sends_completed"] - c1["sends_completed"] == n2
+        assert c2["bytes_tx"] - c1["bytes_tx"] >= n2 * size
+        # The last sample IS the final registry snapshot (quiescent run).
+        assert c2 == client._client.counters_snapshot()
+        srv_final = s2["workers"][server._server.trace_label]["counters"]
+        assert srv_final == server._server.counters_snapshot()
+        assert srv_final["recvs_completed"] == n1 + n2
+        # ...and the worker surfaces the plane via evaluate_perf_detail.
+        detail = client.evaluate_perf_detail(1024)["telemetry"]
+        assert detail["armed"] is True
+        assert detail["samples"][-1]["mono"] == s2["mono"]
+        assert set(detail["gauges"]) == {"conns", "posted_recvs",
+                                         "staging_pool_bytes"}
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+# ----------------------------------------------------------------- gauges
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+async def test_gauges_vocabulary_and_drain(port, monkeypatch, engine):
+    """Both engines render the identical GAUGE_NAMES vocabulary per conn;
+    a posted-but-unmatched recv is visible in ``posted_recvs``; and after
+    aflush + recv completion every gauge drains to zero (the idle-conn
+    invariant the vocabulary documents)."""
+    _skip_unless(engine)
+    _env(monkeypatch, native=(engine == "native"))
+    server, client = await _pair(port)
+    try:
+        worker = client._client
+        snap = worker.gauges_snapshot()
+        assert snap["conns"], "no conn in the gauge snapshot"
+        for g in snap["conns"].values():
+            assert set(g) == set(telemetry.GAUGE_NAMES)
+
+        # Deterministic nonzero: a posted recv with no matching send.
+        sink = np.empty(1024, dtype=np.uint8)
+        fut = server.arecv(sink, 0x31, MASK)
+        for _ in range(200):
+            if server._server.gauges_snapshot()["posted_recvs"] == 1:
+                break
+            await asyncio.sleep(0.005)
+        assert server._server.gauges_snapshot()["posted_recvs"] == 1
+
+        await client.asend(np.ones(1024, dtype=np.uint8), 0x31)
+        await fut
+        await client.aflush()
+        # Everything drained: flushed sender, completed receiver.
+        for owner in (client._client, server._server):
+            snap = owner.gauges_snapshot()
+            assert snap["posted_recvs"] == 0, snap
+            for g in snap["conns"].values():
+                assert all(v == 0 for v in g.values()), snap
+    finally:
+        await client.aclose()
+        await server.aclose()
+    # ...and a closed worker's snapshot is empty/zero, never an error.
+    snap = client._client.gauges_snapshot()
+    assert snap["posted_recvs"] == 0
+    assert all(all(v == 0 for v in g.values())
+               for g in snap["conns"].values()), snap
+
+
+async def test_sw_gauges_small_cap_reports_needed_size(port, monkeypatch):
+    """ABI contract: a too-small sw_gauges buffer returns -(needed
+    bytes) -- not the wedged-engine -1 -- so the wrapper retries sized
+    exactly and a high-fan-out snapshot never degrades to empty."""
+    if not _native_available():
+        pytest.skip("native engine unavailable")
+    import ctypes
+
+    _env(monkeypatch, native=True)
+    server, client = await _pair(port)
+    try:
+        w = client._client
+        buf = ctypes.create_string_buffer(8)
+        n = w._lib.sw_gauges(w._h, buf, 8)
+        assert n < -1, n  # needed size, negated (at least the empty shape)
+        buf = ctypes.create_string_buffer(-n)
+        m = w._lib.sw_gauges(w._h, buf, -n)
+        assert m == -n - 1, (n, m)  # exact fit: length excl. the NUL
+        snap = w.gauges_snapshot()  # and the wrapper path still renders
+        assert snap["conns"], snap
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+# --------------------------------------------------------- overhead guard
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+async def test_metrics_off_adds_no_per_op_work(port, monkeypatch, engine):
+    """Tracing off + metrics off: no worker registers with the sampler,
+    no sampler thread exists, and the per-op path touches neither the
+    trace ring nor the gauge renderer -- in either engine (the pinned
+    acceptance bar; mirrors the PR-4 armed-state caching)."""
+    _skip_unless(engine)
+    _env(monkeypatch, native=(engine == "native"), armed=False)
+    assert not telemetry.armed()
+    server, client = await _pair(port)
+    try:
+        assert telemetry._workers == []          # nobody registered
+        assert telemetry._samples is None        # no sample ring exists
+        assert telemetry._thread is None         # no sampler thread
+
+        def boom(*a, **k):
+            raise AssertionError("telemetry/trace hook ran with metrics off")
+
+        monkeypatch.setattr(telemetry, "conn_gauges", boom)
+        monkeypatch.setattr(telemetry, "sample_now", boom)
+        monkeypatch.setattr(swtrace.TraceRing, "rec", boom)
+        monkeypatch.setattr(swtrace, "wrap_op", boom)
+        sinks = [np.empty(512, dtype=np.uint8) for _ in range(8)]
+        futs = [server.arecv(b, 0x60 + i, MASK) for i, b in enumerate(sinks)]
+        await asyncio.sleep(0.05)
+        await asyncio.gather(*(client.asend(
+            np.full(512, i, dtype=np.uint8), 0x60 + i) for i in range(8)))
+        await asyncio.gather(*futs)
+        await client.aflush()
+        cs = client._client.counters_snapshot()
+        assert cs["sends_completed"] == 8
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+
+# ------------------------------------------------- JSONL emitter and CLI
+
+
+async def test_jsonl_emitter_and_metrics_cli(port, monkeypatch, tmp_path,
+                                             capsys):
+    """STARWAY_METRICS_PATH appends one JSON object per sample; the
+    ``python -m starway_tpu.metrics --once`` viewer renders them and
+    prints the run summary."""
+    from starway_tpu import metrics as metrics_mod
+
+    _env(monkeypatch, native=False)
+    out = tmp_path / "samples.jsonl"
+    monkeypatch.setenv("STARWAY_METRICS_PATH", str(out))
+    server, client = await _pair(port)
+    try:
+        sink = np.empty(4096, dtype=np.uint8)
+        fut = server.arecv(sink, 7, MASK)
+        await asyncio.sleep(0.05)
+        telemetry.sample_now()
+        await client.asend(np.ones(4096, dtype=np.uint8), 7)
+        await fut
+        await client.aflush()
+        telemetry.sample_now()
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+    lines = [json.loads(l) for l in out.read_text().splitlines() if l.strip()]
+    assert len(lines) == 2
+    monos = [s["mono"] for s in lines]
+    assert monos == sorted(monos)
+    assert all("workers" in s and "t" in s for s in lines)
+
+    rc = metrics_mod.main([str(out), "--once"])
+    assert rc == 0
+    printed = capsys.readouterr().out
+    assert "2 sample(s)" in printed
+    assert "client-" in printed and "server-" in printed
+    # An unreadable source is a clean error, not a traceback.
+    assert metrics_mod.main([str(tmp_path / "absent.jsonl"), "--once"]) == 1
+
+
+# ------------------------------------------- flight recorder trend embed
+
+
+async def test_flight_dump_embeds_telemetry_trend(port, monkeypatch,
+                                                  tmp_path):
+    """A FaultProxy-killed conn triggers a flight dump that carries the
+    per-conn gauge snapshot at trigger time AND the recent telemetry
+    samples -- the post-mortem shows the trend INTO the failure, not just
+    the instant (ISSUE 6 satellite)."""
+    flight = tmp_path / "flight"
+    _env(monkeypatch, native=False)
+    monkeypatch.setenv("STARWAY_FLIGHT_DIR", str(flight))
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port, mode="drop", limit_bytes=8 * 1024).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        # Two pre-failure samples: the dump must carry this trend.
+        telemetry.sample_now()
+        await client.asend(np.ones(64 * 1024, dtype=np.uint8), 5)
+        telemetry.sample_now()
+        with pytest.raises(Exception) as err:
+            await client.aflush(timeout=5.0)
+        assert "cancel" not in str(err.value).lower()
+        dumps = sorted(flight.glob("flight-*.json"))
+        assert dumps, "no flight-recorder dump written"
+        payload = json.loads(dumps[0].read_text())
+        assert payload["trigger"] == "op-failed"
+        gauges = payload["gauges"]
+        assert set(gauges) >= {"conns", "posted_recvs"}, gauges
+        samples = payload["telemetry"]
+        assert len(samples) == 2, "pre-failure trend missing from the dump"
+        assert samples[0]["mono"] < samples[1]["mono"]
+        assert any(lbl.startswith("client-") for lbl in
+                   samples[-1]["workers"]), samples[-1]
+    finally:
+        await client.aclose()
+        await server.aclose()
+        proxy.stop()

@@ -1,5 +1,9 @@
 """swtrace tests (DESIGN.md §13): per-op lifecycle tracing, the counter
-registry, the flight recorder, and the tracing-off overhead guard.
+registry, the flight recorder, and the tracing-off overhead guard --
+plus the swscope stitching layer (DESIGN.md §15): two-process ring dumps
+merged by ``python -m starway_tpu.trace --merge`` into one clock-aligned
+trace with flow-connected send->recv spans, and the session-resume
+(conn, epoch) track keying of the Chrome exporter.
 
 Covers BOTH engines where they implement the surface (the trace ring and
 counter registry live in core/engine.py and native/sw_engine.cpp; the
@@ -9,6 +13,9 @@ way), plus mixed-engine counter parity over real sockets.
 
 import asyncio
 import json
+import os
+import subprocess
+import sys
 
 import numpy as np
 import pytest
@@ -340,6 +347,255 @@ async def test_chrome_export_spans_per_conn(port, monkeypatch, tmp_path):
     rc = trace_mod.main([str(dump_file), "-o", str(tmp_path / "cli.json")])
     assert rc == 0
     assert json.loads((tmp_path / "cli.json").read_text())["traceEvents"]
+
+
+# ------------------------------------------------- swscope: trace --merge
+#
+# A real two-process run: the server lives in a subprocess, both sides
+# write per-process ring dumps (swtrace.write_ring_dump), and the CLI's
+# --merge mode must stitch them into ONE Chrome trace whose EV_E2E
+# ordinal pairs become cross-process flow events and whose EV_CLOCK
+# samples align the two timelines (DESIGN.md §15).
+
+_MERGE_SERVER = """
+import asyncio, os, sys
+os.environ["STARWAY_TLS"] = "tcp"
+os.environ["STARWAY_TRACE"] = "1"
+os.environ["STARWAY_DEVPULL"] = "0"
+os.environ["STARWAY_NATIVE"] = sys.argv[1]
+port, n, dump = int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+import numpy as np
+from starway_tpu import Server
+from starway_tpu.core import swtrace
+
+async def main():
+    server = Server()
+    server.listen("127.0.0.1", port)
+    print("READY", flush=True)
+    bufs = [np.empty(4096, dtype=np.uint8) for _ in range(n)]
+    futs = [server.arecv(bufs[i], i + 1, (1 << 64) - 1) for i in range(n)]
+    await asyncio.wait_for(asyncio.gather(*futs), timeout=60)
+    # Two replies: the conn is BIDIRECTIONAL, so both ends own a tx
+    # ordinal sequence -- the merge must pair each with the OTHER end.
+    ep = server.list_clients().pop()
+    for i in range(2):
+        await server.asend(ep, np.full(4096, 0xAB, dtype=np.uint8), 101 + i)
+    await asyncio.wait_for(server.aflush_ep(ep), timeout=60)
+    swtrace.write_ring_dump(dump)
+    await server.aclose()
+
+asyncio.run(main())
+"""
+
+
+@pytest.mark.parametrize("pairing", ["py-py", "py-native", "native-py"])
+async def test_merge_stitches_two_process_trace(port, monkeypatch, tmp_path,
+                                                pairing):
+    """Two processes (and the mixed py<->native pairings) produce ring
+    dumps that ``trace --merge`` stitches into one Chrome trace: every
+    transferred message becomes a flow event whose send end and recv end
+    sit in DIFFERENT trace processes, a clock edge aligns the tracks, and
+    the wire-latency breakdown covers every pair -- the ISSUE 6
+    acceptance structure."""
+    from starway_tpu import trace as trace_mod
+    from starway_tpu.core import swtrace as swtrace_mod
+
+    s_eng, c_eng = pairing.split("-")
+    if "native" in (s_eng, c_eng) and not _native_available():
+        pytest.skip("native engine unavailable")
+    n = 6
+    srv_dump = tmp_path / "server.json"
+    cli_dump = tmp_path / "client.json"
+    _env(monkeypatch, native=(c_eng == "native"))
+    env = dict(os.environ)
+    env.pop("STARWAY_FLIGHT_DIR", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _MERGE_SERVER,
+         "1" if s_eng == "native" else "0", str(port), str(n),
+         str(srv_dump)],
+        stdout=subprocess.PIPE, text=True, env=env, cwd="/root/repo")
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        client = Client()
+        await client.aconnect(ADDR, port)
+        try:
+            rbufs = [np.empty(4096, dtype=np.uint8) for _ in range(2)]
+            rfuts = [client.arecv(rbufs[i], 101 + i, MASK) for i in range(2)]
+            await asyncio.gather(*(client.asend(
+                np.full(4096, i + 1, dtype=np.uint8), i + 1)
+                for i in range(n)))
+            await client.aflush()
+            await asyncio.wait_for(asyncio.gather(*rfuts), timeout=60)
+            # The one-shot handshake PING's PONG carries the clock sample;
+            # it raced the data frames, so wait for it before dumping.
+            for _ in range(400):
+                if any(e[1] == swtrace_mod.EV_CLOCK
+                       for e in client._client.trace_events()):
+                    break
+                await asyncio.sleep(0.005)
+            events = client._client.trace_events()
+            assert any(e[1] == swtrace_mod.EV_CLOCK for e in events), (
+                "no clock sample on the connector")
+            swtrace_mod.write_ring_dump(cli_dump)
+        finally:
+            await client.aclose()
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    out = tmp_path / "merged.json"
+    rc = trace_mod.main(["--merge", str(srv_dump), str(cli_dump),
+                         "-o", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    summary = doc["swscope"]
+    assert summary["processes"] == 2
+    assert summary["pairs"] >= n + 2, summary
+    assert summary["bytes_paired"] >= (n + 2) * 4096, summary
+    assert summary["clock_edges"], "no clock edge between the processes"
+    assert summary["wire_us"]["p50"] >= 0.0
+
+    evs = doc["traceEvents"]
+    # Clock-aligned tracks: both processes' workers present as trace
+    # processes.
+    pnames = [e for e in evs if e["ph"] == "M"
+              and e["name"] == "process_name"]
+    assert len({e["pid"] for e in pnames}) >= 2, pnames
+    # Flow events: starts and ends pair by id, across DIFFERENT pids,
+    # with the (clock-aligned) send end never after the recv end.
+    starts = {e["id"]: e for e in evs
+              if e.get("ph") == "s" and e.get("cat") == "swscope"}
+    ends = {e["id"]: e for e in evs
+            if e.get("ph") == "f" and e.get("cat") == "swscope"}
+    assert len(starts) == len(ends) == summary["pairs"]
+    for fid, s in starts.items():
+        f = ends[fid]
+        assert s["pid"] != f["pid"], (s, f)
+        assert s["ts"] <= f["ts"] + 5000, (s, f)  # 5 ms slack for jitter
+    # Both directions paired: flow arrows originate from BOTH processes
+    # (a (tcid, ordinal)-only join would collide the two ends' ordinal
+    # sequences and lose or mispair the reverse traffic).
+    assert len({e["pid"] for e in starts.values()}) == 2, starts
+
+
+async def test_merge_clock_alignment_sign_convention():
+    """The delta propagation is exact, not just small-skew-tolerant: a
+    synthetic 2 s clock skew between two processes must align to the
+    TRUE 50 us wire latency (a sign error would show +/-2 s)."""
+    from starway_tpu import trace as trace_mod
+
+    tc = "deadbeef00000000"
+    # Process B's clock runs 2.0 s ahead of A's; B pinged A, so B's ring
+    # holds the sample offset = t_A - t_B = -2_000_000 us.  B sent at
+    # true time 10.0 (stamped 12.0 on its clock); A received 50 us later.
+    dump_b = {"pid": 222, "workers": [{"worker": "B", "events": [
+        [12.0, "e2e", 1, 7, 4096, tc + ":tx", 0.0],
+        [11.5, "clock_sample", 0, 7, 0, f"{tc}:-2000000:10", 0.0],
+    ]}]}
+    dump_a = {"pid": 111, "workers": [{"worker": "A", "events": [
+        [10.000050, "e2e", 1, 3, 4096, tc + ":rx", 0.0],
+    ]}]}
+    doc = trace_mod.merge_chrome([("a", dump_a), ("b", dump_b)])
+    assert doc["swscope"]["pairs"] == 1
+    assert doc["swscope"]["clock_edges"][0]["offset_us"] == -2000000
+    assert abs(doc["swscope"]["wire_us"]["p50"] - 50.0) < 1.0, (
+        doc["swscope"]["wire_us"])
+    s = [e for e in doc["traceEvents"] if e.get("ph") == "s"]
+    f = [e for e in doc["traceEvents"] if e.get("ph") == "f"]
+    assert len(s) == len(f) == 1
+    assert abs((f[0]["ts"] - s[0]["ts"]) - 50.0) < 1.0, (s, f)
+
+
+async def test_merge_ring_dump_cli_single_mode(tmp_path, port, monkeypatch):
+    """Without --merge the CLI accepts write_ring_dump files too (the
+    per-process shape), flattening every worker into one trace."""
+    from starway_tpu import trace as trace_mod
+
+    _env(monkeypatch, native=False)
+    server, client, _ep = await _pair(port)
+    try:
+        sink = np.empty(1024, dtype=np.uint8)
+        fut = server.arecv(sink, 4, MASK)
+        await asyncio.sleep(0.05)
+        await client.asend(np.ones(1024, dtype=np.uint8), 4)
+        await fut
+        await client.aflush()
+    finally:
+        await client.aclose()
+        await server.aclose()
+    dump = swtrace.write_ring_dump(tmp_path / "ring.json")
+    rc = trace_mod.main([str(dump), "-o", str(tmp_path / "chrome.json")])
+    assert rc == 0
+    doc = json.loads((tmp_path / "chrome.json").read_text())
+    assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
+
+# ------------------------------------- swscope: (conn, epoch) track keying
+
+
+async def test_chrome_export_epoch_tracks_on_resume(port, monkeypatch):
+    """Satellite fix: a session resume starts a NEW exporter track --
+    pre- and post-resume events never interleave on one tid.  Driven by
+    the tests/test_session.py machinery (FaultProxy RST mid-burst with
+    STARWAY_SESSION=1)."""
+    from starway_tpu import trace as trace_mod
+
+    _env(monkeypatch, native=False)
+    monkeypatch.setenv("STARWAY_SESSION", "1")
+    monkeypatch.setenv("STARWAY_SESSION_GRACE", "20")
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+    try:
+        n, size = 12, 4096
+        bufs = [np.zeros(size, dtype=np.uint8) for _ in range(n)]
+        recvs = [server.arecv(bufs[i], i + 1, MASK) for i in range(n)]
+        sends = []
+        for i in range(n):
+            sends.append(client.asend(
+                np.full(size, (i + 1) % 251, dtype=np.uint8), i + 1))
+            if i == n // 2:
+                await asyncio.sleep(0.3)   # let part of the burst fly
+                proxy.kill_all(rst=True)   # suspend + redial + replay
+        await asyncio.wait_for(asyncio.gather(*sends), timeout=60)
+        await asyncio.wait_for(client.aflush(), timeout=60)
+        await asyncio.wait_for(asyncio.gather(*recvs), timeout=60)
+
+        events = client._client.trace_events()
+        resume_idx = _first_index(events, swtrace.EV_SESS_RESUME)
+        assert resume_idx is not None, "no resume recorded"
+        chrome = trace_mod.chrome_events("client", events, pid=1)
+        labels = {e["tid"]: e["args"]["name"] for e in chrome
+                  if e["ph"] == "M" and e["name"] == "thread_name"}
+        epoch_tids = {t for t, name in labels.items() if "epoch" in name}
+        assert epoch_tids, f"no epoch track created on resume: {labels}"
+        base_tids = {t for t, name in labels.items()
+                     if name.startswith("conn ") and "epoch" not in name}
+        assert base_tids, labels
+        # Send spans landed on BOTH incarnations' tracks...
+        sends_by_tid = {}
+        for e in chrome:
+            if e["ph"] == "X" and e["name"].startswith("send tag="):
+                sends_by_tid.setdefault(e["tid"], []).append(e)
+        assert sends_by_tid.keys() & base_tids, sends_by_tid.keys()
+        assert sends_by_tid.keys() & epoch_tids, (
+            f"post-resume sends still on the old track: {sends_by_tid.keys()}")
+        # ...and nothing COMPLETING after the resume sits on the old
+        # track: the exporter keys the track by the epoch current at the
+        # event's terminal record, so the old track's spans all ended
+        # before the resume instant (the interleaving this fix removes).
+        resume_ts = events[resume_idx][0] * 1e6
+        for tid in sends_by_tid.keys() & base_tids:
+            for e in sends_by_tid[tid]:
+                assert e["ts"] + e["dur"] <= resume_ts + 1000, (
+                    f"span ending after resume on pre-resume track: {e}")
+    finally:
+        await client.aclose()
+        await server.aclose()
+        proxy.stop()
 
 
 async def test_device_payload_stage_spans_in_trace(port, monkeypatch):
