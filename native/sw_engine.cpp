@@ -1403,6 +1403,7 @@ struct Worker {
   // all per-incarnation parser state, keep every queue, journal, and
   // flush bookkeeping.  The conn stays `alive` so flush barriers keep
   // waiting and new sends keep queueing -- they complete after resume.
+  // swcheck: state(estab, lost, suspended)
   void sess_suspend(Conn* c, FireList& fires) {
     Session* s = c->sess.get();
     SW_DEBUG("conn %llu lost; session suspended", (unsigned long long)c->id);
@@ -1460,6 +1461,7 @@ struct Worker {
   // handshake), and replay everything past it.  `ack_body` is the
   // acceptor's HELLO_ACK JSON -- it must precede replayed frames on the
   // wire ("" on the client side, which already consumed the peer's ACK).
+  // swcheck: state(suspended, resume, estab)
   void sess_resume(Conn* c, int fd, uint64_t peer_ack,
                    const std::string& ack_body, FireList& fires) {
     Session* s = c->sess.get();
@@ -1511,6 +1513,7 @@ struct Worker {
   // Terminal session failure: grace elapsed, or the peer answered a
   // resume dial with a new epoch.  Everything that was riding out the
   // outage fails with the stable "session expired" reason.
+  // swcheck: state(suspended, expire, expired)
   void sess_expire(Conn* c, FireList& fires) {
     Session* s = c->sess.get();
     if (!s || s->expired) return;
@@ -1717,6 +1720,8 @@ struct Worker {
     uint64_t a, b;
     if (!read_exact(hdr, HEADER_SIZE)) { close(fd); return false; }
     unpack_header(hdr, &type, &a, &b);
+    // swcheck: state(hello-sent, HELLO_ACK, estab)
+    // swcheck: state(hello-sent, OTHER, down)
     if (type != T_HELLO_ACK || b > 4096) { close(fd); return false; }
     std::vector<uint8_t> body(b);
     if (b && !read_exact(body.data(), b)) { close(fd); return false; }
@@ -2215,8 +2220,10 @@ struct Worker {
         c->ctl_need = 0;
         c->ctl_type = 0;
         c->ctl_a = 0;
+        // swcheck: state(estab, HELLO, estab)
         if (t == T_HELLO) on_hello(c, body, fires);
         else if (t == T_DEVPULL) {
+          // swcheck: state(estab, DEVPULL, estab)
           on_devpull(c, ctl_a, body, fires);
           rx_e2e(c, body.size());
           sess_commit(c);
@@ -2233,6 +2240,7 @@ struct Worker {
       uint64_t a, b;
       unpack_header(c->hdr, &type, &a, &b);
       switch (type) {
+        // swcheck: state(estab, DATA, estab)
         case T_DATA: {
           if (c->sess_drop) {
             c->sess_drop = false;
@@ -2257,6 +2265,7 @@ struct Worker {
           }
           break;
         }
+        // swcheck: state(estab, FLUSH, estab)
         case T_FLUSH:
           if (c->sess_drop) {
             c->sess_drop = false;
@@ -2273,6 +2282,7 @@ struct Worker {
                           /*switch_after=*/false, /*sess_frame=*/true);
           }
           break;
+        // swcheck: state(estab, FLUSH_ACK, estab)
         case T_FLUSH_ACK:
           if (c->sess_drop) {
             c->sess_drop = false;
@@ -2281,12 +2291,15 @@ struct Worker {
           sess_commit(c);
           on_flush_ack(c, a, fires);
           break;
+        // swcheck: state(estab, SEQ, estab|down)
         case T_SEQ:
           if (!sess_on_seq(c, a, fires)) return;
           break;
+        // swcheck: state(estab, ACK, estab)
         case T_ACK:
           if (c->sess) sess_on_ack(c, a, fires);
           break;
+        // swcheck: state(estab, BYE, estab|expired)
         case T_BYE:
           // Peer's clean local close on a session conn: the session is
           // over -- the imminent EOF must take the seed/keepalive death
@@ -2297,6 +2310,7 @@ struct Worker {
             sessions.erase(c->sess->id);
           }
           break;
+        // swcheck: state(estab, PING, estab)
         case T_PING:
           // Liveness probe: answer immediately (stream_read already
           // refreshed last_rx, so inbound PINGs also prove the peer
@@ -2304,6 +2318,7 @@ struct Worker {
           // reading -- the swscope sample channel (frames.py).
           conn_send_ctl(c, T_PONG, a, now_ns(), "", fires);
           break;
+        // swcheck: state(estab, PONG, estab)
         case T_PONG:
           // Timestamped PONG: one NTP-style clock sample for this peer
           // (offset = t_peer - (t_tx + rtt/2), error rtt/2).  Zero
@@ -2330,6 +2345,7 @@ struct Worker {
           }
           break;  // proof of life recorded by stream_read
         case T_HELLO:
+        // swcheck: state(estab, HELLO_ACK, estab)
         case T_HELLO_ACK:
         case T_DEVPULL:
           if (type == T_DEVPULL && c->sess_drop) {
@@ -2341,6 +2357,7 @@ struct Worker {
           c->ctl_need = (size_t)b;
           c->ctl_a = a;
           break;
+        // swcheck: state(estab, OTHER, down)
         default:
           conn_broken(c, fires);
           return;
@@ -3235,6 +3252,8 @@ struct ClientWorker : Worker {
     uint8_t type;
     uint64_t a, b;
     unpack_header(hdr, &type, &a, &b);
+    // swcheck: state(hello-sent, HELLO_ACK, estab)
+    // swcheck: state(hello-sent, OTHER, down)
     if (type != T_HELLO_ACK || b > 4096) return fail_connect("bad handshake frame");
     std::vector<uint8_t> body(b);
     if (b && !read_exact(body.data(), b)) return fail_connect("handshake body read failed");

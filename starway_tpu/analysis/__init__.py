@@ -1,17 +1,29 @@
-"""swcheck: static cross-engine contract checker and concurrency lint.
+"""swcheck + swproof: static cross-engine contract and behavior checking.
 
-``python -m starway_tpu.analysis`` runs five passes and exits non-zero on
-any finding (the CI merge gate; also step 1 of scripts/release_smoke.sh):
+``python -m starway_tpu.analysis`` runs seven passes and exits non-zero
+on any finding (the CI merge gate; also step 1 of
+scripts/release_smoke.sh):
 
 * **contract** -- diffs the wire/shm/ABI/reason/handshake contract between
   ``core/engine.py``-side sources and ``native/sw_engine.{h,cpp}``
   ("two engines, one contract", CLAUDE.md).
-* **concurrency** -- callbacks never fire under a worker lock; no blocking
-  calls on the engine thread (DESIGN.md §2).
+* **concurrency** -- callbacks never fire under a worker lock (direct or
+  *reachable* through the call graph); no blocking calls on the engine
+  thread or reachable under a lock; lock-order cycle detection spanning
+  the Python locks and the native mutex sites; the TX-item duck-type
+  attribute contract; lint-surface coverage audit (DESIGN.md §2, §16).
 * **layering** -- no jax imports under core/.
 * **markers** -- multi-GiB test payloads must carry @pytest.mark.slow.
 * **hotpath** -- no full-payload ``bytes(...)``/``.tobytes()`` copies on
   core/ data paths (the zero-copy discipline, DESIGN.md §12).
+* **protomodel** -- extracts the protocol state machine from BOTH engines
+  (ast over the Python dispatch; ``swcheck: state(...)`` annotations in
+  the native engine) and diffs them transition-by-transition
+  (DESIGN.md §16).
+* **explore** -- bounded exhaustive model checking of the §14 session
+  layer: every fault schedule (kill/dup/reorder/restart) over a bounded
+  workload, against the exactly-once / journal-trim / flush-order /
+  epoch / quiescence invariants.
 
 Waivers: a finding is suppressed by an explicit justified comment on (or
 directly above) the flagged line::
@@ -19,21 +31,24 @@ directly above) the flagged line::
     # swcheck: allow(blocking-call): bench harness runs off-engine
 
 A waiver without the ``: why`` justification, or naming an unknown rule,
-is itself a finding (``bad-waiver``).  See DESIGN.md §11.
+is itself a finding (``bad-waiver``).  See DESIGN.md §11 and §16.
 """
 
 from __future__ import annotations
 
+import time
 from pathlib import Path
 from typing import Iterable, Optional
 
-from . import concurrency, contract, hotpath, layering, markers
+from . import concurrency, contract, explore, hotpath, layering, markers, protomodel
 from .base import (  # noqa: F401  (re-exported for tests and tooling)
     RULES,
     Finding,
     apply_waivers,
+    clear_caches,
     core_py_files,
     find_root,
+    lint_py_files,
     scan_bad_waivers,
     test_files,
     waiver_audit_files,
@@ -45,18 +60,26 @@ PASSES = {
     "layering": layering.run,
     "markers": markers.run,
     "hotpath": hotpath.run,
+    "protomodel": protomodel.run,
+    "explore": explore.run,
 }
 
 
 def run_all(root: Optional[str] = None,
-            passes: Optional[Iterable[str]] = None) -> list:
+            passes: Optional[Iterable[str]] = None,
+            timings: Optional[dict] = None) -> list:
     """Run the selected passes (default: all) against ``root`` and return
-    the post-waiver findings, sorted by location."""
+    the post-waiver findings, sorted by location.  ``timings``, when a
+    dict, receives per-pass wall seconds (the --timings CLI surface)."""
     rootp = find_root(root) if not isinstance(root, Path) else root
+    clear_caches()  # parse-once per gate run; files may change between runs
     selected = list(passes) if passes else list(PASSES)
     findings: list = []
     for name in selected:
+        t0 = time.perf_counter()
         findings.extend(PASSES[name](rootp))
+        if timings is not None:
+            timings[name] = time.perf_counter() - t0
     findings = apply_waivers(rootp, findings)
     findings.extend(scan_bad_waivers(rootp, waiver_audit_files(rootp)))
     seen = set()

@@ -1,12 +1,16 @@
 """CLI entry: ``python -m starway_tpu.analysis [--root DIR] [pass ...]``.
 
 Exit status 0 = contract holds; 1 = findings (printed one per line as
-``file:line: [rule] message``); 2 = usage error.  Stdlib-only.
+``file:line: [rule] message`` -- the shape .github/swcheck-matcher.json
+turns into PR diff annotations); 2 = usage error.  ``--json`` emits one
+machine-readable document instead (findings + per-pass timings);
+``--timings`` prints per-pass wall time to stderr.  Stdlib-only.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from . import PASSES, RULES, find_root, run_all
@@ -15,8 +19,9 @@ from . import PASSES, RULES, find_root, run_all
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m starway_tpu.analysis",
-        description="swcheck: cross-engine contract checker + concurrency "
-                    "lint (see DESIGN.md §11)",
+        description="swcheck/swproof: cross-engine contract checker, "
+                    "concurrency lint, protocol state-machine diff, and "
+                    "session model checking (DESIGN.md §11, §16)",
     )
     parser.add_argument("passes", nargs="*", metavar="pass",
                         help=f"subset of passes to run ({', '.join(PASSES)}); "
@@ -26,6 +31,11 @@ def main(argv=None) -> int:
                              "package location)")
     parser.add_argument("--rules", action="store_true",
                         help="list every rule name (waiver targets) and exit")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings + timings as one JSON document "
+                             "on stdout (exit status semantics unchanged)")
+    parser.add_argument("--timings", action="store_true",
+                        help="print per-pass wall time to stderr")
     args = parser.parse_args(argv)
 
     if args.rules:
@@ -39,10 +49,30 @@ def main(argv=None) -> int:
                      f"{', '.join(PASSES)}")
 
     root = find_root(args.root)
-    findings = run_all(root, args.passes or None)
-    for f in findings:
-        print(f.render())
+    timings: dict = {}
+    findings = run_all(root, args.passes or None, timings=timings)
     ran = ", ".join(args.passes or PASSES)
+    if args.as_json:
+        print(json.dumps({
+            "root": str(root),
+            "passes": list(args.passes or PASSES),
+            "findings": [
+                {"file": f.file, "line": f.line, "rule": f.rule,
+                 "message": f.message}
+                for f in findings
+            ],
+            "timings_s": {k: round(v, 4) for k, v in timings.items()},
+            "ok": not findings,
+        }, indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+    if args.timings:
+        total = sum(timings.values())
+        for name, secs in timings.items():
+            print(f"swcheck: pass {name:12s} {secs * 1000:8.1f} ms",
+                  file=sys.stderr)
+        print(f"swcheck: total {total * 1000:.1f} ms", file=sys.stderr)
     if findings:
         print(f"swcheck: {len(findings)} finding(s) [{ran}] in {root}",
               file=sys.stderr)

@@ -14,7 +14,8 @@ Flagged (rule ``hotpath-copy``):
   ``bytes(17)``, ``bytes()``) are allocation, not copying, and are skipped.
 * any ``x.tobytes()`` call.
 
-Scanned: every ``core/*.py`` except ``frames.py`` -- the control-frame
+Scanned: the full lint surface (every ``core/*.py`` plus
+``base.LINT_EXTRA_FILES``) except ``frames.py`` -- the control-frame
 codec builds/parses small bounded JSON bodies, and its one documented
 ``tobytes`` (the memoryview escape hatch in ``unpack_json_body``) is not a
 payload path.  Genuinely-needed copies elsewhere take an explicit waiver:
@@ -26,7 +27,7 @@ from __future__ import annotations
 import ast
 from pathlib import Path
 
-from .base import Finding, core_py_files, parse_or_finding, rel
+from .base import Finding, lint_py_files, parse_or_finding, rel
 
 
 def _is_literal_arg(node: ast.AST) -> bool:
@@ -60,7 +61,7 @@ class _CopyLint(ast.NodeVisitor):
 
 def run(root: Path) -> list:
     out: list = []
-    for path in core_py_files(root):
+    for path in lint_py_files(root):
         if path.name == "frames.py":
             continue  # control-frame codec: small bounded bodies (docstring)
         relpath = rel(root, path)

@@ -95,6 +95,7 @@ def _iter_path(path: Path, follow: bool) -> Iterator[dict]:
                 except ValueError:
                     continue
             elif follow:
+                # swcheck: allow(blocking-call): viewer CLI tails on its own app thread, no engine in-process
                 time.sleep(0.2)
             else:
                 return
@@ -102,6 +103,7 @@ def _iter_path(path: Path, follow: bool) -> Iterator[dict]:
 
 def _iter_addr(addr: str) -> Iterator[dict]:
     host, _, port = addr.rpartition(":")
+    # swcheck: allow(blocking-call): viewer CLI dials the feed on its own app thread and may wait for it
     with socket.create_connection((host or "127.0.0.1", int(port))) as s:
         buf = b""
         while True:
