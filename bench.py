@@ -185,6 +185,9 @@ def main() -> None:
                 "value": round(fw_gbps, 3),
                 "unit": "GB/s",
                 "vs_baseline": round(vs_baseline, 3),
+                # Structured fallback flag so trajectory tooling can filter
+                # CPU-FALLBACK rows without parsing the metric string.
+                "fallback": cpu_fallback,
             }
         )
     )
@@ -256,6 +259,7 @@ def main_watchdog() -> None:
         "value": 0.0,
         "unit": "GB/s",
         "vs_baseline": 0.0,
+        "fallback": True,
     }))
 
 

@@ -33,6 +33,7 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cerrno>
 #include <chrono>
@@ -85,7 +86,12 @@ constexpr uint8_t T_PONG = 8;
 constexpr uint8_t T_SEQ = 9;      // session layer: next frame's sequence number
 constexpr uint8_t T_ACK = 10;     // session layer: cumulative received seq
 constexpr uint8_t T_BYE = 11;     // session layer: peer's clean local close
+constexpr uint8_t T_SDATA = 12;   // multi-rail striped chunk (DESIGN.md §17)
+constexpr uint8_t T_SACK = 13;    // striped-message assembly complete
 constexpr size_t HEADER_SIZE = 17;
+// Striped-DATA sub-header: u64 msg_id, u64 offset, u64 total (LE) --
+// the core/frames.py SDATA_SUB twin, machine-checked by swcheck.
+constexpr size_t SDATA_SUB_SIZE = 24;
 
 constexpr int ST_VOID = 0, ST_INIT = 1, ST_RUNNING = 2, ST_CLOSING = 3, ST_CLOSED = 4;
 
@@ -145,6 +151,8 @@ const char* kCounterNames[] = {
     "sessions_resumed",  "frames_replayed",
     "dup_frames_dropped",
     "acks_tx",           "acks_rx",
+    "stripe_chunks_tx",  "stripe_chunks_rx",
+    "rail_resteals",
 };
 
 // swscope per-conn gauge vocabulary, same order as the values rendered by
@@ -158,6 +166,7 @@ const char* kGaugeNames[] = {
     "tx_queue_depth",  "tx_queue_bytes",
     "inflight_sends",  "inflight_recvs",
     "journal_bytes",   "journal_frames",
+    "stripe_pending",
 };
 
 struct Counters {
@@ -172,6 +181,8 @@ struct Counters {
   std::atomic<uint64_t> sessions_resumed{0}, frames_replayed{0};
   std::atomic<uint64_t> dup_frames_dropped{0};
   std::atomic<uint64_t> acks_tx{0}, acks_rx{0};
+  std::atomic<uint64_t> stripe_chunks_tx{0}, stripe_chunks_rx{0};
+  std::atomic<uint64_t> rail_resteals{0};
 };
 
 inline void bump(std::atomic<uint64_t>& c, uint64_t n = 1) {
@@ -291,6 +302,35 @@ double session_grace_env() {
   const char* e = getenv("STARWAY_SESSION_GRACE");
   double s = e ? strtod(e, nullptr) : 0.0;
   return s > 0 ? s : 30.0;
+}
+
+// Multi-rail striping knobs (config.py STARWAY_RAILS / STRIPE_*;
+// DESIGN.md §17).  Read per handshake / per send like the session knobs.
+int stripe_rails_env() {
+  const char* e = getenv("STARWAY_RAILS");
+  int v = e ? atoi(e) : 1;
+  if (v < 1) v = 1;
+  if (v > 16) v = 16;
+  return v;
+}
+
+uint64_t stripe_threshold_env() {
+  const char* e = getenv("STARWAY_STRIPE_THRESHOLD");
+  uint64_t v = e ? strtoull(e, nullptr, 10) : 0;
+  return v;  // 0 = striping off (seed parity)
+}
+
+uint64_t stripe_chunk_env() {
+  const char* e = getenv("STARWAY_STRIPE_CHUNK");
+  uint64_t v = e ? strtoull(e, nullptr, 10) : 0;
+  if (v == 0) {
+    // Default: 4x the §12 staging granularity = 1 MiB (config.py twin).
+    const char* ch = getenv("STARWAY_CHUNK");
+    uint64_t base = ch ? strtoull(ch, nullptr, 10) : (uint64_t)(256u << 10);
+    if (base == 0) base = 256u << 10;
+    v = 4 * base;
+  }
+  return v < 4096 ? 4096 : v;
 }
 
 // ------------------------------------------------------- shared-memory rings
@@ -849,6 +889,54 @@ struct Matcher {
 
 // ----------------------------------------------------------------- conn
 
+// Multi-rail striping (DESIGN.md §17; core/lane.py is the Python twin).
+// One StripeSrc per striped outgoing message: the payload is BORROWED and
+// pinned (release callback deferred) until the receiver's T_SACK --
+// chunks may be resent after a rail death or session resume, so the
+// bytes must stay stable.
+struct StripeSrc {
+  uint64_t msg_id = 0, tag = 0, total = 0, chunk = 0;
+  const uint8_t* payload = nullptr;
+  std::deque<uint64_t> pending;  // unclaimed chunk offsets, FIFO
+  // Per-lane chunk ledgers, kept until SACK so a dead rail's share can
+  // be re-queued: offsets IN FLIGHT on the lane (claimed, not fully
+  // written) vs already WRITTEN to its transport -- the split keeps
+  // `unwritten` exact across a resteal.
+  std::unordered_map<uint64_t, std::vector<uint64_t>> rail_offs;  // in flight
+  std::unordered_map<uint64_t, std::vector<uint64_t>> done_offs;  // written
+  uint64_t unwritten = 0;
+  int writers = 0;  // feeders currently mid-frame on this source
+  bool local_done = false, counted = false, sacked = false, failed = false;
+  sw_done_cb done = nullptr;
+  sw_fail_cb fail = nullptr;
+  void* ctx = nullptr;
+  sw_done_cb release = nullptr;
+  void* release_ctx = nullptr;
+
+  uint64_t chunk_len(uint64_t off) const {
+    uint64_t left = total - off;
+    return left < chunk ? left : chunk;
+  }
+  bool started() const {
+    return local_done || !rail_offs.empty() || !done_offs.empty();
+  }
+};
+
+using StripeRef = std::shared_ptr<StripeSrc>;
+
+// Receiver-side reassembly of one striped message: the matcher's record
+// plus the offset-dedup set that makes chunks idempotent.
+struct StripeAsm {
+  uint64_t msg_id = 0, tag = 0, total = 0, received = 0;
+  InboundMsg* msg = nullptr;
+  // Probe-tag records live in no matcher queue (see the T_DATA dispatch
+  // rx_msg_unowned twin): this assembly owns the msg at teardown.
+  bool msg_unowned = false;
+  std::unordered_set<uint64_t> offs;
+};
+
+constexpr size_t kStripeDoneLru = 4096;
+
 struct TxItem {
   std::vector<uint8_t> header;
   const uint8_t* payload = nullptr;
@@ -879,6 +967,12 @@ struct TxItem {
   //                             the buffer once done fires; a replay must
   //                             resend the originally-promised bytes)
   bool hold_release = false;  // rndv payload pinned until the peer ACKs
+  // --- multi-rail striping (DESIGN.md §17) ---
+  // Nonnull = this item is a lane's FEEDER: it streams one chunk frame,
+  // then refills in place with the next chunk the group hands it
+  // (completion-driven work stealing).  The SOURCE owns the op callbacks.
+  StripeRef stripe;
+  uint64_t stripe_off = 0;    // payload offset of the current chunk
 
   uint64_t total() const { return header.size() + paylen; }
 };
@@ -981,10 +1075,32 @@ struct Conn {
   // starving byte is the only wakeup a ring-blocked producer gets, so
   // doorbells are queued, never dropped.
   std::string db_out;
+  // --- multi-rail striping (DESIGN.md §17; core/lane.py is the twin) ---
+  std::vector<uint64_t> rails;  // secondary conn ids (primary only)
+  uint64_t rail_parent = 0;     // primary conn id (secondary only)
+  bool rails_ok = false;        // "rails" negotiated on the primary
+  bool feeder_live = false;     // this lane's feeder item is queued
+  // TX scheduler (primary only): sources FIFO + id registry until SACK.
+  uint64_t next_stripe_msg = 1;
+  std::deque<StripeRef> stripe_q;
+  std::unordered_map<uint64_t, StripeRef> stripe_by_id;
+  // RX reassembly (primary only) + completed-id LRU for late resends.
+  std::unordered_map<uint64_t, StripeAsm*> stripe_asm;
+  std::deque<uint64_t> stripe_done_fifo;
+  std::unordered_set<uint64_t> stripe_done;
+  // Per-rail striped rx parser state.
+  bool sdata_active = false;
+  uint8_t sdata_sub[SDATA_SUB_SIZE];
+  size_t sdata_got = 0;
+  uint64_t sdata_tag = 0, sdata_len = 0;
+  StripeAsm* rx_stripe = nullptr;
+  uint64_t rx_stripe_off = 0, rx_stripe_len = 0, rx_stripe_got = 0;
 
   bool has_unfinished_data() const {
-    for (auto& t : tx)
+    for (auto& t : tx) {
       if (t->is_data && t->off < t->total()) return true;
+      if (t->stripe && t->off < t->total()) return true;
+    }
     return false;
   }
 
@@ -1010,7 +1126,10 @@ struct Conn {
     }
   }
 
-  ~Conn() { drop_sm(); }
+  ~Conn() {
+    drop_sm();
+    for (auto& [id, a] : stripe_asm) delete a;
+  }
 };
 
 struct FlushRec {
@@ -1018,6 +1137,10 @@ struct FlushRec {
   sw_fail_cb fail = nullptr;
   void* ctx = nullptr;
   std::unordered_map<uint64_t, uint64_t> waits;  // conn_id -> seq
+  // Striped delivery rides SACKs, not per-rail FLUSH frames: the barrier
+  // also waits until every source with msg_id <= watermark is SACKed
+  // (primary conn id -> watermark; DESIGN.md §17).
+  std::unordered_map<uint64_t, uint64_t> stripe_waits;
   bool completed = false;
 };
 
@@ -1175,6 +1298,15 @@ struct Worker {
                 "Endpoint is not connected (connection reset)");
       if (fail) fires.push_back([fail, ctx] { fail(ctx, "Endpoint is not connected (connection reset)"); });
       fire_op_release(op, fires);
+      return;
+    }
+    uint64_t sthr = stripe_threshold_env();
+    if (!c->rails.empty() && sthr > 0 && op.len >= sthr &&
+        stripe_live_lanes(c) > 1) {
+      // Striped path (DESIGN.md §17): chunks are idempotent and NOT
+      // seq-framed even on session conns -- the group re-dispatches
+      // un-SACKed sources wholesale at resume (journal per-message).
+      stripe_submit(c, op, fires);
       return;
     }
     c->dirty = true;
@@ -1426,6 +1558,14 @@ struct Worker {
     c->rx_skip = 0;
     c->sess_drop = false;
     c->sess_pending = 0;
+    // Striped rx parser state is per-incarnation; the ASSEMBLIES survive
+    // (the resumed sender re-dispatches un-SACKed sources and offset
+    // dedup keeps bytes exactly-once).
+    c->sdata_active = false;
+    c->sdata_got = 0;
+    c->rx_stripe = nullptr;
+    c->rx_stripe_got = 0;
+    c->feeder_live = false;
     if (c->rx_msg) {
       InboundMsg* m = c->rx_msg;
       bool unowned = c->rx_msg_unowned;
@@ -1449,8 +1589,16 @@ struct Worker {
     }
     // Journaled frames replay from the journal; bare per-incarnation ctl
     // (PING/PONG/ACK/handshake) queued on the old transport dies with it.
+    drop_feeder_holds(c, fires);
     c->tx.clear();
-    (void)fires;
+    for (uint64_t rid : std::vector<uint64_t>(c->rails)) {
+      // Rails are per-incarnation transports (like sm rings): the
+      // resumed client re-dials them; un-SACKed striped sources
+      // re-dispatch wholesale at resume (journal per-message).
+      Conn* r = conn_by_id(rid);
+      if (r && r->alive) conn_broken(r, fires);
+    }
+    c->rails.clear();
     add_timer(Timer::SESS_GRACE, (void*)(uintptr_t)c->id, s->grace);
     if (!is_server)
       add_timer(Timer::SESS_REDIAL, (void*)(uintptr_t)c->id, 0.01);
@@ -1477,6 +1625,7 @@ struct Worker {
     // Frames queued while suspended are all journaled (framing happens at
     // submit): rebuild tx purely from the journal, or those items would
     // ride the wire twice.
+    drop_feeder_holds(c, fires);
     c->tx.clear();
     bump(counters.sessions_resumed);
     if (!ack_body.empty()) {
@@ -1504,9 +1653,11 @@ struct Worker {
     }
     bump(counters.frames_replayed, replayed);
     sess_drain_waiting(c);  // trim may have freed journal room
+    c->feeder_live = false;  // tx was rebuilt: the old feeder is gone
     trace.rec(kEvSessResume, 0, c->id, replayed);
     fire_event("session-resume", c->id, fires);
     ep_add(fd, EPOLLIN, c);
+    stripe_redispatch(c, fires);
     kick_tx(c, fires);
   }
 
@@ -1540,7 +1691,9 @@ struct Worker {
         }
         fire_release(item, fires, /*force=*/true);
       }
+      drop_feeder_holds(c, fires);
       c->tx.clear();
+      c->feeder_live = false;
       if (c->rx_msg) {
         std::lock_guard<std::mutex> g(mu);
         matcher.purge_inflight(c->rx_msg);
@@ -1550,6 +1703,12 @@ struct Worker {
       std::lock_guard<std::mutex> g(mu);
       matcher.purge_remote_conn(c->id);
     }
+    stripe_terminal(c, kSessionExpired, fires);
+    for (uint64_t rid : std::vector<uint64_t>(c->rails)) {
+      Conn* r = conn_by_id(rid);
+      if (r && r->alive) conn_broken(r, fires);
+    }
+    c->rails.clear();
     // Session users opted into bounded failure (like the keepalive
     // contract): queued receives fail once no alive conns remain.
     {
@@ -1644,36 +1803,19 @@ struct Worker {
     uint64_t peer_ack =
         strtoull(json_field(ack_body, "sess_ack").c_str(), nullptr, 10);
     sess_resume(c, fd, peer_ack, "", fires);
+    if (c->rails_ok) {
+      // Rails are per-incarnation: re-dial them now that the session is
+      // back (striped sources already re-dispatched on the primary; new
+      // lanes start stealing as they attach).
+      dial_rails(c, stripe_rails_env() - 1, fires);
+    }
   }
 
   // One blocking resume dial + handshake, bounded by the connect timeout.
   // Returns true with *out_fd (nonblocking) and *out_ack on success.
+  // swcheck: state(hello-sent, HELLO_ACK, estab)
+  // swcheck: state(hello-sent, OTHER, down)
   bool sess_dial(Session* s, int* out_fd, std::string* out_ack) {
-    const int cto_ms = connect_timeout_ms();
-    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
-    if (fd < 0) return false;
-    sockaddr_in addr{};
-    addr.sin_family = AF_INET;
-    addr.sin_port = htons((uint16_t)c_port);
-    if (inet_pton(AF_INET, c_host.c_str(), &addr.sin_addr) != 1) {
-      close(fd);
-      return false;
-    }
-    int rc = ::connect(fd, (sockaddr*)&addr, sizeof(addr));
-    if (rc < 0 && errno != EINPROGRESS) {
-      close(fd);
-      return false;
-    }
-    pollfd pfd{fd, POLLOUT, 0};
-    int err = 0;
-    socklen_t elen = sizeof(err);
-    if (poll(&pfd, 1, cto_ms) <= 0 ||
-        getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) != 0 || err != 0) {
-      close(fd);
-      return false;
-    }
-    int one = 1;
-    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     std::string hello = std::string("{\"worker_id\": \"") + worker_id +
                         "\", \"mode\": \"" + c_mode + "\", \"name\": \"\"" +
                         ", \"ka\": \"ok\", \"sess\": \"ok\", \"sess_id\": \"" +
@@ -1682,52 +1824,7 @@ struct Worker {
                         "\"";
     if (devpull_advertise) hello += ", \"devpull\": \"ok\"";
     hello += "}";
-    std::vector<uint8_t> frame(HEADER_SIZE + hello.size());
-    pack_header(frame.data(), T_HELLO, 0, hello.size());
-    memcpy(frame.data() + HEADER_SIZE, hello.data(), hello.size());
-    size_t off = 0;
-    while (off < frame.size()) {
-      ssize_t w = ::send(fd, frame.data() + off, frame.size() - off,
-                         MSG_NOSIGNAL);
-      if (w < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK) {
-          pollfd p2{fd, POLLOUT, 0};
-          if (poll(&p2, 1, cto_ms) <= 0) { close(fd); return false; }
-          continue;
-        }
-        close(fd);
-        return false;
-      }
-      off += (size_t)w;
-    }
-    auto read_exact = [&](uint8_t* out, size_t n) -> bool {
-      size_t got = 0;
-      while (got < n) {
-        ssize_t r = ::recv(fd, out + got, n - got, 0);
-        if (r > 0) { got += (size_t)r; continue; }
-        if (r == 0) return false;
-        if (errno == EAGAIN || errno == EWOULDBLOCK) {
-          pollfd p2{fd, POLLIN, 0};
-          if (poll(&p2, 1, cto_ms) <= 0) return false;
-          continue;
-        }
-        return false;
-      }
-      return true;
-    };
-    uint8_t hdr[HEADER_SIZE];
-    uint8_t type;
-    uint64_t a, b;
-    if (!read_exact(hdr, HEADER_SIZE)) { close(fd); return false; }
-    unpack_header(hdr, &type, &a, &b);
-    // swcheck: state(hello-sent, HELLO_ACK, estab)
-    // swcheck: state(hello-sent, OTHER, down)
-    if (type != T_HELLO_ACK || b > 4096) { close(fd); return false; }
-    std::vector<uint8_t> body(b);
-    if (b && !read_exact(body.data(), b)) { close(fd); return false; }
-    out_ack->assign((char*)body.data(), body.size());
-    *out_fd = fd;
-    return true;
+    return blocking_dial(hello, out_fd, out_ack);
   }
 
   // Session half of the accept handshake.  Returns true when this dial
@@ -1782,6 +1879,540 @@ struct Worker {
     c->sess->grace = session_grace_env();
     sessions[sid] = c;
     return false;
+  }
+
+  // ------------------------------------------------------------- stripe
+  //
+  // Multi-rail striping (DESIGN.md §17; core/lane.py RailGroup is the
+  // Python twin).  All stripe state is engine-thread-owned; `mu` guards
+  // only the conns registry and matcher, as everywhere else.
+
+  Conn* conn_by_id(uint64_t id) {
+    if (!id) return nullptr;
+    std::lock_guard<std::mutex> g(mu);
+    auto it = conns.find(id);
+    return it == conns.end() ? nullptr : it->second;
+  }
+
+  Conn* stripe_root(Conn* c) {
+    if (!c->rail_parent) return c;
+    Conn* root = conn_by_id(c->rail_parent);
+    return root ? root : c;
+  }
+
+  // Drop the payload pin once settled AND no feeder is mid-frame on the
+  // source (a frame header already promised its chunk's bytes).
+  static void stripe_maybe_release(StripeSrc& s, FireList& fires) {
+    if ((s.sacked || s.failed) && s.writers <= 0 && s.release) {
+      auto rel = s.release; auto rctx = s.release_ctx;
+      s.release = nullptr;
+      fires.push_back([rel, rctx] { rel(rctx); });
+    }
+  }
+
+  void stripe_first_progress(const StripeRef& src, FireList& fires) {
+    if (src->local_done) return;
+    // Transmission begun: rndv-style local completion for the message.
+    src->local_done = true;
+    if (src->done) {
+      auto done = src->done; auto ctx = src->ctx;
+      fires.push_back([done, ctx] { done(ctx); });
+    }
+  }
+
+  // The work-stealing heart: hand the next pending chunk (FIFO across
+  // sources) to the lane that asked, loading it into `item` as one
+  // self-describing T_SDATA frame.
+  bool stripe_claim(Conn* root, Conn* lane, TxItem& item) {
+    while (!root->stripe_q.empty()) {
+      StripeRef src = root->stripe_q.front();
+      if (src->pending.empty() || src->sacked || src->failed) {
+        root->stripe_q.pop_front();
+        continue;
+      }
+      uint64_t off = src->pending.front();
+      src->pending.pop_front();
+      src->rail_offs[lane->id].push_back(off);
+      src->writers++;
+      uint64_t n = src->chunk_len(off);
+      item.header.resize(HEADER_SIZE + SDATA_SUB_SIZE);
+      pack_header(item.header.data(), T_SDATA, src->tag, SDATA_SUB_SIZE + n);
+      memcpy(item.header.data() + HEADER_SIZE, &src->msg_id, 8);
+      memcpy(item.header.data() + HEADER_SIZE + 8, &off, 8);
+      memcpy(item.header.data() + HEADER_SIZE + 16, &src->total, 8);
+      item.payload = src->payload + off;
+      item.paylen = n;
+      item.off = 0;
+      item.stripe = src;
+      item.stripe_off = off;
+      return true;
+    }
+    return false;
+  }
+
+  // One chunk fully handed to `lane`'s transport: account it, release
+  // the feeder's hold, and mark the message handed when it was the last.
+  void stripe_tx_chunk_finished(Conn* lane, TxItem& item, FireList& fires) {
+    StripeRef src = item.stripe;
+    bump(counters.stripe_chunks_tx);
+    src->writers--;
+    if (src->unwritten > 0) src->unwritten--;
+    auto it = src->rail_offs.find(lane->id);
+    if (it != src->rail_offs.end()) {
+      auto& v = it->second;
+      auto pos = std::find(v.begin(), v.end(), item.stripe_off);
+      if (pos != v.end()) {
+        v.erase(pos);
+        src->done_offs[lane->id].push_back(item.stripe_off);
+      }
+    }
+    Conn* root = stripe_root(lane);
+    if (src->unwritten == 0 && src->pending.empty() && !src->counted) {
+      src->counted = true;
+      bump(counters.sends_completed);
+      if (trace.enabled) {
+        trace.rec(kEvSendDone, src->tag, root->id, src->total);
+        if (root->tr_hex[0]) {
+          // swscope: ONE marker per striped message on the primary,
+          // ordinal = msg_id (shared wire state -- the pair survives
+          // out-of-order assembly completion).
+          char reason[24];
+          snprintf(reason, sizeof(reason), "%s:sx", root->tr_hex);
+          trace.rec(kEvE2e, src->msg_id, root->id, src->total, reason);
+        }
+      }
+    }
+    stripe_maybe_release(*src, fires);
+  }
+
+  // Refill the lane's feeder with the next chunk; false = group dry.
+  bool stripe_refill(Conn* lane, TxItem& item) {
+    item.stripe.reset();
+    return stripe_claim(stripe_root(lane), lane, item);
+  }
+
+  // A tx queue about to be cleared may hold a feeder mid-frame: release
+  // its hold on the source (writers) or the payload pin would leak past
+  // the SACK that should free it (core/lane.py _drop_src is the twin).
+  void drop_feeder_holds(Conn* c, FireList& fires) {
+    for (auto& ref : c->tx) {
+      if (ref->stripe) {
+        ref->stripe->writers--;
+        stripe_maybe_release(*ref->stripe, fires);
+        ref->stripe.reset();
+      }
+    }
+    c->feeder_live = false;
+  }
+
+  int stripe_live_lanes(Conn* root) {
+    int n = (root->alive && root->fd >= 0) ? 1 : 0;
+    for (uint64_t rid : root->rails) {
+      Conn* r = conn_by_id(rid);
+      if (r && r->alive && r->fd >= 0) n++;
+    }
+    return n;
+  }
+
+  // Make sure every live lane has an active feeder and kick it.
+  void stripe_dispatch(Conn* root, FireList& fires) {
+    std::vector<Conn*> lanes{root};
+    for (uint64_t rid : root->rails) {
+      Conn* r = conn_by_id(rid);
+      if (r) lanes.push_back(r);
+    }
+    for (Conn* lane : lanes) {
+      if (!lane->alive || lane->fd < 0) continue;
+      if (!lane->feeder_live) {
+        auto item = std::make_shared<TxItem>();
+        if (!stripe_claim(root, lane, *item)) break;  // group dry
+        item->counted = true;  // the SOURCE owns per-message accounting
+        lane->feeder_live = true;
+        lane->tx.push_back(std::move(item));
+      }
+      kick_tx(lane, fires);
+    }
+  }
+
+  void stripe_submit(Conn* c, const Op& op, FireList& fires) {
+    auto src = std::make_shared<StripeSrc>();
+    src->msg_id = c->next_stripe_msg++;
+    src->tag = op.tag;
+    src->total = op.len;
+    src->chunk = stripe_chunk_env();
+    src->payload = op.buf;
+    for (uint64_t off = 0; off < src->total; off += src->chunk)
+      src->pending.push_back(off);
+    src->unwritten = src->pending.size();
+    src->done = op.done;
+    src->fail = op.fail;
+    src->ctx = op.ctx;
+    src->release = op.release;
+    src->release_ctx = op.release_ctx;
+    c->dirty = true;
+    c->stripe_by_id[src->msg_id] = src;
+    c->stripe_q.push_back(src);
+    stripe_dispatch(c, fires);
+  }
+
+  bool stripe_has_unsacked(Conn* root, uint64_t watermark) {
+    for (auto& [mid, src] : root->stripe_by_id)
+      if (mid <= watermark && !src->sacked) return true;
+    return false;
+  }
+
+  void stripe_on_sack(Conn* root, uint64_t msg_id, FireList& fires) {
+    auto it = root->stripe_by_id.find(msg_id);
+    if (it == root->stripe_by_id.end()) return;
+    StripeRef src = it->second;
+    root->stripe_by_id.erase(it);
+    if (!src->sacked) {
+      src->sacked = true;
+      stripe_maybe_release(*src, fires);
+    }
+    auto snapshot = flushes;
+    for (auto* rec : snapshot) try_complete_flush(rec, fires);
+  }
+
+  // A secondary lane died: re-queue its claimed-but-unacked chunks and
+  // let the survivors steal them (the payload is pinned until SACK, so
+  // the resend is always legal; receiver offset dedup absorbs chunks
+  // that did land).
+  void stripe_rail_lost(Conn* root, uint64_t rail_id, FireList& fires) {
+    root->rails.erase(std::remove(root->rails.begin(), root->rails.end(),
+                                  rail_id),
+                      root->rails.end());
+    uint64_t restolen = 0;
+    for (auto& [mid, src] : root->stripe_by_id) {
+      std::vector<uint64_t> infl, done;
+      auto it = src->rail_offs.find(rail_id);
+      if (it != src->rail_offs.end()) {
+        infl = std::move(it->second);
+        src->rail_offs.erase(it);
+      }
+      auto dt = src->done_offs.find(rail_id);
+      if (dt != src->done_offs.end()) {
+        done = std::move(dt->second);
+        src->done_offs.erase(dt);
+      }
+      if ((infl.empty() && done.empty()) || src->failed || src->sacked)
+        continue;
+      // In-flight chunks were never counted written (unwritten already
+      // covers them); written-to-the-dead-lane chunks go back to
+      // unwritten for the resend.
+      for (uint64_t off : infl) src->pending.push_back(off);
+      for (uint64_t off : done) src->pending.push_back(off);
+      src->unwritten += done.size();
+      restolen += infl.size() + done.size();
+      bool queued = false;
+      for (auto& q : root->stripe_q)
+        if (q.get() == src.get()) { queued = true; break; }
+      if (!queued) root->stripe_q.push_back(src);
+    }
+    if (restolen) {
+      bump(counters.rail_resteals, restolen);
+      stripe_dispatch(root, fires);
+    }
+  }
+
+  // Session resume: re-dispatch every un-SACKed source from chunk zero
+  // across whatever lanes are live -- the journal is per-message, never
+  // per-lane; the receiver's offset dedup + completed-id LRU make the
+  // wholesale resend exactly-once.
+  void stripe_redispatch(Conn* root, FireList& fires) {
+    root->stripe_q.clear();
+    std::vector<uint64_t> ids;
+    for (auto& [mid, src] : root->stripe_by_id) ids.push_back(mid);
+    std::sort(ids.begin(), ids.end());
+    for (uint64_t mid : ids) {
+      StripeRef src = root->stripe_by_id[mid];
+      if (src->sacked || src->failed) continue;
+      src->pending.clear();
+      for (uint64_t off = 0; off < src->total; off += src->chunk)
+        src->pending.push_back(off);
+      src->rail_offs.clear();
+      src->done_offs.clear();
+      src->writers = 0;  // the suspended incarnation's feeders are gone
+      src->unwritten = src->pending.size();
+      root->stripe_q.push_back(src);
+    }
+    if (!root->stripe_q.empty()) stripe_dispatch(root, fires);
+  }
+
+  // Primary terminal teardown: settle every un-SACKed source (entries
+  // stay registered, marked failed, so a flush barrier waiting on their
+  // SACKs fails instead of completing vacuously) and purge partial
+  // assemblies from the matcher.
+  void stripe_terminal(Conn* c, const char* reason, FireList& fires,
+                       bool purge_rx = true) {
+    for (auto& [mid, src] : c->stripe_by_id) {
+      if (src->sacked || src->failed) continue;
+      src->failed = true;
+      bump(counters.ops_cancelled);
+      if (!src->local_done && src->fail) {
+        auto fail = src->fail; auto ctx = src->ctx;
+        fires.push_back([fail, ctx, reason] { fail(ctx, reason); });
+      }
+      src->local_done = true;
+      src->writers = 0;  // no feeder will ever touch it again
+      stripe_maybe_release(*src, fires);
+    }
+    c->stripe_q.clear();
+    if (!c->stripe_asm.empty()) {
+      std::lock_guard<std::mutex> g(mu);
+      for (auto& [mid, a] : c->stripe_asm) {
+        if (purge_rx) {
+          matcher.purge_inflight(a->msg);
+        } else if (a->msg_unowned) {
+          // do_close: cancel_all already freed every matcher-owned
+          // record; only unowned probe records are still ours to free.
+          delete a->msg;
+        }
+        delete a;
+      }
+      c->stripe_asm.clear();
+    }
+  }
+
+  // A completed striped sub-header on `rail`: resolve the assembly (or
+  // arrange the chunk drained) and arm the payload streaming state.
+  void stripe_rx_resolve(Conn* rail, FireList& fires) {
+    uint64_t msg_id, off, total;
+    memcpy(&msg_id, rail->sdata_sub, 8);
+    memcpy(&off, rail->sdata_sub + 8, 8);
+    memcpy(&total, rail->sdata_sub + 16, 8);
+    uint64_t clen = rail->sdata_len - SDATA_SUB_SIZE;
+    Conn* root = stripe_root(rail);
+    if (root->stripe_done.count(msg_id)) {
+      // Late resend of a completed message: drain + re-SACK.
+      rail->rx_skip = clen;
+      conn_send_ctl(rail, T_SACK, msg_id, total, "", fires);
+      return;
+    }
+    StripeAsm* a = nullptr;
+    auto it = root->stripe_asm.find(msg_id);
+    if (it != root->stripe_asm.end()) {
+      a = it->second;
+    } else {
+      a = new StripeAsm();
+      a->msg_id = msg_id;
+      a->tag = rail->sdata_tag;
+      a->total = total;
+      {
+        std::lock_guard<std::mutex> g(mu);
+        a->msg = matcher.on_start(rail->sdata_tag, total, fires);
+      }
+      a->msg_unowned = (rail->sdata_tag == Matcher::kProbeTag);
+      root->stripe_asm[msg_id] = a;
+    }
+    if (a->offs.count(off) || off + clen > a->total) {
+      rail->rx_skip = clen;  // duplicate (or malformed) chunk: drain
+      return;
+    }
+    rail->rx_stripe = a;
+    rail->rx_stripe_off = off;
+    rail->rx_stripe_len = clen;
+    rail->rx_stripe_got = 0;
+  }
+
+  void stripe_rx_chunk_done(Conn* rail, FireList& fires) {
+    StripeAsm* a = rail->rx_stripe;
+    uint64_t off = rail->rx_stripe_off, clen = rail->rx_stripe_len;
+    rail->rx_stripe = nullptr;
+    rail->rx_stripe_got = 0;
+    if (a->offs.count(off)) return;  // cross-rail duplicate finished
+    //          second: identical bytes, but accounting must be once-only
+    a->offs.insert(off);
+    a->received += clen;
+    bump(counters.stripe_chunks_rx);
+    if (a->received < a->total) return;
+    Conn* root = stripe_root(rail);
+    // A cross-rail duplicate of some offset may still be mid-stream on a
+    // sibling lane; completion frees this assembly and hands the sink
+    // back to the user, so redirect those reads to the drain path NOW
+    // (use-after-free / write-after-done otherwise; core/lane.py twin).
+    std::vector<Conn*> group{root};
+    for (uint64_t rid : root->rails) {
+      Conn* r = conn_by_id(rid);
+      if (r) group.push_back(r);
+    }
+    for (Conn* r : group) {
+      if (r != rail && r->rx_stripe == a) {
+        r->rx_skip = r->rx_stripe_len - r->rx_stripe_got;
+        r->rx_stripe = nullptr;
+        r->rx_stripe_got = 0;
+      }
+    }
+    InboundMsg* m = a->msg;
+    m->received = a->total;
+    root->stripe_asm.erase(a->msg_id);
+    root->stripe_done.insert(a->msg_id);
+    root->stripe_done_fifo.push_back(a->msg_id);
+    while (root->stripe_done_fifo.size() > kStripeDoneLru) {
+      root->stripe_done.erase(root->stripe_done_fifo.front());
+      root->stripe_done_fifo.pop_front();
+    }
+    {
+      std::lock_guard<std::mutex> g(mu);
+      matcher.on_complete(m, fires);
+    }
+    conn_send_ctl(rail, T_SACK, a->msg_id, a->total, "", fires);
+    if (trace.enabled && root->tr_hex[0]) {
+      char reason[24];
+      snprintf(reason, sizeof(reason), "%s:sr", root->tr_hex);
+      trace.rec(kEvE2e, a->msg_id, root->id, a->total, reason);
+    }
+    delete a;
+  }
+
+  // Secondary-lane attach (server side): adopt the accepted conn into
+  // the endpoint whose peer worker id is `rail_of`.
+  void on_rail_hello(Conn* c, const std::string& rail_of,
+                     const std::string& body, FireList& fires) {
+    Conn* primary = nullptr;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      for (auto& [id, cc] : conns) {
+        if (cc->alive && cc->handshaken && cc->peer_name == rail_of &&
+            cc->rail_parent == 0) {
+          primary = cc;
+          break;
+        }
+      }
+    }
+    if (!primary) {
+      // Raced the endpoint's death: answer without "rail": "ok"; the
+      // dialer drops the socket.
+      std::string ack = std::string("{\"worker_id\": \"") + worker_id + "\"}";
+      conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires);
+      return;
+    }
+    if (json_field(body, "ka") == "ok") c->ka_ok = true;
+    c->rail_parent = primary->id;
+    primary->rails.push_back(c->id);
+    {
+      std::lock_guard<std::mutex> g(mu);
+      conns[c->id] = c;
+    }
+    std::string ack = std::string("{\"worker_id\": \"") + worker_id +
+                      "\", \"rail\": \"ok\"" +
+                      (c->ka_ok ? ", \"ka\": \"ok\"" : "") + "}";
+    conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires);
+    trace.rec(kEvConnUp, 0, c->id);
+    if (!primary->stripe_q.empty()) stripe_dispatch(primary, fires);
+  }
+
+  // Client side: dial `count` secondary lanes to the accepted endpoint
+  // (blocking dials on the engine thread, like the primary handshake; a
+  // failed rail is skipped -- striping runs over fewer lanes).
+  void dial_rails(Conn* primary, int count, FireList& fires) {
+    for (int i = 0; i < count; i++) {
+      int fd = -1;
+      std::string ack;
+      std::string hello =
+          std::string("{\"worker_id\": \"") + worker_id +
+          "\", \"mode\": \"" + c_mode + "\", \"name\": \"\", \"rail_of\": \"" +
+          worker_id + "\", \"rail_idx\": \"" + std::to_string(i + 1) +
+          "\", \"ka\": \"ok\"}";
+      if (!blocking_dial(hello, &fd, &ack) || json_field(ack, "rail") != "ok") {
+        SW_DEBUG("rail %d dial failed; striping over fewer lanes", i + 1);
+        if (fd >= 0) close(fd);
+        continue;
+      }
+      auto* r = new Conn();
+      r->fd = fd;
+      r->handshaken = true;
+      r->mode = c_mode;
+      r->peer_name = primary->peer_name;
+      r->ka_ok = json_field(ack, "ka") == "ok";
+      r->rail_parent = primary->id;
+      r->remote_addr = c_host;
+      r->remote_port = c_port;
+      {
+        std::lock_guard<std::mutex> g(mu);
+        r->id = next_conn_id++;
+        conns[r->id] = r;
+      }
+      primary->rails.push_back(r->id);
+      ep_add(fd, EPOLLIN, r);
+      trace.rec(kEvConnUp, 0, r->id);
+    }
+    if (!primary->stripe_q.empty()) stripe_dispatch(primary, fires);
+  }
+
+  // One blocking HELLO/HELLO_ACK exchange against the client's target
+  // (shared by the session redial and the rail dials).
+  bool blocking_dial(const std::string& hello, int* out_fd,
+                     std::string* out_ack) {
+    const int cto_ms = connect_timeout_ms();
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return false;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)c_port);
+    if (inet_pton(AF_INET, c_host.c_str(), &addr.sin_addr) != 1) {
+      close(fd);
+      return false;
+    }
+    int rc = ::connect(fd, (sockaddr*)&addr, sizeof(addr));
+    if (rc < 0 && errno != EINPROGRESS) {
+      close(fd);
+      return false;
+    }
+    pollfd pfd{fd, POLLOUT, 0};
+    int err = 0;
+    socklen_t elen = sizeof(err);
+    if (poll(&pfd, 1, cto_ms) <= 0 ||
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) != 0 || err != 0) {
+      close(fd);
+      return false;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::vector<uint8_t> frame(HEADER_SIZE + hello.size());
+    pack_header(frame.data(), T_HELLO, 0, hello.size());
+    memcpy(frame.data() + HEADER_SIZE, hello.data(), hello.size());
+    size_t off = 0;
+    while (off < frame.size()) {
+      ssize_t w = ::send(fd, frame.data() + off, frame.size() - off,
+                         MSG_NOSIGNAL);
+      if (w < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          pollfd p2{fd, POLLOUT, 0};
+          if (poll(&p2, 1, cto_ms) <= 0) { close(fd); return false; }
+          continue;
+        }
+        close(fd);
+        return false;
+      }
+      off += (size_t)w;
+    }
+    auto read_exact = [&](uint8_t* out, size_t n) -> bool {
+      size_t got = 0;
+      while (got < n) {
+        ssize_t r = ::recv(fd, out + got, n - got, 0);
+        if (r > 0) { got += (size_t)r; continue; }
+        if (r == 0) return false;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          pollfd p2{fd, POLLIN, 0};
+          if (poll(&p2, 1, cto_ms) <= 0) return false;
+          continue;
+        }
+        return false;
+      }
+      return true;
+    };
+    uint8_t hdr[HEADER_SIZE];
+    uint8_t type;
+    uint64_t a, b;
+    if (!read_exact(hdr, HEADER_SIZE)) { close(fd); return false; }
+    unpack_header(hdr, &type, &a, &b);
+    if (type != T_HELLO_ACK || b > 4096) { close(fd); return false; }
+    std::vector<uint8_t> bd(b);
+    if (b && !read_exact(bd.data(), b)) { close(fd); return false; }
+    out_ack->assign((char*)bd.data(), bd.size());
+    *out_fd = fd;
+    return true;
   }
 
   // A surfaced descriptor resolved (embedder's pull landed or failed):
@@ -1918,6 +2549,10 @@ struct Worker {
       }
       // Never batch bytes past the sm switch point onto the socket.
       if (item.switch_after) break;
+      // A stripe feeder refills in place after its chunk completes, so
+      // the byte budget must never span past it (the Python pump's
+      // _gather_tx carries the same rule -- keep the two in lockstep).
+      if (item.stripe) break;
     }
     if (niov == 0) return 0;
     msghdr msg{};
@@ -1995,6 +2630,8 @@ struct Worker {
           if (take > budget) take = budget;
           item.off += take;
           budget -= take;
+          if (item.stripe && take > 0)
+            stripe_first_progress(item.stripe, fires);
           if (item.is_data && item.rndv && !item.local_done &&
               item.off >= item.header.size()) {
             item.local_done = true;
@@ -2004,6 +2641,18 @@ struct Worker {
             }
           }
           if (item.off >= item.total()) {
+            if (item.stripe) {
+              // Chunk fully on the wire: account it and refill the
+              // feeder in place (work stealing); the gather pass
+              // stopped at the feeder, so no later item's budget is
+              // misattributed to the refilled frame.
+              stripe_tx_chunk_finished(c, item, fires);
+              if (!stripe_refill(c, *ref)) {
+                c->feeder_live = false;
+                c->tx.pop_front();
+              }
+              break;
+            }
             if (item.is_data && !item.local_done) {
               item.local_done = true;
               if (item.done) {
@@ -2047,6 +2696,7 @@ struct Worker {
           break;
         }
         item.off += (uint64_t)w;
+        if (item.stripe) stripe_first_progress(item.stripe, fires);
         if (item.is_data && item.rndv && !item.local_done && item.off >= hlen) {
           item.local_done = true;
           if (item.done) {
@@ -2056,6 +2706,14 @@ struct Worker {
         }
       }
       if (!blocked) {
+        if (item.stripe) {
+          // Chunk published to the ring: refill the feeder in place.
+          stripe_tx_chunk_finished(c, item, fires);
+          if (stripe_refill(c, item)) continue;
+          c->feeder_live = false;
+          c->tx.pop_front();
+          continue;
+        }
         if (item.is_data && !item.local_done) {
           item.local_done = true;
           if (item.done) {
@@ -2171,6 +2829,43 @@ struct Worker {
         ssize_t r = stream_read(c, c->scratch.data(), want, fires);
         if (r <= 0) return;
         c->rx_skip -= (uint64_t)r;
+        continue;
+      }
+      if (c->sdata_active) {
+        // Striped-chunk sub-header (msg id, offset, total) accumulating
+        // on this rail (DESIGN.md §17).
+        ssize_t r = stream_read(c, c->sdata_sub + c->sdata_got,
+                                SDATA_SUB_SIZE - c->sdata_got, fires);
+        if (r <= 0) return;
+        c->sdata_got += (size_t)r;
+        if (c->sdata_got < SDATA_SUB_SIZE) continue;
+        c->sdata_active = false;
+        stripe_rx_resolve(c, fires);
+        continue;
+      }
+      if (c->rx_stripe) {
+        StripeAsm* a = c->rx_stripe;
+        InboundMsg* m = a->msg;
+        uint64_t remaining = c->rx_stripe_len - c->rx_stripe_got;
+        uint8_t* target;
+        size_t want;
+        if (m->discard) {
+          if (c->scratch.size() < (1u << 20)) c->scratch.resize(1u << 20);
+          target = c->scratch.data();
+          want = remaining > c->scratch.size() ? c->scratch.size()
+                                               : (size_t)remaining;
+        } else {
+          uint64_t pos = c->rx_stripe_off + c->rx_stripe_got;
+          uint8_t* base = (m->has_pr && !m->use_spill) ? m->pr.buf
+                                                       : m->spill.data();
+          target = base + pos;
+          want = remaining > (4u << 20) ? (4u << 20) : (size_t)remaining;
+        }
+        ssize_t r = stream_read(c, target, want, fires);
+        if (r <= 0) return;
+        c->rx_stripe_got += (uint64_t)r;
+        if (c->rx_stripe_got < c->rx_stripe_len) continue;
+        stripe_rx_chunk_done(c, fires);
         continue;
       }
       if (c->rx_msg) {
@@ -2310,6 +3005,23 @@ struct Worker {
             sessions.erase(c->sess->id);
           }
           break;
+        // swcheck: state(estab, SDATA, estab|down)
+        case T_SDATA:
+          if (b < SDATA_SUB_SIZE) {
+            conn_broken(c, fires);  // sub-header promised, not present
+            return;
+          }
+          c->sdata_active = true;
+          c->sdata_got = 0;
+          c->sdata_tag = a;
+          c->sdata_len = b;
+          break;
+        // swcheck: state(estab, SACK, estab)
+        case T_SACK: {
+          Conn* root = stripe_root(c);
+          stripe_on_sack(root, a, fires);
+          break;
+        }
         // swcheck: state(estab, PING, estab)
         case T_PING:
           // Liveness probe: answer immediately (stream_read already
@@ -2377,6 +3089,12 @@ struct Worker {
         for (auto& [id, c] : conns) candidates.push_back(c);
       }
     }
+    // Secondary rails are never flush targets: they carry only chunk
+    // traffic, and striped delivery is covered by the SACK waits below.
+    candidates.erase(
+        std::remove_if(candidates.begin(), candidates.end(),
+                       [](Conn* c) { return c->rail_parent != 0; }),
+        candidates.end());
     for (Conn* c : candidates) {
       if (!c->alive && c->dirty) {
         // An expired session owns the failure reason (DESIGN.md §14).
@@ -2398,6 +3116,8 @@ struct Worker {
       uint64_t seq = ++c->flush_seq;
       rec->waits[c->id] = seq;
       c->flush_marks[seq] = c->data_counter;
+      if (stripe_has_unsacked(c, c->next_stripe_msg - 1))
+        rec->stripe_waits[c->id] = c->next_stripe_msg - 1;
       conn_send_ctl(c, T_FLUSH, seq, 0, "", fires,
                     /*switch_after=*/false, /*sess_frame=*/true);
     }
@@ -2427,6 +3147,19 @@ struct Worker {
       if (it == conns.end()) continue;
       Conn* c = it->second;
       if (c->flush_acked < seq) {
+        if (!c->alive) {
+          dead = true;
+          if (c->sess_fail) dead_reason = c->sess_fail;
+        } else {
+          pending = true;
+        }
+      }
+    }
+    for (auto& [cid, watermark] : rec->stripe_waits) {
+      auto it = conns.find(cid);
+      if (it == conns.end()) continue;
+      Conn* c = it->second;
+      if (stripe_has_unsacked(c, watermark)) {
         if (!c->alive) {
           dead = true;
           if (c->sess_fail) dead_reason = c->sess_fail;
@@ -2508,6 +3241,7 @@ struct Worker {
       }
       fire_release(item, fires, /*force=*/true);
     }
+    drop_feeder_holds(c, fires);
     c->tx.clear();
     if (c->rx_msg) {
       std::lock_guard<std::mutex> g(mu);
@@ -2517,11 +3251,25 @@ struct Worker {
     }
     close(c->fd);
     c->fd = -1;
+    c->feeder_live = false;
     c->drop_sm();
     {
       std::lock_guard<std::mutex> g(mu);
       matcher.purge_remote_conn(c->id);
     }
+    stripe_terminal(c, kCancelled, fires);
+    if (c->rail_parent) {
+      // A secondary lane died: the endpoint survives; its claimed-but-
+      // unacked chunks re-queue onto the surviving lanes.
+      Conn* root = conn_by_id(c->rail_parent);
+      if (root && root->alive) stripe_rail_lost(root, c->id, fires);
+    }
+    for (uint64_t rid : std::vector<uint64_t>(c->rails)) {
+      // The primary died terminally: its rails are meaningless.
+      Conn* r = conn_by_id(rid);
+      if (r && r->alive) conn_broken(r, fires);
+    }
+    c->rails.clear();
     bool was_half_open = half_open.erase(c) > 0;
     auto snapshot = flushes;
     for (auto* rec : snapshot) try_complete_flush(rec, fires);
@@ -2568,6 +3316,7 @@ struct Worker {
       }
       fire_release(item, fires, /*force=*/true);
     }
+    drop_feeder_holds(c, fires);
     c->tx.clear();
     c->alive = false;
     ep_del(c->fd);
@@ -2586,7 +3335,9 @@ struct Worker {
     }
     close(c->fd);
     c->fd = -1;
+    c->feeder_live = false;
     c->drop_sm();
+    stripe_terminal(c, kCancelled, fires, /*purge_rx=*/false);
   }
 
   // -------------------------------------------------------------- hello
@@ -2601,6 +3352,14 @@ struct Worker {
     }
     c->handshaken = true;
     half_open.erase(c);
+    std::string rail_of = json_field(body, "rail_of");
+    if (!rail_of.empty()) {
+      // Secondary-lane attach (DESIGN.md §17): adopt the conn into the
+      // existing endpoint's rail set -- no accept callback, no new
+      // endpoint, no sm/session negotiation.
+      on_rail_hello(c, rail_of, body, fires);
+      return;
+    }
     // Resilient-session handshake (STARWAY_SESSION): a resume dial adopts
     // the new socket into the suspended conn; a fresh offer registers a
     // new session.  Session conns never take the sm upgrade (the rings
@@ -2629,6 +3388,7 @@ struct Worker {
     if (devpull_advertise && json_field(body, "devpull") == "ok")
       c->devpull_ok = true;
     if (json_field(body, "ka") == "ok") c->ka_ok = true;  // liveness capability
+    if (!json_field(body, "rails").empty()) c->rails_ok = true;
     if (trace.enabled) {
       // swscope stitching: adopt the connector's trace-conn id so both
       // rings tag this conn's EV_E2E events identically (DESIGN.md §15).
@@ -2644,6 +3404,7 @@ struct Worker {
                       (seg ? ", \"sm\": \"ok\"" : "") +
                       (c->devpull_ok ? ", \"devpull\": \"ok\"" : "") +
                       (c->ka_ok ? ", \"ka\": \"ok\"" : "") +
+                      (c->rails_ok ? ", \"rails\": \"ok\"" : "") +
                       (c->tr_hex[0] ? ", \"tr\": \"ok\"" : "") + sess_ext + "}";
     // The ACK is the transport switch point (see TxItem::switch_after).
     conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires,
@@ -2800,6 +3561,37 @@ struct Worker {
         }
         return;
       }
+      // Striped send: the source registry holds it (core/lane.py
+      // _expire_stripe is the Python twin).
+      for (auto& [mid, src] : c->stripe_by_id) {
+        if (src->ctx != t.ctx || src->sacked || src->failed) continue;
+        if (src->local_done) return;  // deadline bounds LOCAL completion
+        if (c->sess && !c->sess->expired && src->started())
+          return;  // promised: re-dispatch at resume completes it late
+        bool started = src->started();
+        bump(counters.ops_timed_out);
+        trace.rec(kEvOpFail, src->tag, c->id, src->total, kTimedOut);
+        src->failed = true;
+        src->local_done = true;
+        if (src->fail) {
+          auto fail = src->fail; auto fctx = src->ctx;
+          fires.push_back([fail, fctx] { fail(fctx, kTimedOut); });
+        }
+        if (!started) {
+          // Untouched: withdraw cleanly from the dispatch queue.
+          for (auto qit = c->stripe_q.begin(); qit != c->stripe_q.end();
+               ++qit)
+            if (qit->get() == src.get()) { c->stripe_q.erase(qit); break; }
+          src->writers = 0;
+          stripe_maybe_release(*src, fires);
+        } else {
+          // Chunks already promised on the wire: the group resets.
+          src->writers = 0;
+          stripe_maybe_release(*src, fires);
+          conn_broken(c, fires);
+        }
+        return;
+      }
       // Session backpressure may have parked it unframed: withdraw
       // cleanly from the waiting queue.
       if (c->sess) {
@@ -2883,7 +3675,12 @@ struct Worker {
         jf = ss->journal.size();
       }
       uint64_t inflr = (c->rx_msg ? 1 : 0) + c->devpull_pending.size();
-      const uint64_t vals[] = {depth, qbytes, infl, inflr, jb, jf};
+      uint64_t sp = 0;  // chunks assigned to this lane but unwritten...
+      for (auto& ref : c->tx)
+        if (ref->stripe && ref->off < ref->total()) sp++;
+      for (auto& [mid, src] : c->stripe_by_id)  // ...plus undisbursed
+        if (!src->sacked && !src->failed) sp += src->pending.size();
+      const uint64_t vals[] = {depth, qbytes, infl, inflr, jb, jf, sp};
       static_assert(sizeof(vals) / sizeof(vals[0]) ==
                         sizeof(kGaugeNames) / sizeof(kGaugeNames[0]),
                     "gauge names and values out of sync");
@@ -3204,6 +4001,13 @@ struct ClientWorker : Worker {
     }
     if (devpull_advertise) hello += ", \"devpull\": \"ok\"";
     hello += ", \"ka\": \"ok\"";  // liveness capability, always offered
+    int rails_n = stripe_rails_env();
+    if (rails_n > 1) {
+      // Multi-rail striping offer (DESIGN.md §17): a capable acceptor
+      // confirms "rails": "ok" and we dial the extra lanes right after
+      // the primary handshake.
+      hello += ", \"rails\": \"" + std::to_string(rails_n) + "\"";
+    }
     char tr_offer[17] = {0};
     if (trace.enabled) {
       // swscope stitching: offer a fresh trace-conn id (DESIGN.md §15).
@@ -3265,6 +4069,7 @@ struct ClientWorker : Worker {
     c->peer_name = json_field(ack_body, "worker_id");
     c->devpull_ok = devpull_advertise && json_field(ack_body, "devpull") == "ok";
     c->ka_ok = json_field(ack_body, "ka") == "ok";
+    c->rails_ok = rails_n > 1 && json_field(ack_body, "rails") == "ok";
     if (tr_offer[0] && json_field(ack_body, "tr") == "ok")
       memcpy(c->tr_hex, tr_offer, sizeof(c->tr_hex));
     if (sess_on && json_field(ack_body, "sess") == "ok") {
@@ -3302,6 +4107,7 @@ struct ClientWorker : Worker {
     }
     ep_add(fd, EPOLLIN, c);
     trace.rec(kEvConnUp, 0, c->id);
+    if (c->rails_ok) dial_rails(c, rails_n - 1, fires);
     if (c->tr_hex[0]) {
       // One-shot clock exchange at handshake: a timestamped PING whose
       // PONG yields the first EV_CLOCK sample even with keepalive off.
@@ -3339,8 +4145,10 @@ extern "C" {
 // 4: swtrace observability (sw_counters/sw_trace);
 // 5: resilient sessions (T_SEQ/T_ACK, "sess" handshake, sw_set_event_cb);
 // 6: swscope ("tr" handshake + EV_E2E ordinals, timestamped PING/PONG
-//    clock samples, per-conn gauges via sw_gauges)
-const char* sw_version() { return "starway-native-6"; }
+//    clock samples, per-conn gauges via sw_gauges);
+// 7: multi-rail striping (T_SDATA/T_SACK, "rails"/"rail_of" handshake,
+//    chunk-level work stealing + offset-dedup reassembly)
+const char* sw_version() { return "starway-native-7"; }
 
 // Portable cursor atomics for the Python engine's sm ring (sw_engine.h).
 // std::atomic_ref would be C++20-tidy but libstdc++'s needs alignment UB
@@ -3646,11 +4454,12 @@ int sw_conn_info(void* h, uint64_t conn_id, char* out, int cap) {
                    "{\"name\": \"%s\", \"mode\": \"%s\", \"alive\": %d, "
                    "\"local_addr\": \"%s\", \"local_port\": %d, "
                    "\"remote_addr\": \"%s\", \"remote_port\": %d, "
-                   "\"transport\": \"%s\", \"devpull\": %d}",
+                   "\"transport\": \"%s\", \"devpull\": %d, \"rails\": %d}",
                    c->peer_name.c_str(), c->mode.c_str(), c->alive ? 1 : 0,
                    c->local_addr.c_str(), c->local_port,
                    c->remote_addr.c_str(), c->remote_port,
-                   c->sm_negotiated ? "sm" : "tcp", c->devpull_ok ? 1 : 0);
+                   c->sm_negotiated ? "sm" : "tcp", c->devpull_ok ? 1 : 0,
+                   (int)c->rails.size());
   if (n < 0 || n >= cap) return -1;
   memcpy(out, buf, (size_t)n + 1);
   return n;
@@ -3673,6 +4482,8 @@ int sw_counters(void* h, char* out, int cap) {
       c.sessions_resumed.load(), c.frames_replayed.load(),
       c.dup_frames_dropped.load(),
       c.acks_tx.load(),        c.acks_rx.load(),
+      c.stripe_chunks_tx.load(), c.stripe_chunks_rx.load(),
+      c.rail_resteals.load(),
   };
   constexpr size_t kN = sizeof(kCounterNames) / sizeof(kCounterNames[0]);
   static_assert(sizeof(vals) / sizeof(vals[0]) == kN,

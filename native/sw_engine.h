@@ -72,11 +72,14 @@ typedef void (*sw_event_cb)(void* ctx, const char* event, uint64_t conn_id);
  * transparent resume -- negotiated via "sess", DESIGN.md §14) + swscope
  * (end-to-end EV_E2E ordinals via the "tr" handshake key, timestamped
  * PING/PONG clock samples, per-conn gauges via sw_gauges -- DESIGN.md
- * §15).  The annotation below is machine-checked against the
+ * §15) + multi-rail striping (T_SDATA/T_SACK chunk frames, the
+ * "rails"/"rail_of" handshake keys, chunk-level work stealing with
+ * offset-dedup reassembly and SACK-covered flush barriers -- DESIGN.md
+ * §17).  The annotation below is machine-checked against the
  * sw_engine.cpp implementation by the contract checker (python -m
  * starway_tpu.analysis, rule contract-version) -- bump BOTH when the
  * protocol changes.
- * swcheck: engine-version "starway-native-6" */
+ * swcheck: engine-version "starway-native-7" */
 const char* sw_version(void);
 
 /* Allocate a client/server worker in the VOID state.  `worker_id` is the

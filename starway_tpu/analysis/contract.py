@@ -42,8 +42,10 @@ _REASON_PAIRS = [
 # Negotiated handshake keys: offered in HELLO, confirmed in HELLO_ACK.
 # "sess" is the resilient-session negotiation (DESIGN.md §14; carries the
 # sess_id/sess_epoch/sess_ack triple alongside it); "tr" is the swscope
-# end-to-end trace-conn id (DESIGN.md §15).
-_HANDSHAKE_KEYS = ["ka", "sm", "devpull", "sess", "tr"]
+# end-to-end trace-conn id (DESIGN.md §15); "rails"/"rail_of" are the
+# multi-rail striping negotiation and the secondary-lane attach key
+# (DESIGN.md §17).
+_HANDSHAKE_KEYS = ["ka", "sm", "devpull", "sess", "tr", "rails", "rail_of"]
 
 # Normalised C type -> acceptable canonical ctypes spellings.
 _C2CTYPES = {
@@ -109,6 +111,28 @@ def _check_frames(py: PyModel, cpp: CppModel, out: list) -> None:
     else:
         out.append(Finding(f_frames, 1, "contract-header",
                            "HEADER = struct.Struct(...) not found"))
+
+    # Striped-DATA sub-header layout (DESIGN.md §17): the SDATA_SUB pack
+    # size must equal the C++ SDATA_SUB_SIZE constexpr.
+    if py.sdata_sub_fmt is not None:
+        fmt, line = py.sdata_sub_fmt
+        try:
+            py_size = struct.calcsize(fmt)
+        except struct.error:
+            py_size = -1
+        cpp_size = cpp.constants.get("SDATA_SUB_SIZE")
+        if cpp_size is None:
+            out.append(Finding(cpp.cpp_file, 1, "contract-header",
+                               "SDATA_SUB_SIZE constexpr not found"))
+        elif cpp_size[0] != py_size:
+            out.append(Finding(
+                f_frames, line, "contract-header",
+                f"SDATA_SUB struct.Struct({fmt!r}) packs {py_size} bytes but "
+                f"{cpp.cpp_file}:{cpp_size[1]} has SDATA_SUB_SIZE = "
+                f"{cpp_size[0]} (two engines, one stripe sub-header)"))
+    else:
+        out.append(Finding(f_frames, 1, "contract-header",
+                           "SDATA_SUB = struct.Struct(...) not found"))
 
 
 def _check_shm(py: PyModel, cpp: CppModel, out: list) -> None:

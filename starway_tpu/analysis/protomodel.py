@@ -60,7 +60,7 @@ from .base import Finding, parse_or_finding, read_text
 KNOWN_STATES = {"hello-sent", "estab", "suspended"}
 KNOWN_INPUTS = {
     "HELLO", "HELLO_ACK", "DATA", "FLUSH", "FLUSH_ACK", "DEVPULL",
-    "PING", "PONG", "SEQ", "ACK", "BYE", "OTHER",
+    "PING", "PONG", "SEQ", "ACK", "BYE", "SDATA", "SACK", "OTHER",
     "lost", "resume", "expire",
 }
 KNOWN_NEXTS = {"estab", "down", "expired", "suspended"}

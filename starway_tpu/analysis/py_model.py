@@ -121,6 +121,7 @@ def canon_ctypes(node: ast.AST) -> str:
 class PyModel:
     frames: dict = field(default_factory=dict)       # T_* -> (int, line)
     header_fmt: Optional[tuple] = None               # (fmt str, line)
+    sdata_sub_fmt: Optional[tuple] = None            # (fmt str, line)
     frames_doc: Optional[str] = None                 # module docstring
     shm: dict = field(default_factory=dict)          # layout name -> (int, line)
     doorbell: dict = field(default_factory=dict)     # DB_* -> (int, line)
@@ -165,15 +166,19 @@ def extract_py(root: Path) -> PyModel:
         }
         model.frames_doc = ast.get_docstring(tree)
         for node in tree.body:
-            # HEADER = struct.Struct("<BQQ")
+            # HEADER = struct.Struct("<BQQ") / SDATA_SUB = struct.Struct("<QQQ")
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name) \
-                    and node.targets[0].id == "HEADER" \
+                    and node.targets[0].id in ("HEADER", "SDATA_SUB") \
                     and isinstance(node.value, ast.Call) \
                     and node.value.args \
                     and isinstance(node.value.args[0], ast.Constant) \
                     and isinstance(node.value.args[0].value, str):
-                model.header_fmt = (node.value.args[0].value, node.lineno)
+                rec = (node.value.args[0].value, node.lineno)
+                if node.targets[0].id == "HEADER":
+                    model.header_fmt = rec
+                else:
+                    model.sdata_sub_fmt = rec
 
     tree = _parse(core / "shmring.py")
     if tree is not None:

@@ -83,6 +83,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stream-bytes", type=parse_size)
     p.add_argument("--stream-iterations", type=int)
     p.add_argument("--stream-warmup", type=int)
+    p.add_argument("--striped-bytes", type=parse_size)
+    p.add_argument("--striped-iterations", type=int)
+    p.add_argument("--striped-warmup", type=int)
+    p.add_argument(
+        "--rails", type=int, metavar="N",
+        help="Open N transport lanes per connection (STARWAY_RAILS) and arm "
+             "multi-rail striping (STARWAY_STRIPE_THRESHOLD defaults to 1 MiB "
+             "when unset); see the 'striped' scenario (DESIGN.md §17).",
+    )
+    p.add_argument(
+        "--paired-baseline", action="store_true",
+        help="Striped scenario only: interleave a striping-OFF baseline with "
+             "every striping-ON iteration in ONE process/connection and "
+             "report the per-pair ratio -- the box-noise-immune methodology "
+             "from BENCHMARK.md, now built in.",
+    )
     p.add_argument("--output", type=Path, help="Path to write the JSON report.")
     p.add_argument("--store-trace", action="store_true", help="Include per-iteration samples in the report.")
     p.add_argument(
@@ -109,6 +125,7 @@ _OVERRIDE_KEYS = {
     ],
     "pingpong-flag": [("flag_iterations", "iterations"), ("flag_warmup", "warmup")],
     "streaming-duplex": [("stream_bytes", "message_bytes"), ("stream_iterations", "iterations"), ("stream_warmup", "warmup")],
+    "striped": [("striped_bytes", "message_bytes"), ("striped_iterations", "iterations"), ("striped_warmup", "warmup")],
 }
 
 
@@ -132,6 +149,8 @@ def scenario_plan(args: argparse.Namespace) -> list[tuple[str, dict[str, Any]]]:
                 overrides[cfg_key] = val
         if getattr(args, "payload", None) and name in ("large-array", "streaming-duplex"):
             overrides["payload"] = args.payload
+        if name == "striped" and getattr(args, "paired_baseline", False):
+            overrides["paired"] = True
         plan.append((name, overrides))
     return plan
 
@@ -439,6 +458,12 @@ def main(argv: Sequence[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.tls:
         os.environ["STARWAY_TLS"] = args.tls
+    if args.rails:
+        # Rails negotiate at connect, so the env must be set before any
+        # worker is built; the threshold default arms striping for the
+        # 'striped' scenario's >= 1 MiB messages.
+        os.environ["STARWAY_RAILS"] = str(max(1, args.rails))
+        os.environ.setdefault("STARWAY_STRIPE_THRESHOLD", str(1 << 20))
     if args.trace:
         # Must land before any worker is created: rings are armed per
         # worker at construction (core/swtrace.py).

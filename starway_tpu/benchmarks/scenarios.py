@@ -40,6 +40,7 @@ FLAG_PING_TAG = 0x2B20
 FLAG_PONG_TAG = 0x2B21
 STREAM_UP_TAG = 0x2B30
 STREAM_DOWN_TAG = 0x2B31
+STRIPED_DATA_TAG = 0x2B40
 
 
 @dataclass
@@ -291,11 +292,108 @@ class StreamingDuplex(Scenario):
         await ctx.flush_endpoint()
 
 
+class Striped(Scenario):
+    """Multi-rail striped throughput (DESIGN.md §17): one-way transfer of
+    large messages with the stripe scheduler armed (``--rails N`` sets
+    ``STARWAY_RAILS`` before the workers are built; the conn then carries
+    N lanes).  ``paired=True`` is the built-in paired-ratio mode: every
+    iteration measures a striping-OFF baseline and a striping-ON transfer
+    back to back over the SAME connection (``STARWAY_STRIPE_THRESHOLD``
+    is read per send, so the toggle is one env flip), which cancels the
+    1.5-6 GB/s box noise that otherwise needs hand-run interleaving
+    (BENCHMARK.md)."""
+
+    name = "striped"
+    description = "Striped large-message throughput across the rail set (optionally HEAD/new paired)."
+    defaults = {"message_bytes": 8 << 20, "warmup": 2, "iterations": 10,
+                "payload": "host", "paired": False}
+
+    @staticmethod
+    def _thr_env():
+        import os
+
+        return os.environ.get("STARWAY_STRIPE_THRESHOLD", "")
+
+    @staticmethod
+    def _set_thr(val: str) -> None:
+        import os
+
+        if val:
+            os.environ["STARWAY_STRIPE_THRESHOLD"] = val
+        else:
+            os.environ.pop("STARWAY_STRIPE_THRESHOLD", None)
+
+    async def run_client(self, ctx, overrides) -> ScenarioResult:
+        cfg = self.config(overrides)
+        size = int(cfg["message_bytes"])
+        warmup, iters = int(cfg["warmup"]), int(cfg["iterations"])
+        paired = bool(cfg.get("paired"))
+        payload = _make_payload(size, 0x5B, cfg.get("payload", "host"))
+        armed = self._thr_env() or str(1 << 20)
+
+        async def one(thr: str) -> float:
+            self._set_thr(thr)
+            try:
+                t0 = time.perf_counter()
+                await ctx.client.asend(payload, STRIPED_DATA_TAG)
+                await ctx.flush()
+                return time.perf_counter() - t0
+            finally:
+                self._set_thr(armed)
+
+        striped: list[float] = []
+        base: list[float] = []
+        for i in range(warmup + iters):
+            if paired:
+                b = await one("0")       # HEAD config: single lane
+                s = await one(armed)     # new config: striped
+                if i >= warmup:
+                    base.append(b)
+                    striped.append(s)
+            else:
+                s = await one(armed)
+                if i >= warmup:
+                    striped.append(s)
+        gbps = [size / dt / 1e9 for dt in striped if dt > 0]
+        metrics = {
+            "striped_gbps_p50": float(np.median(gbps)) if gbps else 0.0,
+            "striped_seconds_total": sum(striped),
+        }
+        samples = {"striped_seconds": striped}
+        if paired:
+            base_gbps = [size / dt / 1e9 for dt in base if dt > 0]
+            ratios = [b / s for b, s in zip(base, striped) if s > 0]
+            metrics.update(
+                baseline_gbps_p50=(float(np.median(base_gbps))
+                                   if base_gbps else 0.0),
+                paired_ratio_p50=float(np.median(ratios)) if ratios else 0.0,
+                paired_ratio_min=min(ratios) if ratios else 0.0,
+                paired_ratio_max=max(ratios) if ratios else 0.0,
+            )
+            samples["baseline_seconds"] = base
+            samples["paired_ratios"] = ratios
+        return ScenarioResult(name=self.name, metrics=metrics,
+                              samples=samples, config=cfg)
+
+    async def run_server(self, ctx, overrides) -> None:
+        cfg = self.config(overrides)
+        size = int(cfg["message_bytes"])
+        total = int(cfg["warmup"]) + int(cfg["iterations"])
+        if bool(cfg.get("paired")):
+            total *= 2
+        sink = _make_sink(size, cfg.get("payload", "host"))
+        await ctx.signal_ready()
+        for _ in range(total):
+            await ctx.server.arecv(sink, STRIPED_DATA_TAG, ctx.tag_mask)
+        await ctx.flush_endpoint()
+
+
 # Back-compat aliases matching the reference's registry surface.
 ScenarioDefinition = Scenario
 
 SCENARIOS: Dict[str, Scenario] = {
-    s.name: s for s in (LargeArray(), SmallMessages(), PingpongFlag(), StreamingDuplex())
+    s.name: s for s in (LargeArray(), SmallMessages(), PingpongFlag(),
+                        StreamingDuplex(), Striped())
 }
 
 __all__ = [

@@ -112,6 +112,38 @@ benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
     with ``"session expired"`` and the seed failure contract applies from
     then on.
 
+``STARWAY_RAILS``
+    Number of parallel transport lanes ("rails") a client opens to each
+    server (default 1).  With N > 1 the primary HELLO offers
+    ``"rails": "<N>"``; a striping-capable acceptor confirms
+    ``"rails": "ok"`` and the connector dials N-1 extra TCP conns, each
+    attached to the primary endpoint via the ``"rail_of"`` handshake key
+    (no new server endpoint is created).  Rails are the stripe targets of
+    the multi-rail data plane (DESIGN.md §17); an old peer simply never
+    confirms and the extra dials are skipped -- all pairings interoperate.
+    On a same-host sm-upgraded primary the extra rails stay on TCP, so
+    one message can ride sm and tcp concurrently.
+
+``STARWAY_STRIPE_THRESHOLD``
+    Payload size in bytes at or above which a send on a railed connection
+    is striped: split at ``STARWAY_STRIPE_CHUNK`` granularity, the chunks
+    dispatched across every live rail with completion-driven work
+    stealing, and reassembled by offset at the receiver (wire frame
+    T_SDATA, core/frames.py).  Default 0 = striping off (seed parity:
+    every send rides exactly one lane).  Striped sends use rendezvous
+    local-completion semantics regardless of size and the payload is
+    pinned by reference until the receiver's T_SACK -- delivery, as
+    always, is promised only by ``aflush``.
+
+``STARWAY_STRIPE_CHUNK``
+    Stripe granularity in bytes (default: 4x the ``STARWAY_CHUNK`` §12
+    staging granularity = 1 MiB, the measured sweet spot on the 1-core
+    dev box -- smaller chunks pay a sendmsg per chunk, larger ones
+    starve the work stealing; floor 4 KiB).  Each chunk is an
+    independent self-describing frame (msg id, offset, total), which is
+    what makes chunk-level work stealing, rail-death redistribution, and
+    receiver-side offset dedup possible.
+
 ``STARWAY_TRACE``
     "1" = record per-op lifecycle events (posted/matched/completed/
     failed, stage spans, connection churn) into a bounded per-worker ring
@@ -176,6 +208,9 @@ __all__ = [
     "session_enabled",
     "session_journal_bytes",
     "session_grace",
+    "stripe_rails",
+    "stripe_threshold",
+    "stripe_chunk",
     "trace_enabled",
     "trace_ring_size",
     "flight_dir",
@@ -305,6 +340,38 @@ def session_grace() -> float:
     except ValueError:
         return 30.0
     return v if v > 0 else 30.0
+
+
+def stripe_rails() -> int:
+    """Parallel transport lanes per client connection (STARWAY_RAILS);
+    1 (the default) keeps the single-conn seed topology."""
+    try:
+        v = int(_env("STARWAY_RAILS", "1"))
+    except ValueError:
+        return 1
+    return max(1, min(16, v))
+
+
+def stripe_threshold() -> int:
+    """Payload bytes at/above which railed sends stripe
+    (STARWAY_STRIPE_THRESHOLD); 0 (the default) disables striping."""
+    try:
+        v = int(_env("STARWAY_STRIPE_THRESHOLD", "0"))
+    except ValueError:
+        return 0
+    return v if v > 0 else 0
+
+
+def stripe_chunk() -> int:
+    """Stripe granularity in bytes (STARWAY_STRIPE_CHUNK; defaults to 4x
+    the §12 STARWAY_CHUNK staging granularity = 1 MiB)."""
+    raw = _env("STARWAY_STRIPE_CHUNK", "")
+    if raw:
+        try:
+            return max(4096, int(raw))
+        except ValueError:
+            pass
+    return max(4096, 4 * (chunk_bytes() or 256 * 1024))
 
 
 def trace_enabled() -> bool:

@@ -46,6 +46,7 @@ from typing import Optional
 from .. import config, perf
 from ..errors import REASON_CANCELLED, REASON_NOT_CONNECTED
 from . import frames, state, swtrace
+from .lane import RailGroup, StripeFeeder, StripeRx
 from .matching import InboundMsg
 
 logger = logging.getLogger("starway_tpu")
@@ -432,6 +433,20 @@ class TcpConn(BaseConn):
         # Resilient-session state (core/session.py; negotiated via the
         # "sess" handshake key).  None on seed-parity conns: every session
         # hook below is a single `is None` check.
+        # Multi-rail striping (core/lane.py; DESIGN.md §17).  On a
+        # PRIMARY conn: ``rails`` holds the attached secondary conns,
+        # ``stripe``/``stripe_rx`` the lazily-created TX scheduler and RX
+        # reassembly tables.  On a SECONDARY: ``rail_parent`` points at
+        # the primary.  All None/empty on seed-parity conns.
+        self.rails: list = []
+        self.rail_parent: Optional["TcpConn"] = None
+        self.rails_ok = False
+        self.stripe: Optional[RailGroup] = None
+        self.stripe_rx: Optional[StripeRx] = None
+        # per-rail striped-chunk rx parser state
+        self._sdata: Optional[tuple] = None   # (tag, subhdr buf, got, blen)
+        self._rx_stripe: Optional[tuple] = None  # (asm, offset, chunk_len)
+        self._rx_stripe_got = 0
         self.sess = None
         self._sess_pending = None   # seq announced by the last T_SEQ
         self._sess_drop = False     # next frame is a duplicate: drain + drop
@@ -552,6 +567,33 @@ class TcpConn(BaseConn):
                 break
         return total
 
+    # ------------------------------------------------------------- stripe
+    def stripe_root(self) -> "TcpConn":
+        return self.rail_parent if self.rail_parent is not None else self
+
+    def stripe_group(self) -> RailGroup:
+        if self.stripe is None:
+            self.stripe = RailGroup(self)
+        return self.stripe
+
+    def _stripe_rx_tbl(self) -> StripeRx:
+        root = self.stripe_root()
+        if root.stripe_rx is None:
+            root.stripe_rx = StripeRx(root)
+        return root.stripe_rx
+
+    def attach_rail(self, conn: "TcpConn", fires: list) -> None:
+        """Adopt ``conn`` as a secondary lane of this (primary) conn."""
+        conn.rail_parent = self
+        self.rails = [r for r in self.rails if r.alive]
+        self.rails.append(conn)
+        grp = self.stripe_group()
+        grp.lanes = [ln for ln in grp.lanes
+                     if ln.conn is self or ln.alive]
+        grp.add_rail(conn)
+        if grp.queue:
+            grp.dispatch(fires)  # mid-stripe join: start stealing now
+
     def send_data(self, tag: int, payload, done, fail, owner, fires: list,
                   kick: bool = True):
         """Queue a tagged message.  Returns the TxData handle so the worker
@@ -566,6 +608,16 @@ class TcpConn(BaseConn):
             if fail is not None:
                 fires.append(lambda: fail(REASON_NOT_CONNECTED + " (connection reset)"))
             return None
+        if self.rails:
+            grp = self.stripe_group()
+            nbytes = (len(payload) if isinstance(payload, memoryview)
+                      else int(payload.nbytes))
+            if grp.stripe_ok(nbytes, payload):
+                # Striped path (DESIGN.md §17): the source is NOT
+                # seq-framed even on session conns -- chunks are
+                # idempotent and the journal is per-message (the group
+                # re-dispatches un-SACKed sources wholesale at resume).
+                return grp.submit(tag, payload, done, fail, owner, fires)
         self.dirty = True
         self._data_counter += 1
         item = TxData(tag, payload, done, fail, owner)
@@ -797,6 +849,12 @@ class TcpConn(BaseConn):
         self._rx_skip = 0
         self._sess_drop = False
         self._sess_pending = None
+        # Striped rx parser state is per-incarnation; the ASSEMBLIES
+        # (stripe_rx) survive -- the resumed sender re-dispatches
+        # un-SACKed sources and offset dedup keeps bytes exactly-once.
+        self._sdata = None
+        self._rx_stripe = None
+        self._rx_stripe_got = 0
         msg, self._rx_msg = self._rx_msg, None
         if msg is not None:
             with self.worker.lock:
@@ -862,6 +920,14 @@ class TcpConn(BaseConn):
                                0, self.tr_id + ":sup")
         self._ctr.frames_replayed += replayed
         self._sess_drain_waiting()  # trim may have freed journal room
+        if self.stripe is not None:
+            # Un-SACKed striped sources re-dispatch wholesale (chunk 0
+            # onward) across whatever lanes are live -- the per-message
+            # journal contract; rails re-attach as the client re-dials.
+            self.stripe.lanes = [ln for ln in self.stripe.lanes
+                                 if ln.conn is self or ln.alive]
+            self.rails = [r for r in self.rails if r.alive]
+            self.stripe.redispatch_all(fires)
         tr = getattr(self.worker, "_trace", None)
         if tr is not None:
             tr.rec(swtrace.EV_SESS_RESUME, 0, self.conn_id, replayed)
@@ -913,6 +979,12 @@ class TcpConn(BaseConn):
             take += offered
             if offered:
                 spans.append((item, offered))
+            if isinstance(item, StripeFeeder):
+                # A feeder refills in place after its chunk completes, so
+                # the byte budget must never span past it (the native
+                # pump's front-pop accounting has the same rule -- keep
+                # the two in lockstep).
+                break
             if item.switch_after:
                 break
             if offered < item.remaining:
@@ -930,6 +1002,14 @@ class TcpConn(BaseConn):
         blocked = False
         try:
             while self.tx:
+                if isinstance(self.tx[0], StripeFeeder) \
+                        and self.tx[0].remaining == 0:
+                    # A feeder that ran the group dry (remaining re-checks
+                    # the claim) must leave the queue, or the gather pump
+                    # -- which never batches past a feeder -- would stall
+                    # every frame queued behind it.
+                    self.tx.popleft()
+                    continue
                 if self._tx_via_ring:
                     item = self.tx[0]
                     if not item.write(self, fires):
@@ -1026,7 +1106,13 @@ class TcpConn(BaseConn):
             self.worker._update_conn_interest(self)
 
     def has_unfinished_data_tx(self) -> bool:
-        return any(isinstance(it, TxData) and not (it.off >= it.total) for it in self.tx)
+        for it in self.tx:
+            if isinstance(it, TxData) and it.off < it.total:
+                return True
+            if isinstance(it, StripeFeeder) \
+                    and getattr(it, "src", None) is not None:
+                return True
+        return False
 
     # ------------------------------------------------------------------ rx
     def _rx_read(self, target) -> int:
@@ -1112,6 +1198,71 @@ class TcpConn(BaseConn):
                     self.worker._conn_broken(self, fires)
                     return
                 self._rx_skip -= n
+                continue
+            if self._sdata is not None:
+                # Striped-chunk sub-header (24 bytes: msg id, offset,
+                # total) accumulating on this rail.
+                stag, sub, got, blen = self._sdata
+                try:
+                    n = self._rx_read(memoryview(sub)[got:])
+                except BlockingIOError:
+                    return
+                except (ConnectionResetError, OSError):
+                    self.worker._conn_broken(self, fires)
+                    return
+                if n == 0:
+                    self.worker._conn_broken(self, fires)
+                    return
+                got += n
+                if got < len(sub):
+                    self._sdata = (stag, sub, got, blen)
+                    continue
+                self._sdata = None
+                msg_id, off, total = frames.SDATA_SUB.unpack(sub)
+                chunk_len = blen - frames.SDATA_SUB_SIZE
+                rx = self._stripe_rx_tbl()
+                asm = rx.chunk_start(stag, msg_id, off, total, chunk_len,
+                                     fires)
+                if asm is None:
+                    # Duplicate offset or already-completed message
+                    # (rail-death resend / session replay): drain the
+                    # chunk, re-SACK completed ids so the sender stops.
+                    self._rx_skip = chunk_len
+                    if msg_id in rx.done_ids:
+                        rx.sack(self, msg_id, total, fires)
+                    continue
+                self._rx_stripe = (asm, off, chunk_len)
+                self._rx_stripe_got = 0
+                continue
+            if self._rx_stripe is not None:
+                asm, off, clen = self._rx_stripe
+                got = self._rx_stripe_got
+                remaining = clen - got
+                m = asm.msg
+                if m.discard or m.sink is None:
+                    if self._scratch is None:
+                        self._scratch = bytearray(RX_CHUNK)
+                    target = memoryview(self._scratch)[: min(remaining, RX_CHUNK)]
+                else:
+                    pos = off + got
+                    target = m.sink[pos: pos + min(remaining, RX_CHUNK)]
+                try:
+                    n = self._rx_read(target)
+                except BlockingIOError:
+                    return
+                except (ConnectionResetError, OSError):
+                    self.worker._conn_broken(self, fires)
+                    return
+                if n == 0:
+                    self.worker._conn_broken(self, fires)
+                    return
+                got += n
+                if got < clen:
+                    self._rx_stripe_got = got
+                    continue
+                self._rx_stripe = None
+                self._rx_stripe_got = 0
+                self._stripe_rx_tbl().chunk_done(self, asm, off, clen, fires)
                 continue
             m = self._rx_msg
             if m is not None:
@@ -1241,6 +1392,17 @@ class TcpConn(BaseConn):
                     self.sess.expired = True
                     getattr(self.worker, "_sessions", {}).pop(
                         self.sess.sid, None)
+            elif ftype == frames.T_SDATA:
+                # Striped chunk (DESIGN.md §17): the 24-byte sub-header
+                # follows; a body shorter than it is a protocol violation.
+                if b < frames.SDATA_SUB_SIZE:
+                    self.worker._conn_broken(self, fires)
+                    return
+                self._sdata = (a, bytearray(frames.SDATA_SUB_SIZE), 0, b)
+            elif ftype == frames.T_SACK:
+                root = self.stripe_root()
+                if root.stripe is not None:
+                    root.stripe.on_sack(a, fires)
             elif ftype == frames.T_PING:
                 # Liveness probe: answer immediately.  _rx_read already
                 # refreshed last_rx, so receiving PINGs also proves the
@@ -1280,6 +1442,12 @@ class TcpConn(BaseConn):
             if count and len(fires) > before:
                 self._ctr.ops_cancelled += 1
         self.tx.clear()
+        if self.stripe is not None:
+            # Primary terminal teardown: un-SACKed striped sources take
+            # the same fate as queued sends (counts ops_cancelled).
+            self.stripe.cancel_all(fires, reason)
+        if self.stripe_rx is not None:
+            self.stripe_rx.purge()
 
     def close(self, fires: list) -> None:
         """Close at local shutdown.

@@ -44,6 +44,7 @@ from ..errors import (
 )
 from . import fabric, frames, state, swtrace, telemetry
 from .conn import InprocConn, TcpConn
+from .lane import StripeSource
 from .session import SessionState
 from .endpoint import ServerEndpoint
 from .matching import PostedRecv, TagMatcher
@@ -69,12 +70,17 @@ class FlushRec:
     ``ucp_ep_flush_nbx`` completion (reference: src/bindings/main.cpp:432,1202).
     """
 
-    __slots__ = ("done", "fail", "waits", "completed")
+    __slots__ = ("done", "fail", "waits", "stripe_waits", "completed")
 
     def __init__(self, done, fail):
         self.done = done
         self.fail = fail
         self.waits: dict = {}  # conn -> seq
+        # Striped delivery rides SACKs, not per-rail FLUSH frames (rails
+        # carry only chunk traffic): the barrier additionally waits until
+        # every striped source submitted before it (msg_id <= watermark)
+        # is SACKed (DESIGN.md §17).
+        self.stripe_waits: dict = {}  # primary conn -> msg_id watermark
         self.completed = False
 
 
@@ -545,6 +551,9 @@ class Worker:
         withdrawn cleanly; one already partially on the wire cannot be
         unsent without corrupting the frame stream, so the conn is torn
         down (the UCX endpoint-error analogue)."""
+        if isinstance(item, StripeSource):
+            self._expire_stripe(conn, item, fires)
+            return
         started = False
         with self.lock:
             if item.local_done:
@@ -581,6 +590,25 @@ class Worker:
         if item.fail is not None:
             fires.append(lambda f=item.fail: f(REASON_TIMEOUT))
         if started:
+            self._conn_broken(conn, fires)
+
+    def _expire_stripe(self, conn, src, fires) -> None:
+        """Deadline on a striped send (core/lane.py): an unstarted source
+        withdraws cleanly; a started one has chunks promised on the wire,
+        so the whole rail group resets -- unless a live session owns it
+        (the per-message journal delivers it late, like any sequenced
+        frame)."""
+        with self.lock:
+            if src.sacked or src.failed or src.local_done:
+                return
+            sess = getattr(conn, "sess", None)
+            if src.started() and sess is not None and not sess.expired:
+                return  # promised: re-dispatch at resume completes it late
+        grp = getattr(conn, "stripe", None)
+        if grp is None:
+            return
+        self.counters.ops_timed_out += 1
+        if grp.expire(src, fires, REASON_TIMEOUT):
             self._conn_broken(conn, fires)
 
     def _expire_flush(self, rec, fires) -> None:
@@ -679,6 +707,10 @@ class Worker:
                      timeout: Optional[float] = None) -> None:
         with self.lock:
             candidates = conns if conns is not None else list(self.conns.values())
+        # Secondary rails are never flush targets: they carry only chunk
+        # traffic, and striped delivery is covered by the SACK waits below.
+        candidates = [c for c in candidates
+                      if getattr(c, "rail_parent", None) is None]
         # A dead connection with unacknowledged tagged data means the barrier
         # cannot truthfully complete: fail like a send on a dead endpoint
         # would, instead of passing vacuously.
@@ -692,6 +724,9 @@ class Worker:
         rec = FlushRec(done, fail)
         for c in targets:
             rec.waits[c] = c.alloc_flush_seq()
+            grp = getattr(c, "stripe", None)
+            if grp is not None and grp.has_unsacked(grp.next_msg_id - 1):
+                rec.stripe_waits[c] = grp.next_msg_id - 1
         self.flush_records.append(rec)
         for c in targets:
             c.send_flush(rec.waits[c], fires)
@@ -706,11 +741,21 @@ class Worker:
         for rec in list(self.flush_records):
             self._try_complete_flush(rec, fires)
 
+    def _on_stripe_sack(self, conn, fires) -> None:
+        """A striped source was SACKed: barriers waiting on it may now
+        complete (core/lane.py RailGroup.on_sack)."""
+        for rec in list(self.flush_records):
+            self._try_complete_flush(rec, fires)
+
     def _try_complete_flush(self, rec: FlushRec, fires) -> None:
         if rec.completed:
             return
         pending = [c for c, s in rec.waits.items() if c.flush_acked < s]
         dead = [c for c in pending if not c.alive]
+        for c, watermark in rec.stripe_waits.items():
+            grp = getattr(c, "stripe", None)
+            if grp is not None and grp.has_unsacked(watermark):
+                (pending if c.alive else dead).append(c)
         if dead:
             rec.completed = True
             if rec in self.flush_records:
@@ -802,6 +847,18 @@ class Worker:
                     stranded = msg.posted
                     msg.posted = None  # mark_dead's purge drops the partial
         conn.mark_dead(fires)
+        root = getattr(conn, "rail_parent", None)
+        if root is not None:
+            # A secondary lane died: the endpoint survives.  Its
+            # claimed-but-unacked chunks re-queue onto the surviving
+            # lanes (core/lane.py rail_lost; ``rail_resteals``).
+            root.rails = [r for r in root.rails if r is not conn]
+            if root.alive and root.stripe is not None:
+                root.stripe.rail_lost(conn, fires)
+        for r in list(getattr(conn, "rails", ())):
+            # The primary died terminally: its rails are meaningless.
+            if r.alive:
+                self._conn_broken(r, fires)
         if ka_live:
             reason = REASON_NOT_CONNECTED + " (peer lost; liveness detection active)"
             if stranded is not None and stranded.fail is not None:
@@ -849,6 +906,12 @@ class Worker:
             "starway: conn %s lost; session %s suspended (grace %.3gs)",
             conn.conn_id, conn.sess.sid[:8], conn.sess.grace)
         conn.suspend(fires)
+        for r in list(getattr(conn, "rails", ())):
+            # Rails are per-incarnation transports (like sm rings): the
+            # resumed client re-dials them; un-SACKed striped sources
+            # re-dispatch wholesale at resume (journal per-message).
+            if r.alive:
+                self._conn_broken(r, fires)
         self._add_timer(conn.sess.grace,
                         lambda fires, c=conn: self._sess_check_grace(c, fires))
         if self.kind == "client":
@@ -1072,6 +1135,12 @@ class ClientWorker(Worker):
             if self._trace is not None:
                 tr_offer = uuid.uuid4().hex[:16]
                 extra["tr"] = tr_offer
+            rails_n = config.stripe_rails()
+            if rails_n > 1:
+                # Multi-rail striping offer (DESIGN.md §17): a capable
+                # acceptor confirms "rails": "ok" and we dial the extra
+                # lanes right after the primary handshake.
+                extra["rails"] = str(rails_n)
             if sess_on:
                 # Stable session id + epoch 0 (the acceptor assigns the
                 # real epoch); sess_ack is our cumulative rx seq (0 new).
@@ -1105,6 +1174,7 @@ class ClientWorker(Worker):
         conn.peer_name = ack.get("worker_id", "")
         conn.devpull_ok = ack.get("devpull") == "ok"
         conn.ka_ok = ack.get("ka") == "ok"
+        conn.rails_ok = rails_n > 1 and ack.get("rails") == "ok"
         if tr_offer and ack.get("tr") == "ok":
             conn.tr_id = tr_offer
         if sess_on and ack.get("sess") == "ok":
@@ -1123,6 +1193,8 @@ class ClientWorker(Worker):
                 self.status = state.RUNNING
         self._register_conn_io(conn)
         fabric.register_worker(self)
+        if conn.rails_ok:
+            self._dial_rails(conn, addr, port, rails_n - 1)
         if self._trace is not None:
             self._trace.rec(swtrace.EV_CONN_UP, 0, conn.conn_id)
         if conn.tr_id:
@@ -1136,6 +1208,50 @@ class ClientWorker(Worker):
         if cb is not None:
             _run_fires([lambda: cb("")])
         return True
+
+    # --------------------------------------------------------------- rails
+    def _dial_rails(self, primary, addr: str, port: int, count: int) -> None:
+        """Open ``count`` secondary lanes to the accepted endpoint
+        (DESIGN.md §17).  Blocking dials on the engine thread, like the
+        primary handshake; a failed rail is skipped -- striping simply
+        runs over fewer lanes."""
+        timeout = self._connect_timeout or config.connect_timeout()
+        fires: list = []
+        for i in range(count):
+            sock = None
+            try:
+                sock = socket.create_connection((addr, port), timeout=timeout)
+                sock.settimeout(timeout)
+                extra = {"rail_of": self.worker_id, "rail_idx": str(i + 1),
+                         "ka": "ok"}
+                sock.sendall(frames.pack_hello(self.worker_id, "socket",
+                                               self.name, extra))
+                hdr = _read_exact(sock, frames.HEADER_SIZE)
+                ftype, _, blen = frames.unpack_header(hdr)
+                if ftype != frames.T_HELLO_ACK:
+                    raise ConnectionError("unexpected frame during rail handshake")
+                ack = frames.unpack_json_body(_read_exact(sock, blen))
+                if ack.get("rail") != "ok":
+                    raise ConnectionError("peer refused rail attach")
+            except Exception as e:
+                logger.warning("starway: rail %d dial failed (%s); striping "
+                               "continues over fewer lanes", i + 1, e)
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                continue
+            rail = TcpConn(self, sock, "socket", handshaken=True)
+            rail.peer_name = primary.peer_name
+            rail.ka_ok = ack.get("ka") == "ok"
+            primary.attach_rail(rail, fires)
+            with self.lock:
+                self.conns[rail.conn_id] = rail
+            self._register_conn_io(rail)
+            if self._trace is not None:
+                self._trace.rec(swtrace.EV_CONN_UP, 0, rail.conn_id)
+        _run_fires(fires)
 
     # ------------------------------------------------------ session redial
     def _sess_redial(self, conn, fires) -> None:
@@ -1175,6 +1291,11 @@ class ClientWorker(Worker):
             self._sess_expire(conn, fires)
             return
         conn.resume(sock, self._sess_int(ack.get("sess_ack", "0")), fires)
+        if conn.rails_ok:
+            # Rails are per-incarnation: re-dial them now that the
+            # session is back (striped sources already re-dispatched on
+            # the primary; new lanes start stealing as they attach).
+            self._dial_rails(conn, addr, port, config.stripe_rails() - 1)
 
     def _sess_dial(self, addr: str, port: int, sess) -> tuple:
         """One blocking resume dial + handshake (bounded by the connect
@@ -1315,6 +1436,12 @@ class ServerWorker(Worker):
             conn.local_port = conn.remote_port = 0
         conn.handshaken = True
         self._half_open.discard(conn)
+        if info.get("rail_of"):
+            # Secondary-lane attach (DESIGN.md §17): adopt the conn into
+            # the existing endpoint's rail set -- no new ServerEndpoint,
+            # no accept callback, no sm/session negotiation.
+            self._on_rail_hello(conn, str(info["rail_of"]), info, fires)
+            return
         # Resilient-session handshake (config.py STARWAY_SESSION): a
         # resume dial adopts the new socket into the suspended conn; a
         # fresh offer registers a new session.  Session conns never take
@@ -1355,6 +1482,11 @@ class ServerWorker(Worker):
             # must PONG (activation stays per-process via STARWAY_KEEPALIVE).
             conn.ka_ok = True
             ack_extra["ka"] = "ok"
+        if info.get("rails"):
+            # Multi-rail striping capability: the connector will dial the
+            # extra lanes (rail_of) right after this ACK.
+            conn.rails_ok = True
+            ack_extra["rails"] = "ok"
         if self._trace is not None and info.get("tr"):
             # swscope stitching: adopt the connector's trace-conn id so
             # both rings tag this conn's EV_E2E events identically.
@@ -1377,6 +1509,38 @@ class ServerWorker(Worker):
             self._trace.rec(swtrace.EV_CONN_UP, 0, conn.conn_id)
         if self.accept_cb is not None:
             fires.append(lambda ep=ep: self.accept_cb(ep))
+
+    def _on_rail_hello(self, conn, rail_of: str, info, fires) -> None:
+        """Attach an accepted conn as a secondary lane of the endpoint
+        whose peer worker id is ``rail_of`` (the primary handshake
+        confirmed ``"rails": "ok"`` moments earlier)."""
+        primary = None
+        with self.lock:
+            for c in self.conns.values():
+                if (c.kind == "tcp" and c.alive and c.handshaken
+                        and c.peer_name == rail_of
+                        and getattr(c, "rail_parent", None) is None):
+                    primary = c
+                    break
+        if primary is None:
+            # Raced the endpoint's death (or a bogus attach): answer
+            # without "rail": "ok"; the dialer drops the socket.
+            conn.send_ctl(frames.pack_hello_ack(self.worker_id, None), fires)
+            return
+        ack_extra = {"rail": "ok"}
+        if info.get("ka") == "ok":
+            conn.ka_ok = True
+            ack_extra["ka"] = "ok"
+        with self.lock:
+            self.conns[conn.conn_id] = conn
+        # ACK first: attach_rail may dispatch a feeder and kick TX at
+        # once (mid-stripe join), and SDATA bytes ahead of the HELLO_ACK
+        # would make the dialer reject the rail (native on_rail_hello
+        # has the same order).
+        conn.send_ctl(frames.pack_hello_ack(self.worker_id, ack_extra), fires)
+        primary.attach_rail(conn, fires)
+        if self._trace is not None:
+            self._trace.rec(swtrace.EV_CONN_UP, 0, conn.conn_id)
 
     def _sess_hello(self, conn, info, fires) -> bool:
         """Session half of the accept handshake.  Returns True when this

@@ -28,6 +28,8 @@ PONG      echoed PING tx time          responder tx time (ns)
 SEQ       next session frame's seq     0
 ACK       cumulative received seq      0
 BYE       0                            0
+SDATA     sender tag                   24-byte stripe sub-header + chunk
+SACK      striped message id           echoed message total (bytes)
 ========= ============================ ======================================
 
 PING / PONG are the *negotiated* peer-liveness probe (``"ka": "ok"``
@@ -119,6 +121,27 @@ contract immediately -- without it, EOF is indistinguishable from a
 crash and the survivor would suspend for the full grace window.  A lost
 BYE only costs the peer that grace-expiry fallback.  See DESIGN.md §14.
 
+SDATA / SACK are the *negotiated* multi-rail striping plane (DESIGN.md
+§17).  A connector started with ``STARWAY_RAILS=N`` offers
+``"rails": "<N>"`` in the primary HELLO; a striping-capable acceptor
+confirms ``"rails": "ok"`` and the connector dials N-1 extra TCP conns
+whose HELLO carries ``"rail_of": "<primary worker_id>"`` -- the acceptor
+attaches each to the existing endpoint (confirming ``"rail": "ok"``)
+instead of creating a new one.  A send at or above
+``STARWAY_STRIPE_THRESHOLD`` on a railed conn is then split at
+``STARWAY_STRIPE_CHUNK`` granularity and each chunk travels as one SDATA
+frame on whichever rail claims it first (completion-driven work
+stealing): header ``a`` = sender tag, ``b`` = body length, and the body
+opens with the 24-byte little-endian sub-header ``u64 msg_id, u64
+offset, u64 total`` followed by the chunk bytes.  The receiver
+reassembles by offset into one matcher message keyed by (rail group,
+msg_id), drops duplicate offsets (chunks are idempotent, which is what
+makes rail-death redistribution and session replay exactly-once), and
+answers SACK (``a`` = msg_id, ``b`` = total) when the last byte lands --
+the sender's signal to release the pinned payload.  Old peers never
+negotiate ``rails`` and never see either frame; sub-threshold sends ride
+ordinary DATA frames on the primary rail even when striping is on.
+
 FLUSH / FLUSH_ACK implement the delivery barrier: because the byte stream is
 processed in order, a FLUSH_ACK for sequence *n* proves every DATA payload
 enqueued before flush *n* has been fully ingested by the peer's matching
@@ -145,6 +168,15 @@ T_PONG = 8
 T_SEQ = 9
 T_ACK = 10
 T_BYE = 11
+T_SDATA = 12
+T_SACK = 13
+
+# Striped-DATA sub-header (DESIGN.md §17): u64 msg_id, u64 offset,
+# u64 total -- little-endian, leading every SDATA body.  The 24-byte size
+# is cross-engine contract surface (SDATA_SUB_SIZE in sw_engine.cpp,
+# machine-checked by `python -m starway_tpu.analysis`).
+SDATA_SUB = struct.Struct("<QQQ")
+SDATA_SUB_SIZE = SDATA_SUB.size  # 24
 
 
 def pack_header(ftype: int, a: int, b: int) -> bytes:
@@ -216,6 +248,17 @@ def pack_ack(cum_seq: int) -> bytes:
 
 def pack_bye() -> bytes:
     return pack_header(T_BYE, 0, 0)
+
+
+def pack_sdata_header(tag: int, msg_id: int, offset: int, total: int,
+                      chunk_len: int) -> bytes:
+    """Header + sub-header of one striped chunk (payload bytes follow)."""
+    return (pack_header(T_SDATA, tag, SDATA_SUB_SIZE + chunk_len)
+            + SDATA_SUB.pack(msg_id, offset, total))
+
+
+def pack_sack(msg_id: int, total: int) -> bytes:
+    return pack_header(T_SACK, msg_id, total)
 
 
 def pack_devpull(tag: int, desc: dict) -> bytes:

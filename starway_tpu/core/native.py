@@ -371,6 +371,12 @@ class NativeConn:
             self._devpull = bool(self._info().get("devpull", 0))
         return self._devpull
 
+    @property
+    def rail_count(self) -> int:
+        """Secondary lanes attached to this (primary) conn (DESIGN.md
+        §17); live value, not memoized -- rails can die and re-attach."""
+        return int(self._info().get("rails", 0))
+
 
 # --------------------------------------------------------------- workers
 

@@ -116,6 +116,9 @@ COUNTER_NAMES = (
     "dup_frames_dropped", # duplicate-seq frames dropped by the receiver
     "acks_tx",            # cumulative session ACK frames sent
     "acks_rx",            # cumulative session ACK frames received
+    "stripe_chunks_tx",   # striped chunks fully handed to a lane (§17)
+    "stripe_chunks_rx",   # striped chunks ingested into an assembly
+    "rail_resteals",      # chunks re-queued off a dead rail onto survivors
 )
 
 

@@ -63,6 +63,9 @@ GAUGE_NAMES = (
     "inflight_recvs",   # inbound payload streaming in + unresolved pulls
     "journal_bytes",    # session replay-journal residency (DESIGN.md §14)
     "journal_frames",   # journaled-but-unacked frames
+    "stripe_pending",   # striped chunks assigned to this lane but not yet
+    #                     fully written (primary rows add undisbursed
+    #                     chunks; DESIGN.md §17 rail balance)
 )
 
 
@@ -109,6 +112,15 @@ def conn_gauges(conn) -> dict:
         if sess is not None:
             gauges["journal_bytes"] = int(sess.journal_bytes)
             gauges["journal_frames"] = len(sess.journal)
+        from .lane import StripeFeeder  # local, like TxCtl above
+
+        pending = sum(1 for i in items
+                      if isinstance(i, StripeFeeder) and i.src is not None)
+        grp = getattr(conn, "stripe", None)
+        if grp is not None:
+            pending += sum(len(s.pending) for s in grp.by_id.values()
+                           if not s.sacked and not s.failed)
+        gauges["stripe_pending"] = pending
     except Exception:
         pass  # a conn torn down mid-snapshot yields a partial sample
     return gauges

@@ -894,8 +894,16 @@ def send_device(worker, conn, buffer, tag, done, fail):
     # host snapshot below instead of the chunked pipeline.
     journaled = (config.session_enabled() if conn is None
                  else getattr(conn, "sess", None) is not None)
+    # Multi-rail striping (DESIGN.md §17) needs a flat host view -- chunks
+    # are random-offset slices, and the §12 lazy-chunked pipeline stages
+    # strictly in order.  A stripe-eligible device send therefore takes
+    # the full host snapshot; the stripe scheduler's chunk-level dispatch
+    # then supplies the transport overlap the pipeline would have.
+    stripe_thr = config.stripe_threshold()
+    striped = (stripe_thr > 0 and payload.nbytes >= stripe_thr
+               and bool(getattr(conn, "rails", None)))
     if (getattr(worker, "supports_chunked_tx", False)
-            and not journaled
+            and not journaled and not striped
             and payload.nbytes <= config.rndv_threshold()):
         # Framed-stream staging pipelines: the TX pump pulls host chunks
         # incrementally so the D2H of chunk k+1 overlaps the write of

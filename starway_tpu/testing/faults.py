@@ -82,7 +82,8 @@ MODES = ("forward", "delay", "drop", "truncate", "blackhole", "duplicate",
 # to the frame it announces -- duplicate/reorder treat the pair as one unit.
 _HDR = 17
 _T_SEQ = 9
-_BODY_TYPES = frozenset((1, 2, 3, 6))  # HELLO, HELLO_ACK, DATA, DEVPULL
+_T_SDATA = 12  # striped chunk: self-describing, dup/reorder-eligible
+_BODY_TYPES = frozenset((1, 2, 3, 6, 12))  # HELLO, HELLO_ACK, DATA, DEVPULL, SDATA
 
 
 class _ConnPair:
@@ -381,6 +382,12 @@ class FaultProxy:
                 if sequenced:
                     unit = held_seq + unit
                     held_seq = None
+                elif ftype == _T_SDATA:
+                    # Striped chunks are offset-addressed and idempotent
+                    # (DESIGN.md §17): dup/reorder-eligible without a
+                    # T_SEQ prefix -- the receiver's offset dedup is what
+                    # these modes exercise on railed conns.
+                    sequenced = True
                 out = unit
                 past = self._c2s_bytes >= self.limit_bytes
                 if sequenced and past and self.mode == "duplicate":

@@ -164,7 +164,10 @@ def chrome_events(label: str, events: Iterable, pid: int,
         else:  # recv_match, conn churn, e2e, clock, anything future
             if e2e_out is not None and ev == swtrace.EV_E2E:
                 tcid, _, direction = reason.rpartition(":")
-                if tcid and direction in ("tx", "rx"):
+                # "sx"/"sr" are the striped-message markers (DESIGN.md
+                # §17): one per message on the primary, ordinal = msg id,
+                # so the pair survives chunks landing on many rails.
+                if tcid and direction in ("tx", "rx", "sx", "sr"):
                     e2e_out.append((tcid, direction, int(tag), ts,
                                     tid_of(conn), nbytes))
             out.append({"ph": "i", "name": ev, "ts": ts, "pid": pid,
@@ -328,9 +331,12 @@ def merge_chrome(named_dumps: list) -> dict:
     wire_lat: list = []
     wire_bytes = 0
     for tcid, dirs in sorted(e2e.items()):
-        for tx_pid, txs in sorted(dirs.get("tx", {}).items()):
+      # Stream ordinals pair tx<->rx; striped msg-id ordinals pair the
+      # sx<->sr markers -- independent namespaces on the same trace conn.
+      for tx_dir, rx_dir in (("tx", "rx"), ("sx", "sr")):
+        for tx_pid, txs in sorted(dirs.get(tx_dir, {}).items()):
             rxs: dict = {}  # ordinal -> (ts_us, rx_pid, tid)
-            for rx_pid, m in dirs.get("rx", {}).items():
+            for rx_pid, m in dirs.get(rx_dir, {}).items():
                 if rx_pid != tx_pid:  # never pair an end with itself
                     for ordinal, (ts_us, tid, _nb) in m.items():
                         rxs[ordinal] = (ts_us, rx_pid, tid)

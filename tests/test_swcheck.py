@@ -189,8 +189,8 @@ def test_garbled_doc_table(tmp_path):
 def test_version_drift(tmp_path):
     root = _seed(tmp_path)
     _edit(root, "native/sw_engine.cpp",
-          'return "starway-native-6"', 'return "starway-native-7"')
-    _assert_caught(root, "contract-version", "starway-native-7", "sw_engine.h")
+          'return "starway-native-7"', 'return "starway-native-8"')
+    _assert_caught(root, "contract-version", "starway-native-8", "sw_engine.h")
 
 
 def test_unmarked_multi_gib_test(tmp_path):
@@ -993,3 +993,65 @@ def test_cli_exit_codes(tmp_path):
     assert main(["--root", str(root), "contract"]) == 0  # pass selection
     with pytest.raises(SystemExit):
         main(["--root", str(root), "nonsense-pass"])
+
+
+# -------------------------------------------- ISSUE 8: stripe contract
+
+
+def test_bumped_sdata_frame_constant(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/frames.py", "T_SDATA = 12", "T_SDATA = 14")
+    _assert_caught(root, "contract-frames", "T_SDATA", "frames.py")
+
+
+def test_changed_sdata_subheader_layout(tmp_path):
+    # The 24-byte stripe sub-header is wire format: shrinking the Python
+    # struct must diff against the native SDATA_SUB_SIZE constexpr.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/frames.py",
+          'SDATA_SUB = struct.Struct("<QQQ")',
+          'SDATA_SUB = struct.Struct("<QQ")')
+    _assert_caught(root, "contract-header", "SDATA_SUB", "frames.py")
+
+
+def test_rails_handshake_key_dropped(tmp_path):
+    # Deleting the rails negotiation from one engine only must fire, even
+    # with the key surviving in comments (code-literal search only).
+    root = _seed(tmp_path)
+    p = root / "starway_tpu" / "core" / "engine.py"
+    p.write_text(p.read_text().replace('"rails"', '"railx"')
+                 + '\n# the "rails" key lives only in this comment now\n')
+    _assert_caught(root, "contract-handshake", '"rails"', "engine.py")
+    root2 = _seed(tmp_path / "two")
+    p2 = root2 / "native" / "sw_engine.cpp"
+    p2.write_text(p2.read_text().replace('"rail_of"', '"rail_xx"'))
+    _assert_caught(root2, "contract-handshake", '"rail_of"', "sw_engine.cpp")
+
+
+def test_stripe_counter_dropped_from_native(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          '"stripe_chunks_tx",  "stripe_chunks_rx",',
+          '"stripe_chunks_tx_v2",  "stripe_chunks_rx",')
+    _assert_caught(root, "contract-trace", "stripe_chunks_tx_v2",
+                   "sw_engine.cpp")
+    _assert_caught(root, "contract-trace", "'stripe_chunks_tx'",
+                   "swtrace.py")
+
+
+def test_stripe_gauge_dropped_from_python(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/telemetry.py",
+          '"stripe_pending",', '')
+    _assert_caught(root, "contract-trace", "stripe_pending",
+                   "sw_engine.cpp")
+
+
+def test_sdata_dispatch_annotation_drift(tmp_path):
+    # Re-routing the native SDATA arm's annotated outcome must diff
+    # against the Python engine's extracted transition (proto-state).
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          "// swcheck: state(estab, SDATA, estab|down)",
+          "// swcheck: state(estab, SDATA, estab)")
+    _assert_caught(root, "proto-state", "SDATA", "conn.py")
