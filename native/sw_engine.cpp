@@ -88,7 +88,15 @@ constexpr uint8_t T_ACK = 10;     // session layer: cumulative received seq
 constexpr uint8_t T_BYE = 11;     // session layer: peer's clean local close
 constexpr uint8_t T_SDATA = 12;   // multi-rail striped chunk (DESIGN.md §17)
 constexpr uint8_t T_SACK = 13;    // striped-message assembly complete
+constexpr uint8_t T_CREDIT = 14;  // flow control: receiver window grant (§18)
+constexpr uint8_t T_RTS = 15;     // flow control: rendezvous announcement
+constexpr uint8_t T_CTS = 16;     // flow control: receiver pull grant
 constexpr size_t HEADER_SIZE = 17;
+// Rendezvous (RTS/CTS) msg-id namespace bit: fc ids carry the top bit so
+// they can never collide with stripe msg ids on a railed+fc conn (the
+// frames.py FC_MSG_BIT twin; both families share the receiver's assembly
+// table and completed-id LRU).
+constexpr uint64_t FC_MSG_BIT = 1ull << 63;
 // Striped-DATA sub-header: u64 msg_id, u64 offset, u64 total (LE) --
 // the core/frames.py SDATA_SUB twin, machine-checked by swcheck.
 constexpr size_t SDATA_SUB_SIZE = 24;
@@ -153,6 +161,7 @@ const char* kCounterNames[] = {
     "acks_tx",           "acks_rx",
     "stripe_chunks_tx",  "stripe_chunks_rx",
     "rail_resteals",
+    "sends_parked",      "sheds",
 };
 
 // swscope per-conn gauge vocabulary, same order as the values rendered by
@@ -167,6 +176,7 @@ const char* kGaugeNames[] = {
     "inflight_sends",  "inflight_recvs",
     "journal_bytes",   "journal_frames",
     "stripe_pending",
+    "unexp_bytes",     "credits_avail",
 };
 
 struct Counters {
@@ -183,6 +193,7 @@ struct Counters {
   std::atomic<uint64_t> acks_tx{0}, acks_rx{0};
   std::atomic<uint64_t> stripe_chunks_tx{0}, stripe_chunks_rx{0};
   std::atomic<uint64_t> rail_resteals{0};
+  std::atomic<uint64_t> sends_parked{0}, sheds{0};
 };
 
 inline void bump(std::atomic<uint64_t>& c, uint64_t n = 1) {
@@ -255,11 +266,12 @@ uint64_t now_ns() {
 }
 
 uint64_t rndv_threshold() {
-  static uint64_t v = [] {
-    const char* e = getenv("STARWAY_RNDV_THRESHOLD");
-    return e ? strtoull(e, nullptr, 10) : (uint64_t)(8u << 20);
-  }();
-  return v;
+  // Read per send like the Python engine's config.rndv_threshold() --
+  // the test matrix (and the §18 fc gate) flip it between workers, and
+  // a process-cached value would make the two engines disagree on the
+  // eager/rndv split for identical submissions.
+  const char* e = getenv("STARWAY_RNDV_THRESHOLD");
+  return e ? strtoull(e, nullptr, 10) : (uint64_t)(8u << 20);
 }
 
 // Per-attempt connect + handshake deadline (config.py STARWAY_CONNECT_TIMEOUT,
@@ -318,6 +330,21 @@ uint64_t stripe_threshold_env() {
   const char* e = getenv("STARWAY_STRIPE_THRESHOLD");
   uint64_t v = e ? strtoull(e, nullptr, 10) : 0;
   return v;  // 0 = striping off (seed parity)
+}
+
+// Receiver-driven flow control (config.py STARWAY_FC_WINDOW /
+// STARWAY_UNEXP_BYTES; DESIGN.md §18).  0 = off, seed parity.  Read per
+// handshake / per conn like the session knobs.
+uint64_t fc_window_env() {
+  const char* e = getenv("STARWAY_FC_WINDOW");
+  uint64_t v = e ? strtoull(e, nullptr, 10) : 0;
+  return v;
+}
+
+uint64_t unexp_cap_env() {
+  const char* e = getenv("STARWAY_UNEXP_BYTES");
+  uint64_t v = e ? strtoull(e, nullptr, 10) : 0;
+  return v;
 }
 
 uint64_t stripe_chunk_env() {
@@ -588,6 +615,18 @@ struct InboundMsg {
   // death, exactly like a complete staged message would.
   bool remote = false, remote_ready = false;
   uint64_t remote_id = 0, remote_conn = 0;
+  // §18 rendezvous (RTS/CTS) record: like a devpull descriptor, but the
+  // engine itself answers CTS and streams the payload (no embedder).
+  // rts_started = CTS issued (assembly registered).
+  bool rts = false, rts_started = false;
+  // §18 flow-control debt: a spilled unexpected message remembers its
+  // origin conn + incarnation generation + payload bytes so the grant
+  // returns the moment the memory is released (Matcher::fc_release).
+  uint64_t fc_conn = 0, fc_gen = 0, fc_bytes = 0;
+};
+
+struct FcGrant {
+  uint64_t conn_id = 0, gen = 0, bytes = 0;
 };
 
 struct Matcher {
@@ -598,6 +637,30 @@ struct Matcher {
   // starts.  Ring appends are lock-free data writes -- legal under mu.
   TraceRing* ring = nullptr;
   Counters* ctr = nullptr;
+  // §18 flow control: total spilled unexpected payload bytes (the
+  // STARWAY_UNEXP_BYTES cap surface) plus the grant/CTS work the engine
+  // thread drains each pass (conn TX is engine territory; matcher paths
+  // run under mu, possibly on app threads).
+  uint64_t unexp_bytes = 0;
+  std::vector<FcGrant> pending_grants;
+  std::vector<InboundMsg*> fc_cts;
+
+  void fc_track(InboundMsg* m, uint64_t conn_id, uint64_t gen, uint64_t n) {
+    m->fc_conn = conn_id;
+    m->fc_gen = gen;
+    m->fc_bytes = n;
+    unexp_bytes += n;
+  }
+
+  // The spilled message's bytes left the unexpected queue: queue the
+  // grant for the engine thread.  Idempotent; caller holds mu.
+  void fc_release(InboundMsg* m) {
+    if (!m->fc_bytes) return;
+    uint64_t n = m->fc_bytes;
+    m->fc_bytes = 0;
+    unexp_bytes = unexp_bytes > n ? unexp_bytes - n : 0;
+    pending_grants.push_back(FcGrant{m->fc_conn, m->fc_gen, n});
+  }
 
   void rec(const char* ev, uint64_t tag, uint64_t nbytes,
            const char* reason = nullptr) {
@@ -622,6 +685,29 @@ struct Matcher {
     for (auto it = unexpected.begin(); it != unexpected.end(); ++it) {
       InboundMsg* m = *it;
       if (!m->has_pr && !m->discard && tags_match(m->tag, pr_in.tag, pr_in.mask)) {
+        if (m->rts && !m->complete) {
+          // §18 rendezvous offer: keep the receive ATTACHED to the
+          // record (unlike the devpull claim, which surfaces to the
+          // embedder) and let the engine thread answer CTS.
+          unexpected.erase(it);
+          inflight.insert(m);
+          if (m->length > pr_in.cap) {
+            // Too-small receive: fail it now; the record still drains
+            // via CTS so the sender's pin and flush barriers release.
+            m->discard = true;
+            fc_cts.push_back(m);
+            rec(kEvOpFail, pr_in.tag, 0, kTruncated);
+            auto fail = pr_in.fail; auto ctx = pr_in.ctx;
+            fires.push_back([fail, ctx] { fail(ctx, kTruncated); });
+            return;
+          }
+          m->pr = pr_in;
+          m->pr.claimed = true;
+          m->has_pr = true;
+          fc_cts.push_back(m);
+          rec(kEvRecvMatch, m->tag, m->length);
+          return;
+        }
         if (m->remote) {
           // Descriptor record: consume it and report the claim to the
           // caller (which marshals it to the embedder).  Too-small
@@ -647,6 +733,7 @@ struct Matcher {
         }
         if (m->length > pr_in.cap) {
           unexpected.erase(it);
+          fc_release(m);
           if (!m->complete) { m->discard = true; } else { delete m; }
           rec(kEvOpFail, pr_in.tag, 0, kTruncated);
           auto fail = pr_in.fail; auto ctx = pr_in.ctx;
@@ -657,6 +744,7 @@ struct Matcher {
           memcpy(pr_in.buf, m->spill.data(), m->length);
           uint64_t t = m->tag, n = m->length;
           unexpected.erase(it);
+          fc_release(m);
           delete m;
           rec(kEvRecvMatch, t, n);
           rec(kEvRecvDone, t, n);
@@ -711,6 +799,13 @@ struct Matcher {
   // like complete staged messages do -- one contract with the Python
   // engine's peer-death sweep.
   void purge_remote_conn(uint64_t conn_id) {
+    // Scrub queued CTS work for the dead conn first: some of its records
+    // are deleted just below and fc_service must never chase them.
+    fc_cts.erase(std::remove_if(fc_cts.begin(), fc_cts.end(),
+                                [conn_id](InboundMsg* m) {
+                                  return m->remote_conn == conn_id;
+                                }),
+                 fc_cts.end());
     for (auto it = unexpected.begin(); it != unexpected.end();) {
       if ((*it)->remote && (*it)->remote_conn == conn_id && !(*it)->remote_ready) {
         delete *it;
@@ -775,6 +870,7 @@ struct Matcher {
         memcpy(m->pr.buf, m->spill.data(), m->length);
         for (auto it = unexpected.begin(); it != unexpected.end(); ++it)
           if (*it == m) { unexpected.erase(it); break; }
+        fc_release(m);
       }
       auto done = m->pr.done; auto ctx = m->pr.ctx;
       uint64_t t = m->tag, n = m->length;
@@ -847,13 +943,43 @@ struct Matcher {
       for (auto it = unexpected.begin(); it != unexpected.end(); ++it)
         if (*it == m) { unexpected.erase(it); break; }
       m->use_spill = false;
+      fc_release(m);
     }
+  }
+
+  // §18 rendezvous announcement arrived: match a posted receive (keep it
+  // attached -- the engine CTSes), or queue the record FIFO with staged
+  // traffic.  Returns true when the caller should CTS now (claimed, or
+  // matched-but-truncated and draining).
+  bool on_rts(InboundMsg* m, FireList& fires) {
+    for (auto it = posted.begin(); it != posted.end(); ++it) {
+      if (it->claimed || !tags_match(m->tag, it->tag, it->mask)) continue;
+      if (m->length > it->cap) {
+        auto fail = it->fail; auto ctx = it->ctx;
+        posted.erase(it);
+        rec(kEvOpFail, m->tag, m->length, kTruncated);
+        fires.push_back([fail, ctx] { fail(ctx, kTruncated); });
+        m->discard = true;
+        inflight.insert(m);
+        return true;  // drain-CTS: sender pin + flush must still release
+      }
+      m->pr = *it;
+      m->pr.claimed = true;
+      m->has_pr = true;
+      posted.erase(it);
+      inflight.insert(m);
+      rec(kEvRecvMatch, m->tag, m->length);
+      return true;
+    }
+    unexpected.push_back(m);
+    return false;
   }
 
   void purge_inflight(InboundMsg* m) {
     if (m->complete) return;
     m->discard = true;
     inflight.erase(m);
+    fc_release(m);
     if (!m->has_pr) {
       for (auto it = unexpected.begin(); it != unexpected.end(); ++it)
         if (*it == m) { unexpected.erase(it); break; }
@@ -884,6 +1010,9 @@ struct Matcher {
     inflight.clear();
     for (auto* m : unexpected) delete m;
     unexpected.clear();
+    unexp_bytes = 0;  // close wipes the queue; grants/CTS are moot
+    pending_grants.clear();
+    fc_cts.clear();
   }
 };
 
@@ -942,6 +1071,7 @@ struct TxItem {
   const uint8_t* payload = nullptr;
   uint64_t paylen = 0;
   uint64_t off = 0;
+  uint64_t tag = 0;  // data items only (the §18 RTS re-announce needs it)
   bool is_data = false;
   bool rndv = false;
   bool local_done = false;
@@ -1095,6 +1225,28 @@ struct Conn {
   uint64_t sdata_tag = 0, sdata_len = 0;
   StripeAsm* rx_stripe = nullptr;
   uint64_t rx_stripe_off = 0, rx_stripe_len = 0, rx_stripe_got = 0;
+  // --- §18 receiver-driven flow control (core/conn.py is the twin) ---
+  // Sender half: fc_window = the PEER's advertised budget, fc_credits
+  // the signed remainder (negative only via the one-oversized-frame
+  // admission), fc_waiting the unframed FIFO of parked sends, fc_rts
+  // the announced-but-unSACKed rendezvous sends (payload pinned until
+  // SACK).  Receiver half: fc_unexp = outstanding (un-granted) spill
+  // bytes, fc_rx_gen the incarnation generation orphaning stale grants
+  // across a resume, fc_rx the un-completed inbound RTS records.
+  bool fc_ok = false;
+  uint64_t fc_window = 0;
+  int64_t fc_credits = 0;
+  std::deque<TxRef> fc_waiting;
+  struct FcRts {
+    TxRef item;
+    bool announced = true;  // false once the CTS dispatched it into tx
+    uint64_t tag = 0;
+  };
+  std::unordered_map<uint64_t, FcRts> fc_rts;
+  uint64_t fc_next_msg = 1;
+  uint64_t fc_unexp = 0, fc_rx_gen = 0;
+  std::unordered_map<uint64_t, InboundMsg*> fc_rx;
+  uint64_t unexp_cap = 0;
 
   bool has_unfinished_data() const {
     for (auto& t : tx) {
@@ -1306,16 +1458,19 @@ struct Worker {
       // Striped path (DESIGN.md §17): chunks are idempotent and NOT
       // seq-framed even on session conns -- the group re-dispatches
       // un-SACKed sources wholesale at resume (journal per-message).
+      // Striped sends are exempt from the §18 credit window: like the
+      // RTS path they are SACK-terminated large transfers
+      // (stripe_threshold should sit at or above the rndv threshold
+      // when combining the two planes).
       stripe_submit(c, op, fires);
       return;
     }
-    c->dirty = true;
-    c->data_counter++;
     auto item = std::make_shared<TxItem>();
     item->header.resize(HEADER_SIZE);
     pack_header(item->header.data(), T_DATA, op.tag, op.len);
     item->payload = op.buf;
     item->paylen = op.len;
+    item->tag = op.tag;
     item->is_data = true;
     item->rndv = op.len > rndv_threshold();
     item->done = op.done;
@@ -1323,12 +1478,302 @@ struct Worker {
     item->ctx = op.ctx;
     item->release = op.release;
     item->release_ctx = op.release_ctx;
+    if (c->fc_ok) {
+      fc_send(c, item, fires);
+      return;
+    }
+    c->dirty = true;
+    c->data_counter++;
     if (c->sess) {
       sess_submit(c, item, fires);
       return;
     }
     c->tx.push_back(std::move(item));
     kick_tx(c, fires);
+  }
+
+  // -------------------------------------------------------- flow control
+  //
+  // Receiver-driven credit flow control + the RTS/CTS rendezvous path
+  // (DESIGN.md §18; core/conn.py carries the Python twin).  All fc state
+  // is engine-thread-owned; the matcher's pending_grants/fc_cts vectors
+  // (filled under mu, possibly from app threads) are drained by
+  // fc_service each loop pass.
+
+  // Debit the window, or refuse.  A fully-replenished (idle) window
+  // always admits one frame even when the payload exceeds it -- the §14
+  // journal-backpressure rule: a single oversized payload must block
+  // later sends, never deadlock itself.
+  static bool fc_admit(Conn* c, uint64_t n) {
+    if (c->fc_credits >= (int64_t)n ||
+        c->fc_credits >= (int64_t)c->fc_window) {
+      c->fc_credits -= (int64_t)n;
+      return true;
+    }
+    return false;
+  }
+
+  void fc_dispatch_eager(Conn* c, const TxRef& item, FireList& fires,
+                         bool kick = true) {
+    c->dirty = true;
+    c->data_counter++;
+    if (c->sess) {
+      sess_submit(c, item, fires);
+      return;
+    }
+    c->tx.push_back(item);
+    if (kick) kick_tx(c, fires);
+  }
+
+  // Announce a rendezvous send: the payload stays pinned here
+  // (hold_release, the journal-pin mechanism) and travels as ONE
+  // self-describing T_SDATA frame only after the receiver's CTS --
+  // large transfers never consume window and never spill.  The RTS ctl
+  // is per-incarnation (never seq-framed): a resume re-announces every
+  // unSACKed entry instead of replaying it.
+  void fc_rts_announce(Conn* c, const TxRef& item, FireList& fires) {
+    c->dirty = true;
+    c->data_counter++;
+    uint64_t mid = FC_MSG_BIT | c->fc_next_msg++;
+    item->header.resize(HEADER_SIZE + SDATA_SUB_SIZE);
+    pack_header(item->header.data(), T_SDATA, item->tag,
+                SDATA_SUB_SIZE + item->paylen);
+    uint64_t zero = 0;
+    memcpy(item->header.data() + HEADER_SIZE, &mid, 8);
+    memcpy(item->header.data() + HEADER_SIZE + 8, &zero, 8);
+    memcpy(item->header.data() + HEADER_SIZE + 16, &item->paylen, 8);
+    item->rndv = true;
+    item->hold_release = true;  // pinned until SACK (resend must be legal)
+    c->fc_rts[mid] = Conn::FcRts{item, true, item->tag};
+    std::string body = "{\"m\": " + std::to_string(mid) +
+                       ", \"n\": " + std::to_string(item->paylen) + "}";
+    conn_send_ctl(c, T_RTS, item->tag, body.size(), body, fires);
+  }
+
+  // send_data on an fc conn: gate eager sends on the peer's window,
+  // announce rendezvous sends via RTS.  Once anything is parked,
+  // EVERYTHING parks behind it -- FIFO arrival order at the receiver's
+  // matcher is part of the matching contract.
+  void fc_send(Conn* c, const TxRef& item, FireList& fires) {
+    if (!c->fc_waiting.empty()) {
+      c->fc_waiting.push_back(item);
+      bump(counters.sends_parked);
+      return;
+    }
+    if (item->rndv) {
+      fc_rts_announce(c, item, fires);
+      return;
+    }
+    if (!fc_admit(c, item->paylen)) {
+      c->fc_waiting.push_back(item);
+      bump(counters.sends_parked);
+      return;
+    }
+    fc_dispatch_eager(c, item, fires);
+  }
+
+  // Move parked sends into dispatch as grants restore the window (FIFO;
+  // rendezvous entries pass straight through to RTS).
+  void fc_drain_waiting(Conn* c, FireList& fires) {
+    bool moved = false;
+    while (!c->fc_waiting.empty()) {
+      TxRef item = c->fc_waiting.front();
+      if (item->local_done) {  // shed by a deadline while parked
+        c->fc_waiting.pop_front();
+        continue;
+      }
+      if (item->rndv) {
+        c->fc_waiting.pop_front();
+        fc_rts_announce(c, item, fires);
+        moved = true;
+        continue;
+      }
+      if (!fc_admit(c, item->paylen)) break;
+      c->fc_waiting.pop_front();
+      fc_dispatch_eager(c, item, fires, /*kick=*/false);
+      moved = true;
+    }
+    if (moved) kick_tx(c, fires);
+  }
+
+  // Peer returned window (T_CREDIT): replenish and drain parked sends.
+  // Clamped at the advertised window -- a wire-duplicated grant must
+  // never mint credit.
+  void fc_on_credit(Conn* c, uint64_t n, FireList& fires) {
+    if (!c->fc_ok) return;  // stray grant: old peers cannot send it
+    c->fc_credits += (int64_t)n;
+    if (c->fc_credits > (int64_t)c->fc_window)
+      c->fc_credits = (int64_t)c->fc_window;
+    fc_drain_waiting(c, fires);
+  }
+
+  // Receiver granted the rendezvous: dispatch the pinned payload as its
+  // pre-built T_SDATA frame.  A duplicate CTS (resume races) is ignored
+  // -- only an announced entry dispatches.
+  void fc_on_cts(Conn* c, uint64_t mid, FireList& fires) {
+    auto it = c->fc_rts.find(mid);
+    if (it == c->fc_rts.end() || !it->second.announced) return;
+    it->second.announced = false;
+    it->second.item->off = 0;
+    c->tx.push_back(it->second.item);
+    kick_tx(c, fires);
+  }
+
+  // True when this SACK settled a §18 rendezvous send: the entry (and
+  // with it the payload pin) drops; the op completed locally at first
+  // byte (rndv semantics).
+  bool fc_on_sack(Conn* c, uint64_t mid, FireList& fires) {
+    auto it = c->fc_rts.find(mid);
+    if (it == c->fc_rts.end()) return false;
+    fire_release(*it->second.item, fires, /*force=*/true);
+    c->fc_rts.erase(it);
+    return true;
+  }
+
+  // Fresh window per incarnation (DESIGN.md §18): stale debits and grant
+  // obligations die with the old transport.  Journal-replayed DATA
+  // frames re-debit the fresh window (their replay WILL arrive, and the
+  // receiver grants duplicates too -- conservation), unSACKed rendezvous
+  // sends re-announce, parked sends re-enter dispatch.
+  void fc_reset_resume(Conn* c, FireList& fires) {
+    c->fc_rx_gen++;
+    c->fc_unexp = 0;
+    c->fc_credits = (int64_t)c->fc_window;
+    if (c->sess) {
+      // Journal-replayed frames AND journal-backpressure-parked frames
+      // (sess->waiting) both ship in this incarnation and were admitted
+      // pre-suspend: re-debit both, or their wire bytes would
+      // oversubscribe the fresh window.
+      for (auto& item : c->sess->journal)
+        if (item->is_data && item->paylen)
+          c->fc_credits -= (int64_t)item->paylen;
+      for (auto& item : c->sess->waiting)
+        if (item->is_data && item->paylen)
+          c->fc_credits -= (int64_t)item->paylen;
+    }
+    for (auto& [mid, ent] : c->fc_rts) {
+      ent.announced = true;
+      ent.item->off = 0;
+      std::string body = "{\"m\": " + std::to_string(mid) +
+                         ", \"n\": " + std::to_string(ent.item->paylen) + "}";
+      conn_send_ctl(c, T_RTS, ent.tag, body.size(), body, fires);
+    }
+    fc_drain_waiting(c, fires);
+  }
+
+  // Terminal teardown sweep for fc state: cancel parked and announced
+  // sends exactly once (a CTS'd delivery item may also sit in tx --
+  // local_done dedupes) and release the pins.
+  void fc_cancel_terminal(Conn* c, FireList& fires, const char* reason) {
+    auto cancel_item = [&](const TxRef& item) {
+      if (item->is_data && !item->local_done && item->fail) {
+        item->local_done = true;
+        bump(counters.ops_cancelled);
+        auto fail = item->fail; auto ctx = item->ctx;
+        fires.push_back([fail, ctx, reason] { fail(ctx, reason); });
+      }
+      fire_release(*item, fires, /*force=*/true);
+    };
+    for (auto& item : c->fc_waiting) cancel_item(item);
+    c->fc_waiting.clear();
+    for (auto& [mid, ent] : c->fc_rts) cancel_item(ent.item);
+    c->fc_rts.clear();
+    c->fc_rx.clear();  // dedup index only; the matcher owns the records
+  }
+
+  // §18 rendezvous announcement arrived: register the offer with the
+  // matcher (flush deferral and force-start ride the devpull pending
+  // machinery); CTS goes out when a receive claims the record.
+  // swcheck: state(estab, RTS, estab)
+  void on_rts(Conn* c, uint64_t tag, const std::string& body,
+              FireList& fires) {
+    if (!c->fc_ok) return;  // never negotiated: drop
+    uint64_t mid = json_num_field(body, "m");
+    uint64_t total = json_num_field(body, "n");
+    if (!mid) return;
+    if (c->stripe_done.count(mid)) {
+      // Late re-announcement of a completed message: re-SACK so the
+      // sender releases its pin.
+      conn_send_ctl(c, T_SACK, mid, total, "", fires);
+      return;
+    }
+    auto known = c->fc_rx.find(mid);
+    if (known != c->fc_rx.end()) {
+      InboundMsg* m = known->second;
+      if (m->rts_started) {
+        // The CTS (or the delivery) died with an incarnation; the
+        // assembly survived (rts_started is set atomically with its
+        // registration) -- just re-CTS.
+        conn_send_ctl(c, T_CTS, mid, 0, "", fires);
+      } else if (m->has_pr || m->discard) {
+        // The CTS hop was consumed by a dead incarnation AFTER a claim
+        // (or drain) consumed the record: no future post_recv can
+        // re-fire it -- restart on the live conn.
+        fc_start_rx(c, m, fires);
+      }
+      return;
+    }
+    auto* m = new InboundMsg();
+    m->tag = tag;
+    m->length = total;
+    m->remote = true;
+    m->rts = true;
+    m->remote_id = mid;
+    m->remote_conn = c->id;
+    c->devpull_pending.insert(mid);  // flush barriers defer until resolved
+    bool cts_now;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      cts_now = matcher.on_rts(m, fires);
+    }
+    c->fc_rx[mid] = m;
+    if (cts_now) fc_start_rx(c, m, fires);
+  }
+
+  // Engine-thread half of the CTS: choose the sink, pre-register the
+  // assembly under the sender's msg id, answer CTS.  The T_SDATA
+  // delivery then streams through the ordinary stripe RX path.
+  void fc_start_rx(Conn* c, InboundMsg* m, FireList& fires) {
+    if (!c->alive || c->fd < 0 || m->rts_started) return;
+    m->rts_started = true;
+    if (!m->discard && !m->has_pr) {
+      // Force-started by a flush barrier before any receive matched:
+      // spill, like a drained devpull (exempt from the window -- the
+      // sender's flush asked for residency here).
+      m->use_spill = true;
+      m->spill.resize(m->length);
+    }
+    auto* a = new StripeAsm();
+    a->msg_id = m->remote_id;
+    a->tag = m->tag;
+    a->total = m->length;
+    a->msg = m;
+    c->stripe_asm[a->msg_id] = a;
+    conn_send_ctl(c, T_CTS, a->msg_id, 0, "", fires);
+  }
+
+  // Drain the matcher's queued fc work (grants from fc_release, CTS
+  // requests from app-thread claims) onto conn TX -- once per loop pass.
+  void fc_service(FireList& fires) {
+    std::vector<FcGrant> grants;
+    std::vector<InboundMsg*> cts;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      if (matcher.pending_grants.empty() && matcher.fc_cts.empty()) return;
+      grants.swap(matcher.pending_grants);
+      cts.swap(matcher.fc_cts);
+    }
+    for (auto& gr : grants) {
+      Conn* c = conn_by_id(gr.conn_id);
+      if (!c || gr.gen != c->fc_rx_gen) continue;
+      c->fc_unexp = c->fc_unexp > gr.bytes ? c->fc_unexp - gr.bytes : 0;
+      if (c->alive && c->fc_ok && c->fd >= 0)
+        conn_send_ctl(c, T_CREDIT, gr.bytes, 0, "", fires);
+    }
+    for (auto* m : cts) {
+      Conn* c = conn_by_id(m->remote_conn);
+      if (c) fc_start_rx(c, m, fires);
+    }
   }
 
   void conn_send_ctl(Conn* c, uint8_t type, uint64_t a, uint64_t b,
@@ -1657,6 +2102,11 @@ struct Worker {
     trace.rec(kEvSessResume, 0, c->id, replayed);
     fire_event("session-resume", c->id, fires);
     ep_add(fd, EPOLLIN, c);
+    if (c->fc_ok)
+      // Fresh credit window per incarnation; unSACKed rendezvous sends
+      // re-announce; parked sends re-enter dispatch (DESIGN.md §18).
+      // After ep_add: the drain may arm write interest.
+      fc_reset_resume(c, fires);
     stripe_redispatch(c, fires);
     kick_tx(c, fires);
   }
@@ -1674,6 +2124,7 @@ struct Worker {
     trace.rec(kEvSessExpire, 0, c->id, 0, kSessionExpired);
     fire_event("session-expired", c->id, fires);
     sess_cancel_terminal(c, fires, kSessionExpired);
+    fc_cancel_terminal(c, fires, kSessionExpired);
     if (c->alive) {
       c->alive = false;
       if (c->fd >= 0) {
@@ -1823,6 +2274,12 @@ struct Worker {
                         "\", \"sess_ack\": \"" + std::to_string(s->rx_cum) +
                         "\"";
     if (devpull_advertise) hello += ", \"devpull\": \"ok\"";
+    uint64_t fc_w = fc_window_env();
+    if (fc_w > 0)
+      // Fresh credit window per incarnation (DESIGN.md §18): both sides
+      // reset to their stored windows at resume; the key is
+      // re-advertised for wire-format consistency.
+      hello += ", \"fc\": \"" + std::to_string(fc_w) + "\"";
     hello += "}";
     return blocking_dial(hello, out_fd, out_ack);
   }
@@ -1858,7 +2315,14 @@ struct Worker {
           "\", \"sess\": \"ok\", \"sess_epoch\": \"" + existing->sess->epoch +
           "\", \"sess_ack\": \"" + std::to_string(existing->sess->rx_cum) +
           "\"" + (existing->ka_ok ? ", \"ka\": \"ok\"" : "") +
-          (existing->devpull_ok ? ", \"devpull\": \"ok\"" : "") + "}";
+          (existing->devpull_ok ? ", \"devpull\": \"ok\"" : "") +
+          (existing->fc_ok
+               ? ", \"fc\": \"" +
+                     std::to_string(fc_window_env() ? fc_window_env()
+                                                    : existing->fc_window) +
+                     "\""
+               : "") +
+          "}";
       sess_resume(existing, fd, peer_ack, ack, fires);
       return true;
     }
@@ -2215,6 +2679,19 @@ struct Worker {
     rail->rx_stripe_got = 0;
   }
 
+  // §18 rendezvous delivery completing: resolve the descriptor record
+  // BEFORE the matcher completion may free it -- deferred flush ACKs
+  // release, and the (now resident) message behaves like staged data.
+  void fc_rx_completing(Conn* root, StripeAsm* a, FireList& fires) {
+    auto it = root->fc_rx.find(a->msg_id);
+    if (it == root->fc_rx.end()) return;
+    InboundMsg* m = it->second;
+    root->fc_rx.erase(it);
+    m->remote = false;
+    m->rts = false;
+    devpull_resolve(root, a->msg_id, fires);
+  }
+
   void stripe_rx_chunk_done(Conn* rail, FireList& fires) {
     StripeAsm* a = rail->rx_stripe;
     uint64_t off = rail->rx_stripe_off, clen = rail->rx_stripe_len;
@@ -2252,6 +2729,7 @@ struct Worker {
       root->stripe_done.erase(root->stripe_done_fifo.front());
       root->stripe_done_fifo.pop_front();
     }
+    fc_rx_completing(root, a, fires);
     {
       std::lock_guard<std::mutex> g(mu);
       matcher.on_complete(m, fires);
@@ -2922,6 +3400,8 @@ struct Worker {
           on_devpull(c, ctl_a, body, fires);
           rx_e2e(c, body.size());
           sess_commit(c);
+        } else if (t == T_RTS) {
+          on_rts(c, ctl_a, body, fires);
         }
         // T_HELLO_ACK handled synchronously during client connect
         continue;
@@ -2935,16 +3415,39 @@ struct Worker {
       uint64_t a, b;
       unpack_header(c->hdr, &type, &a, &b);
       switch (type) {
-        // swcheck: state(estab, DATA, estab)
+        // swcheck: state(estab, DATA, estab|down)
         case T_DATA: {
           if (c->sess_drop) {
             c->sess_drop = false;
-            if (b) c->rx_skip = b;
+            if (b) {
+              c->rx_skip = b;
+              if (c->fc_ok)
+                // The dup was re-debited against the fresh window at
+                // the sender's resume: grant it back (no memory held
+                // -- credit conservation, DESIGN.md §18).
+                conn_send_ctl(c, T_CREDIT, b, 0, "", fires);
+            }
             break;
           }
+          bool spilled = false, overload = false;
           {
             std::lock_guard<std::mutex> g(mu);
             InboundMsg* m = matcher.on_start(a, b, fires);
+            spilled = b > 0 && m->use_spill && !m->has_pr && !m->discard;
+            // Tracked only when §18 is in play (fc negotiated or the
+            // cap armed): the seed path must not pay a pending-grant
+            // push per unexpected message.
+            if (spilled && (c->fc_ok || c->unexp_cap)) {
+              // Unexpected spill: charge this conn's window accounting;
+              // the matcher returns the grant when the bytes leave the
+              // queue (fc_release).
+              matcher.fc_track(m, c->id, c->fc_rx_gen, b);
+              c->fc_unexp += b;
+              // Per-conn cap: the offender is the conn whose own
+              // un-granted residency crossed the line (total bound =
+              // cap x live conns), never an innocent peer.
+              overload = c->unexp_cap && c->fc_unexp > c->unexp_cap;
+            }
             if (b == 0) {
               matcher.on_complete(m, fires);
             } else {
@@ -2954,9 +3457,23 @@ struct Worker {
               c->rx_msg_unowned = (a == Matcher::kProbeTag);
             }
           }
+          if (overload) {
+            // STARWAY_UNEXP_BYTES breaker: reset this conn instead of
+            // letting the process OOM (last resort for peers that
+            // never negotiated fc).
+            SW_DEBUG("unexpected-queue cap exceeded; resetting conn %llu",
+                     (unsigned long long)c->id);
+            conn_broken(c, fires);
+            return;
+          }
           if (b == 0) {
             rx_e2e(c, 0);
             sess_commit(c);
+          } else if (c->fc_ok && !spilled) {
+            // Matched at header (streams into the posted buffer) or
+            // probe-discarded: no unexpected memory is held, so the
+            // sender's debit returns immediately.
+            conn_send_ctl(c, T_CREDIT, b, 0, "", fires);
           }
           break;
         }
@@ -2972,6 +3489,11 @@ struct Worker {
             // the ACK until their pulls land (snapshot, so descriptors
             // arriving after the barrier cannot extend the wait).
             c->devpull_deferred.emplace_back(a, c->devpull_pending);
+            // Force-start any §18 rendezvous offer still waiting for a
+            // matching receive (spill) so the deferred ACK can resolve
+            // -- the Python engine's _force_start_pulls twin.
+            for (auto& [mid, m] : c->fc_rx)
+              if (!m->rts_started && !m->has_pr) fc_start_rx(c, m, fires);
           } else {
             conn_send_ctl(c, T_FLUSH_ACK, a, 0, "", fires,
                           /*switch_after=*/false, /*sess_frame=*/true);
@@ -3018,10 +3540,19 @@ struct Worker {
           break;
         // swcheck: state(estab, SACK, estab)
         case T_SACK: {
+          if (fc_on_sack(c, a, fires)) break;
           Conn* root = stripe_root(c);
           stripe_on_sack(root, a, fires);
           break;
         }
+        // swcheck: state(estab, CREDIT, estab)
+        case T_CREDIT:
+          fc_on_credit(c, a, fires);
+          break;
+        // swcheck: state(estab, CTS, estab)
+        case T_CTS:
+          fc_on_cts(c, a, fires);
+          break;
         // swcheck: state(estab, PING, estab)
         case T_PING:
           // Liveness probe: answer immediately (stream_read already
@@ -3060,6 +3591,7 @@ struct Worker {
         // swcheck: state(estab, HELLO_ACK, estab)
         case T_HELLO_ACK:
         case T_DEVPULL:
+        case T_RTS:
           if (type == T_DEVPULL && c->sess_drop) {
             c->sess_drop = false;
             if (b) c->rx_skip = b;
@@ -3231,6 +3763,7 @@ struct Worker {
     ep_del(c->fd);
     trace.rec(kEvConnDown, 0, c->id);
     sess_cancel_terminal(c, fires, kCancelled);
+    fc_cancel_terminal(c, fires, kCancelled);
     for (auto& ref : c->tx) {
       TxItem& item = *ref;
       if (item.is_data && !item.local_done && item.fail) {
@@ -3306,6 +3839,7 @@ struct Worker {
       (void)!send(c->fd, hdr, HEADER_SIZE, MSG_NOSIGNAL | MSG_DONTWAIT);
     }
     sess_cancel_terminal(c, fires, kCancelled);
+    fc_cancel_terminal(c, fires, kCancelled);
     for (auto& ref : c->tx) {
       TxItem& item = *ref;
       if (item.is_data && !item.local_done && item.fail) {
@@ -3389,6 +3923,18 @@ struct Worker {
       c->devpull_ok = true;
     if (json_field(body, "ka") == "ok") c->ka_ok = true;  // liveness capability
     if (!json_field(body, "rails").empty()) c->rails_ok = true;
+    c->unexp_cap = unexp_cap_env();
+    uint64_t fc_w = fc_window_env();
+    if (fc_w > 0) {
+      // Receiver-driven flow control (DESIGN.md §18): adopt the
+      // connector's advertised window for OUR sends, answer with ours.
+      uint64_t peer_w = strtoull(json_field(body, "fc").c_str(), nullptr, 10);
+      if (peer_w > 0) {
+        c->fc_ok = true;
+        c->fc_window = peer_w;
+        c->fc_credits = (int64_t)peer_w;
+      }
+    }
     if (trace.enabled) {
       // swscope stitching: adopt the connector's trace-conn id so both
       // rings tag this conn's EV_E2E events identically (DESIGN.md §15).
@@ -3405,6 +3951,8 @@ struct Worker {
                       (c->devpull_ok ? ", \"devpull\": \"ok\"" : "") +
                       (c->ka_ok ? ", \"ka\": \"ok\"" : "") +
                       (c->rails_ok ? ", \"rails\": \"ok\"" : "") +
+                      (c->fc_ok ? ", \"fc\": \"" + std::to_string(fc_w) + "\""
+                                : "") +
                       (c->tr_hex[0] ? ", \"tr\": \"ok\"" : "") + sess_ext + "}";
     // The ACK is the transport switch point (see TxItem::switch_after).
     conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires,
@@ -3525,6 +4073,7 @@ struct Worker {
       for (auto& [id, c] : conns) cs.push_back(c);
     }
     for (Conn* c : cs) {
+      if (c->fc_ok && expire_fc_send(c, t.ctx, fires)) return;
       for (auto it = c->tx.begin(); it != c->tx.end(); ++it) {
         TxItem& item = **it;
         if (!item.is_data || item.ctx != t.ctx || item.local_done) continue;
@@ -3612,6 +4161,46 @@ struct Worker {
     }
   }
 
+  // A SEND deadline against §18 flow-control state: a parked send sheds
+  // cleanly (the overload degrades to an op timeout, the conn stays
+  // healthy); an RTS-announced rendezvous send is PROMISED -- the
+  // receiver holds a record a silent withdrawal would wedge -- so a
+  // live session defers it (the resume re-announcement completes it
+  // late) and a plain conn takes the started-send teardown.  Returns
+  // true when the deadline was consumed here.
+  bool expire_fc_send(Conn* c, void* ctx, FireList& fires) {
+    for (auto it = c->fc_waiting.begin(); it != c->fc_waiting.end(); ++it) {
+      TxItem& item = **it;
+      if (!item.is_data || item.ctx != ctx || item.local_done) continue;
+      bump(counters.ops_timed_out);
+      bump(counters.sheds);
+      trace.rec(kEvOpFail, item.tag, c->id, item.paylen, kTimedOut);
+      item.local_done = true;
+      if (item.fail) {
+        auto fail = item.fail; auto fctx = item.ctx;
+        fires.push_back([fail, fctx] { fail(fctx, kTimedOut); });
+      }
+      fire_release(item, fires, /*force=*/true);
+      c->fc_waiting.erase(it);
+      return true;
+    }
+    for (auto& [mid, ent] : c->fc_rts) {
+      TxItem& item = *ent.item;
+      if (item.ctx != ctx || item.local_done) continue;
+      if (c->sess && !c->sess->expired) return true;  // completes late
+      bump(counters.ops_timed_out);
+      trace.rec(kEvOpFail, item.tag, c->id, item.paylen, kTimedOut);
+      item.local_done = true;
+      if (item.fail) {
+        auto fail = item.fail; auto fctx = item.ctx;
+        fires.push_back([fail, fctx] { fail(fctx, kTimedOut); });
+      }
+      conn_broken(c, fires);
+      return true;
+    }
+    return false;
+  }
+
   // ---------------------------------------------------------- keepalive
   void ka_tick(FireList& fires) {
     auto now = Clock::now();
@@ -3680,7 +4269,14 @@ struct Worker {
         if (ref->stripe && ref->off < ref->total()) sp++;
       for (auto& [mid, src] : c->stripe_by_id)  // ...plus undisbursed
         if (!src->sacked && !src->failed) sp += src->pending.size();
-      const uint64_t vals[] = {depth, qbytes, infl, inflr, jb, jf, sp};
+      depth += c->fc_waiting.size();
+      for (auto& ref : c->fc_waiting) {
+        qbytes += ref->total();
+        if (ref->is_data) infl++;
+      }
+      uint64_t credits = c->fc_credits > 0 ? (uint64_t)c->fc_credits : 0;
+      const uint64_t vals[] = {depth, qbytes, infl, inflr, jb, jf, sp,
+                               c->fc_unexp, credits};
       static_assert(sizeof(vals) / sizeof(vals[0]) ==
                         sizeof(kGaugeNames) / sizeof(kGaugeNames[0]),
                     "gauge names and values out of sync");
@@ -3880,6 +4476,7 @@ struct Worker {
       }
       check_timers(fires);
       drain_ops(fires);
+      fc_service(fires);  // §18 grants/CTS queued by matcher paths
       for (auto& f : fires) f();
       for (Conn* z : sess_reap) delete z;
       sess_reap.clear();
@@ -4008,6 +4605,12 @@ struct ClientWorker : Worker {
       // the primary handshake.
       hello += ", \"rails\": \"" + std::to_string(rails_n) + "\"";
     }
+    uint64_t fc_w = fc_window_env();
+    if (fc_w > 0) {
+      // Receiver-driven flow control offer (DESIGN.md §18): the value
+      // is OUR unexpected-queue budget for the peer's eager traffic.
+      hello += ", \"fc\": \"" + std::to_string(fc_w) + "\"";
+    }
     char tr_offer[17] = {0};
     if (trace.enabled) {
       // swscope stitching: offer a fresh trace-conn id (DESIGN.md §15).
@@ -4070,6 +4673,16 @@ struct ClientWorker : Worker {
     c->devpull_ok = devpull_advertise && json_field(ack_body, "devpull") == "ok";
     c->ka_ok = json_field(ack_body, "ka") == "ok";
     c->rails_ok = rails_n > 1 && json_field(ack_body, "rails") == "ok";
+    c->unexp_cap = unexp_cap_env();
+    if (fc_w > 0) {
+      uint64_t peer_w =
+          strtoull(json_field(ack_body, "fc").c_str(), nullptr, 10);
+      if (peer_w > 0) {
+        c->fc_ok = true;
+        c->fc_window = peer_w;
+        c->fc_credits = (int64_t)peer_w;
+      }
+    }
     if (tr_offer[0] && json_field(ack_body, "tr") == "ok")
       memcpy(c->tr_hex, tr_offer, sizeof(c->tr_hex));
     if (sess_on && json_field(ack_body, "sess") == "ok") {
@@ -4147,8 +4760,11 @@ extern "C" {
 // 6: swscope ("tr" handshake + EV_E2E ordinals, timestamped PING/PONG
 //    clock samples, per-conn gauges via sw_gauges);
 // 7: multi-rail striping (T_SDATA/T_SACK, "rails"/"rail_of" handshake,
-//    chunk-level work stealing + offset-dedup reassembly)
-const char* sw_version() { return "starway-native-7"; }
+//    chunk-level work stealing + offset-dedup reassembly);
+// 8: receiver-driven flow control (T_CREDIT window grants, T_RTS/T_CTS
+//    rendezvous pull, "fc" handshake, bounded unexpected queues +
+//    deadline-aware shedding)
+const char* sw_version() { return "starway-native-8"; }
 
 // Portable cursor atomics for the Python engine's sm ring (sw_engine.h).
 // std::atomic_ref would be C++20-tidy but libstdc++'s needs alignment UB
@@ -4349,6 +4965,7 @@ int sw_recv(void* h, void* buf, uint64_t cap, uint64_t tag, uint64_t mask,
             sw_recv_cb done, sw_fail_cb fail, void* ctx, double timeout_s) {
   Worker* w = W(h);
   FireList fires;
+  bool fc_work = false;
   {
     std::lock_guard<std::mutex> g(w->mu);
     if (w->status.load() != ST_RUNNING) return -1;
@@ -4378,7 +4995,11 @@ int sw_recv(void* h, void* buf, uint64_t cap, uint64_t tag, uint64_t mask,
       w->ops.push_back(op);
       w->wake();
     }
+    // §18: a claim/release above may have queued CTS or grant work the
+    // engine thread must drain (fc_service).
+    fc_work = !w->matcher.fc_cts.empty() || !w->matcher.pending_grants.empty();
   }
+  if (fc_work) w->wake();
   // Armed after the matcher ran: an immediately-settled recv (matched a
   // complete unexpected message / truncated) leaves a no-op timer behind.
   // The wake makes the engine recompute its epoll timeout.
@@ -4484,6 +5105,7 @@ int sw_counters(void* h, char* out, int cap) {
       c.acks_tx.load(),        c.acks_rx.load(),
       c.stripe_chunks_tx.load(), c.stripe_chunks_rx.load(),
       c.rail_resteals.load(),
+      c.sends_parked.load(),   c.sheds.load(),
   };
   constexpr size_t kN = sizeof(kCounterNames) / sizeof(kCounterNames[0]);
   static_assert(sizeof(vals) / sizeof(vals[0]) == kN,

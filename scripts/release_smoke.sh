@@ -99,5 +99,9 @@ echo "== 8/8 session-chaos smoke (resets mid-burst, exactly-once oracle)"
 (cd "$WORK/src" && "$VPY" scripts/session_chaos.py --cycles 3)
 (cd "$WORK/src" && "$VPY" scripts/session_chaos.py --cycles 3 \
     --server-engine native --client-engine native)
+# §18 overload smoke: many clients, mixed fast/slow receivers, periodic
+# kills, the credit window as the no-OOM bound (DESIGN.md §18).
+(cd "$WORK/src" && "$VPY" scripts/session_chaos.py --overload \
+    --clients 8 --cycles 2 --n 8)
 
 echo "RELEASE SMOKE: OK ($SDIST)"

@@ -45,6 +45,19 @@ def _parse():
                     help="ops per cycle (default 15)")
     ap.add_argument("--size", type=int, default=4096,
                     help="payload bytes per op (default 4096)")
+    ap.add_argument("--overload", action="store_true",
+                    help="many-client overload soak (DESIGN.md §18): "
+                         "--clients concurrent senders against ONE server, "
+                         "mixed fast/slow receivers, periodic kills; swtrace "
+                         "counters + gauges are the no-OOM / exactly-once "
+                         "oracle")
+    ap.add_argument("--clients", type=int, default=8,
+                    help="overload mode: concurrent client workers (default 8)")
+    ap.add_argument("--slow-every", type=int, default=3,
+                    help="overload mode: every k-th client's receives post "
+                         "LATE (a slow consumer; default 3)")
+    ap.add_argument("--fc-window", type=int, default=64 * 1024,
+                    help="overload mode: STARWAY_FC_WINDOW bytes (default 64Ki)")
     return ap.parse_args()
 
 
@@ -147,5 +160,133 @@ async def _main(args) -> int:
         proxy.stop()
 
 
+async def _overload(args) -> int:
+    """Many-client overload soak (ISSUE 9 satellite): dozens of client
+    workers flood ONE server through per-client FaultProxies with the §18
+    credit window armed; every --slow-every'th client's receives post
+    late (slow consumer), and each cycle kills a rotating subset of
+    connections mid-burst.  Oracle: every op completes exactly once
+    (recvs_completed == posted), resumes cover the kills, and the
+    telemetry samples never show unexpected-queue residency above
+    clients x window -- bounded, not OOM."""
+    os.environ["STARWAY_TLS"] = "tcp"
+    os.environ["STARWAY_SESSION"] = "1"
+    os.environ.setdefault("STARWAY_SESSION_GRACE", "30")
+    os.environ["STARWAY_FC_WINDOW"] = str(args.fc_window)
+    os.environ.setdefault("STARWAY_METRICS_INTERVAL", "0.25")
+
+    import random
+    import socket
+
+    import numpy as np
+
+    from starway_tpu import Client, Server
+    from starway_tpu.core import telemetry
+    from starway_tpu.testing.faults import FaultProxy
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    os.environ["STARWAY_NATIVE"] = "1" if args.server_engine == "native" else "0"
+    server = Server()
+    server.listen("127.0.0.1", port)
+    os.environ["STARWAY_NATIVE"] = "1" if args.client_engine == "native" else "0"
+    proxies = [FaultProxy("127.0.0.1", port).start()
+               for _ in range(args.clients)]
+    clients = []
+    for p in proxies:
+        c = Client()
+        await c.aconnect("127.0.0.1", p.port)
+        clients.append(c)
+
+    rng = random.Random(0xC0FFEE)
+    total = 0
+    kills = 0
+    peak_unexp = 0
+    t0 = time.monotonic()
+    try:
+        for cycle in range(args.cycles):
+            n, size = args.n, args.size
+            sends = []
+            recvs = []
+            bufs = []
+            for ci, c in enumerate(clients):
+                tag0 = (cycle * len(clients) + ci) * 1000
+                for i in range(n):
+                    sends.append(c.asend(
+                        np.full(size, (tag0 + i) % 251, dtype=np.uint8),
+                        tag0 + i))
+
+                async def post_recvs(ci=ci, tag0=tag0):
+                    if args.slow_every and ci % args.slow_every == 0:
+                        await asyncio.sleep(0.5)  # the slow consumer
+                    for i in range(n):
+                        buf = np.zeros(size, dtype=np.uint8)
+                        bufs.append((tag0 + i, buf))
+                        recvs.append(server.arecv(buf, tag0 + i,
+                                                  (1 << 64) - 1))
+
+                asyncio.ensure_future(post_recvs())
+            await asyncio.sleep(0.1)
+            for p in rng.sample(proxies, max(1, len(proxies) // 3)):
+                p.kill_all(rst=True)  # the periodic mid-burst kill
+                kills += 1
+            await asyncio.wait_for(asyncio.gather(*sends), timeout=120)
+            for _ in range(200):
+                if len(recvs) == len(clients) * n:
+                    break
+                await asyncio.sleep(0.05)
+            res = await asyncio.wait_for(asyncio.gather(*recvs), timeout=120)
+            assert len(res) == len(clients) * n
+            for tag, buf in bufs:
+                assert buf[0] == tag % 251 and buf[-1] == tag % 251, tag
+            total += len(res)
+            sample = telemetry.sample_now()
+            for wk in sample.get("workers", {}).values():
+                for g in wk.get("gauges", {}).get("conns", {}).values():
+                    peak_unexp = max(peak_unexp, g.get("unexp_bytes", 0))
+            _print_live(cycle, total, sample)
+
+        await asyncio.wait_for(
+            asyncio.gather(*(c.aflush() for c in clients)), timeout=120)
+        ss = server._server.counters_snapshot()
+        resumes = ss["sessions_resumed"] + sum(
+            c._client.counters_snapshot()["sessions_resumed"]
+            for c in clients)
+        parked = sum(c._client.counters_snapshot()["sends_parked"]
+                     for c in clients)
+        bound = args.fc_window  # per-conn bound: the §18 window
+        report = {
+            "mode": "overload",
+            "server_engine": args.server_engine,
+            "client_engine": args.client_engine,
+            "clients": args.clients,
+            "cycles": args.cycles,
+            "ops": total,
+            "kills": kills,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+            "recvs_completed": ss["recvs_completed"],
+            "sessions_resumed": resumes,
+            "sends_parked": parked,
+            "peak_unexp_bytes": peak_unexp,
+            "unexp_bound": bound,
+        }
+        ok = (ss["recvs_completed"] == total and resumes >= 1
+              and peak_unexp <= bound)
+        report["ok"] = ok
+        print(json.dumps(report))
+        return 0 if ok else 1
+    finally:
+        for obj in clients + [server]:
+            try:
+                await asyncio.wait_for(obj.aclose(), timeout=10)
+            except Exception:
+                pass
+        for p in proxies:
+            p.stop()
+
+
 if __name__ == "__main__":
-    sys.exit(asyncio.run(_main(_parse())))
+    _args = _parse()
+    sys.exit(asyncio.run(_overload(_args) if _args.overload
+                         else _main(_args)))

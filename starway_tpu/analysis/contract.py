@@ -44,8 +44,10 @@ _REASON_PAIRS = [
 # sess_id/sess_epoch/sess_ack triple alongside it); "tr" is the swscope
 # end-to-end trace-conn id (DESIGN.md §15); "rails"/"rail_of" are the
 # multi-rail striping negotiation and the secondary-lane attach key
-# (DESIGN.md §17).
-_HANDSHAKE_KEYS = ["ka", "sm", "devpull", "sess", "tr", "rails", "rail_of"]
+# (DESIGN.md §17); "fc" is the receiver-driven flow-control window
+# advertisement (DESIGN.md §18).
+_HANDSHAKE_KEYS = ["ka", "sm", "devpull", "sess", "tr", "rails", "rail_of",
+                   "fc"]
 
 # Normalised C type -> acceptable canonical ctypes spellings.
 _C2CTYPES = {

@@ -35,6 +35,16 @@ epoch              sessions never resume across an epoch change, and
 quiescence         from every reachable state the run ends -- every op
                    completes or fails with a stable reason; no silent
                    deadlock states (``no-replay``)
+credit-conservation
+                   the §18 flow-control window is never permanently
+                   lost across kill/resume schedules: at clean
+                   quiescence the sender's credits equal the advertised
+                   window.  Grants lost in flight are healed by the
+                   resume-time full-window reset; replayed frames
+                   re-debit and their (possibly duplicate) deliveries
+                   re-grant, clamped at the window (``credit-leak``:
+                   a resume that carries stale credits across the
+                   incarnation leaks the in-flight grants forever)
 =================  =====================================================
 
 The pass also refuses to run vacuously: the Python engine's extracted
@@ -75,10 +85,14 @@ MUTATIONS = {
     "ack-overclaim": "flush-order",
     "resume-ignores-epoch": "epoch",
     "no-replay": "quiescence",
+    "credit-leak": "credit-conservation",
 }
 
 INVARIANTS = ("exactly-once", "journal-trim", "flush-order", "epoch",
-              "quiescence")
+              "quiescence", "credit-conservation")
+
+#: §18 flow-control window in abstract units (each data op debits one).
+FC_W = 2
 
 
 @dataclass(frozen=True)
@@ -94,6 +108,7 @@ class _State:
     delivered: tuple = ()        # data kinds, delivery order
     r_fack_owed: bool = False    # receiver's journaled barrier ACK
     flush_state: str = "none"    # none | sent | done | failed
+    credits: int = FC_W            # §18 sender window remainder
     suspended: bool = False
     expired: bool = False
     epoch_s: int = 0
@@ -156,7 +171,10 @@ def _enabled(s: _State) -> list:
             acts.append("restart")
         return acts
     if s.ops_left and len(s.c2s) < MAX_INFLIGHT:
-        acts.append("submit")
+        # §18 gate: data submits park (are disabled) with the window dry;
+        # grants, or the resume-time reset, re-enable them.
+        if s.ops_left[0] == "flush" or s.credits > 0:
+            acts.append("submit")
     if s.c2s:
         acts.append("deliver")
     if s.s2c:
@@ -179,11 +197,17 @@ def _apply(s: _State, act: str, run: _Run, trace: tuple) -> _State:
             s, ops_left=s.ops_left[1:], tx_seq=seq,
             journal=s.journal + ((seq, kind),),
             c2s=s.c2s + ((seq, kind),),
+            credits=s.credits - (0 if kind == "flush" else 1),
             flush_state="sent" if kind == "flush" else s.flush_state)
     if act == "deliver":
         (seq, kind), rest = s.c2s[0], s.c2s[1:]
         if seq <= s.rx_cum and mut != "no-dedup":
-            return replace(s, c2s=rest)  # dup: drained and dropped
+            # Dup: drained and dropped -- but its (re-)debited window
+            # still returns (§18 credit conservation).
+            s2c = s.s2c
+            if kind != "flush":
+                s2c = s2c + (("credit",),)
+            return replace(s, c2s=rest, s2c=s2c)
         if seq <= s.rx_cum or seq == s.rx_cum + 1:
             # In-order (or, under no-dedup, a replayed duplicate).
             new_cum = max(s.rx_cum, seq)
@@ -197,10 +221,17 @@ def _apply(s: _State, act: str, run: _Run, trace: tuple) -> _State:
                         f"data op {kind!r} (seq {seq}) delivered twice",
                         trace + (act,))
                 delivered = delivered + (kind,)
+                # Matched/drained: the window grant goes back (never
+                # inflight-capped -- grants are deltas, dropping one
+                # would leak window forever).
+                s2c = s2c + (("credit",),)
             else:
                 fack_owed = True
-                if len(s2c) < MAX_INFLIGHT:
-                    s2c = s2c + (("fack",),)
+                # Journaled barrier ACK: it retries from the receiver's
+                # tx queue in the real engine, so the model must never
+                # silently drop it (credit entries would otherwise starve
+                # its inflight slot forever once kills are exhausted).
+                s2c = s2c + (("fack",),)
             if new_cum > s.acked_sent and len(s2c) < MAX_INFLIGHT:
                 s2c = s2c + (("ack", new_cum),)
             return replace(s, c2s=rest, rx_cum=new_cum, delivered=delivered,
@@ -209,6 +240,12 @@ def _apply(s: _State, act: str, run: _Run, trace: tuple) -> _State:
         return _gap_reset(replace(s, c2s=rest), run, trace)
     if act == "deliver_ack":
         msg, rest = s.s2c[0], s.s2c[1:]
+        if msg[0] == "credit":
+            # Clamped at the window: a wire-duplicated grant (the dup
+            # fault hitting a credit-bearing schedule) must never mint
+            # credit -- the engines clamp identically.
+            return replace(s, s2c=rest,
+                           credits=min(FC_W, s.credits + 1))
         if msg[0] == "ack":
             cum = msg[1]
             if mut == "trim-overshoot":
@@ -283,8 +320,17 @@ def _apply(s: _State, act: str, run: _Run, trace: tuple) -> _State:
             # The receiver's journaled barrier ACK rides the new
             # incarnation (FLUSH_ACK is a sequenced session frame).
             s2c = (("fack",),)
+        # §18: fresh window per incarnation -- stale debits and in-flight
+        # grants (wiped with s2c at the kill) are healed by resetting to
+        # the full window minus the re-debited replay frames.  The
+        # credit-leak mutation carries the old remainder across instead,
+        # leaking every grant the kill swallowed.
+        replay_debit = sum(1 for e in replay if e[1] != "flush")
+        credits = (s.credits if mut == "credit-leak"
+                   else FC_W - replay_debit)
         return replace(s, suspended=False, journal=kept, c2s=replay,
                        s2c=s2c, rx_cum=rx_cum, acked_sent=rx_cum,
+                       credits=credits,
                        peer_acked=max(s.peer_acked, reported))
     if act == "restart":
         # The acceptor process restarted: new epoch, session state gone.
@@ -357,6 +403,12 @@ def check(mutation: Optional[str] = None, max_states: int = 200_000) -> dict:
                     "quiescence",
                     "clean quiescence with the flush barrier never "
                     "completed", ())
+            if s.credits != FC_W:
+                run.violate(
+                    "credit-conservation",
+                    f"clean quiescence with credits={s.credits} -- the "
+                    f"§18 window ({FC_W}) was permanently lost across "
+                    "the schedule", ())
     return {"schedules": schedules, "states": len(paths),
             "violations": run.violations}
 

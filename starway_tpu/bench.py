@@ -86,6 +86,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--striped-bytes", type=parse_size)
     p.add_argument("--striped-iterations", type=int)
     p.add_argument("--striped-warmup", type=int)
+    p.add_argument("--flood-bytes", type=parse_size)
+    p.add_argument("--flood-messages", type=int)
+    p.add_argument("--flood-iterations", type=int)
+    p.add_argument(
+        "--fc-window", type=parse_size, metavar="BYTES",
+        help="Arm §18 receiver-driven flow control (STARWAY_FC_WINDOW) for "
+             "the run; see the 'flooded' scenario (DESIGN.md §18).",
+    )
     p.add_argument(
         "--rails", type=int, metavar="N",
         help="Open N transport lanes per connection (STARWAY_RAILS) and arm "
@@ -126,6 +134,7 @@ _OVERRIDE_KEYS = {
     "pingpong-flag": [("flag_iterations", "iterations"), ("flag_warmup", "warmup")],
     "streaming-duplex": [("stream_bytes", "message_bytes"), ("stream_iterations", "iterations"), ("stream_warmup", "warmup")],
     "striped": [("striped_bytes", "message_bytes"), ("striped_iterations", "iterations"), ("striped_warmup", "warmup")],
+    "flooded": [("flood_bytes", "message_bytes"), ("flood_messages", "messages"), ("flood_iterations", "iterations")],
 }
 
 
@@ -149,7 +158,8 @@ def scenario_plan(args: argparse.Namespace) -> list[tuple[str, dict[str, Any]]]:
                 overrides[cfg_key] = val
         if getattr(args, "payload", None) and name in ("large-array", "streaming-duplex"):
             overrides["payload"] = args.payload
-        if name == "striped" and getattr(args, "paired_baseline", False):
+        if name in ("striped", "flooded") and getattr(args, "paired_baseline",
+                                                     False):
             overrides["paired"] = True
         plan.append((name, overrides))
     return plan
@@ -464,6 +474,9 @@ def main(argv: Sequence[str] | None = None) -> int:
         # 'striped' scenario's >= 1 MiB messages.
         os.environ["STARWAY_RAILS"] = str(max(1, args.rails))
         os.environ.setdefault("STARWAY_STRIPE_THRESHOLD", str(1 << 20))
+    if args.fc_window:
+        # Flow control negotiates at connect too (the "fc" handshake key).
+        os.environ["STARWAY_FC_WINDOW"] = str(args.fc_window)
     if args.trace:
         # Must land before any worker is created: rings are armed per
         # worker at construction (core/swtrace.py).

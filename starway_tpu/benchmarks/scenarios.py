@@ -21,11 +21,21 @@ control 0x1AA0-0x1AA2, data 0x2B00-0x2B31.
 from __future__ import annotations
 
 import asyncio
+import json
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Mapping
 
 import numpy as np
+
+
+def _encode_ctl(payload: Mapping[str, Any]) -> np.ndarray:
+    raw = json.dumps(payload, separators=(",", ":"), sort_keys=True).encode()
+    return np.frombuffer(raw, dtype=np.uint8).copy()
+
+
+def _decode_ctl(buffer: np.ndarray, length: int) -> dict:
+    return json.loads(bytes(memoryview(buffer)[:length]).decode())
 
 TAG_MASK: int = (1 << 64) - 1
 
@@ -41,6 +51,8 @@ FLAG_PONG_TAG = 0x2B21
 STREAM_UP_TAG = 0x2B30
 STREAM_DOWN_TAG = 0x2B31
 STRIPED_DATA_TAG = 0x2B40
+FLOOD_DATA_TAG = 0x2B50
+FLOOD_STATS_TAG = 0x2B51
 
 
 @dataclass
@@ -388,12 +400,121 @@ class Striped(Scenario):
         await ctx.flush_endpoint()
 
 
+class Flooded(Scenario):
+    """Overload robustness (DESIGN.md §18): a burst of unmatched eager
+    sends against a peer that posts its receives LATE.  With
+    ``STARWAY_FC_WINDOW`` set the receiver's unexpected-queue residency
+    stays bounded by the window (``peak_unexp_bytes``, sampled live on
+    the receiving worker while the flood is in flight) and the sender
+    parks (``sends_parked``); with it unset the queue grows with the
+    whole burst -- run the CLI once with and once without the env to see
+    bounded-vs-unbounded receiver memory.  ``paired=True``
+    (``--paired-baseline``) interleaves a MATCHED phase (receives posted
+    before the burst) with every flood iteration over the same conn, so
+    one run also shows that flow control adds no measurable cost to the
+    matched-recv fast path (``matched_msgs_per_s`` with fc on vs a run
+    with it off)."""
+
+    name = "flooded"
+    description = "Unmatched-send overload: bounded receiver memory + matched fast-path cost (DESIGN.md §18)."
+    defaults = {"message_bytes": 16 << 10, "messages": 96, "warmup": 1,
+                "iterations": 4, "hold_s": 0.4, "paired": False}
+
+    async def run_client(self, ctx, overrides) -> ScenarioResult:
+        cfg = self.config(overrides)
+        size, nmsg = int(cfg["message_bytes"]), int(cfg["messages"])
+        warmup, iters = int(cfg["warmup"]), int(cfg["iterations"])
+        paired = bool(cfg.get("paired"))
+        payloads = [np.full(size, i % 251, dtype=np.uint8)
+                    for i in range(nmsg)]
+        stats_buf = np.zeros(4096, dtype=np.uint8)
+        flood_secs: list[float] = []
+        matched_secs: list[float] = []
+        peaks: list[int] = []
+        for it in range(warmup + iters):
+            stats_fut = ctx.client.arecv(stats_buf, FLOOD_STATS_TAG,
+                                         ctx.tag_mask)
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(ctx.client.asend(p, FLOOD_DATA_TAG) for p in payloads))
+            _, ln = await stats_fut
+            await ctx.flush()
+            dt = time.perf_counter() - t0
+            stats = _decode_ctl(stats_buf, ln)
+            if it >= warmup:
+                flood_secs.append(dt)
+                peaks.append(int(stats.get("peak", 0)))
+            if paired:
+                # Matched phase: the server posts first and GOes us.
+                _, ln = await ctx.client.arecv(stats_buf, FLOOD_STATS_TAG,
+                                               ctx.tag_mask)
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *(ctx.client.asend(p, FLOOD_DATA_TAG) for p in payloads))
+                await ctx.flush()
+                if it >= warmup:
+                    matched_secs.append(time.perf_counter() - t0)
+        metrics = {
+            "peak_unexp_bytes": max(peaks) if peaks else 0,
+            "flood_seconds_p50": float(np.median(flood_secs))
+            if flood_secs else 0.0,
+            "flood_msgs_per_s": (nmsg / float(np.median(flood_secs)))
+            if flood_secs else 0.0,
+        }
+        samples = {"flood_seconds": flood_secs,
+                   "peak_unexp_bytes": [float(p) for p in peaks]}
+        if paired:
+            metrics["matched_seconds_p50"] = (float(np.median(matched_secs))
+                                              if matched_secs else 0.0)
+            metrics["matched_msgs_per_s"] = (
+                nmsg / float(np.median(matched_secs)) if matched_secs else 0.0)
+            samples["matched_seconds"] = matched_secs
+        return ScenarioResult(name=self.name, metrics=metrics,
+                              samples=samples, config=cfg)
+
+    async def run_server(self, ctx, overrides) -> None:
+        cfg = self.config(overrides)
+        size, nmsg = int(cfg["message_bytes"]), int(cfg["messages"])
+        total = int(cfg["warmup"]) + int(cfg["iterations"])
+        hold = float(cfg["hold_s"])
+        paired = bool(cfg.get("paired"))
+        sinks = [np.empty(size, dtype=np.uint8) for _ in range(nmsg)]
+        worker = ctx.server._server
+
+        def unexp_now() -> int:
+            g = worker.gauges_snapshot()
+            return sum(int(c.get("unexp_bytes", 0))
+                       for c in g.get("conns", {}).values())
+
+        await ctx.signal_ready()
+        for _ in range(total):
+            # Flood phase: hold the receives back and sample residency.
+            peak = 0
+            deadline = time.perf_counter() + hold
+            while time.perf_counter() < deadline:
+                peak = max(peak, unexp_now())
+                await asyncio.sleep(0.02)
+            recvs = [ctx.server.arecv(s, FLOOD_DATA_TAG, ctx.tag_mask)
+                     for s in sinks]
+            await ctx.server.asend(ctx.endpoint, _encode_ctl({"peak": peak}),
+                                   FLOOD_STATS_TAG)
+            await asyncio.gather(*recvs)
+            if paired:
+                # Matched phase: receives first, then GO.
+                recvs = [ctx.server.arecv(s, FLOOD_DATA_TAG, ctx.tag_mask)
+                         for s in sinks]
+                await ctx.server.asend(ctx.endpoint, _encode_ctl({"go": 1}),
+                                       FLOOD_STATS_TAG)
+                await asyncio.gather(*recvs)
+        await ctx.flush_endpoint()
+
+
 # Back-compat aliases matching the reference's registry surface.
 ScenarioDefinition = Scenario
 
 SCENARIOS: Dict[str, Scenario] = {
     s.name: s for s in (LargeArray(), SmallMessages(), PingpongFlag(),
-                        StreamingDuplex(), Striped())
+                        StreamingDuplex(), Striped(), Flooded())
 }
 
 __all__ = [

@@ -144,6 +144,31 @@ benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
     what makes chunk-level work stealing, rail-death redistribution, and
     receiver-side offset dedup possible.
 
+``STARWAY_FC_WINDOW``
+    Receiver-driven flow-control window in bytes (default 0 = off, seed
+    parity).  When > 0 the handshake offers ``"fc": "<bytes>"`` and, once
+    both peers confirm, each direction's eager traffic is governed by the
+    RECEIVER's advertised window: the sender debits it per eager DATA
+    payload, parks sends unframed-FIFO when it runs dry (block, never
+    OOM; one oversized frame is admitted against an idle window so a
+    single payload above the window cannot deadlock), and the receiver
+    returns T_CREDIT grants as unexpected messages are matched or
+    drained.  Sends above ``STARWAY_RNDV_THRESHOLD`` switch to the
+    receiver-pulled RTS/CTS path and never consume window.  A parked
+    send with a ``timeout=`` deadline is shed locally with the stable
+    ``"timed out"`` reason (overload degrades to op timeouts, not conn
+    or process death).  See DESIGN.md §18.
+
+``STARWAY_UNEXP_BYTES``
+    Per-connection ceiling on unexpected-queue payload bytes (default
+    0 = unbounded, seed parity).  A last-resort overload breaker for
+    peers that never negotiated ``fc``: a connection whose own
+    un-granted spill crosses the cap is reset instead of letting the
+    process OOM (total residency is bounded by cap x live conns, and
+    the offender -- never an innocent peer -- takes the reset).  With
+    ``fc`` negotiated the credit window keeps well-behaved peers under
+    the cap.
+
 ``STARWAY_TRACE``
     "1" = record per-op lifecycle events (posted/matched/completed/
     failed, stage spans, connection churn) into a bounded per-worker ring
@@ -211,6 +236,8 @@ __all__ = [
     "stripe_rails",
     "stripe_threshold",
     "stripe_chunk",
+    "fc_window",
+    "unexp_cap",
     "trace_enabled",
     "trace_ring_size",
     "flight_dir",
@@ -372,6 +399,27 @@ def stripe_chunk() -> int:
         except ValueError:
             pass
     return max(4096, 4 * (chunk_bytes() or 256 * 1024))
+
+
+def fc_window() -> int:
+    """Receiver credit window in bytes (STARWAY_FC_WINDOW); 0 (the
+    default) disables flow control entirely -- seed parity: no "fc"
+    handshake key, no T_CREDIT/T_RTS/T_CTS frames."""
+    try:
+        v = int(_env("STARWAY_FC_WINDOW", "0"))
+    except ValueError:
+        return 0
+    return v if v > 0 else 0
+
+
+def unexp_cap() -> int:
+    """Hard unexpected-queue byte ceiling (STARWAY_UNEXP_BYTES); 0 (the
+    default) keeps the seed's unbounded queue."""
+    try:
+        v = int(_env("STARWAY_UNEXP_BYTES", "0"))
+    except ValueError:
+        return 0
+    return v if v > 0 else 0
 
 
 def trace_enabled() -> bool:

@@ -87,7 +87,7 @@ class TxData:
     # __weakref__: deadline timers (core/engine.py) hold queued sends
     # weakly, so a completed send's payload is not pinned until its timer
     # would have fired.
-    __slots__ = ("header", "payload", "nbytes", "off", "done", "fail",
+    __slots__ = ("header", "payload", "nbytes", "tag", "off", "done", "fail",
                  "owner", "rndv", "local_done", "switch_after", "counted",
                  "sess_seq", "sess_nbytes", "e2e_ord",
                  "_chunk_start", "_chunk_view", "__weakref__")
@@ -103,6 +103,7 @@ class TxData:
             self._chunk_view = None
         self.header = frames.pack_data_header(tag, self.nbytes)
         self.payload = payload
+        self.tag = tag
         self.off = 0
         self.done = done
         self.fail = fail
@@ -291,6 +292,35 @@ class TxDevpull:
         self.off = 0
 
 
+class RtsHandle:
+    """Receiver-side §18 rendezvous offer (the sender's T_RTS): the
+    matcher treats it exactly like a devpull descriptor -- duck-typed
+    ``started`` / ``start(msg)`` invoked via fire thunks outside locks,
+    flush-barrier deferral and force-start included.  ``start`` hops to
+    the engine thread, which picks the sink, pre-registers the assembly,
+    and answers CTS."""
+
+    __slots__ = ("conn", "msg_id", "total", "tag", "started", "msg")
+
+    def __init__(self, conn, msg_id: int, total: int, tag: int):
+        self.conn = conn
+        self.msg_id = msg_id
+        self.total = total
+        self.tag = tag
+        self.started = False
+        self.msg = None
+
+    def start(self, msg) -> None:
+        worker = self.conn.worker
+        with worker.lock:
+            if self.started or worker.status != state.RUNNING:
+                return
+            self.started = True
+            worker._busy += 1
+            worker.ops.append(("fc_cts", self.conn, msg))
+        worker._wake()
+
+
 class TxCtl:
     """A small control frame (HELLO/HELLO_ACK/FLUSH/FLUSH_ACK).
 
@@ -447,6 +477,28 @@ class TcpConn(BaseConn):
         self._sdata: Optional[tuple] = None   # (tag, subhdr buf, got, blen)
         self._rx_stripe: Optional[tuple] = None  # (asm, offset, chunk_len)
         self._rx_stripe_got = 0
+        # Receiver-driven flow control (DESIGN.md §18; negotiated via the
+        # "fc" handshake key).  Sender half: ``fc_window`` is the PEER's
+        # advertised unexpected-queue budget, ``fc_credits`` the signed
+        # remainder (negative only via the one-oversized-frame
+        # admission), ``fc_waiting`` the unframed FIFO of parked sends,
+        # ``fc_rts`` the announced-but-unSACKed rendezvous sends
+        # (msg_id -> [TxData, state, tag]; payload pinned until SACK).
+        # Receiver half: ``fc_unexp`` is this conn's outstanding
+        # (un-granted) spill bytes, ``fc_rx_gen`` the incarnation
+        # generation that orphans stale grants across a session resume,
+        # ``fc_rx`` the un-completed inbound RTS records (dedup for
+        # re-announcements).  All zero/empty on seed-parity conns.
+        self.fc_ok = False
+        self.fc_window = 0
+        self.fc_credits = 0
+        self.fc_waiting: deque = deque()
+        self.fc_rts: dict = {}
+        self._fc_next_msg = 1
+        self.fc_unexp = 0
+        self.fc_rx_gen = 0
+        self.fc_rx: dict = {}
+        self._unexp_cap = config.unexp_cap()
         self.sess = None
         self._sess_pending = None   # seq announced by the last T_SEQ
         self._sess_drop = False     # next frame is a duplicate: drain + drop
@@ -617,7 +669,13 @@ class TcpConn(BaseConn):
                 # seq-framed even on session conns -- chunks are
                 # idempotent and the journal is per-message (the group
                 # re-dispatches un-SACKed sources wholesale at resume).
+                # Striped sends are exempt from the §18 credit window:
+                # like the RTS path they are SACK-terminated large
+                # transfers (stripe_threshold should sit at or above the
+                # rndv threshold when combining the two planes).
                 return grp.submit(tag, payload, done, fail, owner, fires)
+        if self.fc_ok:
+            return self._fc_send(tag, payload, done, fail, owner, fires, kick)
         self.dirty = True
         self._data_counter += 1
         item = TxData(tag, payload, done, fail, owner)
@@ -920,6 +978,12 @@ class TcpConn(BaseConn):
                                0, self.tr_id + ":sup")
         self._ctr.frames_replayed += replayed
         self._sess_drain_waiting()  # trim may have freed journal room
+        if self.fc_ok:
+            # Fresh credit window per incarnation; unSACKed rendezvous
+            # sends re-announce; parked sends re-enter dispatch
+            # (DESIGN.md §18 -- the journal already owns their bytes).
+            self._fc_reset_resume()
+            self._fc_drain_waiting(fires)
         if self.stripe is not None:
             # Un-SACKed striped sources re-dispatch wholesale (chunk 0
             # onward) across whatever lanes are live -- the per-message
@@ -934,6 +998,233 @@ class TcpConn(BaseConn):
         swtrace.flight_dump("session-resume", self.worker)
         self.worker._register_conn_io(self)
         self.kick_tx(fires)
+
+    # -------------------------------------------------------- flow control
+    #
+    # Receiver-driven credit flow control + the RTS/CTS rendezvous path
+    # (DESIGN.md §18; negotiated via the "fc" handshake key).  Sender
+    # half below runs on the engine thread (send_data routes through it);
+    # the receiver half hangs off _pump_frames and the matcher's
+    # fc_release hook.
+
+    def _fc_send(self, tag: int, payload, done, fail, owner, fires: list,
+                 kick: bool):
+        """send_data on an fc conn: gate eager sends on the peer's
+        window, announce rendezvous sends via RTS.  Once anything is
+        parked, EVERYTHING parks behind it -- FIFO arrival order at the
+        receiver's matcher is part of the matching contract."""
+        item = TxData(tag, payload, done, fail, owner)
+        if self.fc_waiting:
+            self.fc_waiting.append(item)
+            self._ctr.sends_parked += 1
+            return item
+        if item.rndv:
+            self._fc_rts_announce(item, fires, kick)
+            return item
+        if not self._fc_admit(item.nbytes):
+            self.fc_waiting.append(item)
+            self._ctr.sends_parked += 1
+            return item
+        self._fc_dispatch_eager(item, fires, kick)
+        return item
+
+    def _fc_admit(self, nbytes: int) -> bool:
+        """Debit the window, or refuse.  A fully-replenished (idle)
+        window always admits one frame even when the payload exceeds it
+        -- the §14 journal-backpressure rule: a single oversized payload
+        must block later sends, never deadlock itself."""
+        if self.fc_credits >= nbytes or self.fc_credits >= self.fc_window:
+            self.fc_credits -= nbytes
+            return True
+        return False
+
+    def _fc_dispatch_eager(self, item, fires: list, kick: bool) -> None:
+        self.dirty = True
+        self._data_counter += 1
+        if self.sess is not None:
+            self._sess_submit(item, fires, kick)
+            return
+        self.tx.append(item)
+        if kick:
+            self.kick_tx(fires)
+
+    def _fc_rts_announce(self, item, fires: list, kick: bool) -> None:
+        """Announce a rendezvous send: the payload stays pinned here and
+        travels as ONE self-describing T_SDATA frame only after the
+        receiver's CTS -- large transfers never consume window and never
+        spill at the receiver.  The RTS ctl is per-incarnation (never
+        seq-framed): a session resume re-announces every unSACKed entry
+        instead of replaying it."""
+        self.dirty = True
+        self._data_counter += 1
+        msg_id = frames.FC_MSG_BIT | self._fc_next_msg
+        self._fc_next_msg += 1
+        item.header = frames.pack_sdata_header(item.tag, msg_id, 0,
+                                               item.nbytes, item.nbytes)
+        self.fc_rts[msg_id] = [item, "rts", item.tag]
+        self.tx.append(TxCtl(frames.pack_rts(item.tag, msg_id, item.nbytes)))
+        if kick:
+            self.kick_tx(fires)
+
+    def _on_credit(self, nbytes: int, fires: list) -> None:
+        """Peer returned window (T_CREDIT): replenish and drain parked
+        sends.  Clamped at the advertised window -- a wire-duplicated
+        grant must never mint credit."""
+        if not self.fc_ok:
+            return  # stray grant on a non-fc conn: old peers cannot send it
+        self.fc_credits = min(self.fc_window, self.fc_credits + nbytes)
+        self._fc_drain_waiting(fires)
+
+    def _fc_drain_waiting(self, fires: list) -> None:
+        """Move parked sends into dispatch as grants restore the window
+        (FIFO; rendezvous entries pass straight through to RTS)."""
+        moved = False
+        while self.fc_waiting:
+            item = self.fc_waiting[0]
+            if item.local_done:  # shed by a deadline while parked
+                self.fc_waiting.popleft()
+                continue
+            if item.rndv:
+                self.fc_waiting.popleft()
+                self._fc_rts_announce(item, fires, kick=False)
+                moved = True
+                continue
+            if not self._fc_admit(item.nbytes):
+                break
+            self.fc_waiting.popleft()
+            self._fc_dispatch_eager(item, fires, kick=False)
+            moved = True
+        if moved:
+            self.kick_tx(fires)
+
+    def _on_cts(self, msg_id: int, fires: list) -> None:
+        """Receiver granted the rendezvous: dispatch the pinned payload
+        as its pre-built T_SDATA frame.  A duplicate CTS (resume races)
+        is ignored -- only the "rts" state dispatches."""
+        ent = self.fc_rts.get(msg_id)
+        if ent is None or ent[1] != "rts":
+            return
+        ent[1] = "tx"
+        item = ent[0]
+        item.reset_for_replay()
+        self.tx.append(item)
+        self.kick_tx(fires)
+
+    def _fc_on_sack(self, msg_id: int, fires: list) -> bool:
+        """True when this SACK settled a §18 rendezvous send (the entry
+        -- and with it the payload pin -- is dropped; the op completed
+        locally at first byte, rndv semantics)."""
+        return self.fc_rts.pop(msg_id, None) is not None
+
+    def fc_rts_state(self, item):
+        """The fc_rts state ("rts"/"tx") owning ``item``, or None --
+        the deadline path's promised-send probe (core/engine.py)."""
+        for ent in self.fc_rts.values():
+            if ent[0] is item:
+                return ent[1]
+        return None
+
+    def _fc_reset_resume(self) -> None:
+        """Fresh window per incarnation (DESIGN.md §18): stale debits and
+        grant obligations die with the old transport.  Journal-replayed
+        DATA frames re-debit the fresh window (their replay WILL arrive,
+        and the receiver grants duplicates too -- conservation), parked
+        sends re-enter dispatch, and unSACKed rendezvous sends
+        re-announce (the receiver's assembly/done-LRU dedups)."""
+        self.fc_rx_gen += 1
+        self.fc_unexp = 0
+        self.fc_credits = self.fc_window
+        if self.sess is not None:
+            # Journal-replayed frames AND journal-backpressure-parked
+            # frames (sess.waiting) both ship in this incarnation and
+            # were admitted pre-suspend: re-debit both, or their wire
+            # bytes would oversubscribe the fresh window.
+            for it in list(self.sess.journal) + list(self.sess.waiting):
+                if isinstance(it, TxData):
+                    self.fc_credits -= it.nbytes
+        for msg_id, ent in self.fc_rts.items():
+            ent[1] = "rts"
+            ent[0].reset_for_replay()
+            self.tx.append(TxCtl(frames.pack_rts(ent[2], msg_id,
+                                                 ent[0].nbytes)))
+
+    # --------------------------------------------------- flow control (rx)
+    def fc_on_rts(self, tag: int, msg_id: int, total: int, fires: list) -> None:
+        """An RTS descriptor arrived: register the rendezvous offer with
+        the matcher through the devpull machinery (flush deferral,
+        truncation drain, and force-start come with it); CTS goes out
+        when a receive claims the record."""
+        rx = self._stripe_rx_tbl()
+        if msg_id in rx.done_ids:
+            # Late re-announcement of a completed message: re-SACK so the
+            # sender releases its pin.
+            StripeRx.sack(self, msg_id, total, fires)
+            return
+        msg = self.fc_rx.get(msg_id)
+        if msg is not None:
+            if msg_id in rx.asms:
+                # The CTS (or the delivery) died with an incarnation; the
+                # assembly survived -- just re-CTS.
+                self.send_ctl(frames.pack_cts(msg_id), fires)
+            elif (msg.remote is not None
+                  and (msg.posted is not None or msg.discard
+                       or msg.remote.started)):
+                # The CTS hop was consumed by a dead incarnation AFTER a
+                # claim (or drain) consumed the record: no future
+                # post_recv can re-fire it -- restart on the live conn
+                # (fc_start_rx dedups against a stale queued hop via the
+                # assembly table).
+                msg.remote.started = True
+                self.fc_start_rx(msg, fires)
+            return
+        handle = RtsHandle(self, msg_id, total, tag)
+        with self.worker.lock:
+            msg, f = self.worker.matcher.on_remote_message(tag, total, handle)
+        fires.extend(f)
+        handle.msg = msg
+        self.fc_rx[msg_id] = msg
+        self.remote_received(msg)
+        if msg.discard:
+            # Matched a too-small receive at announce time: the receive
+            # already failed "truncated", but the sender still pins the
+            # payload -- drain-CTS it so the pin (and any flush barrier)
+            # releases, exactly like a truncated devpull descriptor.
+            fires.append(lambda m=msg: m.remote.start(m))
+
+    def fc_start_rx(self, msg, fires: list) -> None:
+        """Engine-thread half of the CTS (RtsHandle.start hops here):
+        choose the sink, pre-register the assembly under the sender's
+        msg id, answer CTS.  The T_SDATA delivery then streams through
+        the ordinary stripe RX path."""
+        handle = msg.remote
+        if handle is None or msg.complete:
+            return
+        rx = self._stripe_rx_tbl()
+        if handle.msg_id in rx.asms:
+            return  # already registered (a duplicate/stale hop)
+        if not self.alive or self.sock is None:
+            # Dead/suspended: this hop is consumed, so re-arm the handle
+            # -- the resume re-announcement restarts it (fc_on_rts).
+            handle.started = False
+            return
+        handle.started = True
+        if not msg.discard and msg.posted is None and msg.spill is None:
+            # Force-started by a flush barrier before any receive
+            # matched: spill, like a drained devpull (exempt from the
+            # window -- the sender's flush asked for residency here).
+            msg.spill = bytearray(msg.length)
+            msg.sink = memoryview(msg.spill)
+        elif msg.posted is not None and msg.sink is None:
+            pr = msg.posted
+            if isinstance(pr.buf, memoryview):
+                msg.sink = pr.buf
+            else:
+                msg.sink = pr.buf.host_staging()
+        from .lane import StripeAsm
+
+        rx.asms[handle.msg_id] = StripeAsm(handle.msg_id, handle.tag,
+                                           msg.length, msg)
+        self.send_ctl(frames.pack_cts(handle.msg_id), fires)
 
     # ------------------------------------------------- devpull rx tracking
     def remote_received(self, msg) -> None:
@@ -1322,6 +1613,8 @@ class TcpConn(BaseConn):
                     self.worker._on_devpull(self, a, info, fires)
                     self._rx_e2e(len(body))
                     self._sess_commit()
+                elif ftype == frames.T_RTS:
+                    self.worker._on_rts(self, a, info, fires)
                 else:
                     self.worker._on_hello_ack(self, info, fires)
                 continue
@@ -1346,17 +1639,59 @@ class TcpConn(BaseConn):
                     self._sess_drop = False
                     if b:
                         self._rx_skip = b
+                        if self.fc_ok:
+                            # The dup was re-debited against the fresh
+                            # window at the sender's resume: grant it
+                            # back (no memory held -- credit
+                            # conservation, DESIGN.md §18).
+                            self.send_ctl(frames.pack_credit(b), fires)
                     continue
+                overload = False
+                spilled = False
                 with lock:
                     msg, f = matcher.on_message_start(a, b)
                     fires.extend(f)
+                    spilled = (b > 0 and not msg.discard
+                               and msg.posted is None
+                               and msg.spill is not None)
+                    # Tracked only when §18 is in play (fc negotiated or
+                    # the cap armed): the seed path must not pay an
+                    # engine op per unexpected message.
+                    if spilled and (self.fc_ok or self._unexp_cap):
+                        # Unexpected spill: charge this conn's window
+                        # accounting; the matcher returns the grant when
+                        # the bytes leave the queue (fc_release).
+                        matcher.fc_track(msg, self, self.fc_rx_gen, b)
+                        self.fc_unexp += b
+                        # Per-conn cap: the offender is the conn whose
+                        # own un-granted residency crossed the line
+                        # (total bound = cap x live conns), never an
+                        # innocent peer spilling into a full queue.
+                        overload = bool(self._unexp_cap
+                                        and self.fc_unexp
+                                        > self._unexp_cap)
                     if b == 0:
                         fires.extend(matcher.on_message_complete(msg))
                     else:
                         self._rx_msg = msg
+                if overload:
+                    # STARWAY_UNEXP_BYTES breaker: reset this conn
+                    # instead of letting the process OOM (last resort
+                    # for peers that never negotiated fc).
+                    logger.warning(
+                        "starway: unexpected-queue cap exceeded "
+                        "(%d > %d); resetting conn %s",
+                        self.fc_unexp, self._unexp_cap, self.conn_id)
+                    self.worker._conn_broken(self, fires)
+                    return
                 if b == 0:
                     self._rx_e2e(0)
                     self._sess_commit()
+                elif self.fc_ok and not spilled:
+                    # Matched at header (streams into the posted buffer)
+                    # or probe-discarded: no unexpected memory is held,
+                    # so the sender's debit returns immediately.
+                    self.send_ctl(frames.pack_credit(b), fires)
             elif ftype == frames.T_FLUSH:
                 if self._sess_drop:
                     self._sess_drop = False
@@ -1400,9 +1735,14 @@ class TcpConn(BaseConn):
                     return
                 self._sdata = (a, bytearray(frames.SDATA_SUB_SIZE), 0, b)
             elif ftype == frames.T_SACK:
-                root = self.stripe_root()
-                if root.stripe is not None:
-                    root.stripe.on_sack(a, fires)
+                if not self._fc_on_sack(a, fires):
+                    root = self.stripe_root()
+                    if root.stripe is not None:
+                        root.stripe.on_sack(a, fires)
+            elif ftype == frames.T_CREDIT:
+                self._on_credit(a, fires)
+            elif ftype == frames.T_CTS:
+                self._on_cts(a, fires)
             elif ftype == frames.T_PING:
                 # Liveness probe: answer immediately.  _rx_read already
                 # refreshed last_rx, so receiving PINGs also proves the
@@ -1412,7 +1752,8 @@ class TcpConn(BaseConn):
                               fires)
             elif ftype == frames.T_PONG:
                 self._on_pong(a, b)  # proof of life recorded by _rx_read
-            elif ftype in (frames.T_HELLO, frames.T_HELLO_ACK, frames.T_DEVPULL):
+            elif ftype in (frames.T_HELLO, frames.T_HELLO_ACK,
+                           frames.T_DEVPULL, frames.T_RTS):
                 if ftype == frames.T_DEVPULL and self._sess_drop:
                     self._sess_drop = False
                     if b:
@@ -1436,6 +1777,17 @@ class TcpConn(BaseConn):
             self.sess.journal.clear()
             self.sess.journal_bytes = 0
             self.sess.waiting.clear()
+        if self.fc_waiting:
+            # Flow-control-parked sends take the same fate as queued ones.
+            items.extend(self.fc_waiting)
+            self.fc_waiting.clear()
+        if self.fc_rts:
+            # Announced rendezvous sends: drop the pins, cancel the ops
+            # (a delivery item may also sit in tx -- cancel is
+            # idempotent, one count).
+            items.extend(ent[0] for ent in self.fc_rts.values())
+            self.fc_rts.clear()
+        self.fc_rx.clear()  # dedup index only; the matcher owns the records
         for item in items:
             before = len(fires)
             item.cancel(fires, reason)

@@ -108,6 +108,10 @@ class Worker:
         self._faulted = False
         self.matcher.counters = self.counters
         self.matcher.trace = self._trace
+        # §18 flow control: the matcher's grant hook runs under the
+        # worker lock and only enqueues an engine op (conn TX is
+        # engine-thread territory).
+        self.matcher.fc_grant = self._fc_enqueue_grant
         self.stage_scope = perf.StageScope(ring=self._trace)
         swtrace.register_worker(self)
         telemetry.register_worker(self)
@@ -300,6 +304,30 @@ class Worker:
                     return None
                 self._xfer_mgr = _device.TransferManager(config.advertised_host())
             return self._xfer_mgr
+
+    # -------------------------------------------------------- flow control
+    def _fc_enqueue_grant(self, conn, gen: int, nbytes: int) -> None:
+        """Matcher fc_release hook: hop the window grant onto the engine
+        thread.  Reentrant-safe (the worker lock is an RLock; the hook
+        fires from matcher paths already holding it)."""
+        with self.lock:
+            if self.status != state.RUNNING:
+                return
+            self._busy += 1
+            self.ops.append(("fc_grant", conn, gen, nbytes))
+        self._wake()
+
+    def _on_rts(self, conn, tag: int, info: dict, fires) -> None:
+        """A §18 rendezvous announcement arrived (conn.fc_on_rts owns the
+        mechanics).  Malformed fields parse as a drop, never a raise on
+        the engine thread (the _sess_int discipline)."""
+        if not conn.fc_ok:
+            return  # never negotiated: drop (protocol-violating peer)
+        msg_id = self._sess_int(info.get("m", 0))
+        total = self._sess_int(info.get("n", 0))
+        if msg_id == 0:
+            return
+        conn.fc_on_rts(tag, msg_id, total, fires)
 
     # ------------------------------------------------------ devpull inbound
     def _on_devpull(self, conn, tag: int, desc: dict, fires) -> None:
@@ -555,13 +583,18 @@ class Worker:
             self._expire_stripe(conn, item, fires)
             return
         started = False
+        shed = False
         with self.lock:
             if item.local_done:
                 return  # settled (completed locally, or cancelled)
             # A sequenced session frame was already promised to the peer
             # (withdrawing it would leave a seq hole the receiver must
-            # treat as a gap): expire it like a started send.
-            started = item.off > 0 or getattr(item, "sess_seq", 0) != 0
+            # treat as a gap): expire it like a started send.  An
+            # RTS-announced rendezvous send is promised the same way --
+            # the receiver holds a record a silent withdrawal would wedge.
+            started = (item.off > 0 or getattr(item, "sess_seq", 0) != 0
+                       or (getattr(conn, "fc_ok", False)
+                           and conn.fc_rts_state(item) is not None))
             sess = getattr(conn, "sess", None)
             if started and sess is not None and not sess.expired:
                 # Live session, sequenced frame: the send is PROMISED.
@@ -579,14 +612,24 @@ class Worker:
                 try:
                     conn.tx.remove(item)
                 except ValueError:
-                    # Session backpressure may have parked it unframed.
+                    # Session or flow-control backpressure may have
+                    # parked it unframed.
                     sess = getattr(conn, "sess", None)
                     if sess is not None and item in sess.waiting:
                         sess.waiting.remove(item)
+                    elif item in getattr(conn, "fc_waiting", ()):
+                        # Deadline-aware load shedding (DESIGN.md §18):
+                        # the receiver is saturated and this send's
+                        # deadline arrived first -- fail it locally, the
+                        # conn stays healthy.
+                        conn.fc_waiting.remove(item)
+                        shed = True
                     else:
                         return  # drained between checks
             item.local_done = True  # suppress the close-time cancel path
         self.counters.ops_timed_out += 1
+        if shed:
+            self.counters.sheds += 1
         if item.fail is not None:
             fires.append(lambda f=item.fail: f(REASON_TIMEOUT))
         if started:
@@ -698,6 +741,15 @@ class Worker:
             with self.lock:
                 fires.extend(self.matcher.on_remote_complete(msg, payload, error))
             msg.remote.conn.remote_resolved(msg, fires)
+        elif op[0] == "fc_grant":
+            _, conn, gen, nbytes = op
+            if gen == conn.fc_rx_gen:
+                conn.fc_unexp = max(0, conn.fc_unexp - nbytes)
+                if conn.alive and conn.fc_ok and conn.sock is not None:
+                    conn.send_ctl(frames.pack_credit(nbytes), fires)
+        elif op[0] == "fc_cts":
+            _, conn, msg = op
+            conn.fc_start_rx(msg, fires)
         elif op[0] == "flush":
             _, done, fail, conns, timeout = op
             self._start_flush(done, fail, conns, fires, timeout)
@@ -1141,6 +1193,13 @@ class ClientWorker(Worker):
                 # acceptor confirms "rails": "ok" and we dial the extra
                 # lanes right after the primary handshake.
                 extra["rails"] = str(rails_n)
+            fc_w = config.fc_window()
+            if fc_w > 0:
+                # Receiver-driven flow control offer (DESIGN.md §18):
+                # the value is OUR unexpected-queue budget for the
+                # peer's eager traffic; an fc-capable acceptor answers
+                # with its own window.
+                extra["fc"] = str(fc_w)
             if sess_on:
                 # Stable session id + epoch 0 (the acceptor assigns the
                 # real epoch); sess_ack is our cumulative rx seq (0 new).
@@ -1175,6 +1234,9 @@ class ClientWorker(Worker):
         conn.devpull_ok = ack.get("devpull") == "ok"
         conn.ka_ok = ack.get("ka") == "ok"
         conn.rails_ok = rails_n > 1 and ack.get("rails") == "ok"
+        if fc_w > 0 and self._sess_int(ack.get("fc", 0)) > 0:
+            conn.fc_ok = True
+            conn.fc_window = conn.fc_credits = self._sess_int(ack["fc"])
         if tr_offer and ack.get("tr") == "ok":
             conn.tr_id = tr_offer
         if sess_on and ack.get("sess") == "ok":
@@ -1304,6 +1366,11 @@ class ClientWorker(Worker):
         timeout = self._connect_timeout or config.connect_timeout()
         extra = {"ka": "ok", "sess": "ok", "sess_id": sess.sid,
                  "sess_epoch": sess.epoch, "sess_ack": str(sess.rx_cum)}
+        if config.fc_window() > 0:
+            # Fresh credit window per incarnation (DESIGN.md §18): both
+            # sides reset to their stored windows at resume; the key is
+            # re-advertised for wire-format consistency.
+            extra["fc"] = str(config.fc_window())
         from .. import device as _device
 
         if _device.devpull_supported():
@@ -1487,6 +1554,14 @@ class ServerWorker(Worker):
             # extra lanes (rail_of) right after this ACK.
             conn.rails_ok = True
             ack_extra["rails"] = "ok"
+        fc_w = config.fc_window()
+        if fc_w > 0 and self._sess_int(info.get("fc", 0)) > 0:
+            # Receiver-driven flow control (DESIGN.md §18): adopt the
+            # connector's advertised window for OUR sends, answer with
+            # our own for its sends.
+            conn.fc_ok = True
+            conn.fc_window = conn.fc_credits = self._sess_int(info["fc"])
+            ack_extra["fc"] = str(fc_w)
         if self._trace is not None and info.get("tr"):
             # swscope stitching: adopt the connector's trace-conn id so
             # both rings tag this conn's EV_E2E events identically.
@@ -1571,6 +1646,9 @@ class ServerWorker(Worker):
                 ack_extra["ka"] = "ok"
             if existing.devpull_ok:
                 ack_extra["devpull"] = "ok"
+            if existing.fc_ok:
+                ack_extra["fc"] = str(config.fc_window() or
+                                      existing.fc_window)
             existing.resume(
                 sock, peer_ack, fires,
                 ack_ctl=frames.pack_hello_ack(self.worker_id, ack_extra))

@@ -30,6 +30,9 @@ ACK       cumulative received seq      0
 BYE       0                            0
 SDATA     sender tag                   24-byte stripe sub-header + chunk
 SACK      striped message id           echoed message total (bytes)
+CREDIT    granted window bytes         0
+RTS       sender tag                   length of JSON descriptor that follows
+CTS       echoed rendezvous msg id     0
 ========= ============================ ======================================
 
 PING / PONG are the *negotiated* peer-liveness probe (``"ka": "ok"``
@@ -142,6 +145,29 @@ the sender's signal to release the pinned payload.  Old peers never
 negotiate ``rails`` and never see either frame; sub-threshold sends ride
 ordinary DATA frames on the primary rail even when striping is on.
 
+CREDIT / RTS / CTS are the *negotiated* receiver-driven flow-control
+plane (DESIGN.md §18).  A peer started with ``STARWAY_FC_WINDOW=N``
+offers ``"fc": "<N>"`` in HELLO -- "my unexpected-queue budget for your
+eager traffic is N bytes"; an fc-capable acceptor confirms with its own
+``"fc": "<M>"`` in HELLO_ACK and each direction is then governed by the
+RECEIVER's advertised window.  The sender debits the window per eager
+DATA payload and parks sends unframed-FIFO when it runs dry (one
+oversized frame is admitted against an idle window so nothing
+deadlocks); the receiver returns CREDIT grants (``a`` = bytes) as the
+debited messages are matched into posted receives or drained, which is
+what bounds receiver unexpected-queue memory to the window.  Sends
+above the rendezvous threshold never consume window: the sender
+announces them with a small RTS descriptor (``a`` = tag, JSON body
+``{"m": msg_id, "n": total}`` -- the devpull-descriptor shape, and the
+receiver queues it through the same matcher machinery), the receiver
+answers CTS (``a`` = msg_id) once a matching receive claims it (or a
+flush barrier forces it), and the payload then travels as one
+self-describing T_SDATA frame routed into the pre-registered assembly
+and SACKed at the last byte -- the sender pins the payload until that
+SACK, so a session resume can safely re-announce it.  Old peers never
+confirm ``fc`` and see none of the three frames; with the env unset the
+HELLO is byte-identical to the seed.
+
 FLUSH / FLUSH_ACK implement the delivery barrier: because the byte stream is
 processed in order, a FLUSH_ACK for sequence *n* proves every DATA payload
 enqueued before flush *n* has been fully ingested by the peer's matching
@@ -170,6 +196,16 @@ T_ACK = 10
 T_BYE = 11
 T_SDATA = 12
 T_SACK = 13
+T_CREDIT = 14
+T_RTS = 15
+T_CTS = 16
+
+# Rendezvous (RTS/CTS) message-id namespace bit (DESIGN.md §18): fc msg
+# ids carry the top bit so they can never collide with stripe msg ids on
+# a railed+fc conn -- both families share the receiver's assembly table
+# and completed-id LRU.  Cross-engine contract (FC_MSG_BIT in
+# sw_engine.cpp).
+FC_MSG_BIT = 1 << 63
 
 # Striped-DATA sub-header (DESIGN.md §17): u64 msg_id, u64 offset,
 # u64 total -- little-endian, leading every SDATA body.  The 24-byte size
@@ -259,6 +295,24 @@ def pack_sdata_header(tag: int, msg_id: int, offset: int, total: int,
 
 def pack_sack(msg_id: int, total: int) -> bytes:
     return pack_header(T_SACK, msg_id, total)
+
+
+def pack_credit(nbytes: int) -> bytes:
+    """Receiver-driven window grant: ``nbytes`` of eager budget returned
+    to the sender (DESIGN.md §18)."""
+    return pack_header(T_CREDIT, nbytes, 0)
+
+
+def pack_rts(tag: int, msg_id: int, total: int) -> bytes:
+    """Rendezvous announcement: a tiny descriptor instead of the payload
+    (the devpull-descriptor shape; the receiver pulls via CTS)."""
+    body = json.dumps({"m": msg_id, "n": total},
+                      separators=(",", ":")).encode()
+    return pack_header(T_RTS, tag, len(body)) + body
+
+
+def pack_cts(msg_id: int) -> bytes:
+    return pack_header(T_CTS, msg_id, 0)
 
 
 def pack_devpull(tag: int, desc: dict) -> bytes:

@@ -351,6 +351,13 @@ class StripeRx:
         worker = root.worker
         with worker.lock:
             fires.extend(worker.matcher.on_message_complete(msg))
+        if msg.remote is not None:
+            # §18 rendezvous delivery: resolve the descriptor record so
+            # deferred flush ACKs release, and let the now-complete
+            # message behave like ordinary staged data from here on.
+            msg.remote = None
+            root.fc_rx.pop(asm.msg_id, None)
+            root.remote_resolved(msg, fires)
         self.sack(conn, asm.msg_id, asm.total, fires)
         if root._ring is not None and root.tr_id:
             # swscope: ONE end-to-end marker per striped message, on the

@@ -102,7 +102,8 @@ class InboundMsg:
     """
 
     __slots__ = ("tag", "length", "sink", "received", "posted", "complete",
-                 "discard", "spill", "device_payload", "remote", "progress")
+                 "discard", "spill", "device_payload", "remote", "progress",
+                 "fc_owner", "fc_gen", "fc_bytes")
 
     def __init__(self, tag: int, length: int):
         self.tag = tag
@@ -114,6 +115,14 @@ class InboundMsg:
         self.discard = False
         self.spill: Optional[bytearray] = None
         self.device_payload = None
+        # Flow-control debt (DESIGN.md §18): a message spilled into the
+        # unexpected queue on a TCP conn carries its origin conn +
+        # incarnation generation + payload bytes, so the matcher can
+        # return the window grant the moment the memory is released
+        # (fc_release).  Zero/None on every other path.
+        self.fc_owner = None
+        self.fc_gen = 0
+        self.fc_bytes = 0
         # Remote-pull handle (device.py RemoteMsg): the payload lives on the
         # sender's transfer server until pulled.  Duck-typed: the matcher
         # only ever calls ``remote.start(msg)`` via fire thunks.
@@ -165,6 +174,37 @@ class TagMatcher:
         # under the worker lock the matcher runs beneath.
         self.counters = swtrace.Counters()
         self.trace = None
+        # Flow control (DESIGN.md §18): total payload bytes currently
+        # held by unexpected spill buffers (the STARWAY_UNEXP_BYTES cap
+        # surface), and the worker-installed grant hook -- called UNDER
+        # the worker lock (it only enqueues an engine op, never runs
+        # user code or touches conn I/O).
+        self.unexp_bytes = 0
+        self.fc_grant = None  # fn(conn, gen, nbytes) | None
+
+    # ------------------------------------------------------- flow control
+    def fc_track(self, msg: "InboundMsg", conn, gen: int, nbytes: int) -> None:
+        """Charge a spilled unexpected message against its origin conn's
+        window accounting.  Caller holds the worker lock."""
+        msg.fc_owner = conn
+        msg.fc_gen = gen
+        msg.fc_bytes = nbytes
+        self.unexp_bytes += nbytes
+
+    def fc_release(self, msg: "InboundMsg") -> None:
+        """The spilled message's bytes left the unexpected queue (matched,
+        truncated-dropped, purged): return the grant.  Idempotent; caller
+        holds the worker lock."""
+        n = msg.fc_bytes
+        if not n:
+            return
+        msg.fc_bytes = 0
+        self.unexp_bytes -= n
+        if self.unexp_bytes < 0:
+            self.unexp_bytes = 0
+        owner, msg.fc_owner = msg.fc_owner, None
+        if self.fc_grant is not None and owner is not None:
+            self.fc_grant(owner, msg.fc_gen, n)
 
     def _rec_match(self, tag: int, length: int) -> None:
         tr = self.trace
@@ -188,6 +228,7 @@ class TagMatcher:
             if msg.posted is None and not msg.discard and tags_match(msg.tag, tag, mask):
                 if msg.length > size:
                     self.unexpected.remove(msg)
+                    self.fc_release(msg)
                     fires.append(lambda fail=fail: fail(REASON_TRUNCATED))
                     if msg.remote is not None and not msg.complete:
                         # Unpulled remote payload: drain-pull it so the
@@ -208,6 +249,7 @@ class TagMatcher:
                     return fires
                 if msg.complete:
                     self.unexpected.remove(msg)
+                    self.fc_release(msg)
                     if msg.device_payload is not None:
                         _copy_complete(pr, msg.device_payload, msg.length)
                     else:
@@ -280,6 +322,7 @@ class TagMatcher:
                     self.unexpected.remove(msg)
                 except ValueError:
                     pass
+                self.fc_release(msg)
             elif not _is_host(pr.buf):
                 # Streamed straight into the device sink's staging buffer.
                 pr.buf.finalize_from_host(msg.length)
@@ -405,6 +448,7 @@ class TagMatcher:
             return
         msg.discard = True
         self.inflight.discard(msg)
+        self.fc_release(msg)
         if msg.posted is None:
             try:
                 self.unexpected.remove(msg)
@@ -488,4 +532,5 @@ class TagMatcher:
                 fires.append(lambda pr=pr: pr.fail(REASON_CANCELLED))
         self.inflight.clear()
         self.unexpected.clear()
+        self.unexp_bytes = 0  # close wipes the queue; grants are moot
         return fires

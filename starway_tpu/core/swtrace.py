@@ -119,6 +119,8 @@ COUNTER_NAMES = (
     "stripe_chunks_tx",   # striped chunks fully handed to a lane (§17)
     "stripe_chunks_rx",   # striped chunks ingested into an assembly
     "rail_resteals",      # chunks re-queued off a dead rail onto survivors
+    "sends_parked",       # sends parked by the §18 credit window
+    "sheds",              # parked sends failed by deadline-aware shedding
 )
 
 

@@ -66,6 +66,12 @@ GAUGE_NAMES = (
     "stripe_pending",   # striped chunks assigned to this lane but not yet
     #                     fully written (primary rows add undisbursed
     #                     chunks; DESIGN.md §17 rail balance)
+    "unexp_bytes",      # receiver-side unexpected-queue bytes this conn
+    #                     has spilled and not yet granted back (§18;
+    #                     populated only with fc or the cap armed -- the
+    #                     seed path carries no accounting)
+    "credits_avail",    # sender-side §18 credit remaining toward the peer
+    #                     (0 when flow control is off or exhausted)
 )
 
 
@@ -98,6 +104,7 @@ def conn_gauges(conn) -> dict:
         items = list(tx)
         sess = getattr(conn, "sess", None)
         waiting = list(sess.waiting) if sess is not None else []
+        waiting += list(getattr(conn, "fc_waiting", ()))  # §18 parked sends
         gauges["tx_queue_depth"] = len(items) + len(waiting)
         gauges["tx_queue_bytes"] = (
             sum(_item_remaining(i) for i in items)
@@ -121,6 +128,9 @@ def conn_gauges(conn) -> dict:
             pending += sum(len(s.pending) for s in grp.by_id.values()
                            if not s.sacked and not s.failed)
         gauges["stripe_pending"] = pending
+        gauges["unexp_bytes"] = int(getattr(conn, "fc_unexp", 0))
+        credits = int(getattr(conn, "fc_credits", 0))
+        gauges["credits_avail"] = credits if credits > 0 else 0
     except Exception:
         pass  # a conn torn down mid-snapshot yields a partial sample
     return gauges

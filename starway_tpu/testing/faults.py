@@ -34,6 +34,13 @@ Fault modes (constructor ``mode=``):
     Accept the client, never dial the target, read and discard inbound
     bytes, send nothing -- the accept-then-silence failure (a wedged or
     firewalled peer).
+``choke``
+    Accept and forward, but drain client->server traffic at
+    ``rate_bytes_per_s`` (small reads + proportional sleeps; the return
+    path stays a transparent pipe).  The reproducible slow consumer:
+    overload tests (DESIGN.md §18 flow control, bounded unexpected
+    queues, deadline shedding) get a receiver that genuinely cannot keep
+    up without real slow hardware or test-side sleeps.
 ``duplicate``
     Frame-aware c->s forwarding that sends every *sequenced* session unit
     (T_SEQ prefix + its frame, core/frames.py) past ``limit_bytes``
@@ -73,7 +80,7 @@ from typing import Optional
 _CHUNK = 1 << 16
 
 MODES = ("forward", "delay", "drop", "truncate", "blackhole", "duplicate",
-         "reorder")
+         "reorder", "choke")
 
 # Wire-format knowledge for the frame-aware modes (core/frames.py): 17-byte
 # little-endian header {u8 type, u64 a, u64 b}; HELLO/HELLO_ACK/DATA/DEVPULL
@@ -124,12 +131,14 @@ class _ConnPair:
 class FaultProxy:
     def __init__(self, target_host: str, target_port: int, mode: str = "forward",
                  *, listen_host: str = "127.0.0.1", delay: float = 0.0,
-                 limit_bytes: int = 0, partition_after: Optional[int] = None):
+                 limit_bytes: int = 0, partition_after: Optional[int] = None,
+                 rate_bytes_per_s: int = 64 * 1024):
         if mode not in MODES:
             raise ValueError(f"unknown fault mode {mode!r}; expected one of {MODES}")
         self.target = (target_host, target_port)
         self.mode = mode
         self.delay = delay
+        self.rate = max(1, int(rate_bytes_per_s))
         self.limit_bytes = limit_bytes
         self.partition_after = partition_after
         self._partitioned = threading.Event()
@@ -273,12 +282,17 @@ class FaultProxy:
 
     def _pump(self, pair: _ConnPair, src: socket.socket, dst: socket.socket,
               is_c2s: bool) -> None:
+        # choke (c->s only): small reads so the rate limit has fine
+        # granularity; the proportional sleep after each forward is what
+        # makes the drain rate real.
+        choked = is_c2s and self.mode == "choke"
+        chunk = min(_CHUNK, max(256, self.rate // 20)) if choked else _CHUNK
         while not self._stopping.is_set() and not pair.dead:
             while (self._stalled.is_set() and not self._stopping.is_set()
                    and not pair.dead):
                 time.sleep(0.01)  # backpressure: let kernel buffers fill
             try:
-                data = src.recv(_CHUNK)
+                data = src.recv(chunk)
             except OSError:
                 break
             if not data:
@@ -295,6 +309,8 @@ class FaultProxy:
                 continue  # swallowed: silence, not EOF
             if self.delay > 0:
                 time.sleep(self.delay)
+            if choked:
+                time.sleep(len(data) / self.rate)
             if is_c2s and self._reset_at is not None:
                 remaining = self._reset_at - self._c2s_bytes
                 if len(data) >= remaining:

@@ -499,10 +499,9 @@ async def test_concurrent_send_recv(port, transport):
         assert received_tags == set(range(n))
 
 
-async def test_bidirectional_traffic(port, transport):
+async def _bidirectional(port, n):
     async with gen_server_client(port) as (server, client):
         client_ep = server.list_clients().pop()
-        n = 2000
 
         server_sends = [server.asend(client_ep, np.array([i]), 100 + i) for i in range(n)]
         client_recvs = [client.arecv(np.zeros(1, dtype=np.uint8), 0, 0) for _ in range(n)]
@@ -514,6 +513,19 @@ async def test_bidirectional_traffic(port, transport):
         server_tags = {r[0] for r in results[3 * n :] if r is not None}
         assert client_tags == set(range(100, 100 + n))
         assert server_tags == set(range(200, 200 + n))
+
+
+async def test_bidirectional_traffic(port, transport):
+    # Moderate storm for the tier-1 process: the 2000-op variant below is
+    # load-flaky when the whole suite shares this 1-core box (noted in
+    # CHANGES PR 8), so the full-size storm runs @slow and tier-1 keeps a
+    # size that exercises the same fan-in/bidirectional machinery.
+    await _bidirectional(port, 600)
+
+
+@pytest.mark.slow
+async def test_bidirectional_traffic_storm(port, transport):
+    await _bidirectional(port, 2000)
 
 
 async def test_rapid_connect_close_client(port, transport):

@@ -287,8 +287,11 @@ async def test_keepalive_mixed_engine_interop(port, monkeypatch,
     if not native.available():
         pytest.skip("native engine unavailable (no toolchain)")
     monkeypatch.setenv("STARWAY_TLS", "tcp")
-    monkeypatch.setenv("STARWAY_KEEPALIVE", "0.15")
-    monkeypatch.setenv("STARWAY_KEEPALIVE_MISSES", "2")
+    # Wide-enough liveness window for a loaded 1-core tier-1 process: a
+    # starved engine thread must not miss a whole window and declare a
+    # healthy peer dead mid-test (noted load-flaky at 0.15s x 2).
+    monkeypatch.setenv("STARWAY_KEEPALIVE", "0.3")
+    monkeypatch.setenv("STARWAY_KEEPALIVE_MISSES", "4")
     monkeypatch.setenv("STARWAY_NATIVE", "1" if server_native else "0")
     server = Server()
     server.listen(ADDR, port)
@@ -299,7 +302,7 @@ async def test_keepalive_mixed_engine_interop(port, monkeypatch,
     try:
         await _roundtrip(client, server, 0x1)
         # Idle across > misses * interval: only PONGs keep the link alive.
-        await asyncio.sleep(0.8)
+        await asyncio.sleep(1.5)
         await _roundtrip(client, server, 0x2)  # both directions still deliver
         # Now the partition: both sides must detect death, so the client's
         # pending receive AND the server's pending receive fail.

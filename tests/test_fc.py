@@ -1,0 +1,478 @@
+"""Overload robustness (DESIGN.md §18): credit-based receiver flow
+control, bounded unexpected queues, and deadline-aware load shedding.
+
+The acceptance contract (ISSUE 9): with ``STARWAY_FC_WINDOW`` set, a
+sender flooding a recv-less peer holds receiver unexpected-queue bytes
+at or below the window (both engines), parked sends complete once
+receives are posted, a parked send with a deadline fails ``"timed out"``
+WITHOUT killing the conn, and rendezvous-size sends ride the
+receiver-pulled RTS/CTS path -- in all four engine pairings, including
+kill-and-resume with sessions on (fresh window per incarnation, no
+credit leak) and striped transfers.  With the env unset the HELLO is
+byte-identical to the seed (raw-socket inspection, both engines).
+
+Wall-clock bounds are loose (noisy CI box): they prove "bounded, not
+hung", not latency.
+"""
+
+import asyncio
+import json
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from starway_tpu import Client, Server
+from starway_tpu.core import frames
+from starway_tpu.testing.faults import FaultProxy
+
+pytestmark = pytest.mark.asyncio
+
+ADDR = "127.0.0.1"
+MASK = (1 << 64) - 1
+WINDOW = 64 * 1024
+
+PAIRS = ["py-py", "native-native", "py-native", "native-py"]
+
+
+def _need_native(*engines):
+    if "native" in engines:
+        from starway_tpu.core import native
+
+        if not native.available():
+            pytest.skip("native engine unavailable (no toolchain)")
+
+
+@pytest.fixture(params=PAIRS)
+def pair(request, monkeypatch):
+    s_eng, c_eng = request.param.split("-")
+    _need_native(s_eng, c_eng)
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_FC_WINDOW", str(WINDOW))
+    return s_eng, c_eng, monkeypatch
+
+
+def _mk_server(eng, monkeypatch, port):
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if eng == "native" else "0")
+    server = Server()
+    server.listen(ADDR, port)
+    return server
+
+
+def _mk_client(eng, monkeypatch):
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if eng == "native" else "0")
+    return Client()
+
+
+async def _aclose_all(*objs):
+    for o in objs:
+        try:
+            await asyncio.wait_for(o.aclose(), timeout=15)
+        except Exception:
+            pass
+
+
+def _unexp_bytes(owner) -> int:
+    g = owner.gauges_snapshot()
+    return sum(int(c.get("unexp_bytes", 0)) for c in g["conns"].values())
+
+
+def _credits(owner) -> list:
+    g = owner.gauges_snapshot()
+    return [int(c.get("credits_avail", 0)) for c in g["conns"].values()]
+
+
+# ---------------------------------------------------------------- tentpole
+
+
+async def test_flood_bound_and_park_complete(pair, port):
+    """A 5x-overwindow eager flood against a recv-less peer: receiver
+    unexpected bytes stay <= window, the overflow parks at the sender,
+    and everything completes exactly once when receives finally post."""
+    s_eng, c_eng, mp = pair
+    server = _mk_server(s_eng, mp, port)
+    client = _mk_client(c_eng, mp)
+    await asyncio.wait_for(client.aconnect(ADDR, port), 30)
+    try:
+        n, size = 40, 8192  # 320 KiB burst vs the 64 KiB window
+        sends = [client.asend(np.full(size, i % 251, dtype=np.uint8), 100 + i)
+                 for i in range(n)]
+        await asyncio.sleep(1.0)
+        unexp = _unexp_bytes(server._server)
+        assert 0 < unexp <= WINDOW, unexp
+        assert client._client.counters_snapshot()["sends_parked"] > 0
+        bufs = [np.zeros(size, dtype=np.uint8) for _ in range(n)]
+        recvs = [server.arecv(bufs[i], 0, 0) for i in range(n)]
+        await asyncio.wait_for(asyncio.gather(*sends), 60)
+        res = await asyncio.wait_for(asyncio.gather(*recvs), 60)
+        # FIFO matching preserved across parking: wildcard receives see
+        # the tags in send order.
+        assert [r[0] for r in res] == list(range(100, 100 + n))
+        for i in range(n):
+            assert bufs[i][0] == i % 251 and bufs[i][-1] == i % 251
+        await asyncio.wait_for(client.aflush(), 30)
+        await asyncio.sleep(0.5)
+        # Credit conservation: the full window is back once drained.
+        assert WINDOW in _credits(client._client)
+        assert _unexp_bytes(server._server) == 0
+    finally:
+        await _aclose_all(client, server)
+
+
+async def test_rts_rendezvous_path(pair, port):
+    """Sends above the rndv threshold never consume window: they RTS,
+    wait for the receiver's CTS (a matching receive), and deliver
+    byte-exactly -- while the unexpected queue stays empty of them."""
+    s_eng, c_eng, mp = pair
+    mp.setenv("STARWAY_RNDV_THRESHOLD", "65536")
+    server = _mk_server(s_eng, mp, port)
+    client = _mk_client(c_eng, mp)
+    await asyncio.wait_for(client.aconnect(ADDR, port), 30)
+    try:
+        big = (np.arange(300_000) % 251).astype(np.uint8)
+        send = client.asend(big, 777)
+        await asyncio.sleep(0.5)
+        # No CTS yet (no receive posted): the payload never hit the wire,
+        # so the receiver holds only the tiny descriptor record.
+        assert _unexp_bytes(server._server) == 0
+        sink = np.zeros(300_000, dtype=np.uint8)
+        stag, ln = await asyncio.wait_for(server.arecv(sink, 0, 0), 30)
+        await asyncio.wait_for(send, 30)
+        assert stag == 777 and ln == 300_000 and (sink == big).all()
+        # Flush-forced CTS: a barrier with no receive posted force-pulls
+        # into spill so the ACK can truthfully mean "resident here".
+        big2 = (np.arange(150_000) % 249).astype(np.uint8)
+        send2 = client.asend(big2, 778)
+        await asyncio.wait_for(client.aflush(), 30)
+        await asyncio.wait_for(send2, 10)
+        sink2 = np.zeros(150_000, dtype=np.uint8)
+        stag2, _ = await asyncio.wait_for(server.arecv(sink2, 0, 0), 30)
+        assert stag2 == 778 and (sink2 == big2).all()
+    finally:
+        await _aclose_all(client, server)
+
+
+@pytest.mark.parametrize("eng", ["py", "native"])
+async def test_parked_send_sheds_on_deadline(eng, port, monkeypatch):
+    """Deadline-aware load shedding: a parked send with timeout= fails
+    locally with the stable "timed out" reason and the conn STAYS
+    healthy -- later traffic still delivers."""
+    _need_native(eng)
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_FC_WINDOW", str(32 * 1024))
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if eng == "native" else "0")
+    server = Server()
+    server.listen(ADDR, port)
+    client = Client()
+    await asyncio.wait_for(client.aconnect(ADDR, port), 30)
+    try:
+        sends = [client.asend(np.full(16384, 7, dtype=np.uint8), 5)
+                 for _ in range(6)]  # 96 KiB > 32 KiB window: tail parks
+        await asyncio.sleep(0.3)
+        with pytest.raises(Exception) as e:
+            await asyncio.wait_for(
+                client.asend(np.full(16384, 9, dtype=np.uint8), 6,
+                             timeout=0.4), 20)
+        assert "timed out" in str(e.value).lower()
+        assert client._client.counters_snapshot()["sheds"] >= 1
+        # The conn survived the shed: drain the flood, then a fresh
+        # matched roundtrip.
+        bufs = [np.zeros(16384, dtype=np.uint8) for _ in range(6)]
+        recvs = [server.arecv(b, 5, MASK) for b in bufs]
+        await asyncio.wait_for(asyncio.gather(*sends, *recvs), 30)
+        ping = np.full(64, 3, dtype=np.uint8)
+        sink = np.zeros(64, dtype=np.uint8)
+        rf = server.arecv(sink, 0xAB, MASK)
+        await asyncio.wait_for(client.asend(ping, 0xAB), 10)
+        await asyncio.wait_for(rf, 10)
+        assert sink[0] == 3
+    finally:
+        await _aclose_all(client, server)
+
+
+async def test_session_resume_fresh_window(pair, port):
+    """Kill-and-resume with sessions + fc: parked sends re-enter
+    dispatch, the rendezvous send re-announces, everything completes
+    exactly once, and the window is fully restored (no credit leak --
+    the explore credit-conservation invariant, live)."""
+    s_eng, c_eng, mp = pair
+    mp.setenv("STARWAY_SESSION", "1")
+    mp.setenv("STARWAY_SESSION_GRACE", "30")
+    mp.setenv("STARWAY_RNDV_THRESHOLD", "65536")
+    server = _mk_server(s_eng, mp, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = _mk_client(c_eng, mp)
+    await asyncio.wait_for(client.aconnect(ADDR, proxy.port), 30)
+    try:
+        n, size = 12, 8192
+        sends = [client.asend(np.full(size, i % 251, dtype=np.uint8), 100 + i)
+                 for i in range(n)]
+        big = (np.arange(150_000) % 251).astype(np.uint8)
+        bigsend = client.asend(big, 999)
+        await asyncio.sleep(0.3)
+        proxy.kill_all(rst=True)  # mid-burst, mid-rendezvous
+        await asyncio.sleep(0.4)
+        bufs = [np.zeros(size, dtype=np.uint8) for _ in range(n)]
+        recvs = [server.arecv(bufs[i], 100 + i, MASK) for i in range(n)]
+        sink = np.zeros(150_000, dtype=np.uint8)
+        bigrecv = server.arecv(sink, 999, MASK)
+        await asyncio.wait_for(asyncio.gather(*sends, bigsend), 90)
+        res = await asyncio.wait_for(asyncio.gather(*recvs), 90)
+        stag, _ = await asyncio.wait_for(bigrecv, 90)
+        for i, (t, ln) in enumerate(res):
+            assert t == 100 + i and ln == size and bufs[i][0] == i % 251
+        assert stag == 999 and (sink == big).all()
+        await asyncio.wait_for(client.aflush(), 60)
+        await asyncio.sleep(0.5)
+        cs = client._client.counters_snapshot()
+        assert cs["sessions_resumed"] >= 1
+        assert WINDOW in _credits(client._client)  # fresh window, no leak
+        assert _unexp_bytes(server._server) == 0
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+async def test_rts_cts_hop_lost_with_incarnation_restarts(port, monkeypatch):
+    """White-box (py engine): a receive claims an inbound RTS record but
+    the CTS hop dies with the incarnation (engine op swallowed by the
+    kill).  No future post_recv can re-fire the claim, so the sender's
+    resume re-announcement must RESTART it -- without the fc_on_rts
+    restart branch the transfer wedges forever (review-found defect)."""
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_FC_WINDOW", str(WINDOW))
+    monkeypatch.setenv("STARWAY_SESSION", "1")
+    monkeypatch.setenv("STARWAY_SESSION_GRACE", "30")
+    monkeypatch.setenv("STARWAY_RNDV_THRESHOLD", "65536")
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = Client()
+    await asyncio.wait_for(client.aconnect(ADDR, proxy.port), 30)
+    try:
+        big = (np.arange(200_000) % 251).astype(np.uint8)
+        send = client.asend(big, 321)
+        sconn = None
+        for _ in range(400):  # wait for the RTS record to register
+            conns = list(server._server.conns.values())
+            if conns and conns[0].fc_rx:
+                sconn = conns[0]
+                break
+            await asyncio.sleep(0.01)
+        assert sconn is not None, "RTS record never arrived"
+        # Swallow the CTS hop, exactly as a kill between the claim and
+        # the engine op does (instance-attr patch wins over the method).
+        sconn.fc_start_rx = lambda msg, fires: None
+        sink = np.zeros(200_000, dtype=np.uint8)
+        recv = server.arecv(sink, 321, MASK)  # claims the record; hop lost
+        await asyncio.sleep(0.3)
+        del sconn.fc_start_rx  # restore the real method
+        proxy.kill_all(rst=True)  # the incarnation the hop died with
+        stag, ln = await asyncio.wait_for(recv, 60)
+        await asyncio.wait_for(send, 60)
+        assert stag == 321 and ln == 200_000 and (sink == big).all()
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+@pytest.mark.parametrize("eng", ["py", "native"])
+async def test_striped_transfers_with_fc_on(eng, port, monkeypatch):
+    """Striped sends are exempt from the window (explicit §18 invariant)
+    and must keep working byte-exactly with fc negotiated on the same
+    conn -- the two planes share the assembly table without collision."""
+    _need_native(eng)
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_FC_WINDOW", str(WINDOW))
+    monkeypatch.setenv("STARWAY_RAILS", "3")
+    monkeypatch.setenv("STARWAY_STRIPE_THRESHOLD", str(1 << 20))
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if eng == "native" else "0")
+    server = Server()
+    server.listen(ADDR, port)
+    client = Client()
+    await asyncio.wait_for(client.aconnect(ADDR, port), 30)
+    try:
+        size = 4 << 20
+        payload = np.frombuffer(
+            bytes(bytearray((i * 31 + 7) % 256 for i in range(256))) * (size // 256),
+            dtype=np.uint8).copy()
+        sink = np.zeros(size, dtype=np.uint8)
+        rf = server.arecv(sink, 0x51, MASK)
+        await asyncio.wait_for(client.asend(payload, 0x51), 60)
+        await asyncio.wait_for(client.aflush(), 60)
+        await asyncio.wait_for(rf, 60)
+        assert (sink == payload).all()
+        # Small eager traffic still rides the credit window beside it.
+        small = np.full(512, 9, dtype=np.uint8)
+        sink2 = np.zeros(512, dtype=np.uint8)
+        rf2 = server.arecv(sink2, 0x52, MASK)
+        await asyncio.wait_for(client.asend(small, 0x52), 20)
+        await asyncio.wait_for(rf2, 20)
+        assert sink2[0] == 9
+    finally:
+        await _aclose_all(client, server)
+
+
+# ------------------------------------------------------------- seed parity
+
+
+@pytest.mark.parametrize("eng", ["py", "native"])
+async def test_seed_parity_fc_unset(eng, port, monkeypatch):
+    """With STARWAY_FC_WINDOW unset the HELLO carries no "fc" key -- the
+    wire is byte-identical to the seed for old peers (raw-socket
+    inspection, the test_stripe seed-parity pattern)."""
+    _need_native(eng)
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.delenv("STARWAY_FC_WINDOW", raising=False)
+    monkeypatch.delenv("STARWAY_UNEXP_BYTES", raising=False)
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if eng == "native" else "0")
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.bind((ADDR, port))
+    listener.listen(4)
+    client = Client()
+    try:
+        fut = client.aconnect(ADDR, port)
+        conn, _ = listener.accept()
+        conn.settimeout(10)
+        hdr = b""
+        while len(hdr) < frames.HEADER_SIZE:
+            hdr += conn.recv(frames.HEADER_SIZE - len(hdr))
+        ftype, _a, blen = frames.unpack_header(hdr)
+        assert ftype == frames.T_HELLO
+        body = b""
+        while len(body) < blen:
+            body += conn.recv(blen - len(body))
+        hello = json.loads(body.decode())
+        assert "fc" not in hello, hello
+        conn.sendall(frames.pack_hello_ack("seedpeer"))
+        await asyncio.wait_for(fut, 30)
+        conn.close()
+    finally:
+        listener.close()
+        try:
+            await asyncio.wait_for(client.aclose(), 10)
+        except Exception:
+            pass
+
+
+@pytest.mark.parametrize("eng", ["py", "native"])
+async def test_fc_off_seed_failure_contract(eng, port, monkeypatch):
+    """With the env unset, an unmatched flood spills unbounded and never
+    parks -- the seed contract byte-for-byte (no grants, no parking,
+    no shedding)."""
+    _need_native(eng)
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.delenv("STARWAY_FC_WINDOW", raising=False)
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if eng == "native" else "0")
+    server = Server()
+    server.listen(ADDR, port)
+    client = Client()
+    await asyncio.wait_for(client.aconnect(ADDR, port), 30)
+    try:
+        n, size = 40, 8192
+        sends = [client.asend(np.full(size, i % 251, dtype=np.uint8), 100 + i)
+                 for i in range(n)]
+        await asyncio.wait_for(asyncio.gather(*sends), 30)  # nothing parks
+        await asyncio.wait_for(client.aflush(), 30)
+        assert client._client.counters_snapshot()["sends_parked"] == 0
+        # The whole burst spilled unexpected (the seed's unbounded
+        # queue; accounting is off on the seed path, so the gauge stays
+        # dark) and is still deliverable.
+        assert _unexp_bytes(server._server) == 0  # §18 accounting off
+        bufs = [np.zeros(size, dtype=np.uint8) for _ in range(n)]
+        recvs = [server.arecv(bufs[i], 0, 0) for i in range(n)]
+        await asyncio.wait_for(asyncio.gather(*recvs), 30)
+    finally:
+        await _aclose_all(client, server)
+
+
+# --------------------------------------------------- bounded queues (cap)
+
+
+@pytest.mark.parametrize("eng", ["py", "native"])
+async def test_unexp_cap_resets_offending_conn(eng, port, monkeypatch):
+    """STARWAY_UNEXP_BYTES is the last-resort breaker for peers that
+    never negotiated fc: the flooding conn is RESET (bounded memory,
+    live process) instead of the queue growing without limit."""
+    _need_native(eng)
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.delenv("STARWAY_FC_WINDOW", raising=False)
+    cap = 64 * 1024
+    monkeypatch.setenv("STARWAY_UNEXP_BYTES", str(cap))
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if eng == "native" else "0")
+    server = Server()
+    server.listen(ADDR, port)
+    # The cap is sampled at CONN creation, which happens at accept time
+    # -- keep the env in place until the handshake lands (the client
+    # side never spills here, so its cap is inert).
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+    client = Client()
+    await asyncio.wait_for(client.aconnect(ADDR, port), 30)
+    try:
+        sends = [client.asend(np.full(8192, i % 251, dtype=np.uint8), 100 + i)
+                 for i in range(40)]  # 320 KiB >> 64 KiB cap
+        res = await asyncio.wait_for(
+            asyncio.gather(*sends, return_exceptions=True), 30)
+        failed = [r for r in res if isinstance(r, Exception)]
+        if not failed:
+            # The burst fit the kernel buffers: the reset surfaces on the
+            # next op against the dead conn.
+            with pytest.raises(Exception):
+                await asyncio.wait_for(
+                    client.asend(np.zeros(8192, dtype=np.uint8), 999), 20)
+                await asyncio.wait_for(client.aflush(), 20)
+        # Bounded: residency never exceeded cap + one in-flight message.
+        assert _unexp_bytes(server._server) <= cap + 8192
+    finally:
+        await _aclose_all(client, server)
+
+
+# ---------------------------------------------------------- choke + soak
+
+
+async def test_choke_proxy_slow_consumer(port, monkeypatch):
+    """FaultProxy's choke mode drains at a configured rate: a burst that
+    would clear instantly takes at least bytes/rate seconds end to end
+    -- the reproducible slow consumer overload tests build on."""
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "0")
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port, mode="choke",
+                       rate_bytes_per_s=128 * 1024).start()
+    client = Client()
+    await asyncio.wait_for(client.aconnect(ADDR, proxy.port), 30)
+    try:
+        import time as _time
+
+        total = 256 * 1024  # 2 s at 128 KiB/s
+        bufs = [np.zeros(32 * 1024, dtype=np.uint8) for _ in range(8)]
+        recvs = [server.arecv(b, 0, 0) for b in bufs]
+        t0 = _time.monotonic()
+        sends = [client.asend(np.full(32 * 1024, i, dtype=np.uint8), i)
+                 for i in range(8)]
+        await asyncio.wait_for(asyncio.gather(*sends, *recvs), 60)
+        elapsed = _time.monotonic() - t0
+        assert elapsed >= 0.5 * (total / (128 * 1024)), elapsed
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()
+
+
+@pytest.mark.slow
+def test_overload_soak_script():
+    """The many-client overload soak (scripts/session_chaos.py
+    --overload) passes its own oracle end to end -- the CI session-chaos
+    job's long twin."""
+    repo = Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "session_chaos.py"),
+         "--overload", "--clients", "10", "--cycles", "3", "--n", "10"],
+        capture_output=True, text=True, timeout=600, cwd=repo)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"] and report["peak_unexp_bytes"] <= report["unexp_bound"]

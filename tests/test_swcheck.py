@@ -189,8 +189,8 @@ def test_garbled_doc_table(tmp_path):
 def test_version_drift(tmp_path):
     root = _seed(tmp_path)
     _edit(root, "native/sw_engine.cpp",
-          'return "starway-native-7"', 'return "starway-native-8"')
-    _assert_caught(root, "contract-version", "starway-native-8", "sw_engine.h")
+          'return "starway-native-8"', 'return "starway-native-9"')
+    _assert_caught(root, "contract-version", "starway-native-9", "sw_engine.h")
 
 
 def test_unmarked_multi_gib_test(tmp_path):
@@ -1055,3 +1055,87 @@ def test_sdata_dispatch_annotation_drift(tmp_path):
           "// swcheck: state(estab, SDATA, estab|down)",
           "// swcheck: state(estab, SDATA, estab)")
     _assert_caught(root, "proto-state", "SDATA", "conn.py")
+
+
+# ------------- ISSUE 9: the §18 flow-control contract surface
+
+
+def test_credit_frame_constant_drift(tmp_path):
+    # The new frame rows: T_CREDIT/T_RTS/T_CTS diverging between the
+    # engines (either direction) is a finding.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/frames.py", "T_CREDIT = 14", "T_CREDIT = 17")
+    _assert_caught(root, "contract-frames", "T_CREDIT", "frames.py")
+    root2 = _seed(tmp_path / "two")
+    _edit(root2, "native/sw_engine.cpp",
+          "constexpr uint8_t T_RTS = 15;", "constexpr uint8_t T_RTS = 18;")
+    _assert_caught(root2, "contract-frames", "T_RTS = 18", "frames.py")
+
+
+def test_fc_handshake_key_dropped(tmp_path):
+    # Deleting the "fc" negotiation from either engine's code fires,
+    # even when the key survives in comments/docstrings.
+    root = _seed(tmp_path)
+    p = root / "starway_tpu" / "core" / "engine.py"
+    p.write_text(p.read_text().replace('"fc"', '"fz"')
+                 + '\n# the "fc" key lives only in this comment now\n')
+    _assert_caught(root, "contract-handshake", '"fc"', "engine.py")
+    root2 = _seed(tmp_path / "two")
+    p2 = root2 / "native" / "sw_engine.cpp"
+    # The checker matches the bare `"fc"` code literal (the json_field
+    # reads); the escaped \"fc\" string-building fragments never match
+    # it, so renaming the reads alone must fire.
+    p2.write_text(p2.read_text().replace('"fc"', '"fz"')
+                  + '\n// the "fc" key lives only in this comment now\n')
+    _assert_caught(root2, "contract-handshake", '"fc"', "sw_engine.cpp")
+
+
+def test_fc_counter_dropped_from_native(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          '"sends_parked",      "sheds",', '"sends_parked_v2",      "sheds",')
+    _assert_caught(root, "contract-trace", "sends_parked_v2", "sw_engine.cpp")
+    _assert_caught(root, "contract-trace", "'sends_parked'", "swtrace.py")
+
+
+def test_fc_gauge_dropped_from_python(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/telemetry.py", '"credits_avail",', '')
+    _assert_caught(root, "contract-trace", "credits_avail", "sw_engine.cpp")
+
+
+def test_credit_doc_table_row_garbled(tmp_path):
+    # The CREDIT row of the frames.py docstring table must track
+    # T_CREDIT; a garbled label is "constant missing from the table".
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/frames.py",
+          "CREDIT    granted window bytes", "CREDITX   granted window bytes")
+    hits = _findings(root, "contract-doctable")
+    assert any("CREDITX" in f.message for f in hits), hits
+    assert any("missing from the docstring table" in f.message
+               for f in hits), hits
+
+
+def test_credit_state_annotation_drift(tmp_path):
+    # Re-routing the native CREDIT arm's annotated outcome must diff
+    # against the Python engine's extracted transition (the ISSUE-9
+    # `state(estab, CREDIT, estab)` requirement).
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          "// swcheck: state(estab, CREDIT, estab)",
+          "// swcheck: state(estab, CREDIT, estab|down)")
+    _assert_caught(root, "proto-state", "CREDIT", "conn.py")
+
+
+def test_explore_credit_conservation_mutation():
+    # The §18 credit-conservation invariant is backed by its seeded
+    # mutation: a resume carrying stale credits across the incarnation
+    # must make exactly it fire (the kill swallowed in-flight grants).
+    from starway_tpu.analysis import explore
+
+    clean = explore.check(None)
+    assert not any(v[0] == "credit-conservation"
+                   for v in clean["violations"]), clean["violations"]
+    leaked = explore.check("credit-leak")
+    fired = {v[0] for v in leaked["violations"]}
+    assert "credit-conservation" in fired, fired
