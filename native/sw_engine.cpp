@@ -32,6 +32,9 @@
 #include <sys/stat.h>
 #include <sys/uio.h>
 #include <unistd.h>
+#if defined(__aarch64__)
+#include <sys/auxv.h>
+#endif
 
 #include <algorithm>
 #include <atomic>
@@ -46,6 +49,7 @@
 #include <functional>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -91,6 +95,8 @@ constexpr uint8_t T_SACK = 13;    // striped-message assembly complete
 constexpr uint8_t T_CREDIT = 14;  // flow control: receiver window grant (§18)
 constexpr uint8_t T_RTS = 15;     // flow control: rendezvous announcement
 constexpr uint8_t T_CTS = 16;     // flow control: receiver pull grant
+constexpr uint8_t T_CSUM = 17;    // integrity: next frame's CRC32C (§19)
+constexpr uint8_t T_SNACK = 18;   // integrity: corrupt-chunk retransmit req
 constexpr size_t HEADER_SIZE = 17;
 // Rendezvous (RTS/CTS) msg-id namespace bit: fc ids carry the top bit so
 // they can never collide with stripe msg ids on a railed+fc conn (the
@@ -108,6 +114,7 @@ const char* kNotConnected = "Endpoint is not connected";
 const char* kTruncated = "Message truncated: payload larger than posted receive buffer";
 const char* kTimedOut = "Operation timed out (deadline exceeded before completion)";
 const char* kSessionExpired = "Session expired (resume window elapsed or peer restarted)";
+const char* kCorrupt = "Data integrity violation (corrupt frame detected)";
 
 using Clock = std::chrono::steady_clock;
 
@@ -162,6 +169,7 @@ const char* kCounterNames[] = {
     "stripe_chunks_tx",  "stripe_chunks_rx",
     "rail_resteals",
     "sends_parked",      "sheds",
+    "csum_fail",         "chunk_retx",
 };
 
 // swscope per-conn gauge vocabulary, same order as the values rendered by
@@ -177,6 +185,7 @@ const char* kGaugeNames[] = {
     "journal_bytes",   "journal_frames",
     "stripe_pending",
     "unexp_bytes",     "credits_avail",
+    "retx_pending",
 };
 
 struct Counters {
@@ -194,6 +203,7 @@ struct Counters {
   std::atomic<uint64_t> stripe_chunks_tx{0}, stripe_chunks_rx{0};
   std::atomic<uint64_t> rail_resteals{0};
   std::atomic<uint64_t> sends_parked{0}, sheds{0};
+  std::atomic<uint64_t> csum_fail{0}, chunk_retx{0};
 };
 
 inline void bump(std::atomic<uint64_t>& c, uint64_t n = 1) {
@@ -347,6 +357,13 @@ uint64_t unexp_cap_env() {
   return v;
 }
 
+// §19 end-to-end integrity plane (config.py STARWAY_INTEGRITY).  Off by
+// default: seed parity (no "csum" handshake key, no checksum frames).
+bool integrity_enabled() {
+  const char* e = getenv("STARWAY_INTEGRITY");
+  return e && *e && strcmp(e, "0") != 0;
+}
+
 uint64_t stripe_chunk_env() {
   const char* e = getenv("STARWAY_STRIPE_CHUNK");
   uint64_t v = e ? strtoull(e, nullptr, 10) : 0;
@@ -358,6 +375,205 @@ uint64_t stripe_chunk_env() {
     v = 4 * base;
   }
   return v < 4096 ? 4096 : v;
+}
+
+// ----------------------------------------------------------------- crc32c
+//
+// CRC32C (Castagnoli): the §19 integrity plane's checksum.  Hardware
+// SSE4.2 (x86) / ARMv8 CRC instructions when the host has them (runtime
+// detected), software slicing-by-8 otherwise.  Chaining matches
+// zlib.crc32: `seed` is the previous call's RESULT (each call re-inverts
+// internally), so payloads fold incrementally.  Exported as sw_crc32c so
+// the Python engine computes the identical function (core/frames.py).
+
+uint32_t crc_tbl[8][256];
+std::once_flag crc_tbl_once;
+
+void crc_tbl_init() {
+  for (int i = 0; i < 256; i++) {
+    uint32_t c = (uint32_t)i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+    crc_tbl[0][i] = c;
+  }
+  for (int t = 1; t < 8; t++)
+    for (int i = 0; i < 256; i++)
+      crc_tbl[t][i] = (crc_tbl[t - 1][i] >> 8) ^ crc_tbl[0][crc_tbl[t - 1][i] & 0xFF];
+}
+
+uint32_t crc32c_soft(const uint8_t* p, size_t n, uint32_t c) {
+  std::call_once(crc_tbl_once, crc_tbl_init);
+  while (n >= 8) {
+    uint32_t lo, hi;
+    memcpy(&lo, p, 4);      // x86/ARM LE, like the wire header
+    memcpy(&hi, p + 4, 4);
+    c ^= lo;
+    c = crc_tbl[7][c & 0xFF] ^ crc_tbl[6][(c >> 8) & 0xFF] ^
+        crc_tbl[5][(c >> 16) & 0xFF] ^ crc_tbl[4][c >> 24] ^
+        crc_tbl[3][hi & 0xFF] ^ crc_tbl[2][(hi >> 8) & 0xFF] ^
+        crc_tbl[1][(hi >> 16) & 0xFF] ^ crc_tbl[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) c = crc_tbl[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+  return c;
+}
+
+// GF(2) machinery for the 3-way interleaved hardware path: the CRC32
+// instruction has 3-cycle latency at 1/cycle throughput, so a single
+// dependency chain caps out near 8 bytes / 3 cycles.  Running three
+// independent chains over adjacent blocks and recombining with
+// precomputed shift-by-N tables (the classic crc32c technique) recovers
+// the instruction's full throughput -- ~3x, which is what keeps the
+// §19 overhead inside its bench gate on copy-saturated hosts.
+uint32_t gf2_matrix_times(const uint32_t* mat, uint32_t vec) {
+  uint32_t sum = 0;
+  while (vec) {
+    if (vec & 1) sum ^= *mat;
+    vec >>= 1;
+    mat++;
+  }
+  return sum;
+}
+
+void gf2_matrix_square(uint32_t* square, const uint32_t* mat) {
+  for (int n = 0; n < 32; n++) square[n] = gf2_matrix_times(mat, mat[n]);
+}
+
+// Operator advancing a CRC over `len` zero bytes (len a power of two).
+void crc32c_zeros_op(uint32_t* even, size_t len) {
+  uint32_t odd[32];
+  odd[0] = 0x82F63B78u;  // CRC-32C polynomial, reflected
+  uint32_t row = 1;
+  for (int n = 1; n < 32; n++) {
+    odd[n] = row;
+    row <<= 1;
+  }
+  gf2_matrix_square(even, odd);   // len == 2
+  gf2_matrix_square(odd, even);   // len == 4
+  do {
+    gf2_matrix_square(even, odd);
+    len >>= 1;
+    if (len == 0) return;
+    gf2_matrix_square(odd, even);
+    len >>= 1;
+  } while (len);
+  for (int n = 0; n < 32; n++) even[n] = odd[n];
+}
+
+void crc32c_zeros(uint32_t zeros[4][256], size_t len) {
+  uint32_t op[32];
+  crc32c_zeros_op(op, len);
+  for (uint32_t n = 0; n < 256; n++) {
+    zeros[0][n] = gf2_matrix_times(op, n);
+    zeros[1][n] = gf2_matrix_times(op, n << 8);
+    zeros[2][n] = gf2_matrix_times(op, n << 16);
+    zeros[3][n] = gf2_matrix_times(op, n << 24);
+  }
+}
+
+inline uint32_t crc32c_shift(const uint32_t zeros[4][256], uint32_t crc) {
+  return zeros[0][crc & 0xff] ^ zeros[1][(crc >> 8) & 0xff] ^
+         zeros[2][(crc >> 16) & 0xff] ^ zeros[3][crc >> 24];
+}
+
+constexpr size_t CRC_LONG = 2048, CRC_SHORT = 256;
+uint32_t crc_long_tbl[4][256], crc_short_tbl[4][256];
+std::once_flag crc_hw_tbl_once;
+
+void crc_hw_tbl_init() {
+  crc32c_zeros(crc_long_tbl, CRC_LONG);
+  crc32c_zeros(crc_short_tbl, CRC_SHORT);
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2")))
+uint32_t crc32c_hw(const uint8_t* p, size_t n, uint32_t c) {
+  std::call_once(crc_hw_tbl_once, crc_hw_tbl_init);
+  uint64_t crc0 = c;
+  while (n && ((uintptr_t)p & 7)) {
+    crc0 = __builtin_ia32_crc32qi((uint32_t)crc0, *p++);
+    n--;
+  }
+  while (n >= 3 * CRC_LONG) {
+    uint64_t crc1 = 0, crc2 = 0;
+    const uint8_t* end = p + CRC_LONG;
+    do {  // three independent dependency chains per iteration
+      uint64_t a, b, d;
+      memcpy(&a, p, 8);
+      memcpy(&b, p + CRC_LONG, 8);
+      memcpy(&d, p + 2 * CRC_LONG, 8);
+      crc0 = __builtin_ia32_crc32di(crc0, a);
+      crc1 = __builtin_ia32_crc32di(crc1, b);
+      crc2 = __builtin_ia32_crc32di(crc2, d);
+      p += 8;
+    } while (p < end);
+    crc0 = crc32c_shift(crc_long_tbl, (uint32_t)crc0) ^ crc1;
+    crc0 = crc32c_shift(crc_long_tbl, (uint32_t)crc0) ^ crc2;
+    p += 2 * CRC_LONG;
+    n -= 3 * CRC_LONG;
+  }
+  while (n >= 3 * CRC_SHORT) {
+    uint64_t crc1 = 0, crc2 = 0;
+    const uint8_t* end = p + CRC_SHORT;
+    do {
+      uint64_t a, b, d;
+      memcpy(&a, p, 8);
+      memcpy(&b, p + CRC_SHORT, 8);
+      memcpy(&d, p + 2 * CRC_SHORT, 8);
+      crc0 = __builtin_ia32_crc32di(crc0, a);
+      crc1 = __builtin_ia32_crc32di(crc1, b);
+      crc2 = __builtin_ia32_crc32di(crc2, d);
+      p += 8;
+    } while (p < end);
+    crc0 = crc32c_shift(crc_short_tbl, (uint32_t)crc0) ^ crc1;
+    crc0 = crc32c_shift(crc_short_tbl, (uint32_t)crc0) ^ crc2;
+    p += 2 * CRC_SHORT;
+    n -= 3 * CRC_SHORT;
+  }
+  while (n >= 8) {
+    uint64_t v;
+    memcpy(&v, p, 8);
+    crc0 = __builtin_ia32_crc32di(crc0, v);
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc0 = __builtin_ia32_crc32qi((uint32_t)crc0, *p++);
+  return (uint32_t)crc0;
+}
+
+bool crc32c_hw_ok() {
+  static const bool ok = __builtin_cpu_supports("sse4.2");
+  return ok;
+}
+#elif defined(__aarch64__)
+__attribute__((target("+crc")))
+uint32_t crc32c_hw(const uint8_t* p, size_t n, uint32_t c) {
+  while (n >= 8) {
+    uint64_t v;
+    memcpy(&v, p, 8);
+    c = __builtin_aarch64_crc32cx(c, v);
+    p += 8;
+    n -= 8;
+  }
+  while (n--) c = __builtin_aarch64_crc32cb(c, *p++);
+  return c;
+}
+
+bool crc32c_hw_ok() {
+  static const bool ok = (getauxval(AT_HWCAP) & (1ul << 7)) != 0;  // HWCAP_CRC32
+  return ok;
+}
+#else
+uint32_t crc32c_hw(const uint8_t* p, size_t n, uint32_t c) {
+  return crc32c_soft(p, n, c);
+}
+bool crc32c_hw_ok() { return false; }
+#endif
+
+uint32_t crc32c(const uint8_t* p, size_t n, uint32_t seed) {
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  c = crc32c_hw_ok() ? crc32c_hw(p, n, c) : crc32c_soft(p, n, c);
+  return c ^ 0xFFFFFFFFu;
 }
 
 // ------------------------------------------------------- shared-memory rings
@@ -376,6 +592,11 @@ constexpr size_t SM_GLOBAL_HDR = 64;
 constexpr size_t SM_RING_HDR = 128;
 constexpr size_t SM_DATA_OFF = SM_GLOBAL_HDR + 2 * SM_RING_HDR;  // 384
 constexpr size_t SM_OFF_TAIL = 0, SM_OFF_HEAD = 64;  // +8: reserved (legacy flag)
+// §19 integrity slot-record header inside the data ring: u32 payload len,
+// u32 CRC32C(u64 slot seqno LE || payload) -- little-endian, leading
+// every ring write once "csum" is negotiated (core/shmring.py REC_HDR is
+// the Python twin; both sides flip framing at handshake).
+constexpr size_t SM_REC_HDR = 8;
 
 // Doorbell byte values on an sm-upgraded conn's socket (contract shared
 // with the Python engine -- core/conn.py).  Any byte wakes the peer;
@@ -414,38 +635,110 @@ struct SmRing {
   uint8_t* hdr = nullptr;
   uint8_t* data = nullptr;
   uint64_t size = 0;
+  // §19 integrity slot records (enabled at handshake once "csum" is
+  // negotiated): producer/consumer slot counters + the record the
+  // consumer is mid-way through.  These live in the per-conn copy of the
+  // ring view, not the shared segment -- each side counts its own role.
+  bool slotted = false;
+  uint64_t tx_seq = 0, rx_seq = 0;
+  uint32_t rec_left = 0, rec_crc = 0, rec_accum = 0;
 
   std::atomic<uint64_t>& tail() const { return *reinterpret_cast<std::atomic<uint64_t>*>(hdr + SM_OFF_TAIL); }
   std::atomic<uint64_t>& head() const { return *reinterpret_cast<std::atomic<uint64_t>*>(hdr + SM_OFF_HEAD); }
 
   uint64_t readable() const { return tail().load(std::memory_order_acquire) - head().load(std::memory_order_relaxed); }
 
+  void put(uint64_t cursor, const uint8_t* src, size_t n) {
+    uint64_t idx = cursor & (size - 1);
+    size_t first = (size_t)(size - idx) < n ? (size_t)(size - idx) : n;
+    memcpy(data + idx, src, first);
+    if (n > first) memcpy(data, src + first, n - first);
+  }
+
+  void take(uint64_t cursor, uint8_t* dst, size_t n) {
+    uint64_t idx = cursor & (size - 1);
+    size_t first = (size_t)(size - idx) < n ? (size_t)(size - idx) : n;
+    memcpy(dst, data + idx, first);
+    if (n > first) memcpy(dst + first, data, n - first);
+  }
+
   size_t write(const uint8_t* src, size_t len) {
     uint64_t t = tail().load(std::memory_order_relaxed);
     uint64_t h = head().load(std::memory_order_acquire);
     uint64_t free_b = size - (t - h);
-    size_t n = len < free_b ? len : (size_t)free_b;
+    if (!slotted) {
+      size_t n = len < free_b ? len : (size_t)free_b;
+      if (n == 0) return 0;
+      put(t, src, n);
+      tail().store(t + n, std::memory_order_release);
+      return n;
+    }
+    // Slotted: frame the accepted bytes as ONE checksummed record with a
+    // single tail publication -- readers always see whole records.
+    if (free_b <= SM_REC_HDR) return 0;
+    size_t n = len < free_b - SM_REC_HDR ? len : (size_t)(free_b - SM_REC_HDR);
     if (n == 0) return 0;
-    uint64_t idx = t & (size - 1);
-    size_t first = (size_t)(size - idx) < n ? (size_t)(size - idx) : n;
-    memcpy(data + idx, src, first);
-    if (n > first) memcpy(data, src + first, n - first);
-    tail().store(t + n, std::memory_order_release);
+    uint8_t seq8[8];
+    memcpy(seq8, &tx_seq, 8);
+    uint32_t crc = crc32c(src, n, crc32c(seq8, 8, 0));
+    tx_seq++;
+    uint8_t rec[SM_REC_HDR];
+    uint32_t n32 = (uint32_t)n;
+    memcpy(rec, &n32, 4);
+    memcpy(rec + 4, &crc, 4);
+    put(t, rec, SM_REC_HDR);
+    put(t + SM_REC_HDR, src, n);
+    tail().store(t + SM_REC_HDR + n, std::memory_order_release);
     return n;
   }
 
-  size_t read_into(uint8_t* dst, size_t len) {
-    uint64_t t = tail().load(std::memory_order_acquire);
-    uint64_t h = head().load(std::memory_order_relaxed);
-    uint64_t avail = t - h;
-    size_t n = len < avail ? len : (size_t)avail;
-    if (n == 0) return 0;
-    uint64_t idx = h & (size - 1);
-    size_t first = (size_t)(size - idx) < n ? (size_t)(size - idx) : n;
-    memcpy(dst, data + idx, first);
-    if (n > first) memcpy(dst + first, data, n - first);
-    head().store(h + n, std::memory_order_release);
-    return n;
+  // >=0 bytes read; -1 = a slot record failed verification at dequeue
+  // (torn write / bit-flip / stale slot): the conn must poison "corrupt".
+  ssize_t read_into(uint8_t* dst, size_t len) {
+    if (!slotted) {
+      uint64_t t = tail().load(std::memory_order_acquire);
+      uint64_t h = head().load(std::memory_order_relaxed);
+      uint64_t avail = t - h;
+      size_t n = len < avail ? len : (size_t)avail;
+      if (n == 0) return 0;
+      take(h, dst, n);
+      head().store(h + n, std::memory_order_release);
+      return (ssize_t)n;
+    }
+    size_t total = 0;
+    for (;;) {
+      uint64_t t = tail().load(std::memory_order_acquire);
+      uint64_t h = head().load(std::memory_order_relaxed);
+      uint64_t avail = t - h;
+      if (rec_left == 0) {
+        if (avail < SM_REC_HDR) break;
+        uint8_t rec[SM_REC_HDR];
+        take(h, rec, SM_REC_HDR);
+        uint32_t n32 = 0, crc = 0;
+        memcpy(&n32, rec, 4);
+        memcpy(&crc, rec + 4, 4);
+        if (n32 == 0 || n32 > size) return -1;  // garbled record header
+        head().store(h + SM_REC_HDR, std::memory_order_release);
+        rec_left = n32;
+        rec_crc = crc;
+        uint8_t seq8[8];
+        memcpy(seq8, &rx_seq, 8);
+        rec_accum = crc32c(seq8, 8, 0);
+        rx_seq++;
+        continue;
+      }
+      if (total >= len || avail == 0) break;
+      size_t n = len - total;
+      if (n > rec_left) n = rec_left;
+      if (n > avail) n = (size_t)avail;
+      take(h, dst + total, n);
+      rec_accum = crc32c(dst + total, n, rec_accum);
+      head().store(h + n, std::memory_order_release);
+      rec_left -= (uint32_t)n;
+      total += n;
+      if (rec_left == 0 && rec_accum != rec_crc) return -1;
+    }
+    return (ssize_t)total;
   }
 };
 
@@ -1247,6 +1540,17 @@ struct Conn {
   uint64_t fc_unexp = 0, fc_rx_gen = 0;
   std::unordered_map<uint64_t, InboundMsg*> fc_rx;
   uint64_t unexp_cap = 0;
+  // --- §19 integrity plane (core/conn.py is the twin) ---
+  // csum_ok arms TX framing + RX verification; poison overrides the
+  // cancel reason at terminal teardown ("corrupt"); csum_pend/f/h/accum
+  // are the RX verification state for the frame announced by the last
+  // T_CSUM; retx_offs tracks NACK-requeued chunks until rewritten (the
+  // `retx_pending` gauge, primary conns only).
+  bool csum_ok = false;
+  const char* poison = nullptr;
+  bool csum_pend = false;
+  uint32_t csum_f = 0, csum_h = 0, csum_accum = 0;
+  std::set<std::pair<uint64_t, uint64_t>> retx_offs;
 
   bool has_unfinished_data() const {
     for (auto& t : tx) {
@@ -1259,6 +1563,12 @@ struct Conn {
   void adopt_sm(SmSegment* seg, bool creator, bool defer_tx) {
     sm = seg;
     seg->tx_rx(creator, &sm_tx, &sm_rx);
+    if (csum_ok) {
+      // §19: the rings carry checksummed slot records from the first
+      // byte (both sides decided at handshake, before any ring traffic).
+      sm_tx.slotted = true;
+      sm_rx.slotted = true;
+    }
     sm_active = true;
     sm_negotiated = true;
     seg->unlink();
@@ -1435,6 +1745,114 @@ struct Worker {
 
   void ep_del(int fd) { epoll_ctl(epfd, EPOLL_CTL_DEL, fd, nullptr); }
 
+  // ---------------------------------------------------------- integrity
+  // Embed the T_CSUM prefix into one tx item's framed bytes (DESIGN.md
+  // §19).  Runs at dispatch, after the item's final wire header exists
+  // and BEFORE any session T_SEQ framing, so the wire order is
+  // [SEQ][CSUM][frame] and journal replays stay byte-identical.
+  // crc_head (`b`) covers the 17-byte header (+ the 24-byte stripe
+  // sub-header for T_SDATA); crc_frame (`a`) every byte of the frame.
+  static void csum_arm(Conn* c, TxItem& item) {
+    if (!c->csum_ok || item.header.empty()) return;
+    uint8_t t = item.header[0];
+    if (t == T_HELLO || t == T_HELLO_ACK) return;  // handshake: unwrapped
+    size_t head_n = HEADER_SIZE;
+    if (t == T_SDATA) head_n = HEADER_SIZE + SDATA_SUB_SIZE;
+    if (head_n > item.header.size()) head_n = item.header.size();
+    uint32_t ch = crc32c(item.header.data(), head_n, 0);
+    uint32_t cf = ch;
+    if (item.header.size() > head_n)
+      cf = crc32c(item.header.data() + head_n, item.header.size() - head_n,
+                  cf);
+    if (item.payload && item.paylen) cf = crc32c(item.payload, item.paylen, cf);
+    std::vector<uint8_t> pre(HEADER_SIZE + item.header.size());
+    pack_header(pre.data(), T_CSUM, cf, ch);
+    memcpy(pre.data() + HEADER_SIZE, item.header.data(), item.header.size());
+    item.header = std::move(pre);
+  }
+
+  // Offset of the data frame's own header inside item.header, past any
+  // embedded T_SEQ / T_CSUM prefixes (tag extraction for trace events).
+  static size_t data_hdr_off(const TxItem& item) {
+    size_t off = 0;
+    while (off + HEADER_SIZE <= item.header.size() &&
+           (item.header[off] == T_SEQ || item.header[off] == T_CSUM))
+      off += HEADER_SIZE;
+    return off;
+  }
+
+  // Unrepairable verification failure: poison the conn with the stable
+  // "corrupt" reason.  Without a session this takes the §10 failure
+  // contract; with a live one conn_broken suspends instead and the
+  // journal replay re-delivers verified bytes exactly-once.
+  void conn_corrupt(Conn* c, const char* what, FireList& fires) {
+    bump(counters.csum_fail);
+    SW_DEBUG("integrity failure on conn %llu: %s", (unsigned long long)c->id,
+             what);
+    c->poison = kCorrupt;
+    if (!c->sess || c->sess->expired) c->sess_fail = kCorrupt;
+    conn_broken(c, fires);
+  }
+
+  // The receiver NACKed one striped chunk (payload checksum failed with
+  // an intact sub-header): re-queue JUST that chunk.  Payloads are
+  // pinned until T_SACK, so the resend is always legal; the receiver's
+  // offset dedup never recorded the corrupt chunk, so the retransmit
+  // streams into the same sink region (core/conn.py _on_snack twin).
+  void on_snack(Conn* c, uint64_t msg_id, uint64_t off, FireList& fires) {
+    if (c->fc_ok) {
+      auto it = c->fc_rts.find(msg_id);
+      if (it != c->fc_rts.end()) {
+        // §18 rendezvous delivery (one self-describing chunk): the whole
+        // frame rides again, exactly like a CTS re-dispatch.
+        if (it->second.announced) return;  // not dispatched yet
+        TxRef item = it->second.item;
+        for (auto& ref : c->tx)
+          if (ref == item) return;  // still (re)transmitting
+        item->off = 0;
+        bump(counters.chunk_retx);
+        c->tx.push_back(item);
+        kick_tx(c, fires);
+        return;
+      }
+    }
+    Conn* root = stripe_root(c);
+    auto sit = root->stripe_by_id.find(msg_id);
+    if (sit == root->stripe_by_id.end()) return;
+    StripeRef src = sit->second;
+    if (src->sacked || src->failed || off >= src->total ||
+        (src->chunk && off % src->chunk))
+      return;  // settled or garbled: a late SACK/redispatch covers it
+    if (std::find(src->pending.begin(), src->pending.end(), off) !=
+        src->pending.end())
+      return;  // duplicate NACK: already queued for resend
+    for (auto& [cid, v] : src->rail_offs)
+      if (std::find(v.begin(), v.end(), off) != v.end())
+        return;  // already back in flight on some lane
+    bool removed = false;
+    for (auto& [cid, v] : src->done_offs) {
+      auto p = std::find(v.begin(), v.end(), off);
+      if (p != v.end()) {
+        v.erase(p);
+        removed = true;
+        break;
+      }
+    }
+    if (!removed) return;  // ledger cleared by a resume: redispatch covers
+    src->pending.push_back(off);
+    src->unwritten++;
+    bump(counters.chunk_retx);
+    root->retx_offs.insert({msg_id, off});
+    bool queued = false;
+    for (auto& q : root->stripe_q)
+      if (q.get() == src.get()) {
+        queued = true;
+        break;
+      }
+    if (!queued) root->stripe_q.push_back(src);
+    stripe_dispatch(root, fires);
+  }
+
   // -------------------------------------------------------------- sends
   static void fire_op_release(const Op& op, FireList& fires) {
     if (op.release) {
@@ -1482,6 +1900,7 @@ struct Worker {
       fc_send(c, item, fires);
       return;
     }
+    csum_arm(c, *item);
     c->dirty = true;
     c->data_counter++;
     if (c->sess) {
@@ -1515,6 +1934,7 @@ struct Worker {
 
   void fc_dispatch_eager(Conn* c, const TxRef& item, FireList& fires,
                          bool kick = true) {
+    csum_arm(c, *item);
     c->dirty = true;
     c->data_counter++;
     if (c->sess) {
@@ -1544,6 +1964,7 @@ struct Worker {
     memcpy(item->header.data() + HEADER_SIZE + 16, &item->paylen, 8);
     item->rndv = true;
     item->hold_release = true;  // pinned until SACK (resend must be legal)
+    csum_arm(c, *item);  // covers header+sub-header+payload (§19)
     c->fc_rts[mid] = Conn::FcRts{item, true, item->tag};
     std::string body = "{\"m\": " + std::to_string(mid) +
                        ", \"n\": " + std::to_string(item->paylen) + "}";
@@ -1784,6 +2205,7 @@ struct Worker {
     item->header.resize(HEADER_SIZE + body.size());
     pack_header(item->header.data(), type, a, b);
     if (!body.empty()) memcpy(item->header.data() + HEADER_SIZE, body.data(), body.size());
+    csum_arm(c, *item);
     item->switch_after = switch_after;
     if (sess_frame && c->sess) {
       // FLUSH / FLUSH_ACK are sequenced session frames: a barrier (or its
@@ -1811,6 +2233,7 @@ struct Worker {
     item->header.resize(HEADER_SIZE + op.body.size());
     pack_header(item->header.data(), T_DEVPULL, op.tag, op.body.size());
     memcpy(item->header.data() + HEADER_SIZE, op.body.data(), op.body.size());
+    csum_arm(c, *item);
     item->is_data = true;  // local completion at full write; flush-counted
     item->done = op.done;
     item->fail = op.fail;
@@ -2003,6 +2426,8 @@ struct Worker {
     c->rx_skip = 0;
     c->sess_drop = false;
     c->sess_pending = 0;
+    c->csum_pend = false;  // per-incarnation: replay re-announces (§19)
+    c->csum_accum = 0;
     // Striped rx parser state is per-incarnation; the ASSEMBLIES survive
     // (the resumed sender re-dispatches un-SACKed sources and offset
     // dedup keeps bytes exactly-once).
@@ -2280,6 +2705,10 @@ struct Worker {
       // reset to their stored windows at resume; the key is
       // re-advertised for wire-format consistency.
       hello += ", \"fc\": \"" + std::to_string(fc_w) + "\"";
+    if (integrity_enabled())
+      // §19: re-offered per incarnation for wire-format consistency
+      // (csum_ok is sticky on the session conn either way).
+      hello += ", \"csum\": \"1\"";
     hello += "}";
     return blocking_dial(hello, out_fd, out_ack);
   }
@@ -2315,6 +2744,7 @@ struct Worker {
           "\", \"sess\": \"ok\", \"sess_epoch\": \"" + existing->sess->epoch +
           "\", \"sess_ack\": \"" + std::to_string(existing->sess->rx_cum) +
           "\"" + (existing->ka_ok ? ", \"ka\": \"ok\"" : "") +
+          (existing->csum_ok ? ", \"csum\": \"ok\"" : "") +
           (existing->devpull_ok ? ", \"devpull\": \"ok\"" : "") +
           (existing->fc_ok
                ? ", \"fc\": \"" +
@@ -2409,6 +2839,9 @@ struct Worker {
       item.off = 0;
       item.stripe = src;
       item.stripe_off = off;
+      // §19: every chunk frame self-verifies; per-lane -- each rail
+      // negotiated csum in its own handshake (core/lane.py twin).
+      csum_arm(lane, item);
       return true;
     }
     return false;
@@ -2419,6 +2852,7 @@ struct Worker {
   void stripe_tx_chunk_finished(Conn* lane, TxItem& item, FireList& fires) {
     StripeRef src = item.stripe;
     bump(counters.stripe_chunks_tx);
+    stripe_root(lane)->retx_offs.erase({src->msg_id, item.stripe_off});
     src->writers--;
     if (src->unwritten > 0) src->unwritten--;
     auto it = src->rail_offs.find(lane->id);
@@ -2528,6 +2962,8 @@ struct Worker {
   void stripe_on_sack(Conn* root, uint64_t msg_id, FireList& fires) {
     auto it = root->stripe_by_id.find(msg_id);
     if (it == root->stripe_by_id.end()) return;
+    for (auto rit = root->retx_offs.begin(); rit != root->retx_offs.end();)
+      rit = rit->first == msg_id ? root->retx_offs.erase(rit) : std::next(rit);
     StripeRef src = it->second;
     root->stripe_by_id.erase(it);
     if (!src->sacked) {
@@ -2585,6 +3021,7 @@ struct Worker {
   // wholesale resend exactly-once.
   void stripe_redispatch(Conn* root, FireList& fires) {
     root->stripe_q.clear();
+    root->retx_offs.clear();  // wholesale resend supersedes NACKs (§19)
     std::vector<uint64_t> ids;
     for (auto& [mid, src] : root->stripe_by_id) ids.push_back(mid);
     std::sort(ids.begin(), ids.end());
@@ -2622,6 +3059,7 @@ struct Worker {
       stripe_maybe_release(*src, fires);
     }
     c->stripe_q.clear();
+    c->retx_offs.clear();
     if (!c->stripe_asm.empty()) {
       std::lock_guard<std::mutex> g(mu);
       for (auto& [mid, a] : c->stripe_asm) {
@@ -2766,6 +3204,8 @@ struct Worker {
       return;
     }
     if (json_field(body, "ka") == "ok") c->ka_ok = true;
+    if (integrity_enabled() && !json_field(body, "csum").empty())
+      c->csum_ok = true;
     c->rail_parent = primary->id;
     primary->rails.push_back(c->id);
     {
@@ -2774,7 +3214,8 @@ struct Worker {
     }
     std::string ack = std::string("{\"worker_id\": \"") + worker_id +
                       "\", \"rail\": \"ok\"" +
-                      (c->ka_ok ? ", \"ka\": \"ok\"" : "") + "}";
+                      (c->ka_ok ? ", \"ka\": \"ok\"" : "") +
+                      (c->csum_ok ? ", \"csum\": \"ok\"" : "") + "}";
     conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires);
     trace.rec(kEvConnUp, 0, c->id);
     if (!primary->stripe_q.empty()) stripe_dispatch(primary, fires);
@@ -2791,7 +3232,8 @@ struct Worker {
           std::string("{\"worker_id\": \"") + worker_id +
           "\", \"mode\": \"" + c_mode + "\", \"name\": \"\", \"rail_of\": \"" +
           worker_id + "\", \"rail_idx\": \"" + std::to_string(i + 1) +
-          "\", \"ka\": \"ok\"}";
+          "\", \"ka\": \"ok\"" +
+          (integrity_enabled() ? ", \"csum\": \"1\"" : "") + "}";
       if (!blocking_dial(hello, &fd, &ack) || json_field(ack, "rail") != "ok") {
         SW_DEBUG("rail %d dial failed; striping over fewer lanes", i + 1);
         if (fd >= 0) close(fd);
@@ -2803,6 +3245,7 @@ struct Worker {
       r->mode = c_mode;
       r->peer_name = primary->peer_name;
       r->ka_ok = json_field(ack, "ka") == "ok";
+      r->csum_ok = integrity_enabled() && json_field(ack, "csum") == "ok";
       r->rail_parent = primary->id;
       r->remote_addr = c_host;
       r->remote_port = c_port;
@@ -3060,7 +3503,7 @@ struct Worker {
     bump(counters.sends_completed);
     if (trace.enabled && item.header.size() >= HEADER_SIZE) {
       uint64_t tag = 0;
-      size_t toff = item.sess_seq ? HEADER_SIZE : 0;  // skip the T_SEQ prefix
+      size_t toff = data_hdr_off(item);  // skip T_SEQ / T_CSUM prefixes
       memcpy(&tag, item.header.data() + toff + 1, 8);
       trace.rec(kEvSendDone, tag, c->id, item.paylen);
     }
@@ -3240,12 +3683,18 @@ struct Worker {
   // the socket (doorbell channel) in conn_readable.
   ssize_t stream_read(Conn* c, uint8_t* dst, size_t want, FireList& fires) {
     if (c->sm_active) {
-      size_t n = c->sm_rx.read_into(dst, want);
+      ssize_t n = c->sm_rx.read_into(dst, want);
+      if (n < 0) {
+        // §19: a torn/corrupt ring slot, caught at dequeue before its
+        // bytes could be parsed -- poison with the stable reason.
+        conn_corrupt(c, "sm slot record", fires);
+        return -1;
+      }
       if (n > 0) {
         c->last_rx = Clock::now();
         bump(counters.bytes_rx, (uint64_t)n);
       }
-      return (ssize_t)n;
+      return n;
     }
     ssize_t r = ::recv(c->fd, dst, want, 0);
     if (r > 0) {
@@ -3306,7 +3755,15 @@ struct Worker {
                                                      : (size_t)c->rx_skip;
         ssize_t r = stream_read(c, c->scratch.data(), want, fires);
         if (r <= 0) return;
+        if (c->csum_pend)
+          c->csum_accum = crc32c(c->scratch.data(), (size_t)r, c->csum_accum);
         c->rx_skip -= (uint64_t)r;
+        if (c->rx_skip == 0 && c->csum_pend) {
+          // A drained frame (duplicate seq / superseded chunk) ends
+          // here: verify for accounting only -- nothing was delivered.
+          c->csum_pend = false;
+          if (c->csum_accum != c->csum_f) bump(counters.csum_fail);
+        }
         continue;
       }
       if (c->sdata_active) {
@@ -3315,9 +3772,18 @@ struct Worker {
         ssize_t r = stream_read(c, c->sdata_sub + c->sdata_got,
                                 SDATA_SUB_SIZE - c->sdata_got, fires);
         if (r <= 0) return;
+        if (c->csum_pend)
+          c->csum_accum = crc32c(c->sdata_sub + c->sdata_got, (size_t)r,
+                                 c->csum_accum);
         c->sdata_got += (size_t)r;
         if (c->sdata_got < SDATA_SUB_SIZE) continue;
         c->sdata_active = false;
+        if (c->csum_pend && c->csum_accum != c->csum_h) {
+          // Routing fields (header+sub-header) cannot be trusted: a
+          // NACK would carry garbage ids -- poison instead (§19).
+          conn_corrupt(c, "stripe sub-header checksum", fires);
+          return;
+        }
         stripe_rx_resolve(c, fires);
         continue;
       }
@@ -3341,8 +3807,26 @@ struct Worker {
         }
         ssize_t r = stream_read(c, target, want, fires);
         if (r <= 0) return;
+        if (c->csum_pend)
+          c->csum_accum = crc32c(target, (size_t)r, c->csum_accum);
         c->rx_stripe_got += (uint64_t)r;
         if (c->rx_stripe_got < c->rx_stripe_len) continue;
+        if (c->csum_pend) {
+          c->csum_pend = false;
+          if (c->csum_accum != c->csum_f) {
+            // Chunk payload corrupt, routing verified: NACK just this
+            // chunk (§19).  The offset was never recorded in the
+            // assembly, so the retransmit streams into the same sink
+            // region; the conn stays healthy.
+            StripeAsm* bad = c->rx_stripe;
+            uint64_t bad_off = c->rx_stripe_off;
+            c->rx_stripe = nullptr;
+            c->rx_stripe_got = 0;
+            bump(counters.csum_fail);
+            conn_send_ctl(c, T_SNACK, bad->msg_id, bad_off, "", fires);
+            continue;
+          }
+        }
         stripe_rx_chunk_done(c, fires);
         continue;
       }
@@ -3364,8 +3848,19 @@ struct Worker {
         }
         ssize_t r = stream_read(c, target, want, fires);
         if (r <= 0) return;
+        if (c->csum_pend)
+          c->csum_accum = crc32c(target, (size_t)r, c->csum_accum);
         m->received += (uint64_t)r;
         if (m->received >= m->length) {
+          if (c->csum_pend) {
+            // Verified BEFORE the matcher completes the receive: corrupt
+            // bytes must never reach user code as good data (§19).
+            c->csum_pend = false;
+            if (c->csum_accum != c->csum_f) {
+              conn_corrupt(c, "payload checksum (DATA)", fires);
+              return;
+            }
+          }
           uint64_t mlen = m->length;
           {
             std::lock_guard<std::mutex> g(mu);
@@ -3384,8 +3879,17 @@ struct Worker {
         uint8_t tmp[4096];
         ssize_t r = stream_read(c, tmp, want > sizeof(tmp) ? sizeof(tmp) : want, fires);
         if (r <= 0) return;
+        if (c->csum_pend)
+          c->csum_accum = crc32c(tmp, (size_t)r, c->csum_accum);
         c->ctl_body.append((char*)tmp, (size_t)r);
         if (c->ctl_body.size() < c->ctl_need) continue;
+        if (c->csum_pend) {
+          c->csum_pend = false;
+          if (c->csum_accum != c->csum_f) {
+            conn_corrupt(c, "control body checksum", fires);
+            return;
+          }
+        }
         int t = c->ctl_type;
         uint64_t ctl_a = c->ctl_a;
         std::string body = std::move(c->ctl_body);
@@ -3408,12 +3912,54 @@ struct Worker {
       }
       ssize_t r = stream_read(c, c->hdr + c->hdr_got, HEADER_SIZE - c->hdr_got, fires);
       if (r <= 0) return;
+      if (c->csum_pend)
+        // The protected frame's header is covered too: a corrupted
+        // length field must never desync the stream (§19).
+        c->csum_accum = crc32c(c->hdr + c->hdr_got, (size_t)r, c->csum_accum);
       c->hdr_got += (size_t)r;
       if (c->hdr_got < HEADER_SIZE) continue;
       c->hdr_got = 0;
       uint8_t type;
       uint64_t a, b;
       unpack_header(c->hdr, &type, &a, &b);
+      if (c->csum_ok) {
+        // §19 verification gate, BEFORE dispatch: arm on T_CSUM, require
+        // one for every protected frame, validate routing fields the
+        // moment they are parsed.
+        // swcheck: state(estab, CSUM, estab|down)
+        if (type == T_CSUM) {
+          if (c->csum_pend) {
+            conn_corrupt(c, "nested checksum prefix", fires);
+            return;
+          }
+          c->csum_pend = true;
+          c->csum_f = (uint32_t)a;
+          c->csum_h = (uint32_t)b;
+          c->csum_accum = 0;
+          continue;
+        }
+        if (type != T_HELLO && type != T_HELLO_ACK && type != T_SEQ) {
+          if (!c->csum_pend) {
+            conn_corrupt(c, "frame without checksum", fires);
+            return;
+          }
+          if (type != T_SDATA && c->csum_accum != c->csum_h) {
+            conn_corrupt(c, "frame header checksum", fires);
+            return;
+          }
+          bool body_follows =
+              type == T_SDATA ||
+              ((type == T_DATA || type == T_DEVPULL || type == T_RTS) && b > 0);
+          if (!body_follows) {
+            // Header-only frame: the header IS the frame.
+            c->csum_pend = false;
+            if (c->csum_accum != c->csum_f) {
+              conn_corrupt(c, "frame checksum", fires);
+              return;
+            }
+          }
+        }
+      }
       switch (type) {
         // swcheck: state(estab, DATA, estab|down)
         case T_DATA: {
@@ -3545,6 +4091,11 @@ struct Worker {
           stripe_on_sack(root, a, fires);
           break;
         }
+        // swcheck: state(estab, SNACK, estab)
+        case T_SNACK:
+          // §19 chunk-level retransmit request from the receiver.
+          on_snack(c, a, b, fires);
+          break;
         // swcheck: state(estab, CREDIT, estab)
         case T_CREDIT:
           fc_on_credit(c, a, fires);
@@ -3762,15 +4313,18 @@ struct Worker {
     c->alive = false;
     ep_del(c->fd);
     trace.rec(kEvConnDown, 0, c->id);
-    sess_cancel_terminal(c, fires, kCancelled);
-    fc_cancel_terminal(c, fires, kCancelled);
+    // A §19 poison owns the cancel reason: in-flight ops report
+    // "corrupt", not a generic cancel (core/conn.py mark_dead twin).
+    const char* reason = c->poison ? c->poison : kCancelled;
+    sess_cancel_terminal(c, fires, reason);
+    fc_cancel_terminal(c, fires, reason);
     for (auto& ref : c->tx) {
       TxItem& item = *ref;
       if (item.is_data && !item.local_done && item.fail) {
         item.local_done = true;
         auto fail = item.fail; auto ctx = item.ctx;
         bump(counters.ops_cancelled);
-        fires.push_back([fail, ctx] { fail(ctx, kCancelled); });
+        fires.push_back([fail, ctx, reason] { fail(ctx, reason); });
       }
       fire_release(item, fires, /*force=*/true);
     }
@@ -3790,7 +4344,7 @@ struct Worker {
       std::lock_guard<std::mutex> g(mu);
       matcher.purge_remote_conn(c->id);
     }
-    stripe_terminal(c, kCancelled, fires);
+    stripe_terminal(c, reason, fires);
     if (c->rail_parent) {
       // A secondary lane died: the endpoint survives; its claimed-but-
       // unacked chunks re-queue onto the surviving lanes.
@@ -3834,9 +4388,17 @@ struct Worker {
       // (T_BYE) so it fails over to the seed death contract instead of
       // suspending for the grace window.  Best-effort -- a lost BYE only
       // costs the peer the grace-expiry fallback.
-      uint8_t hdr[HEADER_SIZE];
-      pack_header(hdr, T_BYE, 0, 0);
-      (void)!send(c->fd, hdr, HEADER_SIZE, MSG_NOSIGNAL | MSG_DONTWAIT);
+      uint8_t bye[2 * HEADER_SIZE];
+      pack_header(bye + HEADER_SIZE, T_BYE, 0, 0);
+      size_t bye_off = HEADER_SIZE, bye_n = HEADER_SIZE;
+      if (c->csum_ok) {
+        // §19: even the goodbye is checksummed (uniform "every frame").
+        uint32_t ch = crc32c(bye + HEADER_SIZE, HEADER_SIZE, 0);
+        pack_header(bye, T_CSUM, ch, ch);
+        bye_off = 0;
+        bye_n = 2 * HEADER_SIZE;
+      }
+      (void)!send(c->fd, bye + bye_off, bye_n, MSG_NOSIGNAL | MSG_DONTWAIT);
     }
     sess_cancel_terminal(c, fires, kCancelled);
     fc_cancel_terminal(c, fires, kCancelled);
@@ -3914,6 +4476,9 @@ struct Worker {
         seg = SmSegment::attach(key, nonce, rsz);
       }
     }
+    // §19 integrity negotiation, decided BEFORE the sm adopt below: the
+    // rings' slot-record framing must be agreed before any ring byte.
+    c->csum_ok = integrity_enabled() && !json_field(body, "csum").empty();
     if (seg) c->adopt_sm(seg, /*creator=*/false, /*defer_tx=*/true);
     {
       std::lock_guard<std::mutex> g(mu);
@@ -3953,6 +4518,7 @@ struct Worker {
                       (c->rails_ok ? ", \"rails\": \"ok\"" : "") +
                       (c->fc_ok ? ", \"fc\": \"" + std::to_string(fc_w) + "\""
                                 : "") +
+                      (c->csum_ok ? ", \"csum\": \"ok\"" : "") +
                       (c->tr_hex[0] ? ", \"tr\": \"ok\"" : "") + sess_ext + "}";
     // The ACK is the transport switch point (see TxItem::switch_after).
     conn_send_ctl(c, T_HELLO_ACK, 0, ack.size(), ack, fires,
@@ -4091,7 +4657,7 @@ struct Worker {
         auto fail = item.fail; auto ctx = item.ctx;
         bump(counters.ops_timed_out);
         uint64_t tg = 0;
-        size_t toff = item.sess_seq ? HEADER_SIZE : 0;
+        size_t toff = data_hdr_off(item);
         if (item.header.size() >= toff + HEADER_SIZE)
           memcpy(&tg, item.header.data() + toff + 1, 8);
         trace.rec(kEvOpFail, tg, c->id, item.paylen, kTimedOut);
@@ -4276,7 +4842,8 @@ struct Worker {
       }
       uint64_t credits = c->fc_credits > 0 ? (uint64_t)c->fc_credits : 0;
       const uint64_t vals[] = {depth, qbytes, infl, inflr, jb, jf, sp,
-                               c->fc_unexp, credits};
+                               c->fc_unexp, credits,
+                               (uint64_t)c->retx_offs.size()};
       static_assert(sizeof(vals) / sizeof(vals[0]) ==
                         sizeof(kGaugeNames) / sizeof(kGaugeNames[0]),
                     "gauge names and values out of sync");
@@ -4611,6 +5178,12 @@ struct ClientWorker : Worker {
       // is OUR unexpected-queue budget for the peer's eager traffic.
       hello += ", \"fc\": \"" + std::to_string(fc_w) + "\"";
     }
+    bool integ = integrity_enabled();
+    if (integ) {
+      // End-to-end integrity offer (DESIGN.md §19): an integrity-capable
+      // acceptor confirms "csum": "ok" and every later frame checksums.
+      hello += ", \"csum\": \"1\"";
+    }
     char tr_offer[17] = {0};
     if (trace.enabled) {
       // swscope stitching: offer a fresh trace-conn id (DESIGN.md §15).
@@ -4683,6 +5256,7 @@ struct ClientWorker : Worker {
         c->fc_credits = (int64_t)peer_w;
       }
     }
+    c->csum_ok = integ && json_field(ack_body, "csum") == "ok";
     if (tr_offer[0] && json_field(ack_body, "tr") == "ok")
       memcpy(c->tr_hex, tr_offer, sizeof(c->tr_hex));
     if (sess_on && json_field(ack_body, "sess") == "ok") {
@@ -4764,7 +5338,10 @@ extern "C" {
 // 8: receiver-driven flow control (T_CREDIT window grants, T_RTS/T_CTS
 //    rendezvous pull, "fc" handshake, bounded unexpected queues +
 //    deadline-aware shedding)
-const char* sw_version() { return "starway-native-8"; }
+// 9: end-to-end integrity plane (T_CSUM per-frame CRC32C, T_SNACK
+//    chunk-level retransmit, checksummed sm slot records, "csum"
+//    handshake, "corrupt" poison reason -- DESIGN.md §19)
+const char* sw_version() { return "starway-native-9"; }
 
 // Portable cursor atomics for the Python engine's sm ring (sw_engine.h).
 // std::atomic_ref would be C++20-tidy but libstdc++'s needs alignment UB
@@ -4776,6 +5353,13 @@ uint64_t sw_atomic_load_u64(const void* p) {
 
 void sw_atomic_store_u64(void* p, uint64_t v) {
   __atomic_store_n(static_cast<uint64_t*>(p), v, __ATOMIC_RELEASE);
+}
+
+// §19 integrity checksum (sw_engine.h): hardware CRC32C with software
+// fallback; the Python engine calls this same export (core/frames.py) so
+// mixed pairs agree bit-for-bit.
+uint32_t sw_crc32c(const void* p, uint64_t n, uint32_t seed) {
+  return crc32c(static_cast<const uint8_t*>(p), (size_t)n, seed);
 }
 
 // ----- client
@@ -5106,6 +5690,7 @@ int sw_counters(void* h, char* out, int cap) {
       c.stripe_chunks_tx.load(), c.stripe_chunks_rx.load(),
       c.rail_resteals.load(),
       c.sends_parked.load(),   c.sheds.load(),
+      c.csum_fail.load(),      c.chunk_retx.load(),
   };
   constexpr size_t kN = sizeof(kCounterNames) / sizeof(kCounterNames[0]);
   static_assert(sizeof(vals) / sizeof(vals[0]) == kN,

@@ -75,11 +75,14 @@ typedef void (*sw_event_cb)(void* ctx, const char* event, uint64_t conn_id);
  * §15) + multi-rail striping (T_SDATA/T_SACK chunk frames, the
  * "rails"/"rail_of" handshake keys, chunk-level work stealing with
  * offset-dedup reassembly and SACK-covered flush barriers -- DESIGN.md
- * §17).  The annotation below is machine-checked against the
+ * §17) + the end-to-end integrity plane (T_CSUM per-frame CRC32C
+ * prefixes, T_SNACK chunk-level retransmit, checksummed sm slot records,
+ * the "csum" handshake key and the stable "corrupt" poison reason --
+ * DESIGN.md §19).  The annotation below is machine-checked against the
  * sw_engine.cpp implementation by the contract checker (python -m
  * starway_tpu.analysis, rule contract-version) -- bump BOTH when the
  * protocol changes.
- * swcheck: engine-version "starway-native-8" */
+ * swcheck: engine-version "starway-native-9" */
 const char* sw_version(void);
 
 /* Allocate a client/server worker in the VOID state.  `worker_id` is the
@@ -300,6 +303,15 @@ void sw_free(void* h);
  * both engines on the same segment layout. */
 uint64_t sw_atomic_load_u64(const void* p);
 void sw_atomic_store_u64(void* p, uint64_t v);
+
+/* CRC32C (Castagnoli) over `n` bytes at `p`, chained onto a previous
+ * call's RESULT via `seed` (the zlib.crc32 calling convention: pass 0 to
+ * start, the last return value to continue).  Hardware SSE4.2 / ARMv8
+ * CRC instructions when the host supports them, software slicing-by-8
+ * otherwise.  This is the §19 integrity plane's checksum; the PYTHON
+ * engine calls this same export (core/frames.py crc32c), so both engines
+ * -- and both ends of a mixed pair -- agree bit-for-bit. */
+uint32_t sw_crc32c(const void* p, uint64_t n, uint32_t seed);
 
 #ifdef __cplusplus
 } /* extern "C" */

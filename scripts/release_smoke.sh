@@ -18,25 +18,26 @@ rm -rf "$WORK"
 mkdir -p "$WORK"
 WORK="$(cd "$WORK" && pwd)"   # later steps cd around; must be absolute
 
-echo "== 1/8 swcheck: cross-engine contract + concurrency lint"
+echo "== 1/9 swcheck: cross-engine contract + concurrency lint"
 # Nothing ships until the two engines agree on the wire format, shm
 # layout, ABI, and reason strings (python -m starway_tpu.analysis,
 # DESIGN.md §11).  Runs from the repo tree, before any artifact exists.
 python -m starway_tpu.analysis
 
-echo "== 2/8 sdist build (python -m build --sdist --no-isolation)"
+echo "== 2/9 sdist build (python -m build --sdist --no-isolation)"
 python -m build --sdist --no-isolation --outdir "$WORK/dist" . >"$WORK/build.log" 2>&1 \
   || { tail -20 "$WORK/build.log"; exit 1; }
 SDIST="$(ls "$WORK"/dist/*.tar.gz)"
 echo "   $SDIST"
 
-echo "== 3/8 sdist completeness (native sources + tests ship)"
+echo "== 3/9 sdist completeness (native sources + tests ship)"
 tar tzf "$SDIST" | sed 's|^[^/]*/||' | sort > "$WORK/filelist"
 for f in native/sw_engine.cpp native/sw_engine.h native/CMakeLists.txt \
          tests/test_basic.py tests/conftest.py starway_tpu/api.py \
          starway_tpu/models/llama.py starway_tpu/native_build.py \
          starway_tpu/analysis/__main__.py tests/test_swcheck.py \
-         tests/test_session.py scripts/session_chaos.py; do
+         tests/test_session.py scripts/session_chaos.py \
+         tests/test_integrity.py starway_tpu/testing/faults.py; do
   grep -qx "$f" "$WORK/filelist" || { echo "MISSING from sdist: $f"; exit 1; }
 done
 if grep -qx "starway_tpu/_sw_native.so" "$WORK/filelist"; then
@@ -44,7 +45,7 @@ if grep -qx "starway_tpu/_sw_native.so" "$WORK/filelist"; then
 fi
 echo "   $(wc -l < "$WORK/filelist") files; native sources + tests present, no prebuilt .so"
 
-echo "== 4/8 wheel built FROM the sdist tree; installed into a fresh venv"
+echo "== 4/9 wheel built FROM the sdist tree; installed into a fresh venv"
 mkdir -p "$WORK/src"
 tar xzf "$SDIST" -C "$WORK/src" --strip-components=1
 # The wheel is built from the unpacked sdist (exactly what cibuildwheel
@@ -74,24 +75,24 @@ print("   installed import ok:", starway_tpu.__file__)
 PY
 )
 
-echo "== 5/8 native engine built from the sdist's own sources"
+echo "== 5/9 native engine built from the sdist's own sources"
 (cd "$WORK/src" && "$VPY" -m starway_tpu.native_build >"$WORK/native_build.log" 2>&1) \
   || { tail -20 "$WORK/native_build.log"; exit 1; }
 ls -la "$WORK/src/starway_tpu/_sw_native.so"
 
-echo "== 6/8 smoke tests from the sdist tree on the venv interpreter"
+echo "== 6/9 smoke tests from the sdist tree on the venv interpreter"
 (cd "$WORK/src" && "$VPY" -m pytest \
     tests/test_matching.py tests/test_protocol.py \
     "tests/test_basic.py::test_client_to_server_send_recv[inproc]" -q)
 
-echo "== 7/8 fault-injection smoke (drop + partition, small payloads)"
+echo "== 7/9 fault-injection smoke (drop + partition, small payloads)"
 # The shipped FaultProxy harness against the shipped engines: a mid-frame
 # drop and a partition-driven timeout/liveness slice, small payloads only
 # (the long soaks are @slow and excluded).
 (cd "$WORK/src" && "$VPY" -m pytest tests/test_faults.py -q -m "not slow" \
     -k "drop or partition or repost")
 
-echo "== 8/8 session-chaos smoke (resets mid-burst, exactly-once oracle)"
+echo "== 8/9 session-chaos smoke (resets mid-burst, exactly-once oracle)"
 # The shipped resilient-session layer (STARWAY_SESSION, DESIGN.md §14)
 # through the shipped FaultProxy: periodic connection resets mid-burst,
 # swtrace counters prove every op completed exactly once.  Both engines
@@ -103,5 +104,15 @@ echo "== 8/8 session-chaos smoke (resets mid-burst, exactly-once oracle)"
 # kills, the credit window as the no-OOM bound (DESIGN.md §18).
 (cd "$WORK/src" && "$VPY" scripts/session_chaos.py --overload \
     --clients 8 --cycles 2 --n 8)
+
+echo "== 9/9 integrity smoke (STARWAY_INTEGRITY=1, DESIGN.md §19)"
+# The shipped integrity plane end to end: a checksummed basic slice on
+# both engines, then the corruption soak (bit-flips on striped chunks +
+# eager frames over periodic kills; byte-exact delivery is the oracle).
+(cd "$WORK/src" && STARWAY_INTEGRITY=1 "$VPY" -m pytest \
+    "tests/test_basic.py::test_client_to_server_send_recv" \
+    tests/test_integrity.py -q -m "not slow" \
+    -k "not sm_slot_corruption")
+(cd "$WORK/src" && "$VPY" scripts/session_chaos.py --corrupt --cycles 3)
 
 echo "RELEASE SMOKE: OK ($SDIST)"

@@ -45,6 +45,16 @@ def _parse():
                     help="ops per cycle (default 15)")
     ap.add_argument("--size", type=int, default=4096,
                     help="payload bytes per op (default 4096)")
+    ap.add_argument("--corrupt", action="store_true",
+                    help="mixed corruption soak (ISSUE 11, DESIGN.md §19): "
+                         "STARWAY_INTEGRITY=1 + sessions + fc + rails, "
+                         "driven through a corrupt-mode FaultProxy that "
+                         "bit-flips eager DATA frames AND striped chunks "
+                         "while connections are periodically killed; "
+                         "oracle: every op completes exactly once with "
+                         "byte-exact payloads, every flip is detected "
+                         "(csum_fail), chunk flips recover by retransmit "
+                         "and frame flips by suspend+replay")
     ap.add_argument("--overload", action="store_true",
                     help="many-client overload soak (DESIGN.md §18): "
                          "--clients concurrent senders against ONE server, "
@@ -147,6 +157,123 @@ async def _main(args) -> int:
         # duplicate delivery), and the outage was ridden through by
         # resume, not by fresh conns.
         ok = (ss["recvs_completed"] == total
+              and report["sessions_resumed"] >= 1)
+        report["ok"] = ok
+        print(json.dumps(report))
+        return 0 if ok else 1
+    finally:
+        for obj in (client, server):
+            try:
+                await asyncio.wait_for(obj.aclose(), timeout=10)
+            except Exception:
+                pass
+        proxy.stop()
+
+
+async def _corrupt_soak(args) -> int:
+    """Corruption chaos (ISSUE 11): integrity + sessions + fc + rails all
+    on, a corrupt-mode proxy flipping bits in whatever body frames pass
+    (eager DATA -> poison + suspend + replay; striped T_SDATA -> T_SNACK
+    single-chunk retransmit), and periodic mid-burst kills layered on
+    top.  Oracle: every posted op completes exactly once with byte-exact
+    payloads, every injected flip was DETECTED (csum_fail + chunk_retx
+    cover the injected count -- silent corruption is the one inadmissible
+    outcome), and resumes covered the kills."""
+    os.environ["STARWAY_TLS"] = "tcp"
+    os.environ["STARWAY_SESSION"] = "1"
+    os.environ["STARWAY_INTEGRITY"] = "1"
+    os.environ.setdefault("STARWAY_SESSION_GRACE", "30")
+    os.environ["STARWAY_FC_WINDOW"] = str(args.fc_window)
+    os.environ["STARWAY_RAILS"] = "2"
+    os.environ["STARWAY_STRIPE_THRESHOLD"] = str(1 << 20)
+    os.environ["STARWAY_STRIPE_CHUNK"] = str(256 << 10)
+    os.environ.setdefault("STARWAY_METRICS_INTERVAL", "0.25")
+
+    import socket
+
+    import numpy as np
+
+    from starway_tpu import Client, Server
+    from starway_tpu.core import telemetry
+    from starway_tpu.testing.faults import FaultProxy
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    os.environ["STARWAY_NATIVE"] = "1" if args.server_engine == "native" else "0"
+    server = Server()
+    server.listen("127.0.0.1", port)
+    # Phase 1 targets striped T_SDATA chunks (the NACK/retransmit path);
+    # after half the cycles the selector flips to eager DATA frames (the
+    # poison/suspend/replay path).  Payload-region flips only -- header
+    # flips are the poison-always path, covered by tests/test_integrity.py.
+    # Capped at one flip per cycle so late resumes see a clean pipe.
+    proxy = FaultProxy("127.0.0.1", port, mode="corrupt", corrupt_ftype=12,
+                       corrupt_count=max(1, args.cycles // 2)).start()
+    os.environ["STARWAY_NATIVE"] = "1" if args.client_engine == "native" else "0"
+    client = Client()
+    await client.aconnect("127.0.0.1", proxy.port)
+
+    total = 0
+    t0 = time.monotonic()
+    big_n = 2 << 20
+    big = (np.arange(big_n, dtype=np.uint64) % 251).astype(np.uint8)
+    try:
+        for cycle in range(args.cycles):
+            n, size, tag0 = args.n, args.size, cycle * 1000
+            bufs = [np.zeros(size, dtype=np.uint8) for _ in range(n)]
+            recvs = [server.arecv(bufs[i], tag0 + i, (1 << 64) - 1)
+                     for i in range(n)]
+            sink = np.zeros(big_n, dtype=np.uint8)
+            bigrecv = server.arecv(sink, tag0 + 999, (1 << 64) - 1)
+            sends = [client.asend(
+                np.full(size, (tag0 + i) % 251, dtype=np.uint8), tag0 + i)
+                for i in range(n)]
+            bigsend = client.asend(big, tag0 + 999)  # striped across rails
+            if cycle % 2 == 1:
+                await asyncio.sleep(0.15)
+                proxy.kill_all(rst=True)  # kills layered over corruption
+            if cycle == args.cycles // 2:
+                # Phase 2: retarget the live proxy at eager DATA frames.
+                proxy.corrupt_ftype = 3
+                proxy._corrupt_left = args.cycles - args.cycles // 2
+            await asyncio.wait_for(asyncio.gather(*sends, bigsend), 90)
+            await asyncio.wait_for(client.aflush(), 90)
+            await asyncio.wait_for(asyncio.gather(*recvs, bigrecv), 90)
+            for i in range(n):
+                assert bufs[i][0] == (tag0 + i) % 251, (cycle, i)
+                assert bufs[i][-1] == (tag0 + i) % 251, (cycle, i)
+            assert (sink == big).all(), f"cycle {cycle}: striped corrupt"
+            total += n + 1
+            _print_live(cycle, total, telemetry.sample_now())
+
+        ss = server._server.counters_snapshot()
+        cs = client._client.counters_snapshot()
+        detected = ss["csum_fail"] + cs["csum_fail"]
+        retx = cs["chunk_retx"] + ss["chunk_retx"]
+        report = {
+            "mode": "corrupt",
+            "server_engine": args.server_engine,
+            "client_engine": args.client_engine,
+            "cycles": args.cycles,
+            "ops": total,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+            "recvs_completed": ss["recvs_completed"],
+            "flips_injected": proxy.corrupted_units,
+            "csum_fail": detected,
+            "chunk_retx": retx,
+            "sessions_resumed": cs["sessions_resumed"] + ss["sessions_resumed"],
+        }
+        # The inadmissible outcome is SILENT corruption -- pinned by the
+        # byte-exact payload asserts above.  Detection counts are
+        # evidence the plane is live (>=1; a flip whose frame died with
+        # a killed conn is legitimately never completed, so flips and
+        # detections need not match 1:1 under mixed kills), and resumes
+        # prove the kills were ridden out.
+        ok = (ss["recvs_completed"] == total
+              and proxy.corrupted_units >= 1
+              and detected >= 1
+              and retx >= 1
               and report["sessions_resumed"] >= 1)
         report["ok"] = ok
         print(json.dumps(report))
@@ -288,5 +415,7 @@ async def _overload(args) -> int:
 
 if __name__ == "__main__":
     _args = _parse()
+    if _args.corrupt:
+        sys.exit(asyncio.run(_corrupt_soak(_args)))
     sys.exit(asyncio.run(_overload(_args) if _args.overload
                          else _main(_args)))

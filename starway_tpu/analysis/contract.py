@@ -28,6 +28,9 @@ _SHM_PAIRS = [
     ("DATA_OFF", "SM_DATA_OFF"),
     ("OFF_TAIL", "SM_OFF_TAIL"),
     ("OFF_HEAD", "SM_OFF_HEAD"),
+    # §19 integrity slot-record header (len u32 + crc u32): the trailer
+    # layout both engines frame ring writes with once "csum" negotiates.
+    ("REC_HDR", "SM_REC_HDR"),
 ]
 
 # errors.py constant -> (C++ literal name, stable keyword pinned by tests).
@@ -37,6 +40,7 @@ _REASON_PAIRS = [
     ("REASON_TRUNCATED", "kTruncated", "truncated"),
     ("REASON_TIMEOUT", "kTimedOut", "timed out"),
     ("REASON_SESSION_EXPIRED", "kSessionExpired", "session expired"),
+    ("REASON_CORRUPT", "kCorrupt", "corrupt"),
 ]
 
 # Negotiated handshake keys: offered in HELLO, confirmed in HELLO_ACK.
@@ -45,9 +49,10 @@ _REASON_PAIRS = [
 # end-to-end trace-conn id (DESIGN.md §15); "rails"/"rail_of" are the
 # multi-rail striping negotiation and the secondary-lane attach key
 # (DESIGN.md §17); "fc" is the receiver-driven flow-control window
-# advertisement (DESIGN.md §18).
+# advertisement (DESIGN.md §18); "csum" is the end-to-end integrity
+# negotiation (DESIGN.md §19).
 _HANDSHAKE_KEYS = ["ka", "sm", "devpull", "sess", "tr", "rails", "rail_of",
-                   "fc"]
+                   "fc", "csum"]
 
 # Normalised C type -> acceptable canonical ctypes spellings.
 _C2CTYPES = {
@@ -56,6 +61,7 @@ _C2CTYPES = {
     "uint64_t": {"c_uint64"},
     "uint64_t*": {"POINTER(c_uint64)"},
     "uint8_t": {"c_uint8"},
+    "uint32_t": {"c_uint32"},
     "int": {"c_int"},
     "double": {"c_double"},
 }
@@ -64,6 +70,7 @@ _C2RESTYPE = {
     "char*": "c_char_p",
     "void*": "c_void_p",
     "uint64_t": "c_uint64",
+    "uint32_t": "c_uint32",
 }
 
 

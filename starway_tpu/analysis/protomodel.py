@@ -61,7 +61,7 @@ KNOWN_STATES = {"hello-sent", "estab", "suspended"}
 KNOWN_INPUTS = {
     "HELLO", "HELLO_ACK", "DATA", "FLUSH", "FLUSH_ACK", "DEVPULL",
     "PING", "PONG", "SEQ", "ACK", "BYE", "SDATA", "SACK", "OTHER",
-    "CREDIT", "RTS", "CTS",
+    "CREDIT", "RTS", "CTS", "CSUM", "SNACK",
     "lost", "resume", "expire",
 }
 KNOWN_NEXTS = {"estab", "down", "expired", "suspended"}

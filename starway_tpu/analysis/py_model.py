@@ -184,7 +184,7 @@ def extract_py(root: Path) -> PyModel:
     if tree is not None:
         consts = module_int_constants(tree)
         for name in ("MAGIC", "GLOBAL_HDR", "RING_HDR", "DATA_OFF",
-                     "OFF_TAIL", "OFF_HEAD"):
+                     "OFF_TAIL", "OFF_HEAD", "REC_HDR"):
             if name in consts:
                 model.shm[name] = consts[name]
 

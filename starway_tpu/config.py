@@ -169,6 +169,22 @@ benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
     ``fc`` negotiated the credit window keeps well-behaved peers under
     the cap.
 
+``STARWAY_INTEGRITY``
+    "1" = negotiate the end-to-end data-integrity plane (off by default
+    for seed parity: no ``"csum"`` handshake key, no T_CSUM/T_SNACK
+    frames, byte-stream sm rings).  Once both peers confirm ``csum``,
+    every framed message is preceded by a T_CSUM frame carrying a CRC32C
+    over the frame's header+payload (plus a header-only CRC so routing
+    fields are validated before the payload streams into user buffers),
+    and sm ring writes become per-slot records with a seqno+checksum
+    trailer so torn/partial writes are detected at dequeue.  Verification
+    failures are *recoverable*: a corrupt striped T_SDATA chunk is NACKed
+    (T_SNACK) and only that chunk retransmits; a corrupt non-striped
+    frame poisons the conn with the stable ``"corrupt"`` reason -- which
+    without sessions takes the §10 failure contract and with
+    ``STARWAY_SESSION=1`` suspends + replays so ops still complete
+    exactly-once with verified bytes.  See DESIGN.md §19.
+
 ``STARWAY_TRACE``
     "1" = record per-op lifecycle events (posted/matched/completed/
     failed, stage spans, connection churn) into a bounded per-worker ring
@@ -238,6 +254,7 @@ __all__ = [
     "stripe_chunk",
     "fc_window",
     "unexp_cap",
+    "integrity_enabled",
     "trace_enabled",
     "trace_ring_size",
     "flight_dir",
@@ -420,6 +437,12 @@ def unexp_cap() -> int:
     except ValueError:
         return 0
     return v if v > 0 else 0
+
+
+def integrity_enabled() -> bool:
+    """End-to-end integrity plane (STARWAY_INTEGRITY); off by default --
+    seed parity: no "csum" handshake key, no checksum frames on the wire."""
+    return _env("STARWAY_INTEGRITY", "0") not in ("", "0")
 
 
 def trace_enabled() -> bool:

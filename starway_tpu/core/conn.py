@@ -44,10 +44,11 @@ from collections import deque
 from typing import Optional
 
 from .. import config, perf
-from ..errors import REASON_CANCELLED, REASON_NOT_CONNECTED
+from ..errors import REASON_CANCELLED, REASON_CORRUPT, REASON_NOT_CONNECTED
 from . import frames, state, swtrace
 from .lane import RailGroup, StripeFeeder, StripeRx
 from .matching import InboundMsg
+from .shmring import SmCorrupt
 
 logger = logging.getLogger("starway_tpu")
 
@@ -60,6 +61,18 @@ RX_CHUNK = 1 << 22
 # tcp_tx_gather (native/sw_engine.cpp) -- one syscall covers a burst of
 # queued small frames plus the front of a large payload.
 GATHER_IOV = 64
+
+# §19 integrity plane: frame types exempt from the negotiated per-frame
+# checksum -- the handshake pair predates negotiation and the T_SEQ
+# session prefix glues OUTSIDE the checksum envelope (wire order
+# [SEQ][CSUM][frame]; a corrupted SEQ surfaces as a seq gap, which is
+# already a recoverable fault).  Everything else on a csum conn must be
+# announced by a T_CSUM or the stream is poisoned.
+_CSUM_EXEMPT = frozenset((frames.T_HELLO, frames.T_HELLO_ACK, frames.T_SEQ))
+# Frame types whose bytes continue past the header on the wire (given
+# header ``b`` > 0): the full-frame CRC verifies at their last byte;
+# every other type is header-only and verifies at dispatch.
+_CSUM_BODY = frozenset((frames.T_DATA, frames.T_DEVPULL, frames.T_RTS))
 
 # Doorbell byte values on an sm-upgraded conn's socket (the contract shared
 # with the native engine -- native/sw_engine.cpp).  Any byte wakes the peer
@@ -499,6 +512,18 @@ class TcpConn(BaseConn):
         self.fc_rx_gen = 0
         self.fc_rx: dict = {}
         self._unexp_cap = config.unexp_cap()
+        # §19 integrity plane (negotiated via the "csum" handshake key).
+        # ``csum_ok`` arms TX framing + RX verification; ``poison_reason``
+        # overrides the cancel reason at teardown ("corrupt");
+        # ``_csum_pend`` is the (crc_frame, crc_head) pair announced by
+        # the last T_CSUM with ``_csum_accum`` the running CRC of the
+        # protected frame; ``retx_offs`` tracks NACK-requeued striped
+        # chunks until rewritten (the ``retx_pending`` gauge).
+        self.csum_ok = False
+        self.poison_reason = None
+        self._csum_pend = None
+        self._csum_accum = 0
+        self.retx_offs: set = set()
         self.sess = None
         self._sess_pending = None   # seq announced by the last T_SEQ
         self._sess_drop = False     # next frame is a duplicate: drain + drop
@@ -619,6 +644,94 @@ class TcpConn(BaseConn):
                 break
         return total
 
+    # ---------------------------------------------------------- integrity
+    def _csum_arm(self, item) -> None:
+        """Embed the T_CSUM prefix into one tx item's framed bytes
+        (DESIGN.md §19).  Runs at dispatch, after the item's final wire
+        header exists and BEFORE any session T_SEQ framing, so the wire
+        order is [SEQ][CSUM][frame] and journal replays stay
+        byte-identical.  Handshake frames are never wrapped."""
+        if not self.csum_ok:
+            return
+        if isinstance(item, (TxCtl, TxDevpull)):
+            if item.data[0] in _CSUM_EXEMPT:
+                return
+            item.data = frames.pack_csum_for(item.data) + item.data
+            return
+        # TxData: flat host payload (device.py stages integrity conns
+        # flat, like session conns -- the CRC needs the whole payload).
+        payload = item.payload if isinstance(item.payload, memoryview) \
+            else None
+        item.header = frames.pack_csum_for(item.header, payload) \
+            + item.header
+
+    def _corrupt(self, fires: list, what: str) -> None:
+        """Unrepairable verification failure: poison the conn with the
+        stable "corrupt" reason.  Without a session this takes the §10
+        failure contract (queued sends fail "corrupt", posted recvs keep
+        the peer-death pendings, flush fails); with a live session
+        _conn_broken suspends instead and the journal replay re-delivers
+        verified bytes exactly-once."""
+        self._ctr.csum_fail += 1
+        logger.warning("starway: integrity failure on conn %s: %s",
+                       self.conn_id, what)
+        self.poison_reason = REASON_CORRUPT
+        sess = self.sess
+        if sess is None or sess.expired:
+            # Flush barriers against the poisoned conn report the true
+            # cause (engine.py _try_complete_flush reads this override).
+            self.sess_fail_reason = REASON_CORRUPT
+        self.worker._conn_broken(self, fires)
+
+    def _on_snack(self, msg_id: int, off: int, fires: list) -> None:
+        """The receiver NACKed one striped chunk (payload checksum failed
+        with an intact sub-header): re-queue JUST that chunk.  The payload
+        is pinned until T_SACK, so the resend is always legal; the
+        receiver's offset dedup never recorded the corrupt chunk, so the
+        retransmit streams into the same sink region."""
+        if self.fc_ok and msg_id in self.fc_rts:
+            # §18 rendezvous delivery (one self-describing chunk): the
+            # whole frame rides again, exactly like a CTS re-dispatch.
+            ent = self.fc_rts[msg_id]
+            if ent[1] != "tx":
+                return  # not dispatched yet (stale/garbled NACK)
+            item = ent[0]
+            if item in self.tx:
+                return  # still (re)transmitting
+            item.reset_for_replay()
+            self._ctr.chunk_retx += 1
+            self.tx.append(item)
+            self.kick_tx(fires)
+            return
+        root = self.stripe_root()
+        grp = root.stripe
+        if grp is None:
+            return
+        src = grp.by_id.get(msg_id)
+        if (src is None or src.sacked or src.failed
+                or off >= src.total or off % src.chunk):
+            return  # settled or garbled: a late SACK/redispatch covers it
+        if off in src.pending:
+            return  # duplicate NACK: already queued for resend
+        for offs in src.rail_offs.values():
+            if off in offs:
+                return  # already back in flight on some lane
+        removed = False
+        for offs in src.done_offs.values():
+            if off in offs:
+                offs.remove(off)
+                removed = True
+                break
+        if not removed:
+            return  # ledger cleared by a resume: redispatch_all covers it
+        src.pending.append(off)
+        src.unwritten += 1
+        root._ctr.chunk_retx += 1
+        root.retx_offs.add((msg_id, off))
+        if src not in grp.queue:
+            grp.queue.append(src)
+        grp.dispatch(fires)
+
     # ------------------------------------------------------------- stripe
     def stripe_root(self) -> "TcpConn":
         return self.rail_parent if self.rail_parent is not None else self
@@ -679,6 +792,7 @@ class TcpConn(BaseConn):
         self.dirty = True
         self._data_counter += 1
         item = TxData(tag, payload, done, fail, owner)
+        self._csum_arm(item)
         if self.sess is not None:
             self._sess_submit(item, fires, kick)
             return item
@@ -690,6 +804,7 @@ class TcpConn(BaseConn):
     def send_flush(self, seq: int, fires: list) -> None:
         self._flush_marks[seq] = self._data_counter
         item = TxCtl(frames.pack_flush(seq))
+        self._csum_arm(item)
         if self.sess is not None:
             self._sess_submit(item, fires, True)
             return
@@ -700,6 +815,7 @@ class TcpConn(BaseConn):
         """FLUSH_ACK is a *sequenced* session frame (a barrier ACK lost
         with a conn must replay, or the peer's flush hangs forever)."""
         item = TxCtl(frames.pack_flush_ack(seq))
+        self._csum_arm(item)
         if self.sess is not None:
             self._sess_submit(item, fires, True)
             return
@@ -712,7 +828,9 @@ class TcpConn(BaseConn):
             self.dirty = False
 
     def send_ctl(self, data: bytes, fires: list, switch_after: bool = False) -> None:
-        self.tx.append(TxCtl(data, switch_after))
+        item = TxCtl(data, switch_after)
+        self._csum_arm(item)
+        self.tx.append(item)
         self.kick_tx(fires)
 
     def send_ping(self, fires: list) -> None:
@@ -780,6 +898,7 @@ class TcpConn(BaseConn):
         self.dirty = True
         self._data_counter += 1
         item = TxDevpull(data, done, fail, owner)
+        self._csum_arm(item)
         if self.sess is not None:
             self._sess_submit(item, fires, kick)
             return
@@ -907,6 +1026,8 @@ class TcpConn(BaseConn):
         self._rx_skip = 0
         self._sess_drop = False
         self._sess_pending = None
+        self._csum_pend = None  # per-incarnation: replay re-announces
+        self._csum_accum = 0
         # Striped rx parser state is per-incarnation; the ASSEMBLIES
         # (stripe_rx) survive -- the resumed sender re-dispatches
         # un-SACKed sources and offset dedup keeps bytes exactly-once.
@@ -1041,6 +1162,7 @@ class TcpConn(BaseConn):
     def _fc_dispatch_eager(self, item, fires: list, kick: bool) -> None:
         self.dirty = True
         self._data_counter += 1
+        self._csum_arm(item)
         if self.sess is not None:
             self._sess_submit(item, fires, kick)
             return
@@ -1061,8 +1183,11 @@ class TcpConn(BaseConn):
         self._fc_next_msg += 1
         item.header = frames.pack_sdata_header(item.tag, msg_id, 0,
                                                item.nbytes, item.nbytes)
+        self._csum_arm(item)  # covers header+sub-header+payload (§19)
         self.fc_rts[msg_id] = [item, "rts", item.tag]
-        self.tx.append(TxCtl(frames.pack_rts(item.tag, msg_id, item.nbytes)))
+        rts = TxCtl(frames.pack_rts(item.tag, msg_id, item.nbytes))
+        self._csum_arm(rts)
+        self.tx.append(rts)
         if kick:
             self.kick_tx(fires)
 
@@ -1145,8 +1270,9 @@ class TcpConn(BaseConn):
         for msg_id, ent in self.fc_rts.items():
             ent[1] = "rts"
             ent[0].reset_for_replay()
-            self.tx.append(TxCtl(frames.pack_rts(ent[2], msg_id,
-                                                 ent[0].nbytes)))
+            rts = TxCtl(frames.pack_rts(ent[2], msg_id, ent[0].nbytes))
+            self._csum_arm(rts)
+            self.tx.append(rts)
 
     # --------------------------------------------------- flow control (rx)
     def fc_on_rts(self, tag: int, msg_id: int, total: int, fires: list) -> None:
@@ -1414,7 +1540,21 @@ class TcpConn(BaseConn):
         """
         t0 = time.perf_counter()
         if self.sm_active:
-            n = self.sm_rx.read_into(target)
+            try:
+                n = self.sm_rx.read_into(target)
+            except SmCorrupt as e:
+                # §19: a torn/corrupt ring slot, caught at dequeue before
+                # its bytes could be parsed.  Mark the poison here (this
+                # helper has no fires list) and let the caller's OSError
+                # handler run _conn_broken -- mark_dead then reports the
+                # stable "corrupt" reason.
+                self._ctr.csum_fail += 1
+                logger.warning("starway: integrity failure on conn %s: %s",
+                               self.conn_id, e)
+                self.poison_reason = REASON_CORRUPT
+                if self.sess is None or self.sess.expired:
+                    self.sess_fail_reason = REASON_CORRUPT
+                raise
             if n == 0:
                 raise BlockingIOError
             self.last_rx = time.monotonic()
@@ -1488,7 +1628,17 @@ class TcpConn(BaseConn):
                 if n == 0:
                     self.worker._conn_broken(self, fires)
                     return
+                if self._csum_pend is not None:
+                    self._csum_accum = frames.crc32c(target[:n],
+                                                     self._csum_accum)
                 self._rx_skip -= n
+                if self._rx_skip == 0 and self._csum_pend is not None:
+                    # A drained frame (duplicate seq / superseded chunk)
+                    # ends here: verify for accounting only -- nothing
+                    # was delivered, so a mismatch needs no recovery.
+                    pend, self._csum_pend = self._csum_pend, None
+                    if self._csum_accum != pend[0]:
+                        self._ctr.csum_fail += 1
                 continue
             if self._sdata is not None:
                 # Striped-chunk sub-header (24 bytes: msg id, offset,
@@ -1504,11 +1654,21 @@ class TcpConn(BaseConn):
                 if n == 0:
                     self.worker._conn_broken(self, fires)
                     return
+                if self._csum_pend is not None:
+                    self._csum_accum = frames.crc32c(
+                        memoryview(sub)[got:got + n], self._csum_accum)
                 got += n
                 if got < len(sub):
                     self._sdata = (stag, sub, got, blen)
                     continue
                 self._sdata = None
+                if (self._csum_pend is not None
+                        and self._csum_accum != self._csum_pend[1]):
+                    # Routing fields (header+sub-header) cannot be
+                    # trusted: the stream framing itself is suspect, and
+                    # a NACK would carry garbage ids -- poison instead.
+                    self._corrupt(fires, "stripe sub-header checksum")
+                    return
                 msg_id, off, total = frames.SDATA_SUB.unpack(sub)
                 chunk_len = blen - frames.SDATA_SUB_SIZE
                 rx = self._stripe_rx_tbl()
@@ -1547,12 +1707,31 @@ class TcpConn(BaseConn):
                 if n == 0:
                     self.worker._conn_broken(self, fires)
                     return
+                if self._csum_pend is not None:
+                    self._csum_accum = frames.crc32c(target[:n],
+                                                     self._csum_accum)
                 got += n
                 if got < clen:
                     self._rx_stripe_got = got
                     continue
                 self._rx_stripe = None
                 self._rx_stripe_got = 0
+                if self._csum_pend is not None:
+                    pend, self._csum_pend = self._csum_pend, None
+                    if self._csum_accum != pend[0]:
+                        # Chunk payload corrupt, routing verified: NACK
+                        # just this chunk (§19).  The offset was never
+                        # recorded in the assembly, so the retransmit
+                        # streams into the same sink region; the conn
+                        # stays healthy.
+                        self._ctr.csum_fail += 1
+                        logger.warning(
+                            "starway: corrupt striped chunk on conn %s "
+                            "(msg %d off %d); requesting retransmit",
+                            self.conn_id, asm.msg_id, off)
+                        self.send_ctl(frames.pack_snack(asm.msg_id, off),
+                                      fires)
+                        continue
                 self._stripe_rx_tbl().chunk_done(self, asm, off, clen, fires)
                 continue
             m = self._rx_msg
@@ -1574,6 +1753,9 @@ class TcpConn(BaseConn):
                 if n == 0:
                     self.worker._conn_broken(self, fires)
                     return
+                if self._csum_pend is not None:
+                    self._csum_accum = frames.crc32c(target[:n],
+                                                     self._csum_accum)
                 m.received += n
                 if (m.progress is not None and not m.discard
                         and m.sink is not None):
@@ -1582,6 +1764,15 @@ class TcpConn(BaseConn):
                     # (device.py DeviceRecvSink.staged; DESIGN.md §12).
                     m.progress(m.received)
                 if m.received >= m.length:
+                    if self._csum_pend is not None:
+                        # Verified BEFORE the matcher completes the
+                        # receive: corrupt bytes must never reach user
+                        # code as good data (§19).  Poison -- the replay
+                        # (sessions) rewrites the sink from the start.
+                        pend, self._csum_pend = self._csum_pend, None
+                        if self._csum_accum != pend[0]:
+                            self._corrupt(fires, "payload checksum (DATA)")
+                            return
                     with lock:
                         fires.extend(matcher.on_message_complete(m))
                     self._rx_msg = None
@@ -1600,11 +1791,19 @@ class TcpConn(BaseConn):
                 if n == 0:
                     self.worker._conn_broken(self, fires)
                     return
+                if self._csum_pend is not None:
+                    self._csum_accum = frames.crc32c(
+                        memoryview(body)[got:got + n], self._csum_accum)
                 got += n
                 if got < len(body):
                     self._ctl = (ftype, body, got, a)
                     continue
                 self._ctl = None
+                if self._csum_pend is not None:
+                    pend, self._csum_pend = self._csum_pend, None
+                    if self._csum_accum != pend[0]:
+                        self._corrupt(fires, "control body checksum")
+                        return
                 # json.loads reads the bytearray directly: no full-body copy.
                 info = frames.unpack_json_body(body)
                 if ftype == frames.T_HELLO:
@@ -1629,11 +1828,45 @@ class TcpConn(BaseConn):
             if n == 0:
                 self.worker._conn_broken(self, fires)
                 return
+            if self._csum_pend is not None:
+                # The header of the protected frame is covered too: a
+                # corrupted length field must never desync the stream.
+                self._csum_accum = frames.crc32c(
+                    memoryview(self._hdr)[self._hdr_got:self._hdr_got + n],
+                    self._csum_accum)
             self._hdr_got += n
             if self._hdr_got < frames.HEADER_SIZE:
                 continue
             self._hdr_got = 0
             ftype, a, b = frames.unpack_header(self._hdr)
+            if self.csum_ok:
+                # §19 verification gate, BEFORE dispatch: arm on T_CSUM,
+                # require one for every protected frame, and validate
+                # routing fields the moment they are parsed.
+                pend = self._csum_pend
+                if ftype == frames.T_CSUM:
+                    if pend is not None:
+                        self._corrupt(fires, "nested checksum prefix")
+                        return
+                    self._csum_pend = (a, b)
+                    self._csum_accum = 0
+                    continue
+                if ftype not in _CSUM_EXEMPT:
+                    if pend is None:
+                        self._corrupt(fires, "frame without checksum")
+                        return
+                    if (ftype != frames.T_SDATA
+                            and self._csum_accum != pend[1]):
+                        self._corrupt(fires, "frame header checksum")
+                        return
+                    body_follows = (ftype == frames.T_SDATA
+                                    or (ftype in _CSUM_BODY and b > 0))
+                    if not body_follows:
+                        # Header-only frame: the header IS the frame.
+                        self._csum_pend = None
+                        if self._csum_accum != pend[0]:
+                            self._corrupt(fires, "frame checksum")
+                            return
             if ftype == frames.T_DATA:
                 if self._sess_drop:
                     self._sess_drop = False
@@ -1739,6 +1972,9 @@ class TcpConn(BaseConn):
                     root = self.stripe_root()
                     if root.stripe is not None:
                         root.stripe.on_sack(a, fires)
+            elif ftype == frames.T_SNACK:
+                # §19 chunk-level retransmit request from the receiver.
+                self._on_snack(a, b, fires)
             elif ftype == frames.T_CREDIT:
                 self._on_credit(a, fires)
             elif ftype == frames.T_CTS:
@@ -1819,7 +2055,10 @@ class TcpConn(BaseConn):
             # instead of suspending for the grace window.  Best-effort --
             # a lost BYE only costs the peer the grace-expiry fallback.
             try:
-                self.sock.sendall(frames.pack_bye())
+                bye = frames.pack_bye()
+                if self.csum_ok:
+                    bye = frames.pack_csum_for(bye) + bye
+                self.sock.sendall(bye)
             except OSError:
                 pass
         self._cancel_tx_state(fires)
@@ -1844,7 +2083,10 @@ class TcpConn(BaseConn):
         if self.alive:
             self.alive = False
             self.worker._unregister_conn_io(self)
-            self._cancel_tx_state(fires)
+            # A §19 poison owns the cancel reason: in-flight ops report
+            # "corrupt", not a generic cancel (tests/test_integrity.py).
+            self._cancel_tx_state(fires,
+                                  self.poison_reason or REASON_CANCELLED)
             if self._rx_msg is not None:
                 with self.worker.lock:
                     self.worker.matcher.purge_inflight(self._rx_msg)

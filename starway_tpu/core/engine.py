@@ -765,12 +765,17 @@ class Worker:
                       if getattr(c, "rail_parent", None) is None]
         # A dead connection with unacknowledged tagged data means the barrier
         # cannot truthfully complete: fail like a send on a dead endpoint
-        # would, instead of passing vacuously.
-        if any((not c.alive) and c.dirty for c in candidates):
+        # would, instead of passing vacuously.  An expired session or a §19
+        # poison owns the reason (the native start_flush reads sess_fail the
+        # same way).
+        dead_dirty = [c for c in candidates if (not c.alive) and c.dirty]
+        if dead_dirty:
+            reason = next(
+                (c.sess_fail_reason for c in dead_dirty
+                 if getattr(c, "sess_fail_reason", None)),
+                REASON_NOT_CONNECTED + " (peer reset before flush)")
             if fail is not None:
-                fires.append(
-                    lambda f=fail: f(REASON_NOT_CONNECTED + " (peer reset before flush)")
-                )
+                fires.append(lambda f=fail, r=reason: f(r))
             return
         targets = [c for c in candidates if c.alive]
         rec = FlushRec(done, fail)
@@ -1200,6 +1205,12 @@ class ClientWorker(Worker):
                 # peer's eager traffic; an fc-capable acceptor answers
                 # with its own window.
                 extra["fc"] = str(fc_w)
+            integ = config.integrity_enabled()
+            if integ:
+                # End-to-end integrity offer (DESIGN.md §19): an
+                # integrity-capable acceptor confirms "csum": "ok" and
+                # every later frame on the conn is checksummed.
+                extra["csum"] = "1"
             if sess_on:
                 # Stable session id + epoch 0 (the acceptor assigns the
                 # real epoch); sess_ack is our cumulative rx seq (0 new).
@@ -1237,6 +1248,7 @@ class ClientWorker(Worker):
         if fc_w > 0 and self._sess_int(ack.get("fc", 0)) > 0:
             conn.fc_ok = True
             conn.fc_window = conn.fc_credits = self._sess_int(ack["fc"])
+        conn.csum_ok = integ and ack.get("csum") == "ok"
         if tr_offer and ack.get("tr") == "ok":
             conn.tr_id = tr_offer
         if sess_on and ack.get("sess") == "ok":
@@ -1245,6 +1257,10 @@ class ClientWorker(Worker):
         if sm_offer is not None:
             if ack.get("sm") == "ok":
                 conn.adopt_sm(sm_offer, creator=True)
+                if conn.csum_ok:
+                    # §19: the rings carry checksummed slot records from
+                    # the first byte (both sides enable at handshake).
+                    sm_offer.enable_integrity()
             else:
                 sm_offer.unlink()
                 sm_offer.close()
@@ -1286,6 +1302,10 @@ class ClientWorker(Worker):
                 sock.settimeout(timeout)
                 extra = {"rail_of": self.worker_id, "rail_idx": str(i + 1),
                          "ka": "ok"}
+                if config.integrity_enabled():
+                    # §19: every lane of a railed conn checksums its own
+                    # frames (chunks verify on the rail they rode).
+                    extra["csum"] = "1"
                 sock.sendall(frames.pack_hello(self.worker_id, "socket",
                                                self.name, extra))
                 hdr = _read_exact(sock, frames.HEADER_SIZE)
@@ -1307,6 +1327,8 @@ class ClientWorker(Worker):
             rail = TcpConn(self, sock, "socket", handshaken=True)
             rail.peer_name = primary.peer_name
             rail.ka_ok = ack.get("ka") == "ok"
+            rail.csum_ok = (config.integrity_enabled()
+                            and ack.get("csum") == "ok")
             primary.attach_rail(rail, fires)
             with self.lock:
                 self.conns[rail.conn_id] = rail
@@ -1366,6 +1388,10 @@ class ClientWorker(Worker):
         timeout = self._connect_timeout or config.connect_timeout()
         extra = {"ka": "ok", "sess": "ok", "sess_id": sess.sid,
                  "sess_epoch": sess.epoch, "sess_ack": str(sess.rx_cum)}
+        if config.integrity_enabled():
+            # §19: re-offered per incarnation for wire-format consistency
+            # (csum_ok is sticky on the session conn either way).
+            extra["csum"] = "1"
         if config.fc_window() > 0:
             # Fresh credit window per incarnation (DESIGN.md §18): both
             # sides reset to their stored windows at resume; the key is
@@ -1517,6 +1543,11 @@ class ServerWorker(Worker):
                         and info.get("sess") == "ok" and "sess_id" in info)
         if sess_offered and self._sess_hello(conn, info, fires):
             return  # resumed onto the suspended conn; this wrapper consumed
+        # §19 integrity negotiation, decided BEFORE the sm adopt below:
+        # the rings' slot-record framing must be agreed before any ring
+        # byte flows.
+        csum_on = config.integrity_enabled() and bool(info.get("csum"))
+        conn.csum_ok = csum_on
         # Same-host shared-memory offer: map + validate the segment, confirm
         # in the ACK.  Any failure (different host, bad nonce, sm disabled)
         # silently stays on TCP.
@@ -1537,6 +1568,8 @@ class ServerWorker(Worker):
         # completes, list_clients() must already contain it.
         if sm_seg is not None:
             conn.adopt_sm(sm_seg, creator=False, defer_tx=True)
+            if csum_on:
+                sm_seg.enable_integrity()
         ep = ServerEndpoint(conn)
         with self.lock:
             self.conns[conn.conn_id] = conn
@@ -1562,6 +1595,8 @@ class ServerWorker(Worker):
             conn.fc_ok = True
             conn.fc_window = conn.fc_credits = self._sess_int(info["fc"])
             ack_extra["fc"] = str(fc_w)
+        if csum_on:
+            ack_extra["csum"] = "ok"
         if self._trace is not None and info.get("tr"):
             # swscope stitching: adopt the connector's trace-conn id so
             # both rings tag this conn's EV_E2E events identically.
@@ -1606,6 +1641,9 @@ class ServerWorker(Worker):
         if info.get("ka") == "ok":
             conn.ka_ok = True
             ack_extra["ka"] = "ok"
+        if config.integrity_enabled() and info.get("csum"):
+            conn.csum_ok = True
+            ack_extra["csum"] = "ok"
         with self.lock:
             self.conns[conn.conn_id] = conn
         # ACK first: attach_rail may dispatch a feeder and kick TX at
@@ -1644,6 +1682,8 @@ class ServerWorker(Worker):
                          "sess_ack": str(existing.sess.rx_cum)}
             if existing.ka_ok:
                 ack_extra["ka"] = "ok"
+            if existing.csum_ok:
+                ack_extra["csum"] = "ok"
             if existing.devpull_ok:
                 ack_extra["devpull"] = "ok"
             if existing.fc_ok:

@@ -33,6 +33,8 @@ SACK      striped message id           echoed message total (bytes)
 CREDIT    granted window bytes         0
 RTS       sender tag                   length of JSON descriptor that follows
 CTS       echoed rendezvous msg id     0
+CSUM      next frame's full CRC32C     next frame's header(+sub) CRC32C
+SNACK     corrupt chunk's msg id       corrupt chunk's offset (retransmit)
 ========= ============================ ======================================
 
 PING / PONG are the *negotiated* peer-liveness probe (``"ka": "ok"``
@@ -168,6 +170,29 @@ SACK, so a session resume can safely re-announce it.  Old peers never
 confirm ``fc`` and see none of the three frames; with the env unset the
 HELLO is byte-identical to the seed.
 
+CSUM / SNACK are the *negotiated* end-to-end integrity plane
+(``STARWAY_INTEGRITY``, DESIGN.md §19).  A peer started with the knob
+offers ``"csum": "1"`` in HELLO; an integrity-capable acceptor confirms
+``"csum": "ok"`` and every subsequent framed message on the conn -- DATA,
+ctl, striped chunks, everything except the handshake pair and the T_SEQ
+session prefix -- is preceded by one T_CSUM frame: ``a`` is the CRC32C
+(Castagnoli; :func:`crc32c`) over the next frame's entire header+body
+bytes, ``b`` the CRC32C over just its header (plus the 24-byte sub-header
+for T_SDATA).  The receiver verifies ``b`` the moment the routing fields
+are parsed -- BEFORE the payload streams into a user buffer -- so a
+corrupted length/offset can never desync the stream or scribble on a
+verified region; ``a`` is verified at the frame's last byte.  The two
+recovery paths: a corrupt striped T_SDATA chunk with an intact sub-header
+answers T_SNACK (``a`` = msg id, ``b`` = chunk offset) and the sender
+re-queues JUST that chunk through the §17 offset-dedup reassembly
+(payloads are pinned until T_SACK, so the resend is always legal); any
+other mismatch poisons the conn with the stable ``"corrupt"`` reason --
+seed contract without sessions, suspend+replay with them.  Wrap order on
+session conns is ``[T_SEQ][T_CSUM][frame]``: the checksum rides inside
+the sequenced envelope and replays byte-identically from the journal.
+Old peers never confirm ``csum`` and see neither frame; with the env
+unset the HELLO is byte-identical to the seed.
+
 FLUSH / FLUSH_ACK implement the delivery barrier: because the byte stream is
 processed in order, a FLUSH_ACK for sequence *n* proves every DATA payload
 enqueued before flush *n* has been fully ingested by the peer's matching
@@ -177,6 +202,7 @@ engine -- the semantics ``ucp_worker_flush_nbx`` provides in the reference
 
 from __future__ import annotations
 
+import ctypes
 import json
 import struct
 
@@ -199,6 +225,8 @@ T_SACK = 13
 T_CREDIT = 14
 T_RTS = 15
 T_CTS = 16
+T_CSUM = 17
+T_SNACK = 18
 
 # Rendezvous (RTS/CTS) message-id namespace bit (DESIGN.md §18): fc msg
 # ids carry the top bit so they can never collide with stripe msg ids on
@@ -213,6 +241,85 @@ FC_MSG_BIT = 1 << 63
 # machine-checked by `python -m starway_tpu.analysis`).
 SDATA_SUB = struct.Struct("<QQQ")
 SDATA_SUB_SIZE = SDATA_SUB.size  # 24
+
+
+# ------------------------------------------------------------- integrity
+#
+# CRC32C (Castagnoli, the iSCSI/ext4 polynomial) is the integrity plane's
+# checksum (DESIGN.md §19): the native engine computes it with the SSE4.2
+# / ARMv8 CRC instructions (sw_crc32c, software slicing fallback), and
+# the Python engine calls that same export through ctypes so both engines
+# -- and both ends of a mixed pair -- agree bit-for-bit.  The pure-Python
+# table below is the no-toolchain fallback; tests pin it against the
+# native export and the standard check vector crc32c(b"123456789") ==
+# 0xE3069283.  The chaining contract matches zlib.crc32: ``crc`` is the
+# previous call's RESULT (the implementation re-inverts internally), so
+# a payload can be folded incrementally chunk by chunk.
+
+_CRC32C_POLY = 0x82F63B78
+_crc_table: list | None = None
+_crc_native = None  # ctypes fn, or False once probed absent
+
+
+def _crc32c_table() -> list:
+    global _crc_table
+    if _crc_table is None:
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ _CRC32C_POLY if c & 1 else c >> 1
+            tbl.append(c)
+        _crc_table = tbl
+    return _crc_table
+
+
+def _crc32c_fn():
+    """The native sw_crc32c export, or False.  Probed lazily and only
+    against an already-built artifact -- the first checksum computes on
+    the connection path, where a synchronous g++ build would stall it
+    (the shmring.atomics(build=False) discipline)."""
+    global _crc_native
+    if _crc_native is None:
+        try:
+            from . import native
+
+            fn = native.crc32c_fn(build=False)
+        except Exception:
+            fn = None
+        _crc_native = fn if fn is not None else False
+    return _crc_native
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC32C of ``data`` chained onto a previous result ``crc`` (zlib
+    calling convention).  Accepts any C-contiguous buffer."""
+    mv = data if isinstance(data, memoryview) else memoryview(data)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    n = len(mv)
+    if n == 0:
+        return crc & 0xFFFFFFFF
+    fn = _crc32c_fn()
+    if fn is not False:
+        try:
+            buf = (ctypes.c_ubyte * n).from_buffer(mv)
+        except TypeError:
+            # Read-only source.  A whole immutable buffer (bytes payloads,
+            # packed ctl frames) crosses ctypes as a borrowed pointer --
+            # no copy; only a read-only *slice* (rare, small spans) pays a
+            # materialisation.
+            base = getattr(mv, "obj", None)
+            if isinstance(base, bytes) and len(base) == n:
+                buf = base
+            else:
+                buf = bytes(mv)
+        return fn(buf, n, crc & 0xFFFFFFFF)
+    tbl = _crc32c_table()
+    c = (crc & 0xFFFFFFFF) ^ 0xFFFFFFFF
+    for b in bytes(mv):
+        c = tbl[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
 
 
 def pack_header(ftype: int, a: int, b: int) -> bytes:
@@ -313,6 +420,36 @@ def pack_rts(tag: int, msg_id: int, total: int) -> bytes:
 
 def pack_cts(msg_id: int) -> bytes:
     return pack_header(T_CTS, msg_id, 0)
+
+
+def pack_snack(msg_id: int, offset: int) -> bytes:
+    """Chunk-level retransmit request (DESIGN.md §19): the T_SDATA chunk
+    at ``offset`` of ``msg_id`` failed payload verification; its routing
+    sub-header verified, so only that chunk needs to ride again."""
+    return pack_header(T_SNACK, msg_id, offset)
+
+
+def pack_csum_for(frame_bytes, payload=None) -> bytes:
+    """The T_CSUM prefix for one outgoing frame (DESIGN.md §19).
+
+    ``frame_bytes`` is everything of the frame already materialised as
+    bytes (header, plus any sub-header/JSON body); ``payload`` the
+    remaining flat payload view, if any.  ``b`` (crc_head) covers the
+    17-byte header -- plus the 24-byte stripe sub-header for T_SDATA --
+    so the receiver validates routing fields before streaming the
+    payload; ``a`` (crc_frame) covers every byte of the frame."""
+    head_len = HEADER_SIZE
+    if frame_bytes[0] == T_SDATA:
+        head_len += SDATA_SUB_SIZE
+    if head_len > len(frame_bytes):
+        head_len = len(frame_bytes)
+    ch = crc32c(frame_bytes[:head_len])
+    cf = ch
+    if len(frame_bytes) > head_len:
+        cf = crc32c(frame_bytes[head_len:], cf)
+    if payload is not None and len(payload):
+        cf = crc32c(payload, cf)
+    return pack_header(T_CSUM, cf, ch)
 
 
 def pack_devpull(tag: int, desc: dict) -> bytes:

@@ -179,6 +179,14 @@ class StripeFeeder:
         n = src.chunk_len(off)
         self.header = frames.pack_sdata_header(src.tag, src.msg_id, off,
                                                src.total, n)
+        if self.lane.conn.csum_ok:
+            # §19 integrity: every chunk frame is self-verifying -- the
+            # prefix's crc_head covers header+sub-header (so routing is
+            # validated before the chunk bytes land in a sink), crc_frame
+            # the chunk bytes too.  Per-lane: each rail negotiated csum
+            # in its own handshake.
+            self.header = frames.pack_csum_for(
+                self.header, src.payload[off:off + n]) + self.header
         self.chunk_end = off + n
         self.written = 0
         return True
@@ -464,6 +472,7 @@ class RailGroup:
                       fires: list) -> None:
         prim = self.primary
         prim._ctr.stripe_chunks_tx += 1
+        prim.retx_offs.discard((src.msg_id, off))  # §19 retx satisfied
         cid = lane.conn.conn_id
         infl = src.rail_offs.get(cid)
         if infl is not None and off in infl:
@@ -481,6 +490,9 @@ class RailGroup:
         src = self.by_id.pop(msg_id, None)
         if src is None or src.sacked:
             return
+        if self.primary.retx_offs:
+            self.primary.retx_offs = {
+                t for t in self.primary.retx_offs if t[0] != msg_id}
         src.sacked = True
         src.settle(fires, None)
         self.primary.worker._on_stripe_sack(self.primary, fires)
@@ -538,6 +550,7 @@ class RailGroup:
         and completed-id LRU make the wholesale resend exactly-once --
         the journal is per-message, never per-lane."""
         self.queue.clear()
+        self.primary.retx_offs.clear()  # wholesale resend supersedes NACKs
         for msg_id in sorted(self.by_id):
             src = self.by_id[msg_id]
             if src.sacked or src.failed:
@@ -562,5 +575,6 @@ class RailGroup:
                 src.settle(fires, reason, force=True)
                 count += 1
         self.queue.clear()
+        self.primary.retx_offs.clear()
         if count:
             self.primary._ctr.ops_cancelled += count

@@ -140,6 +140,14 @@ def load() -> Optional[ctypes.CDLL]:
             lib.sw_atomic_load_u64.restype = ctypes.c_uint64
             lib.sw_atomic_store_u64.argtypes = [ctypes.c_void_p,
                                                 ctypes.c_uint64]
+        # Optional: hardware CRC32C for the §19 integrity plane -- the
+        # Python engine checksums through the same export the C++ engine
+        # uses internally, so mixed pairs agree bit-for-bit
+        # (core/frames.py crc32c).
+        if hasattr(lib, "sw_crc32c"):
+            lib.sw_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                      ctypes.c_uint32]
+            lib.sw_crc32c.restype = ctypes.c_uint32
         _lib = lib
     except Exception as e:  # toolchain/build failure => Python engine
         _lib_err = str(e)
@@ -170,6 +178,25 @@ def atomics(build: bool = True) -> Optional[tuple]:
     if lib is None or not hasattr(lib, "sw_atomic_load_u64"):
         return None
     return lib.sw_atomic_load_u64, lib.sw_atomic_store_u64
+
+
+def crc32c_fn(build: bool = True):
+    """The native ``sw_crc32c`` ctypes fn (hardware CRC32C with software
+    fallback inside the engine), or None.  ``build=False`` mirrors
+    :func:`atomics`: only an already-built artifact -- the first checksum
+    computes on the connection path, where a synchronous g++ build would
+    stall the handshake (core/frames.py falls back to its pure-Python
+    table)."""
+    global _lib
+    if _lib is None and _lib_err is None and not build:
+        from .. import native_build
+
+        if native_build.prebuilt() is None:
+            return None
+    lib = load()
+    if lib is None or not hasattr(lib, "sw_crc32c"):
+        return None
+    return lib.sw_crc32c
 
 
 # ----------------------------------------------------------- op registry

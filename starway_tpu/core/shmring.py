@@ -41,6 +41,27 @@ carries sm on any architecture.  This layout is the cross-engine
 contract: any change here must land in both engines (CLAUDE.md "two
 engines, one contract").
 
+Integrity records (DESIGN.md §19): when both peers negotiated ``csum``
+(``STARWAY_INTEGRITY``), each producer write becomes one *slot record*
+inside the same byte ring::
+
+    u32 len     payload bytes that follow
+    u32 crc     CRC32C over (u64 slot seqno LE || payload bytes)
+
+The slot seqno is an implicit free-running per-direction counter both
+sides maintain, so a stale or replayed region of ring memory can never
+verify; the checksum over the payload catches torn/partial writes (a
+consumer observing a published tail whose data stores it cannot yet see,
+e.g. on a weakly-ordered host, reads a record that fails verification
+instead of silently delivering garbage).  Records are written atomically
+-- header+payload copied, then one tail publication -- so ``readable()``
+always covers whole records; a record is sized to whatever fits, so the
+stream semantics above the ring are unchanged.  Verification happens at
+dequeue: a mismatch raises :class:`SmCorrupt` and the conn poisons with
+the stable ``"corrupt"`` reason (core/conn.py).  The 8-byte record
+header (``REC_HDR``) is cross-engine contract surface (``SM_REC_HDR`` in
+sw_engine.cpp, machine-checked by ``python -m starway_tpu.analysis``).
+
 Wakeup protocol: every cross-side wakeup rides the TCP socket, never shared
 memory.  A producer that advances ``tail`` sends a doorbell byte (DB_DATA);
 a producer that finds the ring full sends a *starving* byte (DB_STARVING)
@@ -62,6 +83,8 @@ import os
 import secrets
 import struct
 
+from . import frames
+
 MAGIC = 0x31676E69726D7773  # b"swmring1" little-endian
 
 _HDR = struct.Struct("<QQQ")  # magic, nonce, ring_size
@@ -72,6 +95,13 @@ DATA_OFF = GLOBAL_HDR + 2 * RING_HDR  # 384
 
 OFF_TAIL = 0
 OFF_HEAD = 64
+
+# §19 integrity slot-record header: u32 payload len, u32 CRC32C(seqno ||
+# payload) -- little-endian, leading every ring write when the conn
+# negotiated "csum".  Cross-engine contract (SM_REC_HDR in sw_engine.cpp).
+REC_HDR = 8
+_REC = struct.Struct("<II")
+_SEQ8 = struct.Struct("<Q")
 
 SHM_DIR = "/dev/shm"
 
@@ -108,17 +138,32 @@ def default_ring_size() -> int:
     return 1 << (v - 1).bit_length()
 
 
+class SmCorrupt(OSError):
+    """A §19 slot record failed verification at dequeue: torn write,
+    bit-flip, or stale slot content.  The conn poisons ("corrupt")."""
+
+
 class Ring:
     """One direction of the segment, viewed as a byte stream.
 
     Exactly one process calls :meth:`write` (the producer) and exactly one
     calls :meth:`read_into` (the consumer); both may inspect cursors.
+    ``slotted`` (set via :meth:`ShmSegment.enable_integrity` once both
+    peers negotiated ``csum``) switches both methods to the checksummed
+    slot-record framing documented in the module docstring.
     """
 
     __slots__ = ("_u64", "_data", "size", "_hdr_idx", "_at", "_tail_addr",
-                 "_head_addr")
+                 "_head_addr", "slotted", "_tx_seq", "_rx_seq", "_rec_left",
+                 "_rec_crc", "_rec_accum")
 
     def __init__(self, seg_mv: memoryview, hdr_off: int, data_off: int, size: int):
+        self.slotted = False
+        self._tx_seq = 0      # producer slot counter
+        self._rx_seq = 0      # consumer slot counter
+        self._rec_left = 0    # payload bytes left in the record being read
+        self._rec_crc = 0
+        self._rec_accum = 0
         # One u64 view over the whole segment: index = byte offset / 8.
         self._u64 = seg_mv.cast("B").cast("Q")
         self._data = seg_mv[data_off : data_off + size]
@@ -182,34 +227,100 @@ class Ring:
         return self.size - (self.tail - self.head)
 
     # ------------------------------------------------------------------ I/O
-    def write(self, src: memoryview) -> int:
-        """Producer: append up to ``len(src)`` bytes; returns bytes written
-        (0 when full).  Data is copied before the tail store publishes it."""
-        tail = self.tail
-        n = min(len(src), self.size - (tail - self.head))
-        if n <= 0:
-            return 0
-        idx = tail & (self.size - 1)
+    def _put(self, cursor: int, src) -> None:
+        """Copy ``src`` into the data area at ``cursor`` (wrapping); the
+        caller publishes the tail afterwards."""
+        n = len(src)
+        idx = cursor & (self.size - 1)
         first = min(n, self.size - idx)
         self._data[idx : idx + first] = src[:first]
         if n > first:
             self._data[: n - first] = src[first:n]
-        self.tail = tail + n
-        return n
 
-    def read_into(self, dst: memoryview) -> int:
-        """Consumer: read up to ``len(dst)`` bytes; returns bytes read."""
-        head = self.head
-        n = min(len(dst), self.tail - head)
-        if n <= 0:
-            return 0
-        idx = head & (self.size - 1)
+    def _take(self, cursor: int, dst) -> None:
+        """Copy ``len(dst)`` bytes out of the data area at ``cursor``
+        (wrapping); the caller advances the head afterwards."""
+        n = len(dst)
+        idx = cursor & (self.size - 1)
         first = min(n, self.size - idx)
         dst[:first] = self._data[idx : idx + first]
         if n > first:
             dst[first:n] = self._data[: n - first]
-        self.head = head + n
+
+    def write(self, src: memoryview) -> int:
+        """Producer: append up to ``len(src)`` bytes; returns bytes written
+        (0 when full).  Data is copied before the tail store publishes it.
+        Slotted mode frames the accepted bytes as ONE checksummed record
+        (header + payload, single tail publication: whole-record
+        visibility)."""
+        tail = self.tail
+        free = self.size - (tail - self.head)
+        if not self.slotted:
+            n = min(len(src), free)
+            if n <= 0:
+                return 0
+            self._put(tail, src[:n])
+            self.tail = tail + n
+            return n
+        if free <= REC_HDR:
+            return 0
+        n = min(len(src), free - REC_HDR)
+        if n <= 0:
+            return 0
+        body = src[:n]
+        crc = frames.crc32c(body, frames.crc32c(_SEQ8.pack(self._tx_seq)))
+        self._tx_seq += 1
+        self._put(tail, _REC.pack(n, crc))
+        self._put(tail + REC_HDR, body)
+        self.tail = tail + REC_HDR + n
         return n
+
+    def read_into(self, dst: memoryview) -> int:
+        """Consumer: read up to ``len(dst)`` bytes; returns bytes read.
+        Slotted mode walks the record framing, folds the payload CRC as
+        bytes leave the ring, and raises :class:`SmCorrupt` at a record
+        boundary whose checksum (over seqno + payload) does not verify --
+        detection happens AT DEQUEUE, before the bytes can be parsed."""
+        if not self.slotted:
+            head = self.head
+            n = min(len(dst), self.tail - head)
+            if n <= 0:
+                return 0
+            self._take(head, dst[:n])
+            self.head = head + n
+            return n
+        total = 0
+        while total < len(dst):
+            head = self.head
+            avail = self.tail - head
+            if self._rec_left == 0:
+                if avail < REC_HDR:
+                    break  # producers publish whole records: ring idle
+                hdr = bytearray(REC_HDR)
+                self._take(head, hdr)
+                ln, crc = _REC.unpack(hdr)
+                if ln == 0 or ln > self.size:
+                    raise SmCorrupt("sm slot record header corrupt "
+                                    f"(len={ln})")
+                self.head = head + REC_HDR
+                self._rec_left = ln
+                self._rec_crc = crc
+                self._rec_accum = frames.crc32c(_SEQ8.pack(self._rx_seq))
+                self._rx_seq += 1
+                continue
+            n = min(len(dst) - total, self._rec_left, avail)
+            if n <= 0:
+                break
+            out = dst[total : total + n]
+            self._take(head, out)
+            self._rec_accum = frames.crc32c(out, self._rec_accum)
+            self.head = head + n
+            self._rec_left -= n
+            total += n
+            if self._rec_left == 0 and self._rec_accum != self._rec_crc:
+                raise SmCorrupt("sm slot record checksum mismatch "
+                                f"(slot {self._rx_seq - 1})")
+        return total
 
     def release(self) -> None:
         # Null the atomics path too: a post-close cursor access must raise
@@ -294,6 +405,13 @@ class ShmSegment:
             mm.close()
             raise ValueError("sm segment header mismatch")
         return cls(key, nonce, ring_size, mm, creator=False)
+
+    def enable_integrity(self) -> None:
+        """Switch both rings to §19 checksummed slot records.  Decided by
+        the csum handshake and called before any ring byte flows -- both
+        sides must agree or the framings cannot interoperate."""
+        for r in self.rings:
+            r.slotted = True
 
     def unlink(self) -> None:
         try:

@@ -121,6 +121,8 @@ COUNTER_NAMES = (
     "rail_resteals",      # chunks re-queued off a dead rail onto survivors
     "sends_parked",       # sends parked by the §18 credit window
     "sheds",              # parked sends failed by deadline-aware shedding
+    "csum_fail",          # §19 integrity verification failures detected
+    "chunk_retx",         # §19 striped chunks retransmitted after a NACK
 )
 
 

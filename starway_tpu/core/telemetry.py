@@ -72,6 +72,9 @@ GAUGE_NAMES = (
     #                     seed path carries no accounting)
     "credits_avail",    # sender-side §18 credit remaining toward the peer
     #                     (0 when flow control is off or exhausted)
+    "retx_pending",     # §19 NACK-requeued striped chunks not yet
+    #                     rewritten (drains to 0 once every retransmit
+    #                     is back on a lane; primary rows only)
 )
 
 
@@ -131,6 +134,7 @@ def conn_gauges(conn) -> dict:
         gauges["unexp_bytes"] = int(getattr(conn, "fc_unexp", 0))
         credits = int(getattr(conn, "fc_credits", 0))
         gauges["credits_avail"] = credits if credits > 0 else 0
+        gauges["retx_pending"] = len(getattr(conn, "retx_offs", ()) or ())
     except Exception:
         pass  # a conn torn down mid-snapshot yields a partial sample
     return gauges

@@ -894,6 +894,12 @@ def send_device(worker, conn, buffer, tag, done, fail):
     # host snapshot below instead of the chunked pipeline.
     journaled = (config.session_enabled() if conn is None
                  else getattr(conn, "sess", None) is not None)
+    # §19 integrity conns checksum at framing time, which needs the whole
+    # payload resident: device sends on them take the flat host snapshot
+    # too (the CRC folds once over the full view; DESIGN.md §19).
+    journaled = journaled or (
+        config.integrity_enabled() if conn is None
+        else bool(getattr(conn, "csum_ok", False)))
     # Multi-rail striping (DESIGN.md §17) needs a flat host view -- chunks
     # are random-offset slices, and the §12 lazy-chunked pipeline stages
     # strictly in order.  A stripe-eligible device send therefore takes

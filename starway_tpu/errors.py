@@ -21,6 +21,12 @@ tests/test_basic.py:500-511).  We keep those observable contracts:
     answered the resume handshake with a new epoch; ops that were riding
     out the outage fail with this reason instead of completing late
     (tests/test_session.py)
+  - ``"corrupt"``    -- the negotiated integrity plane (``STARWAY_INTEGRITY``,
+    DESIGN.md §19) detected silent data corruption that cannot be repaired
+    by a chunk retransmit: a frame-header/payload checksum mismatch on a
+    non-striped frame, or a torn shared-memory ring record.  The poisoned
+    connection resets; with ``STARWAY_SESSION=1`` it suspends and the
+    journal replay re-delivers verified bytes instead (tests/test_integrity.py)
 """
 
 from __future__ import annotations
@@ -45,4 +51,5 @@ REASON_NOT_CONNECTED = "Endpoint is not connected"
 REASON_TRUNCATED = "Message truncated: payload larger than posted receive buffer"
 REASON_TIMEOUT = "Operation timed out (deadline exceeded before completion)"
 REASON_SESSION_EXPIRED = "Session expired (resume window elapsed or peer restarted)"
+REASON_CORRUPT = "Data integrity violation (corrupt frame detected)"
 REASON_INTERNAL = "Internal transport error"

@@ -54,6 +54,29 @@ Fault modes (constructor ``mode=``):
     receiver sees a sequence gap it cannot repair in place, resets the
     conn, and the session layer's redial + replay-from-cumulative-ACK
     path runs end to end.
+``corrupt``
+    Frame-aware c->s forwarding that mutates matching units past
+    ``limit_bytes`` -- the silent-data-corruption generator the §19
+    integrity plane (``STARWAY_INTEGRITY``, DESIGN.md §19) is tested
+    against.  Selection and mutation knobs:
+
+    * ``corrupt_ftype`` -- wire frame type to target (e.g. 3 = DATA,
+      12 = SDATA); ``None`` targets any frame that carries a body.
+    * ``corrupt_where`` -- ``"payload"`` (default) flips inside the
+      frame's body (for SDATA: past the 24-byte sub-header, so routing
+      stays intact and the receiver answers T_SNACK); ``"header"`` flips
+      inside the 17-byte header / sub-header region (routing corrupt:
+      the receiver must poison the conn).
+    * ``corrupt_kind`` -- ``"flip"`` (default) XORs one byte at
+      ``corrupt_offset`` (relative to the chosen region; default mid);
+      ``"truncate"`` deletes ``corrupt_bytes`` bytes there instead,
+      desyncing the stream mid-frame.
+    * ``corrupt_count`` -- units to mutate (default 1, then the pump is
+      transparent again).
+
+    Without integrity negotiated the corruption is SILENT -- bytes
+    deliver as good data -- which is exactly the blindness the plane
+    exists to remove.
 
 ``partition_after`` (bytes, any mode that forwards) auto-triggers
 :meth:`partition` once that much client->server traffic has passed --
@@ -80,16 +103,20 @@ from typing import Optional
 _CHUNK = 1 << 16
 
 MODES = ("forward", "delay", "drop", "truncate", "blackhole", "duplicate",
-         "reorder", "choke")
+         "reorder", "choke", "corrupt")
 
 # Wire-format knowledge for the frame-aware modes (core/frames.py): 17-byte
 # little-endian header {u8 type, u64 a, u64 b}; HELLO/HELLO_ACK/DATA/DEVPULL
 # stream `b` payload bytes behind the header, everything else is bare.  A
-# T_SEQ frame (9) is the session layer's sequence prefix and travels glued
-# to the frame it announces -- duplicate/reorder treat the pair as one unit.
+# T_SEQ frame (9) is the session layer's sequence prefix and a T_CSUM
+# frame (17) the §19 integrity prefix; both travel glued to the frame they
+# announce -- the frame-aware modes treat [SEQ][CSUM][frame] as one unit.
 _HDR = 17
 _T_SEQ = 9
 _T_SDATA = 12  # striped chunk: self-describing, dup/reorder-eligible
+_T_CSUM = 17   # §19 integrity prefix: glues to the next frame
+_PREFIX_TYPES = frozenset((_T_SEQ, _T_CSUM))
+_SDATA_SUB = 24  # stripe sub-header behind an SDATA header (frames.py)
 _BODY_TYPES = frozenset((1, 2, 3, 6, 12))  # HELLO, HELLO_ACK, DATA, DEVPULL, SDATA
 
 
@@ -132,15 +159,32 @@ class FaultProxy:
     def __init__(self, target_host: str, target_port: int, mode: str = "forward",
                  *, listen_host: str = "127.0.0.1", delay: float = 0.0,
                  limit_bytes: int = 0, partition_after: Optional[int] = None,
-                 rate_bytes_per_s: int = 64 * 1024):
+                 rate_bytes_per_s: int = 64 * 1024,
+                 corrupt_ftype: Optional[int] = None,
+                 corrupt_where: str = "payload",
+                 corrupt_kind: str = "flip",
+                 corrupt_offset: Optional[int] = None,
+                 corrupt_bytes: int = 1,
+                 corrupt_count: int = 1):
         if mode not in MODES:
             raise ValueError(f"unknown fault mode {mode!r}; expected one of {MODES}")
+        if corrupt_where not in ("payload", "header"):
+            raise ValueError(f"corrupt_where {corrupt_where!r}")
+        if corrupt_kind not in ("flip", "truncate"):
+            raise ValueError(f"corrupt_kind {corrupt_kind!r}")
         self.target = (target_host, target_port)
         self.mode = mode
         self.delay = delay
         self.rate = max(1, int(rate_bytes_per_s))
         self.limit_bytes = limit_bytes
         self.partition_after = partition_after
+        self.corrupt_ftype = corrupt_ftype
+        self.corrupt_where = corrupt_where
+        self.corrupt_kind = corrupt_kind
+        self.corrupt_offset = corrupt_offset
+        self.corrupt_bytes = max(1, int(corrupt_bytes))
+        self._corrupt_left = max(0, int(corrupt_count))
+        self.corrupted_units = 0  # units actually mutated (test oracle)
         self._partitioned = threading.Event()
         self._stalled = threading.Event()
         self._stopping = threading.Event()
@@ -259,10 +303,11 @@ class FaultProxy:
             with self._lock:
                 self._pairs.append(pair)
             for src, dst, is_c2s in ((down, up, True), (up, down, False)):
-                # duplicate/reorder are frame-aware on the faulted (c->s)
-                # direction only; the return path stays a byte pipe.
+                # duplicate/reorder/corrupt are frame-aware on the faulted
+                # (c->s) direction only; the return path stays a byte pipe.
                 fn = (self._pump_framed
-                      if is_c2s and self.mode in ("duplicate", "reorder")
+                      if is_c2s and self.mode in ("duplicate", "reorder",
+                                                  "corrupt")
                       else self._pump)
                 t = threading.Thread(target=fn, args=(pair, src, dst, is_c2s),
                                      daemon=True)
@@ -294,7 +339,12 @@ class FaultProxy:
             try:
                 data = src.recv(chunk)
             except OSError:
-                break
+                # One side died hard (RST): propagate to the other, as a
+                # direct connection would -- a silent exit here would
+                # leave the survivor connected to a dead pipe forever.
+                if not self._partitioned.is_set():
+                    pair.kill(rst=True)
+                return
             if not data:
                 if self._partitioned.is_set():
                     return  # a partition swallows EOFs too: pure silence
@@ -338,16 +388,48 @@ class FaultProxy:
                     and self._c2s_bytes >= self.partition_after):
                 self._partitioned.set()
 
+    def _maybe_corrupt(self, unit: bytes, plen: int, ftype: int) -> bytes:
+        """Corrupt-mode mutation of one assembled unit.  ``plen`` is the
+        byte length of the glued SEQ/CSUM prefixes; the targeted frame's
+        header starts there.  Mutates at most ``corrupt_count`` units."""
+        if self._corrupt_left <= 0:
+            return unit
+        if self.corrupt_ftype is not None:
+            if ftype != self.corrupt_ftype:
+                return unit
+        elif ftype not in (3, 6, 12):  # DATA / DEVPULL / SDATA
+            return unit
+        head_len = _HDR + (_SDATA_SUB if ftype == _T_SDATA else 0)
+        if self.corrupt_where == "header":
+            start, length = plen, min(head_len, len(unit) - plen)
+        else:
+            start = plen + head_len
+            length = len(unit) - start
+        if length <= 0:
+            return unit
+        rel = self.corrupt_offset if self.corrupt_offset is not None \
+            else length // 2
+        idx = start + max(0, min(length - 1, rel))
+        out = bytearray(unit)
+        if self.corrupt_kind == "flip":
+            out[idx] ^= 0x20
+        else:  # truncate: drop bytes mid-frame, desyncing the stream
+            del out[idx : idx + self.corrupt_bytes]
+        self._corrupt_left -= 1
+        self.corrupted_units += 1
+        return bytes(out)
+
     def _pump_framed(self, pair: _ConnPair, src: socket.socket,
                      dst: socket.socket, is_c2s: bool) -> None:
-        """Frame-aware client->server pump for the duplicate/reorder
-        modes: reassembles the byte stream into wire units (header +
-        payload, with a T_SEQ prefix glued to the frame it announces) and
-        injects the fault on *sequenced* units past ``limit_bytes``.
-        Unsequenced traffic (handshake, liveness, ACKs) passes through
-        untouched, so seed-parity conns see a transparent proxy."""
+        """Frame-aware client->server pump for the duplicate/reorder/
+        corrupt modes: reassembles the byte stream into wire units
+        (header + payload, with T_SEQ/T_CSUM prefixes glued to the frame
+        they announce) and injects the fault on eligible units past
+        ``limit_bytes``.  Other traffic (handshake, liveness, ACKs)
+        passes through untouched, so seed-parity conns see a transparent
+        proxy."""
         buf = bytearray()
-        held_seq: Optional[bytes] = None   # T_SEQ unit awaiting its frame
+        held: list = []   # SEQ/CSUM prefix units awaiting their frame
         reorder_hold: Optional[bytes] = None
         try:
             src.settimeout(0.2)  # idle tick: a held swap must not hang a quiet stream
@@ -369,7 +451,10 @@ class FaultProxy:
                         return
                 continue
             except OSError:
-                break
+                # RST propagation, like the raw pump above.
+                if not self._partitioned.is_set():
+                    pair.kill(rst=True)
+                return
             if not data:
                 if self._partitioned.is_set():
                     return
@@ -391,22 +476,23 @@ class FaultProxy:
                     break
                 unit = bytes(buf[:need])
                 del buf[:need]
-                if ftype == _T_SEQ:
-                    held_seq = unit  # glue to the frame it announces
+                if ftype in _PREFIX_TYPES:
+                    held.append(unit)  # glue to the frame they announce
                     continue
-                sequenced = held_seq is not None
-                if sequenced:
-                    unit = held_seq + unit
-                    held_seq = None
-                elif ftype == _T_SDATA:
-                    # Striped chunks are offset-addressed and idempotent
-                    # (DESIGN.md §17): dup/reorder-eligible without a
-                    # T_SEQ prefix -- the receiver's offset dedup is what
-                    # these modes exercise on railed conns.
-                    sequenced = True
+                # dup/reorder eligibility: sequenced session units, or
+                # self-describing striped chunks (offset-dedup'd,
+                # DESIGN.md §17) -- the faults these modes exercise.
+                sequenced = (any(u[0] == _T_SEQ for u in held)
+                             or ftype == _T_SDATA)
+                plen = sum(len(u) for u in held)
+                if held:
+                    unit = b"".join(held) + unit
+                    held.clear()
                 out = unit
                 past = self._c2s_bytes >= self.limit_bytes
-                if sequenced and past and self.mode == "duplicate":
+                if past and self.mode == "corrupt":
+                    out = self._maybe_corrupt(unit, plen, ftype)
+                elif sequenced and past and self.mode == "duplicate":
                     out = unit + unit  # replay overlap: receiver must dedup
                 elif (sequenced and past and self.mode == "reorder"
                       and not self._reordered):

@@ -484,3 +484,145 @@ async def test_fault_cycles_stress(engine, port, monkeypatch):
         await _aclose_all(server)
         for p in proxies:
             p.stop()
+
+
+# --------------------------------------------------- corrupt mode (ISSUE 11)
+#
+# The silent-data-corruption generator the §19 integrity plane is tested
+# against (tests/test_integrity.py drives integrity-negotiated pairs
+# through it).  Here: the mode's own mechanics against raw sockets --
+# selector targeting, byte-exact flips, truncation, and single-shot
+# transparency afterwards.
+
+
+def _proxy_roundtrip_frames(proxy_port, target_listener, frames_out):
+    """Push crafted wire frames through a proxy c->s and return what the
+    'server' side receives."""
+    import socket as _socket
+
+    cli = _socket.create_connection((ADDR, proxy_port), timeout=5)
+    try:
+        up, _addr = target_listener.accept()
+        up.settimeout(5)
+        cli.sendall(frames_out)
+        got = b""
+        while len(got) < len(frames_out):
+            chunk = up.recv(65536)
+            if not chunk:
+                break
+            got += chunk
+        up.close()
+        return got
+    finally:
+        cli.close()
+
+
+def test_corrupt_mode_flips_one_byte_of_selected_frame(port):
+    """corrupt/flip mutates exactly one byte of the first matching frame
+    (by type, in the chosen region) and forwards everything else
+    verbatim -- single-shot: later matching frames pass untouched."""
+    import socket as _socket
+
+    from starway_tpu.core import frames as _frames
+
+    listener = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    listener.bind((ADDR, port))
+    listener.listen(4)
+    proxy = FaultProxy(ADDR, port, mode="corrupt", corrupt_ftype=3,
+                       corrupt_where="payload", corrupt_offset=2).start()
+    try:
+        payload = bytes(range(32))
+        data1 = _frames.pack_data_header(7, len(payload)) + payload
+        ping = _frames.pack_ping(0)
+        data2 = _frames.pack_data_header(8, len(payload)) + payload
+        wire = ping + data1 + data2
+        got = _proxy_roundtrip_frames(proxy.port, listener, wire)
+        assert len(got) == len(wire)
+        assert got[: len(ping)] == ping  # non-matching type untouched
+        d1 = got[len(ping): len(ping) + len(data1)]
+        assert d1[:_frames.HEADER_SIZE] == data1[:_frames.HEADER_SIZE]
+        flipped = [i for i in range(len(payload))
+                   if d1[_frames.HEADER_SIZE + i] != payload[i]]
+        assert flipped == [2], flipped  # corrupt_offset=2, one byte
+        assert got[len(ping) + len(data1):] == data2  # single-shot
+        assert proxy.corrupted_units == 1
+    finally:
+        proxy.stop()
+        listener.close()
+
+
+def test_corrupt_mode_header_and_truncate(port):
+    """corrupt_where="header" flips inside the 17-byte header region;
+    corrupt_kind="truncate" deletes bytes mid-frame (the stream-desync
+    fault).  Selection still keys on the original frame type."""
+    import socket as _socket
+
+    from starway_tpu.core import frames as _frames
+
+    listener = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    listener.bind((ADDR, port))
+    listener.listen(4)
+    payload = bytes(range(48))
+    data = _frames.pack_data_header(9, len(payload)) + payload
+    proxy = FaultProxy(ADDR, port, mode="corrupt", corrupt_ftype=3,
+                       corrupt_where="header", corrupt_offset=3).start()
+    try:
+        got = _proxy_roundtrip_frames(proxy.port, listener, data)
+        assert len(got) == len(data)
+        assert got[3] != data[3] and got[_frames.HEADER_SIZE:] == payload
+    finally:
+        proxy.stop()
+        listener.close()
+    listener = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    listener.bind((ADDR, 0))
+    listener.listen(4)
+    tport = listener.getsockname()[1]
+    proxy = FaultProxy(ADDR, tport, mode="corrupt", corrupt_ftype=3,
+                       corrupt_kind="truncate", corrupt_bytes=5).start()
+    try:
+        cli = _socket.create_connection((ADDR, proxy.port), timeout=5)
+        up, _ = listener.accept()
+        up.settimeout(5)
+        cli.sendall(data + _frames.pack_ping(0))
+        want = len(data) - 5 + _frames.HEADER_SIZE
+        got = b""
+        while len(got) < want:
+            chunk = up.recv(65536)
+            if not chunk:
+                break
+            got += chunk
+        assert len(got) == want, (len(got), want)  # 5 bytes vanished
+        assert proxy.corrupted_units == 1
+        cli.close()
+        up.close()
+    finally:
+        proxy.stop()
+        listener.close()
+
+
+def test_corrupt_mode_glues_csum_prefix(port):
+    """A [CSUM][frame] unit stays glued through the framed pump, and the
+    flip lands in the FRAME's payload -- never in the prefix -- so the
+    receiver sees a checksum that truthfully disagrees with the bytes."""
+    import socket as _socket
+
+    from starway_tpu.core import frames as _frames
+
+    listener = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    listener.bind((ADDR, port))
+    listener.listen(4)
+    proxy = FaultProxy(ADDR, port, mode="corrupt", corrupt_ftype=3).start()
+    try:
+        payload = bytes(range(64))
+        hdr = _frames.pack_data_header(5, len(payload))
+        unit = _frames.pack_csum_for(hdr, memoryview(payload)) + hdr + payload
+        got = _proxy_roundtrip_frames(proxy.port, listener, unit)
+        assert len(got) == len(unit)
+        pre_len = _frames.HEADER_SIZE
+        assert got[:pre_len] == unit[:pre_len]            # prefix intact
+        assert got[pre_len: 2 * pre_len] == hdr           # header intact
+        assert got[2 * pre_len:] != payload               # payload flipped
+        assert proxy.corrupted_units == 1
+    finally:
+        proxy.stop()
+        listener.close()

@@ -476,3 +476,62 @@ def test_overload_soak_script():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     report = json.loads(proc.stdout.strip().splitlines()[-1])
     assert report["ok"] and report["peak_unexp_bytes"] <= report["unexp_bound"]
+
+
+async def test_fc_rails_session_resume_striped_credit(pair, port):
+    """fc x rails x sessions, all on (the ISSUE 11 interaction gap): a
+    kill mid-striped-transfer suspends the session; the resume re-debits
+    journal-replayed EAGER sends against the fresh window while un-SACKed
+    STRIPED sources re-dispatch wholesale outside it (striped sends never
+    consume credit -- SACK-terminated, like the RTS path).  Everything
+    completes exactly once, the striped payload lands byte-exact through
+    the offset dedup, and the window fully restores (credit conservation
+    across the incarnation, with both kinds in the journal)."""
+    s_eng, c_eng, mp = pair
+    if {s_eng, c_eng} == {"py", "native"}:
+        pytest.skip("mixed pairs covered by the homogeneous runs (cost)")
+    mp.setenv("STARWAY_SESSION", "1")
+    mp.setenv("STARWAY_SESSION_GRACE", "30")
+    mp.setenv("STARWAY_RAILS", "3")
+    mp.setenv("STARWAY_STRIPE_THRESHOLD", str(1 << 20))
+    mp.setenv("STARWAY_STRIPE_CHUNK", str(256 << 10))
+    server = _mk_server(s_eng, mp, port)
+    proxy = FaultProxy(ADDR, port).start()
+    client = _mk_client(c_eng, mp)
+    await asyncio.wait_for(client.aconnect(ADDR, proxy.port), 30)
+    try:
+        n, size = 6, 8192
+        payload = (np.arange(4 << 20, dtype=np.uint64) % 251).astype(np.uint8)
+        striped = client.asend(payload, 777)
+        sends = [client.asend(np.full(size, i % 251, dtype=np.uint8), 300 + i)
+                 for i in range(n)]
+        await asyncio.sleep(0.2)
+        proxy.kill_all(rst=True)  # mid-stripe: primary + rails all die
+        await asyncio.sleep(0.4)
+        sink = np.zeros(4 << 20, dtype=np.uint8)
+        bigrecv = server.arecv(sink, 777, MASK)
+        bufs = [np.zeros(size, dtype=np.uint8) for _ in range(n)]
+        recvs = [server.arecv(bufs[i], 300 + i, MASK) for i in range(n)]
+        await asyncio.wait_for(asyncio.gather(striped, *sends), 90)
+        await asyncio.wait_for(client.aflush(), 90)
+        await asyncio.wait_for(asyncio.gather(bigrecv, *recvs), 90)
+        assert (sink == payload).all(), "striped replay corrupted bytes"
+        for i in range(n):
+            assert bufs[i][0] == i % 251
+        cs = client._client.counters_snapshot()
+        ss = server._server.counters_snapshot()
+        assert cs["sessions_resumed"] >= 1, cs
+        assert ss["recvs_completed"] == n + 1, ss
+        # Credit conservation across the resume: the fresh window was
+        # re-debited by replayed eager frames only; once their grants
+        # return, the full window is back -- striped traffic never
+        # touched it.
+        for _ in range(200):
+            if WINDOW in _credits(client._client):
+                break
+            await asyncio.sleep(0.05)
+        assert WINDOW in _credits(client._client), _credits(client._client)
+        assert _unexp_bytes(server._server) == 0
+    finally:
+        await _aclose_all(client, server)
+        proxy.stop()

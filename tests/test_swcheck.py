@@ -189,8 +189,8 @@ def test_garbled_doc_table(tmp_path):
 def test_version_drift(tmp_path):
     root = _seed(tmp_path)
     _edit(root, "native/sw_engine.cpp",
-          'return "starway-native-8"', 'return "starway-native-9"')
-    _assert_caught(root, "contract-version", "starway-native-9", "sw_engine.h")
+          'return "starway-native-9"', 'return "starway-native-10"')
+    _assert_caught(root, "contract-version", "starway-native-10", "sw_engine.h")
 
 
 def test_unmarked_multi_gib_test(tmp_path):
@@ -1139,3 +1139,111 @@ def test_explore_credit_conservation_mutation():
     leaked = explore.check("credit-leak")
     fired = {v[0] for v in leaked["violations"]}
     assert "credit-conservation" in fired, fired
+
+
+# ------------------- ISSUE 11: the §19 integrity plane contract surface
+#
+# The integrity plane grew two frame types (T_CSUM/T_SNACK), a handshake
+# key ("csum"), a stable poison reason ("corrupt"), an sm slot-record
+# trailer layout (REC_HDR <-> SM_REC_HDR), two counters, a gauge, an ABI
+# export (sw_crc32c), and new dispatch transitions -- every row below
+# seeds one violation and pins that the matching rule fires.
+
+
+def test_csum_frame_constant_drift(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/frames.py", "T_SNACK = 18", "T_SNACK = 19")
+    _assert_caught(root, "contract-frames", "T_SNACK", "frames.py")
+    root2 = _seed(tmp_path / "two")
+    _edit(root2, "native/sw_engine.cpp",
+          "constexpr uint8_t T_CSUM = 17;", "constexpr uint8_t T_CSUM = 19;")
+    _assert_caught(root2, "contract-frames", "T_CSUM = 19", "frames.py")
+
+
+def test_csum_handshake_key_dropped(tmp_path):
+    # Deleting the "csum" negotiation from either engine's code fires,
+    # even when the key survives in comments/docstrings.
+    root = _seed(tmp_path)
+    p = root / "starway_tpu" / "core" / "engine.py"
+    p.write_text(p.read_text().replace('"csum"', '"csux"')
+                 + '\n# the "csum" key lives only in this comment now\n')
+    _assert_caught(root, "contract-handshake", '"csum"', "engine.py")
+    root2 = _seed(tmp_path / "two")
+    p = root2 / "native" / "sw_engine.cpp"
+    p.write_text(p.read_text().replace('"csum"', '"csux"')
+                 + '\n// the "csum" key lives only in this comment now\n')
+    _assert_caught(root2, "contract-handshake", '"csum"', "sw_engine.cpp")
+
+
+def test_corrupt_reason_reworded(tmp_path):
+    # "corrupt" is the stable poison keyword callers match on
+    # (tests/test_integrity.py): rewording fires both sub-checks.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/errors.py",
+          'REASON_CORRUPT = "Data integrity violation (corrupt frame'
+          ' detected)"',
+          'REASON_CORRUPT = "Checksum mismatch"')
+    hits = _findings(root, "contract-reason")
+    assert any("stable keyword" in f.message for f in hits), hits
+    assert any("kCorrupt" in f.message for f in hits), hits
+    _assert_caught(root, "contract-reason", "REASON_CORRUPT", "errors.py")
+
+
+def test_sm_slot_trailer_layout_drift(tmp_path):
+    # The slot-record header size is shared segment framing: the engines
+    # disagreeing on it would silently interleave garbage.
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          "constexpr size_t SM_REC_HDR = 8;", "constexpr size_t SM_REC_HDR = 16;")
+    _assert_caught(root, "contract-shm", "SM_REC_HDR", "shmring.py")
+
+
+def test_csum_counter_dropped_from_cpp(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp", '"csum_fail"', '"csum_fail_v2"')
+    _assert_caught(root, "contract-trace", "csum_fail_v2", "sw_engine.cpp")
+    _assert_caught(root, "contract-trace", "'csum_fail'", "swtrace.py")
+
+
+def test_retx_gauge_dropped_from_cpp(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp", '"retx_pending",', "")
+    _assert_caught(root, "contract-trace", "retx_pending", "telemetry.py")
+
+
+def test_csum_doc_table_row_garbled(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/frames.py",
+          "SNACK     corrupt chunk's msg id", "SNACKX    corrupt chunk's msg id")
+    hits = _findings(root, "contract-doctable")
+    assert any("SNACKX" in f.message for f in hits), hits
+    assert any("missing from the docstring table" in f.message
+               for f in hits), hits
+
+
+def test_csum_state_annotation_drift(tmp_path):
+    # The CSUM gate can tear the conn down (nested/missing checksum):
+    # the native annotation claiming estab-only must diff against the
+    # Python extraction.
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          "// swcheck: state(estab, CSUM, estab|down)",
+          "// swcheck: state(estab, CSUM, estab)")
+    _assert_caught(root, "proto-state", "CSUM", "conn.py")
+
+
+def test_snack_state_annotation_missing(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          "// swcheck: state(estab, SNACK, estab)\n", "")
+    _assert_caught(root, "proto-state", "(estab, SNACK)", "conn.py")
+
+
+def test_sw_crc32c_abi_dropped(tmp_path):
+    # Removing the export from the header while the ctypes binding stays
+    # is a stale-binding finding (and vice versa would be a missing
+    # argtypes finding) -- the §19 checksum must stay one function.
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.h",
+          "uint32_t sw_crc32c(const void* p, uint64_t n, uint32_t seed);", "")
+    _assert_caught(root, "contract-abi", "sw_crc32c", "native.py")
