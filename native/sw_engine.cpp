@@ -170,6 +170,7 @@ const char* kCounterNames[] = {
     "rail_resteals",
     "sends_parked",      "sheds",
     "csum_fail",         "chunk_retx",
+    "reshard_bytes",     "reshard_rounds",
 };
 
 // swscope per-conn gauge vocabulary, same order as the values rendered by
@@ -204,6 +205,9 @@ struct Counters {
   std::atomic<uint64_t> rail_resteals{0};
   std::atomic<uint64_t> sends_parked{0}, sheds{0};
   std::atomic<uint64_t> csum_fail{0}, chunk_retx{0};
+  // §20 swshard schedule accounting: wrapper-owned (the executor runs
+  // above the workers), overlaid at snapshot time like staging_*.
+  std::atomic<uint64_t> reshard_bytes{0}, reshard_rounds{0};
 };
 
 inline void bump(std::atomic<uint64_t>& c, uint64_t n = 1) {
@@ -341,6 +345,18 @@ uint64_t stripe_threshold_env() {
   uint64_t v = e ? strtoull(e, nullptr, 10) : 0;
   return v;  // 0 = striping off (seed parity)
 }
+
+bool stripe_weighted_env() {
+  // Lane-weighted tail claiming (config.py STARWAY_STRIPE_WEIGHTED;
+  // DESIGN.md §17).  Off by default: pure work stealing.
+  const char* e = getenv("STARWAY_STRIPE_WEIGHTED");
+  return e && *e && strcmp(e, "0") != 0;
+}
+
+// EWMA smoothing / slow-lane fraction: core/lane.py EWMA_ALPHA and
+// SLOW_FRACTION are the twins.
+constexpr double kStripeEwmaAlpha = 0.3;
+constexpr double kStripeSlowFraction = 0.5;
 
 // Receiver-driven flow control (config.py STARWAY_FC_WINDOW /
 // STARWAY_UNEXP_BYTES; DESIGN.md §18).  0 = off, seed parity.  Read per
@@ -1396,6 +1412,7 @@ struct TxItem {
   // (completion-driven work stealing).  The SOURCE owns the op callbacks.
   StripeRef stripe;
   uint64_t stripe_off = 0;    // payload offset of the current chunk
+  double stripe_t0 = 0;       // claim timestamp (lane throughput EWMA)
 
   uint64_t total() const { return header.size() + paylen; }
 };
@@ -1503,6 +1520,10 @@ struct Conn {
   uint64_t rail_parent = 0;     // primary conn id (secondary only)
   bool rails_ok = false;        // "rails" negotiated on the primary
   bool feeder_live = false;     // this lane's feeder item is queued
+  // Per-lane delivered-throughput EWMA (one update per completed chunk;
+  // 0 = no data yet) + tail steals declined under STARWAY_STRIPE_WEIGHTED.
+  double stripe_ewma_bps = 0;
+  uint64_t stripe_tail_declines = 0;
   // TX scheduler (primary only): sources FIFO + id registry until SACK.
   uint64_t next_stripe_msg = 1;
   std::deque<StripeRef> stripe_q;
@@ -2814,16 +2835,48 @@ struct Worker {
     }
   }
 
+  // STARWAY_STRIPE_WEIGHTED tail bias (core/lane.py _decline_tail is the
+  // twin): in a message's last chunks a slow lane's final chunk IS the
+  // completion time, so a lane whose delivered-throughput EWMA sits
+  // below half the fastest live lane's declines the steal and leaves it
+  // for a faster lane's next refill.
+  bool stripe_decline_tail(Conn* root, Conn* lane, const StripeRef& src) {
+    if (lane->stripe_ewma_bps <= 0 || !stripe_weighted_env()) return false;
+    int live = stripe_live_lanes(root);
+    if (live < 2 || src->pending.size() > (size_t)live) return false;
+    double best = (root->alive && root->fd >= 0) ? root->stripe_ewma_bps : 0;
+    for (uint64_t rid : root->rails) {
+      Conn* r = conn_by_id(rid);
+      if (r && r->alive && r->fd >= 0 && r->stripe_ewma_bps > best)
+        best = r->stripe_ewma_bps;
+    }
+    if (lane->stripe_ewma_bps >= kStripeSlowFraction * best) return false;
+    lane->stripe_tail_declines++;
+    return true;
+  }
+
   // The work-stealing heart: hand the next pending chunk (FIFO across
   // sources) to the lane that asked, loading it into `item` as one
-  // self-describing T_SDATA frame.
-  bool stripe_claim(Conn* root, Conn* lane, TxItem& item) {
+  // self-describing T_SDATA frame.  `steal` marks a refill claim; only
+  // steals may be declined by the weighted-tail policy (dispatch always
+  // feeds every live lane, so a declined chunk can never strand).
+  bool stripe_claim(Conn* root, Conn* lane, TxItem& item, bool steal) {
     while (!root->stripe_q.empty()) {
-      StripeRef src = root->stripe_q.front();
-      if (src->pending.empty() || src->sacked || src->failed) {
+      StripeRef& front = root->stripe_q.front();
+      if (front->pending.empty() || front->sacked || front->failed) {
         root->stripe_q.pop_front();
         continue;
       }
+      break;
+    }
+    for (auto& qref : root->stripe_q) {
+      StripeRef src = qref;
+      if (src->pending.empty() || src->sacked || src->failed)
+        continue;  // settled mid-queue: dropped when it reaches front
+      // A declined tail skips THIS source only: the slow lane must
+      // still carry the bulk of messages queued behind it (core/lane.py
+      // claim_next is the twin).
+      if (steal && stripe_decline_tail(root, lane, src)) continue;
       uint64_t off = src->pending.front();
       src->pending.pop_front();
       src->rail_offs[lane->id].push_back(off);
@@ -2839,6 +2892,9 @@ struct Worker {
       item.off = 0;
       item.stripe = src;
       item.stripe_off = off;
+      item.stripe_t0 =
+          std::chrono::duration<double>(Clock::now().time_since_epoch())
+              .count();
       // §19: every chunk frame self-verifies; per-lane -- each rail
       // negotiated csum in its own handshake (core/lane.py twin).
       csum_arm(lane, item);
@@ -2852,6 +2908,19 @@ struct Worker {
   void stripe_tx_chunk_finished(Conn* lane, TxItem& item, FireList& fires) {
     StripeRef src = item.stripe;
     bump(counters.stripe_chunks_tx);
+    // Lane throughput EWMA (tracked unconditionally, one multiply per
+    // chunk; only the weighted-claim policy is env-gated).
+    double dt = std::chrono::duration<double>(
+                    Clock::now().time_since_epoch()).count() - item.stripe_t0;
+    uint64_t nb = src->chunk_len(item.stripe_off);
+    if (dt > 0 && nb > 0) {
+      double bps = (double)nb / dt;
+      lane->stripe_ewma_bps =
+          lane->stripe_ewma_bps == 0
+              ? bps
+              : (1.0 - kStripeEwmaAlpha) * lane->stripe_ewma_bps +
+                    kStripeEwmaAlpha * bps;
+    }
     stripe_root(lane)->retx_offs.erase({src->msg_id, item.stripe_off});
     src->writers--;
     if (src->unwritten > 0) src->unwritten--;
@@ -2883,10 +2952,11 @@ struct Worker {
     stripe_maybe_release(*src, fires);
   }
 
-  // Refill the lane's feeder with the next chunk; false = group dry.
+  // Refill the lane's feeder with the next chunk; false = group dry
+  // (or a weighted-tail decline -- the steal point).
   bool stripe_refill(Conn* lane, TxItem& item) {
     item.stripe.reset();
-    return stripe_claim(stripe_root(lane), lane, item);
+    return stripe_claim(stripe_root(lane), lane, item, /*steal=*/true);
   }
 
   // A tx queue about to be cleared may hold a feeder mid-frame: release
@@ -2923,7 +2993,8 @@ struct Worker {
       if (!lane->alive || lane->fd < 0) continue;
       if (!lane->feeder_live) {
         auto item = std::make_shared<TxItem>();
-        if (!stripe_claim(root, lane, *item)) break;  // group dry
+        if (!stripe_claim(root, lane, *item, /*steal=*/false))
+          break;  // group dry
         item->counted = true;  // the SOURCE owns per-message accounting
         lane->feeder_live = true;
         lane->tx.push_back(std::move(item));
@@ -5691,6 +5762,7 @@ int sw_counters(void* h, char* out, int cap) {
       c.rail_resteals.load(),
       c.sends_parked.load(),   c.sheds.load(),
       c.csum_fail.load(),      c.chunk_retx.load(),
+      c.reshard_bytes.load(),  c.reshard_rounds.load(),
   };
   constexpr size_t kN = sizeof(kCounterNames) / sizeof(kCounterNames[0]);
   static_assert(sizeof(vals) / sizeof(vals[0]) == kN,

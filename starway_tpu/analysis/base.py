@@ -38,6 +38,8 @@ RULES = {
     "proto-state": "protocol state machines of the two engines disagree",
     "proto-explore": "session-model invariant violated under a fault schedule",
     "layering-jax": "jax imported under core/ (device.py owns that boundary)",
+    "layering-reshard": "reshard/-above-core/ boundary crossed (core/ "
+                        "imports reshard, or jax bound outside reshard/api.py)",
     "marker-slow": "multi-GiB test payload without a `slow` marker",
     "hotpath-copy": "full-payload bytes()/.tobytes() copy on a core/ data path",
     "bad-waiver": "swcheck waiver without a justification string",
@@ -237,6 +239,10 @@ def waiver_audit_files(root: Path) -> list[Path]:
     ]
     extra += [root / rel_ for rel_ in LINT_EXTRA_FILES]
     extra += sorted((root / "starway_tpu").glob("*.py"))
+    # reshard/ carries the layering-reshard rule, so its waivers must be
+    # auditable too (rglob: nested modules are lint surface like core/'s).
+    extra += sorted(p for p in (root / "starway_tpu" / "reshard").rglob("*.py")
+                    if "__pycache__" not in p.parts)
     seen: set = set()
     out = []
     for p in core_py_files(root) + test_files(root) + [p for p in extra

@@ -1,11 +1,20 @@
-"""Pass 3: layering lint -- no ``import jax`` anywhere under core/.
+"""Pass 3: layering lint -- the import-direction rules between layers.
 
-The matcher and transports are byte-oriented; device awareness enters
-only through the duck-typed sink/payload protocols in device.py
-(CLAUDE.md architecture invariants).  A jax import in core/ would make
-the host transport unimportable in jax-free processes (the wheel's
-test-command imports core.native with only numpy installed) and couple
-the engine to the device plane.
+Two rows, one discipline (dependencies point DOWN the stack only):
+
+* **core/ imports no jax** (``layering-jax``).  The matcher and
+  transports are byte-oriented; device awareness enters only through the
+  duck-typed sink/payload protocols in device.py (CLAUDE.md architecture
+  invariants).  A jax import in core/ would make the host transport
+  unimportable in jax-free processes (the wheel's test-command imports
+  core.native with only numpy installed) and couple the engine to the
+  device plane.
+* **reshard/ sits above core/** (``layering-reshard``, DESIGN.md §20).
+  Both directions of the boundary: no module under core/ may import
+  ``starway_tpu.reshard`` (the engine must not know schedules exist),
+  and under reshard/ only ``api.py`` -- the jax adapter -- may import
+  jax, so the planner/executor stay runnable in jax-free processes the
+  same way core/ does.
 """
 
 from __future__ import annotations
@@ -15,9 +24,39 @@ from pathlib import Path
 
 from .base import Finding, core_py_files, parse_or_finding, rel
 
+#: The one reshard/ module allowed to bind jax (the adapter) -- exact
+#: repo-relative path, so a nested helper named api.py is NOT exempt.
+RESHARD_JAX_OK = ("starway_tpu/reshard/api.py",)
+
 
 def _is_jax(module: str) -> bool:
     return module == "jax" or module.startswith("jax.")
+
+
+def _is_reshard(module: str, level: int) -> bool:
+    if level == 0:
+        return (module == "starway_tpu.reshard"
+                or module.startswith("starway_tpu.reshard."))
+    # Relative imports from core/ modules: `..reshard` is level 2,
+    # module "reshard" (or "reshard.plan").
+    return module == "reshard" or module.startswith("reshard.")
+
+
+def _names_package_root(node: "ast.ImportFrom") -> bool:
+    """Does this ImportFrom's module part resolve to the starway_tpu
+    package root (from a core/ module)?  Then its alias names can bind
+    reshard: `from starway_tpu import reshard`, `from .. import
+    reshard`."""
+    if node.level == 0:
+        return node.module == "starway_tpu"
+    return node.level == 2 and not node.module
+
+
+def reshard_py_files(root: Path) -> list:
+    pkg = root / "starway_tpu" / "reshard"
+    if not pkg.is_dir():
+        return []
+    return sorted(p for p in pkg.rglob("*.py") if "__pycache__" not in p.parts)
 
 
 def run(root: Path) -> list:
@@ -37,10 +76,59 @@ def run(root: Path) -> list:
                             f"`import {alias.name}` under core/ -- device "
                             "awareness enters only via device.py's "
                             "duck-typed sink/payload protocols"))
+                    elif _is_reshard(alias.name, 0):
+                        out.append(Finding(
+                            relpath, node.lineno, "layering-reshard",
+                            f"`import {alias.name}` under core/ -- "
+                            "reshard/ sits ABOVE core/; the engine must "
+                            "not import the schedule layer"))
             elif isinstance(node, ast.ImportFrom):
                 if node.level == 0 and node.module and _is_jax(node.module):
                     out.append(Finding(
                         relpath, node.lineno, "layering-jax",
                         f"`from {node.module} import ...` under core/ -- "
                         "device awareness enters only via device.py"))
+                elif node.module and _is_reshard(node.module, node.level):
+                    out.append(Finding(
+                        relpath, node.lineno, "layering-reshard",
+                        f"`from {'.' * node.level}{node.module} import ...` "
+                        "under core/ -- reshard/ sits ABOVE core/; the "
+                        "engine must not import the schedule layer"))
+                elif _names_package_root(node):
+                    # `from starway_tpu import reshard` / `from .. import
+                    # reshard` bind the subpackage through the package
+                    # root -- same boundary, different spelling.
+                    for alias in node.names:
+                        if (alias.name == "reshard"
+                                or alias.name.startswith("reshard.")):
+                            out.append(Finding(
+                                relpath, node.lineno, "layering-reshard",
+                                f"`from {node.module or '.' * node.level} "
+                                f"import {alias.name}` under core/ -- "
+                                "reshard/ sits ABOVE core/; the engine "
+                                "must not import the schedule layer"))
+    for path in reshard_py_files(root):
+        relpath = rel(root, path)
+        if relpath in RESHARD_JAX_OK:
+            continue
+        tree, err = parse_or_finding(path, relpath)
+        if tree is None:
+            out.append(err)
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if _is_jax(alias.name):
+                        out.append(Finding(
+                            relpath, node.lineno, "layering-reshard",
+                            f"`import {alias.name}` in reshard/{path.name} "
+                            "-- only the api.py adapter may bind jax; the "
+                            "planner/executor stay jax-free (DESIGN.md §20)"))
+            elif isinstance(node, ast.ImportFrom):
+                if node.level == 0 and node.module and _is_jax(node.module):
+                    out.append(Finding(
+                        relpath, node.lineno, "layering-reshard",
+                        f"`from {node.module} import ...` in "
+                        f"reshard/{path.name} -- only the api.py adapter "
+                        "may bind jax (DESIGN.md §20)"))
     return out

@@ -90,6 +90,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--flood-messages", type=int)
     p.add_argument("--flood-iterations", type=int)
     p.add_argument(
+        "--reshard-bytes", type=parse_size, metavar="BYTES",
+        help="Array size for the 'reshard' scenario (redistributed whole "
+             "each iteration under the §20 O(shard) staging bound).",
+    )
+    p.add_argument("--reshard-blocks", type=int, metavar="N",
+                   help="Shards per side for the 'reshard' scenario "
+                        "(row-sharded source -> column-sharded sink).")
+    p.add_argument("--reshard-iterations", type=int)
+    p.add_argument("--reshard-warmup", type=int)
+    p.add_argument(
         "--fc-window", type=parse_size, metavar="BYTES",
         help="Arm §18 receiver-driven flow control (STARWAY_FC_WINDOW) for "
              "the run; see the 'flooded' scenario (DESIGN.md §18).",
@@ -135,6 +145,10 @@ _OVERRIDE_KEYS = {
     "streaming-duplex": [("stream_bytes", "message_bytes"), ("stream_iterations", "iterations"), ("stream_warmup", "warmup")],
     "striped": [("striped_bytes", "message_bytes"), ("striped_iterations", "iterations"), ("striped_warmup", "warmup")],
     "flooded": [("flood_bytes", "message_bytes"), ("flood_messages", "messages"), ("flood_iterations", "iterations")],
+    "reshard": [
+        ("reshard_bytes", "message_bytes"), ("reshard_blocks", "blocks"),
+        ("reshard_iterations", "iterations"), ("reshard_warmup", "warmup"),
+    ],
 }
 
 

@@ -53,6 +53,11 @@ STREAM_DOWN_TAG = 0x2B31
 STRIPED_DATA_TAG = 0x2B40
 FLOOD_DATA_TAG = 0x2B50
 FLOOD_STATS_TAG = 0x2B51
+RESHARD_STATS_TAG = 0x2B60
+#: swshard schedules address their transfers inside the reserved
+#: 0xE5<<56 namespace (reshard/tags.py); the scenario pins lease slot 11
+#: on both roles -- the shared-coordinate contract.
+RESHARD_LEASE_SLOT = 11
 
 
 @dataclass
@@ -509,12 +514,171 @@ class Flooded(Scenario):
         await ctx.flush_endpoint()
 
 
+class Reshard(Scenario):
+    """swshard array redistribution (DESIGN.md §20): the measuring side
+    (rank 0) owns an N-byte array row-sharded into ``blocks`` shards,
+    the sink side (rank 1) wants it column-sharded -- the transposed-
+    ownership retile every piece of the array must cross for.  The
+    planner compiles the block intersections into rounds of <=budget
+    transfers and the executor drives them with flush barriers between
+    rounds, so peak staging per role stays O(shard) = O(N/blocks), not
+    O(N) -- ``peak_staging_bytes`` (the live reshard_staging gauge) vs
+    ``staging_bound_bytes`` in the metrics shows the §20 memory bound
+    holding at full bandwidth.  Host numpy path: the schedule machinery
+    itself is jax-free; jax arrays enter via reshard.redistribute()."""
+
+    name = "reshard"
+    description = "Sharding->sharding redistribution: GB/s under the O(shard) staging bound (DESIGN.md §20)."
+    defaults = {"message_bytes": 256 << 20, "blocks": 8, "warmup": 1,
+                "iterations": 3}
+
+    @staticmethod
+    def _specs(size: int, blocks: int):
+        from ..reshard import Block, ShardSpec
+
+        rows = int(blocks)
+        cols = max(rows, int(size) // rows)
+        shape = (rows, cols)  # one row per source shard
+        src = ShardSpec(shape, 1, [
+            Block(0, ((r, r + 1), (0, cols))) for r in range(rows)])
+        step = cols // rows
+        edges = [c * step for c in range(rows)] + [cols]
+        dst = ShardSpec(shape, 1, [
+            Block(1, ((0, rows), (edges[c], edges[c + 1])))
+            for c in range(rows)])
+        return shape, src, dst
+
+    @staticmethod
+    def _lease():
+        from ..reshard import tags
+
+        # Direct construction (no registry acquire): both roles -- which
+        # share one process in loopback -- coordinate on the same slot.
+        return tags.TagLease(RESHARD_LEASE_SLOT)
+
+    async def run_client(self, ctx, overrides) -> ScenarioResult:
+        from ..reshard import build_plan, executor
+
+        cfg = self.config(overrides)
+        size, blocks = int(cfg["message_bytes"]), int(cfg["blocks"])
+        warmup, iters = int(cfg["warmup"]), int(cfg["iterations"])
+        shape, src, dst = self._specs(size, blocks)
+        plan = build_plan(src, dst)
+        lease = self._lease()
+        # Tiled 0..250 pattern with no multi-GiB uint64 temporaries (the
+        # scenario's selling point is bounded staging; its own setup
+        # must not allocate O(8 x array)).
+        data = np.resize(np.arange(251, dtype=np.uint8),
+                         shape[0] * shape[1]).reshape(shape)
+
+        def read_box(box):
+            (r0, r1), (c0, c1) = box
+            return np.ascontiguousarray(data[r0:r1, c0:c1]).reshape(-1)
+
+        def write_box(box, view):  # rank 0 is a pure sender
+            raise AssertionError("unexpected receive on the source rank")
+
+        stats_buf = np.zeros(4096, dtype=np.uint8)
+        secs: list[float] = []
+        peaks: list[int] = []
+        rounds = 0
+        for i in range(warmup + iters):
+            stats_fut = ctx.client.arecv(stats_buf, RESHARD_STATS_TAG,
+                                         ctx.tag_mask)
+            t0 = time.perf_counter()
+            st = await executor.execute(
+                plan, 0, {1: ctx.client}, read_box, write_box,
+                tag_of=lambda t: lease.data_tag(t.tag_off))
+            _, ln = await stats_fut
+            dt = time.perf_counter() - t0
+            peer = _decode_ctl(stats_buf, ln)
+            if i >= warmup:
+                secs.append(dt)
+                rounds = st["rounds"]
+                # Worst single ROLE's own high-water: per-invocation
+                # peaks, not the process-global gauge -- in loopback
+                # both roles share one process and would double-count.
+                peaks.append(max(int(st["peak_staging"]),
+                                 int(peer.get("peak", 0))))
+        await ctx.flush()
+        total = sum(secs)
+        moved = plan.total_wire_nbytes()
+        return ScenarioResult(
+            name=self.name,
+            metrics={
+                "total_seconds": total,
+                "avg_seconds_per_iter": total / iters if iters else 0.0,
+                "avg_gbps": (moved * iters / total / 1e9) if total > 0 else 0.0,
+                "rounds": rounds,
+                "transfers": len(plan.transfers),
+                "wire_bytes_per_iter": moved,
+                "peak_staging_bytes": max(peaks) if peaks else 0,
+                "staging_bound_bytes": 2 * plan.budget,
+            },
+            samples={"duration_seconds": secs,
+                     "peak_staging_bytes": [float(p) for p in peaks]},
+            config=cfg,
+        )
+
+    class _SinkPort:
+        """Endpoint-bound server port (dp_exchange.ServerPort's shape,
+        local so this module stays importable without jax)."""
+
+        def __init__(self, server, endpoint):
+            self._s = server
+            self._ep = endpoint
+
+        def asend(self, buf, tag):
+            return self._s.asend(self._ep, buf, tag)
+
+        def arecv(self, buf, tag, mask):
+            return self._s.arecv(buf, tag, mask)
+
+        def aflush(self):
+            return self._s.aflush_ep(self._ep)
+
+    async def run_server(self, ctx, overrides) -> None:
+        from ..reshard import build_plan, executor
+
+        cfg = self.config(overrides)
+        size, blocks = int(cfg["message_bytes"]), int(cfg["blocks"])
+        total = int(cfg["warmup"]) + int(cfg["iterations"])
+        shape, src, dst = self._specs(size, blocks)
+        plan = build_plan(src, dst)
+        lease = self._lease()
+        out = np.empty(shape, dtype=np.uint8)
+
+        def read_box(box):  # rank 1 is a pure receiver
+            raise AssertionError("unexpected send from the sink rank")
+
+        def write_box(box, view):
+            (r0, r1), (c0, c1) = box
+            out[r0:r1, c0:c1] = np.frombuffer(view, dtype=np.uint8).reshape(
+                (r1 - r0, c1 - c0))
+
+        port = self._SinkPort(ctx.server, ctx.endpoint)
+        await ctx.signal_ready()
+        for _ in range(total):
+            st = await executor.execute(
+                plan, 1, {0: port}, read_box, write_box,
+                tag_of=lambda t: lease.data_tag(t.tag_off))
+            await ctx.server.asend(
+                ctx.endpoint, _encode_ctl({"peak": int(st["peak_staging"])}),
+                RESHARD_STATS_TAG)
+        # Cheap correctness pin: the received retile is the source pattern.
+        want = np.resize(np.arange(251, dtype=np.uint8),
+                         shape[0] * shape[1]).reshape(shape)
+        if not np.array_equal(out, want):
+            raise AssertionError("reshard scenario: received retile corrupt")
+        await ctx.flush_endpoint()
+
+
 # Back-compat aliases matching the reference's registry surface.
 ScenarioDefinition = Scenario
 
 SCENARIOS: Dict[str, Scenario] = {
     s.name: s for s in (LargeArray(), SmallMessages(), PingpongFlag(),
-                        StreamingDuplex(), Striped(), Flooded())
+                        StreamingDuplex(), Striped(), Flooded(), Reshard())
 }
 
 __all__ = [

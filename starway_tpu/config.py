@@ -144,6 +144,19 @@ benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
     what makes chunk-level work stealing, rail-death redistribution, and
     receiver-side offset dedup possible.
 
+``STARWAY_STRIPE_WEIGHTED``
+    "1" = lane-weighted tail claiming (default off).  The stripe
+    scheduler always tracks a per-lane EWMA of delivered throughput
+    (bytes of each completed chunk over its claim-to-written wall time);
+    with the knob armed, a lane whose EWMA has fallen below half the
+    fastest live lane's *declines to steal one of the last chunks* of a
+    message (the tail, where a slow lane's final chunk IS the message's
+    completion time), leaving it for a faster lane's next refill.
+    Dispatch-time claims are never declined, so a chunk can never
+    strand: the fastest live lane never declines, and every requeue path
+    re-feeds all lanes unconditionally.  Both engines implement the
+    identical policy.  See DESIGN.md §17.
+
 ``STARWAY_FC_WINDOW``
     Receiver-driven flow-control window in bytes (default 0 = off, seed
     parity).  When > 0 the handshake offers ``"fc": "<bytes>"`` and, once
@@ -252,6 +265,7 @@ __all__ = [
     "stripe_rails",
     "stripe_threshold",
     "stripe_chunk",
+    "stripe_weighted",
     "fc_window",
     "unexp_cap",
     "integrity_enabled",
@@ -416,6 +430,12 @@ def stripe_chunk() -> int:
         except ValueError:
             pass
     return max(4096, 4 * (chunk_bytes() or 256 * 1024))
+
+
+def stripe_weighted() -> bool:
+    """Lane-weighted tail claiming (STARWAY_STRIPE_WEIGHTED); off by
+    default -- pure work stealing, the PR-8 behaviour."""
+    return _env("STARWAY_STRIPE_WEIGHTED", "0") not in ("", "0")
 
 
 def fc_window() -> int:

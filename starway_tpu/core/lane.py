@@ -15,7 +15,12 @@ concurrently:
   from the group the moment its current chunk finishes writing --
   completion-driven work stealing, not static round-robin: a lane twice
   as fast naturally carries twice the chunks, and a stalled lane stops
-  claiming.  Each chunk travels as a self-describing T_SDATA frame
+  claiming.  Each lane tracks an EWMA of its delivered throughput, and
+  under ``STARWAY_STRIPE_WEIGHTED`` a slow lane (below half the fastest
+  live lane's EWMA) declines *steal* claims in a message's tail so the
+  last chunks avoid stragglers -- dispatch claims are never declined,
+  which is what keeps a declined chunk from stranding (DESIGN.md §17).
+  Each chunk travels as a self-describing T_SDATA frame
   (msg id, offset, total), so chunks are idempotent and unordered.
 * **RX** -- :class:`StripeRx` (on the receiving side's primary conn)
   reassembles by offset into ONE matcher message per msg id, whatever
@@ -43,12 +48,21 @@ pairings interoperate chunk-for-chunk.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Optional
 
 from .. import config
 from ..errors import REASON_CANCELLED
 from . import frames, swtrace
+
+#: EWMA smoothing for per-lane delivered throughput (one update per
+#: completed chunk; ~3-4 chunks to converge after a speed change).
+EWMA_ALPHA = 0.3
+
+#: A lane slower than this fraction of the fastest live lane's EWMA
+#: declines tail steals under STARWAY_STRIPE_WEIGHTED.
+SLOW_FRACTION = 0.5
 
 #: Completed-message ids remembered per receiving rail group so a late or
 #: replayed chunk re-SACKs instead of corrupting state.  Bounded: the
@@ -62,18 +76,32 @@ class Lane:
     of the rail set.  ``idx`` 0 is the primary; the feeder is this lane's
     persistent tx item while it has (or may claim) chunks."""
 
-    __slots__ = ("conn", "idx", "feeder", "chunks_tx")
+    __slots__ = ("conn", "idx", "feeder", "chunks_tx", "ewma_bps",
+                 "tail_declines")
 
     def __init__(self, conn, idx: int):
         self.conn = conn
         self.idx = idx
         self.feeder: Optional["StripeFeeder"] = None
         self.chunks_tx = 0  # cumulative chunks this lane carried (balance)
+        self.ewma_bps = 0.0  # delivered-throughput EWMA (0 = no data yet)
+        self.tail_declines = 0  # tail steals declined as the slow lane
 
     @property
     def alive(self) -> bool:
         c = self.conn
         return c.alive and c.sock is not None
+
+    def note_chunk(self, nbytes: int, dt: float) -> None:
+        """One chunk fully written after ``dt`` seconds on this lane:
+        fold it into the throughput EWMA (tracked unconditionally -- one
+        multiply per chunk; only the weighted-claim *policy* is gated)."""
+        if dt <= 0.0 or nbytes <= 0:
+            return
+        bps = nbytes / dt
+        self.ewma_bps = (bps if self.ewma_bps == 0.0
+                         else (1.0 - EWMA_ALPHA) * self.ewma_bps
+                         + EWMA_ALPHA * bps)
 
 
 class StripeSource:
@@ -151,7 +179,7 @@ class StripeFeeder:
 
     __slots__ = ("group", "lane", "src", "chunk_off", "header", "chunk_end",
                  "written", "switch_after", "counted", "sess_seq",
-                 "sess_nbytes", "e2e_ord")
+                 "sess_nbytes", "e2e_ord", "claim_t0")
 
     def __init__(self, group: "RailGroup", lane: Lane):
         self.group = group
@@ -166,13 +194,15 @@ class StripeFeeder:
         self.sess_seq = 0     # chunks are never seq-framed (idempotent)
         self.sess_nbytes = 0
         self.e2e_ord = 0
+        self.claim_t0 = 0.0   # perf_counter at claim (lane EWMA sample)
 
     # ------------------------------------------------------------- claim
-    def _claim(self) -> bool:
-        nxt = self.group.claim_next(self.lane)
+    def _claim(self, steal: bool = True) -> bool:
+        nxt = self.group.claim_next(self.lane, steal)
         if nxt is None:
             return False
         src, off = nxt
+        self.claim_t0 = time.perf_counter()
         self.src = src
         src.writers += 1
         self.chunk_off = off
@@ -240,6 +270,8 @@ class StripeFeeder:
             # whole striped message (DESIGN.md §17).
             self.group.first_progress(self.src, fires)
         if self.written >= self._frame_total():
+            self.lane.note_chunk(self.chunk_end - self.chunk_off,
+                                 time.perf_counter() - self.claim_t0)
             self.group.chunk_written(self.lane, self.src, self.chunk_off,
                                      fires)
             self._drop_src()
@@ -267,6 +299,8 @@ class StripeFeeder:
                 self.written += n
                 if not self.src.local_done:
                     self.group.first_progress(self.src, fires)
+            self.lane.note_chunk(self.chunk_end - self.chunk_off,
+                                 time.perf_counter() - self.claim_t0)
             self.group.chunk_written(self.lane, self.src, self.chunk_off,
                                      fires)
             self._drop_src()
@@ -440,25 +474,61 @@ class RailGroup:
             conn = lane.conn
             if feeder is None or feeder not in conn.tx:
                 feeder = StripeFeeder(self, lane)
-                if not feeder._claim():
+                if not feeder._claim(steal=False):
                     break  # group dry: later lanes have nothing to claim
                 lane.feeder = feeder
                 conn.tx.append(feeder)
             conn.kick_tx(fires)
 
-    def claim_next(self, lane: Lane):
+    def claim_next(self, lane: Lane, steal: bool = False):
         """The work-stealing heart: hand the next pending chunk (FIFO
-        across sources) to whichever lane asked first."""
+        across sources) to whichever lane asked first.
+
+        ``steal`` marks a refill claim (a feeder that just finished a
+        chunk) as opposed to a dispatch claim.  Only steals may be
+        declined by the weighted-tail policy: dispatch always feeds
+        every live lane, so a declined chunk can never strand -- any
+        requeue path (submit, rail death, NACK retransmit, session
+        resume) goes through dispatch, and the fastest live lane never
+        declines (its EWMA is the maximum by definition)."""
         while self.queue:
             src = self.queue[0]
             if not src.pending or src.sacked or src.failed:
                 self.queue.popleft()
+                continue
+            break
+        for src in self.queue:
+            if not src.pending or src.sacked or src.failed:
+                continue  # settled mid-queue: dropped when it reaches front
+            if steal and self._decline_tail(lane, src):
+                # Leave THIS message's tail to faster lanes, but keep
+                # scanning: a slow lane declining msg N must still carry
+                # the bulk of msg N+1 queued behind it -- idling the
+                # lane entirely would halve throughput exactly when the
+                # knob is meant to help.
                 continue
             off = src.pending.popleft()
             src.rail_offs.setdefault(lane.conn.conn_id, []).append(off)
             lane.chunks_tx += 1
             return src, off
         return None
+
+    def _decline_tail(self, lane: Lane, src: StripeSource) -> bool:
+        """STARWAY_STRIPE_WEIGHTED tail bias (DESIGN.md §17): in the last
+        chunks of a message -- where handing the final chunk to a slow
+        lane makes that lane's drain time the WHOLE message's completion
+        time -- a lane whose delivered-throughput EWMA sits below
+        SLOW_FRACTION of the fastest live lane's declines the steal."""
+        if not config.stripe_weighted() or lane.ewma_bps <= 0.0:
+            return False
+        live = self.live_lanes()
+        if len(live) < 2 or len(src.pending) > len(live):
+            return False  # not the tail (or nobody else to leave it to)
+        best = max(ln.ewma_bps for ln in live)
+        if lane.ewma_bps >= SLOW_FRACTION * best:
+            return False
+        lane.tail_declines += 1
+        return True
 
     # -------------------------------------------------------- completion
     def first_progress(self, src: StripeSource, fires: list) -> None:

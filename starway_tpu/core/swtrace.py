@@ -123,6 +123,10 @@ COUNTER_NAMES = (
     "sheds",              # parked sends failed by deadline-aware shedding
     "csum_fail",          # §19 integrity verification failures detected
     "chunk_retx",         # §19 striped chunks retransmitted after a NACK
+    "reshard_bytes",      # §20 swshard bytes staged through schedules
+    #                       (process-global: the executor runs above the
+    #                       workers, like the staging pool does)
+    "reshard_rounds",     # §20 swshard schedule rounds executed
 )
 
 
@@ -147,7 +151,8 @@ class Counters:
 #: Process-global counters (staging pool, api-layer reconnects).
 GLOBAL = Counters()
 
-_GLOBAL_NAMES = ("staging_hits", "staging_misses", "reconnects")
+_GLOBAL_NAMES = ("staging_hits", "staging_misses", "reconnects",
+                 "reshard_bytes", "reshard_rounds")
 
 
 def merge_global_counters(snap: dict) -> dict:

@@ -153,10 +153,29 @@ def staging_pool_bytes() -> int:
     return int(getattr(pool, "_held", 0)) if pool is not None else 0
 
 
+def _reshard_staging() -> dict:
+    """Process-global swshard transfer-staging occupancy + high-water
+    mark (reshard/executor.py; DESIGN.md §20's asserted memory bound).
+    Zeros when the reshard layer has never loaded -- core/ must not
+    import it (layering-reshard, the jax-rule twin)."""
+    import sys
+
+    ex = sys.modules.get("starway_tpu.reshard.executor")
+    if ex is None:
+        return {"now": 0, "peak": 0}
+    try:
+        return ex.staging_snapshot()
+    except Exception:
+        return {"now": 0, "peak": 0}
+
+
 def merge_global_gauges(snap: dict) -> dict:
     """Overlay the process-global gauges onto a worker snapshot (the
     native engine reports 0 for them, like its counter twin)."""
     snap["staging_pool_bytes"] = staging_pool_bytes()
+    st = _reshard_staging()
+    snap["reshard_staging_bytes"] = st["now"]
+    snap["reshard_staging_peak"] = st["peak"]
     return snap
 
 

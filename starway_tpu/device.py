@@ -48,13 +48,19 @@ def _record_stage(name: str, seconds: float, nbytes: int, scope=None) -> None:
 
 def _np_dtype(dtype):
     """Normalise numpy / jax.numpy scalar types / strings to np.dtype
-    (ml_dtypes like bfloat16 included)."""
+    (ml_dtypes like bfloat16 included -- by NAME too, which np.dtype
+    alone rejects; reshard/api.py round-trips dtypes as strings)."""
     import numpy as np
 
     d = getattr(dtype, "dtype", None)
     if isinstance(d, np.dtype):
         return d
-    return np.dtype(dtype)
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, str(dtype)))
 
 
 # --------------------------------------------------------------- fast copy
@@ -327,7 +333,11 @@ class DevicePayload:
 
             t0 = time.perf_counter()
             host = np.ascontiguousarray(np.asarray(self.array))
-            self._host_view = memoryview(host).cast("B")
+            # view(uint8) first: extension dtypes (ml_dtypes bfloat16 et
+            # al) have no buffer-protocol format char, so memoryview()
+            # on the raw array raises for exactly the payloads TPU work
+            # ships most.
+            self._host_view = memoryview(host.view(np.uint8)).cast("B")
             _record_stage("stage", time.perf_counter() - t0, self.nbytes,
                           self.scope)
         return self._host_view
@@ -819,7 +829,7 @@ class PulledPayload:
             import numpy as np
 
             host = np.ascontiguousarray(np.asarray(self.array))
-            self._host_view = memoryview(host).cast("B")
+            self._host_view = memoryview(host.view(np.uint8)).cast("B")
         return self._host_view
 
 

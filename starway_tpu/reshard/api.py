@@ -1,0 +1,371 @@
+"""swshard jax adapter + the public ``redistribute()`` entry point.
+
+This is the ONLY module under reshard/ allowed to import jax (analysis
+rule ``layering-reshard`` -- the planner/executor stay pure so the
+schedule machinery works in jax-free processes, mirroring core/'s
+no-jax rule).  It lowers ``jax.sharding.NamedSharding`` into the
+planner's pure-data :class:`~.plan.ShardSpec`, exchanges per-rank spec
+contributions over the fabric itself (so participants on *different
+meshes/process sets* never need a shared jax namespace), drives
+:func:`~.executor.execute`, and re-assembles the destination
+``jax.Array``.
+
+>>> res = await redistribute(src_array, dst_sharding, peers={1: port},
+...                          rank=0, lease_slot=3)
+>>> res.array   # the redistributed jax.Array under dst_sharding
+
+Participants coordinate on three things only: the same ``lease_slot``
+(tag namespace, reshard/tags.py), a ``rank`` per process, and a port per
+peer -- exactly the coordination surface parallel/dp_exchange.py already
+asks for.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Callable, Optional
+
+from . import executor as _executor
+from . import tags as _tags
+from .plan import Block, ShardSpec, box_nbytes, build_plan
+
+__all__ = ["ArrayRef", "ReshardResult", "redistribute", "spec_from_sharding",
+           "default_rank_of"]
+
+
+class ArrayRef:
+    """Descriptor standing in for an array this process does not hold
+    (the pure-receiver side of a cross-pod redistribution)."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = _np_dtype(dtype)
+
+
+def _np_dtype(dtype):
+    # One normaliser for the whole device-adjacent surface (handles
+    # ml_dtypes by name -- the spec exchange ships dtypes as strings).
+    from ..device import _np_dtype as _dev_np_dtype
+
+    return _dev_np_dtype(dtype)
+
+
+def default_rank_of(device) -> int:
+    """Device -> participant rank: the owning process (the real-cluster
+    mapping; tests override to simulate many ranks on one host mesh)."""
+    return int(device.process_index)
+
+
+def _slices_to_box(idx, shape):
+    box = []
+    for sl, dim in zip(idx, shape):
+        lo = 0 if sl.start is None else int(sl.start)
+        hi = int(dim) if sl.stop is None else int(sl.stop)
+        box.append((lo, hi))
+    # Trailing dims a PartitionSpec left unmentioned are unsharded.
+    for dim in shape[len(idx):]:
+        box.append((0, int(dim)))
+    return tuple(box)
+
+
+def spec_from_sharding(sharding, shape, itemsize,
+                       rank_of: Callable = default_rank_of,
+                       only_rank: Optional[int] = None) -> ShardSpec:
+    """Lower a NamedSharding (or any jax sharding with
+    ``devices_indices_map``) into a pure-data :class:`ShardSpec`.
+    ``only_rank`` keeps just that rank's blocks -- the per-process
+    contribution the spec exchange ships to peers."""
+    blocks = []
+    for dev, idx in sharding.devices_indices_map(tuple(shape)).items():
+        r = rank_of(dev)
+        if only_rank is not None and r != only_rank:
+            continue
+        blocks.append(Block(r, _slices_to_box(idx, shape)))
+    return ShardSpec(tuple(shape), itemsize, blocks)
+
+
+class ReshardResult:
+    """Per-device destination buffers + lazy assembly into a jax.Array.
+
+    ``shards`` maps local destination devices to filled host buffers.
+    :attr:`array` assembles them under ``sharding`` once every
+    addressable device of the sharding is present; simulated-rank
+    callers (several ranks in one process) :meth:`merge` their partial
+    results first."""
+
+    def __init__(self, shape, dtype, sharding, shards: dict, stats: dict):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.sharding = sharding
+        self.shards = shards
+        self.stats = stats
+        self._array = None
+
+    def merge(self, other: "ReshardResult") -> "ReshardResult":
+        self.shards.update(other.shards)
+        return self
+
+    @property
+    def array(self):
+        import jax
+
+        if self._array is not None:
+            return self._array
+        if self.sharding is None:
+            raise ValueError("no destination sharding on this rank "
+                             "(pure sender) -- there is nothing to assemble")
+        want = set(self.sharding.addressable_devices)
+        have = set(self.shards)
+        if have != want:
+            raise ValueError(
+                f"destination incomplete: {len(have)}/{len(want)} local "
+                "device shards filled -- merge() the other simulated "
+                "ranks' results first")
+        arrays = [jax.device_put(buf, dev) for dev, buf in self.shards.items()]
+        self._array = jax.make_array_from_single_device_arrays(
+            self.shape, self.sharding, arrays)
+        return self._array
+
+
+# ------------------------------------------------------------ spec exchange
+
+
+def _ctl_payload(obj: dict):
+    import numpy as np
+
+    raw = json.dumps(obj, separators=(",", ":"), sort_keys=True).encode()
+    return np.frombuffer(raw, dtype=np.uint8).copy()
+
+
+async def _exchange_specs(rank, peers, lease, src_spec, dst_spec,
+                          shape, itemsize, dtype_name, ctl_bytes, timeout):
+    """All-gather the per-rank spec contributions over the ports: my
+    contribution goes out on ``ctl_tag(rank)``, each peer's arrives on
+    ``ctl_tag(peer)``.  Returns the merged (src, dst) specs."""
+    import numpy as np
+
+    mine = {
+        "rank": rank,
+        "shape": list(shape),
+        "itemsize": itemsize,
+        "dtype": dtype_name,
+        "src": src_spec.to_dict()["blocks"],
+        "dst": dst_spec.to_dict()["blocks"],
+    }
+    payload = _ctl_payload(mine)
+    if len(payload) > ctl_bytes:
+        raise ValueError(
+            f"spec contribution ({len(payload)} B) exceeds the ctl buffer "
+            f"({ctl_bytes} B); raise ctl_bytes")
+    bufs = {p: np.empty(ctl_bytes, dtype=np.uint8) for p in peers}
+    ops = [peers[p].arecv(bufs[p], lease.ctl_tag(p), _executor.FULL_MASK)
+           for p in sorted(peers)]
+    ops += [peers[p].asend(payload, lease.ctl_tag(rank)) for p in sorted(peers)]
+    gathered = asyncio.gather(*ops)
+    if timeout is not None:
+        results = await asyncio.wait_for(gathered, timeout)
+    else:
+        results = await gathered
+    src, dst = src_spec, dst_spec
+    for (_, ln), p in zip(results[:len(peers)], sorted(peers)):
+        theirs = json.loads(bytes(memoryview(bufs[p])[:ln]).decode())
+        if (tuple(theirs["shape"]) != tuple(shape)
+                or int(theirs["itemsize"]) != itemsize
+                or theirs["dtype"] != dtype_name):
+            raise ValueError(
+                f"rank {p} describes a different array "
+                f"({theirs['shape']}/{theirs['dtype']}) than this rank "
+                f"({list(shape)}/{dtype_name})")
+        src = src.merged(ShardSpec(shape, itemsize,
+                                   [Block.from_dict(b) for b in theirs["src"]]))
+        dst = dst.merged(ShardSpec(shape, itemsize,
+                                   [Block.from_dict(b) for b in theirs["dst"]]))
+    return src, dst
+
+
+# ----------------------------------------------------------- local adapters
+
+
+def _local_src_shards(array, rank, rank_of):
+    """[(box, lazy host getter, jax shard array)] for this rank's share
+    of the source array."""
+    import numpy as np
+
+    shape = array.shape
+    out = []
+    for shard in array.addressable_shards:
+        if rank_of(shard.device) != rank:
+            continue
+        box = _slices_to_box(shard.index, shape)
+        out.append([box, None, shard.data])
+    def host_of(entry):
+        if entry[1] is None:
+            entry[1] = np.ascontiguousarray(np.asarray(entry[2]))
+        return entry[1]
+    return out, host_of
+
+
+def _box_contains(outer, inner) -> bool:
+    return all(olo <= ilo and ihi <= ohi
+               for (olo, ohi), (ilo, ihi) in zip(outer, inner))
+
+
+def _local_slices(outer, inner):
+    return tuple(slice(ilo - olo, ihi - olo)
+                 for (olo, _), (ilo, ihi) in zip(outer, inner))
+
+
+# -------------------------------------------------------------- entry point
+
+
+async def redistribute(array_or_ref, dst_sharding=None, peers=None, *,
+                       rank: int = 0, rank_of: Callable = default_rank_of,
+                       src_sharding=None, lease=None, lease_slot=None,
+                       budget: Optional[int] = None, via: str = "host",
+                       round_timeout: Optional[float] = None,
+                       ctl_bytes: int = 1 << 18) -> ReshardResult:
+    """Move an array between two shardings over the starway fabric.
+
+    ``array_or_ref`` is this process's view of the SOURCE: a sharded
+    ``jax.Array`` (source holder) or an :class:`ArrayRef` (pure
+    receiver).  ``dst_sharding`` is the destination sharding for this
+    process's devices (None on a pure sender).  ``peers`` maps the other
+    participants' ranks to duck-typed ports (``asend``/``arecv``/
+    ``aflush`` -- parallel/dp_exchange.py ports fit); omit it for a
+    purely local retile.
+
+    Every participant must pass the same ``lease_slot`` (reserved-tag
+    coordination, reshard/tags.py) and a unique ``rank``.  ``via`` picks
+    the transfer representation: ``"host"`` (flat uint8 staging, works
+    everywhere) or ``"device"`` (jax.Array payloads/DeviceBuffer sinks
+    through device.py's duck-typed protocols -- rides devpull when the
+    connection negotiated it).  ``budget`` caps one message's bytes
+    (default: the largest shard, the §20 memory unit).
+
+    Returns a :class:`ReshardResult`; ``result.array`` is the assembled
+    destination ``jax.Array`` (raises on a pure sender).
+    """
+    import numpy as np
+
+    peers = dict(peers or {})
+    if rank in peers:
+        raise ValueError(f"peers must not contain this rank ({rank})")
+    if via not in ("host", "device"):
+        raise ValueError(f"via={via!r}: expected 'host' or 'device'")
+
+    is_ref = isinstance(array_or_ref, ArrayRef)
+    array = None if is_ref else array_or_ref
+    if array is not None and not hasattr(array, "addressable_shards"):
+        raise TypeError(
+            f"array_or_ref must be a jax.Array or ArrayRef, got "
+            f"{type(array_or_ref)!r}")
+    shape = tuple(array_or_ref.shape)
+    dtype = _np_dtype(array_or_ref.dtype)
+    itemsize = int(dtype.itemsize)
+
+    # ---- local contributions ----------------------------------------
+    if array is not None:
+        src_sh = src_sharding if src_sharding is not None else array.sharding
+        src_spec = spec_from_sharding(src_sh, shape, itemsize,
+                                      rank_of, only_rank=rank)
+        src_shards, src_host = _local_src_shards(array, rank, rank_of)
+    else:
+        src_spec = ShardSpec(shape, itemsize, [])
+        src_shards, src_host = [], None
+
+    dst_devs: dict = {}
+    if dst_sharding is not None:
+        for dev, idx in dst_sharding.devices_indices_map(shape).items():
+            if rank_of(dev) == rank:
+                dst_devs[dev] = _slices_to_box(idx, shape)
+    dst_spec = ShardSpec(shape, itemsize,
+                         [Block(rank, box) for box in dst_devs.values()])
+
+    # ---- spec exchange + plan ---------------------------------------
+    own_lease = None
+    if lease is None:
+        lease = own_lease = _tags.lease(lease_slot)
+    try:
+        if peers:
+            src_spec, dst_spec = await _exchange_specs(
+                rank, peers, lease, src_spec, dst_spec, shape, itemsize,
+                str(dtype), ctl_bytes, round_timeout)
+        plan = build_plan(src_spec, dst_spec, budget=budget)
+
+        # ---- local IO callbacks -------------------------------------
+        dst_bufs = {dev: np.empty(tuple(hi - lo for lo, hi in box),
+                                  dtype=dtype)
+                    for dev, box in dst_devs.items()}
+
+        def read_box(box):
+            for entry in src_shards:
+                if _box_contains(entry[0], box):
+                    sub = src_host(entry)[_local_slices(entry[0], box)]
+                    return np.ascontiguousarray(sub).view(np.uint8).reshape(-1)
+            raise KeyError(f"no local source shard contains {box}")
+
+        def write_box(box, view):
+            shaped = None
+            for dev, dbox in dst_devs.items():
+                if _box_contains(dbox, box):
+                    if shaped is None:
+                        flat = np.frombuffer(view, dtype=np.uint8)
+                        shaped = flat.view(dtype).reshape(
+                            tuple(hi - lo for lo, hi in box))
+                    dst_bufs[dev][_local_slices(dbox, box)] = shaped
+
+        hooks = {}
+        if via == "device":
+            hooks = _device_hooks(plan, src_shards, write_box, dtype)
+
+        stats = await _executor.execute(
+            plan, rank, peers, read_box, write_box,
+            tag_of=lambda t: lease.data_tag(t.tag_off),
+            round_timeout=round_timeout, **hooks)
+    finally:
+        if own_lease is not None:
+            own_lease.release()
+
+    stats["plan_rounds"] = plan.rounds
+    stats["peak_staging_bound"] = 2 * plan.budget
+    return ReshardResult(shape, dtype, dst_sharding, dst_bufs, stats)
+
+
+def _device_hooks(plan, src_shards, write_box, dtype):
+    """Device-plane transfer hooks: payloads are jax.Arrays sliced on
+    device (sent through device.py's DevicePayload path -- devpull when
+    negotiated), sinks are DeviceBuffers.  Assembly still lands through
+    ``write_box`` (the host buffers are the destination staging)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..device import DeviceBuffer
+
+    def dev_read(box):
+        for entry in src_shards:
+            if _box_contains(entry[0], box):
+                return entry[2][_local_slices(entry[0], box)].reshape(-1)
+        raise KeyError(f"no local source shard contains {box}")
+
+    def make_payload(t):
+        parts = [dev_read(p.box) for p in t.pieces]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def make_sink(t):
+        elems = t.nbytes // dtype.itemsize
+        return DeviceBuffer((elems,), dtype)
+
+    def consume_sink(t, sink):
+        host = np.ascontiguousarray(np.asarray(sink.array))
+        flat = host.view(np.uint8).reshape(-1)
+        off = 0
+        for p in t.pieces:
+            nb = box_nbytes(p.box, plan.itemsize)
+            write_box(p.box, flat[off:off + nb])
+            off += nb
+
+    return {"make_payload": make_payload, "make_sink": make_sink,
+            "consume_sink": consume_sink}

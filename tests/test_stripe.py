@@ -396,6 +396,144 @@ async def test_striped_e2e_markers_per_message(port, monkeypatch):
         await _aclose_all(client, server)
 
 
+# ------------------------------------- lane-weighted tail claiming (§17)
+
+
+class _StubConn:
+    """Bare conn stand-in for white-box RailGroup policy tests."""
+
+    def __init__(self, cid):
+        self.conn_id = cid
+        self.alive = True
+        self.sock = object()
+        self.tx = []
+        self.dirty = False
+        self.csum_ok = False
+        self.retx_offs = set()
+
+    def kick_tx(self, fires):
+        pass
+
+
+def _stub_group(nlanes):
+    from starway_tpu.core.lane import RailGroup
+
+    group = RailGroup(_StubConn(1))
+    for i in range(1, nlanes):
+        group.add_rail(_StubConn(i + 1))
+    return group
+
+
+def _queue_source(group, nchunks, chunk=4096):
+    from starway_tpu.core.lane import StripeSource
+
+    payload = memoryview(bytes(nchunks * chunk))
+    src = StripeSource(group.next_msg_id, 5, payload, None, None, None, chunk)
+    group.next_msg_id += 1
+    group.by_id[src.msg_id] = src
+    group.queue.append(src)
+    return src
+
+
+def test_weighted_tail_decline_policy(monkeypatch):
+    """White-box: under STARWAY_STRIPE_WEIGHTED a slow lane (EWMA below
+    half the fastest live lane's) declines *steal* claims in a message's
+    tail -- and ONLY there: dispatch claims, head-of-message steals, and
+    the fastest lane itself always claim."""
+    monkeypatch.setenv("STARWAY_STRIPE_WEIGHTED", "1")
+    group = _stub_group(2)
+    fast, slow = group.lanes
+    fast.ewma_bps = 100e6
+    slow.ewma_bps = 10e6
+    src = _queue_source(group, nchunks=8)
+    # Head of the message (8 pending > 2 lanes): the slow lane steals.
+    assert group.claim_next(slow, steal=True) is not None
+    # Drain to the tail (2 pending <= 2 lanes).
+    while len(src.pending) > 2:
+        assert group.claim_next(fast, steal=True) is not None
+    assert group.claim_next(slow, steal=True) is None, \
+        "slow lane must decline a tail steal"
+    assert slow.tail_declines == 1
+    assert len(src.pending) == 2, "a declined chunk must stay pending"
+    # Dispatch-time claims are never declined (liveness: every requeue
+    # path re-feeds lanes through dispatch).
+    assert group.claim_next(slow, steal=False) is not None
+    # The fastest lane never declines its own tail.
+    assert group.claim_next(fast, steal=True) is not None
+    # Knob off: pure work stealing, no declines anywhere.
+    monkeypatch.setenv("STARWAY_STRIPE_WEIGHTED", "0")
+    src2 = _queue_source(group, nchunks=2)
+    assert group.claim_next(slow, steal=True) is not None
+    assert slow.tail_declines == 1
+
+
+def test_weighted_decline_scans_past_declined_tail(monkeypatch):
+    """A slow lane declining msg N's tail must still claim from msg N+1
+    queued behind it -- idling the lane entirely would halve striped
+    throughput exactly when the knob is meant to help."""
+    monkeypatch.setenv("STARWAY_STRIPE_WEIGHTED", "1")
+    group = _stub_group(2)
+    fast, slow = group.lanes
+    fast.ewma_bps = 100e6
+    slow.ewma_bps = 10e6
+    tail_src = _queue_source(group, nchunks=1)   # msg N: in its tail
+    bulk_src = _queue_source(group, nchunks=16)  # msg N+1: plenty of work
+    got = group.claim_next(slow, steal=True)
+    assert got is not None and got[0] is bulk_src, \
+        "slow lane must skip the declined tail and claim the next message"
+    assert slow.tail_declines >= 1
+    assert len(tail_src.pending) == 1  # the tail chunk stays for the
+    got2 = group.claim_next(fast, steal=True)  # fast lane
+    assert got2 is not None and got2[0] is tail_src
+
+
+def test_weighted_decline_needs_ewma_and_peers(monkeypatch):
+    """No decline without data (cold EWMA) and no decline when the slow
+    lane is the only live one -- the chunk would strand."""
+    monkeypatch.setenv("STARWAY_STRIPE_WEIGHTED", "1")
+    group = _stub_group(2)
+    fast, slow = group.lanes
+    _queue_source(group, nchunks=1)
+    # Cold EWMA (no chunks carried yet): claim.
+    assert group.claim_next(slow, steal=True) is not None
+    fast.ewma_bps = 100e6
+    slow.ewma_bps = 1e6
+    _queue_source(group, nchunks=1)
+    # Fast lane dead: the slow lane is the tail's only carrier.
+    fast.conn.alive = False
+    assert group.claim_next(slow, steal=True) is not None
+
+
+async def test_weighted_striped_transfer_all_pairings(pair, port):
+    """End-to-end with the knob armed: striped transfers stay byte-exact
+    across every engine pairing (the policy biases scheduling, never
+    correctness), and lane EWMAs converge on the Python side."""
+    s_eng, c_eng, mp = pair
+    mp.setenv("STARWAY_STRIPE_WEIGHTED", "1")
+    server = _mk_server(s_eng, mp, port)
+    client = _mk_client(c_eng, mp)
+    try:
+        await _connect(client, server, port)
+        n = 4 << 20
+        payload = _payload(n)
+        sink = np.zeros(n, dtype=np.uint8)
+        for i in range(3):
+            sink[:] = 0
+            rf = server.arecv(sink, 40 + i, MASK)
+            await asyncio.wait_for(client.asend(payload, 40 + i), 30)
+            await asyncio.wait_for(client.aflush(), 30)
+            await asyncio.wait_for(rf, 30)
+            assert np.array_equal(sink, payload), f"iter {i}"
+        if c_eng == "py":
+            conn = client._client.primary_conn
+            group = getattr(conn, "stripe", None)
+            assert group is not None
+            carried = [ln for ln in group.lanes if ln.chunks_tx > 0]
+            assert carried and all(ln.ewma_bps > 0 for ln in carried)
+    finally:
+        await _aclose_all(client, server)
+
+
 # ------------------------------------------------------------------ soak
 
 

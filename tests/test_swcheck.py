@@ -149,6 +149,43 @@ def test_import_jax_in_core(tmp_path):
     _assert_caught(root, "layering-jax", "import jax", "_seeded_jax.py")
 
 
+def test_reshard_imported_from_core(tmp_path):
+    """layering-reshard row 1 (ISSUE 12): reshard/ sits ABOVE core/ --
+    any core/ module importing the schedule layer, absolutely or
+    relatively, is a finding."""
+    root = _seed(tmp_path)
+    (root / "starway_tpu" / "core" / "_seeded_reshard.py").write_text(
+        "import starway_tpu.reshard\n"
+        "from starway_tpu.reshard import plan\n"
+        "from ..reshard import tags\n"
+        "from starway_tpu import reshard\n"
+        "from .. import reshard\n"
+    )
+    hits = _findings(root, "layering-reshard")
+    assert {f.line for f in hits} == {1, 2, 3, 4, 5}, hits
+    _assert_caught(root, "layering-reshard", "ABOVE core/",
+                   "_seeded_reshard.py")
+
+
+def test_jax_bound_outside_reshard_adapter(tmp_path):
+    """layering-reshard row 2: under reshard/ only api.py (the jax
+    adapter) may import jax -- the planner/executor stay jax-free."""
+    root = _seed(tmp_path)
+    pkg = root / "starway_tpu" / "reshard"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "plan.py").write_text(
+        "import jax\n"
+        "from jax.sharding import NamedSharding\n"
+    )
+    # The adapter itself is exempt: jax is its whole job.
+    (pkg / "api.py").write_text("import jax\n")
+    hits = _findings(root, "layering-reshard")
+    assert {(f.file.rsplit('/', 1)[-1], f.line) for f in hits} == \
+        {("plan.py", 1), ("plan.py", 2)}, hits
+    _assert_caught(root, "layering-reshard", "api.py", "plan.py")
+
+
 def test_reworded_reason_string(tmp_path):
     root = _seed(tmp_path)
     _edit(root, "starway_tpu/errors.py",

@@ -117,7 +117,9 @@ async def test_sampler_counter_delta_parity(port, monkeypatch, engine):
         assert detail["armed"] is True
         assert detail["samples"][-1]["mono"] == s2["mono"]
         assert set(detail["gauges"]) == {"conns", "posted_recvs",
-                                         "staging_pool_bytes"}
+                                         "staging_pool_bytes",
+                                         "reshard_staging_bytes",
+                                         "reshard_staging_peak"}
     finally:
         await client.aclose()
         await server.aclose()
