@@ -107,6 +107,38 @@ constexpr uint64_t FC_MSG_BIT = 1ull << 63;
 // the core/frames.py SDATA_SUB twin, machine-checked by swcheck.
 constexpr size_t SDATA_SUB_SIZE = 24;
 
+// §19/§21 decode-contract tables, shared between the live parser
+// (pump_frames) and the sw_wire_decode differential harness so the two
+// can never drift from each other.  The Python twins are
+// frames.CSUM_EXEMPT / frames.CSUM_BODY / frames.HEADER_ONLY /
+// frames.CTL_MAX; membership and value are diffed by the `wirefuzz`
+// analysis pass (DESIGN.md §21).
+constexpr uint8_t kCsumExempt[] = {T_HELLO, T_HELLO_ACK, T_SEQ};
+constexpr uint8_t kCsumBody[] = {T_DATA, T_DEVPULL, T_RTS};
+constexpr uint8_t kHeaderOnly[] = {T_FLUSH, T_FLUSH_ACK, T_PING, T_PONG,
+                                   T_SEQ,   T_ACK,       T_BYE,  T_SACK,
+                                   T_CREDIT, T_CTS,      T_SNACK};
+// Ctl (JSON-body) frames are tiny; their length field is otherwise a
+// remote allocation primitive, and b == 0 was a cross-engine divergence
+// (silent drop here, conn-death/stall in the Python engine).
+constexpr uint64_t CTL_MAX = 1ull << 20;
+
+inline bool csum_exempt(uint8_t t) {
+  for (uint8_t e : kCsumExempt)
+    if (t == e) return true;
+  return false;
+}
+inline bool csum_body(uint8_t t) {
+  for (uint8_t e : kCsumBody)
+    if (t == e) return true;
+  return false;
+}
+inline bool header_only_frame(uint8_t t) {
+  for (uint8_t e : kHeaderOnly)
+    if (t == e) return true;
+  return false;
+}
+
 constexpr int ST_VOID = 0, ST_INIT = 1, ST_RUNNING = 2, ST_CLOSING = 3, ST_CLOSED = 4;
 
 const char* kCancelled = "Operation cancelled (local endpoint closed before completion)";
@@ -2126,7 +2158,7 @@ struct Worker {
   // §18 rendezvous announcement arrived: register the offer with the
   // matcher (flush deferral and force-start ride the devpull pending
   // machinery); CTS goes out when a receive claims the record.
-  // swcheck: state(estab, RTS, estab)
+  // swcheck: state(estab, RTS, estab|down)
   void on_rts(Conn* c, uint64_t tag, const std::string& body,
               FireList& fires) {
     if (!c->fc_ok) return;  // never negotiated: drop
@@ -3968,10 +4000,22 @@ struct Worker {
         c->ctl_need = 0;
         c->ctl_type = 0;
         c->ctl_a = 0;
-        // swcheck: state(estab, HELLO, estab)
+        // Ctl bodies are JSON OBJECTS by contract: reject non-object
+        // shapes ([] / "x" / 42 / nesting bombs) exactly as the Python
+        // engine's unpack_json_body does (one rule, both engines --
+        // PR-14 wirefuzz hardening).  Braced-but-invalid JSON stays
+        // tolerated here: the per-field extractor shrugs where
+        // json.loads raises, the one documented residual asymmetry.
+        size_t b0 = body.find_first_not_of(" \t\r\n");
+        size_t b1 = body.find_last_not_of(" \t\r\n");
+        if (b0 == std::string::npos || body[b0] != '{' || body[b1] != '}') {
+          conn_broken(c, fires);
+          return;
+        }
+        // swcheck: state(estab, HELLO, estab|down)
         if (t == T_HELLO) on_hello(c, body, fires);
         else if (t == T_DEVPULL) {
-          // swcheck: state(estab, DEVPULL, estab)
+          // swcheck: state(estab, DEVPULL, estab|down)
           on_devpull(c, ctl_a, body, fires);
           rx_e2e(c, body.size());
           sess_commit(c);
@@ -4009,7 +4053,7 @@ struct Worker {
           c->csum_accum = 0;
           continue;
         }
-        if (type != T_HELLO && type != T_HELLO_ACK && type != T_SEQ) {
+        if (!csum_exempt(type)) {
           if (!c->csum_pend) {
             conn_corrupt(c, "frame without checksum", fires);
             return;
@@ -4019,8 +4063,7 @@ struct Worker {
             return;
           }
           bool body_follows =
-              type == T_SDATA ||
-              ((type == T_DATA || type == T_DEVPULL || type == T_RTS) && b > 0);
+              type == T_SDATA || (csum_body(type) && b > 0);
           if (!body_follows) {
             // Header-only frame: the header IS the frame.
             c->csum_pend = false;
@@ -4146,7 +4189,11 @@ struct Worker {
           break;
         // swcheck: state(estab, SDATA, estab|down)
         case T_SDATA:
-          if (b < SDATA_SUB_SIZE) {
+          // A body not longer than the sub-header is a protocol
+          // violation: no sender emits zero-length chunks, and a
+          // zero-length chunk read misparsed as transport EOF here
+          // while the Python sm path stalled forever (wirefuzz seed).
+          if (b <= SDATA_SUB_SIZE) {
             conn_broken(c, fires);  // sub-header promised, not present
             return;
           }
@@ -4210,13 +4257,23 @@ struct Worker {
           }
           break;  // proof of life recorded by stream_read
         case T_HELLO:
-        // swcheck: state(estab, HELLO_ACK, estab)
+        // swcheck: state(estab, HELLO_ACK, estab|down)
         case T_HELLO_ACK:
         case T_DEVPULL:
         case T_RTS:
+          // A ctl frame's JSON body is small and never empty: b == 0
+          // was silently dropped here (ctl_need = 0 never entered the
+          // body state) while the Python engine's 0-byte read broke or
+          // stalled the conn, and an unchecked length accumulates
+          // attacker-sized bodies -- both are protocol violations now,
+          // in BOTH engines (frames.CTL_MAX; wirefuzz corpus seeds).
+          if (b == 0 || b > CTL_MAX) {
+            conn_broken(c, fires);
+            return;
+          }
           if (type == T_DEVPULL && c->sess_drop) {
             c->sess_drop = false;
-            if (b) c->rx_skip = b;
+            c->rx_skip = b;
             break;
           }
           c->ctl_type = type;
@@ -5381,6 +5438,195 @@ struct ClientWorker : Worker {
   }
 };
 
+// ------------------------------------------- §21 decode harness (pure)
+//
+// The engine-side half of the swcompose differential wire fuzzer: the
+// structural decode rules of pump_frames (and SmRing::read_into's
+// slot-record walk), runnable over a flat buffer with no worker and no
+// I/O, rendered as the canonical outcome string core/frames.py
+// decode_stream emits byte-identically.  Shares kCsumExempt/kCsumBody/
+// kHeaderOnly/CTL_MAX/SM_REC_HDR and crc32c with the live parser, so
+// the harness cannot drift from the engine on the table-driven rules.
+
+struct DecodeOut {
+  std::vector<std::string> entries;
+  int extra = 0;
+  void emit(const char* e) {
+    if (entries.size() < 64)  // frames.DECODE_MAX_ENTRIES
+      entries.emplace_back(e);
+    else
+      extra++;
+  }
+  std::string finish(const char* status, uint64_t consumed) {
+    std::string s = status;
+    s += " n=" + std::to_string(consumed) + " [";
+    for (size_t i = 0; i < entries.size(); i++) {
+      if (i) s += " ";
+      s += entries[i];
+    }
+    if (extra) {
+      if (!entries.empty()) s += " ";
+      s += "+" + std::to_string(extra);
+    }
+    s += "]";
+    return s;
+  }
+};
+
+std::string wire_decode_stream(const uint8_t* buf, uint64_t n, bool csum) {
+  uint64_t pos = 0, consumed = 0;
+  bool pend = false;
+  uint32_t pf = 0, ph = 0, accum = 0;
+  DecodeOut o;
+  char tmp[192];
+  for (;;) {
+    if (n - pos < HEADER_SIZE)
+      return o.finish(pos == n ? "ok" : "short:header", consumed);
+    uint8_t type;
+    uint64_t a, b;
+    unpack_header(buf + pos, &type, &a, &b);
+    if (pend) accum = crc32c(buf + pos, HEADER_SIZE, accum);
+    pos += HEADER_SIZE;
+    if (csum) {
+      // §19 verification gate, BEFORE dispatch (pump_frames twin).
+      if (type == T_CSUM) {
+        if (pend) return o.finish("reject(nested checksum prefix)", consumed);
+        pend = true;
+        pf = (uint32_t)a;
+        ph = (uint32_t)b;
+        accum = 0;
+        snprintf(tmp, sizeof(tmp), "%u:%llu:%llu", type,
+                 (unsigned long long)a, (unsigned long long)b);
+        o.emit(tmp);
+        consumed = pos;
+        continue;
+      }
+      if (!csum_exempt(type)) {
+        if (!pend) return o.finish("reject(frame without checksum)", consumed);
+        if (type != T_SDATA && accum != ph)
+          return o.finish("reject(frame header checksum)", consumed);
+        bool body_follows = type == T_SDATA || (csum_body(type) && b > 0);
+        if (!body_follows) {
+          pend = false;
+          if (accum != pf) return o.finish("reject(frame checksum)", consumed);
+        }
+      }
+    }
+    if (type == T_SDATA) {
+      if (b <= SDATA_SUB_SIZE)
+        return o.finish("reject(sdata sub-header)", consumed);
+      if (n - pos < SDATA_SUB_SIZE) return o.finish("short:sub", consumed);
+      if (pend) {
+        accum = crc32c(buf + pos, SDATA_SUB_SIZE, accum);
+        if (accum != ph)
+          return o.finish("reject(stripe sub-header checksum)", consumed);
+      }
+      uint64_t mid, off, tot;
+      memcpy(&mid, buf + pos, 8);
+      memcpy(&off, buf + pos + 8, 8);
+      memcpy(&tot, buf + pos + 16, 8);
+      pos += SDATA_SUB_SIZE;
+      uint64_t clen = b - SDATA_SUB_SIZE;
+      if (clen > n - pos) return o.finish("short:body", consumed);
+      if (pend) {
+        accum = crc32c(buf + pos, (size_t)clen, accum);
+        pend = false;
+        if (accum != pf) {
+          // Chunk payload corrupt, routing verified: the recoverable
+          // T_SNACK retransmit -- an event, not a poison.
+          pos += clen;
+          snprintf(tmp, sizeof(tmp), "snack:%llu:%llu",
+                   (unsigned long long)mid, (unsigned long long)off);
+          o.emit(tmp);
+          consumed = pos;
+          continue;
+        }
+      }
+      pos += clen;
+      snprintf(tmp, sizeof(tmp), "%u:%llu:%llu:%llu:%llu:%llu", type,
+               (unsigned long long)a, (unsigned long long)b,
+               (unsigned long long)mid, (unsigned long long)off,
+               (unsigned long long)tot);
+      o.emit(tmp);
+      consumed = pos;
+      continue;
+    }
+    if (type == T_DATA) {
+      if (b) {
+        if (b > n - pos) return o.finish("short:body", consumed);
+        if (pend) {
+          accum = crc32c(buf + pos, (size_t)b, accum);
+          pend = false;
+          if (accum != pf)
+            return o.finish("reject(payload checksum (DATA))", consumed);
+        }
+        pos += b;
+      }
+      snprintf(tmp, sizeof(tmp), "%u:%llu:%llu", type,
+               (unsigned long long)a, (unsigned long long)b);
+      o.emit(tmp);
+      consumed = pos;
+      continue;
+    }
+    if (type == T_HELLO || type == T_HELLO_ACK || type == T_DEVPULL ||
+        type == T_RTS) {
+      if (b == 0) return o.finish("reject(zero control body)", consumed);
+      if (b > CTL_MAX) return o.finish("reject(oversized control body)", consumed);
+      if (b > n - pos) return o.finish("short:body", consumed);
+      if (pend) {
+        // The ctl-completion verify consumes the envelope even for the
+        // (nonsensical) exempt-frame-inside-envelope shape -- the live
+        // parser clears pend at any ctl body end.
+        accum = crc32c(buf + pos, (size_t)b, accum);
+        pend = false;
+        if (accum != pf)
+          return o.finish("reject(control body checksum)", consumed);
+      }
+      pos += b;
+      snprintf(tmp, sizeof(tmp), "%u:%llu:%llu", type,
+               (unsigned long long)a, (unsigned long long)b);
+      o.emit(tmp);
+      consumed = pos;
+      continue;
+    }
+    if (header_only_frame(type)) {
+      snprintf(tmp, sizeof(tmp), "%u:%llu:%llu", type,
+               (unsigned long long)a, (unsigned long long)b);
+      o.emit(tmp);
+      consumed = pos;
+      continue;
+    }
+    return o.finish("reject(unknown frame type)", consumed);
+  }
+}
+
+std::string wire_decode_recs(const uint8_t* buf, uint64_t n) {
+  uint64_t pos = 0, consumed = 0, seq = 0;
+  const uint64_t ring_size = 1ull << 20;  // shmring.DEFAULT_RING model size
+  DecodeOut o;
+  char tmp[32];
+  for (;;) {
+    if (n - pos == 0) return o.finish("ok", consumed);
+    if (n - pos < SM_REC_HDR) return o.finish("short:rec-header", consumed);
+    uint32_t ln, crc;
+    memcpy(&ln, buf + pos, 4);
+    memcpy(&crc, buf + pos + 4, 4);
+    if (ln == 0 || ln > ring_size)
+      return o.finish("reject(sm record header)", consumed);
+    if ((uint64_t)ln > n - pos - SM_REC_HDR)
+      return o.finish("short:rec-body", consumed);
+    uint8_t seq8[8];
+    memcpy(seq8, &seq, 8);
+    uint32_t accum = crc32c(buf + pos + SM_REC_HDR, ln, crc32c(seq8, 8, 0));
+    if (accum != crc) return o.finish("reject(sm record checksum)", consumed);
+    seq++;
+    pos += SM_REC_HDR + ln;
+    consumed = pos;
+    snprintf(tmp, sizeof(tmp), "r:%u", ln);
+    o.emit(tmp);
+  }
+}
+
 int worker_start(Worker* w) {
   w->epfd = epoll_create1(EPOLL_CLOEXEC);
   w->evfd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
@@ -5411,8 +5657,12 @@ extern "C" {
 //    deadline-aware shedding)
 // 9: end-to-end integrity plane (T_CSUM per-frame CRC32C, T_SNACK
 //    chunk-level retransmit, checksummed sm slot records, "csum"
-//    handshake, "corrupt" poison reason -- DESIGN.md §19)
-const char* sw_version() { return "starway-native-9"; }
+//    handshake, "corrupt" poison reason -- DESIGN.md §19);
+// 10: swcompose decode-contract hardening (zero/oversized ctl bodies and
+//    zero-length striped chunks are protocol violations, T_CSUM prefix
+//    truncates to the 32-bit CRC) + the sw_wire_decode differential
+//    harness -- DESIGN.md §21
+const char* sw_version() { return "starway-native-10"; }
 
 // Portable cursor atomics for the Python engine's sm ring (sw_engine.h).
 // std::atomic_ref would be C++20-tidy but libstdc++'s needs alignment UB
@@ -5431,6 +5681,22 @@ void sw_atomic_store_u64(void* p, uint64_t v) {
 // mixed pairs agree bit-for-bit.
 uint32_t sw_crc32c(const void* p, uint64_t n, uint32_t seed) {
   return crc32c(static_cast<const uint8_t*>(p), (size_t)n, seed);
+}
+
+// §21 swcompose differential decode harness (sw_engine.h): the engine's
+// structural frame decoder over a flat buffer, canonical outcome string
+// out -- the C++ half the wirefuzz analysis pass diffs against
+// core/frames.py decode_stream and its grammar-derived oracle.
+int sw_wire_decode(const void* p, uint64_t n, int mode, char* out, int cap) {
+  if (!p && n) return -1;
+  if (!out || cap <= 0) return -1;
+  const uint8_t* buf = static_cast<const uint8_t*>(p);
+  std::string res = mode == 2 ? wire_decode_recs(buf, n)
+                              : wire_decode_stream(buf, n, mode == 1);
+  size_t len = res.size() < (size_t)(cap - 1) ? res.size() : (size_t)(cap - 1);
+  memcpy(out, res.data(), len);
+  out[len] = 0;
+  return (int)res.size();
 }
 
 // ----- client

@@ -78,11 +78,14 @@ typedef void (*sw_event_cb)(void* ctx, const char* event, uint64_t conn_id);
  * §17) + the end-to-end integrity plane (T_CSUM per-frame CRC32C
  * prefixes, T_SNACK chunk-level retransmit, checksummed sm slot records,
  * the "csum" handshake key and the stable "corrupt" poison reason --
- * DESIGN.md §19).  The annotation below is machine-checked against the
- * sw_engine.cpp implementation by the contract checker (python -m
- * starway_tpu.analysis, rule contract-version) -- bump BOTH when the
- * protocol changes.
- * swcheck: engine-version "starway-native-9" */
+ * DESIGN.md §19) + the swcompose decode-contract hardening (zero and
+ * oversized ctl bodies and zero-length striped chunks are protocol
+ * violations in both engines; T_CSUM prefixes truncate to the 32-bit
+ * CRC -- DESIGN.md §21).  The annotation below is machine-checked
+ * against the sw_engine.cpp implementation by the contract checker
+ * (python -m starway_tpu.analysis, rule contract-version) -- bump BOTH
+ * when the protocol changes.
+ * swcheck: engine-version "starway-native-10" */
 const char* sw_version(void);
 
 /* Allocate a client/server worker in the VOID state.  `worker_id` is the
@@ -312,6 +315,17 @@ void sw_atomic_store_u64(void* p, uint64_t v);
  * engine calls this same export (core/frames.py crc32c), so both engines
  * -- and both ends of a mixed pair -- agree bit-for-bit. */
 uint32_t sw_crc32c(const void* p, uint64_t n, uint32_t seed);
+
+/* swcompose differential decode harness (DESIGN.md §21): run the
+ * engine's structural frame decoder over a flat buffer and render the
+ * canonical outcome string (status, consumed bytes, frame entries --
+ * the byte-identical format of core/frames.py decode_stream /
+ * core/shmring.py decode_sm_records).  `mode`: 0 = plain stream,
+ * 1 = §19 integrity stream, 2 = sm slot records.  Pure function -- no
+ * worker, no I/O, callable from any thread.  Returns the full outcome
+ * length (output truncated to cap-1 + NUL when longer), or -1 on a bad
+ * argument.  Consumed by `python -m starway_tpu.analysis` (wirefuzz). */
+int sw_wire_decode(const void* p, uint64_t n, int mode, char* out, int cap);
 
 #ifdef __cplusplus
 } /* extern "C" */

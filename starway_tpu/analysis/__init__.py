@@ -1,6 +1,6 @@
-"""swcheck + swproof: static cross-engine contract and behavior checking.
+"""swcheck + swproof + swcompose: static cross-engine contract checking.
 
-``python -m starway_tpu.analysis`` runs seven passes and exits non-zero
+``python -m starway_tpu.analysis`` runs ten passes and exits non-zero
 on any finding (the CI merge gate; also step 1 of
 scripts/release_smoke.sh):
 
@@ -24,6 +24,20 @@ scripts/release_smoke.sh):
   layer: every fault schedule (kill/dup/reorder/restart) over a bounded
   workload, against the exactly-once / journal-trim / flush-order /
   epoch / quiescence invariants.
+* **compose** -- the swcompose product model (DESIGN.md §21): sessions
+  x striped chunks x credit window x integrity retransmit explored
+  under conn kills, rail deaths, corruption, and duplication, against
+  the stripe-exactly-once / pin-release / credit-conservation /
+  no-wrong-answer / quiescence invariants.
+* **wirefuzz** -- a contract-derived differential fuzzer for the frame
+  and sm-slot-record decoders: identical adversarial bytes through a
+  grammar oracle, ``frames.decode_stream`` / ``decode_sm_records``, and
+  the native ``sw_wire_decode`` export; a checked-in regression corpus
+  replays every run (DESIGN.md §21).
+* **taint** -- the §19 unverified-byte lint: every rx delivery sink in
+  BOTH engines is dominated by a CRC verify whose mismatch arm aborts,
+  every payload read accumulates, and sm slot corruption poisons
+  before parse (DESIGN.md §21).
 
 Waivers: a finding is suppressed by an explicit justified comment on (or
 directly above) the flagged line::
@@ -40,7 +54,8 @@ import time
 from pathlib import Path
 from typing import Iterable, Optional
 
-from . import concurrency, contract, explore, hotpath, layering, markers, protomodel
+from . import (compose, concurrency, contract, explore, hotpath, layering,
+               markers, protomodel, taint, wirefuzz)
 from .base import (  # noqa: F401  (re-exported for tests and tooling)
     RULES,
     Finding,
@@ -62,6 +77,9 @@ PASSES = {
     "hotpath": hotpath.run,
     "protomodel": protomodel.run,
     "explore": explore.run,
+    "compose": compose.run,
+    "wirefuzz": wirefuzz.run,
+    "taint": taint.run,
 }
 
 

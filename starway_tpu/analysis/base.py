@@ -37,6 +37,12 @@ RULES = {
     "lint-coverage": "runtime module outside the swcheck lint surface",
     "proto-state": "protocol state machines of the two engines disagree",
     "proto-explore": "session-model invariant violated under a fault schedule",
+    "proto-compose": "composed-plane invariant (sessions x striping x fc x "
+                     "integrity) violated under a fault schedule",
+    "wire-diff": "frame/record decoders diverge between the engines (or "
+                 "from the contract-derived oracle) on identical bytes",
+    "taint-integrity": "payload bytes can reach a user buffer or callback "
+                       "before the §19 CRC verify dominates them",
     "layering-jax": "jax imported under core/ (device.py owns that boundary)",
     "layering-reshard": "reshard/-above-core/ boundary crossed (core/ "
                         "imports reshard, or jax bound outside reshard/api.py)",

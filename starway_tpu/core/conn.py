@@ -67,12 +67,14 @@ GATHER_IOV = 64
 # session prefix glues OUTSIDE the checksum envelope (wire order
 # [SEQ][CSUM][frame]; a corrupted SEQ surfaces as a seq gap, which is
 # already a recoverable fault).  Everything else on a csum conn must be
-# announced by a T_CSUM or the stream is poisoned.
-_CSUM_EXEMPT = frozenset((frames.T_HELLO, frames.T_HELLO_ACK, frames.T_SEQ))
+# announced by a T_CSUM or the stream is poisoned.  The sets live in
+# frames.py (one decode contract: this parser, frames.decode_stream, and
+# the native kCsumExempt[]/kCsumBody[] -- diffed by the wirefuzz pass).
+_CSUM_EXEMPT = frames.CSUM_EXEMPT
 # Frame types whose bytes continue past the header on the wire (given
 # header ``b`` > 0): the full-frame CRC verifies at their last byte;
 # every other type is header-only and verifies at dispatch.
-_CSUM_BODY = frozenset((frames.T_DATA, frames.T_DEVPULL, frames.T_RTS))
+_CSUM_BODY = frames.CSUM_BODY
 
 # Doorbell byte values on an sm-upgraded conn's socket (the contract shared
 # with the native engine -- native/sw_engine.cpp).  Any byte wakes the peer
@@ -1805,7 +1807,21 @@ class TcpConn(BaseConn):
                         self._corrupt(fires, "control body checksum")
                         return
                 # json.loads reads the bytearray directly: no full-body copy.
-                info = frames.unpack_json_body(body)
+                # A body that is not a valid JSON OBJECT (bad syntax, a
+                # nesting bomb, or the wrong shape -- unpack_json_body
+                # raises ValueError for all three) is a protocol
+                # violation on THIS conn, not an engine-thread
+                # exception: an unhandled raise here escaped the event
+                # loop and emergency-closed the whole worker (every conn
+                # with it).  The native ctl dispatch breaks the conn on
+                # the same non-object shapes; braced-but-invalid JSON is
+                # the one residual asymmetry (its tolerant field
+                # extractor cannot see syntax).
+                try:
+                    info = frames.unpack_json_body(body)
+                except ValueError:
+                    self.worker._conn_broken(self, fires)
+                    return
                 if ftype == frames.T_HELLO:
                     self.worker._on_hello(self, info, fires)
                 elif ftype == frames.T_DEVPULL:
@@ -1848,7 +1864,11 @@ class TcpConn(BaseConn):
                     if pend is not None:
                         self._corrupt(fires, "nested checksum prefix")
                         return
-                    self._csum_pend = (a, b)
+                    # Only the low 32 bits are CRC (the native engine
+                    # truncates to uint32_t; keeping the full u64 here
+                    # made the engines disagree on adversarial prefixes
+                    # -- wirefuzz corpus seed).
+                    self._csum_pend = (a & 0xFFFFFFFF, b & 0xFFFFFFFF)
                     self._csum_accum = 0
                     continue
                 if ftype not in _CSUM_EXEMPT:
@@ -1962,8 +1982,11 @@ class TcpConn(BaseConn):
                         self.sess.sid, None)
             elif ftype == frames.T_SDATA:
                 # Striped chunk (DESIGN.md §17): the 24-byte sub-header
-                # follows; a body shorter than it is a protocol violation.
-                if b < frames.SDATA_SUB_SIZE:
+                # follows; a body not longer than it is a protocol
+                # violation (no sender emits zero-length chunks, and a
+                # zero-length read here stalled the sm transport forever
+                # while TCP misread it as EOF -- wirefuzz corpus seed).
+                if b <= frames.SDATA_SUB_SIZE:
                     self.worker._conn_broken(self, fires)
                     return
                 self._sdata = (a, bytearray(frames.SDATA_SUB_SIZE), 0, b)
@@ -1990,10 +2013,18 @@ class TcpConn(BaseConn):
                 self._on_pong(a, b)  # proof of life recorded by _rx_read
             elif ftype in (frames.T_HELLO, frames.T_HELLO_ACK,
                            frames.T_DEVPULL, frames.T_RTS):
+                # A ctl frame's JSON body is small and never empty; a
+                # zero length used to issue a 0-byte read (EOF-alike on
+                # TCP, a permanent stall on sm rings, a silent drop in
+                # the C++ engine) and an unchecked length is a remote
+                # allocation primitive -- both are protocol violations
+                # now, in BOTH engines (frames.CTL_MAX; wirefuzz seeds).
+                if b == 0 or b > frames.CTL_MAX:
+                    self.worker._conn_broken(self, fires)
+                    return
                 if ftype == frames.T_DEVPULL and self._sess_drop:
                     self._sess_drop = False
-                    if b:
-                        self._rx_skip = b
+                    self._rx_skip = b
                     continue
                 self._ctl = (ftype, bytearray(b), 0, a)
             else:

@@ -338,9 +338,13 @@ class Worker:
             # We never advertised the capability; a peer sending DEVPULL
             # anyway gets the message dropped (descriptor unpullable here).
             return
+        # Peer-supplied size: the _sess_int discipline (missing/garbled
+        # parses as 0, like the C++ engine's json_num_field) -- a
+        # malformed descriptor must not raise on the engine thread.
+        nbytes = self._sess_int(desc.get("n", 0))
         remote = _device.RemoteMsg(desc, conn, mgr)
         with self.lock:
-            msg, f = self.matcher.on_remote_message(tag, int(desc["n"]), remote)
+            msg, f = self.matcher.on_remote_message(tag, nbytes, remote)
         fires.extend(f)
         conn.remote_received(msg)
         if msg.discard:

@@ -235,6 +235,38 @@ T_SNACK = 18
 # sw_engine.cpp).
 FC_MSG_BIT = 1 << 63
 
+# §19 per-frame checksum scope (DESIGN.md §19, §21): the frame types
+# exempt from the negotiated T_CSUM prefix (the handshake pair predates
+# negotiation; the T_SEQ session prefix glues OUTSIDE the envelope --
+# wire order [SEQ][CSUM][frame]), and the types whose bytes continue past
+# the 17-byte header (their full-frame CRC verifies at the last payload
+# byte; every other protected type is header-only and verifies at
+# dispatch).  Shared by the live parser (core/conn.py), the reference
+# decoder below, and -- as kCsumExempt[]/kCsumBody[] -- the C++ engine's
+# parser and decode harness; membership is cross-engine contract surface
+# diffed by the `wirefuzz` analysis pass.
+CSUM_EXEMPT = frozenset((T_HELLO, T_HELLO_ACK, T_SEQ))
+CSUM_BODY = frozenset((T_DATA, T_DEVPULL, T_RTS))
+
+# Upper bound on a control-frame JSON body (DESIGN.md §21).  The
+# HELLO/HELLO_ACK/DEVPULL/RTS descriptors are tiny, but the engines
+# allocate/accumulate `b` bytes for them, so an unchecked length field is
+# a remote allocation primitive -- and a zero length is degenerate (the
+# Python parser used to issue a 0-byte read that a TCP socket reports as
+# EOF and an sm ring reports as idle: conn death on one transport, a
+# silent permanent stall on the other, while the C++ engine silently
+# dropped the frame).  A ctl frame announcing b == 0 or b > CTL_MAX is a
+# protocol violation and breaks the conn in BOTH engines (CTL_MAX
+# constexpr in sw_engine.cpp; wirefuzz corpus seeds pin both edges).
+CTL_MAX = 1 << 20
+
+#: Frame types that are exactly one 17-byte header on the wire.  T_CSUM
+#: is deliberately absent: on a conn that never negotiated "csum" it is
+#: an unknown frame (conn death), and on an integrity conn it is the
+#: envelope the verification gate consumes before dispatch.
+HEADER_ONLY = frozenset((T_FLUSH, T_FLUSH_ACK, T_PING, T_PONG, T_SEQ,
+                         T_ACK, T_BYE, T_SACK, T_CREDIT, T_CTS, T_SNACK))
+
 # Striped-DATA sub-header (DESIGN.md §17): u64 msg_id, u64 offset,
 # u64 total -- little-endian, leading every SDATA body.  The 24-byte size
 # is cross-engine contract surface (SDATA_SUB_SIZE in sw_engine.cpp,
@@ -355,7 +387,20 @@ def unpack_json_body(body) -> dict:
     ``memoryview`` is materialised here because json cannot read one."""
     if isinstance(body, memoryview):
         body = body.tobytes()
-    return json.loads(body)
+    try:
+        info = json.loads(body)
+    except RecursionError:
+        # A nesting bomb (b"["*50000 fits well under CTL_MAX) must be a
+        # protocol violation like any other malformed body, never an
+        # engine-thread escape that emergency-closes the whole worker.
+        raise ValueError("ctl body nesting too deep") from None
+    if not isinstance(info, dict):
+        # Every ctl body in the protocol is a JSON OBJECT; valid JSON of
+        # the wrong shape ([] / "x" / 42) would otherwise raise from the
+        # handlers' .get() field access off the event loop.  The native
+        # engine enforces the same object shape at its ctl dispatch.
+        raise ValueError("ctl body is not a JSON object")
+    return info
 
 
 def pack_data_header(tag: int, length: int) -> bytes:
@@ -455,3 +500,157 @@ def pack_csum_for(frame_bytes, payload=None) -> bytes:
 def pack_devpull(tag: int, desc: dict) -> bytes:
     body = json.dumps(desc, separators=(",", ":")).encode()
     return pack_header(T_DEVPULL, tag, len(body)) + body
+
+
+# ------------------------------------------------- reference decoder
+#
+# The normative structural decoder for the framed stream: the exact
+# accept/reject/short outcome of core/conn.py's `_pump_frames` parser
+# (and the C++ engine's `pump_frames`), as one pure function over a flat
+# byte buffer.  `python -m starway_tpu.analysis` (the `wirefuzz` pass,
+# DESIGN.md §21) feeds identical adversarial buffers to this function, to
+# its own grammar-derived oracle, and to the native engine's
+# `sw_wire_decode` export, and diffs the canonical outcome strings --
+# any divergence is a cross-engine contract finding.  Keep this function
+# in lockstep with `_pump_frames`: it IS the written-down decode
+# contract ("two engines, one wire format", CLAUDE.md).
+
+#: Cap on rendered frame entries (both engines truncate identically so
+#: the canonical strings stay diffable on long streams).
+DECODE_MAX_ENTRIES = 64
+
+
+def fmt_decode(status: str, consumed: int, entries: list) -> str:
+    """Canonical decode-outcome string: shared, byte-identical format
+    between this module, analysis/wirefuzz.py's oracle, shmring's record
+    decoder, and the native sw_wire_decode export."""
+    shown = entries[:DECODE_MAX_ENTRIES]
+    extra = len(entries) - len(shown)
+    if extra > 0:
+        shown.append(f"+{extra}")
+    return f"{status} n={consumed} [" + " ".join(shown) + "]"
+
+
+def decode_stream(data, csum: bool = False) -> str:
+    """Decode one framed byte stream and return the canonical outcome.
+
+    ``csum=True`` decodes under the negotiated §19 integrity plane (the
+    T_CSUM envelope rules).  The outcome triple is (status, consumed,
+    frames): status is ``ok`` (buffer ends on a frame boundary),
+    ``short:<state>`` (mid-frame: more bytes would continue the stream),
+    or ``reject(<reason>)`` (the engines poison/break the conn here --
+    ``<reason>`` uses the engines' stable corruption phrases).
+    ``n=<consumed>`` counts bytes of fully-processed frames; entries are
+    ``type:a:b`` (T_SDATA adds ``:msg_id:off:total``; a corrupt striped
+    chunk with verified routing renders the recoverable
+    ``snack:msg_id:off`` event instead -- the §19 retransmit path, not a
+    poison).  Never allocates from wire-controlled lengths."""
+    buf = bytes(data)  # swcheck: allow(hotpath-copy): bounded fuzz/gate input, never a data path
+    n = len(buf)
+    pos = 0
+    consumed = 0
+    entries: list = []
+    pend = None  # armed T_CSUM envelope: (crc_frame, crc_head)
+    accum = 0
+
+    def done(status: str) -> str:
+        return fmt_decode(status, consumed, entries)
+
+    while True:
+        if pos + HEADER_SIZE > n:
+            return done("ok" if pos == n else "short:header")
+        hdr = buf[pos:pos + HEADER_SIZE]
+        ftype, a, b = HEADER.unpack(hdr)
+        if pend is not None:
+            # The protected frame's header is covered too: a corrupted
+            # length field must never desync the stream (§19).
+            accum = crc32c(hdr, accum)
+        pos += HEADER_SIZE
+        if csum:
+            # §19 verification gate, BEFORE dispatch (conn.py twin).
+            if ftype == T_CSUM:
+                if pend is not None:
+                    return done("reject(nested checksum prefix)")
+                # Engines keep only the low 32 bits (the CRC width).
+                pend = (a & 0xFFFFFFFF, b & 0xFFFFFFFF)
+                accum = 0
+                entries.append(f"{ftype}:{a}:{b}")
+                consumed = pos
+                continue
+            if ftype not in CSUM_EXEMPT:
+                if pend is None:
+                    return done("reject(frame without checksum)")
+                if ftype != T_SDATA and accum != pend[1]:
+                    return done("reject(frame header checksum)")
+                body_follows = (ftype == T_SDATA
+                                or (ftype in CSUM_BODY and b > 0))
+                if not body_follows:
+                    cf, pend = pend[0], None
+                    if accum != cf:
+                        return done("reject(frame checksum)")
+        if ftype == T_SDATA:
+            if b <= SDATA_SUB_SIZE:
+                return done("reject(sdata sub-header)")
+            if pos + SDATA_SUB_SIZE > n:
+                return done("short:sub")
+            sub = buf[pos:pos + SDATA_SUB_SIZE]
+            if pend is not None:
+                accum = crc32c(sub, accum)
+                if accum != pend[1]:
+                    return done("reject(stripe sub-header checksum)")
+            msg_id, off, total = SDATA_SUB.unpack(sub)
+            pos += SDATA_SUB_SIZE
+            clen = b - SDATA_SUB_SIZE
+            if pos + clen > n:
+                return done("short:body")
+            if pend is not None:
+                accum = crc32c(buf[pos:pos + clen], accum)
+                cf, pend = pend[0], None
+                if accum != cf:
+                    # Chunk payload corrupt, routing verified: the
+                    # recoverable T_SNACK retransmit, conn stays healthy.
+                    pos += clen
+                    entries.append(f"snack:{msg_id}:{off}")
+                    consumed = pos
+                    continue
+            pos += clen
+            entries.append(f"{ftype}:{a}:{b}:{msg_id}:{off}:{total}")
+            consumed = pos
+            continue
+        if ftype == T_DATA:
+            if b:
+                if pos + b > n:
+                    return done("short:body")
+                if pend is not None:
+                    accum = crc32c(buf[pos:pos + b], accum)
+                    cf, pend = pend[0], None
+                    if accum != cf:
+                        return done("reject(payload checksum (DATA))")
+                pos += b
+            entries.append(f"{ftype}:{a}:{b}")
+            consumed = pos
+            continue
+        if ftype in (T_HELLO, T_HELLO_ACK, T_DEVPULL, T_RTS):
+            if b == 0:
+                return done("reject(zero control body)")
+            if b > CTL_MAX:
+                return done("reject(oversized control body)")
+            if pos + b > n:
+                return done("short:body")
+            if pend is not None:
+                # The ctl-completion verify consumes the envelope even
+                # for the (nonsensical) exempt-frame-inside-envelope
+                # shape -- both engines clear pend at any ctl body end.
+                accum = crc32c(buf[pos:pos + b], accum)
+                cf, pend = pend[0], None
+                if accum != cf:
+                    return done("reject(control body checksum)")
+            pos += b
+            entries.append(f"{ftype}:{a}:{b}")
+            consumed = pos
+            continue
+        if ftype in HEADER_ONLY:
+            entries.append(f"{ftype}:{a}:{b}")
+            consumed = pos
+            continue
+        return done("reject(unknown frame type)")

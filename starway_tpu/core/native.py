@@ -148,6 +148,14 @@ def load() -> Optional[ctypes.CDLL]:
             lib.sw_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                       ctypes.c_uint32]
             lib.sw_crc32c.restype = ctypes.c_uint32
+        # Optional: the §21 swcompose differential decode harness -- a
+        # pure structural decoder the wirefuzz analysis pass diffs
+        # against frames.decode_stream byte-for-byte.
+        if hasattr(lib, "sw_wire_decode"):
+            lib.sw_wire_decode.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_int
+            ]
         _lib = lib
     except Exception as e:  # toolchain/build failure => Python engine
         _lib_err = str(e)

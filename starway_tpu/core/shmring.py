@@ -332,6 +332,45 @@ class Ring:
         self._u64.release()
 
 
+def decode_sm_records(data, ring_size: int = DEFAULT_RING) -> str:
+    """Reference decoder for the §19 slot-record framing: the exact
+    accept/reject/short outcome of :meth:`Ring.read_into`'s slotted walk
+    (and the C++ engine's ``SmRing::read_into``) over a flat byte region,
+    as one canonical string (frames.fmt_decode).  The slot seqno is the
+    implicit free-running counter starting at 0, so a record lifted from
+    a stale/replayed region of ring memory fails its checksum here
+    exactly as it does at live dequeue.  Fed identical adversarial
+    buffers by the `wirefuzz` analysis pass (mode ``smrec``) on both
+    engines -- divergence is a contract finding (DESIGN.md §21)."""
+    buf = bytes(data)  # swcheck: allow(hotpath-copy): bounded fuzz/gate input, never a data path
+    n = len(buf)
+    pos = 0
+    consumed = 0
+    seq = 0
+    entries: list = []
+    while True:
+        if n - pos == 0:
+            return frames.fmt_decode("ok", consumed, entries)
+        if n - pos < REC_HDR:
+            return frames.fmt_decode("short:rec-header", consumed, entries)
+        ln, crc = _REC.unpack(buf[pos:pos + REC_HDR])
+        if ln == 0 or ln > ring_size:
+            # Garbled record header: SmCorrupt / -1 at live dequeue.
+            return frames.fmt_decode("reject(sm record header)",
+                                     consumed, entries)
+        if pos + REC_HDR + ln > n:
+            return frames.fmt_decode("short:rec-body", consumed, entries)
+        accum = frames.crc32c(buf[pos + REC_HDR:pos + REC_HDR + ln],
+                              frames.crc32c(_SEQ8.pack(seq)))
+        if accum != crc:
+            return frames.fmt_decode("reject(sm record checksum)",
+                                     consumed, entries)
+        seq += 1
+        pos += REC_HDR + ln
+        consumed = pos
+        entries.append(f"r:{ln}")
+
+
 class ShmSegment:
     """A mapped segment holding both rings of one connection.
 

@@ -626,3 +626,49 @@ def test_corrupt_mode_glues_csum_prefix(port):
     finally:
         proxy.stop()
         listener.close()
+
+
+async def test_wrong_shape_ctl_body_does_not_kill_worker(engine, port):
+    """A ctl frame whose body is not a JSON OBJECT -- valid JSON of the
+    wrong shape (``[]``), a ``[``*50k nesting bomb (RecursionError out
+    of json.loads, NOT a ValueError), or not JSON at all -- is a
+    protocol violation on THAT conn only (PR-14 wirefuzz hardening):
+    the Python engine used to let the parse/field access raise off the
+    event loop and emergency-close the whole worker (every conn with
+    it).  Both engines now break the conn on non-object shapes -- the
+    C++ brace check also rejects b"{]" (last non-ws byte is not "}") --
+    while braced-but-invalid JSON like b"{,}" is the documented residual
+    asymmetry (C++'s per-field extractor shrugs where json.loads
+    raises), so that case asserts py-only.  Either way the worker must
+    keep serving."""
+    import socket as _socket
+
+    from starway_tpu.core import frames as _frames
+
+    server = Server()
+    server.listen(ADDR, port)
+    raws = []
+    client = Client()
+    try:
+        bodies = [b"[]", b'"x"', b"[" * 50000, b"{]"]
+        if engine == "py":
+            bodies.append(b"{,}")  # braced but invalid: py-only reject
+        for body in bodies:
+            raw = _socket.create_connection((ADDR, port), timeout=10)
+            raw.settimeout(10)
+            raw.sendall(_frames.pack_header(_frames.T_HELLO, 0, len(body))
+                        + body)
+            raws.append(raw)
+        # The offending conns are torn down (EOF), never answered.
+        for raw in raws:
+            assert raw.recv(1) == b"", "bad-ctl conn not closed"
+        # The worker survived: a well-formed client still round-trips.
+        await asyncio.wait_for(client.aconnect(ADDR, port), 15)
+        await _roundtrip(client, server, tag=0x77)
+    finally:
+        for raw in raws:
+            try:
+                raw.close()
+            except OSError:
+                pass
+        await _aclose_all(client, server)

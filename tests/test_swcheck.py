@@ -226,8 +226,8 @@ def test_garbled_doc_table(tmp_path):
 def test_version_drift(tmp_path):
     root = _seed(tmp_path)
     _edit(root, "native/sw_engine.cpp",
-          'return "starway-native-9"', 'return "starway-native-10"')
-    _assert_caught(root, "contract-version", "starway-native-10", "sw_engine.h")
+          'return "starway-native-10"', 'return "starway-native-11"')
+    _assert_caught(root, "contract-version", "starway-native-11", "sw_engine.h")
 
 
 def test_unmarked_multi_gib_test(tmp_path):
@@ -1284,3 +1284,313 @@ def test_sw_crc32c_abi_dropped(tmp_path):
     _edit(root, "native/sw_engine.h",
           "uint32_t sw_crc32c(const void* p, uint64_t n, uint32_t seed);", "")
     _assert_caught(root, "contract-abi", "sw_crc32c", "native.py")
+
+
+# ------------ ISSUE 14: swcompose -- compose (proto-compose) product model
+
+
+def test_swcompose_rules_registered():
+    # Satellite: the three new finding codes are waiver targets
+    # (--rules) and render as problem-matcher rows like every pass.
+    for rule in ("proto-compose", "wire-diff", "taint-integrity"):
+        assert rule in analysis.RULES, rule
+
+
+def test_compose_head_clean_and_schedule_floor():
+    # The faithful composed model (sessions x striping x fc x integrity)
+    # must exhaust clean, over a product space comfortably past the
+    # single-plane explore floor.
+    from starway_tpu.analysis import compose
+
+    result = compose.check(None)
+    assert result["violations"] == [], result["violations"]
+    assert result["schedules"] >= 2000, result["schedules"]
+    assert result["states"] > 1000, result["states"]
+
+
+def test_compose_every_invariant_fires_under_its_mutation():
+    # Repo convention: every invariant is backed by a seeded model
+    # mutation that makes the checker fail -- otherwise it could never
+    # see the failure class it claims to rule out.
+    from starway_tpu.analysis import compose
+
+    assert set(compose.MUTATIONS.values()) == set(compose.INVARIANTS)
+    for mutation, invariant in compose.MUTATIONS.items():
+        result = compose.check(mutation)
+        fired = {v[0] for v in result["violations"]}
+        assert invariant in fired, (mutation, invariant, fired)
+
+
+def test_compose_unknown_mutation_rejected():
+    from starway_tpu.analysis import compose
+
+    with pytest.raises(ValueError):
+        compose.check("no-such-mutation")
+
+
+def test_compose_refuses_vacuity_when_machine_drifts(tmp_path):
+    # If extraction loses the striping dispatch arm the product model
+    # abstracts, compose must flag the desync instead of verifying
+    # planes the code no longer implements.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py",
+          "elif ftype == frames.T_SDATA:", "elif ftype == frames.T_SDATAX:")
+    _assert_caught(root, "proto-compose", "no longer extracted", "lane.py")
+
+
+def test_compose_waiver(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py",
+          "elif ftype == frames.T_SDATA:", "elif ftype == frames.T_SDATAX:")
+    p = root / "starway_tpu" / "core" / "lane.py"
+    p.write_text(f"{_SWA}(proto-compose): exercising the waiver path\n"
+                 + p.read_text())
+    assert _findings(root, "proto-compose") == []
+
+
+# ------------- ISSUE 14: swcompose -- wirefuzz (wire-diff) differential
+
+
+def test_wirefuzz_head_replays_corpus_clean_with_native():
+    # The acceptance bar: the checked-in corpus (>= the 100-case floor)
+    # plus the quick-mode generator replays with zero divergence across
+    # the oracle, frames.decode_stream/decode_sm_records, AND the native
+    # sw_wire_decode export (the built artifact must be present here).
+    from starway_tpu.analysis import wirefuzz
+
+    out: list = []
+    got = wirefuzz._extract_tables(REPO, out)
+    assert got is not None and out == [], [f.render() for f in out]
+    counts = wirefuzz.fuzz(REPO, got[0], out,
+                           seeds_per_mode=wirefuzz.QUICK_SEEDS)
+    assert out == [], [f.render() for f in out]
+    assert counts["native"], "native sw_wire_decode export not loaded"
+    assert counts["divergences"] == 0
+    assert counts["cases"] >= (wirefuzz.CORPUS_FLOOR
+                               + 3 * wirefuzz.QUICK_SEEDS), counts
+
+
+def test_wirefuzz_fixed_divergence_seed_pinned():
+    # The zero-length ctl body was a REAL cross-engine divergence (C++
+    # silently dropped the frame; the Python parser issued a 0-byte read
+    # -- conn death on TCP, a permanent stall on sm rings).  Both
+    # engines now reject it identically; the corpus pins the bytes.
+    from starway_tpu.analysis import wirefuzz
+    from starway_tpu.core import frames
+
+    zero_ctl = bytes.fromhex("0100000000000000000000000000000000")
+    want = "reject(zero control body) n=0 []"
+    assert frames.decode_stream(zero_ctl) == want
+    lib = wirefuzz._load_native(REPO)
+    assert lib is not None, "native decode harness missing"
+    assert wirefuzz._native_decode(lib, zero_ctl, "stream") == want
+    corpus = (REPO / "starway_tpu" / "analysis"
+              / "wirefuzz_corpus.txt").read_text()
+    assert zero_ctl.hex() in corpus, "divergent seed not pinned in corpus"
+
+
+def test_wirefuzz_python_decoder_divergence_seeded(tmp_path):
+    # Mutate the reference decoder's ctl-body rule: the oracle (derived
+    # from the contract tables, not the decoder) catches the divergence
+    # on the pinned corpus bytes.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/frames.py",
+          '            if b == 0:\n'
+          '                return done("reject(zero control body)")',
+          '            if b == 0 and False:\n'
+          '                return done("reject(zero control body)")')
+    _assert_caught(root, "wire-diff", "Python decoder diverges", "frames.py")
+
+
+def test_wirefuzz_smrec_divergence_seeded(tmp_path):
+    # Mutate the slot-record decoder's seqno seed: every valid record
+    # now rejects, diverging from the oracle in mode smrec.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/shmring.py",
+          "frames.crc32c(_SEQ8.pack(seq))",
+          "frames.crc32c(_SEQ8.pack(seq + 1))")
+    _assert_caught(root, "wire-diff", "diverges", "shmring.py")
+
+
+def test_wirefuzz_native_table_drift_seeded(tmp_path):
+    # The static leg: kCsumExempt[] losing a member diffs against
+    # frames.CSUM_EXEMPT without running a single byte.
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          "constexpr uint8_t kCsumExempt[] = {T_HELLO, T_HELLO_ACK, T_SEQ};",
+          "constexpr uint8_t kCsumExempt[] = {T_HELLO, T_HELLO_ACK};")
+    _assert_caught(root, "wire-diff", "kCsumExempt", "frames.py")
+
+
+def test_wirefuzz_ctl_bound_drift_seeded(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          "constexpr uint64_t CTL_MAX = 1ull << 20;",
+          "constexpr uint64_t CTL_MAX = 1ull << 21;")
+    _assert_caught(root, "wire-diff", "ctl-body bound", "frames.py")
+
+
+def test_wirefuzz_smrec_ring_bound_drift_seeded(tmp_path):
+    # The smrec record-length bound is pinned statically like CTL_MAX:
+    # the oracle follows the tree's shmring.DEFAULT_RING, the native
+    # harness hardcodes its twin, and a drift is a finding even with no
+    # built artifact to fuzz (the corpus boundary cases fire it
+    # dynamically too).
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          "const uint64_t ring_size = 1ull << 20;",
+          "const uint64_t ring_size = 1ull << 21;")
+    _assert_caught(root, "wire-diff", "record-length bound", "shmring.py")
+
+
+def test_wirefuzz_private_parser_table_seeded(tmp_path):
+    # The live parser growing a private decode table (instead of
+    # aliasing frames.CSUM_EXEMPT) is the drift the fuzzer cannot see
+    # dynamically -- the alias check catches it statically.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py",
+          "_CSUM_EXEMPT = frames.CSUM_EXEMPT",
+          "_CSUM_EXEMPT = frozenset((frames.T_HELLO, frames.T_HELLO_ACK,"
+          " frames.T_SEQ))")
+    _assert_caught(root, "wire-diff", "no longer aliases", "conn.py")
+
+
+def test_wirefuzz_corpus_floor_and_malformed_lines(tmp_path):
+    # A truncated or garbled corpus is itself a finding, never a silent
+    # skip (the seeded tree's own corpus shadows the checked-in one).
+    root = _seed(tmp_path)
+    adir = root / "starway_tpu" / "analysis"
+    adir.mkdir(parents=True)
+    (adir / "wirefuzz_corpus.txt").write_text(
+        "# truncated corpus\n"
+        "seed stream 1\n"
+        "bogus stream 2\n"
+        "hex stream zz\n")
+    _assert_caught(root, "wire-diff", "below the", "wirefuzz_corpus.txt")
+    _assert_caught(root, "wire-diff", "malformed corpus",
+                   "wirefuzz_corpus.txt")
+
+
+def test_wirefuzz_waiver(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          "constexpr uint8_t kCsumExempt[] = {T_HELLO, T_HELLO_ACK, T_SEQ};",
+          "constexpr uint8_t kCsumExempt[] = {T_HELLO, T_HELLO_ACK};")
+    _edit(root, "starway_tpu/core/frames.py",
+          "CSUM_EXEMPT = frozenset((T_HELLO, T_HELLO_ACK, T_SEQ))",
+          f"{_SWA}(wire-diff): exercising the waiver path\n"
+          "CSUM_EXEMPT = frozenset((T_HELLO, T_HELLO_ACK, T_SEQ))")
+    assert _findings(root, "wire-diff") == []
+    assert _findings(root, "bad-waiver") == []
+
+
+@pytest.mark.slow
+def test_wirefuzz_long_soak():
+    # The nightly CI leg's in-repo twin: a deep generator run over all
+    # three modes with zero divergence (quick mode covers the gate).
+    from starway_tpu.analysis import wirefuzz
+
+    out: list = []
+    got = wirefuzz._extract_tables(REPO, out)
+    assert got is not None and out == [], [f.render() for f in out]
+    counts = wirefuzz.fuzz(REPO, got[0], out, seeds_per_mode=20000)
+    assert out == [], [f.render() for f in out]
+    assert counts["cases"] >= 60000, counts
+
+
+# ------------- ISSUE 14: swcompose -- taint (taint-integrity) lint
+
+
+def test_taint_dropped_accumulation_seeded(tmp_path):
+    # Remove the guarded CRC accumulation on the eager-body read: the
+    # eventual verify goes blind to those bytes.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py",
+          "                if self._csum_pend is not None:\n"
+          "                    self._csum_accum = frames.crc32c(target[:n],\n"
+          "                                                     self._csum_accum)\n"
+          "                m.received += n",
+          "                m.received += n")
+    _assert_caught(root, "taint-integrity", "CRC accumulator", "conn.py")
+
+
+def test_taint_softened_gate_seeded(tmp_path):
+    # Soften the pre-completion mismatch arm from poison to a counter
+    # bump: corrupt bytes would complete the receive.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py",
+          '                        if self._csum_accum != pend[0]:\n'
+          '                            self._corrupt(fires, "payload checksum (DATA)")\n'
+          '                            return',
+          '                        if self._csum_accum != pend[0]:\n'
+          '                            self._ctr.csum_fail += 1')
+    _assert_caught(root, "taint-integrity", "does not abort", "conn.py")
+
+
+def test_taint_sm_poison_dropped_seeded(tmp_path):
+    # The SmCorrupt handler must surface the stable "corrupt" poison;
+    # dropping the poison_reason assignment degrades it to a generic
+    # conn break (or worse).
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py",
+          "                self.poison_reason = REASON_CORRUPT\n"
+          "                if self.sess is None or self.sess.expired:",
+          "                if self.sess is None or self.sess.expired:")
+    _assert_caught(root, "taint-integrity", "SmCorrupt", "conn.py")
+
+
+def test_taint_shmring_raise_dropped_seeded(tmp_path):
+    # Ring.read_into silently tolerating a checksum mismatch means torn
+    # ring bytes parse as frames.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/shmring.py",
+          'raise SmCorrupt("sm slot record checksum mismatch "',
+          'raise OSError("sm slot record checksum mismatch "')
+    _edit(root, "starway_tpu/core/shmring.py",
+          'raise SmCorrupt("sm slot record header corrupt "',
+          'raise OSError("sm slot record header corrupt "')
+    _assert_caught(root, "taint-integrity", "read_into", "shmring.py")
+
+
+def test_taint_cpp_sm_poison_dropped_seeded(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          'conn_corrupt(c, "sm slot record", fires);',
+          'bump(counters.csum_fail);')
+    _assert_caught(root, "taint-integrity", "sm slot record",
+                   "sw_engine.cpp")
+
+
+def test_taint_cpp_dropped_accumulation_seeded(tmp_path):
+    # Remove the striped-chunk payload accumulation in the native rx
+    # arm: the chunk-level verify goes blind (first occurrence of this
+    # exact statement is the rx_stripe arm).
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          "c->csum_accum = crc32c(target, (size_t)r, c->csum_accum);",
+          ";")
+    _assert_caught(root, "taint-integrity", "CRC accumulator",
+                   "sw_engine.cpp")
+
+
+def test_taint_refuses_vacuity_when_pump_renamed(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py",
+          "def _pump_frames(self, fires: list) -> None:",
+          "def _pump_frames_gone(self, fires: list) -> None:")
+    _assert_caught(root, "taint-integrity", "_pump_frames not found",
+                   "conn.py")
+
+
+def test_taint_waiver(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py",
+          "                self.poison_reason = REASON_CORRUPT\n"
+          "                if self.sess is None or self.sess.expired:",
+          "                if self.sess is None or self.sess.expired:")
+    _edit(root, "starway_tpu/core/conn.py",
+          "    def _rx_read(self, target) -> int:",
+          f"    {_SWA}(taint-integrity): exercising the waiver path\n"
+          "    def _rx_read(self, target) -> int:")
+    assert _findings(root, "taint-integrity") == []
+    assert _findings(root, "bad-waiver") == []
