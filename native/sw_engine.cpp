@@ -180,6 +180,42 @@ const char* kEvE2e = "e2e";
 // Clock-offset sample from a timestamped PING/PONG round trip:
 // reason = "<trace-conn id>:<offset_us>:<err_us>".
 const char* kEvClock = "clock_sample";
+// swrefine protocol event (DESIGN.md §22): conn = conn id, reason = the
+// canonical event -- "rx:<FRAME>" at inbound dispatch, "tx:<FRAME>" at
+// ctl-plane handoff, "st:hello-sent"/"st:estab" at conn creation,
+// "lost"/"resume"/"expire"/"down" for the lifecycle.  Armed only by
+// STARWAY_PROTO_TRACE / STARWAY_MONITOR (TraceRing::proto); replayed
+// through the monitor automaton by `python -m starway_tpu.analysis
+// refine --replay` and core/monitor.py.
+const char* kEvProto = "proto";
+
+// Canonical frame-type -> protocol-event name table (the T_* suffix).
+// Cross-engine contract surface: frames.py FRAME_NAMES is the Python
+// twin, diffed entry-by-entry by the `refine` analysis pass.  Unknown
+// types render as "OTHER" -- the unknown-frame dispatch arm.
+const char* proto_frame_name(uint8_t t) {
+  switch (t) {
+    case T_HELLO: return "HELLO";
+    case T_HELLO_ACK: return "HELLO_ACK";
+    case T_DATA: return "DATA";
+    case T_FLUSH: return "FLUSH";
+    case T_FLUSH_ACK: return "FLUSH_ACK";
+    case T_DEVPULL: return "DEVPULL";
+    case T_PING: return "PING";
+    case T_PONG: return "PONG";
+    case T_SEQ: return "SEQ";
+    case T_ACK: return "ACK";
+    case T_BYE: return "BYE";
+    case T_SDATA: return "SDATA";
+    case T_SACK: return "SACK";
+    case T_CREDIT: return "CREDIT";
+    case T_RTS: return "RTS";
+    case T_CTS: return "CTS";
+    case T_CSUM: return "CSUM";
+    case T_SNACK: return "SNACK";
+    default: return "OTHER";
+  }
+}
 
 // Counter vocabulary, same order as the Counters fields and the values
 // array in sw_counters() below (and as core/swtrace.py COUNTER_NAMES).
@@ -261,16 +297,25 @@ struct TraceEvent {
 // is post-mortem/bench tooling and tolerates that.
 struct TraceRing {
   bool enabled = false;
+  // swrefine protocol-event channel (DESIGN.md §22): armed separately so
+  // plain STARWAY_TRACE runs keep their seed event streams; the env-unset
+  // path pays one bool test per frame and emits nothing.
+  bool proto = false;
   uint64_t cap = 0;
   std::vector<TraceEvent> buf;
   std::atomic<uint64_t> widx{0};
 
-  // Armed per worker at creation: STARWAY_TRACE on, or a flight-recorder
-  // directory configured (core/swtrace.py active() is the Python twin).
+  // Armed per worker at creation: STARWAY_TRACE on, a flight-recorder
+  // directory configured, or the swrefine protocol channel requested
+  // (core/swtrace.py active()/proto_active() are the Python twins).
   void init() {
     const char* t = getenv("STARWAY_TRACE");
     const char* f = getenv("STARWAY_FLIGHT_DIR");
-    enabled = (t && *t && strcmp(t, "0") != 0) || (f && *f);
+    const char* p = getenv("STARWAY_PROTO_TRACE");
+    const char* m = getenv("STARWAY_MONITOR");
+    proto = (p && *p && strcmp(p, "0") != 0) ||
+            (m && *m && strcmp(m, "0") != 0);
+    enabled = (t && *t && strcmp(t, "0") != 0) || (f && *f) || proto;
     if (!enabled) return;
     const char* rs = getenv("STARWAY_TRACE_RING");
     uint64_t c = rs ? strtoull(rs, nullptr, 10) : 4096;
@@ -300,6 +345,26 @@ struct TraceRing {
       e.reason[0] = 0;
     }
     e.ev = ev;  // written last: a nonnull ev marks the slot renderable
+  }
+
+  // swrefine taps (no-ops unless the protocol channel is armed).
+  void proto_ev(uint64_t conn, const char* ev) {
+    if (proto) rec(kEvProto, 0, conn, 0, ev);
+  }
+  // 32 bytes: longest current name is HELLO_ACK (9 + "rx:" + NUL = 13);
+  // headroom so a future long frame name cannot silently truncate into
+  // a spurious bad-event at replay (the reason slot itself holds 48).
+  void proto_rx(uint64_t conn, uint8_t type) {
+    if (!proto) return;
+    char r[32];
+    snprintf(r, sizeof(r), "rx:%s", proto_frame_name(type));
+    rec(kEvProto, 0, conn, 0, r);
+  }
+  void proto_tx(uint64_t conn, uint8_t type) {
+    if (!proto) return;
+    char r[32];
+    snprintf(r, sizeof(r), "tx:%s", proto_frame_name(type));
+    rec(kEvProto, 0, conn, 0, r);
   }
 };
 
@@ -2254,6 +2319,9 @@ struct Worker {
                      const std::string& body, FireList& fires,
                      bool switch_after = false, bool sess_frame = false) {
     if (!c->alive) return;
+    // swrefine tx event at the ctl-plane handoff (DESIGN.md §22; data
+    // frames are covered by send_post/send_done and the peer's rx side).
+    trace.proto_tx(c->id, type);
     auto item = std::make_shared<TxItem>();
     item->header.resize(HEADER_SIZE + body.size());
     pack_header(item->header.data(), type, a, b);
@@ -2282,6 +2350,7 @@ struct Worker {
     // pulled payload (the receiver defers the ACK until pulls resolve).
     c->dirty = true;
     c->data_counter++;
+    trace.proto_tx(c->id, T_DEVPULL);
     auto item = std::make_shared<TxItem>();
     item->header.resize(HEADER_SIZE + op.body.size());
     pack_header(item->header.data(), T_DEVPULL, op.tag, op.body.size());
@@ -2460,6 +2529,8 @@ struct Worker {
   void sess_suspend(Conn* c, FireList& fires) {
     Session* s = c->sess.get();
     SW_DEBUG("conn %llu lost; session suspended", (unsigned long long)c->id);
+    // swrefine: (estab, lost) -> suspended (DESIGN.md §22).
+    trace.proto_ev(c->id, "lost");
     s->suspended = true;
     s->deadline = Clock::now() + std::chrono::duration_cast<Clock::duration>(
                                      std::chrono::duration<double>(s->grace));
@@ -2536,6 +2607,10 @@ struct Worker {
   void sess_resume(Conn* c, int fd, uint64_t peer_ack,
                    const std::string& ack_body, FireList& fires) {
     Session* s = c->sess.get();
+    // swrefine: (suspended, resume) -> estab; the resume dial's
+    // HELLO/HELLO_ACK exchange is folded into this one event
+    // (DESIGN.md §22).
+    trace.proto_ev(c->id, "resume");
     s->suspended = false;
     s->attempt = 0;
     c->fd = fd;
@@ -2596,6 +2671,10 @@ struct Worker {
   void sess_expire(Conn* c, FireList& fires) {
     Session* s = c->sess.get();
     if (!s || s->expired) return;
+    // swrefine: terminal expiry -- from `suspended` (grace / epoch
+    // mismatch) or straight from `estab` (the stale-epoch registration
+    // path, MONITOR_EXTRA in analysis/refine.py; DESIGN.md §22).
+    trace.proto_ev(c->id, "expire");
     s->expired = true;
     c->sess_fail = kSessionExpired;
     SW_DEBUG("session expired (conn %llu)", (unsigned long long)c->id);
@@ -3358,6 +3437,9 @@ struct Worker {
         conns[r->id] = r;
       }
       primary->rails.push_back(r->id);
+      // swrefine: rails take the same blocking handshake as the primary.
+      trace.proto_ev(r->id, "st:hello-sent");
+      trace.proto_ev(r->id, "rx:HELLO_ACK");
       ep_add(fd, EPOLLIN, r);
       trace.rec(kEvConnUp, 0, r->id);
     }
@@ -4037,6 +4119,11 @@ struct Worker {
       uint8_t type;
       uint64_t a, b;
       unpack_header(c->hdr, &type, &a, &b);
+      // swrefine: one protocol event per dispatched inbound frame,
+      // BEFORE the §19 gate and the dispatch switch -- the monitor sees
+      // exactly what the parser saw (DESIGN.md §22; core/conn.py
+      // _pump_frames taps the same point).
+      trace.proto_rx(c->id, type);
       if (c->csum_ok) {
         // §19 verification gate, BEFORE dispatch: arm on T_CSUM, require
         // one for every protected frame, validate routing fields the
@@ -4419,6 +4506,9 @@ struct Worker {
       sess_suspend(c, fires);
       return;
     }
+    // swrefine: terminal transport death (the suspend path above
+    // records "lost" instead; DESIGN.md §22).
+    trace.proto_ev(c->id, "down");
     // With liveness detection active (STARWAY_KEEPALIVE > 0) on a
     // ka-negotiated conn, the user opted out of recvs-pend-forever:
     // whatever killed the conn, the receive it was streaming into fails,
@@ -5208,6 +5298,10 @@ struct Worker {
         c->local_addr = buf;
         c->local_port = ntohs(local.sin_port);
       }
+      // swrefine: accepted conns start in `estab` -- the pre-HELLO
+      // accept state is folded into the same framed dispatch
+      // (DESIGN.md §16, §22).
+      trace.proto_ev(c->id, "st:estab");
       half_open.insert(c);
       ep_add(fd, EPOLLIN, c);
     }
@@ -5420,6 +5514,11 @@ struct ClientWorker : Worker {
       conns[c->id] = c;
       primary_conn = c->id;
     }
+    // swrefine: the blocking handshake above IS the hello-sent state --
+    // HELLO written, HELLO_ACK consumed synchronously before the Conn
+    // exists, so both events are recorded at its birth (DESIGN.md §22).
+    trace.proto_ev(c->id, "st:hello-sent");
+    trace.proto_ev(c->id, "rx:HELLO_ACK");
     ep_add(fd, EPOLLIN, c);
     trace.rec(kEvConnUp, 0, c->id);
     if (c->rails_ok) dial_rails(c, rails_n - 1, fires);
@@ -5662,7 +5761,7 @@ extern "C" {
 //    zero-length striped chunks are protocol violations, T_CSUM prefix
 //    truncates to the 32-bit CRC) + the sw_wire_decode differential
 //    harness -- DESIGN.md §21
-const char* sw_version() { return "starway-native-10"; }
+const char* sw_version() { return "starway-native-11"; }
 
 // Portable cursor atomics for the Python engine's sm ring (sw_engine.h).
 // std::atomic_ref would be C++20-tidy but libstdc++'s needs alignment UB

@@ -81,11 +81,13 @@ typedef void (*sw_event_cb)(void* ctx, const char* event, uint64_t conn_id);
  * DESIGN.md §19) + the swcompose decode-contract hardening (zero and
  * oversized ctl bodies and zero-length striped chunks are protocol
  * violations in both engines; T_CSUM prefixes truncate to the 32-bit
- * CRC -- DESIGN.md §21).  The annotation below is machine-checked
- * against the sw_engine.cpp implementation by the contract checker
- * (python -m starway_tpu.analysis, rule contract-version) -- bump BOTH
- * when the protocol changes.
- * swcheck: engine-version "starway-native-10" */
+ * CRC -- DESIGN.md §21) + the swrefine protocol-event channel (EV_PROTO
+ * events on the swtrace ring, armed by STARWAY_PROTO_TRACE /
+ * STARWAY_MONITOR; no wire change -- DESIGN.md §22).  The annotation
+ * below is machine-checked against the sw_engine.cpp implementation by
+ * the contract checker (python -m starway_tpu.analysis, rule
+ * contract-version) -- bump BOTH when the protocol changes.
+ * swcheck: engine-version "starway-native-11" */
 const char* sw_version(void);
 
 /* Allocate a client/server worker in the VOID state.  `worker_id` is the

@@ -36,6 +36,8 @@ for f in native/sw_engine.cpp native/sw_engine.h native/CMakeLists.txt \
          tests/test_basic.py tests/conftest.py starway_tpu/api.py \
          starway_tpu/models/llama.py starway_tpu/native_build.py \
          starway_tpu/analysis/__main__.py tests/test_swcheck.py \
+         starway_tpu/analysis/wirefuzz_corpus.txt \
+         starway_tpu/analysis/refine_corpus.txt \
          tests/test_session.py scripts/session_chaos.py \
          tests/test_integrity.py starway_tpu/testing/faults.py; do
   grep -qx "$f" "$WORK/filelist" || { echo "MISSING from sdist: $f"; exit 1; }

@@ -71,6 +71,31 @@ def _parse():
     return ap.parse_args()
 
 
+def _monitor_check(report: dict) -> bool:
+    """swrefine conformance checkpoint (DESIGN.md §22): with
+    STARWAY_MONITOR=1 every chaos schedule is also a model<->code
+    conformance check -- replay every traced ring through the protocol
+    monitor and fail the soak hard on any divergence (the violation's
+    flight dump + ring land under STARWAY_FLIGHT_DIR for CI artifacts)."""
+    from starway_tpu.core import monitor, swtrace
+
+    if not monitor.active():
+        return True
+    monitor.check_all()
+    viols = monitor.violations()
+    report["monitor_violations"] = len(viols)
+    report["monitor_witnessed"] = len(monitor.witnessed())
+    if viols:
+        flight = os.environ.get("STARWAY_FLIGHT_DIR")
+        if flight:
+            swtrace.write_ring_dump(
+                os.path.join(flight, f"monitor-rings-{os.getpid()}.json"))
+        for v in viols:
+            print(f"MONITOR VIOLATION: {v.render()}", file=sys.stderr)
+        return False
+    return True
+
+
 def _print_live(cycle: int, total: int, sample: dict) -> None:
     """One progress line per cycle, read from the sampler's snapshot (the
     same JSONL shape STARWAY_METRICS_PATH emits)."""
@@ -158,6 +183,7 @@ async def _main(args) -> int:
         # resume, not by fresh conns.
         ok = (ss["recvs_completed"] == total
               and report["sessions_resumed"] >= 1)
+        ok = _monitor_check(report) and ok
         report["ok"] = ok
         print(json.dumps(report))
         return 0 if ok else 1
@@ -275,6 +301,7 @@ async def _corrupt_soak(args) -> int:
               and detected >= 1
               and retx >= 1
               and report["sessions_resumed"] >= 1)
+        ok = _monitor_check(report) and ok
         report["ok"] = ok
         print(json.dumps(report))
         return 0 if ok else 1
@@ -400,6 +427,7 @@ async def _overload(args) -> int:
         }
         ok = (ss["recvs_completed"] == total and resumes >= 1
               and peak_unexp <= bound)
+        ok = _monitor_check(report) and ok
         report["ok"] = ok
         print(json.dumps(report))
         return 0 if ok else 1

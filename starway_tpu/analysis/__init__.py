@@ -38,6 +38,13 @@ scripts/release_smoke.sh):
   BOTH engines is dominated by a CRC verify whose mismatch arm aborts,
   every payload read accumulates, and sm slot corruption poisons
   before parse (DESIGN.md §21).
+* **refine** -- swrefine model<->code conformance (DESIGN.md §22): the
+  canonical protocol-event vocabulary diffed across both engines, the
+  checked-in event corpus replayed through the monitor automaton
+  compiled from the engines' own extracted state machines, and
+  transition coverage (every model arm witnessed by a pinned run or a
+  justified waiver).  ``refine --replay <dump>`` replays any swtrace
+  ring/flight dump through the same monitor.
 
 Waivers: a finding is suppressed by an explicit justified comment on (or
 directly above) the flagged line::
@@ -55,7 +62,7 @@ from pathlib import Path
 from typing import Iterable, Optional
 
 from . import (compose, concurrency, contract, explore, hotpath, layering,
-               markers, protomodel, taint, wirefuzz)
+               markers, protomodel, refine, taint, wirefuzz)
 from .base import (  # noqa: F401  (re-exported for tests and tooling)
     RULES,
     Finding,
@@ -80,6 +87,7 @@ PASSES = {
     "compose": compose.run,
     "wirefuzz": wirefuzz.run,
     "taint": taint.run,
+    "refine": refine.run,
 }
 
 

@@ -31,6 +31,12 @@ def main(argv=None) -> int:
                              "package location)")
     parser.add_argument("--rules", action="store_true",
                         help="list every rule name (waiver targets) and exit")
+    parser.add_argument("--replay", metavar="DUMP", default=None,
+                        help="swrefine: replay a swtrace ring dump "
+                             "(swtrace.write_ring_dump) or flight-recorder "
+                             "JSON through the protocol monitor and report "
+                             "divergences (DESIGN.md §22); implies the "
+                             "refine pass only")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit findings + timings as one JSON document "
                              "on stdout (exit status semantics unchanged)")
@@ -41,6 +47,32 @@ def main(argv=None) -> int:
     if args.rules:
         for name, desc in sorted(RULES.items()):
             print(f"{name:22s} {desc}")
+        return 0
+
+    if args.replay is not None:
+        from . import refine
+
+        viols = refine.replay_dump(args.replay,
+                                   find_root(args.root) if args.root else None)
+        if args.as_json:
+            print(json.dumps({
+                "dump": args.replay,
+                "violations": [
+                    {"label": v.label, "conn": v.conn, "index": v.index,
+                     "class": v.cls, "message": v.message,
+                     "context": v.context}
+                    for v in viols
+                ],
+                "ok": not viols,
+            }, indent=1))
+        else:
+            for v in viols:
+                print(v.render())
+        if viols:
+            print(f"refine: {len(viols)} divergence(s) in {args.replay}",
+                  file=sys.stderr)
+            return 1
+        print(f"refine: OK (replayed {args.replay})", file=sys.stderr)
         return 0
 
     unknown = [p for p in args.passes if p not in PASSES]

@@ -43,6 +43,11 @@ RULES = {
                  "from the contract-derived oracle) on identical bytes",
     "taint-integrity": "payload bytes can reach a user buffer or callback "
                        "before the §19 CRC verify dominates them",
+    "refine": "model<->code conformance broken: protocol-event vocabulary "
+              "drifted, or a pinned event history diverges from the "
+              "monitor compiled from the engines' own state machines",
+    "monitor-coverage": "a protocol-model transition no pinned run ever "
+                        "witnesses (stale model arm or dead code)",
     "layering-jax": "jax imported under core/ (device.py owns that boundary)",
     "layering-reshard": "reshard/-above-core/ boundary crossed (core/ "
                         "imports reshard, or jax bound outside reshard/api.py)",

@@ -206,6 +206,28 @@ benchmark.md:114-126 for ``UCX_TLS``).  The TPU build mirrors that shape:
     allocation, no syscall.  Export with ``python -m starway_tpu.trace``
     or ``python -m starway_tpu.bench --trace PATH`` (Chrome/Perfetto).
 
+``STARWAY_PROTO_TRACE``
+    "1" = additionally record the swrefine protocol-event channel
+    (DESIGN.md §22) into the same ring, in BOTH engines: one ``EV_PROTO``
+    event per dispatched inbound frame (``rx:<FRAME>``), per ctl-plane
+    frame handed to a transport (``tx:<FRAME>``), plus the conn lifecycle
+    (``st:hello-sent``/``st:estab`` at creation, ``lost``/``resume``/
+    ``expire``/``down``).  ``python -m starway_tpu.analysis refine
+    --replay <ring dump>`` replays the channel through the protocol
+    monitor automaton compiled from the engines' own state machines.
+    Default off; setting it arms the trace ring even without
+    STARWAY_TRACE.  The seed path (env unset) emits zero protocol events
+    -- one ``is None`` check per frame, pinned by test.
+
+``STARWAY_MONITOR``
+    "1" = runtime conformance checking (swrefine, DESIGN.md §22): implies
+    STARWAY_PROTO_TRACE, and every traced worker's protocol events are
+    replayed through the monitor automaton in-process at worker
+    retirement (plus on demand via ``core.monitor.check_all()`` -- the
+    chaos soaks call it every run).  A violation records the divergence,
+    dumps the §13 flight recorder, and fails the soak hard
+    (``monitor.assert_clean()``).  Default off.
+
 ``STARWAY_TRACE_RING``
     Trace ring capacity in events per worker (default 4096; min 16).
 
@@ -270,6 +292,8 @@ __all__ = [
     "unexp_cap",
     "integrity_enabled",
     "trace_enabled",
+    "proto_trace_enabled",
+    "monitor_enabled",
     "trace_ring_size",
     "flight_dir",
     "metrics_interval",
@@ -469,6 +493,20 @@ def trace_enabled() -> bool:
     """Per-op lifecycle tracing (STARWAY_TRACE); off by default -- the
     tracing-off hot path must stay allocation-free (DESIGN.md §13)."""
     return _env("STARWAY_TRACE", "0") not in ("", "0")
+
+
+def proto_trace_enabled() -> bool:
+    """swrefine protocol-event channel (STARWAY_PROTO_TRACE; implied by
+    STARWAY_MONITOR); off by default -- the seed path emits no protocol
+    events and pays one ``is None`` check per frame (DESIGN.md §22)."""
+    return (_env("STARWAY_PROTO_TRACE", "0") not in ("", "0")
+            or monitor_enabled())
+
+
+def monitor_enabled() -> bool:
+    """In-process protocol-monitor checking (STARWAY_MONITOR); off by
+    default.  Implies the protocol-event channel (DESIGN.md §22)."""
+    return _env("STARWAY_MONITOR", "0") not in ("", "0")
 
 
 def trace_ring_size() -> int:

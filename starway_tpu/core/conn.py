@@ -399,6 +399,11 @@ class BaseConn:
         # sides confirm), and the per-direction wire ordinals that pair
         # send-side and recv-side EV_E2E events across processes.
         self._ring = getattr(worker, "_trace", None)
+        # swrefine protocol-event channel (DESIGN.md §22): the same ring,
+        # armed only by STARWAY_PROTO_TRACE / STARWAY_MONITOR -- the seed
+        # path (and plain STARWAY_TRACE runs) pay one `is None` check per
+        # frame and emit nothing.
+        self._proto = self._ring if swtrace.proto_active() else None
         self.tr_id = ""
         self.tx_e2e_ord = 0
         self.rx_e2e_ord = 0
@@ -803,8 +808,17 @@ class TcpConn(BaseConn):
             self.kick_tx(fires)
         return item
 
+    def _proto_tx(self, ftype: int) -> None:
+        """swrefine tx event at the ctl-plane handoff (DESIGN.md §22;
+        data frames are covered by send_post/send_done and the peer's
+        rx events)."""
+        self._proto.rec(swtrace.EV_PROTO, 0, self.conn_id, 0,
+                        "tx:" + frames.FRAME_NAMES.get(ftype, "OTHER"))
+
     def send_flush(self, seq: int, fires: list) -> None:
         self._flush_marks[seq] = self._data_counter
+        if self._proto is not None:
+            self._proto_tx(frames.T_FLUSH)
         item = TxCtl(frames.pack_flush(seq))
         self._csum_arm(item)
         if self.sess is not None:
@@ -816,6 +830,8 @@ class TcpConn(BaseConn):
     def send_flush_ack(self, seq: int, fires: list) -> None:
         """FLUSH_ACK is a *sequenced* session frame (a barrier ACK lost
         with a conn must replay, or the peer's flush hangs forever)."""
+        if self._proto is not None:
+            self._proto_tx(frames.T_FLUSH_ACK)
         item = TxCtl(frames.pack_flush_ack(seq))
         self._csum_arm(item)
         if self.sess is not None:
@@ -830,6 +846,8 @@ class TcpConn(BaseConn):
             self.dirty = False
 
     def send_ctl(self, data: bytes, fires: list, switch_after: bool = False) -> None:
+        if self._proto is not None and data:
+            self._proto_tx(data[0])  # the frame header leads with its type
         item = TxCtl(data, switch_after)
         self._csum_arm(item)
         self.tx.append(item)
@@ -899,6 +917,8 @@ class TcpConn(BaseConn):
             return
         self.dirty = True
         self._data_counter += 1
+        if self._proto is not None:
+            self._proto_tx(frames.T_DEVPULL)
         item = TxDevpull(data, done, fail, owner)
         self._csum_arm(item)
         if self.sess is not None:
@@ -1013,6 +1033,9 @@ class TcpConn(BaseConn):
         journal, and flush bookkeeping.  The conn stays ``alive`` so
         flush barriers keep waiting and new sends keep queueing -- they
         complete after resume instead of failing."""
+        if self._proto is not None:
+            # swrefine: (estab, lost) -> suspended (DESIGN.md §22).
+            self._proto.rec(swtrace.EV_PROTO, 0, self.conn_id, 0, "lost")
         sess = self.sess
         sess.suspend()
         self.worker._unregister_conn_io(self)
@@ -1065,6 +1088,11 @@ class TcpConn(BaseConn):
         the handshake), and replay everything past it.  ``ack_ctl`` is the
         acceptor's HELLO_ACK -- it must precede replayed frames on the
         wire."""
+        if self._proto is not None:
+            # swrefine: (suspended, resume) -> estab; the resume dial's
+            # HELLO/HELLO_ACK exchange is folded into this one event
+            # (the conn never leaves the session machine, DESIGN.md §22).
+            self._proto.rec(swtrace.EV_PROTO, 0, self.conn_id, 0, "resume")
         sess = self.sess
         sock.setblocking(False)
         try:
@@ -1855,6 +1883,15 @@ class TcpConn(BaseConn):
                 continue
             self._hdr_got = 0
             ftype, a, b = frames.unpack_header(self._hdr)
+            if self._proto is not None:
+                # swrefine: one protocol event per dispatched inbound
+                # frame, BEFORE the §19 gate and the dispatch chain --
+                # the monitor sees exactly what the parser saw
+                # (DESIGN.md §22; the native pump_stream taps the same
+                # point).
+                self._proto.rec(swtrace.EV_PROTO, 0, self.conn_id, 0,
+                                "rx:" + frames.FRAME_NAMES.get(ftype,
+                                                               "OTHER"))
             if self.csum_ok:
                 # §19 verification gate, BEFORE dispatch: arm on T_CSUM,
                 # require one for every protected frame, and validate

@@ -888,6 +888,7 @@ class Worker:
         whatever killed the conn (liveness expiry, RST, EOF), the receive
         it was streaming into fails, and once no alive conns remain every
         queued receive fails too -- stable "not connected" keyword."""
+        was_alive = conn.alive
         if self._trace is not None and conn.alive:
             self._trace.rec(swtrace.EV_CONN_DOWN, 0, conn.conn_id)
         sess = getattr(conn, "sess", None)
@@ -898,6 +899,10 @@ class Worker:
             if running:
                 self._sess_suspend(conn, fires)
                 return
+        if was_alive and getattr(conn, "_proto", None) is not None:
+            # swrefine: terminal transport death (the suspend path above
+            # records "lost" instead; DESIGN.md §22).
+            conn._proto.rec(swtrace.EV_PROTO, 0, conn.conn_id, 0, "down")
         ka_live = (self._ka_interval > 0 and conn.alive
                    and getattr(conn, "ka_ok", False))
         stranded = None
@@ -999,6 +1004,12 @@ class Worker:
         logger.warning("starway: session %s expired", sess.sid[:8])
         if self._trace is not None:
             self._trace.rec(swtrace.EV_SESS_EXPIRE, 0, conn.conn_id, 0, reason)
+        if getattr(conn, "_proto", None) is not None:
+            # swrefine: terminal expiry -- from `suspended` (grace
+            # elapsed / epoch mismatch) or straight from `estab` (the
+            # stale-epoch registration path, MONITOR_EXTRA in
+            # analysis/refine.py; DESIGN.md §22).
+            conn._proto.rec(swtrace.EV_PROTO, 0, conn.conn_id, 0, "expire")
         self._faulted = True
         swtrace.flight_dump("session-expired", self, reason)
         # count=True: the C++ engine bumps ops_cancelled per item it fails
@@ -1275,6 +1286,15 @@ class ClientWorker(Worker):
                 self.status = state.RUNNING
         self._register_conn_io(conn)
         fabric.register_worker(self)
+        if conn._proto is not None:
+            # swrefine: the blocking handshake above IS the hello-sent
+            # state -- HELLO written, HELLO_ACK consumed synchronously
+            # before the conn object exists, so both events are recorded
+            # here at its birth (DESIGN.md §22).
+            conn._proto.rec(swtrace.EV_PROTO, 0, conn.conn_id, 0,
+                            "st:hello-sent")
+            conn._proto.rec(swtrace.EV_PROTO, 0, conn.conn_id, 0,
+                            "rx:HELLO_ACK")
         if conn.rails_ok:
             self._dial_rails(conn, addr, port, rails_n - 1)
         if self._trace is not None:
@@ -1337,6 +1357,13 @@ class ClientWorker(Worker):
             with self.lock:
                 self.conns[rail.conn_id] = rail
             self._register_conn_io(rail)
+            if rail._proto is not None:
+                # swrefine: rails take the same blocking handshake as the
+                # primary (DESIGN.md §22).
+                rail._proto.rec(swtrace.EV_PROTO, 0, rail.conn_id, 0,
+                                "st:hello-sent")
+                rail._proto.rec(swtrace.EV_PROTO, 0, rail.conn_id, 0,
+                                "rx:HELLO_ACK")
             if self._trace is not None:
                 self._trace.rec(swtrace.EV_CONN_UP, 0, rail.conn_id)
         _run_fires(fires)
@@ -1518,6 +1545,12 @@ class ServerWorker(Worker):
             except (BlockingIOError, OSError):
                 return
             conn = TcpConn(self, s, "socket", handshaken=False)
+            if conn._proto is not None:
+                # swrefine: accepted conns start in `estab` -- the
+                # pre-HELLO accept state is folded into the same framed
+                # dispatch (DESIGN.md §16, §22).
+                conn._proto.rec(swtrace.EV_PROTO, 0, conn.conn_id, 0,
+                                "st:estab")
             self._half_open.add(conn)
             self._register_conn_io(conn)
             # The connection joins self.conns once its HELLO arrives.

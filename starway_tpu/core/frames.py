@@ -228,6 +228,37 @@ T_CTS = 16
 T_CSUM = 17
 T_SNACK = 18
 
+# Canonical frame-name table for the swrefine protocol-event channel
+# (DESIGN.md §22): ``rx:<NAME>``/``tx:<NAME>`` events and the protocol
+# monitor automaton use exactly these names -- the T_* suffix, which is
+# also the protomodel annotation vocabulary (analysis/protomodel.py
+# KNOWN_INPUTS).  Cross-engine contract surface: the C++ engine carries
+# the same table as ``proto_frame_name()`` in sw_engine.cpp, and the
+# `refine` analysis pass diffs the two entry-by-entry (a frame type
+# missing from either table, or mapped to a different name, is a merge-
+# gate finding).  Types absent from the table render as "OTHER" -- the
+# unknown-frame dispatch arm.
+FRAME_NAMES = {
+    T_HELLO: "HELLO",
+    T_HELLO_ACK: "HELLO_ACK",
+    T_DATA: "DATA",
+    T_FLUSH: "FLUSH",
+    T_FLUSH_ACK: "FLUSH_ACK",
+    T_DEVPULL: "DEVPULL",
+    T_PING: "PING",
+    T_PONG: "PONG",
+    T_SEQ: "SEQ",
+    T_ACK: "ACK",
+    T_BYE: "BYE",
+    T_SDATA: "SDATA",
+    T_SACK: "SACK",
+    T_CREDIT: "CREDIT",
+    T_RTS: "RTS",
+    T_CTS: "CTS",
+    T_CSUM: "CSUM",
+    T_SNACK: "SNACK",
+}
+
 # Rendezvous (RTS/CTS) message-id namespace bit (DESIGN.md §18): fc msg
 # ids carry the top bit so they can never collide with stripe msg ids on
 # a railed+fc conn -- both families share the receiver's assembly table

@@ -85,6 +85,18 @@ EV_CLOCK = "clock_sample"     # swscope clock-offset sample from a
 #                               timestamped PING/PONG round trip: reason =
 #                               "<trace-conn id>:<offset_us>:<err_us>"
 #                               (peer_clock ~= local_clock + offset).
+EV_PROTO = "proto"            # swrefine protocol event (DESIGN.md §22):
+#                               conn = conn id, reason = the canonical
+#                               event -- "rx:<FRAME>" at inbound dispatch,
+#                               "tx:<FRAME>" at ctl-plane handoff,
+#                               "st:hello-sent"/"st:estab" at conn
+#                               creation, "lost"/"resume"/"expire"/"down"
+#                               for the lifecycle.  Armed only by
+#                               STARWAY_PROTO_TRACE / STARWAY_MONITOR
+#                               (proto_active below); analysis/refine.py
+#                               replays the channel through the monitor
+#                               automaton compiled from both engines'
+#                               protocol state machines.
 
 # ----------------------------------------------------- counter vocabulary
 #
@@ -167,9 +179,20 @@ def merge_global_counters(snap: dict) -> dict:
 
 def active() -> bool:
     """Tracing hooks armed for new workers?  True when ``STARWAY_TRACE``
-    is on or a flight directory is configured (the recorder needs the
-    ring's last-N events even when nobody asked for a full trace)."""
-    return config.trace_enabled() or bool(config.flight_dir())
+    is on, a flight directory is configured (the recorder needs the
+    ring's last-N events even when nobody asked for a full trace), or the
+    swrefine protocol-event channel is armed (its events ride this ring,
+    DESIGN.md §22)."""
+    return (config.trace_enabled() or bool(config.flight_dir())
+            or config.proto_trace_enabled())
+
+
+def proto_active() -> bool:
+    """swrefine protocol-event channel armed for new conns?  Kept
+    separate from :func:`active` so plain STARWAY_TRACE runs keep their
+    seed event streams (the proto channel adds one event per frame); the
+    env-unset path stays a single ``is None`` check per frame."""
+    return config.proto_trace_enabled()
 
 
 class TraceRing:
@@ -252,7 +275,10 @@ def register_worker(worker) -> None:
 
 def retire(worker) -> None:
     """Snapshot a closing worker's ring into the retired list so its
-    events survive the worker object (bench reports run after close)."""
+    events survive the worker object (bench reports run after close).
+    With STARWAY_MONITOR armed this is also the automatic conformance
+    checkpoint: the worker's protocol events replay through the monitor
+    before the ring is retired (DESIGN.md §22)."""
     if not active() or getattr(worker, "_trace_retired", False):
         return
     worker._trace_retired = True
@@ -260,6 +286,10 @@ def retire(worker) -> None:
         events = worker.trace_events()
     except Exception:
         events = []
+    if events and config.monitor_enabled():
+        from . import monitor
+
+        monitor.check_worker(worker, events)
     if not events:
         return
     with _reg_lock:

@@ -1,0 +1,259 @@
+"""swrefine runtime verification (DESIGN.md §22): the protocol-event
+channel, the monitor automaton, ring-dump replay, and the STARWAY_MONITOR
+in-process plane.
+
+Static halves (vocabulary diff, corpus replay, transition coverage, the
+seeded gate violations) live in tests/test_swcheck.py; this file drives
+REAL engines: both emit the canonical event channel, real rings replay
+clean through the monitor, each divergence class is detected on
+adversarial rings, and the seed path (env unset) emits nothing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from starway_tpu import Client, Server
+from starway_tpu.analysis import refine
+from starway_tpu.core import monitor, swtrace
+
+ADDR = "127.0.0.1"
+
+
+def _native_available() -> bool:
+    from starway_tpu.core import native
+
+    return native.available()
+
+
+def _env(monkeypatch, *, native: bool, proto: bool = True,
+         monitor_on: bool = False, trace: bool = False, flight=None):
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if native else "0")
+    monkeypatch.setenv("STARWAY_DEVPULL", "0")
+    for name, on in (("STARWAY_PROTO_TRACE", proto),
+                     ("STARWAY_MONITOR", monitor_on),
+                     ("STARWAY_TRACE", trace)):
+        if on:
+            monkeypatch.setenv(name, "1")
+        else:
+            monkeypatch.delenv(name, raising=False)
+    if flight is not None:
+        monkeypatch.setenv("STARWAY_FLIGHT_DIR", str(flight))
+    else:
+        monkeypatch.delenv("STARWAY_FLIGHT_DIR", raising=False)
+    swtrace.reset()
+    monitor.reset()
+
+
+def _proto_events(dumps):
+    return [e for d in dumps for e in d["events"] if e[1] == swtrace.EV_PROTO]
+
+
+async def _exchange(port, n=4):
+    server = Server()
+    client = Client()
+    server.listen(ADDR, port)
+    await client.aconnect(ADDR, port)
+    bufs = [np.zeros(256, dtype=np.uint8) for _ in range(n)]
+    recvs = [server.arecv(bufs[i], 100 + i, (1 << 64) - 1) for i in range(n)]
+    sends = [client.asend(np.full(256, i + 1, dtype=np.uint8), 100 + i)
+             for i in range(n)]
+    await asyncio.gather(*sends)
+    await client.aflush()
+    await asyncio.gather(*recvs)
+    await client.aclose()
+    await server.aclose()
+
+
+# ------------------------------------------------- channel + clean replay
+
+
+@pytest.mark.parametrize("engine", ["python", "native"])
+async def test_real_rings_replay_clean(port, monkeypatch, engine):
+    """Both engines emit the canonical channel and their real rings
+    replay through the monitor without divergence -- the engines conform
+    to their own extracted model."""
+    if engine == "native" and not _native_available():
+        pytest.skip("native engine not built")
+    _env(monkeypatch, native=engine == "native")
+    await _exchange(port)
+    dumps = swtrace.dump_all()
+    assert _proto_events(dumps), "protocol channel armed but silent"
+    mon, problems = refine.compile_monitor()
+    assert mon is not None, problems
+    witnessed = set()
+    for d in dumps:
+        viols, seen = mon.replay(d["events"], label=d["worker"])
+        assert viols == [], [v.render() for v in viols]
+        witnessed |= seen
+    # The plain pair witnesses the handshake + data + flush arms.
+    for key in (("hello-sent", "HELLO_ACK"), ("estab", "HELLO"),
+                ("estab", "DATA"), ("estab", "FLUSH"),
+                ("estab", "FLUSH_ACK")):
+        assert key in witnessed, (key, sorted(witnessed))
+
+
+@pytest.mark.parametrize("engine", ["python", "native"])
+async def test_seed_path_emits_no_protocol_events(port, monkeypatch, engine):
+    """The channel is strictly opt-in: a plain STARWAY_TRACE=1 run keeps
+    its seed event stream -- zero EV_PROTO events (the BENCHMARK.md §22
+    overhead note's pinned premise)."""
+    if engine == "native" and not _native_available():
+        pytest.skip("native engine not built")
+    _env(monkeypatch, native=engine == "native", proto=False, trace=True)
+    await _exchange(port)
+    dumps = swtrace.dump_all()
+    assert dumps, "tracing was armed"
+    assert _proto_events(dumps) == []
+
+
+# ----------------------------------------------------- divergence classes
+
+
+def _mon():
+    mon, problems = refine.compile_monitor()
+    assert mon is not None, problems
+    return mon
+
+
+def _ring(*events, conn=7):
+    """Synthetic swtrace ring carrying one conn's protocol events."""
+    return [(0.0, swtrace.EV_PROTO, 0, conn, 0, ev, 0.0) for ev in events]
+
+
+@pytest.mark.parametrize("events,cls", [
+    (("st:estab", "rx:HELLO", "resume"), "no-transition"),
+    (("st:estab", "lost", "rx:DATA"), "no-transition"),
+    (("st:estab", "rx:OTHER", "rx:DATA"), "event-after-terminal"),
+    (("st:estab", "lost", "expire", "rx:SEQ"), "event-after-terminal"),
+    (("st:estab", "lost", "st:estab"), "state-decl"),
+    (("st:estab", "rx:BOGUS"), "bad-event"),
+])
+def test_divergence_classes_detected(events, cls):
+    viols, _ = _mon().replay(_ring(*events))
+    assert len(viols) == 1 and viols[0].cls == cls, viols
+    assert viols[0].conn == 7
+    assert viols[0].context[-1] == events[-1]  # ring context ships along
+
+
+def test_replay_stops_per_conn_not_per_ring():
+    """A diverged conn stops replaying; other conns in the same ring keep
+    being checked (one bad conn must not mask another)."""
+    events = _ring("st:estab", "rx:OTHER", "rx:DATA", conn=1) \
+        + _ring("st:estab", "lost", "lost", conn=2)
+    viols, _ = _mon().replay(events)
+    assert {v.conn for v in viols} == {1, 2}
+
+
+def test_midstream_ring_starts_universal():
+    """A bounded ring that lost the conn's birth replays from the
+    universal live set -- truncation is not a divergence."""
+    viols, seen = _mon().replay(_ring("rx:DATA", "rx:FLUSH", "lost",
+                                      "resume", "down"))
+    assert viols == []
+    assert ("estab", "DATA") in seen and ("suspended", "resume") in seen
+
+
+# -------------------------------------------------------- ring-dump replay
+
+
+async def test_replay_dump_cli_roundtrip(port, monkeypatch, tmp_path):
+    """write_ring_dump -> `analysis refine --replay` accepts a clean run
+    and flags a doctored one (the offline half of the monitor)."""
+    _env(monkeypatch, native=False)
+    await _exchange(port)
+    dump = tmp_path / "rings.json"
+    swtrace.write_ring_dump(dump)
+    assert refine.replay_dump(dump) == []
+    from starway_tpu.analysis.__main__ import main as analysis_main
+
+    assert analysis_main(["--replay", str(dump)]) == 0
+    doc = json.loads(dump.read_text())
+    doc["workers"].append({
+        "worker": "doctored",
+        "events": [[0.0, "proto", 0, 9, 0, ev, 0.0]
+                   for ev in ("st:estab", "lost", "rx:DATA")],
+    })
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(doc))
+    viols = refine.replay_dump(bad)
+    assert viols and viols[0].cls == "no-transition"
+    assert analysis_main(["--replay", str(bad)]) == 1
+
+
+# ------------------------------------------------- STARWAY_MONITOR plane
+
+
+async def test_monitor_mode_clean_run(port, monkeypatch):
+    """STARWAY_MONITOR=1: workers are checked in-process at retirement;
+    a conforming run records no violations and real coverage."""
+    _env(monkeypatch, native=False, monitor_on=True)
+    await _exchange(port)
+    monitor.check_all()
+    assert monitor.violations() == []
+    assert ("estab", "DATA") in monitor.witnessed()
+    monitor.assert_clean()  # must not raise
+
+
+async def test_monitor_violation_fails_hard_and_dumps_flight(
+        port, monkeypatch, tmp_path):
+    """A divergent ring recorded under STARWAY_MONITOR turns into a hard
+    failure with the §13 flight recorder dumped alongside."""
+    flight = tmp_path / "flight"
+    _env(monkeypatch, native=False, monitor_on=True, flight=flight)
+    server = Server()
+    client = Client()
+    server.listen(ADDR, port)
+    await client.aconnect(ADDR, port)
+    await client.asend(np.zeros(64, dtype=np.uint8), 1)
+    await client.aflush()
+    # Doctor a divergent event into the live server ring, then run the
+    # in-process checkpoint the soaks (and worker retirement) use.
+    worker = server._server
+    worker._trace.rec(swtrace.EV_PROTO, 0, 424242, 0, "st:estab")
+    worker._trace.rec(swtrace.EV_PROTO, 0, 424242, 0, "resume")
+    viols = monitor.check_worker(worker)
+    assert viols and viols[0].cls == "no-transition"
+    with pytest.raises(AssertionError, match="no-transition"):
+        monitor.assert_clean()
+    dumps = list(flight.glob("flight-*.json"))
+    assert dumps, "monitor violation must dump the flight recorder"
+    payload = json.loads(dumps[0].read_text())
+    assert payload["trigger"] == "monitor-violation"
+    await client.aclose()
+    await server.aclose()
+
+
+async def test_monitor_checks_at_worker_retirement(port, monkeypatch):
+    """swtrace.retire (worker close) is an automatic checkpoint: a
+    divergence present in the ring is recorded without anyone calling
+    check_all -- chaos soaks cannot forget to look."""
+    _env(monkeypatch, native=False, monitor_on=True)
+    server = Server()
+    client = Client()
+    server.listen(ADDR, port)
+    await client.aconnect(ADDR, port)
+    await client.asend(np.zeros(64, dtype=np.uint8), 1)
+    await client.aflush()
+    client._client._trace.rec(swtrace.EV_PROTO, 0, 979797, 0, "st:estab")
+    client._client._trace.rec(swtrace.EV_PROTO, 0, 979797, 0, "lost")
+    client._client._trace.rec(swtrace.EV_PROTO, 0, 979797, 0, "lost")
+    await client.aclose()
+    await server.aclose()
+    assert monitor.violations(), "retirement checkpoint missed the ring"
+    assert monitor.violations()[0].cls == "no-transition"
+
+
+def test_monitor_off_is_dark(monkeypatch):
+    monkeypatch.delenv("STARWAY_MONITOR", raising=False)
+    monkeypatch.delenv("STARWAY_PROTO_TRACE", raising=False)
+    monitor.reset()
+    assert not monitor.active()
+    assert monitor.check_all() == []
+    monitor.assert_clean()

@@ -226,8 +226,8 @@ def test_garbled_doc_table(tmp_path):
 def test_version_drift(tmp_path):
     root = _seed(tmp_path)
     _edit(root, "native/sw_engine.cpp",
-          'return "starway-native-10"', 'return "starway-native-11"')
-    _assert_caught(root, "contract-version", "starway-native-11", "sw_engine.h")
+          'return "starway-native-11"', 'return "starway-native-12"')
+    _assert_caught(root, "contract-version", "starway-native-12", "sw_engine.h")
 
 
 def test_unmarked_multi_gib_test(tmp_path):
@@ -1593,4 +1593,221 @@ def test_taint_waiver(tmp_path):
           f"    {_SWA}(taint-integrity): exercising the waiver path\n"
           "    def _rx_read(self, target) -> int:")
     assert _findings(root, "taint-integrity") == []
+    assert _findings(root, "bad-waiver") == []
+
+
+# --------------------------------------------- swrefine (DESIGN.md §22)
+#
+# Model<->code conformance: the canonical protocol-event vocabulary, the
+# monitor automaton compiled from both engines' extracted machines, the
+# checked-in event corpus, and transition coverage.  The runtime half
+# (real rings, divergence classes, STARWAY_MONITOR) lives in
+# tests/test_refine.py.
+
+
+def test_refine_rules_registered():
+    assert "refine" in analysis.RULES
+    assert "monitor-coverage" in analysis.RULES
+    from starway_tpu.analysis import PASSES
+
+    assert "refine" in PASSES
+
+
+def test_refine_head_clean_with_real_corpus():
+    # The acceptance bar: monitor compiles from HEAD's machines, the
+    # checked-in corpus (>= the floor) replays clean, every model
+    # transition is witnessed or waived, and every divergence class is
+    # pinned.
+    from starway_tpu.analysis import refine
+
+    assert analysis.run_all(REPO, ["refine"]) == []
+    mon, problems = refine.compile_monitor(REPO)
+    assert mon is not None and not problems
+    assert len(mon.transitions) >= 20, sorted(mon.transitions)
+    sink: list = []
+    cases = refine.load_corpus(sink, REPO)
+    assert sink == [] and len(cases) >= refine.CORPUS_FLOOR
+
+
+async def _refine_floor_scenario(port):
+    """Quick live scenario whose rings must witness COVERAGE_FLOOR: a
+    session pair exchanging bursts through a FaultProxy with one
+    mid-burst kill (suspend -> resume) -- the same shape as the chaos
+    soaks, bounded for the gate."""
+    import asyncio
+
+    import numpy as np
+
+    from starway_tpu import Client, Server
+    from starway_tpu.testing.faults import FaultProxy
+
+    server = Server()
+    server.listen("127.0.0.1", port)
+    proxy = FaultProxy("127.0.0.1", port).start()
+    client = Client()
+    await client.aconnect("127.0.0.1", proxy.port)
+    try:
+        for cycle in range(2):
+            tag0 = cycle * 100
+            bufs = [np.zeros(256, dtype=np.uint8) for _ in range(5)]
+            recvs = [server.arecv(bufs[i], tag0 + i, (1 << 64) - 1)
+                     for i in range(5)]
+            sends = [client.asend(
+                np.full(256, (tag0 + i) % 251, dtype=np.uint8), tag0 + i)
+                for i in range(5)]
+            if cycle == 1:
+                await asyncio.sleep(0.2)
+                proxy.kill_all(rst=True)
+            await asyncio.wait_for(asyncio.gather(*sends), 30)
+            await asyncio.wait_for(client.aflush(), 30)
+            await asyncio.wait_for(asyncio.gather(*recvs), 30)
+    finally:
+        await client.aclose()
+        await server.aclose()
+        proxy.stop()
+
+
+@pytest.mark.parametrize("engine", ["python", "native"])
+async def test_refine_live_transition_coverage_floor(port, monkeypatch,
+                                                     engine):
+    """The LIVE transition-coverage floor (ISSUE 15): quick scenarios on
+    EACH engine must witness refine.COVERAGE_FLOOR through real rings --
+    the corpus proves the monitor can see every arm, this proves the
+    engine taps actually fire.  Failures name the unwitnessed
+    transitions."""
+    from starway_tpu.analysis import refine
+    from starway_tpu.core import native, swtrace
+
+    if engine == "native" and not native.available():
+        pytest.skip("native engine not built")
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if engine == "native" else "0")
+    monkeypatch.setenv("STARWAY_PROTO_TRACE", "1")
+    monkeypatch.setenv("STARWAY_SESSION", "1")
+    monkeypatch.delenv("STARWAY_TRACE", raising=False)
+    swtrace.reset()
+    await _refine_floor_scenario(port)
+    mon, problems = refine.compile_monitor(REPO)
+    assert mon is not None, problems
+    witnessed: set = set()
+    for dump in swtrace.dump_all():
+        viols, seen = mon.replay(dump["events"], label=dump["worker"])
+        assert viols == [], [v.render() for v in viols]
+        witnessed |= seen
+    missing = [t for t in refine.COVERAGE_FLOOR if t not in witnessed]
+    assert not missing, (
+        f"{engine} engine never witnessed model transition(s) {missing} "
+        f"(witnessed: {sorted(witnessed)})")
+
+
+def test_refine_frame_name_drift_python_seeded(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/frames.py",
+          '    T_SACK: "SACK",', '    T_SACK: "SACKZ",')
+    _assert_caught(root, "refine", "canonical event name", "frames.py")
+
+
+def test_refine_frame_name_drift_cpp_seeded(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          'case T_SACK: return "SACK";', 'case T_SACK: return "WRONG";')
+    _assert_caught(root, "refine", "disagree on T_SACK", "frames.py")
+
+
+def test_refine_native_table_gone_seeded(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          "const char* proto_frame_name(uint8_t t) {",
+          "const char* frame_name_x(uint8_t t) {")
+    _assert_caught(root, "refine", "proto_frame_name() not found",
+                   "sw_engine.cpp")
+
+
+def test_refine_python_taps_gone_seeded(tmp_path):
+    # An engine that loses its EV_PROTO taps makes every replay
+    # vacuously green -- that is a finding, not a pass.
+    root = _seed(tmp_path)
+    p = root / "starway_tpu" / "core" / "conn.py"
+    text = p.read_text()
+    assert "EV_PROTO" in text
+    p.write_text(text.replace("swtrace.EV_PROTO", "swtrace.EV_CONN_UP"))
+    _assert_caught(root, "refine", "taps are gone", "conn.py")
+
+
+def test_refine_engine_transition_mutation_turns_gate_red(tmp_path):
+    """The refinement gap itself (ISSUE 15): remove one dispatch arm from
+    BOTH engines consistently -- protomodel stays green (the machines
+    still agree), but the pinned event history replays red: the model no
+    longer matches the histories real engines produced."""
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py",
+          "elif ftype == frames.T_BYE:", "elif ftype == 0xEE:")
+    _edit(root, "native/sw_engine.cpp",
+          "        // swcheck: state(estab, BYE, estab|expired)\n", "")
+    assert _findings(root, "proto-state") == []  # still two equal machines
+    _assert_caught(root, "refine", "session-bye-then-eof", "refine_corpus.txt")
+
+
+def test_refine_corpus_floor_and_malformed_lines(tmp_path):
+    # A truncated or garbled corpus is itself a finding, never a silent
+    # skip (the seeded tree's own corpus shadows the checked-in one).
+    root = _seed(tmp_path)
+    adir = root / "starway_tpu" / "analysis"
+    adir.mkdir(parents=True, exist_ok=True)
+    (adir / "refine_corpus.txt").write_text(
+        "# truncated corpus\n"
+        "only-case | ok | st:estab rx:HELLO\n"
+        "garbled line without pipes\n"
+        "bad-expect | violation:made-up | st:estab\n")
+    _assert_caught(root, "refine", "below the", "refine_corpus.txt")
+    _assert_caught(root, "refine", "malformed corpus", "refine_corpus.txt")
+    _assert_caught(root, "refine", "not `ok` or a known violation class",
+                   "refine_corpus.txt")
+
+
+def test_refine_expectation_flip_seeded(tmp_path):
+    # A pinned-ok history that starts violating (or vice versa) is the
+    # core regression signal: model and history must move together.
+    root = _seed(tmp_path)
+    adir = root / "starway_tpu" / "analysis"
+    adir.mkdir(parents=True, exist_ok=True)
+    real = (REPO / "starway_tpu" / "analysis" / "refine_corpus.txt").read_text()
+    (adir / "refine_corpus.txt").write_text(real.replace(
+        "viol-resume-from-estab | violation:no-transition |",
+        "viol-resume-from-estab | ok |", 1))
+    _assert_caught(root, "refine", "viol-resume-from-estab",
+                   "refine_corpus.txt")
+
+
+def test_refine_unwitnessed_transition_seeded(tmp_path):
+    # monitor-coverage: drop the corpus cases that witness (estab, SNACK)
+    # (padding to stay above the floor) -- the unwitnessed transition
+    # must be named.
+    root = _seed(tmp_path)
+    adir = root / "starway_tpu" / "analysis"
+    adir.mkdir(parents=True, exist_ok=True)
+    real = (REPO / "starway_tpu" / "analysis" / "refine_corpus.txt").read_text()
+    kept = [ln for ln in real.splitlines()
+            if "rx:SNACK" not in ln]
+    kept += [f"pad-{i} | ok | st:estab rx:HELLO rx:DATA down"
+             for i in range(4)]
+    (adir / "refine_corpus.txt").write_text("\n".join(kept) + "\n")
+    _assert_caught(root, "monitor-coverage", "(estab, SNACK)",
+                   "refine_corpus.txt")
+
+
+def test_refine_coverage_waiver(tmp_path):
+    # The shadow corpus's own line-1 waiver suppresses the coverage
+    # finding -- the new rules are ordinary --rules waiver targets.
+    root = _seed(tmp_path)
+    adir = root / "starway_tpu" / "analysis"
+    adir.mkdir(parents=True, exist_ok=True)
+    real = (REPO / "starway_tpu" / "analysis" / "refine_corpus.txt").read_text()
+    kept = [ln for ln in real.splitlines() if "rx:SNACK" not in ln]
+    kept += [f"pad-{i} | ok | st:estab rx:HELLO rx:DATA down"
+             for i in range(4)]
+    (adir / "refine_corpus.txt").write_text(
+        f"{_SWA}(monitor-coverage): exercising the waiver path\n"
+        + "\n".join(kept) + "\n")
+    assert _findings(root, "monitor-coverage") == []
     assert _findings(root, "bad-waiver") == []
