@@ -239,6 +239,7 @@ const char* kCounterNames[] = {
     "sends_parked",      "sheds",
     "csum_fail",         "chunk_retx",
     "reshard_bytes",     "reshard_rounds",
+    "io_syscalls",       "hot_copies",
 };
 
 // swscope per-conn gauge vocabulary, same order as the values rendered by
@@ -276,6 +277,10 @@ struct Counters {
   // §20 swshard schedule accounting: wrapper-owned (the executor runs
   // above the workers), overlaid at snapshot time like staging_*.
   std::atomic<uint64_t> reshard_bytes{0}, reshard_rounds{0};
+  // §23 swcost runtime twin: the dynamic shadow of the static ledger
+  // (analysis/cost_budgets.txt).  Unconditional relaxed increments at
+  // the data-plane syscall/copy sites -- zero branches on the seed path.
+  std::atomic<uint64_t> io_syscalls{0}, hot_copies{0};
 };
 
 inline void bump(std::atomic<uint64_t>& c, uint64_t n = 1) {
@@ -3568,9 +3573,13 @@ struct Worker {
       // 0 = ring full; kick_tx signals the peer with a starving doorbell
       // and its reply (after draining) re-enters kick_tx.
       ssize_t w = (ssize_t)c->sm_tx.write(p, n);
-      if (w > 0) bump(counters.bytes_tx, (uint64_t)w);
+      if (w > 0) {
+        bump(counters.bytes_tx, (uint64_t)w);
+        bump(counters.hot_copies);  // §23 sm ring put (one slot memcpy)
+      }
       return w;
     }
+    bump(counters.io_syscalls);  // §23 runtime cost twin
     ssize_t w = ::send(c->fd, p, n, MSG_NOSIGNAL);
     if (w < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
@@ -3586,6 +3595,7 @@ struct Worker {
       if (c->db_out.find((char)val) == std::string::npos) c->db_out.push_back((char)val);
       return;
     }
+    bump(counters.io_syscalls);  // §23 runtime cost twin
     ssize_t w = ::send(c->fd, &val, 1, MSG_NOSIGNAL | MSG_DONTWAIT);
     if (w == 1) return;
     if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
@@ -3604,6 +3614,7 @@ struct Worker {
   // EPOLLOUT: flush queued doorbell bytes, then retry the tx queue.
   void conn_writable(Conn* c, FireList& fires) {
     while (!c->db_out.empty()) {
+      bump(counters.io_syscalls);  // §23 runtime cost twin
       ssize_t w = ::send(c->fd, c->db_out.data(), c->db_out.size(),
                          MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w > 0) {
@@ -3664,6 +3675,7 @@ struct Worker {
     msghdr msg{};
     msg.msg_iov = iov;
     msg.msg_iovlen = (size_t)niov;
+    bump(counters.io_syscalls);  // §23 runtime cost twin
     ssize_t w = ::sendmsg(c->fd, &msg, MSG_NOSIGNAL);
     if (w < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
@@ -3878,9 +3890,11 @@ struct Worker {
       if (n > 0) {
         c->last_rx = Clock::now();
         bump(counters.bytes_rx, (uint64_t)n);
+        bump(counters.hot_copies);  // §23 sm ring take (one slot memcpy)
       }
       return n;
     }
+    bump(counters.io_syscalls);  // §23 runtime cost twin
     ssize_t r = ::recv(c->fd, dst, want, 0);
     if (r > 0) {
       c->last_rx = Clock::now();
@@ -3904,6 +3918,7 @@ struct Worker {
     bool eof = false, starving = false;
     for (;;) {
       char buf[4096];
+      bump(counters.io_syscalls);  // §23 runtime cost twin
       ssize_t r = ::recv(c->fd, buf, sizeof(buf), 0);
       if (r > 0) {
         c->last_rx = Clock::now();  // doorbell bytes are proof of life
@@ -5761,7 +5776,7 @@ extern "C" {
 //    zero-length striped chunks are protocol violations, T_CSUM prefix
 //    truncates to the 32-bit CRC) + the sw_wire_decode differential
 //    harness -- DESIGN.md §21
-const char* sw_version() { return "starway-native-11"; }
+const char* sw_version() { return "starway-native-12"; }
 
 // Portable cursor atomics for the Python engine's sm ring (sw_engine.h).
 // std::atomic_ref would be C++20-tidy but libstdc++'s needs alignment UB
@@ -6128,6 +6143,7 @@ int sw_counters(void* h, char* out, int cap) {
       c.sends_parked.load(),   c.sheds.load(),
       c.csum_fail.load(),      c.chunk_retx.load(),
       c.reshard_bytes.load(),  c.reshard_rounds.load(),
+      c.io_syscalls.load(),    c.hot_copies.load(),
   };
   constexpr size_t kN = sizeof(kCounterNames) / sizeof(kCounterNames[0]);
   static_assert(sizeof(vals) / sizeof(vals[0]) == kN,

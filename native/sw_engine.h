@@ -87,7 +87,7 @@ typedef void (*sw_event_cb)(void* ctx, const char* event, uint64_t conn_id);
  * below is machine-checked against the sw_engine.cpp implementation by
  * the contract checker (python -m starway_tpu.analysis, rule
  * contract-version) -- bump BOTH when the protocol changes.
- * swcheck: engine-version "starway-native-11" */
+ * swcheck: engine-version "starway-native-12" */
 const char* sw_version(void);
 
 /* Allocate a client/server worker in the VOID state.  `worker_id` is the

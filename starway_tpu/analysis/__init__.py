@@ -1,6 +1,6 @@
 """swcheck + swproof + swcompose: static cross-engine contract checking.
 
-``python -m starway_tpu.analysis`` runs ten passes and exits non-zero
+``python -m starway_tpu.analysis`` runs twelve passes and exits non-zero
 on any finding (the CI merge gate; also step 1 of
 scripts/release_smoke.sh):
 
@@ -45,6 +45,13 @@ scripts/release_smoke.sh):
   transition coverage (every model arm witnessed by a pinned run or a
   justified waiver).  ``refine --replay <dump>`` replays any swtrace
   ring/flight dump through the same monitor.
+* **cost** -- swcost hot-path cost certification (DESIGN.md §23): a
+  per-contract-path ``{syscalls, copies, allocs, locks}`` site vector
+  extracted from BOTH engines and ratcheted against the checked-in
+  ``analysis/cost_budgets.txt`` ledger (over OR under a pin is a
+  finding), plus liveness of the ``io_syscalls``/``hot_copies``
+  runtime twin the tests/test_cost.py conformance check rides on.
+  ``cost --write-budgets`` re-pins the ledger from head.
 
 Waivers: a finding is suppressed by an explicit justified comment on (or
 directly above) the flagged line::
@@ -61,8 +68,8 @@ import time
 from pathlib import Path
 from typing import Iterable, Optional
 
-from . import (compose, concurrency, contract, explore, hotpath, layering,
-               markers, protomodel, refine, taint, wirefuzz)
+from . import (compose, concurrency, contract, cost, explore, hotpath,
+               layering, markers, protomodel, refine, taint, wirefuzz)
 from .base import (  # noqa: F401  (re-exported for tests and tooling)
     RULES,
     Finding,
@@ -88,6 +95,7 @@ PASSES = {
     "wirefuzz": wirefuzz.run,
     "taint": taint.run,
     "refine": refine.run,
+    "cost": cost.run,
 }
 
 

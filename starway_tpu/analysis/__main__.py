@@ -37,6 +37,15 @@ def main(argv=None) -> int:
                              "JSON through the protocol monitor and report "
                              "divergences (DESIGN.md §22); implies the "
                              "refine pass only")
+    parser.add_argument("--write-budgets", action="store_true",
+                        help="swcost: re-pin analysis/cost_budgets.txt from "
+                             "the current extraction (the ratchet update "
+                             "step; DESIGN.md §23) and exit")
+    parser.add_argument("--minimize", action="store_true",
+                        help="wirefuzz: dedup the regression corpus by "
+                             "canonical-outcome signature (keeps every "
+                             "pinned hex case and the corpus floor), "
+                             "rewrite it in place, and exit")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit findings + timings as one JSON document "
                              "on stdout (exit status semantics unchanged)")
@@ -79,6 +88,32 @@ def main(argv=None) -> int:
     if unknown:
         parser.error(f"unknown pass(es) {unknown}; choose from "
                      f"{', '.join(PASSES)}")
+
+    if args.write_budgets:
+        from . import cost
+
+        root = find_root(args.root)
+        vectors, vac = cost.extract(root)
+        if vac:
+            for f in vac:
+                print(f.render())
+            print("swcost: extraction is not clean; fix the anchors "
+                  "before re-pinning", file=sys.stderr)
+            return 1
+        path = root / cost.LEDGER_REL
+        path.write_text(cost.render_ledger(vectors))
+        print(f"swcost: wrote {path} ({len(vectors)} rows)", file=sys.stderr)
+        return 0
+
+    if args.minimize:
+        from . import wirefuzz
+
+        root = find_root(args.root)
+        report = wirefuzz.minimize_corpus(root)
+        print("wirefuzz: corpus {path}: {before} -> {after} case(s) "
+              "({hex_kept} pinned hex case(s) kept, floor {floor})"
+              .format(**report), file=sys.stderr)
+        return 0
 
     root = find_root(args.root)
     timings: dict = {}

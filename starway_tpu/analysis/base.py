@@ -48,6 +48,13 @@ RULES = {
               "monitor compiled from the engines' own state machines",
     "monitor-coverage": "a protocol-model transition no pinned run ever "
                         "witnesses (stale model arm or dead code)",
+    "cost-budget": "hot-path cost vector drifted from its "
+                   "analysis/cost_budgets.txt pin (over = regression; "
+                   "under = lower the pin to ratchet it in)",
+    "cost-model": "swcost extraction stale: an anchor function, rx arm, "
+                  "ledger row, or runtime-twin counter site is gone",
+    "cost-site": "hot-path syscall/copy/alloc/lock site excluded from the "
+                 "swcost ledger (waiver target; counted otherwise)",
     "layering-jax": "jax imported under core/ (device.py owns that boundary)",
     "layering-reshard": "reshard/-above-core/ boundary crossed (core/ "
                         "imports reshard, or jax bound outside reshard/api.py)",
@@ -247,6 +254,8 @@ def waiver_audit_files(root: Path) -> list[Path]:
         root / "starway_tpu" / "errors.py",
         root / "native" / "sw_engine.h",
         root / "native" / "sw_engine.cpp",
+        # The swcost ledger carries in-place cost-budget waivers.
+        root / "starway_tpu" / "analysis" / "cost_budgets.txt",
     ]
     extra += [root / rel_ for rel_ in LINT_EXTRA_FILES]
     extra += sorted((root / "starway_tpu").glob("*.py"))

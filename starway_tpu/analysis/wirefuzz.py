@@ -816,6 +816,89 @@ def fuzz(root: Path, tbl: Tables, out: list,
     return counts
 
 
+def minimize_corpus(root: Path) -> dict:
+    """Dedup the regression corpus in place by canonical-outcome
+    signature (``(mode, grammar-oracle outcome)``): the oracle IS the
+    contract, so two seeds it maps to the same outcome exercise the same
+    decode behaviour and one suffices.  Every ``hex`` case is a pinned
+    divergence (each carries its ``# why`` note) and is always kept --
+    their outcomes also seed the duplicate set, so a generator seed
+    shadowing a pin drops.  Comment lines survive verbatim, and if
+    dedup would shrink the corpus below the CORPUS_FLOOR replay floor,
+    dropped seeds are padded back (first-dropped first) under a marker
+    comment.  Returns a summary dict for the CLI."""
+    out: list = []
+    got = _extract_tables(root, out)
+    if got is None or out:
+        raise SystemExit(
+            "wirefuzz: cannot minimize -- grammar extraction failed:\n"
+            + "\n".join(f.render() for f in out))
+    tbl, _sets = got
+    path = corpus_path(root)
+    lines = path.read_text().splitlines()
+
+    def signature(mode: str, data: bytes) -> tuple:
+        if mode == "smrec":
+            return (mode, _outcome(oracle_recs, tbl, data))
+        return (mode, _outcome(oracle_stream, tbl, data, mode == "csum"))
+
+    parsed = []
+    for line in lines:
+        s = line.strip()
+        kind = mode = tok = None
+        if s and not s.startswith("#"):
+            parts = s.split(None, 2)
+            if len(parts) >= 3 and parts[0] in ("seed", "hex") \
+                    and parts[1] in MODES:
+                kind, mode, tok = parts[0], parts[1], parts[2].split()[0]
+        parsed.append((line, kind, mode, tok))
+
+    seen: set = set()
+    for _, kind, mode, tok in parsed:
+        if kind == "hex":
+            try:
+                seen.add(signature(
+                    mode, b"" if tok == "-" else bytes.fromhex(tok)))
+            except ValueError:
+                pass  # load_corpus flags malformed pins; keep them as-is
+
+    kept: list = []
+    dropped: list = []
+    before = after = hex_kept = 0
+    for line, kind, mode, tok in parsed:
+        if kind is None:
+            kept.append(line)
+            continue
+        before += 1
+        if kind == "hex":
+            hex_kept += 1
+            kept.append(line)
+            after += 1
+            continue
+        try:
+            key = signature(mode, gen_case(tbl, mode, int(tok)))
+        except Exception:
+            kept.append(line)  # unparseable seed: a finding, not a drop
+            after += 1
+            continue
+        if key in seen:
+            dropped.append(line)
+        else:
+            seen.add(key)
+            kept.append(line)
+            after += 1
+    if after < CORPUS_FLOOR and dropped:
+        refill = dropped[:CORPUS_FLOOR - after]
+        kept.append("# floor padding: outcome-duplicate seeds retained to "
+                    f"keep the corpus at the {CORPUS_FLOOR}-case replay "
+                    "floor")
+        kept.extend(refill)
+        after += len(refill)
+    path.write_text("\n".join(kept) + "\n")
+    return {"path": str(path), "before": before, "after": after,
+            "hex_kept": hex_kept, "floor": CORPUS_FLOOR}
+
+
 def run(root: Path) -> list:
     out: list = []
     got = _extract_tables(root, out)

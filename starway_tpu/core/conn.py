@@ -576,6 +576,7 @@ class TcpConn(BaseConn):
             if val not in self._db_out:
                 self._db_out.extend(b)
             return
+        self._ctr.io_syscalls += 1  # §23 runtime cost twin
         try:
             self.sock.send(b)
         except BlockingIOError:
@@ -589,6 +590,7 @@ class TcpConn(BaseConn):
     def on_writable(self, fires: list) -> None:
         """EPOLLOUT: flush queued doorbell bytes first, then the tx queue."""
         while self._db_out:
+            self._ctr.io_syscalls += 1  # §23 runtime cost twin
             try:
                 n = self.sock.send(self._db_out)
             except BlockingIOError:
@@ -616,6 +618,7 @@ class TcpConn(BaseConn):
         it cannot take any (socket buffer / ring full)."""
         t0 = time.perf_counter()
         if not self._tx_via_ring:
+            self._ctr.io_syscalls += 1  # §23 runtime cost twin
             n = self.sock.send(chunk)
             if n:
                 self._ctr.bytes_tx += n
@@ -630,6 +633,7 @@ class TcpConn(BaseConn):
             # race-free even though pure Python cannot fence (shmring.py).
             raise BlockingIOError
         self._ctr.bytes_tx += n
+        self._ctr.hot_copies += 1  # §23 sm ring put (one slot copy)
         perf.record_stage("tx", time.perf_counter() - t0, n, self._scope)
         return n
 
@@ -1476,6 +1480,7 @@ class TcpConn(BaseConn):
                 if not views:
                     break
                 tw0 = time.perf_counter()
+                self._ctr.io_syscalls += 1  # §23 runtime cost twin
                 try:
                     n = self.sock.sendmsg(views)
                 except BlockingIOError:
@@ -1589,8 +1594,10 @@ class TcpConn(BaseConn):
                 raise BlockingIOError
             self.last_rx = time.monotonic()
             self._ctr.bytes_rx += n
+            self._ctr.hot_copies += 1  # §23 sm ring take (one slot copy)
             perf.record_stage("rx", time.perf_counter() - t0, n, self._scope)
             return n
+        self._ctr.io_syscalls += 1  # §23 runtime cost twin
         n = self.sock.recv_into(target)
         if n:
             self.last_rx = time.monotonic()
@@ -1610,6 +1617,7 @@ class TcpConn(BaseConn):
         eof = False
         starving = False
         while True:
+            self._ctr.io_syscalls += 1  # §23 runtime cost twin
             try:
                 b = self.sock.recv(4096)
             except BlockingIOError:

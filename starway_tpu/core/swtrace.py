@@ -139,6 +139,10 @@ COUNTER_NAMES = (
     #                       (process-global: the executor runs above the
     #                       workers, like the staging pool does)
     "reshard_rounds",     # §20 swshard schedule rounds executed
+    "io_syscalls",        # §23 hot-path I/O syscalls issued
+    #                       (send/sendmsg/recv/recv_into on the data path)
+    "hot_copies",         # §23 hot-path payload byte-copies (sm ring
+    #                       put/take; the tcp data path is copy-free)
 )
 
 

@@ -226,8 +226,8 @@ def test_garbled_doc_table(tmp_path):
 def test_version_drift(tmp_path):
     root = _seed(tmp_path)
     _edit(root, "native/sw_engine.cpp",
-          'return "starway-native-11"', 'return "starway-native-12"')
-    _assert_caught(root, "contract-version", "starway-native-12", "sw_engine.h")
+          'return "starway-native-12"', 'return "starway-native-13"')
+    _assert_caught(root, "contract-version", "starway-native-13", "sw_engine.h")
 
 
 def test_unmarked_multi_gib_test(tmp_path):
@@ -1810,4 +1810,182 @@ def test_refine_coverage_waiver(tmp_path):
         f"{_SWA}(monitor-coverage): exercising the waiver path\n"
         + "\n".join(kept) + "\n")
     assert _findings(root, "monitor-coverage") == []
+    assert _findings(root, "bad-waiver") == []
+
+
+# --------------------------------------------- swcost (DESIGN.md §23)
+
+_GATHER_ANCHOR = "views, spans = self._gather_tx()"
+_SENDMSG_ANCHOR = "ssize_t w = ::sendmsg(c->fd, &msg, MSG_NOSIGNAL);"
+
+
+def _shadow_ledger(root: Path) -> Path:
+    """Give the seeded tree its own cost_budgets.txt (ledger_path prefers
+    the tree copy over the package fallback, wirefuzz-corpus style)."""
+    adir = root / "starway_tpu" / "analysis"
+    adir.mkdir(parents=True, exist_ok=True)
+    dst = adir / "cost_budgets.txt"
+    dst.write_text(
+        (REPO / "starway_tpu" / "analysis" / "cost_budgets.txt").read_text())
+    return dst
+
+
+def test_swcost_rules_registered():
+    # The three new finding codes are waiver targets (--rules) and
+    # render as problem-matcher rows like every pass.
+    for rule in ("cost-budget", "cost-model", "cost-site"):
+        assert rule in analysis.RULES, rule
+
+
+def test_cost_py_syscall_regression_seeded(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py",
+          "n = self.sock.sendmsg(views)",
+          "n = self.sock.sendmsg(views) + self.sock.send(b\"\")")
+    _assert_caught(root, "cost-budget", "py eager_tx syscalls",
+                   "cost_budgets.txt")
+
+
+def test_cost_py_copy_regression_seeded(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py", _GATHER_ANCHOR,
+          _GATHER_ANCHOR + "\n                junk = b\"\".join(views)")
+    _assert_caught(root, "cost-budget", "py eager_tx copies",
+                   "cost_budgets.txt")
+
+
+def test_cost_py_alloc_regression_seeded(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py", _GATHER_ANCHOR,
+          _GATHER_ANCHOR + "\n                junk = bytearray(4096)")
+    _assert_caught(root, "cost-budget", "py eager_tx allocs",
+                   "cost_budgets.txt")
+
+
+def test_cost_py_lock_regression_seeded(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py", _GATHER_ANCHOR,
+          _GATHER_ANCHOR + "\n                self.worker._lock.acquire()")
+    _assert_caught(root, "cost-budget", "py eager_tx locks",
+                   "cost_budgets.txt")
+
+
+def test_cost_cpp_syscall_regression_seeded(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp", _SENDMSG_ANCHOR,
+          "::send(c->fd, \"\", 0, 0);\n    " + _SENDMSG_ANCHOR)
+    _assert_caught(root, "cost-budget", "cpp eager_tx syscalls",
+                   "cost_budgets.txt")
+
+
+def test_cost_cpp_copy_regression_seeded(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp", _SENDMSG_ANCHOR,
+          "memcpy(iov, iov, 0);\n    " + _SENDMSG_ANCHOR)
+    _assert_caught(root, "cost-budget", "cpp eager_tx copies",
+                   "cost_budgets.txt")
+
+
+def test_cost_cpp_alloc_regression_seeded(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp", _SENDMSG_ANCHOR,
+          "void* zz = malloc(1);\n    " + _SENDMSG_ANCHOR)
+    _assert_caught(root, "cost-budget", "cpp eager_tx allocs",
+                   "cost_budgets.txt")
+
+
+def test_cost_cpp_lock_regression_seeded(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp", _SENDMSG_ANCHOR,
+          "std::lock_guard<std::mutex> zz(gather_mu);\n    "
+          + _SENDMSG_ANCHOR)
+    _assert_caught(root, "cost-budget", "cpp eager_tx locks",
+                   "cost_budgets.txt")
+
+
+def test_cost_ratchet_fires_on_improvement(tmp_path):
+    # BEATING a pin is also red until the ledger is lowered: raise the
+    # py eager_tx syscalls pin above the measured value and the gate
+    # must demand the ratchet, not silently accept the slack.
+    root = _seed(tmp_path)
+    led = _shadow_ledger(root)
+    led.write_text(led.read_text().replace(
+        "py  eager_tx    syscalls  1", "py  eager_tx    syscalls  3", 1))
+    _assert_caught(root, "cost-budget", "beats the pinned budget",
+                   "cost_budgets.txt")
+
+
+def test_cost_ledger_malformed_and_unknown_rows(tmp_path):
+    root = _seed(tmp_path)
+    led = _shadow_ledger(root)
+    led.write_text(led.read_text()
+                   + "py eager_tx syscalls noninteger\n"
+                   + "py warp_tx syscalls 1\n")
+    _assert_caught(root, "cost-model", "malformed ledger row",
+                   "cost_budgets.txt")
+    _assert_caught(root, "cost-model", "unknown surface",
+                   "cost_budgets.txt")
+
+
+def test_cost_ledger_missing_row(tmp_path):
+    root = _seed(tmp_path)
+    led = _shadow_ledger(root)
+    led.write_text(led.read_text().replace(
+        "py  eager_tx    syscalls  1\n", "", 1))
+    _assert_caught(root, "cost-model", "no ledger row for py eager_tx",
+                   "cost_budgets.txt")
+
+
+def test_cost_refuses_vacuity_when_anchor_renamed(tmp_path):
+    # A hot-path anchor disappearing must be loud (cost-model), never a
+    # silently-zero vector ratified by the ledger.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py",
+          "def kick_tx(", "def kick_tx_v2(")
+    _assert_caught(root, "cost-model", "kick_tx", "conn.py")
+
+
+def test_cost_refuses_vacuity_when_cpp_pump_arm_gone(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          "if (c->rx_skip)", "if (c->rx_skip2)")
+    _assert_caught(root, "cost-model", "pump_frames rx arms",
+                   "sw_engine.cpp")
+
+
+def test_cost_instrumentation_removed_seeded(tmp_path):
+    # Deleting the §23 runtime twin turns the gate red even though no
+    # static site count moved: the dynamic conformance test would be
+    # vacuous without the counters.
+    root = _seed(tmp_path)
+    p = root / "native" / "sw_engine.cpp"
+    text = p.read_text()
+    assert "bump(counters.io_syscalls" in text
+    p.write_text(text.replace("bump(counters.io_syscalls",
+                              "bump(counters.bytes_tx_shadow"))
+    _assert_caught(root, "cost-model", "runtime cost twin dark",
+                   "sw_engine.cpp")
+
+
+def test_cost_site_waiver_excludes_site(tmp_path):
+    # A justified cost-site waiver on the new site's own line excludes
+    # it at extraction time: the ledger pin holds and the gate is green.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/conn.py", _GATHER_ANCHOR,
+          _GATHER_ANCHOR + "\n                junk = b\"\".join(views)"
+          f"  {_SWA}(cost-site): exercising the waiver path")
+    assert _findings(root, "cost-budget") == []
+    assert _findings(root, "bad-waiver") == []
+
+
+def test_cost_budget_waiver_on_ledger_row(tmp_path):
+    # cost-budget findings anchor to the ledger row, so the in-place
+    # waiver discipline works there like any source line.
+    root = _seed(tmp_path)
+    led = _shadow_ledger(root)
+    led.write_text(led.read_text().replace(
+        "py  eager_tx    syscalls  1",
+        "py  eager_tx    syscalls  3  "
+        f"{_SWA}(cost-budget): exercising the waiver path", 1))
+    assert _findings(root, "cost-budget") == []
     assert _findings(root, "bad-waiver") == []

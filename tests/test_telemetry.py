@@ -318,3 +318,49 @@ async def test_flight_dump_embeds_telemetry_trend(port, monkeypatch,
         await client.aclose()
         await server.aclose()
         proxy.stop()
+
+
+# -------------------------------------- bench --metrics -> metrics --once
+
+
+async def test_bench_metrics_file_renders_with_metrics_once(tmp_path,
+                                                            capsys):
+    """The documented loop closes end-to-end: ``python -m
+    starway_tpu.bench --metrics out.jsonl`` produces a file the
+    ``python -m starway_tpu.metrics <path> --once`` viewer accepts --
+    the script-facing surface CLAUDE.md documents, previously covered
+    only for sampler-written files."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    from starway_tpu import metrics as metrics_mod
+
+    out = tmp_path / "bench_metrics.jsonl"
+    report_path = tmp_path / "bench_report.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("STARWAY_METRICS_PATH", None)
+    env.pop("STARWAY_METRICS_INTERVAL", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "starway_tpu.bench", "--role", "loopback",
+         "--scenarios", "pingpong-flag", "--flag-iterations", "8",
+         "--flag-warmup", "2", "--metrics", str(out),
+         "--output", str(report_path)],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=str(Path(__file__).resolve().parents[1]))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(report_path.read_text())
+    assert report["metrics"] == str(out)
+    assert "telemetry" in report, sorted(report)
+
+    lines = [json.loads(l) for l in out.read_text().splitlines()
+             if l.strip()]
+    assert lines, "bench --metrics wrote no samples"
+    assert all("workers" in s and "mono" in s for s in lines)
+
+    rc = metrics_mod.main([str(out), "--once"])
+    assert rc == 0
+    printed = capsys.readouterr().out
+    assert f"{len(lines)} sample(s)" in printed
