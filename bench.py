@@ -151,6 +151,13 @@ def _stage_summary() -> str:
     return ",".join(parts) if parts else "none"
 
 
+def _active_levers() -> list:
+    """§24 swfast levers armed via env for this process ([] = seed)."""
+    from starway_tpu.bench import active_levers
+
+    return active_levers()
+
+
 def main() -> None:
     import jax
 
@@ -188,6 +195,9 @@ def main() -> None:
                 # Structured fallback flag so trajectory tooling can filter
                 # CPU-FALLBACK rows without parsing the metric string.
                 "fallback": cpu_fallback,
+                # §24: swfast levers armed via env for this run ([] = seed
+                # data path) -- rows are self-describing from BENCH_r06 on.
+                "levers": _active_levers(),
             }
         )
     )

@@ -21,6 +21,7 @@
 
 #include <arpa/inet.h>
 #include <fcntl.h>
+#include <linux/errqueue.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
@@ -30,10 +31,37 @@
 #include <sys/random.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
 #include <sys/uio.h>
 #include <unistd.h>
 #if defined(__aarch64__)
 #include <sys/auxv.h>
+#endif
+
+// DESIGN.md §24 swfast: the io_uring lever is compiled from the raw
+// kernel uapi header (the image carries no liburing); kernels or build
+// environments without it degrade to the epoll core at compile time,
+// and a failed runtime probe degrades at worker start.
+#if defined(__linux__) && __has_include(<linux/io_uring.h>) && \
+    defined(__NR_io_uring_setup)
+#include <linux/io_uring.h>
+#define SW_HAVE_IOURING 1
+#else
+#define SW_HAVE_IOURING 0
+#endif
+
+// MSG_ZEROCOPY shipped in 4.14 but some libc headers lag the kernel.
+#ifndef SO_ZEROCOPY
+#define SO_ZEROCOPY 60
+#endif
+#ifndef MSG_ZEROCOPY
+#define MSG_ZEROCOPY 0x4000000
+#endif
+#ifndef SO_EE_ORIGIN_ZEROCOPY
+#define SO_EE_ORIGIN_ZEROCOPY 5
+#endif
+#ifndef SO_EE_CODE_ZEROCOPY_COPIED
+#define SO_EE_CODE_ZEROCOPY_COPIED 1
 #endif
 
 #include <algorithm>
@@ -240,6 +268,9 @@ const char* kCounterNames[] = {
     "csum_fail",         "chunk_retx",
     "reshard_bytes",     "reshard_rounds",
     "io_syscalls",       "hot_copies",
+    "uring_submits",     "uring_sqes",
+    "zc_sends",          "zc_notifies",
+    "busypoll_hits",
 };
 
 // swscope per-conn gauge vocabulary, same order as the values rendered by
@@ -255,7 +286,7 @@ const char* kGaugeNames[] = {
     "journal_bytes",   "journal_frames",
     "stripe_pending",
     "unexp_bytes",     "credits_avail",
-    "retx_pending",
+    "retx_pending",    "zc_pending",
 };
 
 struct Counters {
@@ -281,6 +312,13 @@ struct Counters {
   // (analysis/cost_budgets.txt).  Unconditional relaxed increments at
   // the data-plane syscall/copy sites -- zero branches on the seed path.
   std::atomic<uint64_t> io_syscalls{0}, hot_copies{0};
+  // §24 swfast levers (native-only; the Python engine declares the same
+  // names for vocabulary parity and leaves them 0, like staging_* here).
+  // zc_notifies counts every errqueue completion, including the ones the
+  // kernel flagged SO_EE_CODE_ZEROCOPY_COPIED (fell back to a copy).
+  std::atomic<uint64_t> uring_submits{0}, uring_sqes{0};
+  std::atomic<uint64_t> zc_sends{0}, zc_notifies{0};
+  std::atomic<uint64_t> busypoll_hits{0};
 };
 
 inline void bump(std::atomic<uint64_t>& c, uint64_t n = 1) {
@@ -431,6 +469,187 @@ double session_grace_env() {
   double s = e ? strtod(e, nullptr) : 0.0;
   return s > 0 ? s : 30.0;
 }
+
+// ------------------------------------------------- swfast (DESIGN.md §24)
+// Three independently-gated opt-in levers on the native data path.  All
+// are sampled ONCE per worker at engine-thread start: they are process-
+// local accelerations with no wire/HELLO surface, so (unlike
+// rndv_threshold) the two peers never need to agree on them.
+
+bool iouring_enabled() {
+  const char* e = getenv("STARWAY_IOURING");
+  return e && *e && strcmp(e, "0") != 0;
+}
+
+bool zerocopy_enabled() {
+  const char* e = getenv("STARWAY_ZEROCOPY");
+  return e && *e && strcmp(e, "0") != 0;
+}
+
+uint64_t busypoll_us_env() {
+  const char* e = getenv("STARWAY_BUSYPOLL_US");
+  uint64_t v = e ? strtoull(e, nullptr, 10) : 0;
+  // Bound the spin budget: this is a latency lever, not a license to
+  // burn a core for seconds (the reference's 100%-spin made safe).
+  return v > 1000000 ? 1000000 : v;
+}
+
+#if SW_HAVE_IOURING
+// Raw-syscall shims (no liburing in the image).  Named after the
+// syscalls so the §23 cost extractor classifies their call sites.
+int io_uring_setup(unsigned entries, struct io_uring_params* p) {
+  return (int)syscall(__NR_io_uring_setup, entries, p);
+}
+
+int io_uring_enter(int fd, unsigned to_submit, unsigned min_complete,
+                   unsigned flags) {
+  return (int)syscall(__NR_io_uring_enter, fd, to_submit, min_complete, flags,
+                      nullptr, 0);
+}
+#endif
+
+// Minimal single-threaded io_uring wrapper: SQ/CQ rings mapped once per
+// worker, used in a strictly synchronous batch model (submit N, wait N)
+// so every buffer an SQE references lives on the submitting frame's
+// stack/queue and the conn-state machine is identical to the epoll
+// core's.  init() failing for ANY reason (old kernel, seccomp, RLIMIT)
+// just leaves ok() false and the worker on the epoll core.
+struct UringCore {
+  int ring_fd = -1;
+  unsigned sq_entries = 0;
+  unsigned* sq_head = nullptr;
+  unsigned* sq_tail = nullptr;
+  unsigned* sq_mask = nullptr;
+  unsigned* sq_array = nullptr;
+  unsigned* cq_head = nullptr;
+  unsigned* cq_tail = nullptr;
+  unsigned* cq_mask = nullptr;
+#if SW_HAVE_IOURING
+  io_uring_sqe* sqes = nullptr;
+  io_uring_cqe* cqes = nullptr;
+  void* sq_ring = nullptr;
+  void* cq_ring = nullptr;
+  size_t sq_ring_sz = 0, cq_ring_sz = 0, sqes_sz = 0;
+#endif
+
+  bool ok() const { return ring_fd >= 0; }
+
+#if SW_HAVE_IOURING
+  bool init(unsigned entries) {
+    io_uring_params p{};
+    int fd = io_uring_setup(entries, &p);
+    if (fd < 0) return false;
+    sq_ring_sz = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+    cq_ring_sz = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+    bool single = (p.features & IORING_FEAT_SINGLE_MMAP) != 0;
+    if (single) {
+      if (cq_ring_sz > sq_ring_sz) sq_ring_sz = cq_ring_sz;
+      cq_ring_sz = sq_ring_sz;
+    }
+    sq_ring = mmap(nullptr, sq_ring_sz, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQ_RING);
+    if (sq_ring == MAP_FAILED) {
+      sq_ring = nullptr;
+      close(fd);
+      return false;
+    }
+    cq_ring = single ? sq_ring
+                     : mmap(nullptr, cq_ring_sz, PROT_READ | PROT_WRITE,
+                            MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_CQ_RING);
+    if (cq_ring == MAP_FAILED) {
+      cq_ring = nullptr;
+      teardown_maps();
+      close(fd);
+      return false;
+    }
+    sqes_sz = p.sq_entries * sizeof(io_uring_sqe);
+    sqes = (io_uring_sqe*)mmap(nullptr, sqes_sz, PROT_READ | PROT_WRITE,
+                               MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQES);
+    if (sqes == MAP_FAILED) {
+      sqes = nullptr;
+      teardown_maps();
+      close(fd);
+      return false;
+    }
+    auto* sqp = (uint8_t*)sq_ring;
+    auto* cqp = (uint8_t*)cq_ring;
+    sq_head = (unsigned*)(sqp + p.sq_off.head);
+    sq_tail = (unsigned*)(sqp + p.sq_off.tail);
+    sq_mask = (unsigned*)(sqp + p.sq_off.ring_mask);
+    sq_array = (unsigned*)(sqp + p.sq_off.array);
+    cq_head = (unsigned*)(cqp + p.cq_off.head);
+    cq_tail = (unsigned*)(cqp + p.cq_off.tail);
+    cq_mask = (unsigned*)(cqp + p.cq_off.ring_mask);
+    cqes = (io_uring_cqe*)(cqp + p.cq_off.cqes);
+    sq_entries = p.sq_entries;
+    ring_fd = fd;
+    // Probe pass: one NOP through submit+reap proves io_uring_enter works
+    // under whatever sandbox/seccomp profile this process runs (SENDMSG
+    // itself is kernel 5.3+; anything older fails here, not mid-traffic).
+    io_uring_sqe* sqe = get_sqe();
+    if (!sqe) {
+      shutdown();
+      return false;
+    }
+    sqe->opcode = IORING_OP_NOP;
+    int r = io_uring_enter(ring_fd, 1, 1, IORING_ENTER_GETEVENTS);
+    bool nop_ok = false;
+    reap([&](uint64_t, int) { nop_ok = true; });
+    if (r != 1 || !nop_ok) {
+      shutdown();
+      return false;
+    }
+    return true;
+  }
+
+  // Next free SQE, zeroed, with its ring-array slot wired; caller fills
+  // and publishes via the tail store here (single-threaded: no racing
+  // producers, the kernel only reads up to the published tail).
+  io_uring_sqe* get_sqe() {
+    unsigned head = __atomic_load_n(sq_head, __ATOMIC_ACQUIRE);
+    unsigned tail = *sq_tail;
+    if (tail - head >= sq_entries) return nullptr;
+    unsigned idx = tail & *sq_mask;
+    io_uring_sqe* sqe = &sqes[idx];
+    memset(sqe, 0, sizeof(*sqe));
+    sq_array[idx] = idx;
+    __atomic_store_n(sq_tail, tail + 1, __ATOMIC_RELEASE);
+    return sqe;
+  }
+
+  template <typename F>
+  void reap(F&& f) {
+    unsigned head = *cq_head;
+    unsigned tail = __atomic_load_n(cq_tail, __ATOMIC_ACQUIRE);
+    while (head != tail) {
+      io_uring_cqe* cqe = &cqes[head & *cq_mask];
+      f(cqe->user_data, cqe->res);
+      head++;
+    }
+    __atomic_store_n(cq_head, head, __ATOMIC_RELEASE);
+  }
+
+  void teardown_maps() {
+    if (sqes) munmap(sqes, sqes_sz);
+    if (cq_ring && cq_ring != sq_ring) munmap(cq_ring, cq_ring_sz);
+    if (sq_ring) munmap(sq_ring, sq_ring_sz);
+    sqes = nullptr;
+    cq_ring = nullptr;
+    sq_ring = nullptr;
+  }
+
+  void shutdown() {
+    teardown_maps();
+    if (ring_fd >= 0) close(ring_fd);
+    ring_fd = -1;
+    sq_entries = 0;
+  }
+#else
+  // Header absent: the lever compiles out; callers are all guarded.
+  bool init(unsigned) { return false; }
+  void shutdown() {}
+#endif
+};
 
 // Multi-rail striping knobs (config.py STARWAY_RAILS / STRIPE_*;
 // DESIGN.md §17).  Read per handshake / per send like the session knobs.
@@ -1515,6 +1734,13 @@ struct TxItem {
   StripeRef stripe;
   uint64_t stripe_off = 0;    // payload offset of the current chunk
   double stripe_t0 = 0;       // claim timestamp (lane throughput EWMA)
+  // --- MSG_ZEROCOPY TX (DESIGN.md §24) ---
+  // Kernel page pins outstanding on this payload: MSG_ZEROCOPY shares
+  // the user pages with the NIC/loopback skbs, so `release` (= the user
+  // may reuse the buffer) must wait for the errqueue notification --
+  // reusing earlier would put the NEW bytes on the wire.
+  uint32_t zc_pins = 0;
+  bool zc_deferred = false;   // release requested while pins outstanding
 
   uint64_t total() const { return header.size() + paylen; }
 };
@@ -1523,8 +1749,16 @@ using TxRef = std::shared_ptr<TxItem>;
 
 // `force` overrides a session journal's payload pin (hold_release):
 // teardown paths are terminal, so the buffer is released regardless.
+// A §24 kernel zerocopy pin (zc_pins) also defers the release -- the
+// errqueue completion re-fires it -- but yields to `force` too: on
+// teardown the fd is closing, so in-flight shared pages can at worst
+// put stale bytes on a dead socket, never complete a receive.
 void fire_release(TxItem& item, FireList& fires, bool force = false) {
   if (item.is_data && item.release && (force || !item.hold_release)) {
+    if (item.zc_pins && !force) {
+      item.zc_deferred = true;
+      return;
+    }
     auto rel = item.release; auto rctx = item.release_ctx;
     item.release = nullptr;
     fires.push_back([rel, rctx] { rel(rctx); });
@@ -1564,6 +1798,14 @@ struct Conn {
   std::string local_addr, remote_addr;
   int local_port = 0, remote_port = 0;
   std::deque<TxRef> tx;
+  // §24 swfast (all dark unless the envs armed them at worker start)
+  bool in_uring_q = false;    // queued for this pass's batched submit
+  int8_t zc_state = 0;        // 0 unknown, 1 SO_ZEROCOPY armed, -1 refused
+  bool zc_skip_once = false;  // ENOBUFS fallback: next pass copies
+  uint32_t zc_next_seq = 0;   // kernel's per-socket zerocopy seq counter
+  // (seq, item) in send order; the TxRef is the real kernel-pin -- it
+  // keeps the payload (or its session snapshot) alive until notified.
+  std::deque<std::pair<uint32_t, TxRef>> zc_outstanding;
   // session layer (nullptr on seed-parity conns: every hook below is one
   // null check)
   std::unique_ptr<Session> sess;
@@ -1794,6 +2036,13 @@ struct Worker {
   Counters counters;
   TraceRing trace;
   int epfd = -1, evfd = -1;
+  // §24 swfast lever state: sampled once per worker at engine start.
+  // uring.ok() false = epoll core (the default and the probe fallback).
+  UringCore uring;
+  std::vector<Conn*> uring_q;  // conns with deferred TX this pass
+  bool zc_armed = false;
+  uint64_t zc_thresh = 0;      // rndv threshold sampled at engine start
+  uint64_t busypoll_us = 0;
   std::thread::id engine_tid{};
   std::string worker_id;
   std::deque<Op> ops;
@@ -2546,6 +2795,11 @@ struct Worker {
     }
     c->want_write = false;
     c->db_out.clear();
+    uring_unqueue(c);
+    // §24: the dead incarnation's zerocopy notifications are unreadable;
+    // drop the kernel pins.  The journal's hold_release (NOT force here)
+    // keeps the §14 pin alive until the resume replay acks.
+    zc_abandon(c, fires);
     // rx parser reset: the replayed stream restarts at a frame boundary.
     c->hdr_got = 0;
     c->ctl_type = 0;
@@ -3628,6 +3882,68 @@ struct Worker {
     kick_tx(c, fires);
   }
 
+  // §24 MSG_ZEROCOPY eligibility: an armed worker, a plain data payload
+  // at or above the rndv threshold, and a socket that accepted
+  // SO_ZEROCOPY (probed lazily, once per conn, from here -- rails,
+  // resumes, and accepts all funnel through without per-site plumbing).
+  // Striped feeders are excluded: their frames interleave with T_SNACK
+  // retransmits and refill in place, so the notification bookkeeping
+  // would pin the wrong incarnation of the feeder's payload.
+  bool zc_ready(Conn* c, const TxItem& item) {
+    if (!zc_armed || !item.is_data || item.stripe || item.paylen < zc_thresh)
+      return false;
+    if (c->zc_state == 0) {
+      int one = 1;
+      c->zc_state = setsockopt(c->fd, SOL_SOCKET, SO_ZEROCOPY, &one,
+                               sizeof(one)) == 0
+                        ? 1
+                        : -1;
+    }
+    return c->zc_state == 1;
+  }
+
+  // Record one successful MSG_ZEROCOPY submission: the kernel's
+  // per-socket notification counter increments once per zerocopy
+  // sendmsg, and the deque's TxRef keeps the payload bytes alive until
+  // zc_complete_range pops it.
+  void zc_track(Conn* c, const TxRef& ref) {
+    ref->zc_pins++;
+    c->zc_outstanding.emplace_back(c->zc_next_seq++, ref);
+    bump(counters.zc_sends);
+  }
+
+  // One MSG_ZEROCOPY payload pass for the front item (its header already
+  // left via the copying gather).  Returns like tcp_tx_gather: bytes
+  // written, 0 = socket full, -1 = conn broke.  Fallback ladder on
+  // ENOBUFS (socket optmem exhausted): retry the same slice as an
+  // ordinary copying sendmsg -- the kernel's own documented advice.
+  ssize_t zc_tx_send(Conn* c, FireList& fires) {
+    TxRef ref = c->tx.front();
+    TxItem& item = *ref;
+    uint64_t po = item.off - item.header.size();
+    uint64_t left = item.paylen - po;
+    size_t n = left > (4u << 20) ? (4u << 20) : (size_t)left;
+    struct iovec iov{(void*)(item.payload + po), n};
+    msghdr msg{};
+    msg.msg_iov = &iov;
+    msg.msg_iovlen = 1;
+    bump(counters.io_syscalls);  // §23 runtime cost twin
+    ssize_t w = ::sendmsg(c->fd, &msg, MSG_NOSIGNAL | MSG_ZEROCOPY);
+    if (w > 0) {
+      zc_track(c, ref);
+    } else if (w < 0 && errno == ENOBUFS) {
+      bump(counters.io_syscalls);  // §23 runtime cost twin
+      w = ::sendmsg(c->fd, &msg, MSG_NOSIGNAL);
+    }
+    if (w < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+      conn_broken(c, fires);
+      return -1;
+    }
+    if (w > 0) bump(counters.bytes_tx, (uint64_t)w);
+    return w;
+  }
+
   // Gather pending tx bytes across queue items into one sendmsg: small
   // messages cost one syscall (and one TCP segment) for header+payload
   // instead of two, and bursts of messages coalesce.  Returns bytes
@@ -3636,6 +3952,10 @@ struct Worker {
   // (core/conn.py): both engines batch at most 64 iovecs / 4 MiB per
   // pass and never batch bytes past the sm transport switch point --
   // keep the two pumps in lockstep when changing either.
+  // The §24 zerocopy carve-out batches a zc-eligible item's HEADER only
+  // and hands its payload to zc_tx_send on the following pass -- payload
+  // pages must ride their own sendmsg for the notification to map back
+  // to one item.
   ssize_t tcp_tx_gather(Conn* c, FireList& fires) {
     constexpr int kMaxIov = 64;
     constexpr uint64_t kMaxBytes = 4u << 20;
@@ -3645,8 +3965,17 @@ struct Worker {
     for (auto& ref : c->tx) {
       TxItem& item = *ref;
       if (niov >= kMaxIov || bytes >= kMaxBytes) break;
+      bool zc = zc_ready(c, item);
       uint64_t hlen = item.header.size();
       uint64_t off = item.off;
+      if (zc && niov == 0 && off >= hlen) {
+        if (c->zc_skip_once) {
+          c->zc_skip_once = false;
+          zc = false;  // ENOBUFS fallback: this pass copies
+        } else {
+          return zc_tx_send(c, fires);
+        }
+      }
       if (off < hlen) {
         iov[niov].iov_base = (void*)(item.header.data() + off);
         iov[niov].iov_len = (size_t)(hlen - off);
@@ -3654,6 +3983,7 @@ struct Worker {
         niov++;
         off = hlen;
       }
+      if (zc) break;  // payload goes zerocopy on the next pass
       if (niov < kMaxIov && off < item.total() && bytes < kMaxBytes) {
         uint64_t po = off - hlen;
         uint64_t left = item.paylen - po;
@@ -3725,9 +4055,73 @@ struct Worker {
     trace.rec(kEvE2e, ++c->rx_e2e, c->id, nbytes, reason);
   }
 
-  void kick_tx(Conn* c, FireList& fires) {
+  // Credit `w` freshly-written socket bytes to the queued items in order:
+  // the budget-accounting half of the TCP pump, shared verbatim by the
+  // epoll core (kick_tx below) and the §24 uring core (uring_service) so
+  // the two cores cannot drift on completion/release/switch semantics.
+  void tcp_tx_account(Conn* c, uint64_t budget, FireList& fires) {
+    while (budget > 0 && !c->tx.empty()) {
+      TxRef ref = c->tx.front();  // keep alive across the pop
+      TxItem& item = *ref;
+      uint64_t take = item.total() - item.off;
+      if (take > budget) take = budget;
+      item.off += take;
+      budget -= take;
+      if (item.stripe && take > 0)
+        stripe_first_progress(item.stripe, fires);
+      if (item.is_data && item.rndv && !item.local_done &&
+          item.off >= item.header.size()) {
+        item.local_done = true;
+        if (item.done) {
+          auto done = item.done; auto ctx = item.ctx;
+          fires.push_back([done, ctx] { done(ctx); });
+        }
+      }
+      if (item.off >= item.total()) {
+        if (item.stripe) {
+          // Chunk fully on the wire: account it and refill the
+          // feeder in place (work stealing); the gather pass
+          // stopped at the feeder, so no later item's budget is
+          // misattributed to the refilled frame.
+          stripe_tx_chunk_finished(c, item, fires);
+          if (!stripe_refill(c, *ref)) {
+            c->feeder_live = false;
+            c->tx.pop_front();
+          }
+          break;
+        }
+        if (item.is_data && !item.local_done) {
+          item.local_done = true;
+          if (item.done) {
+            auto done = item.done; auto ctx = item.ctx;
+            fires.push_back([done, ctx] { done(ctx); });
+          }
+        }
+        bool flip = item.switch_after;
+        tx_item_completed(c, item);
+        fire_release(item, fires);
+        c->tx.pop_front();
+        if (flip) {
+          // Switch point left the socket: later items ride the ring.
+          c->tx_via_ring = true;
+          break;
+        }
+      }
+    }
+  }
+
+  void kick_tx(Conn* c, FireList& fires, bool direct = false) {
     // fd < 0: session-suspended (resume re-kicks).
     if (!c->alive || c->fd < 0) return;
+    // §24 uring core: TCP-phase sends from every conn kicked this pass
+    // coalesce into one batched submit (uring_service, end of the loop
+    // pass).  Ring-mode conns stay on the memcpy transport below -- their
+    // hot path has no per-message syscall to batch.  `direct` is the
+    // service's own re-entry (and the singleton bypass), never deferred.
+    if (!direct && uring.ok() && !c->tx_via_ring) {
+      uring_queue(c);
+      return;
+    }
     uint64_t t0 = c->sm_active ? c->sm_tx.tail().load(std::memory_order_relaxed) : 0;
     bool blocked = false;
     while (!c->tx.empty() && !blocked) {
@@ -3740,55 +4134,7 @@ struct Worker {
           blocked = true;
           break;
         }
-        uint64_t budget = (uint64_t)w;
-        while (budget > 0 && !c->tx.empty()) {
-          TxRef ref = c->tx.front();  // keep alive across the pop
-          TxItem& item = *ref;
-          uint64_t take = item.total() - item.off;
-          if (take > budget) take = budget;
-          item.off += take;
-          budget -= take;
-          if (item.stripe && take > 0)
-            stripe_first_progress(item.stripe, fires);
-          if (item.is_data && item.rndv && !item.local_done &&
-              item.off >= item.header.size()) {
-            item.local_done = true;
-            if (item.done) {
-              auto done = item.done; auto ctx = item.ctx;
-              fires.push_back([done, ctx] { done(ctx); });
-            }
-          }
-          if (item.off >= item.total()) {
-            if (item.stripe) {
-              // Chunk fully on the wire: account it and refill the
-              // feeder in place (work stealing); the gather pass
-              // stopped at the feeder, so no later item's budget is
-              // misattributed to the refilled frame.
-              stripe_tx_chunk_finished(c, item, fires);
-              if (!stripe_refill(c, *ref)) {
-                c->feeder_live = false;
-                c->tx.pop_front();
-              }
-              break;
-            }
-            if (item.is_data && !item.local_done) {
-              item.local_done = true;
-              if (item.done) {
-                auto done = item.done; auto ctx = item.ctx;
-                fires.push_back([done, ctx] { done(ctx); });
-              }
-            }
-            bool flip = item.switch_after;
-            tx_item_completed(c, item);
-            fire_release(item, fires);
-            c->tx.pop_front();
-            if (flip) {
-              // Switch point left the socket: later items ride the ring.
-              c->tx_via_ring = true;
-              break;
-            }
-          }
-        }
+        tcp_tx_account(c, (uint64_t)w, fires);
         continue;
       }
       // Ring path: stream the front item chunk-by-chunk (no syscalls).
@@ -3872,6 +4218,285 @@ struct Worker {
     }
     if (c->sm_active && c->sm_tx.tail().load(std::memory_order_relaxed) != t0)
       doorbell(c, fires);
+  }
+
+  // --------------------------------------------- swfast (DESIGN.md §24)
+  // The uring TX core: kick_tx defers TCP-phase conns into uring_q; once
+  // per event-loop pass uring_service collects every deferred conn's
+  // gather into SQEs and lands them with ONE io_uring_enter.  The
+  // collect/account halves are the same code both cores run
+  // (uring_tx_collect mirrors tcp_tx_gather; tcp_tx_account is shared),
+  // so protocol behavior -- completion order, switch points, stripe
+  // refills, release discipline -- is identical under either core.
+
+  struct UringOp {
+    Conn* c = nullptr;
+    bool is_zc = false;
+    TxRef zc_ref;
+    struct iovec iov[64];
+    int niov = 0;
+    msghdr mh{};
+    int res = 0;
+  };
+
+  void uring_queue(Conn* c) {
+    if (c->in_uring_q) return;
+    c->in_uring_q = true;
+    uring_q.push_back(c);
+  }
+
+  // Teardown hook: a dying conn must leave the pass's submit queue (the
+  // service loop holds raw pointers, and half-open conns are deleted the
+  // moment they break).
+  void uring_unqueue(Conn* c) {
+    if (!c->in_uring_q) return;
+    c->in_uring_q = false;
+    uring_q.erase(std::remove(uring_q.begin(), uring_q.end(), c),
+                  uring_q.end());
+  }
+
+  // Build one conn's submission for this pass: either a gathered
+  // header/ctl batch or a single zerocopy payload slice -- the same
+  // item-walk rules as tcp_tx_gather (64 iovecs / 4 MiB, stop at the sm
+  // switch point, stripe feeders, and zc boundaries), with the sendmsg
+  // deferred to the ring.  Keep in lockstep with tcp_tx_gather.
+  bool uring_tx_collect(Conn* c, UringOp& op) {
+    constexpr int kMaxIov = 64;
+    constexpr uint64_t kMaxBytes = 4u << 20;
+    int niov = 0;
+    uint64_t bytes = 0;
+    for (auto& ref : c->tx) {
+      TxItem& item = *ref;
+      if (niov >= kMaxIov || bytes >= kMaxBytes) break;
+      bool zc = zc_ready(c, item);
+      uint64_t hlen = item.header.size();
+      uint64_t off = item.off;
+      if (zc && niov == 0 && off >= hlen) {
+        if (c->zc_skip_once) {
+          c->zc_skip_once = false;
+          zc = false;  // ENOBUFS fallback: this pass copies
+        } else {
+          uint64_t po = off - hlen;
+          uint64_t left = item.paylen - po;
+          size_t n = left > kMaxBytes ? (size_t)kMaxBytes : (size_t)left;
+          op.iov[0].iov_base = (void*)(item.payload + po);
+          op.iov[0].iov_len = n;
+          op.niov = 1;
+          op.is_zc = true;
+          op.zc_ref = ref;
+          return true;
+        }
+      }
+      if (off < hlen) {
+        op.iov[niov].iov_base = (void*)(item.header.data() + off);
+        op.iov[niov].iov_len = (size_t)(hlen - off);
+        bytes += op.iov[niov].iov_len;
+        niov++;
+        off = hlen;
+      }
+      if (zc) break;  // payload goes zerocopy on the next pass
+      if (niov < kMaxIov && off < item.total() && bytes < kMaxBytes) {
+        uint64_t po = off - hlen;
+        uint64_t left = item.paylen - po;
+        uint64_t room = kMaxBytes - bytes;
+        size_t n = (size_t)(left < room ? left : room);
+        op.iov[niov].iov_base = (void*)(item.payload + po);
+        op.iov[niov].iov_len = n;
+        bytes += n;
+        niov++;
+      }
+      if (item.switch_after) break;
+      if (item.stripe) break;
+    }
+    op.niov = niov;
+    return niov > 0;
+  }
+
+  // One completed (or refused) SQE: the same outcome ladder as the epoll
+  // core's gather return -- EAGAIN parks on EPOLLOUT, errors break the
+  // conn, bytes route through the shared tcp_tx_account.
+  void uring_op_finish(UringOp& op, FireList& fires) {
+    Conn* c = op.c;
+    if (!c->alive || c->fd < 0) return;
+    int res = op.res;
+    if (res == -EAGAIN || res == -EWOULDBLOCK) {
+      if (!c->want_write) {
+        c->want_write = true;
+        ep_mod_conn(c);
+      }
+      return;
+    }
+    if (res == -ENOBUFS && op.is_zc) {
+      c->zc_skip_once = true;  // §24 ladder: next pass copies
+      uring_queue(c);
+      return;
+    }
+    if (res < 0) {
+      conn_broken(c, fires);
+      return;
+    }
+    if (res > 0) {
+      bump(counters.bytes_tx, (uint64_t)res);
+      if (op.is_zc) {
+        zc_track(c, op.zc_ref);
+      } else {
+        bump(counters.gather_passes);
+        bump(counters.gather_items, (uint64_t)op.niov);
+      }
+      tcp_tx_account(c, (uint64_t)res, fires);
+    }
+    if (!c->tx.empty() && !c->tx_via_ring) {
+      uring_queue(c);  // more to send: next round of the service loop
+    } else {
+      // Drained (or flipped to the ring): the direct kick is the shared
+      // epilogue -- want_write teardown, the sm flip, the doorbell.
+      kick_tx(c, fires, /*direct=*/true);
+    }
+  }
+
+#if SW_HAVE_IOURING
+  // The batched submit: ONE io_uring_enter lands every ready conn's
+  // sendmsg for the pass (the §23 ledger's uring_flush path, amortized
+  // across conns).  Strictly synchronous: every SQE carries
+  // MSG_DONTWAIT, so GETEVENTS with min_complete = n returns with all
+  // CQEs inline and no buffer outlives the call.
+  int uring_submit_wait(unsigned n) {
+    unsigned done = 0;
+    while (done < n) {
+      bump(counters.io_syscalls);  // §23 runtime cost twin
+      bump(counters.uring_submits);
+      int r = io_uring_enter(uring.ring_fd, n - done, n - done,
+                             IORING_ENTER_GETEVENTS);
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        return -1;
+      }
+      if (r == 0) return -1;  // wedged ring: treat as a core failure
+      done += (unsigned)r;
+    }
+    return (int)done;
+  }
+
+  void uring_service(FireList& fires) {
+    int guard = 0;
+    while (!uring_q.empty() && ++guard <= 4096) {
+      std::vector<Conn*> batch;
+      batch.swap(uring_q);
+      std::deque<UringOp> ops;  // stable addresses: SQEs point at mh
+      for (Conn* c : batch) {
+        c->in_uring_q = false;
+        if (!c->alive || c->fd < 0) continue;
+        if (c->tx_via_ring || c->tx.empty()) {
+          // Ring-mode conns (and bare epilogue kicks) run the classic
+          // pump inline -- no socket syscalls to batch there.
+          kick_tx(c, fires, /*direct=*/true);
+          continue;
+        }
+        ops.emplace_back();
+        ops.back().c = c;
+        if (!uring_tx_collect(c, ops.back())) ops.pop_back();
+      }
+      if (ops.empty()) continue;
+      if (ops.size() == 1) {
+        // Singleton bypass: a ring round-trip buys no batching, so the
+        // classic pump keeps single-conn workers at exact epoll-core
+        // syscall cost (the paired-bench parity case).
+        kick_tx(ops[0].c, fires, /*direct=*/true);
+        continue;
+      }
+      size_t done = 0;
+      while (done < ops.size()) {
+        unsigned chunk = 0;
+        for (size_t i = done; i < ops.size(); i++) {
+          io_uring_sqe* sqe = uring.get_sqe();
+          if (!sqe) break;  // SQ full: flush this chunk, then continue
+          UringOp& op = ops[i];
+          op.mh.msg_iov = op.iov;
+          op.mh.msg_iovlen = (size_t)op.niov;
+          sqe->opcode = IORING_OP_SENDMSG;
+          sqe->fd = op.c->fd;
+          sqe->addr = (uint64_t)(uintptr_t)&op.mh;
+          sqe->msg_flags = MSG_NOSIGNAL | MSG_DONTWAIT |
+                           (op.is_zc ? MSG_ZEROCOPY : 0);
+          sqe->user_data = (uint64_t)i;
+          chunk++;
+        }
+        bump(counters.uring_sqes, chunk);
+        if (uring_submit_wait(chunk) < 0) {
+          // enter() itself failed (not an op result): abandon the core
+          // for this worker; deferred conns re-kick on the classic pump.
+          uring.shutdown();
+          for (size_t i = done; i < ops.size(); i++)
+            kick_tx(ops[i].c, fires, /*direct=*/true);
+          return;
+        }
+        uring.reap([&](uint64_t ud, int res) {
+          if (ud < ops.size()) ops[ud].res = res;
+        });
+        for (size_t i = done; i < done + chunk; i++)
+          uring_op_finish(ops[i], fires);
+        done += chunk;
+      }
+    }
+  }
+#else
+  void uring_service(FireList&) {}
+#endif
+
+  // §24 MSG_ZEROCOPY completions.  Ranges complete cumulatively in seq
+  // order on TCP: everything at or below `hi` is done (wrap-safe
+  // signed compare; a socket wraps after 4B zerocopy sends).
+  void zc_complete_range(Conn* c, uint32_t hi, FireList& fires) {
+    while (!c->zc_outstanding.empty()) {
+      auto& front = c->zc_outstanding.front();
+      if ((int32_t)(front.first - hi) > 0) break;
+      TxRef ref = front.second;
+      c->zc_outstanding.pop_front();
+      if (ref->zc_pins > 0) ref->zc_pins--;
+      bump(counters.zc_notifies);
+      if (ref->zc_pins == 0 && ref->zc_deferred) {
+        ref->zc_deferred = false;
+        fire_release(*ref, fires);
+      }
+    }
+  }
+
+  // EPOLLERR with pins outstanding: drain the error queue.  Zerocopy
+  // notifications ride it with ee_errno 0 (not a socket error); a real
+  // error leaves the queue empty and surfaces on the rx path as ever.
+  void zc_drain_errqueue(Conn* c, FireList& fires) {
+    while (!c->zc_outstanding.empty()) {
+      char cbuf[256];
+      msghdr msg{};
+      msg.msg_control = cbuf;
+      msg.msg_controllen = sizeof(cbuf);
+      bump(counters.io_syscalls);  // §23 runtime cost twin
+      ssize_t r = ::recvmsg(c->fd, &msg, MSG_ERRQUEUE | MSG_DONTWAIT);
+      if (r < 0) return;  // EAGAIN: drained
+      for (cmsghdr* cm = CMSG_FIRSTHDR(&msg); cm; cm = CMSG_NXTHDR(&msg, cm)) {
+        if (cm->cmsg_level != SOL_IP || cm->cmsg_type != IP_RECVERR) continue;
+        auto* ee = (sock_extended_err*)CMSG_DATA(cm);
+        if (ee->ee_origin != SO_EE_ORIGIN_ZEROCOPY) continue;
+        // [ee_info, ee_data] completed; SO_EE_CODE_ZEROCOPY_COPIED just
+        // means the kernel copied after all -- still a completion.
+        zc_complete_range(c, ee->ee_data, fires);
+      }
+    }
+  }
+
+  // fd teardown with zerocopy pins in flight: the notifications can no
+  // longer be read, so drop the kernel pins.  NOT force: a session
+  // journal's hold_release still gates the actual release.
+  void zc_abandon(Conn* c, FireList& fires) {
+    while (!c->zc_outstanding.empty()) {
+      TxRef ref = c->zc_outstanding.front().second;
+      c->zc_outstanding.pop_front();
+      ref->zc_pins = 0;
+      if (ref->zc_deferred) {
+        ref->zc_deferred = false;
+        fire_release(*ref, fires);
+      }
+    }
   }
 
   // ----------------------------------------------------------------- rx
@@ -4545,6 +5170,8 @@ struct Worker {
     }
     c->alive = false;
     ep_del(c->fd);
+    uring_unqueue(c);
+    zc_abandon(c, fires);  // §24: the fd dies, kernel pins with it
     trace.rec(kEvConnDown, 0, c->id);
     // A §19 poison owns the cancel reason: in-flight ops report
     // "corrupt", not a generic cancel (core/conn.py mark_dead twin).
@@ -4649,6 +5276,8 @@ struct Worker {
     c->tx.clear();
     c->alive = false;
     ep_del(c->fd);
+    uring_unqueue(c);
+    zc_abandon(c, fires);  // §24: the fd dies, kernel pins with it
     if (c->rx_msg) {
       // cancel_all already ran (do_close order) and freed every record the
       // matcher owns -- dereferencing those here would be use-after-free.
@@ -5076,7 +5705,8 @@ struct Worker {
       uint64_t credits = c->fc_credits > 0 ? (uint64_t)c->fc_credits : 0;
       const uint64_t vals[] = {depth, qbytes, infl, inflr, jb, jf, sp,
                                c->fc_unexp, credits,
-                               (uint64_t)c->retx_offs.size()};
+                               (uint64_t)c->retx_offs.size(),
+                               (uint64_t)c->zc_outstanding.size()};
       static_assert(sizeof(vals) / sizeof(vals[0]) ==
                         sizeof(kGaugeNames) / sizeof(kGaugeNames[0]),
                     "gauge names and values out of sync");
@@ -5092,7 +5722,9 @@ struct Worker {
       s += "}";
       first = false;
     }
-    s += "}, \"posted_recvs\": " + std::to_string(matcher.posted.size()) + "}";
+    s += "}, \"posted_recvs\": " + std::to_string(matcher.posted.size()) +
+         ", \"uring_depth\": " +
+         std::to_string(uring.ok() ? (uint64_t)uring.sq_entries : 0) + "}";
     return s;
   }
 
@@ -5178,7 +5810,9 @@ struct Worker {
         if (op.kind == Op::GAUGES) {
           // Never leave a sw_gauges caller parked on a dead engine: a
           // closed worker's gauges are all drained-to-zero by contract.
-          gauges_signal(op.gwait, "{\"conns\": {}, \"posted_recvs\": 0}");
+          gauges_signal(op.gwait,
+                        "{\"conns\": {}, \"posted_recvs\": 0, "
+                        "\"uring_depth\": 0}");
           ops.pop_front();
           continue;
         }
@@ -5213,6 +5847,7 @@ struct Worker {
     for (auto* c : half_open) {
       c->alive = false;
       ep_del(c->fd);
+      uring_unqueue(c);
       close(c->fd);
       c->fd = -1;
       delete c;
@@ -5250,13 +5885,34 @@ struct Worker {
     if (ka_interval > 0)
       next_ka = Clock::now() + std::chrono::duration_cast<Clock::duration>(
                                    std::chrono::duration<double>(ka_interval));
+    // §24 swfast levers, sampled once per worker lifetime.  Each is
+    // strictly opt-in; env unset leaves this loop byte-identical to the
+    // seed.  STARWAY_IOURING_PROBE_FAIL is the test hook for the
+    // kernel-without-io_uring fallback ladder (probe fails -> epoll).
+    busypoll_us = busypoll_us_env();
+    zc_armed = zerocopy_enabled();
+    zc_thresh = rndv_threshold();
+    if (iouring_enabled() && !std::getenv("STARWAY_IOURING_PROBE_FAIL"))
+      uring.init(256);
     epoll_event events[64];
+    auto spin_until = Clock::time_point::min();
     for (;;) {
       if (status.load() == ST_CLOSING) break;
-      int n = epoll_wait(epfd, events, 64, poll_timeout_ms());
+      int timeout = poll_timeout_ms();
+      bool spinning = false;
+      if (busypoll_us > 0 && Clock::now() < spin_until) {
+        timeout = 0;  // §24 bounded busy-poll: nonblocking inside the window
+        spinning = true;
+      }
+      int n = epoll_wait(epfd, events, 64, timeout);
       if (n < 0) {
         if (errno == EINTR) continue;
         break;
+      }
+      if (n > 0 && busypoll_us > 0) {
+        if (spinning) bump(counters.busypoll_hits);
+        spin_until = Clock::now() +
+                     std::chrono::microseconds((int64_t)busypoll_us);
       }
       FireList fires;
       for (int i = 0; i < n; i++) {
@@ -5269,6 +5925,8 @@ struct Worker {
           accept_loop(fires);
         } else {
           Conn* c = (Conn*)ptr;
+          if ((events[i].events & EPOLLERR) && !c->zc_outstanding.empty())
+            zc_drain_errqueue(c, fires);  // §24 zerocopy notifications
           if (events[i].events & EPOLLOUT) conn_writable(c, fires);
           if ((events[i].events & (EPOLLIN | EPOLLHUP | EPOLLERR)) && c->alive)
             conn_readable(c, fires);
@@ -5277,6 +5935,7 @@ struct Worker {
       check_timers(fires);
       drain_ops(fires);
       fc_service(fires);  // §18 grants/CTS queued by matcher paths
+      uring_service(fires);  // §24 batched submit of deferred TX (no-op off)
       for (auto& f : fires) f();
       for (Conn* z : sess_reap) delete z;
       sess_reap.clear();
@@ -5323,6 +5982,7 @@ struct Worker {
   }
 
   void cleanup_fds() {
+    uring.shutdown();
     if (epfd >= 0) {
       close(epfd);
       epfd = -1;
@@ -5776,7 +6436,35 @@ extern "C" {
 //    zero-length striped chunks are protocol violations, T_CSUM prefix
 //    truncates to the 32-bit CRC) + the sw_wire_decode differential
 //    harness -- DESIGN.md §21
-const char* sw_version() { return "starway-native-12"; }
+// 11: swfast opt-in hot-path levers (io_uring batched TX submission,
+//    MSG_ZEROCOPY >= rndv payloads, bounded busy-poll) + the
+//    sw_fast_probe capability export; no wire/HELLO change, seed path
+//    byte-identical with the envs unset -- DESIGN.md §24
+const char* sw_version() { return "starway-native-13"; }
+
+// swfast capability probe (sw_engine.h, DESIGN.md §24): which levers can
+// this build+kernel actually engage?  bit0 io_uring, bit1 MSG_ZEROCOPY,
+// bit2 busy-poll.  Scratch resources only; nothing persists.
+uint64_t sw_fast_probe() {
+  uint64_t caps = 4;  // busy-poll needs nothing beyond the event loop
+#if SW_HAVE_IOURING
+  if (!std::getenv("STARWAY_IOURING_PROBE_FAIL")) {
+    UringCore probe;
+    if (probe.init(8)) caps |= 1;
+    probe.shutdown();
+  }
+#endif
+  {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd >= 0) {
+      int one = 1;
+      if (setsockopt(fd, SOL_SOCKET, SO_ZEROCOPY, &one, sizeof(one)) == 0)
+        caps |= 2;
+      close(fd);
+    }
+  }
+  return caps;
+}
 
 // Portable cursor atomics for the Python engine's sm ring (sw_engine.h).
 // std::atomic_ref would be C++20-tidy but libstdc++'s needs alignment UB
@@ -6144,6 +6832,9 @@ int sw_counters(void* h, char* out, int cap) {
       c.csum_fail.load(),      c.chunk_retx.load(),
       c.reshard_bytes.load(),  c.reshard_rounds.load(),
       c.io_syscalls.load(),    c.hot_copies.load(),
+      c.uring_submits.load(),  c.uring_sqes.load(),
+      c.zc_sends.load(),       c.zc_notifies.load(),
+      c.busypoll_hits.load(),
   };
   constexpr size_t kN = sizeof(kCounterNames) / sizeof(kCounterNames[0]);
   static_assert(sizeof(vals) / sizeof(vals[0]) == kN,

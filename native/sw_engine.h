@@ -87,8 +87,19 @@ typedef void (*sw_event_cb)(void* ctx, const char* event, uint64_t conn_id);
  * below is machine-checked against the sw_engine.cpp implementation by
  * the contract checker (python -m starway_tpu.analysis, rule
  * contract-version) -- bump BOTH when the protocol changes.
- * swcheck: engine-version "starway-native-12" */
+ * swcheck: engine-version "starway-native-13" */
 const char* sw_version(void);
+
+/* swfast capability probe (DESIGN.md §24).  Bitmask of the levers this
+ * build+kernel can actually engage: bit0 io_uring (compiled in AND the
+ * runtime NOP probe succeeds; honors STARWAY_IOURING_PROBE_FAIL so the
+ * fallback ladder is testable), bit1 MSG_ZEROCOPY (SO_ZEROCOPY settable),
+ * bit2 bounded busy-poll (always available).  Pure probe -- no worker,
+ * no persistent fds, callable from any thread.  The levers themselves
+ * are armed per-worker from STARWAY_IOURING / STARWAY_ZEROCOPY /
+ * STARWAY_BUSYPOLL_US at engine-thread start; a probe failure at arm
+ * time silently falls back to the seed epoll path. */
+uint64_t sw_fast_probe(void);
 
 /* Allocate a client/server worker in the VOID state.  `worker_id` is the
  * UUID hex advertised in the HELLO handshake.  Returned handle must be

@@ -62,7 +62,10 @@ METRICS = ("syscalls", "copies", "allocs", "locks")
 #: ``arm:<name>`` components are carved out of the frame pumps below.
 COMPONENTS = {
     "tx_pump":       {"py": (F_CONN, ["kick_tx"]),
-                      "cpp": ["void kick_tx("]},
+                      # tcp_tx_account is kick_tx's budget loop, extracted
+                      # so both event cores share it (§24) -- same slice,
+                      # zero sites of its own, ledger-neutral.
+                      "cpp": ["void kick_tx(", "void tcp_tx_account("]},
     "tx_gather":     {"py": (F_CONN, ["_gather_tx"]),
                       "cpp": ["ssize_t tcp_tx_gather("]},
     "tx_write":      {"py": (F_CONN, ["_tx_write"]),
@@ -85,6 +88,21 @@ COMPONENTS = {
                       "cpp": ["ssize_t read_into(uint8_t* dst, size_t len)"]},
     "stripe_feed":   {"py": (F_LANE, ["_claim"]),
                       "cpp": ["bool stripe_claim("]},
+    # §24 swfast components are native-only ("py": None): the Python
+    # engine declares the counter vocabulary but has no submission ring
+    # or zerocopy machinery, so its rows for these paths pin at 0.
+    "uring_pump":    {"py": None,
+                      "cpp": ["void uring_service("]},
+    "uring_collect": {"py": None,
+                      "cpp": ["bool uring_tx_collect("]},
+    "uring_finish":  {"py": None,
+                      "cpp": ["void uring_op_finish("]},
+    "uring_submit":  {"py": None,
+                      "cpp": ["int uring_submit_wait("]},
+    "zc_send":       {"py": None,
+                      "cpp": ["ssize_t zc_tx_send("]},
+    "zc_notify":     {"py": None,
+                      "cpp": ["void zc_drain_errqueue("]},
 }
 
 #: The five rx-state arms of the frame pumps (taint.py's CPP_ARMS order)
@@ -109,6 +127,21 @@ PATHS = {
     "sm_enqueue": ["tx_write", "sm_write", "doorbell"],
     "sm_dequeue": ["rx_socket", "sm_read"],
     "dispatch":   ["arm:dispatch", "arm:skip", "rx_read"],
+    # §24 swfast (STARWAY_IOURING=1): the per-conn TX pass under the
+    # uring core.  Eager AND rndv payload bytes both ride this collector
+    # (the rndv_tx path above is the RTS/CTS ctl plane, already at 0
+    # syscalls) -- its per-pass site count is STRICTLY LOWER than
+    # eager_tx's because the one sendmsg moved into uring_flush, where a
+    # single io_uring_enter lands every ready conn's batch.
+    "eager_tx_uring": ["uring_pump", "uring_collect", "uring_finish"],
+    "uring_flush":    ["uring_submit"],
+    # §24 (STARWAY_ZEROCOPY=1): the MSG_ZEROCOPY payload pass (two
+    # sendmsg sites: the zerocopy send + the documented ENOBUFS copying
+    # fallback) and the errqueue completion drain.  The eliminated cost
+    # is the KERNEL-side payload copy -- not a static site here -- so
+    # these rows pin the added notification machinery instead.
+    "zc_tx":          ["zc_send"],
+    "zc_notify":      ["zc_notify"],
 }
 
 # ------------------------------------------------------- site tables
@@ -119,7 +152,8 @@ PATHS = {
 #: idioms (push_back onto a reserved vector is amortised, not counted).
 CPP_SITE_RES = {
     "syscalls": re.compile(r"::send\(|::sendmsg\(|::recv\(|::recvmsg\(|"
-                           r"::writev\(|\bepoll_wait\(|\bepoll_ctl\("),
+                           r"::writev\(|\bepoll_wait\(|\bepoll_ctl\(|"
+                           r"\bio_uring_enter\(|\bio_uring_setup\("),
     "copies":   re.compile(r"\bmemcpy\(|std::copy\(|\bmemmove\(|\.assign\("),
     "allocs":   re.compile(r"\bnew\s|\bmalloc\(|\.resize\(|\.reserve\(|"
                            r"make_shared<"),
@@ -257,6 +291,8 @@ def _extract_python(root: Path, vectors: dict, out: list) -> None:
 
     comp_vecs: dict = {}
     for name, spec in COMPONENTS.items():
+        if spec["py"] is None:
+            continue  # native-only §24 component: the py rows pin at 0
         f, funcs = spec["py"]
         defs = _py_functions(trees[f])
         sites: list = []
@@ -462,7 +498,7 @@ def render_ledger(vectors: dict) -> str:
         for engine in ("py", "cpp"):
             for metric in METRICS:
                 v = vectors.get((engine, pname, metric), 0)
-                lines.append(f"{engine:<4}{pname:<12}{metric:<10}{v}")
+                lines.append(f"{engine:<4}{pname:<16}{metric:<10}{v}")
     return "\n".join(lines) + "\n"
 
 

@@ -111,6 +111,23 @@ def build_parser() -> argparse.ArgumentParser:
              "when unset); see the 'striped' scenario (DESIGN.md §17).",
     )
     p.add_argument(
+        "--uring", action="store_true",
+        help="Arm the §24 io_uring batched-TX lever (STARWAY_IOURING=1); "
+             "native engine only, silently falls back to epoll when the "
+             "kernel probe fails.",
+    )
+    p.add_argument(
+        "--zerocopy", action="store_true",
+        help="Arm the §24 MSG_ZEROCOPY lever (STARWAY_ZEROCOPY=1) for "
+             ">= rndv-threshold payloads; native engine only.",
+    )
+    p.add_argument(
+        "--busypoll", type=int, metavar="US",
+        help="Arm the §24 bounded busy-poll lever (STARWAY_BUSYPOLL_US): "
+             "spin up to US microseconds after the last event before "
+             "blocking; native engine only.",
+    )
+    p.add_argument(
         "--paired-baseline", action="store_true",
         help="Striped scenario only: interleave a striping-OFF baseline with "
              "every striping-ON iteration in ONE process/connection and "
@@ -414,6 +431,23 @@ def _dump_trace(args: argparse.Namespace) -> "dict | None":
     return ptiles
 
 
+def active_levers() -> list:
+    """The §24 swfast levers armed for this process, by env (covers both
+    the CLI flags and direct env arming) -- recorded in every JSON report
+    so a result row is self-describing."""
+    levers = []
+    if os.environ.get("STARWAY_IOURING") == "1":
+        levers.append("uring")
+    if os.environ.get("STARWAY_ZEROCOPY") == "1":
+        levers.append("zerocopy")
+    try:
+        if int(os.environ.get("STARWAY_BUSYPOLL_US", "0")) > 0:
+            levers.append(f"busypoll:{int(os.environ['STARWAY_BUSYPOLL_US'])}")
+    except ValueError:
+        pass
+    return levers
+
+
 def dump_results(results, args: argparse.Namespace) -> None:
     from . import perf
     from .benchmarks import get_scenario
@@ -440,6 +474,8 @@ def dump_results(results, args: argparse.Namespace) -> None:
         report = {
             "timestamp": time.time(),
             "transport": os.environ.get("STARWAY_TLS"),
+            # §24: which swfast levers this run armed ([] = seed path).
+            "levers": active_levers(),
             "scenarios": [r.to_dict(include_samples=args.store_trace) for r in results],
             # Per-stage pipeline telemetry (DESIGN.md §12): loopback runs
             # see both sides; client-role runs see the client's half.
@@ -491,6 +527,14 @@ def main(argv: Sequence[str] | None = None) -> int:
     if args.fc_window:
         # Flow control negotiates at connect too (the "fc" handshake key).
         os.environ["STARWAY_FC_WINDOW"] = str(args.fc_window)
+    # §24 swfast levers: engine-local (no handshake surface), but sampled
+    # once at worker start -- so land the envs before any worker exists.
+    if args.uring:
+        os.environ["STARWAY_IOURING"] = "1"
+    if args.zerocopy:
+        os.environ["STARWAY_ZEROCOPY"] = "1"
+    if args.busypoll:
+        os.environ["STARWAY_BUSYPOLL_US"] = str(max(0, args.busypoll))
     if args.trace:
         # Must land before any worker is created: rings are armed per
         # worker at construction (core/swtrace.py).

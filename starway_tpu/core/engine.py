@@ -185,6 +185,8 @@ class Worker:
         snap = {
             "conns": {c.conn_id: telemetry.conn_gauges(c) for c in conns},
             "posted_recvs": posted,
+            # §24: native-only lever; this engine has no submission ring.
+            "uring_depth": 0,
         }
         return telemetry.merge_global_gauges(snap)
 

@@ -156,6 +156,13 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
                 ctypes.c_char_p, ctypes.c_int
             ]
+        # Optional: the §24 swfast capability probe (bit0 io_uring, bit1
+        # MSG_ZEROCOPY, bit2 busy-poll) -- which opt-in hot-path levers
+        # this build+kernel can actually engage (tests/test_fast.py and
+        # the CI capability check consume it).
+        if hasattr(lib, "sw_fast_probe"):
+            lib.sw_fast_probe.argtypes = []
+            lib.sw_fast_probe.restype = ctypes.c_uint64
         _lib = lib
     except Exception as e:  # toolchain/build failure => Python engine
         _lib_err = str(e)
@@ -165,6 +172,16 @@ def load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return load() is not None
+
+
+def fast_probe() -> int:
+    """§24 swfast capability bitmask: bit0 io_uring (runtime probe OK),
+    bit1 MSG_ZEROCOPY, bit2 bounded busy-poll.  0 when the native lib is
+    absent or predates the probe."""
+    lib = load()
+    if lib is None or not hasattr(lib, "sw_fast_probe"):
+        return 0
+    return int(lib.sw_fast_probe())
 
 
 def atomics(build: bool = True) -> Optional[tuple]:
@@ -545,7 +562,7 @@ class NativeWorkerBase:
         the engine thread) with the process-global staging-pool occupancy
         overlaid -- same shape as the Python engine's
         ``Worker.gauges_snapshot`` (DESIGN.md §15)."""
-        snap: dict = {"conns": {}, "posted_recvs": 0}
+        snap: dict = {"conns": {}, "posted_recvs": 0, "uring_depth": 0}
         if self._h is not None:
             cap = 65536
             buf = ctypes.create_string_buffer(cap)
@@ -560,6 +577,9 @@ class NativeWorkerBase:
                 try:
                     raw = json.loads(buf.value.decode())
                     snap["posted_recvs"] = int(raw.get("posted_recvs", 0))
+                    # §24: submission-ring depth, 0 when the uring core
+                    # is dark (seed parity) or the build predates it.
+                    snap["uring_depth"] = int(raw.get("uring_depth", 0))
                     snap["conns"] = {
                         int(cid): {k: int(v) for k, v in g.items()}
                         for cid, g in raw.get("conns", {}).items()

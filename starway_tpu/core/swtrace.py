@@ -143,6 +143,15 @@ COUNTER_NAMES = (
     #                       (send/sendmsg/recv/recv_into on the data path)
     "hot_copies",         # §23 hot-path payload byte-copies (sm ring
     #                       put/take; the tcp data path is copy-free)
+    "uring_submits",      # §24 io_uring_enter batched-submit calls
+    #                       (native-only lever; this engine declares the
+    #                       name and leaves it 0, like staging_* on the
+    #                       C++ side)
+    "uring_sqes",         # §24 sendmsg SQEs landed through the ring
+    "zc_sends",           # §24 MSG_ZEROCOPY payload sendmsg calls
+    "zc_notifies",        # §24 zerocopy completion ranges drained from
+    #                       the errqueue (COPIED fallbacks included)
+    "busypoll_hits",      # §24 events harvested inside the spin window
 )
 
 

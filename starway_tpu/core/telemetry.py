@@ -75,6 +75,9 @@ GAUGE_NAMES = (
     "retx_pending",     # §19 NACK-requeued striped chunks not yet
     #                     rewritten (drains to 0 once every retransmit
     #                     is back on a lane; primary rows only)
+    "zc_pending",       # §24 MSG_ZEROCOPY sends awaiting the kernel's
+    #                     errqueue completion (native-only lever; this
+    #                     engine declares the name and reports 0)
 )
 
 

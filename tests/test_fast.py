@@ -1,0 +1,316 @@
+"""swfast opt-in hot-path levers (DESIGN.md §24).
+
+Three independently-gated levers on the NATIVE engine's data path --
+io_uring batched TX submission (``STARWAY_IOURING=1``), MSG_ZEROCOPY for
+>= rndv payloads (``STARWAY_ZEROCOPY=1``), and bounded busy-poll
+(``STARWAY_BUSYPOLL_US=<n>``).  These tests pin the §24 contract:
+
+* every lever and every lever-pair moves real traffic on all four engine
+  pairings (the levers are native-only, so a Python peer must
+  interoperate completely unchanged);
+* seed parity: with the three envs unset the HELLO is byte-identical
+  and the new counters stay 0 (no wire surface, no handshake key);
+* the fallback ladder: a kernel without io_uring (forced via
+  ``STARWAY_IOURING_PROBE_FAIL``) silently runs the seed epoll core;
+* the counters tell the truth: zerocopy sends are notified 1:1, and the
+  uring core genuinely batches multiple conns' sendmsg into one submit.
+"""
+
+import asyncio
+import json
+import socket
+
+import numpy as np
+import pytest
+
+from starway_tpu import Client, Server
+from starway_tpu.core import frames, native, swtrace
+
+pytestmark = pytest.mark.asyncio
+
+ADDR = "127.0.0.1"
+MASK = (1 << 64) - 1
+ENGINES = ["python", "native"]
+
+#: lever name -> env overlay.  The rndv threshold is pinned alongside the
+#: zerocopy arm so the test payload (512 KiB) rides the rndv/zc path
+#: without multi-MiB traffic on the 1-core box.
+LEVERS = {
+    "uring":    {"STARWAY_IOURING": "1"},
+    "zerocopy": {"STARWAY_ZEROCOPY": "1", "STARWAY_RNDV_THRESHOLD": "262144"},
+    "busypoll": {"STARWAY_BUSYPOLL_US": "200"},
+}
+LEVER_SETS = (["uring"], ["zerocopy"], ["busypoll"],
+              ["uring", "zerocopy"], ["uring", "busypoll"],
+              ["zerocopy", "busypoll"])
+
+K_EAGER, N_EAGER = 4, 4096
+N_BIG = 512 * 1024
+
+
+def _native_available() -> bool:
+    return native.available()
+
+
+def _env(monkeypatch, levers=()):
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_DEVPULL", "0")
+    for lever in levers:
+        for k, v in LEVERS[lever].items():
+            monkeypatch.setenv(k, v)
+    swtrace.reset()
+
+
+async def _pair(monkeypatch, port, server_engine, client_engine):
+    monkeypatch.setenv("STARWAY_NATIVE",
+                       "1" if server_engine == "native" else "0")
+    server = Server()
+    server.listen(ADDR, port)
+    monkeypatch.setenv("STARWAY_NATIVE",
+                       "1" if client_engine == "native" else "0")
+    client = Client()
+    await asyncio.wait_for(client.aconnect(ADDR, port), 30)
+    return server, client
+
+
+async def _drive(server, client):
+    """Quick pingpong + one rndv-sized streaming send, data verified."""
+    sinks = [np.empty(N_EAGER, dtype=np.uint8) for _ in range(K_EAGER)]
+    futs = [server.arecv(b, 0x700 + i, MASK) for i, b in enumerate(sinks)]
+    await asyncio.sleep(0.05)
+    srcs = [np.full(N_EAGER, i + 1, dtype=np.uint8) for i in range(K_EAGER)]
+    await asyncio.gather(
+        *(client.asend(s, 0x700 + i) for i, s in enumerate(srcs)))
+    await asyncio.gather(*futs)
+    big_sink = np.empty(N_BIG, dtype=np.uint8)
+    fut = server.arecv(big_sink, 0x7F0, MASK)
+    big_src = (np.arange(N_BIG, dtype=np.uint64) % 251).astype(np.uint8)
+    await client.asend(big_src, 0x7F0)
+    await fut
+    await client.aflush()
+    for s, b in zip(srcs + [big_src], sinks + [big_sink]):
+        assert bytes(b) == bytes(s)
+
+
+@pytest.mark.parametrize("levers", LEVER_SETS,
+                         ids=["+".join(ls) for ls in LEVER_SETS])
+@pytest.mark.parametrize("server_engine", ENGINES)
+@pytest.mark.parametrize("client_engine", ENGINES)
+async def test_levers_all_pairings(port, monkeypatch, client_engine,
+                                   server_engine, levers):
+    """Each lever and lever-pair moves traffic on every engine pairing.
+    The levers only change HOW the native engine lands bytes on the
+    socket -- a Python peer (which ignores the envs entirely) must see
+    an unchanged wire."""
+    if "native" in (client_engine, server_engine) and not _native_available():
+        pytest.skip("native engine unavailable")
+    _env(monkeypatch, levers)
+    server, client = await _pair(monkeypatch, port, server_engine,
+                                 client_engine)
+    try:
+        await _drive(server, client)
+    finally:
+        await asyncio.wait_for(client.aclose(), 15)
+        await asyncio.wait_for(server.aclose(), 15)
+
+
+async def test_zerocopy_counters_and_notifications(port, monkeypatch):
+    """Native tx with zerocopy armed: the big send rides MSG_ZEROCOPY and
+    every zc send is eventually notified (the §24 pin-until-notification
+    discipline drains: flush has completed, so the kernel has landed and
+    acknowledged every byte)."""
+    if not _native_available():
+        pytest.skip("native engine unavailable")
+    if not native.fast_probe() & 2:
+        pytest.skip("kernel without SO_ZEROCOPY")
+    _env(monkeypatch, ["zerocopy"])
+    server, client = await _pair(monkeypatch, port, "native", "native")
+    try:
+        await _drive(server, client)
+        await asyncio.sleep(0.1)  # errqueue notifications drain via EPOLLERR
+        snap = client._client.counters_snapshot()
+        assert snap["zc_sends"] > 0
+        assert snap["zc_notifies"] == snap["zc_sends"]
+        gauges = client._client.gauges_snapshot()
+        for g in gauges["conns"].values():
+            assert g["zc_pending"] == 0  # all pins released
+    finally:
+        await asyncio.wait_for(client.aclose(), 15)
+        await asyncio.wait_for(server.aclose(), 15)
+
+
+async def test_uring_batches_multi_conn_tx(port, monkeypatch):
+    """The uring core's reason to exist: multiple ready conns' sendmsg
+    land through ONE io_uring_enter.  Rails give the worker several live
+    TCP conns per pass; single-conn workers take the documented singleton
+    bypass (exact epoll-core cost), pinned by the seed-parity test."""
+    if not _native_available():
+        pytest.skip("native engine unavailable")
+    if not native.fast_probe() & 1:
+        pytest.skip("kernel without io_uring")
+    _env(monkeypatch, ["uring"])
+    monkeypatch.setenv("STARWAY_RAILS", "2")
+    monkeypatch.setenv("STARWAY_STRIPE_THRESHOLD", str(256 * 1024))
+    server, client = await _pair(monkeypatch, port, "native", "native")
+    try:
+        n = 2 << 20
+        for r in range(3):
+            sink = np.empty(n, dtype=np.uint8)
+            fut = server.arecv(sink, 0x800 + r, MASK)
+            src = np.full(n, r + 3, dtype=np.uint8)
+            await client.asend(src, 0x800 + r)
+            await fut
+            assert bytes(sink) == bytes(src)
+        await client.aflush()
+        snap = client._client.counters_snapshot()
+        assert snap["uring_submits"] > 0
+        # Batching means strictly more SQEs than enter() calls.
+        assert snap["uring_sqes"] > snap["uring_submits"]
+        gauges = client._client.gauges_snapshot()
+        assert gauges["uring_depth"] > 0  # the ring is armed
+    finally:
+        await asyncio.wait_for(client.aclose(), 15)
+        await asyncio.wait_for(server.aclose(), 15)
+
+
+async def test_busypoll_spin_window_harvests(port, monkeypatch):
+    """A pingpong chain under a generous spin budget: consecutive events
+    land inside the window, so the engine harvests at least some of them
+    from the nonblocking spin (busypoll_hits > 0) -- and the budget is
+    bounded, so the test also proves the spin gives the CPU back."""
+    if not _native_available():
+        pytest.skip("native engine unavailable")
+    _env(monkeypatch)
+    monkeypatch.setenv("STARWAY_BUSYPOLL_US", "50000")
+    server, client = await _pair(monkeypatch, port, "native", "native")
+    try:
+        for i in range(20):
+            sink = np.empty(N_EAGER, dtype=np.uint8)
+            fut = server.arecv(sink, 0x900 + i, MASK)
+            await client.asend(np.full(N_EAGER, i + 1, dtype=np.uint8),
+                               0x900 + i)
+            await fut
+        await client.aflush()
+        hits = (client._client.counters_snapshot()["busypoll_hits"]
+                + server._server.counters_snapshot()["busypoll_hits"])
+        assert hits > 0
+    finally:
+        await asyncio.wait_for(client.aclose(), 15)
+        await asyncio.wait_for(server.aclose(), 15)
+
+
+async def test_probe_failure_falls_back_to_epoll(port, monkeypatch):
+    """The io_uring fallback ladder: a kernel without io_uring (forced
+    via the probe-fail hook) leaves STARWAY_IOURING=1 running the seed
+    epoll core -- traffic flows, nothing rides the ring."""
+    if not _native_available():
+        pytest.skip("native engine unavailable")
+    _env(monkeypatch, ["uring"])
+    monkeypatch.setenv("STARWAY_IOURING_PROBE_FAIL", "1")
+    assert native.fast_probe() & 1 == 0  # the probe honours the hook
+    assert native.fast_probe() & 4  # busy-poll needs nothing
+    server, client = await _pair(monkeypatch, port, "native", "native")
+    try:
+        await _drive(server, client)
+        for snap in (client._client.counters_snapshot(),
+                     server._server.counters_snapshot()):
+            assert snap["uring_submits"] == 0
+            assert snap["uring_sqes"] == 0
+        assert client._client.gauges_snapshot()["uring_depth"] == 0
+    finally:
+        await asyncio.wait_for(client.aclose(), 15)
+        await asyncio.wait_for(server.aclose(), 15)
+
+
+# ------------------------------------------------------------ seed parity
+
+
+async def _capture_hello(port):
+    """Accept one native-client dial and return its parsed HELLO body."""
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind((ADDR, port))
+    listener.listen(4)
+    client = Client()
+    try:
+        fut = client.aconnect(ADDR, port)
+        conn, _ = listener.accept()
+        conn.settimeout(10)
+        hdr = b""
+        while len(hdr) < frames.HEADER_SIZE:
+            hdr += conn.recv(frames.HEADER_SIZE - len(hdr))
+        ftype, _a, blen = frames.unpack_header(hdr)
+        assert ftype == frames.T_HELLO
+        body = b""
+        while len(body) < blen:
+            body += conn.recv(blen - len(body))
+        conn.sendall(frames.pack_hello_ack("seedpeer"))
+        await asyncio.wait_for(fut, 30)
+        conn.close()
+        return json.loads(body.decode())
+    finally:
+        listener.close()
+        try:
+            await asyncio.wait_for(client.aclose(), 10)
+        except Exception:
+            pass
+
+
+async def test_hello_parity_levers_have_no_wire_surface(port, port2,
+                                                        monkeypatch):
+    """§24 seed parity, handshake half: the levers change how bytes land
+    on the socket, never what bytes.  The HELLO with all three levers
+    armed is identical (modulo worker_id) to the seed HELLO."""
+    if not _native_available():
+        pytest.skip("native engine unavailable")
+    _env(monkeypatch)
+    monkeypatch.setenv("STARWAY_NATIVE", "1")
+    for var in ("STARWAY_IOURING", "STARWAY_ZEROCOPY", "STARWAY_BUSYPOLL_US"):
+        monkeypatch.delenv(var, raising=False)
+    seed = await _capture_hello(port)
+    _env(monkeypatch, ["uring", "zerocopy", "busypoll"])
+    monkeypatch.setenv("STARWAY_NATIVE", "1")
+    armed = await _capture_hello(port2)
+    scrub = lambda h: {k: v for k, v in h.items()
+                       if k not in ("worker_id", "name")}
+    assert scrub(seed) == scrub(armed)
+
+
+async def test_seed_parity_counters_dark(port, monkeypatch):
+    """§24 seed parity, counter half: with the envs unset the five new
+    counters never move on either engine -- the seed data path does not
+    branch into any lever."""
+    if not _native_available():
+        pytest.skip("native engine unavailable")
+    _env(monkeypatch)
+    for var in ("STARWAY_IOURING", "STARWAY_ZEROCOPY", "STARWAY_BUSYPOLL_US"):
+        monkeypatch.delenv(var, raising=False)
+    server, client = await _pair(monkeypatch, port, "native", "native")
+    try:
+        await _drive(server, client)
+        for snap in (client._client.counters_snapshot(),
+                     server._server.counters_snapshot()):
+            for name in ("uring_submits", "uring_sqes", "zc_sends",
+                         "zc_notifies", "busypoll_hits"):
+                assert snap[name] == 0, name
+        assert client._client.gauges_snapshot()["uring_depth"] == 0
+    finally:
+        await asyncio.wait_for(client.aclose(), 15)
+        await asyncio.wait_for(server.aclose(), 15)
+
+
+def test_python_engine_declares_the_vocabulary():
+    """The contract-trace gate needs both engines to share one counter /
+    gauge vocabulary; the Python engine declares the §24 names and
+    reports zeros (the staging_* precedent, mirrored)."""
+    from starway_tpu.core import telemetry
+    from starway_tpu.core.engine import Worker
+
+    for name in ("uring_submits", "uring_sqes", "zc_sends", "zc_notifies",
+                 "busypoll_hits"):
+        assert name in swtrace.COUNTER_NAMES
+    assert "zc_pending" in telemetry.GAUGE_NAMES
+    # A bare (never-started) worker: construction registers only weakly,
+    # and the io thread does not exist until listen/connect.
+    w = Worker("vocab-test")
+    assert w.gauges_snapshot()["uring_depth"] == 0

@@ -381,6 +381,12 @@ async def main():
     for i in range(2):
         await server.asend(ep, np.full(4096, 0xAB, dtype=np.uint8), 101 + i)
     await asyncio.wait_for(server.aflush_ep(ep), timeout=60)
+    # Two-way shutdown handshake.  DONE gates the client's close on this
+    # flush retiring; the BYE wait gates OUR close on the client's own
+    # flush retiring.  Without either, one side tears the conn down under
+    # the other's FLUSH/FLUSH_ACK (peer-reset race -> flaky test).
+    print("DONE", flush=True)
+    sys.stdin.readline()
     swtrace.write_ring_dump(dump)
     await server.aclose()
 
@@ -413,7 +419,8 @@ async def test_merge_stitches_two_process_trace(port, monkeypatch, tmp_path,
         [sys.executable, "-c", _MERGE_SERVER,
          "1" if s_eng == "native" else "0", str(port), str(n),
          str(srv_dump)],
-        stdout=subprocess.PIPE, text=True, env=env, cwd="/root/repo")
+        stdout=subprocess.PIPE, stdin=subprocess.PIPE, text=True, env=env,
+        cwd="/root/repo")
     try:
         assert proc.stdout.readline().strip() == "READY"
         client = Client()
@@ -437,6 +444,11 @@ async def test_merge_stitches_two_process_trace(port, monkeypatch, tmp_path,
             assert any(e[1] == swtrace_mod.EV_CLOCK for e in events), (
                 "no clock sample on the connector")
             swtrace_mod.write_ring_dump(cli_dump)
+            # Shutdown handshake (see _MERGE_SERVER): wait for the
+            # server's flush before closing, then release its close.
+            assert proc.stdout.readline().strip() == "DONE"
+            proc.stdin.write("BYE\n")
+            proc.stdin.flush()
         finally:
             await client.aclose()
         assert proc.wait(timeout=60) == 0
@@ -454,7 +466,13 @@ async def test_merge_stitches_two_process_trace(port, monkeypatch, tmp_path,
     assert summary["pairs"] >= n + 2, summary
     assert summary["bytes_paired"] >= (n + 2) * 4096, summary
     assert summary["clock_edges"], "no clock edge between the processes"
-    assert summary["wire_us"]["p50"] >= 0.0
+    # Causal ordering is only as tight as the clock alignment itself: a
+    # one-shot PING/PONG edge on a busy 1-core box can carry hundreds of
+    # us of error (err_us is the measured RTT half-width), which dwarfs
+    # real loopback wire latency -- derive the tolerance from the edges
+    # instead of hard-coding one.
+    slack = max(5000.0, 4.0 * max(e["err_us"] for e in summary["clock_edges"]))
+    assert summary["wire_us"]["p50"] >= -slack, (summary, slack)
 
     evs = doc["traceEvents"]
     # Clock-aligned tracks: both processes' workers present as trace
@@ -472,7 +490,7 @@ async def test_merge_stitches_two_process_trace(port, monkeypatch, tmp_path,
     for fid, s in starts.items():
         f = ends[fid]
         assert s["pid"] != f["pid"], (s, f)
-        assert s["ts"] <= f["ts"] + 5000, (s, f)  # 5 ms slack for jitter
+        assert s["ts"] <= f["ts"] + slack, (s, f, slack)
     # Both directions paired: flow arrows originate from BOTH processes
     # (a (tcid, ordinal)-only join would collide the two ends' ordinal
     # sequences and lose or mispair the reverse traffic).
