@@ -34,8 +34,9 @@ MASK = (1 << 64) - 1
 PING, PONG = 0x51, 0x52
 
 
-async def _pingpong(devices) -> tuple[list[float], list[float]]:
-    """Interleaved framework/raw pingpong; returns (fw_rtts, raw_rtts)."""
+async def _pingpong(devices) -> tuple[list[float], list[float], dict]:
+    """Interleaved framework/raw pingpong; returns (fw_rtts, raw_rtts,
+    and the client worker's §25 swpulse percentile view)."""
     import numpy as np
 
     from starway_tpu import Client, DeviceBuffer, Server
@@ -124,9 +125,14 @@ async def _pingpong(devices) -> tuple[list[float], list[float]]:
             raw_rtts.append(raw_dt)
         i += 1
 
+    # §25 swpulse: the always-on distributions, read before teardown --
+    # the percentile view of the SAME run the headline p50 summarises.
+    from starway_tpu.core import swtrace
+
+    pulse = swtrace.hist_summary(client._client.hists_snapshot())
     await client.aclose()
     await server.aclose()
-    return fw_rtts, raw_rtts
+    return fw_rtts, raw_rtts, pulse
 
 
 def _pct(sorted_vals: list, q: float) -> float:
@@ -169,7 +175,7 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     devices = jax.devices()
-    fw, raw = asyncio.run(_pingpong(devices))
+    fw, raw, pulse = asyncio.run(_pingpong(devices))
 
     fw_sorted = sorted(fw)
     fw_p10, fw_p50, fw_p90 = (_pct(fw_sorted, 10), statistics.median(fw),
@@ -198,6 +204,10 @@ def main() -> None:
                 # §24: swfast levers armed via env for this run ([] = seed
                 # data path) -- rows are self-describing from BENCH_r06 on.
                 "levers": _active_levers(),
+                # §25 swpulse: the client worker's always-on distributions
+                # (log-bucket percentiles per HIST_NAMES row) from the same
+                # run -- BENCH_r07 on.
+                "hists": pulse,
             }
         )
     )

@@ -216,6 +216,10 @@ const char* kEvClock = "clock_sample";
 // through the monitor automaton by `python -m starway_tpu.analysis
 // refine --replay` and core/monitor.py.
 const char* kEvProto = "proto";
+// swpulse stall-sentinel alert (DESIGN.md §25): conn = suspect conn id
+// (0 = worker-wide), nbytes = condition age in ms, reason = one of
+// kStallReasons.  Armed only by STARWAY_STALL_MS.
+const char* kEvStall = "stall";
 
 // Canonical frame-type -> protocol-event name table (the T_* suffix).
 // Cross-engine contract surface: frames.py FRAME_NAMES is the Python
@@ -271,6 +275,7 @@ const char* kCounterNames[] = {
     "uring_submits",     "uring_sqes",
     "zc_sends",          "zc_notifies",
     "busypoll_hits",
+    "stall_alerts",
 };
 
 // swscope per-conn gauge vocabulary, same order as the values rendered by
@@ -319,11 +324,69 @@ struct Counters {
   std::atomic<uint64_t> uring_submits{0}, uring_sqes{0};
   std::atomic<uint64_t> zc_sends{0}, zc_notifies{0};
   std::atomic<uint64_t> busypoll_hits{0};
+  // §25 swpulse stall sentinel: alerts raised (0 unless STARWAY_STALL_MS
+  // armed it -- the sentinel itself never runs on the seed path).
+  std::atomic<uint64_t> stall_alerts{0};
 };
 
 inline void bump(std::atomic<uint64_t>& c, uint64_t n = 1) {
   c.fetch_add(n, std::memory_order_relaxed);
 }
+
+// ------------------------------------------------ swpulse (DESIGN.md §25)
+//
+// Always-on log-bucketed latency/size distributions, bumped
+// unconditionally at the contract points.  Vocabulary AND bucket layout
+// are cross-engine contract surface: core/swtrace.py HIST_NAMES /
+// HIST_BUCKETS / hist_bucket are the Python twins, diffed by swcheck's
+// contract-trace pass.  Latencies in MICROSECONDS, sizes in BYTES;
+// bucket i holds values of bit-length i (0 -> bucket 0), so boundaries
+// are powers of two and percentiles derive from bucket upper bounds at
+// read time.  One bump = one clock read + one relaxed increment into a
+// fixed per-worker array: no allocation, no lock, no branch.
+
+const char* kHistNames[] = {
+    "send_local_us",  // send post -> local completion (§10 contract)
+    "recv_wait_us",   // recv post -> matcher claim
+    "flush_us",       // flush barrier post -> all-target acknowledgement
+    "park_us",        // §18 credit-window park residency
+    "pin_us",         // §17 stripe / §24 zerocopy payload-pin residency
+    "msg_bytes",      // payload size per posted send
+};
+
+constexpr int kHistBuckets = 64;
+
+// Twin of swtrace.hist_bucket: value.bit_length() clamped to the last
+// bucket, 0/negative -> bucket 0 (the argument is unsigned here).
+inline int hist_bucket(uint64_t v) {
+  if (v == 0) return 0;
+  int b = 64 - __builtin_clzll(v);
+  return b < kHistBuckets ? b : kHistBuckets - 1;
+}
+
+// Same field order as kHistNames and the sw_hists render below.
+struct Hists {
+  std::atomic<uint64_t> send_local_us[kHistBuckets] = {};
+  std::atomic<uint64_t> recv_wait_us[kHistBuckets] = {};
+  std::atomic<uint64_t> flush_us[kHistBuckets] = {};
+  std::atomic<uint64_t> park_us[kHistBuckets] = {};
+  std::atomic<uint64_t> pin_us[kHistBuckets] = {};
+  std::atomic<uint64_t> msg_bytes[kHistBuckets] = {};
+};
+
+inline void hbump(std::atomic<uint64_t>* h, uint64_t v) {
+  h[hist_bucket(v)].fetch_add(1, std::memory_order_relaxed);
+}
+
+// Stall-reason vocabulary (§25 sentinel), carried verbatim as the
+// EV_STALL reason.  Cross-engine contract surface: swtrace.STALL_REASONS
+// is the Python twin, diffed by contract-pulse.
+const char* kStallReasons[] = {
+    "stall-flush",   // flush barrier outlived the threshold, no progress
+    "stall-credit",  // §18 parked sends aged out with no credit arrival
+    "stall-pin",     // stripe/zerocopy/journal pins undrained
+    "stall-unexp",   // unexpected-queue residency with no recv progress
+};
 
 struct TraceEvent {
   double t = 0.0;
@@ -349,16 +412,19 @@ struct TraceRing {
   std::atomic<uint64_t> widx{0};
 
   // Armed per worker at creation: STARWAY_TRACE on, a flight-recorder
-  // directory configured, or the swrefine protocol channel requested
+  // directory configured, the swrefine protocol channel requested, or the
+  // §25 stall sentinel armed (EV_STALL alerts need a ring to land in)
   // (core/swtrace.py active()/proto_active() are the Python twins).
   void init() {
     const char* t = getenv("STARWAY_TRACE");
     const char* f = getenv("STARWAY_FLIGHT_DIR");
     const char* p = getenv("STARWAY_PROTO_TRACE");
     const char* m = getenv("STARWAY_MONITOR");
+    const char* s = getenv("STARWAY_STALL_MS");
     proto = (p && *p && strcmp(p, "0") != 0) ||
             (m && *m && strcmp(m, "0") != 0);
-    enabled = (t && *t && strcmp(t, "0") != 0) || (f && *f) || proto;
+    enabled = (t && *t && strcmp(t, "0") != 0) || (f && *f) || proto ||
+              (s && strtod(s, nullptr) > 0);
     if (!enabled) return;
     const char* rs = getenv("STARWAY_TRACE_RING");
     uint64_t c = rs ? strtoull(rs, nullptr, 10) : 4096;
@@ -417,6 +483,21 @@ uint64_t now_ns() {
   return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
              Clock::now().time_since_epoch())
       .count();
+}
+
+// Monotonic seconds (the trace ring's `t` epoch): the §25 histogram taps
+// stamp origins and diff against this.
+inline double mono_s() {
+  return std::chrono::duration<double>(Clock::now().time_since_epoch())
+      .count();
+}
+
+// §25 stall-sentinel threshold (STARWAY_STALL_MS, ms; 0/unset = off).
+// Sampled once per worker at engine start, like the §24 levers.
+double stall_ms_env() {
+  const char* e = getenv("STARWAY_STALL_MS");
+  double v = e ? strtod(e, nullptr) : 0.0;
+  return v > 0 ? v : 0.0;
 }
 
 uint64_t rndv_threshold() {
@@ -1227,6 +1308,7 @@ struct PostedRecv {
   sw_fail_cb fail = nullptr;
   void* ctx = nullptr;
   bool claimed = false;
+  double t_post = mono_s();  // swpulse recv_wait_us origin (§25)
 };
 
 struct InboundMsg {
@@ -1253,6 +1335,7 @@ struct InboundMsg {
   // origin conn + incarnation generation + payload bytes so the grant
   // returns the moment the memory is released (Matcher::fc_release).
   uint64_t fc_conn = 0, fc_gen = 0, fc_bytes = 0;
+  double born = mono_s();  // swpulse stall-unexp age origin (§25)
 };
 
 struct FcGrant {
@@ -1267,6 +1350,12 @@ struct Matcher {
   // starts.  Ring appends are lock-free data writes -- legal under mu.
   TraceRing* ring = nullptr;
   Counters* ctr = nullptr;
+  Hists* hst = nullptr;  // swpulse (§25): relaxed bumps, legal under mu
+
+  // swpulse (§25): post -> delivery latency of a completed receive.
+  void pulse_wait(const PostedRecv& pr) {
+    if (hst) hbump(hst->recv_wait_us, (uint64_t)((mono_s() - pr.t_post) * 1e6));
+  }
   // §18 flow control: total spilled unexpected payload bytes (the
   // STARWAY_UNEXP_BYTES cap surface) plus the grant/CTS work the engine
   // thread drains each pass (conn TX is engine territory; matcher paths
@@ -1379,6 +1468,7 @@ struct Matcher {
           rec(kEvRecvMatch, t, n);
           rec(kEvRecvDone, t, n);
           if (ctr) bump(ctr->recvs_completed);
+          pulse_wait(pr_in);
           auto done = pr_in.done; auto ctx = pr_in.ctx;
           fires.push_back([done, ctx, t, n] { done(ctx, t, n); });
           return;
@@ -1506,6 +1596,7 @@ struct Matcher {
       uint64_t t = m->tag, n = m->length;
       rec(kEvRecvDone, t, n);
       if (ctr) bump(ctr->recvs_completed);
+      pulse_wait(m->pr);
       fires.push_back([done, ctx, t, n] { done(ctx, t, n); });
       delete m;
       return;
@@ -1655,6 +1746,7 @@ struct Matcher {
 // bytes must stay stable.
 struct StripeSrc {
   uint64_t msg_id = 0, tag = 0, total = 0, chunk = 0;
+  double t_post = mono_s();  // swpulse (§25): send_local_us/pin_us origin
   const uint8_t* payload = nullptr;
   std::deque<uint64_t> pending;  // unclaimed chunk offsets, FIFO
   // Per-lane chunk ledgers, kept until SACK so a dead rail's share can
@@ -1734,6 +1826,11 @@ struct TxItem {
   StripeRef stripe;
   uint64_t stripe_off = 0;    // payload offset of the current chunk
   double stripe_t0 = 0;       // claim timestamp (lane throughput EWMA)
+  // --- swpulse (DESIGN.md §25) ---
+  // Creation stamp for the send_local_us distribution (0 = not a tagged
+  // data submission), park stamp for park_us (0 = never parked).
+  double t_post = 0;
+  double t_park = 0;
   // --- MSG_ZEROCOPY TX (DESIGN.md §24) ---
   // Kernel page pins outstanding on this payload: MSG_ZEROCOPY shares
   // the user pages with the NIC/loopback skbs, so `release` (= the user
@@ -1969,6 +2066,7 @@ struct FlushRec {
   // (primary conn id -> watermark; DESIGN.md §17).
   std::unordered_map<uint64_t, uint64_t> stripe_waits;
   bool completed = false;
+  double born = mono_s();  // swpulse flush_us origin + stall-flush age (§25)
 };
 
 // ------------------------------------------------------------------ ops
@@ -2035,6 +2133,15 @@ struct Worker {
   // atomics); the trace ring armed per worker at creation (env knobs).
   Counters counters;
   TraceRing trace;
+  // swpulse (DESIGN.md §25): always-on histograms (relaxed atomics, like
+  // the counters) + the opt-in stall sentinel's engine-thread state.
+  Hists hists;
+  double stall_s = 0;              // threshold seconds (0 = sentinel off)
+  Clock::time_point next_stall{};  // next sentinel scan
+  uint64_t stall_prog = 0;         // progress sum at the last scan
+  // Live alert keys (reason literal, condition id): a condition alerts
+  // once until it clears -- the set is rebuilt each scan.
+  std::set<std::pair<const void*, uint64_t>> stall_seen;
   int epfd = -1, evfd = -1;
   // §24 swfast lever state: sampled once per worker at engine start.
   // uring.ok() false = epoll core (the default and the probe fallback).
@@ -2256,6 +2363,7 @@ struct Worker {
       return;
     }
     auto item = std::make_shared<TxItem>();
+    item->t_post = mono_s();  // swpulse send_local_us origin (§25)
     item->header.resize(HEADER_SIZE);
     pack_header(item->header.data(), T_DATA, op.tag, op.len);
     item->payload = op.buf;
@@ -2349,6 +2457,7 @@ struct Worker {
   // matcher is part of the matching contract.
   void fc_send(Conn* c, const TxRef& item, FireList& fires) {
     if (!c->fc_waiting.empty()) {
+      item->t_park = mono_s();  // swpulse park_us origin (§25)
       c->fc_waiting.push_back(item);
       bump(counters.sends_parked);
       return;
@@ -2358,6 +2467,7 @@ struct Worker {
       return;
     }
     if (!fc_admit(c, item->paylen)) {
+      item->t_park = mono_s();  // swpulse park_us origin (§25)
       c->fc_waiting.push_back(item);
       bump(counters.sends_parked);
       return;
@@ -2373,16 +2483,19 @@ struct Worker {
       TxRef item = c->fc_waiting.front();
       if (item->local_done) {  // shed by a deadline while parked
         c->fc_waiting.pop_front();
+        pulse_unpark(*item);
         continue;
       }
       if (item->rndv) {
         c->fc_waiting.pop_front();
+        pulse_unpark(*item);
         fc_rts_announce(c, item, fires);
         moved = true;
         continue;
       }
       if (!fc_admit(c, item->paylen)) break;
       c->fc_waiting.pop_front();
+      pulse_unpark(*item);
       fc_dispatch_eager(c, item, fires, /*kick=*/false);
       moved = true;
     }
@@ -3199,6 +3312,8 @@ struct Worker {
     if (src->local_done) return;
     // Transmission begun: rndv-style local completion for the message.
     src->local_done = true;
+    // swpulse (§25): striped submit -> first wire progress.
+    hbump(hists.send_local_us, (uint64_t)((mono_s() - src->t_post) * 1e6));
     if (src->done) {
       auto done = src->done; auto ctx = src->ctx;
       fires.push_back([done, ctx] { done(ctx); });
@@ -3409,6 +3524,8 @@ struct Worker {
     root->stripe_by_id.erase(it);
     if (!src->sacked) {
       src->sacked = true;
+      // swpulse (§25): §17 payload-pin residency, submit -> SACK.
+      hbump(hists.pin_us, (uint64_t)((mono_s() - src->t_post) * 1e6));
       stripe_maybe_release(*src, fires);
     }
     auto snapshot = flushes;
@@ -4020,6 +4137,24 @@ struct Worker {
     return w;
   }
 
+  // swpulse (§25): one send_local_us bump at the local-completion
+  // transition -- a clock read + a relaxed increment, nothing else.
+  // Callers guard with `!local_done`, so a session replay cannot
+  // re-measure.  t_post == 0 (feeder/ctl items) records nothing.
+  void pulse_local(const TxItem& item) {
+    if (item.t_post > 0)
+      hbump(hists.send_local_us, (uint64_t)((mono_s() - item.t_post) * 1e6));
+  }
+
+  // swpulse (§25): one park_us bump as a §18-parked send leaves the park
+  // queue (drained, shed, or re-announced).
+  void pulse_unpark(TxItem& item) {
+    if (item.t_park > 0) {
+      hbump(hists.park_us, (uint64_t)((mono_s() - item.t_park) * 1e6));
+      item.t_park = 0;
+    }
+  }
+
   // A tagged (is_data) TxItem fully handed to the transport: account it
   // and record its send_done event (tag lives in the packed header).
   // `counted` makes this once-only: a session replay re-writes journaled
@@ -4072,6 +4207,7 @@ struct Worker {
       if (item.is_data && item.rndv && !item.local_done &&
           item.off >= item.header.size()) {
         item.local_done = true;
+        pulse_local(item);
         if (item.done) {
           auto done = item.done; auto ctx = item.ctx;
           fires.push_back([done, ctx] { done(ctx); });
@@ -4092,6 +4228,7 @@ struct Worker {
         }
         if (item.is_data && !item.local_done) {
           item.local_done = true;
+          pulse_local(item);
           if (item.done) {
             auto done = item.done; auto ctx = item.ctx;
             fires.push_back([done, ctx] { done(ctx); });
@@ -4163,6 +4300,7 @@ struct Worker {
         if (item.stripe) stripe_first_progress(item.stripe, fires);
         if (item.is_data && item.rndv && !item.local_done && item.off >= hlen) {
           item.local_done = true;
+          pulse_local(item);
           if (item.done) {
             auto done = item.done; auto ctx = item.ctx;
             fires.push_back([done, ctx] { done(ctx); });
@@ -4180,6 +4318,7 @@ struct Worker {
         }
         if (item.is_data && !item.local_done) {
           item.local_done = true;
+          pulse_local(item);
           if (item.done) {
             auto done = item.done; auto ctx = item.ctx;
             fires.push_back([done, ctx] { done(ctx); });
@@ -4454,6 +4593,10 @@ struct Worker {
       c->zc_outstanding.pop_front();
       if (ref->zc_pins > 0) ref->zc_pins--;
       bump(counters.zc_notifies);
+      // swpulse (§25): §24 kernel-pin residency, send post -> last
+      // errqueue notification for the item.
+      if (ref->zc_pins == 0 && ref->t_post > 0)
+        hbump(hists.pin_us, (uint64_t)((mono_s() - ref->t_post) * 1e6));
       if (ref->zc_pins == 0 && ref->zc_deferred) {
         ref->zc_deferred = false;
         fire_release(*ref, fires);
@@ -5117,6 +5260,8 @@ struct Worker {
       rec->completed = true;
       remove_flush(rec);
       bump(counters.flushes_completed);
+      // swpulse (§25): barrier post -> all-target acknowledgement.
+      hbump(hists.flush_us, (uint64_t)((mono_s() - rec->born) * 1e6));
       trace.rec(kEvFlushDone);
       auto done = rec->done; auto ctx = rec->ctx;
       if (done) fires.push_back([done, ctx] { done(ctx); });
@@ -5444,6 +5589,99 @@ struct Worker {
       next_ka = now + std::chrono::duration_cast<Clock::duration>(
                           std::chrono::duration<double>(ka_interval));
       ka_tick(fires);
+    }
+  }
+
+  // ------------------------------------- §25 swpulse stall sentinel
+  //
+  // Engine-thread self-detection, armed only by STARWAY_STALL_MS (the
+  // env-unset loop takes zero sentinel branches past one double test per
+  // pass).  The telemetry thread (core/telemetry.py _stall_tick) watches
+  // this worker's stall_alerts delta and reshapes the ring's EV_STALL
+  // records into the unified report stream -- so the alert encoding
+  // (conn, nbytes = age ms, reason = kStallReasons entry) is contract
+  // surface with the Python engine's Worker.stall_scan.
+
+  // Sum of every counter except stall_alerts: any movement between scans
+  // clears suspicion (bytes_tx/rx are in here, so a long streaming
+  // transfer registers progress and never false-alarms).
+  uint64_t progress_sum() {
+    Counters& c = counters;
+    return c.sends_posted.load() + c.sends_completed.load() +
+           c.recvs_posted.load() + c.recvs_completed.load() +
+           c.flushes_posted.load() + c.flushes_completed.load() +
+           c.ops_timed_out.load() + c.ops_cancelled.load() +
+           c.bytes_tx.load() + c.bytes_rx.load() +
+           c.gather_passes.load() + c.gather_items.load() +
+           c.staging_hits.load() + c.staging_misses.load() +
+           c.ka_misses.load() + c.reconnects.load() +
+           c.sessions_resumed.load() + c.frames_replayed.load() +
+           c.dup_frames_dropped.load() +
+           c.acks_tx.load() + c.acks_rx.load() +
+           c.stripe_chunks_tx.load() + c.stripe_chunks_rx.load() +
+           c.rail_resteals.load() +
+           c.sends_parked.load() + c.sheds.load() +
+           c.csum_fail.load() + c.chunk_retx.load() +
+           c.reshard_bytes.load() + c.reshard_rounds.load() +
+           c.io_syscalls.load() + c.hot_copies.load() +
+           c.uring_submits.load() + c.uring_sqes.load() +
+           c.zc_sends.load() + c.zc_notifies.load() +
+           c.busypoll_hits.load();
+  }
+
+  // One sentinel scan: flag no-progress conditions older than stall_s.
+  // The Python engine's Worker.stall_scan is the twin -- same conditions,
+  // same reason vocabulary, same once-until-cleared dedup.
+  void stall_tick() {
+    double now = mono_s();
+    uint64_t prog = progress_sum();
+    bool progressed = prog != stall_prog;
+    stall_prog = prog;
+    struct Alert { const char* reason; uint64_t conn, age_ms; };
+    std::vector<Alert> alerts;
+    std::set<std::pair<const void*, uint64_t>> live;
+    if (!progressed && status.load() == ST_RUNNING) {
+      auto flag = [&](const char* reason, uint64_t key_id, uint64_t conn,
+                      double age) {
+        auto key = std::make_pair((const void*)reason, key_id);
+        live.insert(key);
+        if (!stall_seen.count(key))
+          alerts.push_back(Alert{reason, conn, (uint64_t)(age * 1e3)});
+      };
+      // conns is mutated under mu (accept/registration) and the matcher
+      // is shared with app threads (sw_recv runs it under mu): the scan
+      // reads both under the same lock.  Pure reads + lock-free ring/
+      // counter writes -- no user callback fires under mu.
+      std::lock_guard<std::mutex> g(mu);
+      for (auto* rec : flushes) {
+        double age = now - rec->born;
+        if (age > stall_s)
+          flag(kStallReasons[0], (uint64_t)(uintptr_t)rec, 0, age);
+      }
+      for (auto& [id, c] : conns) {
+        if (!c->alive || (c->sess && c->sess->suspended))
+          continue;  // §14 resume owns progress; not a wedge
+        if (!c->fc_waiting.empty() && c->fc_waiting.front()->t_park > 0) {
+          double age = now - c->fc_waiting.front()->t_park;
+          if (age > stall_s) flag(kStallReasons[1], id, id, age);
+        }
+        double oldest = 0;
+        for (auto& [mid, src] : c->stripe_by_id)
+          if (!src->sacked && !src->failed && now - src->t_post > stall_s &&
+              (oldest == 0 || src->t_post < oldest))
+            oldest = src->t_post;
+        if (oldest > 0) flag(kStallReasons[2], id, id, now - oldest);
+      }
+      if (!matcher.unexpected.empty()) {
+        double age = now - matcher.unexpected.front()->born;
+        if (age > stall_s) flag(kStallReasons[3], 0, 0, age);
+      }
+    }
+    stall_seen = std::move(live);
+    if (!alerts.empty()) {
+      bump(counters.stall_alerts, alerts.size());
+      for (auto& a : alerts)
+        trace.rec(kEvStall, 0, a.conn, a.age_ms, a.reason);
     }
   }
 
@@ -5894,11 +6132,22 @@ struct Worker {
     zc_thresh = rndv_threshold();
     if (iouring_enabled() && !std::getenv("STARWAY_IOURING_PROBE_FAIL"))
       uring.init(256);
+    // §25 stall sentinel, sampled once per worker lifetime like the
+    // levers above (0 = off: the loop below takes no sentinel branch
+    // beyond one double comparison per pass).
+    stall_s = stall_ms_env() / 1e3;
+    if (stall_s > 0) next_stall = Clock::now();
     epoll_event events[64];
     auto spin_until = Clock::time_point::min();
     for (;;) {
       if (status.load() == ST_CLOSING) break;
       int timeout = poll_timeout_ms();
+      if (stall_s > 0) {
+        // Scan at half the threshold so a wedge is flagged within ~1.5x.
+        int cap_ms = (int)(stall_s * 500);
+        if (cap_ms < 10) cap_ms = 10;
+        if (timeout < 0 || timeout > cap_ms) timeout = cap_ms;
+      }
       bool spinning = false;
       if (busypoll_us > 0 && Clock::now() < spin_until) {
         timeout = 0;  // §24 bounded busy-poll: nonblocking inside the window
@@ -5933,6 +6182,11 @@ struct Worker {
         }
       }
       check_timers(fires);
+      if (stall_s > 0 && Clock::now() >= next_stall) {
+        next_stall = Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                                        std::chrono::duration<double>(stall_s / 2));
+        stall_tick();
+      }
       drain_ops(fires);
       fc_service(fires);  // §18 grants/CTS queued by matcher paths
       uring_service(fires);  // §24 batched submit of deferred TX (no-op off)
@@ -6440,7 +6694,11 @@ extern "C" {
 //    MSG_ZEROCOPY >= rndv payloads, bounded busy-poll) + the
 //    sw_fast_probe capability export; no wire/HELLO change, seed path
 //    byte-identical with the envs unset -- DESIGN.md §24
-const char* sw_version() { return "starway-native-13"; }
+// 12: swpulse always-on latency/size histograms (kHistNames vocabulary,
+//    sw_hists export) + the opt-in STARWAY_STALL_MS stall sentinel
+//    (EV_STALL alerts, stall_alerts counter); no wire/HELLO change --
+//    DESIGN.md §25
+const char* sw_version() { return "starway-native-14"; }
 
 // swfast capability probe (sw_engine.h, DESIGN.md §24): which levers can
 // this build+kernel actually engage?  bit0 io_uring, bit1 MSG_ZEROCOPY,
@@ -6509,6 +6767,7 @@ void* sw_client_new(const char* worker_id) {
   w->trace.init();
   w->matcher.ring = &w->trace;
   w->matcher.ctr = &w->counters;
+  w->matcher.hst = &w->hists;
   return w;
 }
 
@@ -6533,6 +6792,7 @@ void* sw_server_new(const char* worker_id) {
   w->trace.init();
   w->matcher.ring = &w->trace;
   w->matcher.ctr = &w->counters;
+  w->matcher.hst = &w->hists;
   return w;
 }
 
@@ -6610,6 +6870,7 @@ int sw_send(void* h, uint64_t conn_id, const void* buf, uint64_t len, uint64_t t
     // thread may complete the op, and its DONE event must not precede
     // this POST in the ring.
     bump(w->counters.sends_posted);
+    hbump(w->hists.msg_bytes, len);  // swpulse (§25)
     w->trace.rec(kEvSendPost, tag, conn_id, len);
   }
   if (timeout_s > 0) w->add_timer(Timer::SEND, ctx, timeout_s);
@@ -6678,6 +6939,9 @@ int sw_send_devpull(void* h, uint64_t conn_id, uint64_t tag,
     op.ctx = ctx;
     w->ops.push_back(op);
     bump(w->counters.sends_posted);  // under mu: POST must precede DONE
+    // swpulse (§25): size of the advertised payload, not the descriptor
+    // body -- the Python engine's submit_devpull twin.
+    hbump(w->hists.msg_bytes, json_num_field(op.body, "n"));
     w->trace.rec(kEvSendPost, tag, conn_id, len);
   }
   w->wake();
@@ -6835,6 +7099,7 @@ int sw_counters(void* h, char* out, int cap) {
       c.uring_submits.load(),  c.uring_sqes.load(),
       c.zc_sends.load(),       c.zc_notifies.load(),
       c.busypoll_hits.load(),
+      c.stall_alerts.load(),
   };
   constexpr size_t kN = sizeof(kCounterNames) / sizeof(kCounterNames[0]);
   static_assert(sizeof(vals) / sizeof(vals[0]) == kN,
@@ -6846,6 +7111,42 @@ int sw_counters(void* h, char* out, int cap) {
                      (unsigned long long)vals[i]);
     if (m < 0 || off + m >= cap) return -1;
     off += m;
+  }
+  if (off + 2 >= cap) return -1;
+  out[off++] = '}';
+  out[off] = 0;
+  return off;
+}
+
+// swpulse histogram snapshot (sw_engine.h, DESIGN.md §25): a JSON object
+// {"<name>": [64 bucket counts], ...} over the kHistNames vocabulary, in
+// declaration order.  Thread-safe: relaxed loads of the atomic arrays.
+int sw_hists(void* h, char* out, int cap) {
+  Worker* w = W(h);
+  Hists& hs = w->hists;
+  const std::atomic<uint64_t>* rows[] = {
+      hs.send_local_us, hs.recv_wait_us, hs.flush_us,
+      hs.park_us,       hs.pin_us,       hs.msg_bytes,
+  };
+  constexpr size_t kN = sizeof(kHistNames) / sizeof(kHistNames[0]);
+  static_assert(sizeof(rows) / sizeof(rows[0]) == kN,
+                "hist names and rows out of sync");
+  int off = 0;
+  for (size_t i = 0; i < kN; i++) {
+    int m = snprintf(out + off, cap > off ? (size_t)(cap - off) : 0,
+                     "%s\"%s\": [", i == 0 ? "{" : ", ", kHistNames[i]);
+    if (m < 0 || off + m >= cap) return -1;
+    off += m;
+    for (int b = 0; b < kHistBuckets; b++) {
+      m = snprintf(out + off, cap > off ? (size_t)(cap - off) : 0,
+                   "%s%llu", b == 0 ? "" : ", ",
+                   (unsigned long long)rows[i][b].load(
+                       std::memory_order_relaxed));
+      if (m < 0 || off + m >= cap) return -1;
+      off += m;
+    }
+    if (off + 1 >= cap) return -1;
+    out[off++] = ']';
   }
   if (off + 2 >= cap) return -1;
   out[off++] = '}';

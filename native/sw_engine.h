@@ -83,11 +83,13 @@ typedef void (*sw_event_cb)(void* ctx, const char* event, uint64_t conn_id);
  * violations in both engines; T_CSUM prefixes truncate to the 32-bit
  * CRC -- DESIGN.md §21) + the swrefine protocol-event channel (EV_PROTO
  * events on the swtrace ring, armed by STARWAY_PROTO_TRACE /
- * STARWAY_MONITOR; no wire change -- DESIGN.md §22).  The annotation
+ * STARWAY_MONITOR; no wire change -- DESIGN.md §22) + swpulse always-on
+ * latency/size histograms and the opt-in stall sentinel (sw_hists,
+ * STARWAY_STALL_MS, EV_STALL -- DESIGN.md §25).  The annotation
  * below is machine-checked against the sw_engine.cpp implementation by
  * the contract checker (python -m starway_tpu.analysis, rule
  * contract-version) -- bump BOTH when the protocol changes.
- * swcheck: engine-version "starway-native-13" */
+ * swcheck: engine-version "starway-native-14" */
 const char* sw_version(void);
 
 /* swfast capability probe (DESIGN.md §24).  Bitmask of the levers this
@@ -201,6 +203,16 @@ int sw_conn_info(void* h, uint64_t conn_id, char* out, int cap);
  * lifecycle state until sw_free.  Returns the body length, or -1 when
  * `cap` is too small. */
 int sw_counters(void* h, char* out, int cap);
+
+/* swpulse histogram snapshot (DESIGN.md §25): a JSON object
+ * {"<name>": [64 bucket counts], ...} over the kHistNames vocabulary
+ * (the core/swtrace.py HIST_NAMES twin, machine-checked by rule
+ * contract-trace).  Log-bucketed: bucket i counts values of bit-length i
+ * (zero -> bucket 0); latencies in microseconds, sizes in bytes.
+ * Always live (the taps are unconditional, like the counters);
+ * thread-safe relaxed loads.  Returns the body length, or -1 when `cap`
+ * is too small. */
+int sw_hists(void* h, char* out, int cap);
 
 /* Trace-ring dump as a JSON array, oldest event first, each
  * {"t": seconds, "ev": "...", "tag": N, "conn": N, "n": N, "reason": "..."}

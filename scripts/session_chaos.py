@@ -118,6 +118,10 @@ async def _main(args) -> int:
     os.environ.setdefault("STARWAY_SESSION_GRACE", "30")
     # Arm the swscope sampler so progress prints come from live samples.
     os.environ.setdefault("STARWAY_METRICS_INTERVAL", "0.25")
+    # swpulse sentinel (DESIGN.md §25): every chaos schedule doubles as a
+    # liveness check -- kills + resumes are PROGRESS, so a healthy soak
+    # must end with zero stall_alerts (asserted in the oracle below).
+    os.environ.setdefault("STARWAY_STALL_MS", "5000")
 
     import socket
 
@@ -176,13 +180,17 @@ async def _main(args) -> int:
             "frames_replayed": cs["frames_replayed"],
             "dup_frames_dropped": ss["dup_frames_dropped"],
             "ops_failed": cs["ops_timed_out"] + ss["ops_timed_out"],
+            "stall_alerts": cs["stall_alerts"] + ss["stall_alerts"],
         }
         # The exactly-once oracle: each posted recv completed ONCE (the
         # matcher never double-fires a future, so == total also rules out
         # duplicate delivery), and the outage was ridden through by
-        # resume, not by fresh conns.
+        # resume, not by fresh conns.  The §25 sentinel doubles as the
+        # liveness oracle: a schedule that completes must never have
+        # tripped a stall alert along the way.
         ok = (ss["recvs_completed"] == total
-              and report["sessions_resumed"] >= 1)
+              and report["sessions_resumed"] >= 1
+              and report["stall_alerts"] == 0)
         ok = _monitor_check(report) and ok
         report["ok"] = ok
         print(json.dumps(report))
@@ -214,6 +222,7 @@ async def _corrupt_soak(args) -> int:
     os.environ["STARWAY_STRIPE_THRESHOLD"] = str(1 << 20)
     os.environ["STARWAY_STRIPE_CHUNK"] = str(256 << 10)
     os.environ.setdefault("STARWAY_METRICS_INTERVAL", "0.25")
+    os.environ.setdefault("STARWAY_STALL_MS", "5000")  # §25 liveness oracle
 
     import socket
 
@@ -289,6 +298,7 @@ async def _corrupt_soak(args) -> int:
             "csum_fail": detected,
             "chunk_retx": retx,
             "sessions_resumed": cs["sessions_resumed"] + ss["sessions_resumed"],
+            "stall_alerts": cs["stall_alerts"] + ss["stall_alerts"],
         }
         # The inadmissible outcome is SILENT corruption -- pinned by the
         # byte-exact payload asserts above.  Detection counts are
@@ -300,7 +310,8 @@ async def _corrupt_soak(args) -> int:
               and proxy.corrupted_units >= 1
               and detected >= 1
               and retx >= 1
-              and report["sessions_resumed"] >= 1)
+              and report["sessions_resumed"] >= 1
+              and report["stall_alerts"] == 0)
         ok = _monitor_check(report) and ok
         report["ok"] = ok
         print(json.dumps(report))
@@ -328,6 +339,7 @@ async def _overload(args) -> int:
     os.environ.setdefault("STARWAY_SESSION_GRACE", "30")
     os.environ["STARWAY_FC_WINDOW"] = str(args.fc_window)
     os.environ.setdefault("STARWAY_METRICS_INTERVAL", "0.25")
+    os.environ.setdefault("STARWAY_STALL_MS", "5000")  # §25 liveness oracle
 
     import random
     import socket
@@ -424,9 +436,13 @@ async def _overload(args) -> int:
             "sends_parked": parked,
             "peak_unexp_bytes": peak_unexp,
             "unexp_bound": bound,
+            "stall_alerts": ss["stall_alerts"] + sum(
+                c._client.counters_snapshot()["stall_alerts"]
+                for c in clients),
         }
         ok = (ss["recvs_completed"] == total and resumes >= 1
-              and peak_unexp <= bound)
+              and peak_unexp <= bound
+              and report["stall_alerts"] == 0)
         ok = _monitor_check(report) and ok
         report["ok"] = ok
         print(json.dumps(report))

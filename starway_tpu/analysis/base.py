@@ -29,6 +29,8 @@ RULES = {
     "contract-version": "native engine version string drifted",
     "contract-doctable": "frames.py docstring frame table drifted",
     "contract-trace": "swtrace event/counter vocabulary differs between engines",
+    "contract-pulse": "swpulse histogram/stall vocabulary or bucket "
+                      "resolution differs between engines",
     "callback-under-lock": "user callback invoked while holding a worker lock",
     "blocking-call": "blocking call reachable on the engine thread",
     "reachable-blocking": "blocking call reachable while a worker lock is held",

@@ -374,6 +374,75 @@ def _check_trace(py: PyModel, cpp: CppModel, out: list) -> None:
                 "(a gauge added to one engine only)"))
 
 
+def _check_pulse(py: PyModel, cpp: CppModel, out: list) -> None:
+    """swpulse vocabulary parity (DESIGN.md §25): the histogram name
+    vocabulary (HIST_NAMES <-> kHistNames[], ORDER included -- it is the
+    sw_hists row order), the bucket resolution (HIST_BUCKETS <->
+    kHistBuckets) and the stall sentinel reasons (STALL_REASONS <->
+    kStallReasons[]) must exist, identically, in both engines.  Vacuity
+    guarded: a missing vocabulary is a finding, never a silent pass."""
+    f_sw = py.files["swtrace"]
+    if py.hist_names is None:
+        out.append(Finding(f_sw, 1, "contract-pulse",
+                           "HIST_NAMES tuple not found"))
+        return
+    if cpp.hist_names is None:
+        out.append(Finding(cpp.cpp_file, 1, "contract-pulse",
+                           "kHistNames[] array not found"))
+        return
+    ph_names, ph_line = py.hist_names
+    ch_names, ch_line = cpp.hist_names
+    for name in ph_names:
+        if name not in ch_names:
+            out.append(Finding(
+                f_sw, ph_line, "contract-pulse",
+                f"histogram {name!r} is declared in HIST_NAMES only -- "
+                f"{cpp.cpp_file}:{ch_line} kHistNames[] lacks it "
+                "(a histogram added to one engine only)"))
+    for name in ch_names:
+        if name not in ph_names:
+            out.append(Finding(
+                cpp.cpp_file, ch_line, "contract-pulse",
+                f"histogram {name!r} is declared in kHistNames[] only -- "
+                f"{f_sw}:{ph_line} HIST_NAMES lacks it "
+                "(a histogram added to one engine only)"))
+    if set(ph_names) == set(ch_names) and ph_names != ch_names:
+        out.append(Finding(
+            cpp.cpp_file, ch_line, "contract-pulse",
+            f"kHistNames[] order {ch_names} differs from "
+            f"{f_sw}:{ph_line} HIST_NAMES {ph_names} -- the order is the "
+            "sw_hists row order and must match"))
+    if py.hist_buckets is None:
+        out.append(Finding(f_sw, 1, "contract-pulse",
+                           "HIST_BUCKETS constant not found"))
+    elif "kHistBuckets" not in cpp.constants:
+        out.append(Finding(cpp.cpp_file, 1, "contract-pulse",
+                           "kHistBuckets constexpr not found"))
+    elif cpp.constants["kHistBuckets"][0] != py.hist_buckets[0]:
+        cval, cline = cpp.constants["kHistBuckets"]
+        out.append(Finding(
+            f_sw, py.hist_buckets[1], "contract-pulse",
+            f"HIST_BUCKETS = {py.hist_buckets[0]} but "
+            f"{cpp.cpp_file}:{cline} has kHistBuckets = {cval} "
+            "(the bucket boundaries must be identical in both engines)"))
+    if py.stall_reasons is None:
+        out.append(Finding(f_sw, 1, "contract-pulse",
+                           "STALL_REASONS tuple not found"))
+        return
+    if cpp.stall_reasons is None:
+        out.append(Finding(cpp.cpp_file, 1, "contract-pulse",
+                           "kStallReasons[] array not found"))
+        return
+    ps_names, ps_line = py.stall_reasons
+    cs_names, cs_line = cpp.stall_reasons
+    if ps_names != cs_names:
+        out.append(Finding(
+            cpp.cpp_file, cs_line, "contract-pulse",
+            f"kStallReasons[] {cs_names} differs from {f_sw}:{ps_line} "
+            f"STALL_REASONS {ps_names} -- stall reports must carry the "
+            "same reason strings from both engines"))
+
+
 def _check_version(cpp: CppModel, out: list) -> None:
     if cpp.version is None:
         out.append(Finding(cpp.cpp_file, 1, "contract-version",
@@ -466,6 +535,7 @@ def run(root: Path) -> list:
     _check_reasons(py, cpp, out)
     _check_handshake(py, cpp, out)
     _check_trace(py, cpp, out)
+    _check_pulse(py, cpp, out)
     _check_version(cpp, out)
     _check_doctable(py, out)
     return out

@@ -65,6 +65,8 @@ class CppModel:
     trace_events: dict = field(default_factory=dict)  # kEv* -> (str, line)
     counter_names: Optional[tuple] = None           # (list[str], line)
     gauge_names: Optional[tuple] = None             # (list[str], line)
+    hist_names: Optional[tuple] = None              # (list[str], line)
+    stall_reasons: Optional[tuple] = None           # (list[str], line)
     version: Optional[tuple] = None                 # (str, line) from .cpp
     header_version: Optional[tuple] = None          # (str, line) from .h
     functions: dict = field(default_factory=dict)   # name -> CppFunc (.h)
@@ -92,6 +94,18 @@ _COUNTERS_RE = re.compile(
 # vocabulary (contract-trace pairs it with core/telemetry.py GAUGE_NAMES).
 _GAUGES_RE = re.compile(
     r"const\s+char\s*\*\s*kGaugeNames\s*\[\s*\]\s*=\s*\{([^}]*)\}", re.S
+)
+
+# const char* kHistNames[] = {"a", ...}; -- the swpulse histogram
+# vocabulary (contract-pulse pairs it with core/swtrace.py HIST_NAMES).
+_HISTS_RE = re.compile(
+    r"const\s+char\s*\*\s*kHistNames\s*\[\s*\]\s*=\s*\{([^}]*)\}", re.S
+)
+
+# const char* kStallReasons[] = {"stall-flush", ...}; -- the swpulse
+# sentinel vocabulary (contract-pulse pairs it with STALL_REASONS).
+_STALLS_RE = re.compile(
+    r"const\s+char\s*\*\s*kStallReasons\s*\[\s*\]\s*=\s*\{([^}]*)\}", re.S
 )
 
 _VERSION_RE = re.compile(
@@ -172,6 +186,14 @@ def extract_cpp(root: Path) -> CppModel:
         if m:
             names = re.findall(r'"([^"]*)"', m.group(1))
             model.gauge_names = (names, _line_of(text, m.start()))
+        m = _HISTS_RE.search(text)
+        if m:
+            names = re.findall(r'"([^"]*)"', m.group(1))
+            model.hist_names = (names, _line_of(text, m.start()))
+        m = _STALLS_RE.search(text)
+        if m:
+            names = re.findall(r'"([^"]*)"', m.group(1))
+            model.stall_reasons = (names, _line_of(text, m.start()))
         m = _VERSION_RE.search(text)
         if m:
             model.version = (m.group(1), _line_of(text, m.start()))

@@ -133,6 +133,9 @@ class PyModel:
     trace_events: dict = field(default_factory=dict)  # EV_* -> (str, line)
     counter_names: Optional[tuple] = None            # (list[str], line)
     gauge_names: Optional[tuple] = None              # (list[str], line)
+    hist_names: Optional[tuple] = None               # (list[str], line)
+    hist_buckets: Optional[tuple] = None             # (int, line)
+    stall_reasons: Optional[tuple] = None            # (list[str], line)
     native_text: str = ""                            # core/native.py source
     files: dict = field(default_factory=dict)        # logical -> repo-rel path
 
@@ -238,17 +241,32 @@ def extract_py(root: Path) -> PyModel:
             k: v for k, v in module_str_constants(tree).items()
             if k.startswith("EV_")
         }
+        # HIST_BUCKETS = 64 -- the swpulse histogram resolution
+        # (contract-pulse pairs it with the kHistBuckets constexpr).
+        consts = module_int_constants(tree)
+        if "HIST_BUCKETS" in consts:
+            model.hist_buckets = consts["HIST_BUCKETS"]
         for node in tree.body:
             # COUNTER_NAMES = ("sends_posted", ...) -- the shared counter
-            # vocabulary (contract-trace pairs it with kCounterNames[]).
+            # vocabulary (contract-trace pairs it with kCounterNames[]);
+            # HIST_NAMES / STALL_REASONS are the swpulse twins (DESIGN.md
+            # §25; contract-pulse pairs them with kHistNames[] /
+            # kStallReasons[]).
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name) \
-                    and node.targets[0].id == "COUNTER_NAMES" \
+                    and node.targets[0].id in ("COUNTER_NAMES", "HIST_NAMES",
+                                               "STALL_REASONS") \
                     and isinstance(node.value, (ast.Tuple, ast.List)):
                 names = [e.value for e in node.value.elts
                          if isinstance(e, ast.Constant)
                          and isinstance(e.value, str)]
-                model.counter_names = (names, node.lineno)
+                rec = (names, node.lineno)
+                if node.targets[0].id == "COUNTER_NAMES":
+                    model.counter_names = rec
+                elif node.targets[0].id == "HIST_NAMES":
+                    model.hist_names = rec
+                else:
+                    model.stall_reasons = rec
 
     tree = _parse(core / "telemetry.py")
     if tree is not None:

@@ -300,6 +300,7 @@ __all__ = [
     "metrics_path",
     "metrics_addr",
     "metrics_ring_size",
+    "stall_ms",
 ]
 
 
@@ -553,6 +554,17 @@ def metrics_ring_size() -> int:
     except ValueError:
         return 512
     return max(16, v)
+
+
+def stall_ms() -> float:
+    """swpulse stall-sentinel threshold in milliseconds (STARWAY_STALL_MS);
+    0 (the default) disables the sentinel entirely -- the seed path takes
+    zero sentinel branches (DESIGN.md §25)."""
+    try:
+        v = float(_env("STARWAY_STALL_MS", "0"))
+    except ValueError:
+        return 0.0
+    return v if v > 0 else 0.0
 
 
 def use_native() -> bool:

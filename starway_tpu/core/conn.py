@@ -86,6 +86,11 @@ _CSUM_BODY = frames.CSUM_BODY
 DB_DATA = 1
 DB_STARVING = 2
 
+# swpulse (DESIGN.md §25): sink for items built without a worker-backed
+# histogram set (tests constructing bare conns) -- the bump sites then
+# never branch.  Mirrors the ``_ctr`` fallback in BaseConn.__init__.
+_ORPHAN_HISTS = swtrace.Hists()
+
 
 class TxData:
     """An outgoing tagged message (header + zero-copy payload view).
@@ -104,10 +109,11 @@ class TxData:
     # would have fired.
     __slots__ = ("header", "payload", "nbytes", "tag", "off", "done", "fail",
                  "owner", "rndv", "local_done", "switch_after", "counted",
-                 "sess_seq", "sess_nbytes", "e2e_ord",
-                 "_chunk_start", "_chunk_view", "__weakref__")
+                 "sess_seq", "sess_nbytes", "e2e_ord", "t_post", "t_park",
+                 "hists", "_chunk_start", "_chunk_view", "__weakref__")
 
-    def __init__(self, tag: int, payload, done, fail, owner):
+    def __init__(self, tag: int, payload, done, fail, owner,
+                 hists: Optional[swtrace.Hists] = None):
         if isinstance(payload, memoryview):
             self.nbytes = len(payload)
             self._chunk_start = 0
@@ -130,6 +136,24 @@ class TxData:
         self.sess_seq = 0     # session sequence number (0 = unframed)
         self.sess_nbytes = 0  # journal accounting (prefix + header + payload)
         self.e2e_ord = 0      # swscope wire ordinal (assigned at first full TX)
+        # swpulse (DESIGN.md §25): creation stamp for the send_local_us
+        # distribution, park stamp for park_us (0 = never parked).
+        self.t_post = time.perf_counter()
+        self.t_park = 0.0
+        self.hists = hists if hists is not None else _ORPHAN_HISTS
+
+    def _pulse_local(self) -> None:
+        """One send_local_us bump at the local-completion transition
+        (§25): a clock read + an array increment, nothing else."""
+        us = int((time.perf_counter() - self.t_post) * 1e6)
+        self.hists.send_local_us[swtrace.hist_bucket(us)] += 1
+
+    def _pulse_unpark(self) -> None:
+        """One park_us bump as a §18-parked send leaves the park queue."""
+        if self.t_park:
+            us = int((time.perf_counter() - self.t_park) * 1e6)
+            self.hists.park_us[swtrace.hist_bucket(us)] += 1
+            self.t_park = 0.0
 
     @property
     def total(self) -> int:
@@ -170,6 +194,7 @@ class TxData:
         self._maybe_local_complete(fires)
         if self.off >= self.total and not self.local_done:
             self.local_done = True
+            self._pulse_local()
             if self.done is not None:
                 fires.append(self.done)
 
@@ -200,6 +225,7 @@ class TxData:
             self._maybe_local_complete(fires)
         if not self.local_done:
             self.local_done = True
+            self._pulse_local()
             if self.done is not None:
                 fires.append(self.done)
         return True
@@ -208,6 +234,7 @@ class TxData:
         # Rendezvous local completion: transmission begun (header written).
         if self.rndv and not self.local_done and self.off >= len(self.header):
             self.local_done = True
+            self._pulse_local()
             if self.done is not None:
                 fires.append(self.done)
 
@@ -393,6 +420,8 @@ class BaseConn:
         # swtrace counters + per-worker stage scope, cached so the data
         # path pays one attribute load per sample (DESIGN.md §13).
         self._ctr = getattr(worker, "counters", None) or swtrace.Counters()
+        # swpulse distributions (DESIGN.md §25), cached like the counters.
+        self._hists = getattr(worker, "hists", None) or _ORPHAN_HISTS
         self._scope = getattr(worker, "stage_scope", None)
         # swscope (DESIGN.md §15): the worker's trace ring (None = dark),
         # the negotiated trace-conn id ("tr" handshake key; "" until both
@@ -802,7 +831,7 @@ class TcpConn(BaseConn):
             return self._fc_send(tag, payload, done, fail, owner, fires, kick)
         self.dirty = True
         self._data_counter += 1
-        item = TxData(tag, payload, done, fail, owner)
+        item = TxData(tag, payload, done, fail, owner, self._hists)
         self._csum_arm(item)
         if self.sess is not None:
             self._sess_submit(item, fires, kick)
@@ -1168,8 +1197,9 @@ class TcpConn(BaseConn):
         window, announce rendezvous sends via RTS.  Once anything is
         parked, EVERYTHING parks behind it -- FIFO arrival order at the
         receiver's matcher is part of the matching contract."""
-        item = TxData(tag, payload, done, fail, owner)
+        item = TxData(tag, payload, done, fail, owner, self._hists)
         if self.fc_waiting:
+            item.t_park = time.perf_counter()
             self.fc_waiting.append(item)
             self._ctr.sends_parked += 1
             return item
@@ -1177,6 +1207,7 @@ class TcpConn(BaseConn):
             self._fc_rts_announce(item, fires, kick)
             return item
         if not self._fc_admit(item.nbytes):
+            item.t_park = time.perf_counter()
             self.fc_waiting.append(item)
             self._ctr.sends_parked += 1
             return item
@@ -1242,15 +1273,18 @@ class TcpConn(BaseConn):
             item = self.fc_waiting[0]
             if item.local_done:  # shed by a deadline while parked
                 self.fc_waiting.popleft()
+                item._pulse_unpark()
                 continue
             if item.rndv:
                 self.fc_waiting.popleft()
+                item._pulse_unpark()
                 self._fc_rts_announce(item, fires, kick=False)
                 moved = True
                 continue
             if not self._fc_admit(item.nbytes):
                 break
             self.fc_waiting.popleft()
+            item._pulse_unpark()
             self._fc_dispatch_eager(item, fires, kick=False)
             moved = True
         if moved:
@@ -2211,6 +2245,8 @@ class InprocConn(BaseConn):
         nbytes = len(payload) if isinstance(payload, memoryview) else int(payload.nbytes)
         self._ctr.bytes_tx += nbytes
         self._ctr.sends_completed += 1
+        # §25: synchronous delivery -- local completion at post (bucket 0).
+        self._hists.send_local_us[0] += 1
         peer_ctr = getattr(peer, "counters", None)
         if peer_ctr is not None:
             peer_ctr.bytes_rx += nbytes

@@ -70,11 +70,14 @@ class FlushRec:
     ``ucp_ep_flush_nbx`` completion (reference: src/bindings/main.cpp:432,1202).
     """
 
-    __slots__ = ("done", "fail", "waits", "stripe_waits", "completed")
+    __slots__ = ("done", "fail", "waits", "stripe_waits", "completed", "born")
 
     def __init__(self, done, fail):
         self.done = done
         self.fail = fail
+        # swpulse (§25): barrier birth stamp for the flush_us distribution
+        # and the stall sentinel's outlived-threshold check.
+        self.born = time.perf_counter()
         self.waits: dict = {}  # conn -> seq
         # Striped delivery rides SACKs, not per-rail FLUSH frames (rails
         # carry only chunk traffic): the barrier additionally waits until
@@ -104,9 +107,17 @@ class Worker:
         # STARWAY_FLIGHT_DIR armed them -- the off path is one `is None`
         # check per op.
         self.counters = swtrace.Counters()
+        # swpulse distributions (DESIGN.md §25): always live, like the
+        # counters -- one clock read + one array increment per bump.
+        self.hists = swtrace.Hists()
+        # swpulse stall sentinel (§25): condition keys already alerted on,
+        # so a wedge raises ONE alert until it clears (telemetry thread
+        # calls stall_scan; empty and untouched unless STARWAY_STALL_MS).
+        self._stall_seen: set = set()
         self._trace = swtrace.worker_ring()
         self._faulted = False
         self.matcher.counters = self.counters
+        self.matcher.hists = self.hists
         self.matcher.trace = self._trace
         # §18 flow control: the matcher's grant hook runs under the
         # worker lock and only enqueues an engine op (conn TX is
@@ -170,6 +181,99 @@ class Worker:
         native engine surfaces through ``sw_counters``."""
         return swtrace.merge_global_counters(self.counters.snapshot())
 
+    def hists_snapshot(self) -> dict:
+        """The §25 swpulse distributions: ``{name: [HIST_BUCKETS counts]}``
+        in the shared HIST_NAMES vocabulary -- the same shape the native
+        engine surfaces through ``sw_hists``.  Percentiles are derived at
+        read time (swtrace.hist_summary)."""
+        return self.hists.snapshot()
+
+    def stall_scan(self, threshold_s: float, progressed: bool = False) -> list:
+        """swpulse stall sentinel (DESIGN.md §25): flag no-progress
+        conditions older than ``threshold_s``.  Called from the telemetry
+        thread when STARWAY_STALL_MS armed it (never on the seed path);
+        ``progressed`` means the worker's counters moved since the last
+        scan, which clears every suspicion -- the sentinel flags *wedges*,
+        not slowness.  Each NEW condition bumps ``stall_alerts`` and lands
+        an EV_STALL event in the trace ring; a condition alerts once until
+        it clears.  Returns structured report dicts."""
+        now = time.perf_counter()
+        reports: list = []
+        with self.lock:
+            live: set = set()
+            if not progressed and self.status == state.RUNNING:
+                for rec in self.flush_records:
+                    age = now - rec.born
+                    if age <= threshold_s:
+                        continue
+                    key = (swtrace.STALL_REASONS[0], id(rec))
+                    live.add(key)
+                    if key not in self._stall_seen:
+                        reports.append({
+                            "reason": swtrace.STALL_REASONS[0], "conn": 0,
+                            "age_ms": int(age * 1e3),
+                            "detail": f"flush barrier pending "
+                                      f"{len(self.flush_records)} record(s)",
+                        })
+                for c in self.conns.values():
+                    sess = getattr(c, "sess", None)
+                    if sess is not None and sess.suspended:
+                        continue  # §14 resume owns progress; not a wedge
+                    fw = getattr(c, "fc_waiting", None)
+                    if fw:
+                        t0 = getattr(fw[0], "t_park", 0.0)
+                        age = now - t0 if t0 else 0.0
+                        if age > threshold_s:
+                            key = (swtrace.STALL_REASONS[1], c.conn_id)
+                            live.add(key)
+                            if key not in self._stall_seen:
+                                reports.append({
+                                    "reason": swtrace.STALL_REASONS[1],
+                                    "conn": c.conn_id,
+                                    "age_ms": int(age * 1e3),
+                                    "detail": f"{len(fw)} parked send(s), "
+                                              f"no credit arrival",
+                                })
+                    grp = getattr(c, "stripe", None)
+                    if grp is not None:
+                        pinned = [s for s in grp.by_id.values()
+                                  if not s.sacked and not s.failed
+                                  and now - s.t_post > threshold_s]
+                        if pinned:
+                            key = (swtrace.STALL_REASONS[2], c.conn_id)
+                            live.add(key)
+                            if key not in self._stall_seen:
+                                age = now - min(s.t_post for s in pinned)
+                                reports.append({
+                                    "reason": swtrace.STALL_REASONS[2],
+                                    "conn": c.conn_id,
+                                    "age_ms": int(age * 1e3),
+                                    "detail": f"{len(pinned)} un-SACKed "
+                                              f"stripe pin(s)",
+                                })
+                un = self.matcher.unexpected
+                if un and now - un[0].born > threshold_s:
+                    key = (swtrace.STALL_REASONS[3], 0)
+                    live.add(key)
+                    if key not in self._stall_seen:
+                        reports.append({
+                            "reason": swtrace.STALL_REASONS[3], "conn": 0,
+                            "age_ms": int((now - un[0].born) * 1e3),
+                            "detail": f"{len(un)} unexpected message(s) "
+                                      f"unclaimed",
+                        })
+            self._stall_seen = live
+            if reports:
+                self.counters.stall_alerts += len(reports)
+                tr = self._trace
+                if tr is not None:
+                    for r in reports:
+                        tr.rec(swtrace.EV_STALL, 0, r["conn"], r["age_ms"],
+                               r["reason"])
+        for r in reports:
+            r["worker"] = self.trace_label
+        return reports
+
     def gauges_snapshot(self) -> dict:
         """Instantaneous per-conn gauges (telemetry.GAUGE_NAMES) plus the
         worker-level ``posted_recvs`` and the process-global staging-pool
@@ -217,16 +321,17 @@ class Worker:
 
     def submit_send(self, conn, view, tag: int, done, fail, owner=None,
                     timeout: Optional[float] = None) -> None:
+        nbytes = int(view.nbytes if hasattr(view, "nbytes") else len(view))
         tr = self._trace
         if tr is not None:
             cid = conn.conn_id if conn is not None else 0
-            nbytes = int(view.nbytes if hasattr(view, "nbytes") else len(view))
             done, fail = swtrace.wrap_op(self, tr, swtrace.EV_SEND_DONE,
                                          tag, cid, nbytes, done, fail)
         inline = False
         with self.lock:
             self._require_running()
             self.counters.sends_posted += 1  # accepted-submit accounting
+            self.hists.msg_bytes[swtrace.hist_bucket(nbytes)] += 1  # §25
             if tr is not None:
                 tr.rec(swtrace.EV_SEND_POST, tag, cid, nbytes)
             if self._busy == 0 and conn is not None and conn.kind == "inproc" and conn.alive:
@@ -279,16 +384,17 @@ class Worker:
         ordering in the stream is what the flush barrier builds on."""
         from . import frames as _frames
 
+        nbytes = int(desc.get("n", 0))
         tr = self._trace
         if tr is not None:
             cid = conn.conn_id if conn is not None else 0
-            nbytes = int(desc.get("n", 0))
             done, fail = swtrace.wrap_op(self, tr, swtrace.EV_SEND_DONE,
                                          tag, cid, nbytes, done, fail)
         data = _frames.pack_devpull(tag, desc)
         with self.lock:
             self._require_running()
             self.counters.sends_posted += 1  # accepted-submit accounting
+            self.hists.msg_bytes[swtrace.hist_bucket(nbytes)] += 1  # §25
             if tr is not None:
                 tr.rec(swtrace.EV_SEND_POST, tag, cid, nbytes)
             self._busy += 1
@@ -836,6 +942,8 @@ class Worker:
             if rec in self.flush_records:
                 self.flush_records.remove(rec)
             self.counters.flushes_completed += 1
+            us = int((time.perf_counter() - rec.born) * 1e6)
+            self.hists.flush_us[swtrace.hist_bucket(us)] += 1  # §25
             if rec.done is not None:
                 fires.append(rec.done)
 

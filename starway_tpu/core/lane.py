@@ -114,10 +114,11 @@ class StripeSource:
     __slots__ = ("msg_id", "tag", "payload", "total", "chunk", "done",
                  "fail", "owner", "pending", "rail_offs", "done_offs",
                  "unwritten", "writers", "local_done", "counted", "sacked",
-                 "failed", "__weakref__")
+                 "failed", "t_post", "__weakref__")
 
     def __init__(self, msg_id: int, tag: int, payload, done, fail, owner,
                  chunk: int):
+        self.t_post = time.perf_counter()  # swpulse pin/send origin (§25)
         self.msg_id = msg_id
         self.tag = tag
         self.payload = payload
@@ -535,6 +536,8 @@ class RailGroup:
         if src.local_done:
             return
         src.local_done = True
+        us = int((time.perf_counter() - src.t_post) * 1e6)
+        self.primary._hists.send_local_us[swtrace.hist_bucket(us)] += 1
         if src.done is not None:
             fires.append(src.done)
 
@@ -564,6 +567,10 @@ class RailGroup:
             self.primary.retx_offs = {
                 t for t in self.primary.retx_offs if t[0] != msg_id}
         src.sacked = True
+        # swpulse pin residency (§25): submit -> SACK is exactly how long
+        # the payload stayed pinned by reference.
+        us = int((time.perf_counter() - src.t_post) * 1e6)
+        self.primary._hists.pin_us[swtrace.hist_bucket(us)] += 1
         src.settle(fires, None)
         self.primary.worker._on_stripe_sack(self.primary, fires)
 

@@ -32,6 +32,7 @@ worker lock so user callbacks can re-enter the API without deadlocking.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Callable, Optional
 
@@ -74,7 +75,7 @@ class PostedRecv:
     # weakly, so a settled receive's buffer is not pinned until its timer
     # would have fired.
     __slots__ = ("buf", "tag", "mask", "done", "fail", "claimed", "owner",
-                 "__weakref__")
+                 "t_post", "__weakref__")
 
     def __init__(self, buf, tag: int, mask: int, done: DoneCb, fail: FailCb, owner=None):
         self.buf = buf
@@ -84,6 +85,7 @@ class PostedRecv:
         self.fail = fail
         self.claimed = False  # an in-flight inbound message is streaming to us
         self.owner = owner  # keepalive for the python object owning buf
+        self.t_post = time.perf_counter()  # swpulse recv_wait_us origin (§25)
 
     @property
     def size(self) -> int:
@@ -103,11 +105,12 @@ class InboundMsg:
 
     __slots__ = ("tag", "length", "sink", "received", "posted", "complete",
                  "discard", "spill", "device_payload", "remote", "progress",
-                 "fc_owner", "fc_gen", "fc_bytes")
+                 "fc_owner", "fc_gen", "fc_bytes", "born")
 
     def __init__(self, tag: int, length: int):
         self.tag = tag
         self.length = length
+        self.born = time.perf_counter()  # swpulse stall-unexp age origin (§25)
         self.sink: Optional[memoryview] = None
         self.received = 0
         self.posted: Optional[PostedRecv] = None
@@ -173,6 +176,7 @@ class TagMatcher:
         # GIL-atomic data writes -- unlike user callbacks, they are safe
         # under the worker lock the matcher runs beneath.
         self.counters = swtrace.Counters()
+        self.hists = swtrace.Hists()  # swapped for the Worker's (§25)
         self.trace = None
         # Flow control (DESIGN.md §18): total payload bytes currently
         # held by unexpected spill buffers (the STARWAY_UNEXP_BYTES cap
@@ -210,6 +214,11 @@ class TagMatcher:
         tr = self.trace
         if tr is not None:
             tr.rec(swtrace.EV_RECV_MATCH, tag, 0, length)
+
+    def _pulse_wait(self, pr: PostedRecv) -> None:
+        # swpulse (§25): post -> delivery latency of a completed receive.
+        us = int((time.perf_counter() - pr.t_post) * 1e6)
+        self.hists.recv_wait_us[swtrace.hist_bucket(us)] += 1
 
     # ------------------------------------------------------------------ post
     def post_recv(self, buf, tag: int, mask: int, done: DoneCb, fail: FailCb, owner=None) -> list:
@@ -257,6 +266,7 @@ class TagMatcher:
                     stag, length = msg.tag, msg.length
                     self._rec_match(stag, length)
                     self.counters.recvs_completed += 1
+                    self._pulse_wait(pr)
                     fires.append(lambda done=done, stag=stag, length=length: done(stag, length))
                     return fires
                 # In flight: claim it; payload keeps streaming into the spill
@@ -327,6 +337,7 @@ class TagMatcher:
                 # Streamed straight into the device sink's staging buffer.
                 pr.buf.finalize_from_host(msg.length)
             self.counters.recvs_completed += 1
+            self._pulse_wait(pr)
             fires.append(lambda pr=pr, m=msg: pr.done(m.tag, m.length))
         # else: stays in the unexpected queue until a matching recv is posted.
         return fires
@@ -391,6 +402,7 @@ class TagMatcher:
         if pr is not None:
             _copy_complete(pr, payload, msg.length)
             self.counters.recvs_completed += 1
+            self._pulse_wait(pr)
             fires.append(lambda pr=pr, m=msg: pr.done(m.tag, m.length))
         else:
             # Force-started by a flush barrier before any receive matched:
@@ -420,6 +432,7 @@ class TagMatcher:
                 _copy_complete(pr, payload, length)
                 self._rec_match(tag, length)
                 self.counters.recvs_completed += 1
+                self._pulse_wait(pr)
                 fires.append(lambda pr=pr, t=tag, n=length: pr.done(t, n))
                 return fires
         msg = InboundMsg(tag, length)

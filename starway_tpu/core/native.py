@@ -117,6 +117,9 @@ def load() -> Optional[ctypes.CDLL]:
         lib.sw_gauges.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
         ]
+        lib.sw_hists.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
+        ]
         lib.sw_free.argtypes = [ctypes.c_void_p]
         lib.sw_set_devpull.argtypes = [
             ctypes.c_void_p, ctypes.c_int, _DEVPULL_CB, _DEVPULL_CLAIM_CB,
@@ -556,6 +559,26 @@ class NativeWorkerBase:
                 except ValueError:
                     pass
         return swtrace.merge_global_counters(snap)
+
+    def hists_snapshot(self) -> dict:
+        """swpulse (DESIGN.md §25): the engine's log-bucket histograms
+        (``sw_hists``) in the shared HIST_NAMES vocabulary -- same shape
+        as the Python engine's ``Worker.hists_snapshot`` (name -> 64
+        bucket counts)."""
+        snap = {name: [0] * swtrace.HIST_BUCKETS
+                for name in swtrace.HIST_NAMES}
+        if self._h is not None:
+            cap = 16384
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.sw_hists(self._h, buf, cap)
+            if n > 0:
+                try:
+                    for key, row in json.loads(buf.value.decode()).items():
+                        if key in snap and len(row) == swtrace.HIST_BUCKETS:
+                            snap[key] = [int(v) for v in row]
+                except (ValueError, TypeError):
+                    pass
+        return snap
 
     def gauges_snapshot(self) -> dict:
         """The engine's live per-conn gauges (``sw_gauges``; rendered on

@@ -97,6 +97,10 @@ EV_PROTO = "proto"            # swrefine protocol event (DESIGN.md §22):
 #                               replays the channel through the monitor
 #                               automaton compiled from both engines'
 #                               protocol state machines.
+EV_STALL = "stall"            # swpulse stall-sentinel alert (DESIGN.md
+#                               §25): conn = suspect conn id (0 = worker-
+#                               wide), reason = one of STALL_REASONS.
+#                               Armed only by STARWAY_STALL_MS.
 
 # ----------------------------------------------------- counter vocabulary
 #
@@ -152,6 +156,8 @@ COUNTER_NAMES = (
     "zc_notifies",        # §24 zerocopy completion ranges drained from
     #                       the errqueue (COPIED fallbacks included)
     "busypoll_hits",      # §24 events harvested inside the spin window
+    "stall_alerts",       # §25 stall-sentinel alerts raised (0 unless
+    #                       STARWAY_STALL_MS armed the sentinel)
 )
 
 
@@ -171,6 +177,115 @@ class Counters:
 
     def snapshot(self) -> dict:
         return {name: getattr(self, name) for name in COUNTER_NAMES}
+
+
+# --------------------------------------------------- histogram vocabulary
+#
+# swpulse (DESIGN.md §25): always-on log-bucketed distributions, bumped
+# unconditionally at the contract points in BOTH engines (engine.py /
+# conn.py / matching.py / lane.py <-> native/sw_engine.cpp, surfaced
+# through ``sw_hists`` <-> ``Worker.hists_snapshot``).  Like COUNTER_NAMES
+# the vocabulary -- and the bucket layout -- is cross-engine contract
+# surface diffed by swcheck's ``contract-pulse`` pass against
+# ``kHistNames[]`` / ``kHistBuckets``.  One bump is one clock read + one
+# integer increment into a fixed per-worker array: no allocation, no lock,
+# no branch on the seed path (the arrays always exist).  Latencies are in
+# MICROSECONDS, sizes in BYTES; bucket i holds values with bit_length i
+# (0 -> bucket 0), so bucket boundaries are powers of two and percentiles
+# are derived at read time from the bucket upper bounds (hist_percentiles).
+
+HIST_NAMES = (
+    "send_local_us",   # send post -> local completion (eager: handed to
+    #                    transport; rndv: transmission begun -- the §10
+    #                    local-completion contract, measured)
+    "recv_wait_us",    # recv post -> matcher claim (posted-first waits;
+    #                    unexpected-first matches at ~0)
+    "flush_us",        # flush barrier post -> all-target acknowledgement
+    "park_us",         # §18 credit-window park residency (parked ->
+    #                    unparked or shed)
+    "pin_us",          # payload pin residency: §17 stripe pinned -> SACKed
+    #                    and §24 zerocopy pinned -> errqueue-released
+    #                    (native lever; this engine records stripe only)
+    "msg_bytes",       # payload size per posted send
+)
+
+#: Buckets per histogram; bucket i covers values of ``bit_length() == i``
+#: (i.e. [2^(i-1), 2^i)), with bucket 0 = zero and the last bucket open.
+HIST_BUCKETS = 64
+
+
+def hist_bucket(value: int) -> int:
+    """Log-bucket index for a nonnegative integer (negative clamps to 0)."""
+    if value <= 0:
+        return 0
+    b = value.bit_length()
+    return b if b < HIST_BUCKETS else HIST_BUCKETS - 1
+
+
+class Hists:
+    """Fixed-vocabulary log-bucket histograms (one instance per worker).
+    Plain list-element increments under the GIL, same tolerance story as
+    :class:`Counters`; the C++ twin uses relaxed atomics."""
+
+    __slots__ = HIST_NAMES
+
+    def __init__(self):
+        for name in HIST_NAMES:
+            setattr(self, name, [0] * HIST_BUCKETS)
+
+    def snapshot(self) -> dict:
+        return {name: list(getattr(self, name)) for name in HIST_NAMES}
+
+
+def hist_percentiles(buckets) -> dict:
+    """p50/p90/p99/p999 + count for one histogram, derived at read time.
+    Each percentile reports the upper bound of the bucket the rank lands
+    in (2^i - 1) -- an over-estimate by at most 2x, which is the log-
+    bucket deal."""
+    total = sum(buckets)
+    out = {"count": total, "p50": 0, "p90": 0, "p99": 0, "p999": 0}
+    if total == 0:
+        return out
+    targets = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99), ("p999", 0.999))
+    ti = 0
+    seen = 0
+    for i, n in enumerate(buckets):
+        if not n:
+            continue
+        seen += n
+        bound = (1 << i) - 1 if i else 0
+        while ti < len(targets) and seen >= targets[ti][1] * total:
+            out[targets[ti][0]] = bound
+            ti += 1
+        if ti == len(targets):
+            break
+    return out
+
+
+def hist_summary(snapshot: dict) -> dict:
+    """Percentile view of a ``hists_snapshot()`` dict -- the compact shape
+    telemetry samples and the metrics viewer carry."""
+    return {name: hist_percentiles(buckets)
+            for name, buckets in snapshot.items()}
+
+
+# ------------------------------------------------- stall-reason vocabulary
+#
+# swpulse sentinel (DESIGN.md §25): the no-progress conditions the
+# detector can flag, carried verbatim as the EV_STALL event reason and in
+# stall reports.  Cross-engine contract surface like the names above
+# (kStallReasons[] in sw_engine.cpp, diffed by contract-pulse).
+
+STALL_REASONS = (
+    "stall-flush",     # a flush barrier outlived the threshold with no
+    #                    counter progress behind it
+    "stall-credit",    # §18 parked sends aged past the threshold with no
+    #                    credit arrival
+    "stall-pin",       # stripe/zerocopy/journal pins undrained with no
+    #                    progress past the threshold
+    "stall-unexp",     # unexpected-queue residency with no recv progress
+    #                    past the threshold
+)
 
 
 #: Process-global counters (staging pool, api-layer reconnects).
@@ -193,11 +308,13 @@ def merge_global_counters(snap: dict) -> dict:
 def active() -> bool:
     """Tracing hooks armed for new workers?  True when ``STARWAY_TRACE``
     is on, a flight directory is configured (the recorder needs the
-    ring's last-N events even when nobody asked for a full trace), or the
+    ring's last-N events even when nobody asked for a full trace), the
     swrefine protocol-event channel is armed (its events ride this ring,
-    DESIGN.md §22)."""
+    DESIGN.md §22), or the swpulse stall sentinel is armed (its EV_STALL
+    alerts and the "last events" in stall reports need a ring to land in,
+    DESIGN.md §25)."""
     return (config.trace_enabled() or bool(config.flight_dir())
-            or config.proto_trace_enabled())
+            or config.proto_trace_enabled() or config.stall_ms() > 0)
 
 
 def proto_active() -> bool:
@@ -305,8 +422,13 @@ def retire(worker) -> None:
         monitor.check_worker(worker, events)
     if not events:
         return
+    try:
+        hists = worker.hists_snapshot()
+    except Exception:
+        hists = {}
     with _reg_lock:
-        _retired.append({"worker": worker.trace_label, "events": events})
+        _retired.append({"worker": worker.trace_label, "events": events,
+                         "hists": hists})
         del _retired[:-_RETIRED_CAP]
 
 
@@ -324,7 +446,12 @@ def dump_all() -> list:
         except Exception:
             continue
         if events:
-            out.append({"worker": w.trace_label, "events": events})
+            try:
+                hists = w.hists_snapshot()
+            except Exception:
+                hists = {}
+            out.append({"worker": w.trace_label, "events": events,
+                        "hists": hists})
     return out
 
 
@@ -343,7 +470,11 @@ def write_ring_dump(path) -> Path:
         "pid": os.getpid(),
         "time": time.time(),
         "workers": [
-            {"worker": d["worker"], "events": [list(e) for e in d["events"]]}
+            {"worker": d["worker"], "events": [list(e) for e in d["events"]],
+             # §25 swpulse distributions ride every ring dump so a
+             # post-mortem (and trace --merge) keeps the percentile
+             # picture next to the event timeline.
+             "hists": d.get("hists", {})}
             for d in dump_all()
         ],
     }
@@ -381,6 +512,10 @@ def flight_dump(trigger: str, worker, reason: str = "") -> Optional[Path]:
             counters = worker.counters_snapshot()
         except Exception:
             counters = {}
+        try:
+            hists = worker.hists_snapshot()
+        except Exception:
+            hists = {}
         # Telemetry trend + the per-conn gauge snapshot at trigger time:
         # a post-mortem then shows the queue/journal trajectory INTO the
         # failure, not just the instant (DESIGN.md §15).
@@ -401,6 +536,7 @@ def flight_dump(trigger: str, worker, reason: str = "") -> Optional[Path]:
             "pid": os.getpid(),
             "time": time.time(),
             "counters": counters,
+            "hists": hists,
             "gauges": gauges,
             "telemetry": samples,
             "events": [list(e) for e in events],

@@ -45,6 +45,7 @@ from collections import deque
 from typing import Optional
 
 from .. import config
+from . import swtrace
 
 logger = logging.getLogger("starway_tpu")
 
@@ -190,14 +191,20 @@ def armed() -> bool:
     construction) -- never per op, so the off path is env-lookup-free on
     the data path (the PR-4 armed-state caching discipline)."""
     return (config.metrics_interval() > 0 or bool(config.metrics_path())
-            or bool(config.metrics_addr()))
+            or bool(config.metrics_addr()) or config.stall_ms() > 0)
 
 
 def interval() -> float:
     """Effective sampling period: the env knob, or 1 s when only a
-    path/addr armed the sampler."""
+    path/addr (or the §25 stall sentinel) armed the sampler.  An armed
+    sentinel caps the period at half its threshold so a wedge is
+    detected within ~1.5x the configured STARWAY_STALL_MS."""
     iv = config.metrics_interval()
-    return iv if iv > 0 else 1.0
+    iv = iv if iv > 0 else 1.0
+    stall = config.stall_ms()
+    if stall > 0:
+        iv = min(iv, max(stall / 2e3, 0.01))
+    return iv
 
 
 _lock = threading.Lock()
@@ -247,6 +254,9 @@ def sample_now() -> dict:
                 workers[w.trace_label] = {
                     "counters": w.counters_snapshot(),
                     "gauges": w.gauges_snapshot(),
+                    # §25 swpulse: the compact percentile view, not the
+                    # raw buckets -- samples stay JSONL-sized.
+                    "hists": swtrace.hist_summary(w.hists_snapshot()),
                 }
             except Exception:
                 continue  # a worker mid-close yields no sample this tick
@@ -292,6 +302,8 @@ def reset() -> None:
         _workers.clear()
         _samples = None
         _thread = None
+        _stall_reports.clear()
+        _stall_state.clear()
         listener, _feed_listener = _feed_listener, None
         clients = list(_feed_clients)
         _feed_clients.clear()
@@ -300,6 +312,97 @@ def reset() -> None:
             s.close()
         except OSError:
             pass
+
+
+# ------------------------------------------------------ §25 stall sentinel
+#
+# Armed only by STARWAY_STALL_MS (stall_ms() > 0): the sampler thread,
+# once per tick, checks every registered worker for no-progress
+# conditions.  Python workers expose the scan itself (Worker.stall_scan:
+# flush barriers, credit parks, stripe pins, unexpected growth -- it
+# bumps stall_alerts and records EV_STALL); the native engine
+# self-detects inside its progress loop, so here its stall_alerts DELTA
+# is what surfaces the report.  Either way the unified answer is a
+# structured report (+ last ring events) in `_stall_reports`, a warning
+# log line, and a §13 flight-recorder dump with the `stall` trigger.
+
+_stall_reports: deque = deque(maxlen=64)
+_stall_state = weakref.WeakKeyDictionary()  # worker -> (progress_sum, alerts)
+
+
+def stall_reports(limit: int = 64) -> list:
+    """The most recent stall-sentinel reports (newest last); [] unless
+    STARWAY_STALL_MS armed the sentinel and a wedge was flagged."""
+    with _lock:
+        return list(_stall_reports)[-limit:]
+
+
+def _progress_sum(counters: dict) -> int:
+    """Monotone work signal: any counter moving between two ticks means
+    the worker is progressing, not wedged.  stall_alerts itself is
+    excluded (an alert must not read as progress)."""
+    return sum(v for k, v in counters.items()
+               if k != "stall_alerts" and isinstance(v, int))
+
+
+def _stall_tick(threshold_s: float) -> None:
+    for w in _live_workers():
+        try:
+            ctr = w.counters_snapshot()
+        except Exception:
+            continue
+        sum_now = _progress_sum(ctr)
+        alerts_now = int(ctr.get("stall_alerts", 0))
+        prev = _stall_state.get(w)
+        try:
+            _stall_state[w] = (sum_now, alerts_now)
+        except TypeError:
+            continue  # un-weakrefable duck: no baseline, no scan
+        if prev is None:
+            continue  # first sight establishes the baseline only
+        progressed = sum_now != prev[0]
+        reports: list = []
+        scan = getattr(w, "stall_scan", None)
+        if scan is not None:
+            try:
+                reports = scan(threshold_s, progressed)
+            except Exception:
+                logger.debug("starway stall scan failed", exc_info=True)
+        elif alerts_now > prev[1]:
+            # Native worker: its run() loop already bumped stall_alerts
+            # and recorded EV_STALL into the engine ring -- reshape the
+            # ring records into the unified report.
+            try:
+                evs = [e for e in w.trace_events()
+                       if e[1] == swtrace.EV_STALL]
+            except Exception:
+                evs = []
+            for e in evs[-(alerts_now - prev[1]):]:
+                reports.append({"worker": w.trace_label, "reason": e[5],
+                                "conn": int(e[3]), "age_ms": int(e[4]),
+                                "detail": "native stall sentinel"})
+            if not reports:  # ring unarmed/wrapped: delta is the report
+                reports.append({"worker": w.trace_label,
+                                "reason": swtrace.STALL_REASONS[0],
+                                "conn": 0, "age_ms": 0,
+                                "detail": "native stall sentinel "
+                                          "(ring unavailable)"})
+        if not reports:
+            continue
+        try:
+            tail = [list(e) for e in w.trace_events()[-8:]]
+        except Exception:
+            tail = []
+        for r in reports:
+            r.setdefault("worker", w.trace_label)
+            r["events"] = tail  # last protocol/trace events from the ring
+            with _lock:
+                _stall_reports.append(r)
+            logger.warning(
+                "starway stall sentinel: %s on %s conn %s after %dms (%s)",
+                r["reason"], r["worker"], r["conn"], r["age_ms"],
+                r["detail"])
+        swtrace.flight_dump("stall", w, reports[-1]["reason"])
 
 
 # ---------------------------------------------------------- emit channels
@@ -374,6 +477,9 @@ def _run(stop: threading.Event) -> None:
                 continue  # every worker gone: idle tick, ring unchanged
             _accept_feed_clients()
             sample_now()
+            stall = config.stall_ms()
+            if stall > 0:
+                _stall_tick(stall / 1e3)
         except Exception:
             logger.debug("starway telemetry tick failed", exc_info=True)
 

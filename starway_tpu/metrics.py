@@ -42,6 +42,32 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.1f}GiB"
 
 
+def _fmt_us(n: float) -> str:
+    if n < 1000:
+        return f"{n:.0f}us"
+    if n < 1e6:
+        return f"{n / 1e3:.1f}ms"
+    return f"{n / 1e6:.1f}s"
+
+
+def _hist_lines(wk: dict) -> list:
+    """swpulse percentile rows (DESIGN.md §25): one line per histogram
+    that has samples.  ``hists`` carries the telemetry-sample percentile
+    shape (hist_summary); `_us` names render as durations, the rest as
+    sizes."""
+    lines = []
+    for name, h in sorted(wk.get("hists", {}).items()):
+        count = int(h.get("count", 0))
+        if not count:
+            continue
+        fmt = _fmt_us if name.endswith("_us") else _fmt_bytes
+        lines.append(
+            f"    {name}: n={count} " + " ".join(
+                f"{p}={fmt(h.get(p, 0))}"
+                for p in ("p50", "p90", "p99", "p999")))
+    return lines
+
+
 def render(sample: dict, prev: Optional[dict] = None) -> str:
     """One sample -> a text block (rates need the previous sample)."""
     lines = [time.strftime("%H:%M:%S", time.localtime(sample.get("t", 0)))
@@ -64,6 +90,9 @@ def render(sample: dict, prev: Optional[dict] = None) -> str:
             for name in _RATE_COUNTERS:
                 if ctr.get(name):
                     parts.append(f"{name}={ctr[name]}")
+        stalls = ctr.get("stall_alerts", 0)
+        if stalls:
+            parts.append(f"STALL_ALERTS={stalls}")
         gauges = wk.get("gauges", {})
         posted = gauges.get("posted_recvs", 0)
         if posted:
@@ -72,6 +101,7 @@ def render(sample: dict, prev: Optional[dict] = None) -> str:
         if pool:
             parts.append(f"staging_pool={_fmt_bytes(pool)}")
         lines.append(" ".join(parts))
+        lines.extend(_hist_lines(wk))
         for cid, g in sorted(gauges.get("conns", {}).items(),
                              key=lambda kv: str(kv[0])):
             busy = {k: v for k, v in g.items() if v}

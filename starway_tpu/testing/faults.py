@@ -345,6 +345,13 @@ class FaultProxy:
                 if not self._partitioned.is_set():
                     pair.kill(rst=True)
                 return
+            # A pump already parked in recv() when stall() fired still
+            # returns this chunk: HOLD it (don't forward, don't drop)
+            # until unstalled, so the stall is byte-deterministic -- no
+            # in-flight frame slips past the wedge.
+            while (self._stalled.is_set() and not self._stopping.is_set()
+                   and not pair.dead):
+                time.sleep(0.01)
             if not data:
                 if self._partitioned.is_set():
                     return  # a partition swallows EOFs too: pure silence
@@ -455,6 +462,11 @@ class FaultProxy:
                 if not self._partitioned.is_set():
                     pair.kill(rst=True)
                 return
+            # Hold-not-forward on a stall that landed mid-recv, like the
+            # raw pump above.
+            while (self._stalled.is_set() and not self._stopping.is_set()
+                   and not pair.dead):
+                time.sleep(0.01)
             if not data:
                 if self._partitioned.is_set():
                     return

@@ -215,14 +215,17 @@ def write_chrome(dumps: Iterable[dict], path) -> Path:
 
 
 def _normalize_dump(raw: dict, fallback_name: str) -> list:
-    """One loaded JSON file -> [{"pid", "worker", "events"}, ...]."""
+    """One loaded JSON file -> [{"pid", "worker", "events", "hists"}, ...]
+    (``hists``: the §25 swpulse buckets a ring dump / flight dump carries
+    next to its events; {} on older dumps)."""
     if "workers" in raw:  # swtrace.write_ring_dump shape
         return [{"pid": raw.get("pid"), "worker": w.get("worker", "worker"),
-                 "events": w.get("events", [])} for w in raw["workers"]]
+                 "events": w.get("events", []),
+                 "hists": w.get("hists", {})} for w in raw["workers"]]
     if "events" in raw:   # flight-recorder / single-ring shape
         return [{"pid": raw.get("pid"), "worker": raw.get("worker",
                                                           fallback_name),
-                 "events": raw["events"]}]
+                 "events": raw["events"], "hists": raw.get("hists", {})}]
     raise ValueError("not a swtrace dump (no 'events' or 'workers' key)")
 
 
@@ -308,12 +311,15 @@ def merge_chrome(named_dumps: list) -> dict:
     # from end A pairs with rx ordinal n at the OTHER end only.
     e2e: dict = {}
     stage_durs: dict = {}
+    pulse: dict = {}  # per-worker §25 percentile view carried through
     pid = 0
     for pkey, workers in procs.items():
         shift = deltas[pkey]
         for w in workers:
             pid += 1
             label = f"{pkey}/{w['worker']}"
+            if w.get("hists"):
+                pulse[label] = swtrace.hist_summary(w["hists"])
             sink: list = []
             trace_events.extend(
                 chrome_events(label, w["events"], pid, ts_shift=shift,
@@ -374,6 +380,9 @@ def merge_chrome(named_dumps: list) -> dict:
                    "p90": percentile(sorted(xs), 90) * 1e6}
             for name, xs in sorted(stage_durs.items())
         },
+        # §25 swpulse: each dump's distributions survive the merge as
+        # their per-worker percentile view (hists ride write_ring_dump).
+        "pulse": pulse,
     }
     return {"traceEvents": trace_events, "displayTimeUnit": "ms",
             "swscope": summary}

@@ -93,8 +93,9 @@ async def test_counter_twin_matches_static_ledger(port, monkeypatch,
                                                   client_engine,
                                                   server_engine):
     """All four pairings: the canonical eager sequence moves io_syscalls
-    within the extraction-derived envelope and keeps hot_copies at the
-    ledger's tcp prediction (zero -- the tcp data path is copy-free)."""
+    within the extraction-derived envelope, keeps hot_copies at the
+    ledger's tcp prediction (zero -- the tcp data path is copy-free), and
+    populates the §25 swpulse histograms without adding a ledger site."""
     if "native" in (client_engine, server_engine) and not _native_available():
         pytest.skip("native engine unavailable")
     vectors = _static_vectors()
@@ -114,6 +115,8 @@ async def test_counter_twin_matches_static_ledger(port, monkeypatch,
         await _drive(server, client)
         cs = client._client.counters_snapshot()
         ss = server._server.counters_snapshot()
+        ch = client._client.hists_snapshot()
+        sh = server._server.hists_snapshot()
     finally:
         await client.aclose()
         await server.aclose()
@@ -121,6 +124,18 @@ async def test_counter_twin_matches_static_ledger(port, monkeypatch,
     # The twin rides the shared vocabulary on both engines.
     for snap in (cs, ss):
         assert "io_syscalls" in snap and "hot_copies" in snap
+
+    # swpulse (DESIGN.md §25) rides the SAME certified hot path without
+    # moving the §23 ledger: the gate's cost leg pins zero new sites, so
+    # conformance here is "the histograms populated anyway" -- on all
+    # four pairings, in the one shared shape.
+    for snap in (ch, sh):
+        assert sorted(snap) == sorted(swtrace.HIST_NAMES)
+        assert all(len(row) == swtrace.HIST_BUCKETS for row in snap.values())
+    assert sum(ch["send_local_us"]) >= K, ch
+    assert sum(ch["msg_bytes"]) >= K, ch
+    assert sum(ch["flush_us"]) >= 1, ch
+    assert sum(sh["recv_wait_us"]) >= K, sh
 
     for engine, snap, role in ((ce, cs, "client"), (se, ss, "server")):
         sites = _sites(vectors, engine, "syscalls")

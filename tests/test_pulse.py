@@ -1,0 +1,281 @@
+"""swpulse (DESIGN.md §25): always-on distributions + the stall sentinel.
+
+Four behaviours pinned here, across engine pairings where they differ:
+
+* **Vocabulary + bucket parity** -- both engines answer
+  ``hists_snapshot()`` in the one HIST_NAMES shape, and a
+  deterministically-sized payload lands in the SAME log bucket on both
+  (the runtime half of the ``contract-pulse`` static gate).
+* **Tap liveness** -- the canonical op sequence populates the latency
+  histograms on the engine that owns each path (send-local + flush on
+  the sender, recv-wait on the receiver) with no env armed: the
+  distributions are always on.
+* **Stall sentinel** -- a deliberately wedged flush (FaultProxy
+  ``stall``) under ``STARWAY_STALL_MS`` raises ``stall_alerts``, lands a
+  structured report in ``telemetry.stall_reports()`` and a §13 flight
+  dump with the ``stall`` trigger, in all four engine pairings; a
+  healthy run under the same env stays alert-free.
+* **Seed darkness** -- with the env unset the sentinel adds zero
+  branches: no trace ring, no alerts, no telemetry registration.
+"""
+
+import asyncio
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from starway_tpu import Client, Server
+from starway_tpu.core import swtrace, telemetry
+from starway_tpu.testing.faults import FaultProxy
+
+pytestmark = pytest.mark.asyncio
+
+ADDR = "127.0.0.1"
+MASK = (1 << 64) - 1
+ENGINES = ["python", "native"]
+NBYTES = 4096  # bit_length 13: the deterministic msg_bytes bucket
+
+
+def _native_available() -> bool:
+    from starway_tpu.core import native
+
+    return native.available()
+
+def _skip_unless(client_engine, server_engine):
+    if "native" in (client_engine, server_engine) and not _native_available():
+        pytest.skip("native engine unavailable")
+
+
+def _env(monkeypatch):
+    monkeypatch.setenv("STARWAY_TLS", "tcp")
+    monkeypatch.setenv("STARWAY_DEVPULL", "0")
+    monkeypatch.delenv("STARWAY_TRACE", raising=False)
+    monkeypatch.delenv("STARWAY_STALL_MS", raising=False)
+    monkeypatch.delenv("STARWAY_FLIGHT_DIR", raising=False)
+    swtrace.reset()
+    telemetry.reset()
+
+
+async def _drive(server, client, k=4):
+    sinks = [np.empty(NBYTES, dtype=np.uint8) for _ in range(k)]
+    futs = [server.arecv(b, 0x900 + i, MASK) for i, b in enumerate(sinks)]
+    await asyncio.sleep(0.05)
+    await asyncio.gather(
+        *(client.asend(np.full(NBYTES, i + 1, dtype=np.uint8), 0x900 + i)
+          for i in range(k)))
+    await asyncio.gather(*futs)
+    await client.aflush()
+
+
+# ------------------------------------------------- percentile derivation
+
+
+def test_hist_bucket_and_percentiles_unit():
+    """Log-bucket indexing and read-time percentiles, in plain numbers:
+    bucket i covers bit_length i, the reported percentile is the bucket's
+    upper bound (2^i - 1)."""
+    assert swtrace.hist_bucket(0) == 0
+    assert swtrace.hist_bucket(-5) == 0
+    assert swtrace.hist_bucket(1) == 1
+    assert swtrace.hist_bucket(4096) == 13
+    assert swtrace.hist_bucket(1 << 200) == swtrace.HIST_BUCKETS - 1
+
+    buckets = [0] * swtrace.HIST_BUCKETS
+    buckets[3] = 90   # values in [4, 8)   -> bound 7
+    buckets[10] = 9   # values in [512, 1024) -> bound 1023
+    buckets[20] = 1   # the tail            -> bound (1<<20)-1
+    p = swtrace.hist_percentiles(buckets)
+    assert p["count"] == 100
+    assert p["p50"] == 7
+    assert p["p90"] == 7      # rank 90 still lands in bucket 3
+    assert p["p99"] == 1023
+    assert p["p999"] == (1 << 20) - 1
+
+    empty = swtrace.hist_percentiles([0] * swtrace.HIST_BUCKETS)
+    assert empty == {"count": 0, "p50": 0, "p90": 0, "p99": 0, "p999": 0}
+
+
+# ----------------------------------------- vocabulary + tap liveness
+
+
+@pytest.mark.parametrize("server_engine", ENGINES)
+@pytest.mark.parametrize("client_engine", ENGINES)
+async def test_taps_populate_all_pairings(port, monkeypatch, client_engine,
+                                          server_engine):
+    """No env armed: the distributions still populate (always-on), in the
+    one HIST_NAMES shape, and the deterministic msg_bytes payload lands
+    in the same bucket on every engine -- runtime bucket-boundary
+    parity next to the static contract-pulse gate."""
+    _skip_unless(client_engine, server_engine)
+    _env(monkeypatch)
+    monkeypatch.setenv("STARWAY_NATIVE",
+                       "1" if server_engine == "native" else "0")
+    server = Server()
+    server.listen(ADDR, port)
+    monkeypatch.setenv("STARWAY_NATIVE",
+                       "1" if client_engine == "native" else "0")
+    client = Client()
+    await client.aconnect(ADDR, port)
+    try:
+        await _drive(server, client)
+        ch = client._client.hists_snapshot()
+        sh = server._server.hists_snapshot()
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+    for snap in (ch, sh):
+        assert sorted(snap) == sorted(swtrace.HIST_NAMES)
+        assert all(len(row) == swtrace.HIST_BUCKETS for row in snap.values())
+    # Sender-owned paths: local completion, flush barrier, message size.
+    assert sum(ch["send_local_us"]) >= 4, ch
+    assert sum(ch["flush_us"]) >= 1, ch
+    # The 4096-byte payload must land in bucket bit_length(4096) == 13 on
+    # BOTH engines -- the boundaries, not just the names, are shared.
+    assert ch["msg_bytes"][swtrace.hist_bucket(NBYTES)] >= 4, ch["msg_bytes"]
+    # Receiver-owned path: posted-recv wait to matcher claim.
+    assert sum(sh["recv_wait_us"]) >= 4, sh
+    # Percentile view over a real snapshot is well-formed.
+    summary = swtrace.hist_summary(ch)
+    assert summary["msg_bytes"]["p50"] >= NBYTES - 1
+
+
+# ------------------------------------------------------- stall sentinel
+
+
+async def _wedge_flush(port, monkeypatch, client_engine, server_engine,
+                       tmp_path):
+    """Connect through a FaultProxy, complete one eager exchange, stall
+    the proxy, then post a flush that can never be acknowledged.
+    Returns (server, client, proxy, flush_future)."""
+    monkeypatch.setenv("STARWAY_STALL_MS", "250")
+    monkeypatch.setenv("STARWAY_FLIGHT_DIR", str(tmp_path / "flight"))
+    monkeypatch.setenv("STARWAY_NATIVE",
+                       "1" if server_engine == "native" else "0")
+    server = Server()
+    server.listen(ADDR, port)
+    proxy = FaultProxy(ADDR, port).start()
+    monkeypatch.setenv("STARWAY_NATIVE",
+                       "1" if client_engine == "native" else "0")
+    client = Client()
+    await client.aconnect(ADDR, proxy.port)
+
+    sink = np.empty(NBYTES, dtype=np.uint8)
+    fut = server.arecv(sink, 0x910, MASK)
+    await client.asend(np.full(NBYTES, 7, dtype=np.uint8), 0x910)
+    await fut
+    proxy.stall()
+    loop = asyncio.get_event_loop()
+    flush = client.aflush(loop)
+    return server, client, proxy, flush
+
+
+@pytest.mark.parametrize("server_engine", ENGINES)
+@pytest.mark.parametrize("client_engine", ENGINES)
+async def test_wedged_flush_raises_stall_alert(port, monkeypatch, tmp_path,
+                                               client_engine, server_engine):
+    """The acceptance scenario: a flush barrier wedged behind a stalled
+    proxy, STARWAY_STALL_MS armed -> stall_alerts moves on the flushing
+    client, a structured stall-flush report lands in
+    telemetry.stall_reports(), and the §13 flight recorder dumps with
+    the `stall` trigger -- on all four engine pairings."""
+    _skip_unless(client_engine, server_engine)
+    _env(monkeypatch)
+    server, client, proxy, flush = await _wedge_flush(
+        port, monkeypatch, client_engine, server_engine, tmp_path)
+    try:
+        deadline = time.monotonic() + 20
+        reports = []
+        while time.monotonic() < deadline:
+            reports = [r for r in telemetry.stall_reports()
+                       if r["reason"] == swtrace.STALL_REASONS[0]]
+            if reports:
+                break
+            await asyncio.sleep(0.1)
+        assert reports, (
+            f"{client_engine}->{server_engine}: no stall-flush report "
+            f"within 20s; reports={telemetry.stall_reports()}")
+        r = reports[0]
+        assert r["age_ms"] >= 250
+        assert "events" in r  # the last ring events ride the report
+
+        alerts = client._client.counters_snapshot()["stall_alerts"]
+        assert alerts >= 1, f"stall_alerts did not move ({alerts})"
+
+        dumps = []
+        flight = tmp_path / "flight"
+        for p in (flight.glob("flight-*.json") if flight.is_dir() else ()):
+            payload = json.loads(p.read_text())
+            if payload.get("trigger") == "stall":
+                dumps.append(payload)
+        assert dumps, "no flight dump with the `stall` trigger"
+        assert dumps[0]["reason"] == swtrace.STALL_REASONS[0]
+        assert "hists" in dumps[0]  # the distributions ride the dump
+    finally:
+        proxy.unstall()
+        flush.cancel()
+        await client.aclose()
+        await server.aclose()
+        proxy.stop()
+        telemetry.reset()
+        swtrace.reset()
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+async def test_healthy_run_stays_alert_free(port, monkeypatch, engine):
+    """Sentinel armed, nothing wedged: a normal op sequence (with idle
+    gaps longer than the threshold) raises no alert -- the sentinel
+    flags wedges, not slowness or idleness."""
+    if engine == "native" and not _native_available():
+        pytest.skip("native engine unavailable")
+    _env(monkeypatch)
+    monkeypatch.setenv("STARWAY_STALL_MS", "100")
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if engine == "native" else "0")
+    server = Server()
+    server.listen(ADDR, port)
+    client = Client()
+    await client.aconnect(ADDR, port)
+    try:
+        await _drive(server, client)
+        await asyncio.sleep(0.6)  # several sentinel periods of pure idle
+        await _drive(server, client)
+        cs = client._client.counters_snapshot()
+        ss = server._server.counters_snapshot()
+    finally:
+        await client.aclose()
+        await server.aclose()
+        telemetry.reset()
+        swtrace.reset()
+    assert cs["stall_alerts"] == 0, cs
+    assert ss["stall_alerts"] == 0, ss
+    assert telemetry.stall_reports() == []
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+async def test_seed_path_sentinel_dark(port, monkeypatch, engine):
+    """Env unset: no trace ring, no alerts, no telemetry registration --
+    the sentinel is strictly opt-in and the histograms add no events."""
+    if engine == "native" and not _native_available():
+        pytest.skip("native engine unavailable")
+    _env(monkeypatch)
+    monkeypatch.setenv("STARWAY_NATIVE", "1" if engine == "native" else "0")
+    assert not telemetry.armed()
+    server = Server()
+    server.listen(ADDR, port)
+    client = Client()
+    await client.aconnect(ADDR, port)
+    try:
+        await _drive(server, client)
+        cs = client._client.counters_snapshot()
+        events = client._client.trace_events()
+        hists = client._client.hists_snapshot()
+    finally:
+        await client.aclose()
+        await server.aclose()
+    assert cs["stall_alerts"] == 0
+    assert events == []  # ring never armed: seed trace parity
+    assert sum(hists["msg_bytes"]) >= 4  # ...but the pulse is always on
+    assert telemetry.stall_reports() == []

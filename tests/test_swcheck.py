@@ -1989,3 +1989,56 @@ def test_cost_budget_waiver_on_ledger_row(tmp_path):
         f"{_SWA}(cost-budget): exercising the waiver path", 1))
     assert _findings(root, "cost-budget") == []
     assert _findings(root, "bad-waiver") == []
+
+
+# ------------------- ISSUE 19: the swpulse contract surface (DESIGN §25)
+#
+# swpulse grew a histogram vocabulary (HIST_NAMES <-> kHistNames[]), a
+# bucket resolution (HIST_BUCKETS <-> kHistBuckets), and a stall-reason
+# vocabulary (STALL_REASONS <-> kStallReasons[]) -- all cross-engine
+# contract surface held by the contract-pulse pass.
+
+
+def test_hist_dropped_from_cpp(tmp_path):
+    # Renaming a histogram in the C++ array alone fires on BOTH sides of
+    # the set diff (a histogram added to one engine only).
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp", '"flush_us",       //',
+          '"flush_us_v2",    //')
+    _assert_caught(root, "contract-pulse", "flush_us_v2", "sw_engine.cpp")
+    _assert_caught(root, "contract-pulse", "'flush_us'", "swtrace.py")
+
+
+def test_hist_added_to_python_only(tmp_path):
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/swtrace.py",
+          '"msg_bytes",', '"msg_bytes",\n    "rtt_us",')
+    _assert_caught(root, "contract-pulse", "rtt_us", "swtrace.py")
+
+
+def test_hist_bucket_resolution_drift(tmp_path):
+    # The bucket count IS the bucket-boundary contract (base-2 buckets):
+    # shrinking the native array alone must fire.
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp",
+          "constexpr int kHistBuckets = 64;",
+          "constexpr int kHistBuckets = 32;")
+    _assert_caught(root, "contract-pulse", "kHistBuckets = 32", "swtrace.py")
+
+
+def test_stall_reason_reworded(tmp_path):
+    # Stall reports carry the reason string verbatim from either engine:
+    # rewording one side alone must fire.
+    root = _seed(tmp_path)
+    _edit(root, "native/sw_engine.cpp", '"stall-credit",', '"stall-credits",')
+    _assert_caught(root, "contract-pulse", "stall-credits", "sw_engine.cpp")
+
+
+def test_hist_vocabulary_vacuity_guard(tmp_path):
+    # An extractor that silently loses the vocabulary must be a finding,
+    # never a vacuous pass.
+    root = _seed(tmp_path)
+    _edit(root, "starway_tpu/core/swtrace.py",
+          "HIST_NAMES = (", "HIST_LABELS = (")
+    _assert_caught(root, "contract-pulse", "HIST_NAMES tuple not found",
+                   "swtrace.py")

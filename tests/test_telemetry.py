@@ -117,6 +117,7 @@ async def test_sampler_counter_delta_parity(port, monkeypatch, engine):
         assert detail["armed"] is True
         assert detail["samples"][-1]["mono"] == s2["mono"]
         assert set(detail["gauges"]) == {"conns", "posted_recvs",
+                                         "uring_depth",
                                          "staging_pool_bytes",
                                          "reshard_staging_bytes",
                                          "reshard_staging_peak"}
@@ -269,11 +270,27 @@ async def test_jsonl_emitter_and_metrics_cli(port, monkeypatch, tmp_path,
     assert monos == sorted(monos)
     assert all("workers" in s and "t" in s for s in lines)
 
+    # §25 swpulse: every sampled worker carries the compact percentile
+    # view of its histograms, and the post-op sample shows the send.
+    for s in lines:
+        for wk in s["workers"].values():
+            hists = wk["hists"]
+            assert sorted(hists) == sorted(swtrace.HIST_NAMES)
+            assert all(set(h) == {"count", "p50", "p90", "p99", "p999"}
+                       for h in hists.values())
+    last = lines[-1]["workers"]
+    sender = next(wk for lbl, wk in last.items() if lbl.startswith("client-"))
+    assert sender["hists"]["msg_bytes"]["count"] >= 1
+    assert sender["hists"]["msg_bytes"]["p50"] >= 4095
+
     rc = metrics_mod.main([str(out), "--once"])
     assert rc == 0
     printed = capsys.readouterr().out
     assert "2 sample(s)" in printed
     assert "client-" in printed and "server-" in printed
+    # The viewer renders a percentile row per populated histogram.
+    assert "msg_bytes: n=" in printed and "p999=" in printed
+    assert "send_local_us: n=" in printed
     # An unreadable source is a clean error, not a traceback.
     assert metrics_mod.main([str(tmp_path / "absent.jsonl"), "--once"]) == 1
 
@@ -364,3 +381,45 @@ async def test_bench_metrics_file_renders_with_metrics_once(tmp_path,
     assert rc == 0
     printed = capsys.readouterr().out
     assert f"{len(lines)} sample(s)" in printed
+
+
+# --------------------------------------- ring dumps survive trace --merge
+
+
+async def test_ring_dump_hists_survive_trace_merge(port, monkeypatch,
+                                                   tmp_path, capsys):
+    """§25 swpulse end-to-end through the §15 stitching path: a traced
+    run's ring dump (swtrace.write_ring_dump) carries the histogram
+    buckets, and ``python -m starway_tpu.trace --merge`` surfaces them in
+    the merged doc's per-worker percentile view."""
+    from starway_tpu import trace as trace_mod
+
+    _env(monkeypatch, native=False)
+    monkeypatch.setenv("STARWAY_TRACE", "1")
+    swtrace.reset()
+    server, client = await _pair(port)
+    try:
+        sink = np.empty(4096, dtype=np.uint8)
+        fut = server.arecv(sink, 9, MASK)
+        await client.asend(np.ones(4096, dtype=np.uint8), 9)
+        await fut
+        await client.aflush()
+        dump = swtrace.write_ring_dump(tmp_path / "ring.json")
+    finally:
+        await client.aclose()
+        await server.aclose()
+
+    raw = json.loads(dump.read_text())
+    assert any(w.get("hists") for w in raw["workers"]), raw["workers"]
+
+    out = tmp_path / "merged.json"
+    rc = trace_mod.main([str(dump), "--merge", "-o", str(out)])
+    assert rc == 0
+    capsys.readouterr()
+    doc = json.loads(out.read_text())
+    pulse = doc["swscope"]["pulse"]
+    assert pulse, "merged doc lost the swpulse distributions"
+    sender = next(h for lbl, h in pulse.items() if "client-" in lbl)
+    assert sender["msg_bytes"]["count"] >= 1
+    assert sender["msg_bytes"]["p50"] >= 4095
+    assert set(sender) == set(swtrace.HIST_NAMES)
